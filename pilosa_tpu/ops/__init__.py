@@ -1,0 +1,2 @@
+"""Bitset kernel layer — the TPU replacement for the reference's roaring
+container kernels (/root/reference/roaring/roaring.go:2313-3607)."""

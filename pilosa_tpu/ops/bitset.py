@@ -1,0 +1,213 @@
+"""Dense packed-bitset kernels for TPU.
+
+This is the L0 of the framework: where the reference implements nine families
+of pairwise container kernels over three container encodings
+(/root/reference/roaring/roaring.go:2313-3607 — intersect*/union*/difference*/
+xor*/intersectionCount*/shift*/flip* for array/bitmap/run), we keep exactly one
+device encoding — a dense packed bitset — and let every op be a fused XLA
+elementwise + reduction over uint32 words.
+
+Layout
+------
+A *shard row* is one row of one fragment restricted to a 2^20-column shard
+(ShardWidth, /root/reference/fragment.go:50). On device it is a
+`uint32[WORDS_PER_SHARD]` array (32768 words = 128 KiB). uint32 rather than
+uint64 because the TPU VPU has 32-bit lanes; XLA legalizes u64 bitwise ops into
+u32 pairs anyway, so we store u32 natively and avoid the round trip.
+
+Bit p (0 <= p < 2^20) lives in word p >> 5, bit p & 31 — identical to the
+little-endian uint64 layout viewed as pairs of uint32, so host numpy uint64
+buffers convert with a zero-copy ``.view('<u4')``.
+
+All ops are pure jnp functions over arrays whose *last* axis is words; any
+leading axes (rows, shards) batch for free. Compositions are jitted at the
+executor layer so XLA fuses e.g. Count(Intersect(a,b)) into a single
+AND+popcount pass without materializing the intersection — the moral
+equivalent of the reference's fused `intersectionCountBitmapBitmap`
+(/root/reference/roaring/roaring.go:2438), generalized to every op pair.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Shard geometry. ShardWidth mirrors /root/reference/fragment.go:50-51
+# (2^20 columns per shard); it must stay a power of two and a multiple of
+# the container width 2^16 so host roaring containers tile it exactly.
+SHARD_WIDTH_EXP = 20
+SHARD_WIDTH = 1 << SHARD_WIDTH_EXP  # 1,048,576 columns per shard
+WORD_BITS = 32
+WORDS_PER_SHARD = SHARD_WIDTH // WORD_BITS  # 32,768 uint32 words (128 KiB)
+
+WORD_DTYPE = jnp.uint32
+NP_WORD_DTYPE = np.uint32
+
+# ---------------------------------------------------------------------------
+# Elementwise set algebra. Last axis = words; leading axes batch.
+# ---------------------------------------------------------------------------
+
+
+def b_and(a, b):
+    """Intersect (reference: roaring.go:497 Intersect / :2630 bitmap∧bitmap)."""
+    return jnp.bitwise_and(a, b)
+
+
+def b_or(a, b):
+    """Union (reference: roaring.go:522)."""
+    return jnp.bitwise_or(a, b)
+
+
+def b_xor(a, b):
+    """Xor (reference: roaring.go:837)."""
+    return jnp.bitwise_xor(a, b)
+
+
+def b_andnot(a, b):
+    """Difference a \\ b (reference: roaring.go:810)."""
+    return jnp.bitwise_and(a, jnp.bitwise_not(b))
+
+
+def b_not(a, existence):
+    """Not(a) relative to an existence row (reference executor computes Not as
+    existence-difference, /root/reference/executor.go:1556-1587)."""
+    return jnp.bitwise_and(existence, jnp.bitwise_not(a))
+
+
+def union_many(stack, axis=0):
+    """N-way union over a stacked axis (reference UnionInPlace,
+    roaring.go:536 — the bulk union used by time-range row reads)."""
+    return jax.lax.reduce(
+        stack,
+        jnp.zeros((), dtype=stack.dtype),
+        jnp.bitwise_or,
+        (axis if axis >= 0 else stack.ndim + axis,),
+    )
+
+
+def intersect_many(stack, axis=0):
+    """N-way intersection over a stacked axis."""
+    return jax.lax.reduce(
+        stack,
+        jnp.bitwise_not(jnp.zeros((), dtype=stack.dtype)),
+        jnp.bitwise_and,
+        (axis if axis >= 0 else stack.ndim + axis,),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Counting. popcount reduces the word axis; XLA fuses it into whatever
+# elementwise op produced the words.
+# ---------------------------------------------------------------------------
+
+
+def popcount(a, axis=-1):
+    """Total set bits, reduced over `axis` (reference Count, roaring.go:319).
+
+    Returns uint32: one reduced axis covers at most one shard row
+    (2^20 bits), and even a full 1024-shard stack is 2^30 < 2^32. Promote
+    on the host when aggregating across many reductions."""
+    return jnp.sum(jax.lax.population_count(a).astype(jnp.uint32), axis=axis,
+                   dtype=jnp.uint32)
+
+
+def count_and(a, b):
+    """|a ∧ b| fused (reference IntersectionCount, roaring.go:472/2438)."""
+    return popcount(jnp.bitwise_and(a, b))
+
+
+def count_or(a, b):
+    return popcount(jnp.bitwise_or(a, b))
+
+
+def count_xor(a, b):
+    return popcount(jnp.bitwise_xor(a, b))
+
+
+def count_andnot(a, b):
+    return popcount(jnp.bitwise_and(a, jnp.bitwise_not(b)))
+
+
+# ---------------------------------------------------------------------------
+# Shifts and masks.
+# ---------------------------------------------------------------------------
+
+
+def shift_bits(a, n=1):
+    """Shift every bit position up by n within the shard (reference
+    roaring.Shift, roaring.go:865, used by executeShiftShard,
+    executor.go:1591). Bits shifted past the top of the shard are dropped —
+    matching the reference's per-rowSegment shift (row.go:180-197), which
+    does not carry across shard boundaries either.
+    """
+    if n == 0:
+        return a
+    word_shift = n // WORD_BITS
+    bit_shift = n % WORD_BITS
+    # Move whole words by padding at the low end of the word axis.
+    if word_shift:
+        pad = [(0, 0)] * (a.ndim - 1) + [(word_shift, 0)]
+        a = jnp.pad(a, pad)[..., : a.shape[-1]]
+    if bit_shift:
+        hi = jnp.left_shift(a, jnp.uint32(bit_shift))
+        carry = jnp.right_shift(a, jnp.uint32(WORD_BITS - bit_shift))
+        pad = [(0, 0)] * (a.ndim - 1) + [(1, 0)]
+        carry = jnp.pad(carry, pad)[..., : a.shape[-1]]
+        a = jnp.bitwise_or(hi, carry)
+    return a
+
+
+def range_mask_np(start: int, end: int, words: int = WORDS_PER_SHARD) -> np.ndarray:
+    """Host-built uint32 mask with bits [start, end) set. Used for
+    CountRange/OffsetRange-style column windows; built once per query on the
+    host, so plain numpy."""
+    mask = np.zeros(words, dtype=np.uint32)
+    start = max(0, start)
+    end = min(end, words * WORD_BITS)
+    if end <= start:
+        return mask
+    w0, b0 = divmod(start, WORD_BITS)
+    w1, b1 = divmod(end, WORD_BITS)
+    if w0 == w1:
+        mask[w0] = (np.uint64((1 << b1) - (1 << b0))).astype(np.uint32)
+    else:
+        mask[w0] = np.uint32(((1 << WORD_BITS) - (1 << b0)) & 0xFFFFFFFF)
+        mask[w0 + 1 : w1] = np.uint32(0xFFFFFFFF)
+        if b1:
+            mask[w1] = np.uint32((1 << b1) - 1)
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device packing helpers (numpy; the storage layer owns durability).
+# ---------------------------------------------------------------------------
+
+
+def pack_positions(positions, width: int = SHARD_WIDTH) -> np.ndarray:
+    """Pack sorted/unsorted bit positions (< width) into a uint32 word array."""
+    words = np.zeros(width // WORD_BITS, dtype=np.uint32)
+    if len(positions) == 0:
+        return words
+    pos = np.asarray(positions, dtype=np.uint64)
+    w = (pos >> np.uint64(5)).astype(np.int64)
+    b = (pos & np.uint64(31)).astype(np.uint32)
+    np.bitwise_or.at(words, w, np.left_shift(np.uint32(1), b))
+    return words
+
+
+def unpack_positions(words: np.ndarray) -> np.ndarray:
+    """Inverse of pack_positions: word array -> sorted uint64 bit positions."""
+    words = np.ascontiguousarray(words, dtype=np.uint32)
+    bytes_ = words.view(np.uint8)
+    bits = np.unpackbits(bytes_, bitorder="little")
+    return np.nonzero(bits)[0].astype(np.uint64)
+
+
+def u64_to_words(buf: np.ndarray) -> np.ndarray:
+    """Zero-copy view of a little-endian uint64 bitmap buffer as u32 words."""
+    return np.ascontiguousarray(buf).view("<u4")
+
+
+def words_to_u64(words: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(words).view("<u8")

@@ -1,0 +1,10 @@
+"""Host storage layer: Pilosa-roaring-format durability + ops log.
+
+The reference keeps roaring containers as its *in-memory compute*
+representation (/root/reference/roaring/roaring.go). In the TPU rebuild the
+compute representation is dense packed words in HBM; roaring survives here as
+the durable interchange format (file cookie 12348) plus a numpy-dense host
+bitmap used for writes, imports, and the CPU baseline path.
+"""
+
+from pilosa_tpu.storage.roaring import Bitmap, MAGIC_NUMBER  # noqa: F401

@@ -1,0 +1,606 @@
+"""Pilosa roaring bitmap: host implementation + file format codec.
+
+File format (binary-compatible with the reference; spec per
+/root/reference/docs/architecture.md "Roaring bitmap storage format" and
+writer/reader /root/reference/roaring/roaring.go:963-1126):
+
+    bytes 0-1   magic number 12348 (little-endian uint16)
+    bytes 2-3   storage version (0)
+    bytes 4-7   container count N (uint32)
+    N x 12      descriptive header: uint64 key, uint16 container type
+                (1=array, 2=bitmap, 3=run), uint16 cardinality-1
+    N x 4       offset header: absolute uint32 byte offset of each container
+    ...         container payloads:
+                  array : n x uint16 sorted values
+                  bitmap: 1024 x uint64 words
+                  run   : uint16 run count, then (uint16 start, uint16 last)*
+    ...         ops log until EOF (op format roaring.go:3628-3691):
+                  byte type (0 add, 1 remove, 2 addBatch, 3 removeBatch)
+                  uint64 value-or-count, uint32 fnv1a checksum,
+                  batch ops: count x uint64 values
+
+In-memory representation: every non-empty container is held *dense* as
+uint64[1024] in a dict keyed by the 48-bit container key. Dense-only is a
+deliberate divergence from the reference's three-encoding polymorphism: the
+host bitmap exists for mutation, durability and the CPU baseline, not as the
+query hot path (that's HBM), and dense numpy makes every mutation a vector op.
+The three encodings are still produced on write (smallest wins, mirroring
+Optimize, roaring.go:1745) and accepted on read.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+MAGIC_NUMBER = 12348
+STORAGE_VERSION = 0
+COOKIE = MAGIC_NUMBER | (STORAGE_VERSION << 16)
+HEADER_BASE_SIZE = 8
+
+CONTAINER_ARRAY = 1
+CONTAINER_BITMAP = 2
+CONTAINER_RUN = 3
+
+CONTAINER_BITS = 1 << 16
+CONTAINER_WORDS = CONTAINER_BITS // 64  # 1024 uint64 words
+ARRAY_MAX_SIZE = 4096  # below this an array encoding beats a bitmap
+RUN_COUNT_HEADER_SIZE = 2
+MAX_CONTAINER_KEY = (1 << 48) - 1
+
+OP_ADD = 0
+OP_REMOVE = 1
+OP_ADD_BATCH = 2
+OP_REMOVE_BATCH = 3
+
+_FNV_OFFSET = 0x811C9DC5
+_FNV_PRIME = 0x01000193
+
+
+def fnv1a32(*chunks: bytes) -> int:
+    """FNV-1a 32-bit, matching Go's hash/fnv.New32a used for op checksums
+    (roaring.go:3647-3650)."""
+    h = _FNV_OFFSET
+    for chunk in chunks:
+        for byte in chunk:
+            h = ((h ^ byte) * _FNV_PRIME) & 0xFFFFFFFF
+    return h
+
+
+# numpy >= 2.0 has a native popcount ufunc; keep a table fallback.
+if hasattr(np, "bitwise_count"):
+    def _popcount_words(words: np.ndarray) -> int:
+        return int(np.bitwise_count(words).sum())
+else:  # pragma: no cover
+    _POP_TABLE = np.array([bin(i).count("1") for i in range(256)], dtype=np.uint16)
+
+    def _popcount_words(words: np.ndarray) -> int:
+        return int(_POP_TABLE[words.view(np.uint8)].sum())
+
+
+def _new_container() -> np.ndarray:
+    return np.zeros(CONTAINER_WORDS, dtype=np.uint64)
+
+
+def _dense_to_array(dense: np.ndarray) -> np.ndarray:
+    bits = np.unpackbits(dense.view(np.uint8), bitorder="little")
+    return np.nonzero(bits)[0].astype(np.uint16)
+
+
+def _array_to_dense(values: np.ndarray) -> np.ndarray:
+    dense = _new_container()
+    if len(values):
+        v = values.astype(np.uint32)
+        np.bitwise_or.at(
+            dense, v >> 6, np.left_shift(np.uint64(1), (v & 63).astype(np.uint64))
+        )
+    return dense
+
+
+def _runs_to_dense(runs: np.ndarray) -> np.ndarray:
+    """runs: (n, 2) uint16 [start, last] inclusive pairs."""
+    dense = _new_container()
+    bits = np.zeros(CONTAINER_BITS, dtype=np.uint8)
+    for start, last in runs:
+        bits[int(start) : int(last) + 1] = 1
+    dense |= np.packbits(bits, bitorder="little").view(np.uint64)
+    return dense
+
+
+def _dense_to_runs(dense: np.ndarray) -> np.ndarray:
+    bits = np.unpackbits(dense.view(np.uint8), bitorder="little")
+    diff = np.diff(np.concatenate(([0], bits, [0])).astype(np.int8))
+    starts = np.nonzero(diff == 1)[0]
+    ends = np.nonzero(diff == -1)[0] - 1
+    return np.stack([starts, ends], axis=1).astype(np.uint16)
+
+
+def _num_runs(dense: np.ndarray) -> int:
+    bits = np.unpackbits(dense.view(np.uint8), bitorder="little")
+    diff = np.diff(np.concatenate(([0], bits)).astype(np.int8))
+    return int((diff == 1).sum())
+
+
+class Bitmap:
+    """A 64-bit-keyed roaring bitmap, dense-container host implementation.
+
+    Mirrors the public surface of the reference's roaring.Bitmap
+    (roaring.go:119) that the rest of the framework uses: Add/Remove/Contains/
+    Count/CountRange/Max/Slice/ForEach, set algebra, OffsetRange, Shift, Flip,
+    serialization, and the append-only ops log (OpWriter, roaring.go:1128).
+    """
+
+    __slots__ = ("containers", "_counts", "op_writer", "op_n")
+
+    def __init__(self, positions: Optional[Iterable[int]] = None):
+        self.containers: Dict[int, np.ndarray] = {}
+        self._counts: Dict[int, int] = {}
+        self.op_writer: Optional[io.RawIOBase] = None
+        self.op_n = 0
+        if positions is not None:
+            self.direct_add_n(np.asarray(list(positions), dtype=np.uint64))
+
+    # -- container plumbing -------------------------------------------------
+
+    def _container(self, key: int, create: bool = False) -> Optional[np.ndarray]:
+        c = self.containers.get(key)
+        if c is None and create:
+            c = _new_container()
+            self.containers[key] = c
+        return c
+
+    def _invalidate(self, key: int) -> None:
+        self._counts.pop(key, None)
+
+    def container_count(self, key: int) -> int:
+        n = self._counts.get(key)
+        if n is None:
+            c = self.containers.get(key)
+            n = _popcount_words(c) if c is not None else 0
+            self._counts[key] = n
+        return n
+
+    def _drop_empty(self, key: int) -> None:
+        if key in self.containers and self.container_count(key) == 0:
+            del self.containers[key]
+            self._invalidate(key)
+
+    # -- point ops ----------------------------------------------------------
+
+    def add(self, *positions: int) -> bool:
+        """Add with op-log append (reference Add, roaring.go:161)."""
+        changed = False
+        for p in positions:
+            if self._direct_add(int(p)):
+                changed = True
+                self._write_op(OP_ADD, value=p)
+        return changed
+
+    def _direct_add(self, p: int) -> bool:
+        key, low = p >> 16, p & 0xFFFF
+        c = self._container(key, create=True)
+        w, b = low >> 6, np.uint64(1 << (low & 63))
+        if c[w] & b:
+            return False
+        c[w] |= b
+        self._invalidate(key)
+        return True
+
+    def direct_add(self, p: int) -> bool:
+        return self._direct_add(int(p))
+
+    def remove(self, *positions: int) -> bool:
+        changed = False
+        for p in positions:
+            if self._direct_remove(int(p)):
+                changed = True
+                self._write_op(OP_REMOVE, value=p)
+        return changed
+
+    def _direct_remove(self, p: int) -> bool:
+        key, low = p >> 16, p & 0xFFFF
+        c = self.containers.get(key)
+        if c is None:
+            return False
+        w, b = low >> 6, np.uint64(1 << (low & 63))
+        if not (c[w] & b):
+            return False
+        c[w] &= ~b
+        self._invalidate(key)
+        self._drop_empty(key)
+        return True
+
+    def contains(self, p: int) -> bool:
+        p = int(p)
+        c = self.containers.get(p >> 16)
+        if c is None:
+            return False
+        low = p & 0xFFFF
+        return bool(c[low >> 6] & np.uint64(1 << (low & 63)))
+
+    # -- batch ops (the import path; reference DirectAddN / bulkImport) -----
+
+    def direct_add_n(self, positions: np.ndarray) -> int:
+        """Bulk add without op-log (reference DirectAddN). Returns #changed."""
+        if len(positions) == 0:
+            return 0
+        positions = np.unique(np.asarray(positions, dtype=np.uint64))
+        changed = 0
+        keys = (positions >> np.uint64(16)).astype(np.int64)
+        for key in np.unique(keys):
+            group = positions[keys == key]
+            low = (group & np.uint64(0xFFFF)).astype(np.uint32)
+            c = self._container(int(key), create=True)
+            before = self.container_count(int(key))
+            np.bitwise_or.at(
+                c, low >> 6, np.left_shift(np.uint64(1), (low & 63).astype(np.uint64))
+            )
+            self._invalidate(int(key))
+            changed += self.container_count(int(key)) - before
+        return changed
+
+    def direct_remove_n(self, positions: np.ndarray) -> int:
+        if len(positions) == 0:
+            return 0
+        positions = np.unique(np.asarray(positions, dtype=np.uint64))
+        changed = 0
+        keys = (positions >> np.uint64(16)).astype(np.int64)
+        for key in np.unique(keys):
+            c = self.containers.get(int(key))
+            if c is None:
+                continue
+            group = positions[keys == key]
+            low = (group & np.uint64(0xFFFF)).astype(np.uint32)
+            mask = _new_container()
+            np.bitwise_or.at(
+                mask, low >> 6, np.left_shift(np.uint64(1), (low & 63).astype(np.uint64))
+            )
+            before = self.container_count(int(key))
+            c &= ~mask
+            self._invalidate(int(key))
+            after = self.container_count(int(key))
+            changed += before - after
+            self._drop_empty(int(key))
+        return changed
+
+    def add_batch(self, positions: np.ndarray) -> int:
+        """Bulk add *with* one batch op-log record (op type 2)."""
+        n = self.direct_add_n(positions)
+        if len(positions):
+            self._write_op(OP_ADD_BATCH, values=np.asarray(positions, dtype=np.uint64))
+        return n
+
+    def remove_batch(self, positions: np.ndarray) -> int:
+        n = self.direct_remove_n(positions)
+        if len(positions):
+            self._write_op(OP_REMOVE_BATCH, values=np.asarray(positions, dtype=np.uint64))
+        return n
+
+    # -- queries ------------------------------------------------------------
+
+    def count(self) -> int:
+        return sum(self.container_count(k) for k in self.containers)
+
+    def any(self) -> bool:
+        return any(self.container_count(k) for k in self.containers)
+
+    def max(self) -> int:
+        if not self.containers:
+            return 0
+        key = max(self.containers)
+        arr = _dense_to_array(self.containers[key])
+        return (key << 16) | int(arr[-1])
+
+    def min(self) -> int:
+        if not self.containers:
+            return 0
+        key = min(self.containers)
+        arr = _dense_to_array(self.containers[key])
+        return (key << 16) | int(arr[0])
+
+    def slice(self) -> np.ndarray:
+        """All set positions, sorted (reference Slice, roaring.go:393)."""
+        out: List[np.ndarray] = []
+        for key in sorted(self.containers):
+            arr = _dense_to_array(self.containers[key])
+            if len(arr):
+                out.append((np.uint64(key << 16) + arr.astype(np.uint64)))
+        if not out:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(out)
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.slice().tolist())
+
+    def count_range(self, start: int, end: int) -> int:
+        """Count of bits in [start, end) (reference CountRange, roaring.go:335)."""
+        if end <= start:
+            return 0
+        total = 0
+        k0, k1 = start >> 16, (end - 1) >> 16
+        for key in self.containers:
+            if key < k0 or key > k1:
+                continue
+            if k0 < key < k1:
+                total += self.container_count(key)
+            else:
+                lo = start - (key << 16) if key == k0 else 0
+                hi = end - (key << 16) if key == k1 else CONTAINER_BITS
+                lo, hi = max(lo, 0), min(hi, CONTAINER_BITS)
+                arr = _dense_to_array(self.containers[key])
+                total += int(np.count_nonzero((arr >= lo) & (arr < hi)))
+        return total
+
+    def offset_range(self, offset: int, start: int, end: int) -> "Bitmap":
+        """Slice bits in [start, end) and rebase them at `offset` (reference
+        OffsetRange, roaring.go:439 — the fragment row-read primitive,
+        fragment.go:378). offset/start/end must be container-aligned."""
+        assert offset & 0xFFFF == 0 and start & 0xFFFF == 0 and end & 0xFFFF == 0
+        other = Bitmap()
+        off_key = offset >> 16
+        hi0, hi1 = start >> 16, end >> 16
+        for key, c in self.containers.items():
+            if hi0 <= key < hi1:
+                if self.container_count(key):
+                    other.containers[off_key + (key - hi0)] = c.copy()
+        return other
+
+    def dense_range(self, start: int, end: int) -> np.ndarray:
+        """Dense uint64 words for bits [start, end) (container-aligned) —
+        the host->HBM handoff: returns ((end-start)//64) words."""
+        assert start & 0xFFFF == 0 and end & 0xFFFF == 0
+        n_containers = (end - start) >> 16
+        out = np.zeros(n_containers * CONTAINER_WORDS, dtype=np.uint64)
+        k0 = start >> 16
+        for i in range(n_containers):
+            c = self.containers.get(k0 + i)
+            if c is not None:
+                out[i * CONTAINER_WORDS : (i + 1) * CONTAINER_WORDS] = c
+        return out
+
+    def set_dense_range(self, start: int, dense: np.ndarray) -> None:
+        """Overwrite container-aligned region from dense uint64 words."""
+        assert start & 0xFFFF == 0 and len(dense) % CONTAINER_WORDS == 0
+        k0 = start >> 16
+        for i in range(len(dense) // CONTAINER_WORDS):
+            chunk = dense[i * CONTAINER_WORDS : (i + 1) * CONTAINER_WORDS]
+            key = k0 + i
+            if chunk.any():
+                self.containers[key] = np.array(chunk, dtype=np.uint64)
+                self._invalidate(key)
+            elif key in self.containers:
+                del self.containers[key]
+                self._invalidate(key)
+
+    def for_each_range(self, start: int, end: int):
+        s = self.slice()
+        return s[(s >= start) & (s < end)]
+
+    # -- set algebra (host path / CPU baseline) -----------------------------
+
+    def _binary(self, other: "Bitmap", op, keys) -> "Bitmap":
+        out = Bitmap()
+        zero = None
+        for key in keys:
+            a = self.containers.get(key)
+            b = other.containers.get(key)
+            if a is None or b is None:
+                if zero is None:
+                    zero = _new_container()
+                a = a if a is not None else zero
+                b = b if b is not None else zero
+            res = op(a, b)
+            if res.any():
+                out.containers[key] = res
+        return out
+
+    def intersect(self, other: "Bitmap") -> "Bitmap":
+        keys = self.containers.keys() & other.containers.keys()
+        return self._binary(other, np.bitwise_and, keys)
+
+    def union(self, other: "Bitmap") -> "Bitmap":
+        keys = self.containers.keys() | other.containers.keys()
+        return self._binary(other, np.bitwise_or, keys)
+
+    def difference(self, other: "Bitmap") -> "Bitmap":
+        keys = self.containers.keys()
+        return self._binary(other, lambda a, b: a & ~b, keys)
+
+    def xor(self, other: "Bitmap") -> "Bitmap":
+        keys = self.containers.keys() | other.containers.keys()
+        return self._binary(other, np.bitwise_xor, keys)
+
+    def intersection_count(self, other: "Bitmap") -> int:
+        total = 0
+        for key in self.containers.keys() & other.containers.keys():
+            total += _popcount_words(self.containers[key] & other.containers[key])
+        return total
+
+    def union_in_place(self, *others: "Bitmap") -> None:
+        """N-way in-place union (reference UnionInPlace, roaring.go:536)."""
+        for other in others:
+            for key, b in other.containers.items():
+                a = self.containers.get(key)
+                if a is None:
+                    self.containers[key] = b.copy()
+                else:
+                    a |= b
+                self._invalidate(key)
+
+    def shift(self, n: int = 1) -> "Bitmap":
+        """Shift all bit positions up by n (reference Shift, roaring.go:865)."""
+        return Bitmap(self.slice() + np.uint64(n))
+
+    def flip(self, start: int, end: int) -> "Bitmap":
+        """Flip bits in [start, end] inclusive (reference Flip, roaring.go:1185).
+        Vectorized: XOR each touched container with a range mask; only the two
+        boundary containers need partial masks."""
+        out = Bitmap(self.slice())
+        k0, k1 = start >> 16, end >> 16
+        for key in range(k0, k1 + 1):
+            lo = start - (key << 16) if key == k0 else 0
+            hi = end - (key << 16) + 1 if key == k1 else CONTAINER_BITS
+            c = out._container(key, create=True)
+            if lo == 0 and hi == CONTAINER_BITS:
+                c ^= np.uint64(0xFFFFFFFFFFFFFFFF)
+            else:
+                bits = np.zeros(CONTAINER_BITS, dtype=np.uint8)
+                bits[lo:hi] = 1
+                c ^= np.packbits(bits, bitorder="little").view(np.uint64)
+            out._invalidate(key)
+            out._drop_empty(key)
+        return out
+
+    # -- ops log ------------------------------------------------------------
+
+    def _write_op(self, typ: int, value: int = 0, values: Optional[np.ndarray] = None):
+        self.op_n += 1 if values is None else len(values)
+        if self.op_writer is None:
+            return
+        self.op_writer.write(encode_op(typ, value, values))
+
+    # -- serialization ------------------------------------------------------
+
+    def write_bytes(self) -> bytes:
+        """Serialize in the reference's file format (roaring.go:963)."""
+        keys = [k for k in sorted(self.containers) if self.container_count(k) > 0]
+        n = len(keys)
+        header = io.BytesIO()
+        header.write(struct.pack("<II", COOKIE, n))
+        payloads: List[bytes] = []
+        for key in keys:
+            dense = self.containers[key]
+            card = self.container_count(key)
+            n_runs = _num_runs(dense)
+            # Pick smallest encoding: sizes are 2*card (array),
+            # 8192 (bitmap), 2 + 4*n_runs (run) — the Optimize rule,
+            # roaring.go:1745-1805.
+            run_size = RUN_COUNT_HEADER_SIZE + 4 * n_runs
+            array_size = 2 * card
+            if run_size < min(array_size, 8192):
+                typ = CONTAINER_RUN
+                runs = _dense_to_runs(dense)
+                payloads.append(
+                    struct.pack("<H", len(runs))
+                    + runs.astype("<u2").tobytes()
+                )
+            elif array_size < 8192:
+                typ = CONTAINER_ARRAY
+                payloads.append(_dense_to_array(dense).astype("<u2").tobytes())
+            else:
+                typ = CONTAINER_BITMAP
+                payloads.append(dense.astype("<u8").tobytes())
+            header.write(struct.pack("<QHH", key, typ, card - 1))
+        offset = HEADER_BASE_SIZE + n * 12 + n * 4
+        for p in payloads:
+            header.write(struct.pack("<I", offset))
+            offset += len(p)
+        return header.getvalue() + b"".join(payloads)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Bitmap":
+        """Deserialize (reference unmarshalPilosaRoaring, roaring.go:1037),
+        including ops-log replay from the file tail."""
+        b = cls()
+        b.read_bytes(data)
+        return b
+
+    def read_bytes(self, data: bytes) -> None:
+        if len(data) < HEADER_BASE_SIZE:
+            raise ValueError("data too small")
+        magic, version = struct.unpack_from("<HH", data, 0)
+        if magic != MAGIC_NUMBER:
+            raise ValueError(f"invalid roaring file, magic number {magic}")
+        if version != STORAGE_VERSION:
+            raise ValueError(f"wrong roaring version v{version}")
+        (n,) = struct.unpack_from("<I", data, 4)
+        self.containers.clear()
+        self._counts.clear()
+        metas: List[Tuple[int, int, int]] = []
+        pos = HEADER_BASE_SIZE
+        for _ in range(n):
+            key, typ, card_minus_1 = struct.unpack_from("<QHH", data, pos)
+            metas.append((key, typ, card_minus_1 + 1))
+            pos += 12
+        ops_offset = pos + 4 * n
+        for i, (key, typ, card) in enumerate(metas):
+            (offset,) = struct.unpack_from("<I", data, pos + 4 * i)
+            if offset >= len(data):
+                raise ValueError(f"offset out of bounds: {offset}")
+            if typ == CONTAINER_ARRAY:
+                vals = np.frombuffer(data, dtype="<u2", count=card, offset=offset)
+                self.containers[key] = _array_to_dense(vals)
+                end = offset + 2 * card
+            elif typ == CONTAINER_BITMAP:
+                words = np.frombuffer(
+                    data, dtype="<u8", count=CONTAINER_WORDS, offset=offset
+                )
+                self.containers[key] = np.array(words, dtype=np.uint64)
+                end = offset + 8 * CONTAINER_WORDS
+            elif typ == CONTAINER_RUN:
+                (run_n,) = struct.unpack_from("<H", data, offset)
+                runs = np.frombuffer(
+                    data, dtype="<u2", count=run_n * 2,
+                    offset=offset + RUN_COUNT_HEADER_SIZE,
+                ).reshape(-1, 2)
+                self.containers[key] = _runs_to_dense(runs)
+                end = offset + RUN_COUNT_HEADER_SIZE + 4 * run_n
+            else:
+                raise ValueError(f"unknown container type {typ}")
+            del card  # header cardinality untrusted; dense payload is authoritative
+            ops_offset = max(ops_offset, end)
+        # Ops log replay.
+        self.op_n = 0
+        buf = memoryview(data)[ops_offset:]
+        while len(buf):
+            op_typ, value, values, size = decode_op(buf)
+            if op_typ == OP_ADD:
+                self._direct_add(value)
+                self.op_n += 1
+            elif op_typ == OP_REMOVE:
+                self._direct_remove(value)
+                self.op_n += 1
+            elif op_typ == OP_ADD_BATCH:
+                self.direct_add_n(values)
+                self.op_n += len(values)
+            elif op_typ == OP_REMOVE_BATCH:
+                self.direct_remove_n(values)
+                self.op_n += len(values)
+            buf = buf[size:]
+
+
+def encode_op(typ: int, value: int = 0, values: Optional[np.ndarray] = None) -> bytes:
+    """Encode one ops-log record (reference op.WriteTo, roaring.go:3628)."""
+    if typ in (OP_ADD, OP_REMOVE):
+        head = struct.pack("<BQ", typ, int(value))
+        chk = fnv1a32(head)
+        return head + struct.pack("<I", chk)
+    vals = np.asarray(values, dtype="<u8").tobytes()
+    head = struct.pack("<BQ", typ, len(values))
+    chk = fnv1a32(head, vals)
+    return head + struct.pack("<I", chk) + vals
+
+
+def decode_op(buf) -> Tuple[int, int, Optional[np.ndarray], int]:
+    """Decode one op record; returns (type, value, values, encoded_size)."""
+    if len(buf) < 13:
+        raise ValueError(f"op data out of bounds: len={len(buf)}")
+    typ, value = struct.unpack_from("<BQ", buf, 0)
+    (chk,) = struct.unpack_from("<I", buf, 9)
+    if typ in (OP_ADD, OP_REMOVE):
+        if chk != fnv1a32(bytes(buf[0:9])):
+            raise ValueError("op checksum mismatch")
+        return typ, value, None, 13
+    if typ in (OP_ADD_BATCH, OP_REMOVE_BATCH):
+        n = value
+        size = 13 + 8 * n
+        if len(buf) < size:
+            raise ValueError("op data truncated")
+        if chk != fnv1a32(bytes(buf[0:9]), bytes(buf[13:size])):
+            raise ValueError("op checksum mismatch")
+        values = np.frombuffer(buf, dtype="<u8", count=n, offset=13).copy()
+        return typ, 0, values, size
+    raise ValueError(f"invalid op type {typ}")

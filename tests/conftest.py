@@ -1,0 +1,30 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax imports,
+so sharding/mesh tests run anywhere (the driver separately dry-runs the
+multi-chip path; real-TPU benching happens in bench.py, not tests)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tmp_holder(tmp_path):
+    from pilosa_tpu.core.holder import Holder
+
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()

@@ -1,0 +1,119 @@
+"""Golden tests: device bitset kernels vs a plain-numpy model."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from pilosa_tpu.ops import bitset as bs
+
+
+def np_pack(positions):
+    return bs.pack_positions(positions)
+
+
+def rand_positions(rng, n, width=bs.SHARD_WIDTH):
+    return np.unique(rng.integers(0, width, size=n, dtype=np.uint64))
+
+
+def test_pack_unpack_roundtrip(rng):
+    pos = rand_positions(rng, 5000)
+    words = bs.pack_positions(pos)
+    assert words.dtype == np.uint32
+    got = bs.unpack_positions(words)
+    np.testing.assert_array_equal(got, pos)
+
+
+def test_u64_u32_view_roundtrip(rng):
+    u64 = rng.integers(0, 2**63, size=1024, dtype=np.uint64)
+    words = bs.u64_to_words(u64)
+    assert words.dtype == np.uint32 and len(words) == 2048
+    back = bs.words_to_u64(words)
+    np.testing.assert_array_equal(back, u64)
+
+
+def test_bit_position_consistency():
+    # bit p lives at u32 word p>>5, bit p&31, and that layout must agree
+    # with the little-endian u64 view used by host storage.
+    for p in [0, 1, 31, 32, 63, 64, 65, 2**16, 2**20 - 1]:
+        words = bs.pack_positions([p])
+        assert words[p >> 5] == np.uint32(1 << (p & 31))
+        u64 = bs.words_to_u64(words)
+        assert u64[p >> 6] == np.uint64(1 << (p & 63))
+
+
+def test_set_algebra_matches_numpy(rng):
+    a_pos = rand_positions(rng, 20000)
+    b_pos = rand_positions(rng, 20000)
+    a, b = np_pack(a_pos), np_pack(b_pos)
+    ja, jb = jnp.asarray(a), jnp.asarray(b)
+
+    cases = {
+        "and": (bs.b_and(ja, jb), np.intersect1d(a_pos, b_pos)),
+        "or": (bs.b_or(ja, jb), np.union1d(a_pos, b_pos)),
+        "xor": (bs.b_xor(ja, jb), np.setxor1d(a_pos, b_pos)),
+        "andnot": (bs.b_andnot(ja, jb), np.setdiff1d(a_pos, b_pos)),
+    }
+    for name, (got_words, want_pos) in cases.items():
+        got = bs.unpack_positions(np.asarray(got_words))
+        np.testing.assert_array_equal(got, want_pos, err_msg=name)
+
+
+def test_not_with_existence(rng):
+    a_pos = rand_positions(rng, 1000)
+    exist_pos = rand_positions(rng, 5000)
+    ja, je = jnp.asarray(np_pack(a_pos)), jnp.asarray(np_pack(exist_pos))
+    got = bs.unpack_positions(np.asarray(bs.b_not(ja, je)))
+    np.testing.assert_array_equal(got, np.setdiff1d(exist_pos, a_pos))
+
+
+def test_counts(rng):
+    a_pos = rand_positions(rng, 30000)
+    b_pos = rand_positions(rng, 30000)
+    ja, jb = jnp.asarray(np_pack(a_pos)), jnp.asarray(np_pack(b_pos))
+    assert int(bs.popcount(ja)) == len(a_pos)
+    assert int(bs.count_and(ja, jb)) == len(np.intersect1d(a_pos, b_pos))
+    assert int(bs.count_or(ja, jb)) == len(np.union1d(a_pos, b_pos))
+    assert int(bs.count_xor(ja, jb)) == len(np.setxor1d(a_pos, b_pos))
+    assert int(bs.count_andnot(ja, jb)) == len(np.setdiff1d(a_pos, b_pos))
+
+
+def test_popcount_batched(rng):
+    rows = np.stack([np_pack(rand_positions(rng, n)) for n in (10, 100, 1000)])
+    counts = bs.popcount(jnp.asarray(rows), axis=-1)
+    assert counts.shape == (3,)
+    for i, row in enumerate(rows):
+        assert int(counts[i]) == len(bs.unpack_positions(row))
+
+
+def test_union_intersect_many(rng):
+    stacks = [rand_positions(rng, 5000) for _ in range(4)]
+    stack = jnp.asarray(np.stack([np_pack(p) for p in stacks]))
+    got_u = bs.unpack_positions(np.asarray(bs.union_many(stack)))
+    want_u = stacks[0]
+    for p in stacks[1:]:
+        want_u = np.union1d(want_u, p)
+    np.testing.assert_array_equal(got_u, want_u)
+
+    got_i = bs.unpack_positions(np.asarray(bs.intersect_many(stack)))
+    want_i = stacks[0]
+    for p in stacks[1:]:
+        want_i = np.intersect1d(want_i, p)
+    np.testing.assert_array_equal(got_i, want_i)
+
+
+def test_shift(rng):
+    for n in (1, 31, 32, 33, 64, 1000):
+        pos = rand_positions(rng, 2000)
+        ja = jnp.asarray(np_pack(pos))
+        got = bs.unpack_positions(np.asarray(bs.shift_bits(ja, n)))
+        want = pos + np.uint64(n)
+        want = want[want < bs.SHARD_WIDTH]  # dropped at shard top
+        np.testing.assert_array_equal(got, want, err_msg=f"shift {n}")
+
+
+def test_range_mask(rng):
+    for start, end in [(0, 1), (5, 37), (0, bs.SHARD_WIDTH), (100, 100), (64, 128),
+                       (bs.SHARD_WIDTH - 3, bs.SHARD_WIDTH)]:
+        mask = bs.range_mask_np(start, end)
+        got = bs.unpack_positions(mask)
+        np.testing.assert_array_equal(got, np.arange(start, end, dtype=np.uint64),
+                                      err_msg=f"[{start},{end})")

@@ -1,0 +1,171 @@
+"""Host roaring bitmap + Pilosa file format codec tests."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.storage import roaring as rr
+
+
+def rand_positions(rng, n, hi=2**30):
+    return np.unique(rng.integers(0, hi, size=n, dtype=np.uint64))
+
+
+def test_point_ops():
+    b = rr.Bitmap()
+    assert not b.contains(5)
+    assert b.add(5)
+    assert not b.add(5)
+    assert b.contains(5)
+    assert b.count() == 1
+    assert b.add(2**40)
+    assert b.count() == 2
+    assert b.max() == 2**40
+    assert b.min() == 5
+    assert b.remove(5)
+    assert not b.remove(5)
+    assert b.count() == 1
+
+
+def test_bulk_add_remove(rng):
+    pos = rand_positions(rng, 10000)
+    b = rr.Bitmap()
+    assert b.direct_add_n(pos) == len(pos)
+    assert b.count() == len(pos)
+    np.testing.assert_array_equal(b.slice(), pos)
+    half = pos[: len(pos) // 2]
+    assert b.direct_remove_n(half) == len(half)
+    np.testing.assert_array_equal(b.slice(), pos[len(pos) // 2 :])
+
+
+def test_count_range(rng):
+    pos = rand_positions(rng, 5000, hi=2**22)
+    b = rr.Bitmap(pos)
+    for start, end in [(0, 2**22), (1000, 2**17), (2**16, 2**16 + 1), (5, 5)]:
+        want = int(np.count_nonzero((pos >= start) & (pos < end)))
+        assert b.count_range(start, end) == want, (start, end)
+
+
+def test_set_algebra(rng):
+    a_pos = rand_positions(rng, 5000, hi=2**20)
+    b_pos = rand_positions(rng, 5000, hi=2**20)
+    a, b = rr.Bitmap(a_pos), rr.Bitmap(b_pos)
+    np.testing.assert_array_equal(a.intersect(b).slice(), np.intersect1d(a_pos, b_pos))
+    np.testing.assert_array_equal(a.union(b).slice(), np.union1d(a_pos, b_pos))
+    np.testing.assert_array_equal(a.difference(b).slice(), np.setdiff1d(a_pos, b_pos))
+    np.testing.assert_array_equal(a.xor(b).slice(), np.setxor1d(a_pos, b_pos))
+    assert a.intersection_count(b) == len(np.intersect1d(a_pos, b_pos))
+
+
+def test_union_in_place(rng):
+    parts = [rand_positions(rng, 3000, hi=2**21) for _ in range(3)]
+    b = rr.Bitmap(parts[0])
+    b.union_in_place(rr.Bitmap(parts[1]), rr.Bitmap(parts[2]))
+    want = np.union1d(np.union1d(parts[0], parts[1]), parts[2])
+    np.testing.assert_array_equal(b.slice(), want)
+
+
+def test_offset_range_and_dense(rng):
+    # A fragment row read: bits of shard s, row r live at
+    # [r*2^20 + 0, r*2^20 + 2^20) and get rebased to [s*2^20, ...).
+    pos = rand_positions(rng, 4000, hi=2**20)
+    row, shard = 7, 3
+    b = rr.Bitmap(pos + np.uint64(row << 20))
+    out = b.offset_range(shard << 20, row << 20, (row + 1) << 20)
+    np.testing.assert_array_equal(out.slice(), pos + np.uint64(shard << 20))
+
+    dense = b.dense_range(row << 20, (row + 1) << 20)
+    assert dense.shape == (2**20 // 64,)
+    bits = np.unpackbits(dense.view(np.uint8), bitorder="little")
+    np.testing.assert_array_equal(np.nonzero(bits)[0].astype(np.uint64), pos)
+
+
+def test_set_dense_range(rng):
+    pos = rand_positions(rng, 1000, hi=2**20)
+    dense = np.zeros(2**20 // 64, dtype=np.uint64)
+    w = (pos >> np.uint64(6)).astype(np.int64)
+    np.bitwise_or.at(dense, w, np.left_shift(np.uint64(1), pos & np.uint64(63)))
+    b = rr.Bitmap()
+    b.set_dense_range(5 << 20, dense)
+    np.testing.assert_array_equal(b.slice(), pos + np.uint64(5 << 20))
+    # overwrite with zeros clears
+    b.set_dense_range(5 << 20, np.zeros_like(dense))
+    assert b.count() == 0
+
+
+def test_serialize_roundtrip_encodings(rng):
+    b = rr.Bitmap()
+    # array container (sparse)
+    b.direct_add_n(rand_positions(rng, 100, hi=2**16))
+    # bitmap container (dense, random)
+    b.direct_add_n(rand_positions(rng, 30000, hi=2**16) + np.uint64(2**16))
+    # run container (contiguous)
+    b.direct_add_n(np.arange(2 * 2**16 + 100, 2 * 2**16 + 60000, dtype=np.uint64))
+    # high key
+    b.direct_add_n(np.array([2**45 + 1, 2**45 + 2], dtype=np.uint64))
+    data = b.write_bytes()
+    got = rr.Bitmap.from_bytes(data)
+    np.testing.assert_array_equal(got.slice(), b.slice())
+
+
+def test_serialize_header_layout(rng):
+    b = rr.Bitmap(np.array([1, 2, 3], dtype=np.uint64))
+    data = b.write_bytes()
+    magic, version, n = struct.unpack_from("<HHI", data, 0)
+    assert magic == 12348 and version == 0 and n == 1
+    key, typ, card_m1 = struct.unpack_from("<QHH", data, 8)
+    assert key == 0 and typ == rr.CONTAINER_ARRAY and card_m1 == 2
+    (offset,) = struct.unpack_from("<I", data, 20)
+    assert offset == 24
+    vals = np.frombuffer(data, dtype="<u2", count=3, offset=24)
+    np.testing.assert_array_equal(vals, [1, 2, 3])
+
+
+def test_run_container_chosen_for_contiguous():
+    b = rr.Bitmap(np.arange(0, 60000, dtype=np.uint64))
+    data = b.write_bytes()
+    _, typ, _ = struct.unpack_from("<QHH", data, 8)
+    assert typ == rr.CONTAINER_RUN
+
+
+def test_ops_log_roundtrip(rng):
+    import io
+
+    b = rr.Bitmap(np.array([10, 20], dtype=np.uint64))
+    snapshot = b.write_bytes()
+    log = io.BytesIO()
+    b.op_writer = log
+    b.add(30)
+    b.remove(10)
+    b.add_batch(np.array([100, 200, 300], dtype=np.uint64))
+    b.remove_batch(np.array([20, 200], dtype=np.uint64))
+    assert b.op_n == 7
+    got = rr.Bitmap.from_bytes(snapshot + log.getvalue())
+    np.testing.assert_array_equal(got.slice(), b.slice())
+    assert got.op_n == 7
+
+
+def test_ops_log_checksum_rejects_corruption():
+    op = rr.encode_op(rr.OP_ADD, value=42)
+    bad = bytearray(op)
+    bad[1] ^= 0xFF
+    with pytest.raises(ValueError, match="checksum"):
+        rr.decode_op(memoryview(bytes(bad)))
+
+
+def test_fnv1a32_vectors():
+    # Known FNV-1a 32-bit test vectors.
+    assert rr.fnv1a32(b"") == 0x811C9DC5
+    assert rr.fnv1a32(b"a") == 0xE40C292C
+    assert rr.fnv1a32(b"foobar") == 0xBF9CF968
+
+
+def test_shift_flip(rng):
+    pos = rand_positions(rng, 200, hi=2**18)
+    b = rr.Bitmap(pos)
+    np.testing.assert_array_equal(b.shift(1).slice(), pos + np.uint64(1))
+    f = b.flip(0, 2**10)
+    span = np.arange(0, 2**10 + 1, dtype=np.uint64)
+    want = np.union1d(np.setdiff1d(span, pos), pos[pos > 2**10])
+    np.testing.assert_array_equal(f.slice(), want)
