@@ -1,0 +1,11 @@
+"""Data model layer: Holder → Index → Field → View → Fragment.
+
+Mirrors the reference hierarchy (/root/reference/holder.go, index.go,
+field.go, view.go, fragment.go) with one structural change: a fragment's
+query-facing representation is a device-resident dense bitset bank
+(rows × packed words in HBM) instead of per-container Go loops; the host
+roaring bitmap underneath is the durable, mutable source of truth.
+"""
+
+from pilosa_tpu.core.holder import Holder  # noqa: F401
+from pilosa_tpu.core.field import FieldOptions  # noqa: F401

@@ -1,0 +1,177 @@
+"""TopN row-count caches.
+
+Mirrors the reference cache layer (/root/reference/cache.go:35 `cache`
+interface; rankCache :136, lruCache :58, nopCache). On TPU a full popcount
+sweep over a fragment's row bank is one fused kernel, so the cache is a
+latency optimization (skip the sweep for hot fragments), not a correctness
+requirement as in the reference — `TopN` falls back to exact device
+recounts whenever the cache is cold or invalidated.
+
+Persistence: `.cache` sidecar file of little-endian (uint64 id, uint64
+count) pairs (the reference persists protobuf Pairs, fragment.go:1858;
+the on-disk encoding here is our own).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Tuple
+
+THRESHOLD_FACTOR = 1.1
+
+CACHE_TYPE_RANKED = "ranked"
+CACHE_TYPE_LRU = "lru"
+CACHE_TYPE_NONE = "none"
+DEFAULT_CACHE_SIZE = 50000
+
+
+class RankedCache:
+    """Keeps the top `size` rows by count; entries below the current
+    threshold are rejected once the cache is full (reference rankCache
+    recalculation, cache.go:245)."""
+
+    def __init__(self, size: int = DEFAULT_CACHE_SIZE):
+        self.size = size
+        self.counts: Dict[int, int] = {}
+        self._threshold = 0
+
+    def add(self, row_id: int, count: int) -> None:
+        if count == 0:
+            self.counts.pop(row_id, None)
+            return
+        if (len(self.counts) >= self.size * THRESHOLD_FACTOR
+                and count < self._threshold and row_id not in self.counts):
+            return
+        self.counts[row_id] = count
+        if len(self.counts) > self.size * THRESHOLD_FACTOR:
+            self._recalculate()
+
+    bulk_add = add
+
+    def get(self, row_id: int) -> int:
+        return self.counts.get(row_id, 0)
+
+    def ids(self) -> List[int]:
+        return sorted(self.counts)
+
+    def top(self) -> List[Tuple[int, int]]:
+        """(row_id, count) sorted by count desc, id asc, trimmed to size."""
+        pairs = sorted(self.counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        return pairs[: self.size]
+
+    def _recalculate(self) -> None:
+        pairs = self.top()
+        self.counts = dict(pairs)
+        self._threshold = pairs[-1][1] if len(pairs) >= self.size else 0
+
+    def invalidate(self) -> None:
+        self.counts.clear()
+        self._threshold = 0
+
+    def __len__(self) -> int:
+        return len(self.counts)
+
+
+class LRUCache:
+    """LRU variant (reference lruCache, cache.go:58 / lru/lru.go)."""
+
+    def __init__(self, size: int = DEFAULT_CACHE_SIZE):
+        self.size = size
+        self.counts: "OrderedDict[int, int]" = OrderedDict()
+
+    def add(self, row_id: int, count: int) -> None:
+        if row_id in self.counts:
+            self.counts.move_to_end(row_id)
+        self.counts[row_id] = count
+        while len(self.counts) > self.size:
+            self.counts.popitem(last=False)
+
+    bulk_add = add
+
+    def get(self, row_id: int) -> int:
+        if row_id in self.counts:
+            self.counts.move_to_end(row_id)
+            return self.counts[row_id]
+        return 0
+
+    def ids(self) -> List[int]:
+        return sorted(self.counts)
+
+    def top(self) -> List[Tuple[int, int]]:
+        return sorted(self.counts.items(), key=lambda kv: (-kv[1], kv[0]))
+
+    def invalidate(self) -> None:
+        self.counts.clear()
+
+    def __len__(self) -> int:
+        return len(self.counts)
+
+
+class NopCache:
+    size = 0
+
+    def add(self, row_id: int, count: int) -> None:
+        pass
+
+    bulk_add = add
+
+    def get(self, row_id: int) -> int:
+        return 0
+
+    def ids(self) -> List[int]:
+        return []
+
+    def top(self) -> List[Tuple[int, int]]:
+        return []
+
+    def invalidate(self) -> None:
+        pass
+
+    def __len__(self) -> int:
+        return 0
+
+
+def new_cache(cache_type: str, size: int):
+    if cache_type == CACHE_TYPE_RANKED:
+        return RankedCache(size)
+    if cache_type == CACHE_TYPE_LRU:
+        return LRUCache(size)
+    if cache_type == CACHE_TYPE_NONE:
+        return NopCache()
+    raise ValueError(f"invalid cache type: {cache_type}")
+
+
+def save_cache(cache, path: str) -> None:
+    pairs = cache.top()
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(struct.pack("<Q", len(pairs)))
+        for row_id, count in pairs:
+            f.write(struct.pack("<QQ", row_id, count))
+    os.replace(tmp, path)
+
+
+def load_cache(cache, path: str) -> None:
+    if not os.path.exists(path):
+        return
+    with open(path, "rb") as f:
+        data = f.read()
+    (n,) = struct.unpack_from("<Q", data, 0)
+    for i in range(n):
+        row_id, count = struct.unpack_from("<QQ", data, 8 + 16 * i)
+        cache.add(row_id, count)
+
+
+class Pairs:
+    """Merge helper for reducing TopN results across shards (reference
+    Pairs.Add, cache.go:356)."""
+
+    @staticmethod
+    def merge(*pair_lists: Iterable[Tuple[int, int]]) -> List[Tuple[int, int]]:
+        acc: Dict[int, int] = {}
+        for pairs in pair_lists:
+            for row_id, count in pairs:
+                acc[row_id] = acc.get(row_id, 0) + count
+        return sorted(acc.items(), key=lambda kv: (-kv[1], kv[0]))

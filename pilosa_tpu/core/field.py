@@ -1,0 +1,313 @@
+"""Field: a typed bitmap matrix within an index.
+
+Reference: /root/reference/field.go:62. Types (field.go:42-45):
+  set   — multi-valued rows, TopN cache (default ranked/50k)
+  int   — BSI bit-sliced integers with [min, max] and offset encoding
+  time  — set + per-time-unit views (quantum "YMDH" subsets)
+  mutex — one row per column (set clears previous value)
+  bool  — mutex with rows {0: false, 1: true}
+
+A timestamped write fans one bit into one view per quantum unit
+(SetBit, field.go:799-837). Field metadata persists as JSON `.meta`
+(the reference uses protobuf, field.go:431-476; disk metadata here is
+JSON by design — wire parity lives at the HTTP layer, not on disk).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field as dc_field, asdict
+from datetime import datetime
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pilosa_tpu.core.view import View, VIEW_STANDARD, view_bsi_name
+from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.core import timeq
+
+FIELD_TYPE_SET = "set"
+FIELD_TYPE_INT = "int"
+FIELD_TYPE_TIME = "time"
+FIELD_TYPE_MUTEX = "mutex"
+FIELD_TYPE_BOOL = "bool"
+
+FALSE_ROW_ID = 0
+TRUE_ROW_ID = 1
+
+
+@dataclass
+class FieldOptions:
+    type: str = FIELD_TYPE_SET
+    cache_type: str = cache_mod.CACHE_TYPE_RANKED
+    cache_size: int = cache_mod.DEFAULT_CACHE_SIZE
+    min: int = 0
+    max: int = 0
+    time_quantum: str = ""
+    keys: bool = False
+    no_standard_view: bool = False
+
+    def validate(self) -> None:
+        if self.type not in (FIELD_TYPE_SET, FIELD_TYPE_INT, FIELD_TYPE_TIME,
+                             FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL):
+            raise ValueError(f"invalid field type: {self.type}")
+        if self.type == FIELD_TYPE_INT and self.max < self.min:
+            raise ValueError("int field max must be >= min")
+        if self.type == FIELD_TYPE_TIME:
+            timeq.validate_quantum(self.time_quantum)
+            if not self.time_quantum:
+                raise ValueError("time field requires a time quantum")
+
+
+def bit_depth_for_range(min_v: int, max_v: int) -> int:
+    """Bits needed for offset-encoded values in [min, max] (reference
+    bitDepth via bsiGroup, field.go:1360-1381). Always at least 1."""
+    span = max_v - min_v
+    return max(1, span.bit_length())
+
+
+class BSIGroup:
+    """Offset-encoded integer group (reference bsiGroup, field.go:1352)."""
+
+    def __init__(self, name: str, min_v: int, max_v: int):
+        self.name = name
+        self.min = min_v
+        self.max = max_v
+
+    @property
+    def bit_depth(self) -> int:
+        return bit_depth_for_range(self.min, self.max)
+
+    def base_value(self, value: int) -> int:
+        if not (self.min <= value <= self.max):
+            raise ValueError(
+                f"value {value} outside field range [{self.min}, {self.max}]")
+        return value - self.min
+
+    def base_value_clamped(self, value: int, op: str) -> Tuple[int, bool]:
+        """Clamp a predicate operand into range; bool=False means the
+        predicate can be answered without scanning (reference baseValue,
+        field.go:1381-1429)."""
+        if op in ("<", "<="):
+            if value < self.min:
+                return 0, False
+            return min(value, self.max) - self.min, True
+        if op in (">", ">="):
+            if value > self.max:
+                return 0, False
+            return max(value, self.min) - self.min, True
+        if value < self.min or value > self.max:
+            return 0, False
+        return value - self.min, True
+
+
+class Field:
+    def __init__(self, path: str, index: str, name: str,
+                 options: Optional[FieldOptions] = None):
+        self.path = path  # .../<index>/<field>
+        self.index = index
+        self.name = name
+        self.options = options or FieldOptions()
+        self.options.validate()
+        self.views: Dict[str, View] = {}
+        self.bsi_groups: Dict[str, BSIGroup] = {}
+        self._lock = threading.RLock()
+        self.on_new_shard = None
+        if self.options.type == FIELD_TYPE_INT:
+            self.bsi_groups[name] = BSIGroup(name, self.options.min,
+                                             self.options.max)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def meta_path(self) -> str:
+        return os.path.join(self.path, ".meta")
+
+    def save_meta(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        tmp = self.meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(asdict(self.options), f)
+        os.replace(tmp, self.meta_path())
+
+    def load_meta(self) -> None:
+        if os.path.exists(self.meta_path()):
+            with open(self.meta_path()) as f:
+                self.options = FieldOptions(**json.load(f))
+            if self.options.type == FIELD_TYPE_INT:
+                self.bsi_groups[self.name] = BSIGroup(
+                    self.name, self.options.min, self.options.max)
+
+    def open(self) -> None:
+        self.load_meta()
+        views_dir = os.path.join(self.path, "views")
+        if os.path.isdir(views_dir):
+            for name in os.listdir(views_dir):
+                v = self._new_view(name)
+                v.open()
+                self.views[name] = v
+
+    def close(self) -> None:
+        with self._lock:
+            for v in self.views.values():
+                v.close()
+
+    def _new_view(self, name: str) -> View:
+        v = View(os.path.join(self.path, "views", name), self.index,
+                 self.name, name, cache_type=self.options.cache_type,
+                 cache_size=self.options.cache_size)
+        v.on_new_shard = self._notify_shard
+        return v
+
+    def _notify_shard(self, shard: int) -> None:
+        if self.on_new_shard is not None:
+            self.on_new_shard(self.name, shard)
+
+    def view(self, name: str = VIEW_STANDARD) -> Optional[View]:
+        return self.views.get(name)
+
+    def create_view_if_not_exists(self, name: str) -> View:
+        with self._lock:
+            v = self.views.get(name)
+            if v is None:
+                v = self._new_view(name)
+                v.open()
+                self.views[name] = v
+            return v
+
+    # -- shard tracking -----------------------------------------------------
+
+    def available_shards(self) -> List[int]:
+        shards = set()
+        for v in self.views.values():
+            shards.update(v.available_shards())
+        return sorted(shards)
+
+    # -- writes -------------------------------------------------------------
+
+    def set_bit(self, row_id: int, column_id: int,
+                timestamp: Optional[datetime] = None) -> bool:
+        """Set a bit, fanning into time views when timestamped (reference
+        SetBit, field.go:799-837)."""
+        changed = False
+        if not self.options.no_standard_view:
+            view = self.create_view_if_not_exists(VIEW_STANDARD)
+            if self.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL):
+                changed |= self._set_mutex(view, row_id, column_id)
+            else:
+                changed |= view.set_bit(row_id, column_id)
+        if timestamp is not None:
+            if self.options.type != FIELD_TYPE_TIME:
+                raise ValueError(
+                    f"cannot set timestamp on {self.options.type} field")
+            for vname in timeq.views_by_time(VIEW_STANDARD, timestamp,
+                                             self.options.time_quantum):
+                changed |= self.create_view_if_not_exists(vname).set_bit(
+                    row_id, column_id)
+        elif self.options.type == FIELD_TYPE_TIME and self.options.no_standard_view:
+            raise ValueError("time field with no standard view requires timestamp")
+        return changed
+
+    def _set_mutex(self, view: View, row_id: int, column_id: int) -> bool:
+        """Mutex semantics: clear the column's existing row first (reference
+        handleMutex, fragment.go:416)."""
+        from pilosa_tpu.ops.bitset import SHARD_WIDTH
+        frag = view.create_fragment_if_not_exists(column_id // SHARD_WIDTH)
+        existing = frag.mutex_vector(column_id)
+        if existing is not None and existing != row_id:
+            frag.clear_bit(existing, column_id)
+        return frag.set_bit(row_id, column_id)
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        changed = False
+        for v in self.views.values():
+            changed |= v.clear_bit(row_id, column_id)
+        return changed
+
+    def set_value(self, column_id: int, value: int) -> bool:
+        bsig = self.bsi_groups.get(self.name)
+        if bsig is None:
+            raise ValueError(f"field {self.name} is not an int field")
+        base = bsig.base_value(value)
+        view = self.create_view_if_not_exists(view_bsi_name(self.name))
+        return view.set_value(column_id, bsig.bit_depth, base)
+
+    def value(self, column_id: int) -> Tuple[int, bool]:
+        bsig = self.bsi_groups.get(self.name)
+        if bsig is None:
+            raise ValueError(f"field {self.name} is not an int field")
+        view = self.view(view_bsi_name(self.name))
+        if view is None:
+            return 0, False
+        base, exists = view.value(column_id, bsig.bit_depth)
+        return base + bsig.min if exists else 0, exists
+
+    # -- bulk import (reference Import, field.go:1054) -----------------------
+
+    def import_bits(self, row_ids: np.ndarray, column_ids: np.ndarray,
+                    timestamps: Optional[List[Optional[datetime]]] = None,
+                    clear: bool = False) -> None:
+        from pilosa_tpu.ops.bitset import SHARD_WIDTH
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+
+        # Route (row, col) pairs per target view.
+        by_view: Dict[str, List[int]] = {}
+        if timestamps is None or self.options.no_standard_view is False:
+            by_view[VIEW_STANDARD] = list(range(len(row_ids)))
+        if timestamps is not None:
+            if self.options.type != FIELD_TYPE_TIME:
+                raise ValueError("timestamps on non-time field")
+            for i, ts in enumerate(timestamps):
+                if ts is None:
+                    continue
+                for vname in timeq.views_by_time(VIEW_STANDARD, ts,
+                                                 self.options.time_quantum):
+                    by_view.setdefault(vname, []).append(i)
+
+        for vname, idxs in by_view.items():
+            if vname == VIEW_STANDARD and self.options.no_standard_view:
+                continue
+            view = self.create_view_if_not_exists(vname)
+            rows = row_ids[idxs]
+            cols = column_ids[idxs]
+            shards = cols // np.uint64(SHARD_WIDTH)
+            for shard in np.unique(shards):
+                m = shards == shard
+                frag = view.create_fragment_if_not_exists(int(shard))
+                if self.options.type in (FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL) \
+                        and not clear:
+                    frag.bulk_import_mutex(rows[m], cols[m])
+                else:
+                    frag.bulk_import(rows[m], cols[m], clear=clear)
+
+    def import_values(self, column_ids: np.ndarray, values: np.ndarray,
+                      clear: bool = False) -> None:
+        from pilosa_tpu.ops.bitset import SHARD_WIDTH
+        bsig = self.bsi_groups.get(self.name)
+        if bsig is None:
+            raise ValueError(f"field {self.name} is not an int field")
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        values = np.asarray(values, dtype=np.int64)
+        if len(values) and (values.min() < bsig.min or values.max() > bsig.max):
+            raise ValueError("value outside field range")
+        base = (values - bsig.min).astype(np.uint64)
+        view = self.create_view_if_not_exists(view_bsi_name(self.name))
+        shards = column_ids // np.uint64(SHARD_WIDTH)
+        for shard in np.unique(shards):
+            m = shards == shard
+            frag = view.create_fragment_if_not_exists(int(shard))
+            frag.import_values(column_ids[m], base[m], bsig.bit_depth,
+                               clear=clear)
+
+    # -- time range reads ---------------------------------------------------
+
+    def views_for_range(self, start: datetime, end: datetime) -> List[str]:
+        return timeq.views_by_time_range(VIEW_STANDARD, start, end,
+                                         self.options.time_quantum)
+
+    def row_time(self, row_id: int, t: datetime, quantum: str):
+        """Row restricted to one time view (reference RowTime, field.go:662)."""
+        vname = timeq.view_by_time_unit(VIEW_STANDARD, t, quantum)
+        return self.view(vname)

@@ -1,0 +1,452 @@
+"""Fragment: the storage/compute unit for one (index, field, view, shard).
+
+Reference: /root/reference/fragment.go:87. A fragment stores bit
+(row i, column c) at position i*2^20 + (c % 2^20) in one flat roaring bitmap
+(pos, fragment.go:1036); durability is snapshot + ops log with a rewrite
+after MaxOpN=10,000 logged ops (fragment.go:79,1769-1843).
+
+TPU redesign: the host roaring bitmap stays the mutable source of truth and
+the durable format, but queries never walk containers. Each fragment
+maintains a *device bank* — a dense `uint32[slots, WORDS_PER_SHARD]` array
+in HBM holding one slot per materialized row. Reads are gathers from the
+bank; multi-row ops (TopN, Rows, GroupBy, BSI) are single batched kernels
+over it. Writes mutate the host bitmap, append to the ops log, and mark the
+row dirty; dirty slots are re-uploaded lazily before the next device read
+(the snapshot ⊕ delta overlay the survey's §7 "Mutability" plan calls for).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pilosa_tpu.ops.bitset import (
+    SHARD_WIDTH,
+    WORDS_PER_SHARD,
+    u64_to_words,
+)
+from pilosa_tpu.storage.roaring import Bitmap, CONTAINER_BITS
+from pilosa_tpu.core import cache as cache_mod
+
+# Snapshot after this many logged ops (reference MaxOpN, fragment.go:79).
+DEFAULT_MAX_OP_N = 10000
+
+# Containers per shard row: 2^20 / 2^16.
+CONTAINERS_PER_ROW = SHARD_WIDTH // CONTAINER_BITS
+
+# Block size for anti-entropy checksums (reference HashBlockSize,
+# fragment.go:76): 100 rows per block.
+HASH_BLOCK_SIZE = 100
+
+
+class Fragment:
+    def __init__(self, path: str, index: str, field: str, view: str,
+                 shard: int, cache_type: str = cache_mod.CACHE_TYPE_RANKED,
+                 cache_size: int = cache_mod.DEFAULT_CACHE_SIZE,
+                 max_op_n: int = DEFAULT_MAX_OP_N):
+        self.path = path
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.max_op_n = max_op_n
+        self.storage = Bitmap()
+        self.cache = cache_mod.new_cache(cache_type, cache_size)
+        self.cache_type = cache_type
+        self._file = None
+        self._lock = threading.RLock()
+        # Device bank state.
+        self._bank = None          # jnp uint32 [slots, WORDS_PER_SHARD]
+        self._slots: Dict[int, int] = {}   # row id -> bank slot
+        self._dirty: set = set()   # row ids needing re-upload
+        self._bank_all_rows = False  # bank covers every present row
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> None:
+        with self._lock:
+            if os.path.exists(self.path):
+                with open(self.path, "rb") as f:
+                    data = f.read()
+                if data:
+                    self.storage.read_bytes(data)
+            else:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                with open(self.path, "wb") as f:
+                    f.write(self.storage.write_bytes())
+            self._file = open(self.path, "ab")
+            self.storage.op_writer = self._file
+            cache_mod.load_cache(self.cache, self.cache_path())
+            # If the op log had grown past the limit, fold it into a snapshot.
+            if self.storage.op_n >= self.max_op_n:
+                self._snapshot()
+
+    def close(self) -> None:
+        with self._lock:
+            self.flush_cache()
+            if self._file is not None:
+                self._file.flush()
+                self._file.close()
+                self._file = None
+            self.storage.op_writer = None
+
+    def cache_path(self) -> str:
+        return self.path + ".cache"
+
+    def flush_cache(self) -> None:
+        if self.cache_type != cache_mod.CACHE_TYPE_NONE:
+            try:
+                cache_mod.save_cache(self.cache, self.cache_path())
+            except OSError:
+                pass
+
+    def _snapshot(self) -> None:
+        """Rewrite the storage file without its op-log tail (reference
+        snapshot, fragment.go:1793: write .snapshotting, rename, remap)."""
+        tmp = self.path + ".snapshotting"
+        if self._file is not None:
+            self._file.flush()
+            self._file.close()
+        with open(tmp, "wb") as f:
+            f.write(self.storage.write_bytes())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self.storage.op_n = 0
+        self._file = open(self.path, "ab")
+        self.storage.op_writer = self._file
+
+    def _maybe_snapshot(self) -> None:
+        if self.storage.op_n >= self.max_op_n:
+            self._snapshot()
+
+    # -- position helpers ---------------------------------------------------
+
+    def pos(self, row_id: int, column_id: int) -> int:
+        """Bit position for (row, column) (reference pos, fragment.go:1036)."""
+        if not (self.shard * SHARD_WIDTH <= column_id
+                < (self.shard + 1) * SHARD_WIDTH):
+            raise ValueError(
+                f"column {column_id} out of shard {self.shard} bounds")
+        return row_id * SHARD_WIDTH + (column_id % SHARD_WIDTH)
+
+    # -- single-bit writes --------------------------------------------------
+
+    def set_bit(self, row_id: int, column_id: int) -> bool:
+        with self._lock:
+            changed = self.storage.add(self.pos(row_id, column_id))
+            if changed:
+                self._touch_row(row_id)
+                if self.cache_type != cache_mod.CACHE_TYPE_NONE:
+                    self.cache.add(row_id, self.row_count(row_id))
+                self._maybe_snapshot()
+            return changed
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        with self._lock:
+            changed = self.storage.remove(self.pos(row_id, column_id))
+            if changed:
+                self._touch_row(row_id)
+                if self.cache_type != cache_mod.CACHE_TYPE_NONE:
+                    self.cache.add(row_id, self.row_count(row_id))
+                self._maybe_snapshot()
+            return changed
+
+    def bit(self, row_id: int, column_id: int) -> bool:
+        return self.storage.contains(self.pos(row_id, column_id))
+
+    # -- row reads ----------------------------------------------------------
+
+    def row_ids(self) -> List[int]:
+        """Sorted ids of rows that contain any bit."""
+        rows = set()
+        for key in self.storage.containers:
+            if self.storage.container_count(key):
+                rows.add(key // CONTAINERS_PER_ROW)
+        return sorted(rows)
+
+    def row_count(self, row_id: int) -> int:
+        return self.storage.count_range(row_id * SHARD_WIDTH,
+                                        (row_id + 1) * SHARD_WIDTH)
+
+    def row_dense(self, row_id: int) -> np.ndarray:
+        """Row as uint32 words [WORDS_PER_SHARD] (host)."""
+        u64 = self.storage.dense_range(row_id * SHARD_WIDTH,
+                                       (row_id + 1) * SHARD_WIDTH)
+        return u64_to_words(u64)
+
+    def row_columns(self, row_id: int) -> np.ndarray:
+        """Absolute column ids set in a row."""
+        pos = self.storage.for_each_range(row_id * SHARD_WIDTH,
+                                          (row_id + 1) * SHARD_WIDTH)
+        return (pos - np.uint64(row_id * SHARD_WIDTH)
+                + np.uint64(self.shard * SHARD_WIDTH))
+
+    def mutex_vector(self, column_id: int, limit_rows: Optional[Sequence[int]] = None
+                     ) -> Optional[int]:
+        """Which row holds `column` in a mutex/bool fragment (reference
+        vector lookup, fragment.go:2486-2553). Host scan over present rows —
+        mutex fragments have at most one bit per column, and their row count
+        is bounded by field cardinality."""
+        for row_id in (limit_rows if limit_rows is not None else self.row_ids()):
+            if self.bit(row_id, column_id):
+                return row_id
+        return None
+
+    # -- device bank --------------------------------------------------------
+
+    def _touch_row(self, row_id: int) -> None:
+        self._dirty.add(row_id)
+
+    def invalidate_bank(self) -> None:
+        with self._lock:
+            self._bank = None
+            self._slots = {}
+            self._dirty = set()
+            self._bank_all_rows = False
+
+    def bank(self, row_ids: Optional[Sequence[int]] = None):
+        """Return (device bank [slots, W] uint32, row->slot map) guaranteed
+        to contain `row_ids` (default: every present row), with dirty rows
+        refreshed. The bank is append-only: slots are stable across calls
+        until invalidate_bank()."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            if row_ids is None:
+                row_ids = self.row_ids()
+                self._bank_all_rows = True
+            missing = [r for r in row_ids if r not in self._slots]
+            refresh = [r for r in self._dirty if r in self._slots]
+            if self._bank is None:
+                base = np.zeros((0, WORDS_PER_SHARD), dtype=np.uint32)
+            else:
+                # np.asarray of a device array is read-only; copy only when
+                # we actually need to mutate host-side.
+                base = np.asarray(self._bank)
+                if refresh:
+                    base = base.copy()
+            if missing or refresh:
+                if missing:
+                    new_rows = np.stack([self.row_dense(r) for r in missing]) \
+                        if missing else np.zeros((0, WORDS_PER_SHARD), np.uint32)
+                    for r in missing:
+                        self._slots[r] = len(self._slots)
+                    base = np.concatenate([base, new_rows], axis=0)
+                for r in refresh:
+                    base[self._slots[r]] = self.row_dense(r)
+                self._dirty -= set(refresh) | set(missing)
+                self._bank = jnp.asarray(base)
+            elif self._bank is None:
+                self._bank = jnp.asarray(base)
+            return self._bank, dict(self._slots)
+
+    def row_device(self, row_id: int):
+        """One row as a device array (gather from the bank)."""
+        bank, slots = self.bank([row_id])
+        return bank[slots[row_id]]
+
+    # -- bulk import --------------------------------------------------------
+
+    def bulk_import(self, row_ids: np.ndarray, column_ids: np.ndarray,
+                    clear: bool = False) -> None:
+        """Bulk bit import (reference bulkImportStandard → importPositions,
+        fragment.go:1508-1604): one batched bitmap op + one batch op-log
+        record, then per-row cache refresh and snapshot check."""
+        row_ids = np.asarray(row_ids, dtype=np.uint64)
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        positions = (row_ids * np.uint64(SHARD_WIDTH)
+                     + (column_ids % np.uint64(SHARD_WIDTH)))
+        with self._lock:
+            if clear:
+                self.storage.remove_batch(positions)
+            else:
+                self.storage.add_batch(positions)
+            touched = np.unique(row_ids)
+            for r in touched.tolist():
+                self._touch_row(int(r))
+                if self.cache_type != cache_mod.CACHE_TYPE_NONE:
+                    self.cache.bulk_add(int(r), self.row_count(int(r)))
+            self._maybe_snapshot()
+
+    def bulk_import_mutex(self, row_ids: np.ndarray, column_ids: np.ndarray
+                          ) -> None:
+        """Mutex import: setting (row, col) clears any other row's bit in
+        that column (reference bulkImportMutex, fragment.go:1605)."""
+        with self._lock:
+            present = self.row_ids()
+            to_clear_rows, to_clear_cols = [], []
+            for r, c in zip(np.asarray(row_ids, np.uint64).tolist(),
+                            np.asarray(column_ids, np.uint64).tolist()):
+                cur = self.mutex_vector(c, present)
+                if cur is not None and cur != r:
+                    to_clear_rows.append(cur)
+                    to_clear_cols.append(c)
+            if to_clear_rows:
+                self.bulk_import(np.array(to_clear_rows, np.uint64),
+                                 np.array(to_clear_cols, np.uint64), clear=True)
+            self.bulk_import(np.asarray(row_ids, np.uint64),
+                             np.asarray(column_ids, np.uint64))
+
+    def import_roaring(self, data: bytes, clear: bool = False) -> None:
+        """Union (or overwrite-clear) a pre-serialized roaring bitmap into
+        storage — the fastest import path (reference ImportRoaring,
+        fragment.go:1721)."""
+        other = Bitmap.from_bytes(data)
+        with self._lock:
+            if clear:
+                for key in list(self.storage.containers):
+                    if key in other.containers:
+                        self.storage.containers[key] &= ~other.containers[key]
+                        self.storage._invalidate(key)
+                        self.storage._drop_empty(key)
+            else:
+                self.storage.union_in_place(other)
+            for key in other.containers:
+                self._touch_row(key // CONTAINERS_PER_ROW)
+            for r in {k // CONTAINERS_PER_ROW for k in other.containers}:
+                if self.cache_type != cache_mod.CACHE_TYPE_NONE:
+                    self.cache.bulk_add(int(r), self.row_count(int(r)))
+            self._snapshot()
+
+    # -- BSI (bit-sliced index) values --------------------------------------
+    # Layout (reference fragment.value, fragment.go:618): rows 0..bitDepth-1
+    # hold value bits LSB-first; row bitDepth is the not-null marker.
+
+    def value(self, column_id: int, bit_depth: int) -> Tuple[int, bool]:
+        with self._lock:
+            if not self.bit(bit_depth, column_id):
+                return 0, False
+            v = 0
+            for i in range(bit_depth):
+                if self.bit(i, column_id):
+                    v |= 1 << i
+            return v, True
+
+    def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
+        with self._lock:
+            changed = False
+            for i in range(bit_depth):
+                if value & (1 << i):
+                    changed |= self.storage.add(self.pos(i, column_id))
+                else:
+                    changed |= self.storage.remove(self.pos(i, column_id))
+                self._touch_row(i)
+            changed |= self.storage.add(self.pos(bit_depth, column_id))
+            self._touch_row(bit_depth)
+            self._maybe_snapshot()
+            return changed
+
+    def clear_value(self, column_id: int, bit_depth: int) -> bool:
+        with self._lock:
+            changed = False
+            for i in range(bit_depth + 1):
+                changed |= self.storage.remove(self.pos(i, column_id))
+                self._touch_row(i)
+            self._maybe_snapshot()
+            return changed
+
+    def import_values(self, column_ids: np.ndarray, values: np.ndarray,
+                      bit_depth: int, clear: bool = False) -> None:
+        """Vectorized BSI import (reference importValue, fragment.go column
+        loop at :679 via positionsForValue): per bit-plane one batched
+        add/remove instead of per-column loops."""
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        values = np.asarray(values, dtype=np.uint64)
+        offsets = column_ids % np.uint64(SHARD_WIDTH)
+        with self._lock:
+            for i in range(bit_depth):
+                row_base = np.uint64(i * SHARD_WIDTH)
+                mask = ((values >> np.uint64(i)) & np.uint64(1)).astype(bool)
+                set_pos = row_base + offsets[mask]
+                clr_pos = row_base + offsets[~mask]
+                if len(set_pos) and not clear:
+                    self.storage.add_batch(set_pos)
+                if len(clr_pos) or clear:
+                    self.storage.remove_batch(
+                        row_base + offsets if clear else clr_pos)
+                self._touch_row(i)
+            nn_base = np.uint64(bit_depth * SHARD_WIDTH)
+            if clear:
+                self.storage.remove_batch(nn_base + offsets)
+            else:
+                self.storage.add_batch(nn_base + offsets)
+            self._touch_row(bit_depth)
+            self._maybe_snapshot()
+
+    def bsi_bank(self, bit_depth: int):
+        """Device array [(bit_depth+1), W]: bit planes 0..bit_depth-1 then
+        the not-null plane — the operand layout for vectorized BSI kernels."""
+        bank, slots = self.bank(list(range(bit_depth + 1)))
+        import jax.numpy as jnp
+        idx = jnp.asarray([slots[i] for i in range(bit_depth + 1)])
+        return bank[idx]
+
+    # -- block checksums (anti-entropy unit) --------------------------------
+
+    def checksum_blocks(self) -> List[Tuple[int, bytes]]:
+        """Per-block digests over 100-row blocks (reference Blocks,
+        fragment.go:1275). Hash input is the sorted absolute positions in
+        the block, so equal bit-sets hash equal regardless of encoding."""
+        out = []
+        rows = self.row_ids()
+        blocks = sorted({r // HASH_BLOCK_SIZE for r in rows})
+        for blk in blocks:
+            lo = blk * HASH_BLOCK_SIZE * SHARD_WIDTH
+            hi = (blk + 1) * HASH_BLOCK_SIZE * SHARD_WIDTH
+            pos = self.storage.for_each_range(lo, hi)
+            if not len(pos):
+                continue
+            h = hashlib.blake2b(pos.astype("<u8").tobytes(), digest_size=16)
+            out.append((blk, h.digest()))
+        return out
+
+    def block_data(self, block: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(row_ids, column_ids) pairs in a block (reference blockData,
+        fragment.go:1356)."""
+        lo = block * HASH_BLOCK_SIZE * SHARD_WIDTH
+        hi = (block + 1) * HASH_BLOCK_SIZE * SHARD_WIDTH
+        pos = self.storage.for_each_range(lo, hi)
+        rows = pos // np.uint64(SHARD_WIDTH)
+        cols = (pos % np.uint64(SHARD_WIDTH)
+                + np.uint64(self.shard * SHARD_WIDTH))
+        return rows, cols
+
+    def merge_block(self, block: int, their_rows: np.ndarray,
+                    their_cols: np.ndarray) -> Tuple[Tuple[np.ndarray, np.ndarray],
+                                                     Tuple[np.ndarray, np.ndarray]]:
+        """Merge a peer's block pairs with union semantics; returns the
+        (sets, clears) deltas to push back to peers (reference mergeBlock,
+        fragment.go:1372 — here without the clear side since union-merge;
+        clears flow through the import clear flag)."""
+        their_pos = (np.asarray(their_rows, np.uint64) * np.uint64(SHARD_WIDTH)
+                     + np.asarray(their_cols, np.uint64) % np.uint64(SHARD_WIDTH))
+        lo = block * HASH_BLOCK_SIZE * SHARD_WIDTH
+        hi = (block + 1) * HASH_BLOCK_SIZE * SHARD_WIDTH
+        ours = self.storage.for_each_range(lo, hi)
+        missing_here = np.setdiff1d(their_pos, ours)
+        missing_there = np.setdiff1d(ours, their_pos)
+        if len(missing_here):
+            rows = missing_here // np.uint64(SHARD_WIDTH)
+            cols = missing_here % np.uint64(SHARD_WIDTH) \
+                + np.uint64(self.shard * SHARD_WIDTH)
+            self.bulk_import(rows, cols)
+        rows_t = missing_there // np.uint64(SHARD_WIDTH)
+        cols_t = (missing_there % np.uint64(SHARD_WIDTH)
+                  + np.uint64(self.shard * SHARD_WIDTH))
+        here_rows = missing_here // np.uint64(SHARD_WIDTH)
+        here_cols = (missing_here % np.uint64(SHARD_WIDTH)
+                     + np.uint64(self.shard * SHARD_WIDTH))
+        return (here_rows, here_cols), (rows_t, cols_t)
+
+    # -- export -------------------------------------------------------------
+
+    def write_bytes(self) -> bytes:
+        """Serialized fragment (snapshot form, no op tail) for streaming to
+        peers / backup (reference fragment.WriteTo, fragment.go:1885)."""
+        with self._lock:
+            return self.storage.write_bytes()

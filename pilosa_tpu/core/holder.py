@@ -1,0 +1,126 @@
+"""Holder: owns every index under one data directory.
+
+Reference: /root/reference/holder.go:50. Responsibilities kept here: open =
+walk the data dir rebuilding schema from the directory tree + `.meta` files
+(holder.go:132), periodic cache flush (holder.go:487-530), node ID
+persistence (holder.go:580). The anti-entropy syncer lives in
+pilosa_tpu/parallel (it needs the cluster view).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+from pilosa_tpu.core.index import Index
+
+
+class Holder:
+    def __init__(self, path: str):
+        self.path = path
+        self.indexes: Dict[str, Index] = {}
+        self._lock = threading.RLock()
+        self.node_id: Optional[str] = None
+        self.on_new_shard = None  # callback(index, field, shard)
+
+    def open(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        self.node_id = self._load_node_id()
+        for name in sorted(os.listdir(self.path)):
+            ipath = os.path.join(self.path, name)
+            if not os.path.isdir(ipath) or name.startswith("."):
+                continue
+            idx = Index(ipath, name)
+            idx.open()
+            idx.on_new_shard = self._notify_shard
+            self.indexes[name] = idx
+
+    def close(self) -> None:
+        with self._lock:
+            for idx in self.indexes.values():
+                idx.close()
+
+    def _load_node_id(self) -> str:
+        """Stable node identity persisted to `.id` (reference holder.go:580)."""
+        id_path = os.path.join(self.path, ".id")
+        if os.path.exists(id_path):
+            with open(id_path) as f:
+                return f.read().strip()
+        node_id = uuid.uuid4().hex
+        with open(id_path, "w") as f:
+            f.write(node_id)
+        return node_id
+
+    def _notify_shard(self, index: str, field: str, shard: int) -> None:
+        if self.on_new_shard is not None:
+            self.on_new_shard(index, field, shard)
+
+    # -- indexes ------------------------------------------------------------
+
+    def index(self, name: str) -> Optional[Index]:
+        return self.indexes.get(name)
+
+    def create_index(self, name: str, keys: bool = False,
+                     track_existence: bool = True,
+                     error_if_exists: bool = True) -> Index:
+        with self._lock:
+            if name in self.indexes:
+                if error_if_exists:
+                    raise ValueError(f"index already exists: {name}")
+                return self.indexes[name]
+            if not name or not name.islower() or not name[0].isalpha():
+                raise ValueError(f"invalid index name: {name!r}")
+            idx = Index(os.path.join(self.path, name), name, keys=keys,
+                        track_existence=track_existence)
+            idx.save_meta()
+            idx.open()
+            idx.on_new_shard = self._notify_shard
+            self.indexes[name] = idx
+            return idx
+
+    def delete_index(self, name: str) -> None:
+        with self._lock:
+            idx = self.indexes.pop(name, None)
+            if idx is None:
+                raise KeyError(f"index not found: {name}")
+            idx.close()
+            shutil.rmtree(idx.path, ignore_errors=True)
+
+    def schema(self) -> List[dict]:
+        """Schema description (feeds /schema and schema broadcasts)."""
+        out = []
+        for iname in sorted(self.indexes):
+            idx = self.indexes[iname]
+            fields = []
+            for fname in sorted(idx.fields):
+                if fname.startswith("_"):
+                    continue
+                f = idx.fields[fname]
+                fields.append({
+                    "name": fname,
+                    "options": {
+                        "type": f.options.type,
+                        "cacheType": f.options.cache_type,
+                        "cacheSize": f.options.cache_size,
+                        "min": f.options.min,
+                        "max": f.options.max,
+                        "timeQuantum": f.options.time_quantum,
+                        "keys": f.options.keys,
+                    },
+                })
+            out.append({"name": iname,
+                        "options": {"keys": idx.keys,
+                                    "trackExistence": idx.track_existence},
+                        "fields": fields,
+                        "shards": idx.available_shards()})
+        return out
+
+    def flush_caches(self) -> None:
+        for idx in self.indexes.values():
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    for frag in v.fragments.values():
+                        frag.flush_cache()

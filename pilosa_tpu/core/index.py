@@ -1,0 +1,136 @@
+"""Index: a namespace sharing one column space.
+
+Reference: /root/reference/index.go:35. Owns fields, the optional existence
+field `_exists` used by Not()/existence tracking (index.go:167-175,
+holder.go:46), and `keys`/`trackExistence` options (index.go:469).
+Available shards for the index = union over fields (index.go:238).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pilosa_tpu.core.field import Field, FieldOptions, FIELD_TYPE_SET
+from pilosa_tpu.core import cache as cache_mod
+
+EXISTENCE_FIELD_NAME = "_exists"
+
+
+class Index:
+    def __init__(self, path: str, name: str, keys: bool = False,
+                 track_existence: bool = True):
+        self.path = path  # <data>/<index>
+        self.name = name
+        self.keys = keys
+        self.track_existence = track_existence
+        self.fields: Dict[str, Field] = {}
+        self._lock = threading.RLock()
+        self.on_new_shard = None  # callback(field, shard)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def meta_path(self) -> str:
+        return os.path.join(self.path, ".meta")
+
+    def save_meta(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        tmp = self.meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"keys": self.keys,
+                       "trackExistence": self.track_existence}, f)
+        os.replace(tmp, self.meta_path())
+
+    def load_meta(self) -> None:
+        if os.path.exists(self.meta_path()):
+            with open(self.meta_path()) as f:
+                meta = json.load(f)
+            self.keys = meta.get("keys", False)
+            self.track_existence = meta.get("trackExistence", True)
+
+    def open(self) -> None:
+        self.load_meta()
+        for name in sorted(os.listdir(self.path)) if os.path.isdir(self.path) else []:
+            fpath = os.path.join(self.path, name)
+            if not os.path.isdir(fpath):
+                continue
+            f = Field(fpath, self.name, name)
+            f.open()
+            f.on_new_shard = self._notify_shard
+            self.fields[name] = f
+        if self.track_existence and EXISTENCE_FIELD_NAME not in self.fields:
+            self._create_existence_field()
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self.fields.values():
+                f.close()
+
+    def _notify_shard(self, field: str, shard: int) -> None:
+        if self.on_new_shard is not None:
+            self.on_new_shard(self.name, field, shard)
+
+    # -- fields -------------------------------------------------------------
+
+    def _create_existence_field(self) -> Field:
+        opts = FieldOptions(type=FIELD_TYPE_SET,
+                            cache_type=cache_mod.CACHE_TYPE_NONE, cache_size=0)
+        f = Field(os.path.join(self.path, EXISTENCE_FIELD_NAME), self.name,
+                  EXISTENCE_FIELD_NAME, opts)
+        f.save_meta()
+        f.on_new_shard = self._notify_shard
+        self.fields[EXISTENCE_FIELD_NAME] = f
+        return f
+
+    def existence_field(self) -> Optional[Field]:
+        return self.fields.get(EXISTENCE_FIELD_NAME)
+
+    def field(self, name: str) -> Optional[Field]:
+        return self.fields.get(name)
+
+    def create_field(self, name: str, options: Optional[FieldOptions] = None,
+                     error_if_exists: bool = True) -> Field:
+        with self._lock:
+            if name in self.fields:
+                if error_if_exists:
+                    raise ValueError(f"field already exists: {name}")
+                return self.fields[name]
+            if name.startswith("_") and name != EXISTENCE_FIELD_NAME:
+                raise ValueError(f"invalid field name: {name}")
+            f = Field(os.path.join(self.path, name), self.name, name, options)
+            f.save_meta()
+            f.on_new_shard = self._notify_shard
+            self.fields[name] = f
+            return f
+
+    def delete_field(self, name: str) -> None:
+        with self._lock:
+            f = self.fields.pop(name, None)
+            if f is None:
+                raise KeyError(f"field not found: {name}")
+            f.close()
+            shutil.rmtree(f.path, ignore_errors=True)
+
+    # -- existence tracking --------------------------------------------------
+
+    def add_existence(self, column_ids: np.ndarray) -> None:
+        """Mark columns as existing (driven by every write path when
+        trackExistence; reference importExistenceColumns, api.go:908)."""
+        ef = self.existence_field()
+        if ef is None:
+            return
+        column_ids = np.asarray(column_ids, dtype=np.uint64)
+        ef.import_bits(np.zeros(len(column_ids), dtype=np.uint64), column_ids)
+
+    # -- shards --------------------------------------------------------------
+
+    def available_shards(self) -> List[int]:
+        shards = set()
+        for f in self.fields.values():
+            shards.update(f.available_shards())
+        return sorted(shards)

@@ -1,0 +1,105 @@
+"""View: a named collection of fragments, one per shard.
+
+Reference: /root/reference/view.go:41. View names: "standard", time views
+"standard_YYYY[MM[DD[HH]]]", and BSI views "bsig_<field>" (view.go:35-37).
+Fragments are created lazily on first write (CreateFragmentIfNotExists,
+view.go:207).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core import cache as cache_mod
+
+VIEW_STANDARD = "standard"
+VIEW_BSI_PREFIX = "bsig_"
+
+
+def view_bsi_name(field: str) -> str:
+    return VIEW_BSI_PREFIX + field
+
+
+class View:
+    def __init__(self, path: str, index: str, field: str, name: str,
+                 cache_type: str = cache_mod.CACHE_TYPE_RANKED,
+                 cache_size: int = cache_mod.DEFAULT_CACHE_SIZE):
+        self.path = path  # .../<field>/views/<name>
+        self.index = index
+        self.field = field
+        self.name = name
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.fragments: Dict[int, Fragment] = {}
+        self._lock = threading.RLock()
+        self.on_new_shard = None  # callback(shard) for shard broadcasts
+
+    def open(self) -> None:
+        frag_dir = os.path.join(self.path, "fragments")
+        if not os.path.isdir(frag_dir):
+            return
+        for name in os.listdir(frag_dir):
+            if name.endswith(".cache") or name.endswith(".snapshotting"):
+                continue
+            try:
+                shard = int(name)
+            except ValueError:
+                continue
+            frag = self._new_fragment(shard)
+            frag.open()
+            self.fragments[shard] = frag
+
+    def close(self) -> None:
+        with self._lock:
+            for frag in self.fragments.values():
+                frag.close()
+
+    def _new_fragment(self, shard: int) -> Fragment:
+        return Fragment(
+            os.path.join(self.path, "fragments", str(shard)),
+            self.index, self.field, self.name, shard,
+            cache_type=self.cache_type, cache_size=self.cache_size)
+
+    def fragment(self, shard: int) -> Optional[Fragment]:
+        return self.fragments.get(shard)
+
+    def create_fragment_if_not_exists(self, shard: int) -> Fragment:
+        with self._lock:
+            frag = self.fragments.get(shard)
+            if frag is None:
+                frag = self._new_fragment(shard)
+                frag.open()
+                self.fragments[shard] = frag
+                if self.on_new_shard is not None:
+                    self.on_new_shard(shard)
+            return frag
+
+    def available_shards(self) -> List[int]:
+        return sorted(self.fragments)
+
+    # Pass-throughs (reference view.go:294-421).
+
+    def set_bit(self, row_id: int, column_id: int) -> bool:
+        from pilosa_tpu.ops.bitset import SHARD_WIDTH
+        return self.create_fragment_if_not_exists(
+            column_id // SHARD_WIDTH).set_bit(row_id, column_id)
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        from pilosa_tpu.ops.bitset import SHARD_WIDTH
+        frag = self.fragment(column_id // SHARD_WIDTH)
+        return frag.clear_bit(row_id, column_id) if frag else False
+
+    def set_value(self, column_id: int, bit_depth: int, value: int) -> bool:
+        from pilosa_tpu.ops.bitset import SHARD_WIDTH
+        return self.create_fragment_if_not_exists(
+            column_id // SHARD_WIDTH).set_value(column_id, bit_depth, value)
+
+    def value(self, column_id: int, bit_depth: int):
+        from pilosa_tpu.ops.bitset import SHARD_WIDTH
+        frag = self.fragment(column_id // SHARD_WIDTH)
+        if frag is None:
+            return 0, False
+        return frag.value(column_id, bit_depth)

@@ -118,12 +118,6 @@ def _dense_to_runs(dense: np.ndarray) -> np.ndarray:
     return np.stack([starts, ends], axis=1).astype(np.uint16)
 
 
-def _num_runs(dense: np.ndarray) -> int:
-    bits = np.unpackbits(dense.view(np.uint8), bitorder="little")
-    diff = np.diff(np.concatenate(([0], bits)).astype(np.int8))
-    return int((diff == 1).sum())
-
-
 class Bitmap:
     """A 64-bit-keyed roaring bitmap, dense-container host implementation.
 
@@ -230,16 +224,30 @@ class Bitmap:
         positions = np.unique(np.asarray(positions, dtype=np.uint64))
         changed = 0
         keys = (positions >> np.uint64(16)).astype(np.int64)
-        for key in np.unique(keys):
-            group = positions[keys == key]
+        # positions are sorted, so group boundaries come from one
+        # unique(return_index) pass — O(N), not O(N x keys).
+        uniq, starts = np.unique(keys, return_index=True)
+        bounds = np.append(starts, len(positions))
+        for i, key in enumerate(uniq.tolist()):
+            group = positions[bounds[i]:bounds[i + 1]]
             low = (group & np.uint64(0xFFFF)).astype(np.uint32)
-            c = self._container(int(key), create=True)
-            before = self.container_count(int(key))
+            fresh = key not in self.containers
+            c = self._container(key, create=True)
+            if fresh:
+                # New container + unique positions: count is len(group),
+                # no popcounts needed.
+                np.bitwise_or.at(
+                    c, low >> 6,
+                    np.left_shift(np.uint64(1), (low & 63).astype(np.uint64)))
+                self._counts[key] = len(group)
+                changed += len(group)
+                continue
+            before = self.container_count(key)
             np.bitwise_or.at(
                 c, low >> 6, np.left_shift(np.uint64(1), (low & 63).astype(np.uint64))
             )
-            self._invalidate(int(key))
-            changed += self.container_count(int(key)) - before
+            self._invalidate(key)
+            changed += self.container_count(key) - before
         return changed
 
     def direct_remove_n(self, positions: np.ndarray) -> int:
@@ -248,22 +256,23 @@ class Bitmap:
         positions = np.unique(np.asarray(positions, dtype=np.uint64))
         changed = 0
         keys = (positions >> np.uint64(16)).astype(np.int64)
-        for key in np.unique(keys):
-            c = self.containers.get(int(key))
+        uniq, starts = np.unique(keys, return_index=True)
+        bounds = np.append(starts, len(positions))
+        for i, key in enumerate(uniq.tolist()):
+            c = self.containers.get(key)
             if c is None:
                 continue
-            group = positions[keys == key]
+            group = positions[bounds[i]:bounds[i + 1]]
             low = (group & np.uint64(0xFFFF)).astype(np.uint32)
             mask = _new_container()
             np.bitwise_or.at(
                 mask, low >> 6, np.left_shift(np.uint64(1), (low & 63).astype(np.uint64))
             )
-            before = self.container_count(int(key))
+            before = self.container_count(key)
             c &= ~mask
-            self._invalidate(int(key))
-            after = self.container_count(int(key))
-            changed += before - after
-            self._drop_empty(int(key))
+            self._invalidate(key)
+            changed += before - self.container_count(key)
+            self._drop_empty(key)
         return changed
 
     def add_batch(self, positions: np.ndarray) -> int:
@@ -430,6 +439,12 @@ class Bitmap:
                     a |= b
                 self._invalidate(key)
 
+    def copy(self) -> "Bitmap":
+        out = Bitmap()
+        out.containers = {k: v.copy() for k, v in self.containers.items()}
+        out._counts = dict(self._counts)
+        return out
+
     def shift(self, n: int = 1) -> "Bitmap":
         """Shift all bit positions up by n (reference Shift, roaring.go:865)."""
         return Bitmap(self.slice() + np.uint64(n))
@@ -438,7 +453,7 @@ class Bitmap:
         """Flip bits in [start, end] inclusive (reference Flip, roaring.go:1185).
         Vectorized: XOR each touched container with a range mask; only the two
         boundary containers need partial masks."""
-        out = Bitmap(self.slice())
+        out = self.copy()
         k0, k1 = start >> 16, end >> 16
         for key in range(k0, k1 + 1):
             lo = start - (key << 16) if key == k0 else 0
@@ -474,15 +489,14 @@ class Bitmap:
         for key in keys:
             dense = self.containers[key]
             card = self.container_count(key)
-            n_runs = _num_runs(dense)
+            runs = _dense_to_runs(dense)
             # Pick smallest encoding: sizes are 2*card (array),
             # 8192 (bitmap), 2 + 4*n_runs (run) — the Optimize rule,
             # roaring.go:1745-1805.
-            run_size = RUN_COUNT_HEADER_SIZE + 4 * n_runs
+            run_size = RUN_COUNT_HEADER_SIZE + 4 * len(runs)
             array_size = 2 * card
             if run_size < min(array_size, 8192):
                 typ = CONTAINER_RUN
-                runs = _dense_to_runs(dense)
                 payloads.append(
                     struct.pack("<H", len(runs))
                     + runs.astype("<u2").tobytes()
@@ -551,6 +565,10 @@ class Bitmap:
             else:
                 raise ValueError(f"unknown container type {typ}")
             del card  # header cardinality untrusted; dense payload is authoritative
+            if not self.containers[key].any():
+                # Never materialize empty containers (max/min assume every
+                # present container has at least one bit).
+                del self.containers[key]
             ops_offset = max(ops_offset, end)
         # Ops log replay.
         self.op_n = 0

@@ -1,0 +1,230 @@
+"""Data model tests: fragment durability, field types, time views, holder walk."""
+
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core import timeq
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+
+def test_fragment_set_clear_persist(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    assert f.set_bit(1, 100)
+    assert not f.set_bit(1, 100)
+    assert f.set_bit(1, SHARD_WIDTH + 5)  # second shard
+    assert f.set_bit(2, 100)
+    assert f.clear_bit(2, 100)
+    assert f.available_shards() == [0, 1]
+    h.close()
+
+    h2 = Holder(str(tmp_path))
+    h2.open()
+    f2 = h2.index("i").field("f")
+    frag = f2.view().fragment(0)
+    assert frag.bit(1, 100)
+    assert not frag.bit(2, 100)
+    assert f2.view().fragment(1).bit(1, SHARD_WIDTH + 5)
+    assert f2.available_shards() == [0, 1]
+    h2.close()
+
+
+def test_fragment_snapshot_rolls_oplog(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    f = h.create_index("i").create_field("f")
+    frag = f.create_view_if_not_exists("standard").create_fragment_if_not_exists(0)
+    frag.max_op_n = 10
+    for c in range(25):
+        frag.set_bit(0, c)
+    assert frag.storage.op_n < 10  # snapshotted at least once
+    h.close()
+    h2 = Holder(str(tmp_path))
+    h2.open()
+    frag2 = h2.index("i").field("f").view().fragment(0)
+    assert frag2.row_count(0) == 25
+    h2.close()
+
+
+def test_row_reads_and_bank(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    f = h.create_index("i").create_field("f")
+    cols = np.array([1, 5, 99, SHARD_WIDTH - 1], dtype=np.uint64)
+    f.import_bits(np.full(len(cols), 3, dtype=np.uint64), cols)
+    frag = f.view().fragment(0)
+    np.testing.assert_array_equal(frag.row_columns(3), cols)
+    assert frag.row_ids() == [3]
+    bank, slots = frag.bank()
+    assert bank.shape[0] == 1 and 3 in slots
+    # write -> dirty -> bank refresh
+    frag.set_bit(3, 42)
+    bank2, slots2 = frag.bank()
+    from pilosa_tpu.ops import bitset as bs
+    got = bs.unpack_positions(np.asarray(bank2[slots2[3]]))
+    np.testing.assert_array_equal(got, np.sort(np.append(cols, 42)))
+    h.close()
+
+
+def test_mutex_field(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    f = h.create_index("i").create_field("m", FieldOptions(type="mutex"))
+    f.set_bit(1, 10)
+    f.set_bit(2, 10)  # clears row 1
+    frag = f.view().fragment(0)
+    assert not frag.bit(1, 10)
+    assert frag.bit(2, 10)
+    # bulk mutex import
+    f.import_bits(np.array([5, 6], np.uint64), np.array([10, 20], np.uint64))
+    assert frag.mutex_vector(10) == 5
+    assert frag.mutex_vector(20) == 6
+    h.close()
+
+
+def test_bool_field(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    f = h.create_index("i").create_field("b", FieldOptions(type="bool"))
+    f.set_bit(1, 7)   # true
+    f.set_bit(0, 7)   # flips to false
+    frag = f.view().fragment(0)
+    assert frag.bit(0, 7) and not frag.bit(1, 7)
+    h.close()
+
+
+def test_int_field_values(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    f = h.create_index("i").create_field(
+        "n", FieldOptions(type="int", min=-10, max=1000))
+    assert f.set_value(3, -10)
+    assert f.set_value(4, 1000)
+    assert f.set_value(5, 0)
+    assert f.value(3) == (-10, True)
+    assert f.value(4) == (1000, True)
+    assert f.value(5) == (0, True)
+    assert f.value(6) == (0, False)
+    with pytest.raises(ValueError):
+        f.set_value(7, 1001)
+    # bulk
+    cols = np.arange(100, 200, dtype=np.uint64)
+    vals = np.arange(-10, 90, dtype=np.int64)
+    f.import_values(cols, vals)
+    assert f.value(150) == (40, True)
+    h.close()
+    h2 = Holder(str(tmp_path))
+    h2.open()
+    f2 = h2.index("i").field("n")
+    assert f2.value(150) == (40, True)
+    assert f2.value(3) == (-10, True)
+    h2.close()
+
+
+def test_time_field_views(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    f = h.create_index("i").create_field(
+        "t", FieldOptions(type="time", time_quantum="YMDH"))
+    ts = datetime(2018, 3, 4, 5)
+    f.set_bit(1, 9, timestamp=ts)
+    names = set(f.views.keys())
+    assert {"standard", "standard_2018", "standard_201803",
+            "standard_20180304", "standard_2018030405"} <= names
+    for vn in names:
+        assert f.view(vn).fragment(0).bit(1, 9)
+    h.close()
+
+
+def test_views_by_time_range_minimal_cover():
+    views = timeq.views_by_time_range(
+        "standard", datetime(2018, 1, 31, 22), datetime(2018, 3, 2, 2), "YMDH")
+    assert views == [
+        "standard_2018013122", "standard_2018013123",
+        "standard_201802",
+        "standard_20180301",
+        "standard_2018030200", "standard_2018030201",
+    ]
+    # whole year aligns to one view
+    assert timeq.views_by_time_range(
+        "standard", datetime(2018, 1, 1), datetime(2019, 1, 1), "YMDH") == \
+        ["standard_2018"]
+
+
+def test_existence_field_tracks_columns(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("i", track_existence=True)
+    idx.create_field("f")
+    idx.add_existence(np.array([1, 2, 3], dtype=np.uint64))
+    ef = idx.existence_field()
+    frag = ef.view().fragment(0)
+    np.testing.assert_array_equal(frag.row_columns(0), [1, 2, 3])
+    h.close()
+
+
+def test_block_checksums_and_merge(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    f = h.create_index("i").create_field("f")
+    f.import_bits(np.array([0, 1, 250], np.uint64), np.array([5, 6, 7], np.uint64))
+    frag = f.view().fragment(0)
+    blocks = dict(frag.checksum_blocks())
+    assert set(blocks) == {0, 2}
+    # identical data on a second holder hashes identically
+    h2 = Holder(str(tmp_path / "other"))
+    h2.open()
+    g = h2.create_index("i").create_field("f")
+    g.import_bits(np.array([0, 1, 250], np.uint64), np.array([5, 6, 7], np.uint64))
+    frag2 = g.view().fragment(0)
+    assert dict(frag2.checksum_blocks()) == blocks
+    # diverge and merge
+    frag2.set_bit(1, 8)
+    rows, cols = frag2.block_data(0)
+    (_, _), (theirs_rows, theirs_cols) = frag.merge_block(0, rows, cols)
+    assert frag.bit(1, 8)
+    assert len(theirs_rows) == 0
+    h.close()
+    h2.close()
+
+
+def test_holder_schema_and_delete(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("myindex")
+    idx.create_field("f1")
+    idx.create_field("n1", FieldOptions(type="int", min=0, max=100))
+    schema = h.schema()
+    assert schema[0]["name"] == "myindex"
+    assert [f["name"] for f in schema[0]["fields"]] == ["f1", "n1"]
+    with pytest.raises(ValueError):
+        h.create_index("myindex")
+    with pytest.raises(ValueError):
+        h.create_index("BadName")
+    idx.delete_field("f1")
+    assert idx.field("f1") is None
+    h.delete_index("myindex")
+    assert h.index("myindex") is None
+    h.close()
+
+
+def test_import_roaring(tmp_path):
+    from pilosa_tpu.storage import Bitmap
+
+    h = Holder(str(tmp_path))
+    h.open()
+    f = h.create_index("i").create_field("f")
+    # row 2, columns 10,11 encoded as a roaring fragment payload
+    bm = Bitmap(np.array([2 * SHARD_WIDTH + 10, 2 * SHARD_WIDTH + 11],
+                         dtype=np.uint64))
+    frag = f.create_view_if_not_exists("standard").create_fragment_if_not_exists(0)
+    frag.import_roaring(bm.write_bytes())
+    assert frag.bit(2, 10) and frag.bit(2, 11)
+    np.testing.assert_array_equal(frag.row_columns(2), [10, 11])
+    h.close()
