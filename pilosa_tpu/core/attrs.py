@@ -1,0 +1,108 @@
+"""Attribute store: arbitrary KV attributes on rows and columns.
+
+Reference: /root/reference/attr.go:34 (AttrStore interface) with the BoltDB
+implementation (boltdb/attrstore.go:82) and 100-id block checksums for
+diff-sync (attr.go:80-119). Host-side by design — attributes never touch
+the device (the reference likewise keeps them out of fragments).
+
+Implementation: in-memory dict + JSON file persisted atomically on every
+mutation batch; block checksums over sorted (id, sorted-attr) tuples give
+the same diff-sync capability the reference gets from BoltDB blocks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+ATTR_BLOCK_SIZE = 100
+
+
+class AttrStore:
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.attrs: Dict[int, Dict[str, Any]] = {}
+        self._lock = threading.RLock()
+
+    def open(self) -> None:
+        if self.path and os.path.exists(self.path):
+            with open(self.path) as f:
+                raw = json.load(f)
+            self.attrs = {int(k): v for k, v in raw.items()}
+
+    def close(self) -> None:
+        pass
+
+    def _save(self) -> None:
+        if not self.path:
+            return
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({str(k): v for k, v in self.attrs.items()}, f)
+        os.replace(tmp, self.path)
+
+    def get(self, id_: int) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self.attrs.get(id_, {}))
+
+    def set(self, id_: int, attrs: Dict[str, Any]) -> None:
+        """Merge attrs for id; null values delete keys (reference
+        boltdb/attrstore.go upsert semantics)."""
+        with self._lock:
+            cur = self.attrs.setdefault(id_, {})
+            for k, v in attrs.items():
+                if v is None:
+                    cur.pop(k, None)
+                else:
+                    cur[k] = v
+            if not cur:
+                self.attrs.pop(id_, None)
+            self._save()
+
+    def set_bulk(self, items: Dict[int, Dict[str, Any]]) -> None:
+        with self._lock:
+            for id_, attrs in items.items():
+                cur = self.attrs.setdefault(id_, {})
+                for k, v in attrs.items():
+                    if v is None:
+                        cur.pop(k, None)
+                    else:
+                        cur[k] = v
+                if not cur:
+                    self.attrs.pop(id_, None)
+            self._save()
+
+    def ids_matching(self, key: str, values: List[Any]) -> List[int]:
+        """Row ids whose attr `key` is in `values` (TopN attrName/attrValues
+        filter, executor.go:764)."""
+        vals = values if isinstance(values, list) else [values]
+        with self._lock:
+            # Linear compare, not set membership: stored values may be
+            # unhashable (lists are legal attr values).
+            return sorted(i for i, a in self.attrs.items()
+                          if any(a.get(key) == v for v in vals))
+
+    def blocks(self) -> List[Tuple[int, bytes]]:
+        """(block, checksum) pairs over 100-id blocks (reference
+        attr.go:80-119) for anti-entropy diffing."""
+        with self._lock:
+            by_block: Dict[int, List[Tuple[int, str]]] = {}
+            for id_, attrs in self.attrs.items():
+                by_block.setdefault(id_ // ATTR_BLOCK_SIZE, []).append(
+                    (id_, json.dumps(attrs, sort_keys=True)))
+            out = []
+            for blk in sorted(by_block):
+                h = hashlib.blake2b(digest_size=16)
+                for id_, payload in sorted(by_block[blk]):
+                    h.update(f"{id_}:{payload};".encode())
+                out.append((blk, h.digest()))
+            return out
+
+    def block_data(self, block: int) -> Dict[int, Dict[str, Any]]:
+        with self._lock:
+            return {i: dict(a) for i, a in self.attrs.items()
+                    if i // ATTR_BLOCK_SIZE == block}

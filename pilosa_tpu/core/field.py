@@ -115,6 +115,9 @@ class Field:
         self.bsi_groups: Dict[str, BSIGroup] = {}
         self._lock = threading.RLock()
         self.on_new_shard = None
+        from pilosa_tpu.core.attrs import AttrStore
+        self.row_attr_store = AttrStore(os.path.join(self.path, ".row_attrs"))
+        self.row_attr_store.open()
         if self.options.type == FIELD_TYPE_INT:
             self.bsi_groups[name] = BSIGroup(name, self.options.min,
                                              self.options.max)

@@ -64,6 +64,10 @@ class Fragment:
         self._slots: Dict[int, int] = {}   # row id -> bank slot
         self._dirty: set = set()   # row ids needing re-upload
         self._bank_all_rows = False  # bank covers every present row
+        # Monotonic write version; executors key leaf caches on it. The
+        # per-row last-touch versions let view banks patch incrementally.
+        self.version = 0
+        self._row_versions: Dict[int, int] = {}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -201,6 +205,11 @@ class Fragment:
 
     def _touch_row(self, row_id: int) -> None:
         self._dirty.add(row_id)
+        self.version += 1
+        self._row_versions[row_id] = self.version
+
+    def rows_changed_since(self, version: int) -> List[int]:
+        return [r for r, v in self._row_versions.items() if v > version]
 
     def invalidate_bank(self) -> None:
         with self._lock:
@@ -311,6 +320,21 @@ class Fragment:
             for r in {k // CONTAINERS_PER_ROW for k in other.containers}:
                 if self.cache_type != cache_mod.CACHE_TYPE_NONE:
                     self.cache.bulk_add(int(r), self.row_count(int(r)))
+            self._snapshot()
+
+    def set_row(self, row_id: int, words: np.ndarray) -> None:
+        """Replace a row's bits wholesale (reference setRow, fragment.go:522
+        — the Store() write path). `words` is uint32[WORDS_PER_SHARD]."""
+        from pilosa_tpu.ops.bitset import words_to_u64
+        with self._lock:
+            self.storage.set_dense_range(
+                row_id * SHARD_WIDTH,
+                words_to_u64(np.ascontiguousarray(words, dtype=np.uint32)))
+            self._touch_row(row_id)
+            if self.cache_type != cache_mod.CACHE_TYPE_NONE:
+                self.cache.add(row_id, self.row_count(row_id))
+            # A whole-row overwrite isn't representable as an op-log record;
+            # fold it into a snapshot for durability.
             self._snapshot()
 
     # -- BSI (bit-sliced index) values --------------------------------------

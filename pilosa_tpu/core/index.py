@@ -32,6 +32,9 @@ class Index:
         self.fields: Dict[str, Field] = {}
         self._lock = threading.RLock()
         self.on_new_shard = None  # callback(field, shard)
+        from pilosa_tpu.core.attrs import AttrStore
+        self.column_attr_store = AttrStore(os.path.join(path, ".col_attrs"))
+        self.column_attr_store.open()
 
     # -- lifecycle ----------------------------------------------------------
 

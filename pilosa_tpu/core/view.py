@@ -12,11 +12,36 @@ import os
 import threading
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from pilosa_tpu.core.fragment import Fragment
 from pilosa_tpu.core import cache as cache_mod
 
 VIEW_STANDARD = "standard"
 VIEW_BSI_PREFIX = "bsig_"
+
+
+class ViewBank:
+    """A view's rows stacked across shards as ONE device array
+    [row_capacity, n_shards, WORDS_PER_SHARD] (uint32) in HBM.
+
+    This is the executor's operand format: a row leaf is `bank[slot]` with
+    the slot passed as a *traced* index, so an entire PQL tree over any rows
+    of any shards compiles once and runs as a single device program — the
+    TPU replacement for goroutine-per-shard fan-out (executor.go:2377).
+    The last slot is always all-zeros (rows absent from the view resolve
+    there). Capacity is padded to a power of two so adding rows rarely
+    changes the compiled shape.
+    """
+
+    def __init__(self, array, slots, zero_slot, versions):
+        self.array = array          # jnp [Rcap, S, W]
+        self.slots = slots          # row id -> slot
+        self.zero_slot = zero_slot
+        self.versions = versions    # {shard: fragment.version} at build time
+
+    def slot(self, row_id: int) -> int:
+        return self.slots.get(row_id, self.zero_slot)
 
 
 def view_bsi_name(field: str) -> str:
@@ -36,6 +61,7 @@ class View:
         self.fragments: Dict[int, Fragment] = {}
         self._lock = threading.RLock()
         self.on_new_shard = None  # callback(shard) for shard broadcasts
+        self._bank_cache: Dict[tuple, ViewBank] = {}
 
     def open(self) -> None:
         frag_dir = os.path.join(self.path, "fragments")
@@ -79,6 +105,91 @@ class View:
 
     def available_shards(self) -> List[int]:
         return sorted(self.fragments)
+
+    # -- device bank --------------------------------------------------------
+
+    def device_bank(self, shards, rows=None) -> ViewBank:
+        """Bank for `shards` covering `rows` (default: all rows present in
+        any of the shards). Cached per shard tuple; rebuilt when any
+        fragment's write version moved. `rows` subsets build transient
+        (uncached) banks — used by chunked TopN over huge row sets."""
+        import jax.numpy as jnp
+        from pilosa_tpu.ops.bitset import WORDS_PER_SHARD
+
+        shards = tuple(shards)
+        with self._lock:
+            frags = {s: self.fragments.get(s) for s in shards}
+            versions = {s: (f.version if f else -1) for s, f in frags.items()}
+            if rows is None:
+                row_set = sorted({r for f in frags.values() if f
+                                  for r in f.row_ids()})
+                cached = self._bank_cache.get(shards)
+                if cached is not None:
+                    if (cached.versions == versions
+                            and all(r in cached.slots for r in row_set)):
+                        return cached
+                    patched = self._patch_bank(cached, frags, versions,
+                                               row_set, shards)
+                    if patched is not None:
+                        self._bank_cache[shards] = patched
+                        return patched
+            else:
+                row_set = sorted(set(rows))
+            cap = 1
+            while cap < len(row_set) + 1:
+                cap *= 2
+            host = np.zeros((cap, len(shards), WORDS_PER_SHARD),
+                            dtype=np.uint32)
+            slots = {}
+            for i, r in enumerate(row_set):
+                slots[r] = i
+                for si, s in enumerate(shards):
+                    f = frags[s]
+                    if f is not None:
+                        host[i, si] = f.row_dense(r)
+            bank = ViewBank(jnp.asarray(host), slots, cap - 1, versions)
+            if rows is None:
+                self._bank_cache[shards] = bank
+            return bank
+
+    def _patch_bank(self, cached: "ViewBank", frags, versions, row_set,
+                    shards):
+        """Incrementally refresh a cached bank: re-upload only (row, shard)
+        cells whose fragment reports a newer row version. Returns None when
+        a rebuild is required (new rows exceed capacity, or the patch would
+        touch most of the bank anyway)."""
+        import jax.numpy as jnp
+
+        new_rows = [r for r in row_set if r not in cached.slots]
+        if len(cached.slots) + len(new_rows) + 1 > cached.array.shape[0]:
+            return None
+        patches = []  # (slot, shard_idx, words)
+        for si, s in enumerate(shards):
+            f = frags[s]
+            if f is None or f.version == cached.versions.get(s):
+                continue
+            for r in f.rows_changed_since(cached.versions.get(s, -1)):
+                if r in cached.slots:
+                    patches.append((cached.slots[r], si, f.row_dense(r)))
+        slots = dict(cached.slots)
+        for r in new_rows:
+            slot = len(slots)
+            slots[r] = slot
+            for si, s in enumerate(shards):
+                f = frags[s]
+                if f is not None:
+                    patches.append((slot, si, f.row_dense(r)))
+        total_cells = cached.array.shape[0] * cached.array.shape[1]
+        if len(patches) > max(16, total_cells // 2):
+            return None
+        array = cached.array
+        if patches:
+            rows_idx = np.asarray([p[0] for p in patches], dtype=np.int32)
+            shard_idx = np.asarray([p[1] for p in patches], dtype=np.int32)
+            words = np.stack([p[2] for p in patches])
+            array = array.at[jnp.asarray(rows_idx),
+                             jnp.asarray(shard_idx)].set(jnp.asarray(words))
+        return ViewBank(array, slots, cached.zero_slot, versions)
 
     # Pass-throughs (reference view.go:294-421).
 

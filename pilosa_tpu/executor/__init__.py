@@ -1,0 +1,10 @@
+"""Query execution layer.
+
+Reference: /root/reference/executor.go. The per-shard goroutine kernels
+(executeIntersectShard etc., executor.go:1487-1887) become batched device
+expressions over a stacked [shards, words] axis; PQL call trees jit-compile
+once per tree shape and are cached (the Go->TPU "executor" the north star
+asks for). Cross-shard reduce happens in the same compiled program.
+"""
+
+from pilosa_tpu.executor.executor import Executor  # noqa: F401

@@ -1,0 +1,148 @@
+"""Bit-sliced-index device kernels.
+
+The reference's BSI engine (/root/reference/fragment.go:767-1035) runs
+O(bitDepth) passes of whole-row bitmap algebra per shard. Here each op is a
+single fused device expression over `planes` shaped [bit_depth+1, S, W]
+(bit planes LSB-first, then the not-null plane; S = shards batch axis).
+Bit-plane loops are Python-unrolled (bit_depth is static per field), so XLA
+sees one straight-line graph and fuses it.
+
+All comparison values are *base values* (offset-encoded by the field's
+bsiGroup, field.go:1381) — callers clamp/offset before lowering here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from pilosa_tpu.ops.bitset import popcount
+
+
+def not_null(planes):
+    return planes[-1]
+
+
+def _vbit(value, i):
+    """Bit i of a (possibly traced) comparison value, as a bool scalar —
+    keeps predicate values out of the compile cache key."""
+    return (jnp.right_shift(jnp.uint32(value) if isinstance(value, int)
+                            else value.astype(jnp.uint32),
+                            jnp.uint32(i)) & jnp.uint32(1)).astype(bool)
+
+
+def eq(planes, value):
+    """Columns whose value == `value` (reference rangeEQ, fragment.go:871)."""
+    m = planes[-1]
+    depth = planes.shape[0] - 1
+    for i in range(depth):
+        m = jnp.bitwise_and(
+            m, jnp.where(_vbit(value, i), planes[i],
+                         jnp.bitwise_not(planes[i])))
+    return m
+
+
+def neq(planes, value: int):
+    return jnp.bitwise_and(planes[-1], jnp.bitwise_not(eq(planes, value)))
+
+
+def lt(planes, value, allow_eq: bool = False):
+    """Columns with value < (or <=) `value` (reference rangeLT,
+    fragment.go:907): MSB-first scan keeping an equality prefix mask."""
+    depth = planes.shape[0] - 1
+    matched = jnp.zeros_like(planes[-1])
+    eq_prefix = planes[-1]
+    for i in reversed(range(depth)):
+        bit = planes[i]
+        vb = _vbit(value, i)
+        # predicate bit 1: values with 0 here are smaller; bit 0: only the
+        # equality prefix narrows.
+        matched = jnp.bitwise_or(
+            matched,
+            jnp.where(vb, jnp.bitwise_and(eq_prefix, jnp.bitwise_not(bit)),
+                      jnp.zeros_like(bit)))
+        eq_prefix = jnp.bitwise_and(
+            eq_prefix, jnp.where(vb, bit, jnp.bitwise_not(bit)))
+    if allow_eq:
+        matched = jnp.bitwise_or(matched, eq_prefix)
+    return matched
+
+
+def gt(planes, value, allow_eq: bool = False):
+    """(reference rangeGT, fragment.go:949)."""
+    depth = planes.shape[0] - 1
+    matched = jnp.zeros_like(planes[-1])
+    eq_prefix = planes[-1]
+    for i in reversed(range(depth)):
+        bit = planes[i]
+        vb = _vbit(value, i)
+        # predicate bit 0: values with 1 here are larger.
+        matched = jnp.bitwise_or(
+            matched,
+            jnp.where(vb, jnp.zeros_like(bit),
+                      jnp.bitwise_and(eq_prefix, bit)))
+        eq_prefix = jnp.bitwise_and(
+            eq_prefix, jnp.where(vb, bit, jnp.bitwise_not(bit)))
+    if allow_eq:
+        matched = jnp.bitwise_or(matched, eq_prefix)
+    return matched
+
+
+def between(planes, low, high):
+    """low <= value <= high (reference rangeBetween, fragment.go:1002)."""
+    return jnp.bitwise_and(gt(planes, low, allow_eq=True),
+                           lt(planes, high, allow_eq=True))
+
+
+def sum_count(planes, filter_mask=None):
+    """(sum of base values, count) over not-null (∧ filter) columns
+    (reference fragment.sum, fragment.go:767). Returns device scalars;
+    sum excludes the base offset — caller adds min*count."""
+    m = planes[-1]
+    if filter_mask is not None:
+        m = jnp.bitwise_and(m, filter_mask)
+    depth = planes.shape[0] - 1
+    # Per-plane counts fit uint32; the 2^i weighting can exceed 32 bits, so
+    # the weighted sum happens on the host over exact Python ints.
+    axes = (-2, -1) if planes.ndim == 3 else -1
+    counts = [popcount(jnp.bitwise_and(planes[i], m), axis=axes)
+              for i in range(depth)]
+    cnt = popcount(m, axis=axes)
+    return jnp.stack(counts), cnt
+
+
+def min_mask(planes, filter_mask=None):
+    """Mask of columns holding the minimum base value + the value itself.
+    Greedy MSB descent (reference fragment.min, fragment.go:794). Fully
+    on-device via where-selects; returns (value_planes_selector, candidates)
+    where the caller popcounts candidates for the count. Value is returned
+    as a vector of chosen bits [depth] (uint32 0/1) to stay traceable."""
+    m = planes[-1]
+    if filter_mask is not None:
+        m = jnp.bitwise_and(m, filter_mask)
+    depth = planes.shape[0] - 1
+    chosen = []
+    cand = m
+    for i in reversed(range(depth)):
+        zeros = jnp.bitwise_and(cand, jnp.bitwise_not(planes[i]))
+        has_zero = jnp.any(zeros != 0)
+        cand = jnp.where(has_zero, zeros, cand)
+        chosen.append(jnp.where(has_zero, jnp.uint32(0), jnp.uint32(1)))
+    bits = jnp.stack(chosen[::-1])  # LSB-first
+    return bits, cand
+
+
+def max_mask(planes, filter_mask=None):
+    """(reference fragment.max, fragment.go:827)."""
+    m = planes[-1]
+    if filter_mask is not None:
+        m = jnp.bitwise_and(m, filter_mask)
+    depth = planes.shape[0] - 1
+    chosen = []
+    cand = m
+    for i in reversed(range(depth)):
+        ones = jnp.bitwise_and(cand, planes[i])
+        has_one = jnp.any(ones != 0)
+        cand = jnp.where(has_one, ones, cand)
+        chosen.append(jnp.where(has_one, jnp.uint32(1), jnp.uint32(0)))
+    bits = jnp.stack(chosen[::-1])
+    return bits, cand

@@ -1,0 +1,774 @@
+"""PQL executor: batched device evaluation of call trees.
+
+Reference: /root/reference/executor.go:84 (Execute), :245 (executeCall
+dispatch), :2277 (mapReduce). Structural translation to TPU:
+
+- The reference evaluates each shard in its own goroutine and merges row
+  results pairwise (executor.go:2377, row.go:60). Here the operands live in
+  per-view HBM banks shaped [rows, shards, words] (core/view.py ViewBank)
+  and a whole PQL tree runs as ONE jitted XLA program over the stacked
+  shard axis.
+- Row identity and BSI predicate operands enter the program as *traced*
+  gather indices / scalars, so the compile cache keys only on tree shape
+  and bank shapes: `Count(Intersect(Row(f=X), Row(g=Y)))` compiles once for
+  all X, Y — and fuses into a single AND+popcount pass, the generalization
+  of the reference's hand-fused intersectionCountBitmapBitmap
+  (roaring.go:2438) to arbitrary trees.
+- Cross-shard reduction (the reference's reduceFn, HTTP scatter-gather) is
+  a reduction over the shard axis inside the same program; the multi-chip
+  version shard_maps these kernels over a mesh with psum on ICI
+  (pilosa_tpu/parallel).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field as dc_field
+from datetime import datetime
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pilosa_tpu.core import timeq
+from pilosa_tpu.core.field import (
+    FIELD_TYPE_BOOL, FIELD_TYPE_INT, FIELD_TYPE_MUTEX, FIELD_TYPE_SET,
+    FIELD_TYPE_TIME, Field,
+)
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.index import Index
+from pilosa_tpu.core.view import VIEW_STANDARD, view_bsi_name
+from pilosa_tpu.executor import bsi
+from pilosa_tpu.executor.results import (
+    FieldRow, GroupCount, PairsResult, RowIdentifiers, RowResult, ValCount,
+)
+from pilosa_tpu.ops.bitset import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.pql import Call, Condition, Query, parse_string
+from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
+
+_BITMAP_CALLS = {"Row", "Range", "Intersect", "Union", "Difference", "Xor",
+                 "Not", "Shift"}
+
+# Expand time-range unions statically up to this many views; beyond it the
+# union is precomputed eagerly into a literal operand (keeps compile sizes
+# bounded for hour-grain multi-year ranges).
+MAX_STATIC_RANGE_VIEWS = 8
+
+# TopN uses the cached full view bank up to this many rows; beyond it rows
+# stream through transient chunk banks (bounds HBM for 50k-row ranked-cache
+# workloads: 8192 rows x 1 shard = 1 GiB bank).
+TOPN_MAX_BANK_ROWS = 8192
+TOPN_CHUNK_ROWS = 1024
+
+
+class ExecutionError(ValueError):
+    pass
+
+
+@dataclass
+class _Plan:
+    """Everything the jitted tree program needs, gathered in one host pass."""
+    sig_parts: List[str] = dc_field(default_factory=list)
+    bank_keys: List[Tuple[str, str]] = dc_field(default_factory=list)
+    bank_pos: Dict[Tuple[str, str], int] = dc_field(default_factory=dict)
+    idxs: List[int] = dc_field(default_factory=list)       # traced gather slots
+    params: List[int] = dc_field(default_factory=list)     # traced u32 scalars
+    literals: List[Any] = dc_field(default_factory=list)   # eager [S, W] ops
+
+    def bank(self, key: Tuple[str, str]) -> int:
+        pos = self.bank_pos.get(key)
+        if pos is None:
+            pos = len(self.bank_keys)
+            self.bank_pos[key] = pos
+            self.bank_keys.append(key)
+        return pos
+
+
+class Executor:
+    def __init__(self, holder: Holder):
+        self.holder = holder
+        self._jit_cache: Dict[str, Callable] = {}
+
+    # ------------------------------------------------------------------ API
+
+    def execute(self, index_name: str, query, shards: Optional[Sequence[int]]
+                = None) -> List[Any]:
+        """Execute every call in `query` (reference executor.Execute,
+        executor.go:84)."""
+        if isinstance(query, str):
+            query = parse_string(query)
+        if isinstance(query, Call):
+            query = Query([query])
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise ExecutionError(f"index not found: {index_name}")
+        results = []
+        for call in query.calls:
+            results.append(self._execute_call(idx, call, shards))
+        return results
+
+    # -------------------------------------------------------- call dispatch
+
+    def _execute_call(self, idx: Index, call: Call,
+                      shards: Optional[Sequence[int]]) -> Any:
+        name = call.name
+        if name == "Count":
+            return self._execute_count(idx, call, shards)
+        if name in _BITMAP_CALLS:
+            return self._execute_bitmap(idx, call, shards)
+        if name == "TopN":
+            return self._execute_topn(idx, call, shards)
+        if name == "Rows":
+            return self._execute_rows(idx, call, shards)
+        if name == "GroupBy":
+            return self._execute_group_by(idx, call, shards)
+        if name in ("Sum", "Min", "Max"):
+            return self._execute_val_count(idx, call, shards, name)
+        if name == "Set":
+            return self._execute_set(idx, call)
+        if name == "Clear":
+            return self._execute_clear(idx, call)
+        if name == "ClearRow":
+            return self._execute_clear_row(idx, call, shards)
+        if name == "Store":
+            return self._execute_store(idx, call, shards)
+        if name == "SetRowAttrs":
+            return self._execute_set_row_attrs(idx, call)
+        if name == "SetColumnAttrs":
+            return self._execute_set_column_attrs(idx, call)
+        raise ExecutionError(f"unknown call: {name}")
+
+    def _shards(self, idx: Index, shards) -> List[int]:
+        if shards is not None:
+            return list(shards)
+        return idx.available_shards() or [0]
+
+    # ----------------------------------------------------- bitmap call eval
+
+    def _execute_bitmap(self, idx: Index, call: Call, shards) -> RowResult:
+        shards = self._shards(idx, shards)
+        words = self._eval_tree(idx, call, shards, mode="row")
+        res = RowResult(shards, words)
+        self._attach_row_attrs(idx, call, res)
+        return res
+
+    def _execute_count(self, idx: Index, call: Call, shards) -> int:
+        if len(call.children) != 1:
+            raise ExecutionError("Count() takes exactly one row argument")
+        shards = self._shards(idx, shards)
+        counts = self._eval_tree(idx, call.children[0], shards, mode="count")
+        return int(np.asarray(counts, dtype=np.int64).sum())
+
+    def _eval_tree(self, idx: Index, call: Call, shards: List[int],
+                   mode: str):
+        """Plan + compile (cached by shape) + run the call tree."""
+        import jax
+        import jax.numpy as jnp
+
+        plan = _Plan()
+        expr = self._plan_call(idx, call, shards, plan)
+        banks = [self._get_bank(idx, key, shards) for key in plan.bank_keys]
+        bank_arrays = tuple(b.array for b in banks)
+        lits = (jnp.stack(plan.literals)
+                if plan.literals else None)
+        sig = (f"{mode}|{''.join(plan.sig_parts)}"
+               f"|B{[a.shape for a in bank_arrays]}"
+               f"|L{None if lits is None else lits.shape}|S{len(shards)}")
+        fn = self._jit_cache.get(sig)
+        if fn is None:
+            def run(bank_arrays, idxs, params, lits):
+                out = expr(bank_arrays, idxs, params, lits)
+                if mode == "count":
+                    from pilosa_tpu.ops.bitset import popcount
+                    return popcount(out, axis=-1)  # [S]
+                return out
+            fn = jax.jit(run)
+            self._jit_cache[sig] = fn
+        idxs = jnp.asarray(np.asarray(plan.idxs, dtype=np.int32))
+        params = jnp.asarray(np.asarray(plan.params, dtype=np.uint32))
+        return fn(bank_arrays, idxs, params, lits)
+
+    # -- planning: one host walk resolving banks/slots/params ---------------
+
+    def _plan_call(self, idx: Index, call: Call, shards, plan: _Plan):
+        """Returns expr(banks, idxs, params, lits) -> [S, W], appending to
+        the plan. Mirrors executeBitmapCallShard's recursion
+        (executor.go:540)."""
+        import jax.numpy as jnp
+        name = call.name
+
+        if name in ("Row", "Range"):
+            return self._plan_row_leaf(idx, call, shards, plan)
+        if name in ("Not", "Shift") and len(call.children) != 1:
+            raise ExecutionError(f"{name}() takes exactly one row argument")
+        if name == "Not":
+            ef = idx.existence_field()
+            if ef is None:
+                raise ExecutionError(
+                    f"index {idx.name} does not support existence (Not)")
+            ex = self._plan_slot_leaf(ef, VIEW_STANDARD, 0, shards, plan)
+            sub = self._plan_call(idx, call.children[0], shards, plan)
+            plan.sig_parts.append("!")
+            return lambda b, i, p, l: jnp.bitwise_and(
+                ex(b, i, p, l), jnp.bitwise_not(sub(b, i, p, l)))
+        if name == "Shift":
+            n = call.uint_arg("n") or 1
+            sub = self._plan_call(idx, call.children[0], shards, plan)
+            plan.sig_parts.append(f"S{n}")
+            from pilosa_tpu.ops.bitset import shift_bits
+            return lambda b, i, p, l: shift_bits(sub(b, i, p, l), n)
+        if name in ("Intersect", "Union", "Difference", "Xor"):
+            if not call.children:
+                raise ExecutionError(f"{name}() requires row arguments")
+            subs = [self._plan_call(idx, c, shards, plan)
+                    for c in call.children]
+            plan.sig_parts.append(f"{name[0]}{len(subs)}")
+            ops = {"Intersect": jnp.bitwise_and, "Union": jnp.bitwise_or,
+                   "Xor": jnp.bitwise_xor,
+                   "Difference": lambda a, c: jnp.bitwise_and(
+                       a, jnp.bitwise_not(c))}
+            op = ops[name]
+            return lambda b, i, p, l: functools.reduce(
+                op, [s(b, i, p, l) for s in subs])
+        raise ExecutionError(f"{name} is not a row query")
+
+    def _plan_slot_leaf(self, field: Field, view_name: str, row_id: int,
+                        shards, plan: _Plan):
+        """A single-row leaf: bank[slot] with the slot traced."""
+        pos = plan.bank((field.name, view_name))
+        bank = self._get_bank_for(field, view_name, shards)
+        i = len(plan.idxs)
+        plan.idxs.append(bank.slot(row_id))
+        plan.sig_parts.append(f"r{pos}")
+        return lambda b, idxs, p, l: b[pos][idxs[i]]
+
+    def _plan_row_leaf(self, idx: Index, call: Call, shards, plan: _Plan):
+        import jax.numpy as jnp
+        field_name, row_ref = self._row_call_field(call)
+        field = idx.field(field_name)
+        if field is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        if isinstance(row_ref, Condition):
+            return self._plan_bsi_leaf(field, row_ref, shards, plan)
+        if field.options.type == FIELD_TYPE_INT:
+            raise ExecutionError(
+                f"int field {field_name} requires a comparison, not =")
+        row_id = self._row_id(field, row_ref)
+        frm, to = call.arg("from"), call.arg("to")
+        if frm is not None or to is not None:
+            if field.options.type != FIELD_TYPE_TIME:
+                raise ExecutionError(f"from/to on non-time field {field_name}")
+            start = timeq.parse_timestamp(frm) if frm else datetime.min
+            end = timeq.parse_timestamp(to) if to else datetime.max
+            views = [v for v in field.views_for_range(start, end)
+                     if field.view(v) is not None]
+            if not views:
+                return (lambda b, i, p, l:
+                        jnp.zeros((len(shards), WORDS_PER_SHARD), jnp.uint32))
+            if len(views) <= MAX_STATIC_RANGE_VIEWS:
+                subs = [self._plan_slot_leaf(field, vn, row_id, shards, plan)
+                        for vn in views]
+                plan.sig_parts.append(f"U{len(subs)}")
+                return lambda b, i, p, l: functools.reduce(
+                    jnp.bitwise_or, [s(b, i, p, l) for s in subs])
+            # Literal: precompute the union eagerly, pass as one operand.
+            from pilosa_tpu.ops.bitset import union_many
+            stacks = [self._get_bank_for(field, vn, shards) for vn in views]
+            arr = union_many(jnp.stack(
+                [bk.array[bk.slot(row_id)] for bk in stacks]), axis=0)
+            k = len(plan.literals)
+            plan.literals.append(arr)
+            plan.sig_parts.append(f"l{k}")
+            return lambda b, i, p, l: l[k]
+        return self._plan_slot_leaf(field, VIEW_STANDARD, row_id, shards,
+                                    plan)
+
+    def _plan_bsi_leaf(self, field: Field, cond: Condition, shards,
+                       plan: _Plan):
+        """BSI comparison leaf: planes gathered from the bsig view bank via
+        traced indices; the predicate operand rides in params."""
+        import jax.numpy as jnp
+        bsig = field.bsi_groups.get(field.name)
+        if bsig is None:
+            raise ExecutionError(f"field {field.name} is not an int field")
+        depth = bsig.bit_depth
+        view_name = view_bsi_name(field.name)
+        pos = plan.bank((field.name, view_name))
+        bank = self._get_bank_for(field, view_name, shards)
+        i0 = len(plan.idxs)
+        plan.idxs.extend(bank.slot(r) for r in range(depth + 1))
+
+        def planes_of(b, idxs):
+            return b[pos][idxs[i0:i0 + depth + 1]]
+
+        op = cond.op
+        zeros = (lambda b, i, p, l:
+                 jnp.zeros((len(shards), WORDS_PER_SHARD), jnp.uint32))
+        if op == BETWEEN:
+            lo_hi = cond.int_slice()
+            lo, ok_lo = bsig.base_value_clamped(lo_hi[0], ">=")
+            hi, ok_hi = bsig.base_value_clamped(lo_hi[1], "<=")
+            if not (ok_lo and ok_hi) or lo > hi:
+                plan.sig_parts.append("z")
+                return zeros
+            j = len(plan.params)
+            plan.params.extend([lo, hi])
+            plan.sig_parts.append(f"c><{pos}d{depth}")
+            return lambda b, i, p, l: bsi.between(planes_of(b, i), p[j],
+                                                  p[j + 1])
+        value = int(cond.value)
+        base, in_range = bsig.base_value_clamped(value, op)
+        if op in (EQ, NEQ) and not in_range:
+            if op == EQ:
+                plan.sig_parts.append("z")
+                return zeros
+            plan.sig_parts.append(f"cn{pos}d{depth}")
+            return lambda b, i, p, l: bsi.not_null(planes_of(b, i))
+        if op in (LT, LTE, GT, GTE) and not in_range:
+            plan.sig_parts.append("z")
+            return zeros
+        if op in (LT, LTE):
+            allow_eq = (op == LTE) or (value > bsig.max)
+        elif op in (GT, GTE):
+            allow_eq = (op == GTE) or (value < bsig.min)
+        else:
+            allow_eq = False
+        j = len(plan.params)
+        plan.params.append(base)
+        kernels = {
+            EQ: lambda pl, v: bsi.eq(pl, v),
+            NEQ: lambda pl, v: bsi.neq(pl, v),
+            LT: lambda pl, v: bsi.lt(pl, v, allow_eq=allow_eq),
+            LTE: lambda pl, v: bsi.lt(pl, v, allow_eq=True),
+            GT: lambda pl, v: bsi.gt(pl, v, allow_eq=allow_eq),
+            GTE: lambda pl, v: bsi.gt(pl, v, allow_eq=True),
+        }
+        kern = kernels[op]
+        plan.sig_parts.append(f"c{op}{int(allow_eq)}{pos}d{depth}")
+        return lambda b, i, p, l: kern(planes_of(b, i), p[j])
+
+    # ----------------------------------------------------------- bank fetch
+
+    def _get_bank(self, idx: Index, key: Tuple[str, str], shards):
+        field = idx.field(key[0])
+        return self._get_bank_for(field, key[1], shards)
+
+    def _get_bank_for(self, field: Field, view_name: str, shards):
+        view = field.view(view_name)
+        if view is None:
+            # Reads must not create views; absent view = all-zero rows.
+            return self._empty_bank(len(shards))
+        return view.device_bank(tuple(shards))
+
+    def _empty_bank(self, n_shards: int):
+        import jax.numpy as jnp
+        from pilosa_tpu.core.view import ViewBank
+        key = f"emptybank:{n_shards}"
+        bank = self._jit_cache.get(key)
+        if bank is None:
+            bank = ViewBank(
+                jnp.zeros((1, n_shards, WORDS_PER_SHARD), jnp.uint32),
+                {}, 0, {})
+            self._jit_cache[key] = bank
+        return bank
+
+    def _row_call_field(self, call: Call) -> Tuple[str, Any]:
+        """Extract (field, row-or-condition) from a Row()/Range() call."""
+        for k, v in call.args.items():
+            if k in ("from", "to", "_field") or k.startswith("_"):
+                continue
+            return k, v
+        raise ExecutionError(f"{call.name}() requires a field argument")
+
+    def _row_id(self, field: Field, row_ref) -> int:
+        if isinstance(row_ref, bool):
+            return 1 if row_ref else 0
+        if isinstance(row_ref, int):
+            return row_ref
+        if isinstance(row_ref, str):
+            raise ExecutionError(
+                f"field {field.name}: row keys require keys=True "
+                "(translation handled at the API layer)")
+        raise ExecutionError(f"invalid row reference {row_ref!r}")
+
+    # ----------------------------------------------------------------- TopN
+
+    def _counts_fn(self, with_filter: bool, shape) -> Callable:
+        """jit: bank chunk [R, S, W] (∧ filter [S, W]) -> counts [R] and raw
+        per-row popcounts [R] (for tanimoto)."""
+        import jax
+        import jax.numpy as jnp
+        from pilosa_tpu.ops.bitset import popcount
+        key = f"topn:{with_filter}:{shape}"
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            if with_filter:
+                def run(chunk, filt):
+                    inter = jnp.bitwise_and(chunk, filt)
+                    return (popcount(inter, axis=(-2, -1)),
+                            popcount(chunk, axis=(-2, -1)))
+            else:
+                def run(chunk, filt):
+                    c = popcount(chunk, axis=(-2, -1))
+                    return c, c
+            fn = jax.jit(run)
+            self._jit_cache[key] = fn
+        return fn
+
+    def _execute_topn(self, idx: Index, call: Call, shards) -> PairsResult:
+        """Exact TopN (reference executeTopN 2-phase approximation,
+        executor.go:694-733, fragment.top :1067). On TPU exact per-row
+        counts are one batched popcount over the view bank, so no candidate
+        phase or ranked-cache dependency is needed — strictly stronger than
+        the reference's cache-approximate result. Row sets larger than
+        TOPN_CHUNK_ROWS stream through the device in chunks."""
+        import jax.numpy as jnp
+        from pilosa_tpu.ops.bitset import popcount
+
+        field_name = call.arg("_field")
+        field = idx.field(field_name)
+        if field is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        n = call.uint_arg("n") or 0
+        shards = self._shards(idx, shards)
+        view = field.view(VIEW_STANDARD)
+        if view is None:
+            return PairsResult([])
+
+        filter_words = None
+        if call.children:
+            filter_words = self._eval_tree(idx, call.children[0], shards,
+                                           mode="row")
+        attr_name = call.arg("attrName")
+        allowed_rows = None
+        if attr_name is not None:
+            allowed_rows = set(field.row_attr_store.ids_matching(
+                attr_name, call.arg("attrValues", [])))
+        tanimoto = call.uint_arg("tanimotoThreshold") or 0
+
+        view_rows = sorted({r for s in shards
+                            for f_ in [view.fragment(s)] if f_
+                            for r in f_.row_ids()})
+        all_rows = view_rows
+        if allowed_rows is not None:
+            all_rows = [r for r in all_rows if r in allowed_rows]
+        if not all_rows:
+            return PairsResult([])
+
+        totals: Dict[int, int] = {}
+        raws: Dict[int, int] = {}
+        # The HBM bound must consider the *bank* size (all view rows), not
+        # the attr-filtered subset — the full-bank path materializes every
+        # view row.
+        if len(view_rows) <= TOPN_MAX_BANK_ROWS:
+            # Hot path: one fused popcount sweep over the whole cached bank
+            # (no gather); rows map to slots host-side, unused slots are
+            # zero rows and drop out naturally.
+            bank = view.device_bank(tuple(shards))
+            fn = self._counts_fn(filter_words is not None, bank.array.shape)
+            counts, raw = fn(bank.array, filter_words)
+            counts = np.asarray(counts)
+            raw = np.asarray(raw)
+            for r in all_rows:
+                s = bank.slot(r)
+                totals[r] = int(counts[s])
+                raws[r] = int(raw[s])
+        else:
+            # Huge row sets stream through transient chunk banks to bound
+            # HBM (the 50k-row ranked-cache shape).
+            for c0 in range(0, len(all_rows), TOPN_CHUNK_ROWS):
+                chunk_rows = all_rows[c0:c0 + TOPN_CHUNK_ROWS]
+                bank = view.device_bank(tuple(shards), rows=chunk_rows)
+                fn = self._counts_fn(filter_words is not None,
+                                     bank.array.shape)
+                counts, raw = fn(bank.array, filter_words)
+                counts = np.asarray(counts)
+                raw = np.asarray(raw)
+                for r in chunk_rows:
+                    s = bank.slot(r)
+                    totals[r] = int(counts[s])
+                    raws[r] = int(raw[s])
+
+        if tanimoto and filter_words is not None:
+            src_total = int(np.asarray(popcount(filter_words, axis=(-2, -1))))
+            totals = {r: inter for r, inter in totals.items()
+                      if (d := raws[r] + src_total - inter) > 0
+                      and (inter * 100) // d >= tanimoto}
+
+        pairs = sorted(((r, c) for r, c in totals.items() if c > 0),
+                       key=lambda rc: (-rc[1], rc[0]))
+        if n:
+            pairs = pairs[:n]
+        return PairsResult(pairs)
+
+    # ----------------------------------------------------------------- Rows
+
+    def _execute_rows(self, idx: Index, call: Call, shards
+                      ) -> RowIdentifiers:
+        """Row-id enumeration with previous/limit/column filters (reference
+        executeRowsShard, executor.go:1143)."""
+        field_name = call.arg("_field")
+        field = idx.field(field_name)
+        if field is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        shards = self._shards(idx, shards)
+        previous = call.arg("previous")
+        limit = call.uint_arg("limit")
+        column = call.arg("column")
+
+        view = field.view(VIEW_STANDARD)
+        rows: set = set()
+        for shard in shards:
+            frag = view.fragment(shard) if view else None
+            if frag is None:
+                continue
+            if column is not None:
+                if column // SHARD_WIDTH != shard:
+                    continue
+                for r in frag.row_ids():
+                    if frag.bit(r, column):
+                        rows.add(r)
+            else:
+                rows.update(frag.row_ids())
+        out = sorted(rows)
+        if previous is not None:
+            out = [r for r in out if r > previous]
+        if limit is not None:
+            out = out[:limit]
+        return RowIdentifiers(out)
+
+    # -------------------------------------------------------------- GroupBy
+
+    def _execute_group_by(self, idx: Index, call: Call, shards
+                          ) -> List[GroupCount]:
+        """Cross-product of Rows() children with intersection counts
+        (reference executeGroupByShard, executor.go:1062 + groupByIterator
+        :2820). TPU shape: intersect the (k-1)-prefix once, then count the
+        last field's rows against it in one batched kernel per prefix."""
+        import jax.numpy as jnp
+        from pilosa_tpu.ops.bitset import popcount
+
+        if not call.children or any(c.name != "Rows" for c in call.children):
+            raise ExecutionError("GroupBy requires Rows() arguments")
+        shards = self._shards(idx, shards)
+        limit = call.uint_arg("limit") or 0
+        filter_call = call.arg("filter")
+        filter_words = None
+        if isinstance(filter_call, Call):
+            filter_words = self._eval_tree(idx, filter_call, shards,
+                                           mode="row")
+
+        child_rows: List[Tuple[str, List[int]]] = []
+        for child in call.children:
+            ids = self._execute_rows(idx, child, shards).rows
+            child_rows.append((child.arg("_field"), ids))
+            if not ids:
+                return []
+
+        banks = {}
+        for fname, _ in child_rows:
+            f = idx.field(fname)
+            banks[fname] = f.view(VIEW_STANDARD).device_bank(tuple(shards))
+
+        results: List[GroupCount] = []
+
+        def rec(depth: int, prefix_words, prefix_rows: List[int]):
+            if limit and len(results) >= limit:
+                return
+            fname, ids = child_rows[depth]
+            bank = banks[fname]
+            last = depth == len(child_rows) - 1
+            if last:
+                sel = jnp.asarray(np.asarray([bank.slot(r) for r in ids],
+                                             dtype=np.int32))
+                stacks = bank.array[sel]  # [R, S, W]
+                inter = stacks if prefix_words is None else \
+                    jnp.bitwise_and(stacks, prefix_words)
+                counts = np.asarray(popcount(inter, axis=(-2, -1)))
+                for r, c in zip(ids, counts.tolist()):
+                    if c == 0:
+                        continue
+                    if limit and len(results) >= limit:
+                        return
+                    group = [FieldRow(f, rid) for (f, _), rid in
+                             zip(child_rows, prefix_rows + [r])]
+                    results.append(GroupCount(group, int(c)))
+                return
+            for r in ids:
+                words = bank.array[bank.slot(r)]
+                merged = words if prefix_words is None else \
+                    jnp.bitwise_and(words, prefix_words)
+                rec(depth + 1, merged, prefix_rows + [r])
+
+        rec(0, filter_words, [])
+        return results
+
+    # -------------------------------------------------------- Sum/Min/Max
+
+    def _execute_val_count(self, idx: Index, call: Call, shards, op: str
+                           ) -> ValCount:
+        """(reference executeSumCountShard :569, executeMinShard :610,
+        executeMaxShard :651)."""
+        import jax
+        import jax.numpy as jnp
+
+        field_name = call.arg("field") or call.arg("_field")
+        if field_name is None:
+            raise ExecutionError(f"{op}() requires a field argument")
+        field = idx.field(field_name)
+        if field is None:
+            raise ExecutionError(f"field not found: {field_name}")
+        bsig = field.bsi_groups.get(field_name)
+        if bsig is None:
+            raise ExecutionError(f"field {field_name} is not an int field")
+        shards = self._shards(idx, shards)
+        depth = bsig.bit_depth
+        bank = self._get_bank_for(field, view_bsi_name(field_name), shards)
+        sel = jnp.asarray(np.asarray([bank.slot(r) for r in range(depth + 1)],
+                                     dtype=np.int32))
+        filter_words = None
+        if call.children:
+            filter_words = self._eval_tree(idx, call.children[0], shards,
+                                           mode="row")
+
+        key = f"val:{op}:{bank.array.shape}:d{depth}:" \
+              f"{filter_words is not None}"
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            from pilosa_tpu.ops.bitset import popcount
+            if op == "Sum":
+                def run(bank_arr, sel, filt):
+                    return bsi.sum_count(bank_arr[sel], filt)
+            else:
+                kernel = bsi.min_mask if op == "Min" else bsi.max_mask
+
+                def run(bank_arr, sel, filt):
+                    bits, cand = kernel(bank_arr[sel], filt)
+                    return bits, popcount(cand, axis=(-2, -1))
+            fn = jax.jit(run)
+            self._jit_cache[key] = fn
+        a, b = fn(bank.array, sel, filter_words)
+        if op == "Sum":
+            counts = np.asarray(a, dtype=np.int64)
+            cnt = int(np.asarray(b))
+            total = sum(int(c) << i for i, c in enumerate(counts.tolist()))
+            return ValCount(total + bsig.min * cnt, cnt)
+        count = int(np.asarray(b))
+        if count == 0:
+            return ValCount(0, 0)
+        base = sum(int(v) << i for i, v in enumerate(np.asarray(a).tolist()))
+        return ValCount(base + bsig.min, count)
+
+    # --------------------------------------------------------------- writes
+
+    def _set_args(self, idx: Index, call: Call) -> Tuple[Field, int, Any]:
+        col = call.arg("_col")
+        if not isinstance(col, int):
+            raise ExecutionError("column keys require keys=True (API layer)")
+        fname, row_ref = self._row_call_field(call)
+        field = idx.field(fname)
+        if field is None:
+            raise ExecutionError(f"field not found: {fname}")
+        return field, col, row_ref
+
+    def _execute_set(self, idx: Index, call: Call) -> bool:
+        """(reference executeSet, executor.go:1889)."""
+        field, col, row_ref = self._set_args(idx, call)
+        if field.options.type == FIELD_TYPE_INT:
+            changed = field.set_value(col, int(row_ref))
+        else:
+            ts = call.arg("_timestamp")
+            timestamp = timeq.parse_timestamp(ts) if ts else None
+            row_id = self._row_id(field, row_ref)
+            changed = field.set_bit(row_id, col, timestamp=timestamp)
+        idx.add_existence(np.array([col], dtype=np.uint64))
+        return changed
+
+    def _execute_clear(self, idx: Index, call: Call) -> bool:
+        field, col, row_ref = self._set_args(idx, call)
+        if field.options.type == FIELD_TYPE_INT:
+            bsig = field.bsi_groups[field.name]
+            view = field.view(view_bsi_name(field.name))
+            if view is None:
+                return False
+            frag = view.fragment(col // SHARD_WIDTH)
+            return frag.clear_value(col, bsig.bit_depth) if frag else False
+        row_id = self._row_id(field, row_ref)
+        return field.clear_bit(row_id, col)
+
+    def _execute_clear_row(self, idx: Index, call: Call, shards) -> bool:
+        """(reference executeClearRowShard, executor.go:1761)."""
+        fname, row_ref = self._row_call_field(call)
+        field = idx.field(fname)
+        if field is None:
+            raise ExecutionError(f"field not found: {fname}")
+        if field.options.type not in (FIELD_TYPE_SET, FIELD_TYPE_TIME,
+                                      FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL):
+            raise ExecutionError(
+                f"ClearRow() is not supported on {field.options.type} fields")
+        row_id = self._row_id(field, row_ref)
+        shards = self._shards(idx, shards)
+        changed = False
+        for view in field.views.values():
+            for shard in shards:
+                frag = view.fragment(shard)
+                if frag is None:
+                    continue
+                cols = frag.row_columns(row_id)
+                if len(cols):
+                    frag.bulk_import(np.full(len(cols), row_id, np.uint64),
+                                     cols, clear=True)
+                    changed = True
+        return changed
+
+    def _execute_store(self, idx: Index, call: Call, shards) -> bool:
+        """Store(Row(...), f=row): write a computed row (reference
+        executeSetRowShard, executor.go:1834)."""
+        if len(call.children) != 1:
+            raise ExecutionError("Store() takes exactly one row argument")
+        fname, row_ref = self._row_call_field(call)
+        field = idx.field(fname)
+        if field is None:
+            field = idx.create_field(fname)
+        elif field.options.type not in (FIELD_TYPE_SET, FIELD_TYPE_TIME):
+            raise ExecutionError(
+                f"Store() is not supported on {field.options.type} fields")
+        row_id = self._row_id(field, row_ref)
+        shards = self._shards(idx, shards)
+        words = np.asarray(self._eval_tree(idx, call.children[0], shards,
+                                           mode="row"))
+        view = field.create_view_if_not_exists(VIEW_STANDARD)
+        for i, shard in enumerate(shards):
+            frag = view.create_fragment_if_not_exists(shard)
+            frag.set_row(row_id, words[i])
+        return True
+
+    def _execute_set_row_attrs(self, idx: Index, call: Call) -> None:
+        """(reference executeSetRowAttrs, executor.go:2029)."""
+        fname = call.arg("_field")
+        field = idx.field(fname)
+        if field is None:
+            raise ExecutionError(f"field not found: {fname}")
+        row_id = call.arg("_row")
+        attrs = {k: v for k, v in call.args.items() if not k.startswith("_")}
+        field.row_attr_store.set(int(row_id), attrs)
+
+    def _execute_set_column_attrs(self, idx: Index, call: Call) -> None:
+        col = call.arg("_col")
+        attrs = {k: v for k, v in call.args.items() if not k.startswith("_")}
+        idx.column_attr_store.set(int(col), attrs)
+
+    # ------------------------------------------------------------ row attrs
+
+    def _attach_row_attrs(self, idx: Index, call: Call, res: RowResult
+                          ) -> None:
+        if call.name not in ("Row", "Range"):
+            return
+        try:
+            fname, row_ref = self._row_call_field(call)
+        except ExecutionError:
+            return
+        field = idx.field(fname)
+        if field is None or isinstance(row_ref, Condition):
+            return
+        if isinstance(row_ref, int) and not isinstance(row_ref, bool):
+            res.attrs = field.row_attr_store.get(row_ref)

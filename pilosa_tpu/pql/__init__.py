@@ -1,0 +1,9 @@
+"""PQL — the Pilosa Query Language.
+
+Reference grammar: /root/reference/pql/pql.peg (PEG, compiled to a generated
+Go parser). Here: a hand-written recursive-descent parser producing the same
+Call/Condition AST shapes (/root/reference/pql/ast.go:27,247,466).
+"""
+
+from pilosa_tpu.pql.ast import Call, Condition, Query  # noqa: F401
+from pilosa_tpu.pql.parser import parse_string, ParseError  # noqa: F401

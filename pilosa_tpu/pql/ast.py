@@ -1,0 +1,88 @@
+"""PQL AST: Query -> Call tree with args and conditions.
+
+Mirrors /root/reference/pql/ast.go: `Call` (:247) holds a name, an argument
+map, and child calls; `Condition` (:466) is a comparison operator + operand
+used as an argument value (`Row(x > 5)`); positional tokens are stored under
+reserved arg keys `_field`, `_col`, `_row`, `_start`, `_end`, `_timestamp`
+(pql.peg `reserved`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# Condition operators (reference token names, pql/token.go).
+EQ = "=="
+NEQ = "!="
+LT = "<"
+LTE = "<="
+GT = ">"
+GTE = ">="
+BETWEEN = "><"
+
+
+@dataclass
+class Condition:
+    op: str
+    value: Any  # int/float, or [low, high] for BETWEEN (inclusive bounds)
+
+    def int_slice(self) -> List[int]:
+        if not isinstance(self.value, list):
+            raise ValueError(f"expected list value, got {self.value!r}")
+        return [int(v) for v in self.value]
+
+    def __str__(self) -> str:
+        return f"{self.op} {self.value}"
+
+
+@dataclass
+class Call:
+    name: str
+    args: Dict[str, Any] = field(default_factory=dict)
+    children: List["Call"] = field(default_factory=list)
+
+    def arg(self, key: str, default: Any = None) -> Any:
+        return self.args.get(key, default)
+
+    def uint_arg(self, key: str) -> Optional[int]:
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            raise ValueError(f"arg {key!r} must be numeric, got {v!r}")
+        return int(v)
+
+    def has_condition_arg(self) -> bool:
+        return any(isinstance(v, Condition) for v in self.args.values())
+
+    def condition_field(self) -> Optional[str]:
+        for k, v in self.args.items():
+            if isinstance(v, Condition):
+                return k
+        return None
+
+    def writes(self) -> bool:
+        return self.name in ("Set", "Clear", "ClearRow", "Store",
+                             "SetRowAttrs", "SetColumnAttrs")
+
+    def __str__(self) -> str:
+        parts = [str(c) for c in self.children]
+        for k in sorted(self.args):
+            v = self.args[k]
+            if isinstance(v, Condition):
+                parts.append(f"{k} {v}")
+            else:
+                parts.append(f"{k}={v!r}")
+        return f"{self.name}({', '.join(parts)})"
+
+
+@dataclass
+class Query:
+    calls: List[Call] = field(default_factory=list)
+
+    def write_calls(self) -> List[Call]:
+        return [c for c in self.calls if c.writes()]
+
+    def __str__(self) -> str:
+        return "\n".join(str(c) for c in self.calls)

@@ -1,0 +1,419 @@
+"""Recursive-descent PQL parser.
+
+Follows the PEG grammar /root/reference/pql/pql.peg rule for rule:
+Calls / Call (special forms Set, SetRowAttrs, SetColumnAttrs, Clear,
+ClearRow, Store, TopN, Rows, plus the generic IDENT form) / allargs / args /
+arg / COND / conditional / value / item. Semantics verified against the
+grammar actions (startCall/addPosNum/addCond/endConditional in
+/root/reference/pql/ast.go).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Tuple
+
+from pilosa_tpu.pql.ast import (
+    BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ, Query,
+)
+
+_IDENT_RE = re.compile(r"[A-Za-z][A-Za-z0-9]*")
+_FIELD_RE = re.compile(r"[A-Za-z][A-Za-z0-9_-]*")
+_RESERVED = ("_row", "_col", "_start", "_end", "_timestamp", "_field")
+_NUMBER_RE = re.compile(r"-?(\d+(\.\d*)?|\.\d+)")
+_UINT_RE = re.compile(r"[1-9]\d*|0")
+_CONDINT_RE = re.compile(r"-?[1-9]\d*|0")
+# token form of bare strings: letters/digits/dash/underscore/colon
+_TOKEN_RE = re.compile(r"[A-Za-z0-9\-_:]+")
+_TIMESTAMP_RE = re.compile(
+    r"\d{4}-[01]\d-[0-3]\d(T[0-2]\d:[0-6]\d(:[0-6]\d)?| [0-2]\d:[0-6]\d)?")
+
+
+class ParseError(ValueError):
+    pass
+
+
+class _Parser:
+    def __init__(self, src: str):
+        self.src = src
+        self.pos = 0
+
+    # -- low-level ----------------------------------------------------------
+
+    def error(self, msg: str):
+        raise ParseError(f"{msg} at offset {self.pos}: "
+                         f"{self.src[self.pos:self.pos + 20]!r}")
+
+    def sp(self) -> None:
+        while self.pos < len(self.src) and self.src[self.pos] in " \t\n\r":
+            self.pos += 1
+
+    def eof(self) -> bool:
+        return self.pos >= len(self.src)
+
+    def peek(self, s: str) -> bool:
+        return self.src.startswith(s, self.pos)
+
+    def lit(self, s: str) -> bool:
+        if self.src.startswith(s, self.pos):
+            self.pos += len(s)
+            return True
+        return False
+
+    def expect(self, s: str) -> None:
+        if not self.lit(s):
+            self.error(f"expected {s!r}")
+
+    def match(self, regex) -> Optional[str]:
+        m = regex.match(self.src, self.pos)
+        if m is None:
+            return None
+        self.pos = m.end()
+        return m.group(0)
+
+    def open(self) -> None:
+        self.expect("(")
+        self.sp()
+
+    def close(self) -> None:
+        self.expect(")")
+        self.sp()
+
+    def comma(self) -> bool:
+        save = self.pos
+        self.sp()
+        if self.lit(","):
+            self.sp()
+            return True
+        self.pos = save
+        return False
+
+    # -- grammar ------------------------------------------------------------
+
+    def parse_query(self) -> Query:
+        q = Query()
+        self.sp()
+        while not self.eof():
+            q.calls.append(self.parse_call())
+            self.sp()
+        return q
+
+    def parse_call(self) -> Call:
+        save = self.pos
+        name = self.match(_IDENT_RE)
+        if name is None:
+            self.error("expected call name")
+        if not self.peek("("):
+            self.pos = save
+            self.error("expected '(' after call name")
+        handler = getattr(self, f"_call_{name}", None)
+        if handler is not None:
+            return handler()
+        return self._call_generic(name)
+
+    # Special forms. Each mirrors one branch of pql.peg `Call`.
+
+    def _call_Set(self) -> Call:
+        call = Call("Set")
+        self.open()
+        self._pos_col(call)
+        self._req_comma()
+        self._args(call)
+        if self.comma():
+            call.args["_timestamp"] = self._timestamp()
+        self.close()
+        return call
+
+    def _call_SetRowAttrs(self) -> Call:
+        call = Call("SetRowAttrs")
+        self.open()
+        self._posfield(call)
+        self._req_comma()
+        self._pos_row(call)
+        self._req_comma()
+        self._args(call)
+        self.close()
+        return call
+
+    def _call_SetColumnAttrs(self) -> Call:
+        call = Call("SetColumnAttrs")
+        self.open()
+        self._pos_col(call)
+        self._req_comma()
+        self._args(call)
+        self.close()
+        return call
+
+    def _call_Clear(self) -> Call:
+        call = Call("Clear")
+        self.open()
+        self._pos_col(call)
+        self._req_comma()
+        self._args(call)
+        self.close()
+        return call
+
+    def _call_ClearRow(self) -> Call:
+        call = Call("ClearRow")
+        self.open()
+        self._arg(call)
+        self.close()
+        return call
+
+    def _call_Store(self) -> Call:
+        call = Call("Store")
+        self.open()
+        call.children.append(self.parse_call())
+        self.sp()
+        self._req_comma()
+        self._arg(call)
+        self.close()
+        return call
+
+    def _call_TopN(self) -> Call:
+        return self._posfield_form("TopN")
+
+    def _call_Rows(self) -> Call:
+        return self._posfield_form("Rows")
+
+    def _posfield_form(self, name: str) -> Call:
+        call = Call(name)
+        self.open()
+        self._posfield(call)
+        if self.comma():
+            self._allargs(call)
+        self.close()
+        return call
+
+    def _call_generic(self, name: str) -> Call:
+        call = Call(name)
+        self.open()
+        self._allargs(call)
+        self.comma()  # trailing comma allowed
+        self.close()
+        return call
+
+    # -- arg forms ----------------------------------------------------------
+
+    def _req_comma(self) -> None:
+        if not self.comma():
+            self.error("expected ','")
+
+    def _allargs(self, call: Call) -> None:
+        """allargs <- Call (comma Call)* (comma args)? / args / sp"""
+        self.sp()
+        if self._at_call():
+            call.children.append(self.parse_call())
+            self.sp()
+            while self.comma():
+                if self._at_call():
+                    call.children.append(self.parse_call())
+                    self.sp()
+                else:
+                    self._args(call)
+                    return
+            return
+        if self.peek(")"):
+            return
+        self._args(call)
+
+    def _at_call(self) -> bool:
+        m = _IDENT_RE.match(self.src, self.pos)
+        return m is not None and self.src.startswith("(", m.end())
+
+    def _args(self, call: Call) -> None:
+        """args <- arg (comma args)? sp  — PEG ordered choice: if the text
+        after a comma isn't an arg (e.g. Set's trailing timestamp), rewind
+        the comma and stop."""
+        self._arg(call)
+        while True:
+            save = self.pos
+            if not self.comma():
+                break
+            if self.peek(")"):
+                self.pos = save
+                break
+            try:
+                self._arg(call)
+            except ParseError:
+                self.pos = save
+                break
+        self.sp()
+
+    def _arg(self, call: Call) -> None:
+        """arg <- field '=' value / field COND value / conditional"""
+        save = self.pos
+        # conditional: int < field < int
+        cond = self._try_conditional(call)
+        if cond:
+            return
+        self.pos = save
+        name = self._field_name()
+        self.sp()
+        if self.peek("=") and not self.peek("=="):
+            self.lit("=")
+            self.sp()
+            call.args[name] = self._value()
+            return
+        op = self._cond_op()
+        if op is None:
+            self.error("expected '=' or comparison operator")
+        self.sp()
+        call.args[name] = Condition(op, self._value())
+
+    def _cond_op(self) -> Optional[str]:
+        for op in (BETWEEN, LTE, GTE, EQ, NEQ, LT, GT):
+            if self.lit(op):
+                return op
+        return None
+
+    def _try_conditional(self, call: Call) -> bool:
+        """conditional <- condint condLT condfield condLT condint
+        Normalized to an inclusive BETWEEN (reference endConditional,
+        pql/ast.go:82-101: '<' bumps the bound inward)."""
+        save = self.pos
+        low_s = self.match(_CONDINT_RE)
+        if low_s is None:
+            return False
+        self.sp()
+        op1 = "<=" if self.lit("<=") else ("<" if self.lit("<") else None)
+        if op1 is None:
+            self.pos = save
+            return False
+        self.sp()
+        field = self.match(_FIELD_RE)
+        if field is None:
+            self.pos = save
+            return False
+        self.sp()
+        op2 = "<=" if self.lit("<=") else ("<" if self.lit("<") else None)
+        if op2 is None:
+            self.pos = save
+            return False
+        self.sp()
+        high_s = self.match(_CONDINT_RE)
+        if high_s is None:
+            self.pos = save
+            return False
+        self.sp()
+        low, high = int(low_s), int(high_s)
+        if op1 == "<":
+            low += 1
+        if op2 == "<":
+            high -= 1
+        call.args[field] = Condition(BETWEEN, [low, high])
+        return True
+
+    def _field_name(self) -> str:
+        for r in _RESERVED:
+            if self.peek(r):
+                self.pos += len(r)
+                return r
+        name = self.match(_FIELD_RE)
+        if name is None:
+            self.error("expected field name")
+        return name
+
+    def _posfield(self, call: Call) -> None:
+        name = self.match(_FIELD_RE)
+        if name is None:
+            self.error("expected field name")
+        call.args["_field"] = name
+
+    def _pos_col(self, call: Call) -> None:
+        call.args["_col"] = self._pos_id()
+
+    def _pos_row(self, call: Call) -> None:
+        call.args["_row"] = self._pos_id()
+
+    def _pos_id(self) -> Any:
+        u = self.match(_UINT_RE)
+        if u is not None:
+            return int(u)
+        if self.lit("'"):
+            return self._quoted("'")
+        if self.lit('"'):
+            return self._quoted('"')
+        self.error("expected id or quoted key")
+
+    def _timestamp(self) -> str:
+        ts = self.match(_TIMESTAMP_RE)
+        if ts is not None:
+            return ts
+        if self.lit("'"):
+            return self._quoted("'")
+        if self.lit('"'):
+            return self._quoted('"')
+        self.error("expected timestamp")
+
+    def _quoted(self, q: str) -> str:
+        out = []
+        while self.pos < len(self.src):
+            ch = self.src[self.pos]
+            if ch == "\\" and self.pos + 1 < len(self.src) \
+                    and self.src[self.pos + 1] in (q, "\\"):
+                out.append(self.src[self.pos + 1])
+                self.pos += 2
+                continue
+            if ch == q:
+                self.pos += 1
+                return "".join(out)
+            out.append(ch)
+            self.pos += 1
+        self.error(f"unterminated {q} string")
+
+    # -- values -------------------------------------------------------------
+
+    def _value(self) -> Any:
+        if self.lit("["):
+            self.sp()
+            items: List[Any] = []
+            if not self.peek("]"):
+                items.append(self._item())
+                while self.comma():
+                    items.append(self._item())
+            self.sp()
+            self.expect("]")
+            self.sp()
+            return items
+        return self._item()
+
+    def _terminates_item(self, at: int) -> bool:
+        """item literals must be followed by comma/close/bracket (pql.peg
+        `&(comma / sp close)`)."""
+        i = at
+        while i < len(self.src) and self.src[i] in " \t\n\r":
+            i += 1
+        return i >= len(self.src) or self.src[i] in ",)]"
+
+    def _item(self) -> Any:
+        for word, val in (("null", None), ("true", True), ("false", False)):
+            if self.peek(word) and self._terminates_item(self.pos + len(word)):
+                self.pos += len(word)
+                return val
+        ts = self.match(_TIMESTAMP_RE)
+        if ts is not None and self._terminates_item(self.pos):
+            return ts
+        elif ts is not None:
+            self.pos -= len(ts)
+        num = self.match(_NUMBER_RE)
+        if num is not None and self._terminates_item(self.pos):
+            return float(num) if ("." in num) else int(num)
+        elif num is not None:
+            self.pos -= len(num)
+        if self._at_call():
+            return self.parse_call()
+        if self.lit('"'):
+            return self._quoted('"')
+        if self.lit("'"):
+            return self._quoted("'")
+        tok = self.match(_TOKEN_RE)
+        if tok is not None:
+            return tok
+        self.error("expected value")
+
+
+def parse_string(src: str) -> Query:
+    """Parse a PQL string into a Query (reference ParseString,
+    pql/parser.go)."""
+    return _Parser(src).parse_query()

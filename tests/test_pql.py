@@ -1,0 +1,137 @@
+"""PQL parser tests — shapes mirror the reference's parser behavioral spec."""
+
+import pytest
+
+from pilosa_tpu.pql import parse_string, ParseError
+from pilosa_tpu.pql.ast import BETWEEN, Condition
+
+
+def one(src):
+    q = parse_string(src)
+    assert len(q.calls) == 1
+    return q.calls[0]
+
+
+def test_row():
+    c = one("Row(f=10)")
+    assert c.name == "Row" and c.args == {"f": 10}
+
+
+def test_row_key():
+    c = one('Row(f="ten")')
+    assert c.args == {"f": "ten"}
+
+
+def test_nested_bitmap_ops():
+    c = one("Intersect(Row(a=1), Union(Row(b=2), Row(c=3)))")
+    assert c.name == "Intersect"
+    assert [ch.name for ch in c.children] == ["Row", "Union"]
+    assert c.children[1].children[0].args == {"b": 2}
+
+
+def test_set_and_clear():
+    c = one("Set(100, f=1)")
+    assert c.name == "Set" and c.args == {"_col": 100, "f": 1}
+    c = one("Set('colkey', f=1)")
+    assert c.args["_col"] == "colkey"
+    c = one("Set(100, f=1, 2018-03-04T05:06)")
+    assert c.args["_timestamp"] == "2018-03-04T05:06"
+    c = one("Clear(7, f=3)")
+    assert c.name == "Clear" and c.args == {"_col": 7, "f": 3}
+
+
+def test_clear_row_and_store():
+    c = one("ClearRow(f=5)")
+    assert c.name == "ClearRow" and c.args == {"f": 5}
+    c = one("Store(Row(f=9), g=2)")
+    assert c.name == "Store"
+    assert c.children[0].name == "Row" and c.args == {"g": 2}
+
+
+def test_topn():
+    c = one("TopN(f, n=25)")
+    assert c.name == "TopN" and c.args == {"_field": "f", "n": 25}
+    c = one("TopN(f)")
+    assert c.args == {"_field": "f"}
+    c = one("TopN(f, Row(other=7), n=10)")
+    assert c.children[0].name == "Row" and c.args["n"] == 10
+
+
+def test_rows():
+    c = one("Rows(f, previous=42, limit=10, column=3)")
+    assert c.args == {"_field": "f", "previous": 42, "limit": 10, "column": 3}
+
+
+def test_groupby():
+    c = one("GroupBy(Rows(a), Rows(b), limit=10, filter=Row(c=1))")
+    assert c.name == "GroupBy"
+    assert [ch.name for ch in c.children] == ["Rows", "Rows"]
+    assert c.args["limit"] == 10
+    assert c.args["filter"].name == "Row"
+
+
+def test_conditions():
+    for src, op, val in [
+        ("Row(n > 5)", ">", 5),
+        ("Row(n >= 5)", ">=", 5),
+        ("Row(n < -3)", "<", -3),
+        ("Row(n <= 0)", "<=", 0),
+        ("Row(n == 9)", "==", 9),
+        ("Row(n != 9)", "!=", 9),
+    ]:
+        c = one(src)
+        cond = c.args["n"]
+        assert isinstance(cond, Condition) and (cond.op, cond.value) == (op, val)
+
+
+def test_between_forms():
+    c = one("Row(n >< [4, 8])")
+    assert c.args["n"].op == BETWEEN and c.args["n"].value == [4, 8]
+    # conditional form, '<' bumps bounds inward (reference endConditional)
+    c = one("Row(4 < n < 9)")
+    assert c.args["n"].op == BETWEEN and c.args["n"].value == [5, 8]
+    c = one("Row(4 <= n <= 9)")
+    assert c.args["n"].value == [4, 9]
+
+
+def test_set_row_attrs():
+    c = one('SetRowAttrs(f, 10, color="blue", happy=true, age=18, x=null)')
+    assert c.args == {"_field": "f", "_row": 10, "color": "blue",
+                      "happy": True, "age": 18, "x": None}
+
+
+def test_set_column_attrs():
+    c = one('SetColumnAttrs(9, name="bob", active=false)')
+    assert c.args == {"_col": 9, "name": "bob", "active": False}
+
+
+def test_value_types():
+    c = one('Opts(a=1, b=-2, c=1.5, d=-0.5, e=[1,2,3], f="q\\"x", g=tok-en_1)')
+    assert c.args["a"] == 1 and c.args["b"] == -2
+    assert c.args["c"] == 1.5 and c.args["d"] == -0.5
+    assert c.args["e"] == [1, 2, 3]
+    assert c.args["f"] == 'q"x'
+    assert c.args["g"] == "tok-en_1"
+
+
+def test_multiple_calls():
+    q = parse_string(" Set(1, f=2)\n Row(f=2) ")
+    assert [c.name for c in q.calls] == ["Set", "Row"]
+    assert q.write_calls()[0].name == "Set"
+
+
+def test_time_range_row():
+    c = one("Row(f=1, from='2018-01-01T00:00', to='2019-01-01T00:00')")
+    assert c.args["from"] == "2018-01-01T00:00"
+
+
+def test_parse_errors():
+    for bad in ["Row(", "Row)", "Set(1 f=2)", "Row(f=)", "Row(=3)", "Foo", "5"]:
+        with pytest.raises(ParseError):
+            parse_string(bad)
+
+
+def test_call_as_value():
+    c = one("Count(Distinct(Row(f=1), field=other))")
+    assert c.children[0].name == "Distinct"
+    assert c.children[0].children[0].name == "Row"
