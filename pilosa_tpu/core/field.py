@@ -53,8 +53,16 @@ class FieldOptions:
         if self.type not in (FIELD_TYPE_SET, FIELD_TYPE_INT, FIELD_TYPE_TIME,
                              FIELD_TYPE_MUTEX, FIELD_TYPE_BOOL):
             raise ValueError(f"invalid field type: {self.type}")
-        if self.type == FIELD_TYPE_INT and self.max < self.min:
-            raise ValueError("int field max must be >= min")
+        if self.type == FIELD_TYPE_INT:
+            if self.max < self.min:
+                raise ValueError("int field max must be >= min")
+            # BSI predicate operands ride in uint32 device params (JAX runs
+            # without x64 on TPU); spans needing >32 bit planes would
+            # silently truncate, so reject them up front. (Two-limb params
+            # would lift this to the reference's 2^63 range.)
+            if (self.max - self.min).bit_length() > 32:
+                raise ValueError(
+                    "int field range too large: max-min must fit in 32 bits")
         if self.type == FIELD_TYPE_TIME:
             timeq.validate_quantum(self.time_quantum)
             if not self.time_quantum:

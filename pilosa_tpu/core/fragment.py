@@ -166,12 +166,21 @@ class Fragment:
     # -- row reads ----------------------------------------------------------
 
     def row_ids(self) -> List[int]:
-        """Sorted ids of rows that contain any bit."""
-        rows = set()
-        for key in self.storage.containers:
-            if self.storage.container_count(key):
-                rows.add(key // CONTAINERS_PER_ROW)
-        return sorted(rows)
+        """Sorted ids of rows that contain any bit. Cached per write
+        version — TopN/Rows walk this per query and fragments can hold
+        hundreds of thousands of containers."""
+        with self._lock:
+            cached = getattr(self, "_row_ids_cache", None)
+            if cached is not None and cached[0] == self.version:
+                return cached[1]
+            version = self.version  # snapshot BEFORE the walk
+            rows = set()
+            for key in self.storage.containers:
+                if self.storage.container_count(key):
+                    rows.add(key // CONTAINERS_PER_ROW)
+            out = sorted(rows)
+            self._row_ids_cache = (version, out)
+            return out
 
     def row_count(self, row_id: int) -> int:
         return self.storage.count_range(row_id * SHARD_WIDTH,

@@ -108,22 +108,25 @@ class View:
 
     # -- device bank --------------------------------------------------------
 
-    def device_bank(self, shards, rows=None) -> ViewBank:
+    def device_bank(self, shards, rows=None, mesh=None) -> ViewBank:
         """Bank for `shards` covering `rows` (default: all rows present in
-        any of the shards). Cached per shard tuple; rebuilt when any
+        any of the shards). Cached per (shard tuple, mesh); rebuilt when any
         fragment's write version moved. `rows` subsets build transient
-        (uncached) banks — used by chunked TopN over huge row sets."""
+        (uncached) banks — used by chunked TopN over huge row sets. With a
+        MeshContext the array is device_put sharded over the mesh's shard
+        axis, which is all the executor needs to run SPMD."""
         import jax.numpy as jnp
         from pilosa_tpu.ops.bitset import WORDS_PER_SHARD
 
         shards = tuple(shards)
+        cache_key = (shards, mesh.cache_key() if mesh else None)
         with self._lock:
             frags = {s: self.fragments.get(s) for s in shards}
             versions = {s: (f.version if f else -1) for s, f in frags.items()}
             if rows is None:
                 row_set = sorted({r for f in frags.values() if f
                                   for r in f.row_ids()})
-                cached = self._bank_cache.get(shards)
+                cached = self._bank_cache.get(cache_key)
                 if cached is not None:
                     if (cached.versions == versions
                             and all(r in cached.slots for r in row_set)):
@@ -131,7 +134,7 @@ class View:
                     patched = self._patch_bank(cached, frags, versions,
                                                row_set, shards)
                     if patched is not None:
-                        self._bank_cache[shards] = patched
+                        self._bank_cache[cache_key] = patched
                         return patched
             else:
                 row_set = sorted(set(rows))
@@ -147,9 +150,10 @@ class View:
                     f = frags[s]
                     if f is not None:
                         host[i, si] = f.row_dense(r)
-            bank = ViewBank(jnp.asarray(host), slots, cap - 1, versions)
+            array = mesh.put_bank(host) if mesh else jnp.asarray(host)
+            bank = ViewBank(array, slots, cap - 1, versions)
             if rows is None:
-                self._bank_cache[shards] = bank
+                self._bank_cache[cache_key] = bank
             return bank
 
     def _patch_bank(self, cached: "ViewBank", frags, versions, row_set,

@@ -84,8 +84,15 @@ class _Plan:
 
 
 class Executor:
-    def __init__(self, holder: Holder):
+    """Single-controller executor. With `mesh=None` everything runs on the
+    local device; with a MeshContext the shard list is padded onto the mesh
+    and banks are sharded over its shard axis — the same compiled query
+    programs then run SPMD with XLA-inserted ICI collectives (the TPU
+    replacement for mapReduce over HTTP, executor.go:2277)."""
+
+    def __init__(self, holder: Holder, mesh=None):
         self.holder = holder
+        self.mesh = mesh
         self._jit_cache: Dict[str, Callable] = {}
 
     # ------------------------------------------------------------------ API
@@ -137,10 +144,15 @@ class Executor:
             return self._execute_set_column_attrs(idx, call)
         raise ExecutionError(f"unknown call: {name}")
 
-    def _shards(self, idx: Index, shards) -> List[int]:
-        if shards is not None:
-            return list(shards)
-        return idx.available_shards() or [0]
+    def _shards(self, idx: Index, shards, pad: bool = True) -> List[int]:
+        available = idx.available_shards()
+        out = list(shards) if shards is not None else (available or [0])
+        if pad and self.mesh is not None:
+            # Padding ids must be absent from the whole index, not just
+            # the requested subset.
+            floor = (max(available) + 1) if available else 0
+            out = self.mesh.pad_shards(out, floor=floor)
+        return out
 
     # ----------------------------------------------------- bitmap call eval
 
@@ -168,8 +180,11 @@ class Executor:
         expr = self._plan_call(idx, call, shards, plan)
         banks = [self._get_bank(idx, key, shards) for key in plan.bank_keys]
         bank_arrays = tuple(b.array for b in banks)
-        lits = (jnp.stack(plan.literals)
-                if plan.literals else None)
+        lits = None
+        if plan.literals:
+            lits = jnp.stack(plan.literals)
+            if self.mesh is not None:
+                lits = self.mesh.put_row(lits)
         sig = (f"{mode}|{''.join(plan.sig_parts)}"
                f"|B{[a.shape for a in bank_arrays]}"
                f"|L{None if lits is None else lits.shape}|S{len(shards)}")
@@ -357,17 +372,19 @@ class Executor:
         if view is None:
             # Reads must not create views; absent view = all-zero rows.
             return self._empty_bank(len(shards))
-        return view.device_bank(tuple(shards))
+        return view.device_bank(tuple(shards), mesh=self.mesh)
 
     def _empty_bank(self, n_shards: int):
         import jax.numpy as jnp
         from pilosa_tpu.core.view import ViewBank
-        key = f"emptybank:{n_shards}"
+        mesh_key = self.mesh.cache_key() if self.mesh else None
+        key = f"emptybank:{n_shards}:{mesh_key}"
         bank = self._jit_cache.get(key)
         if bank is None:
-            bank = ViewBank(
-                jnp.zeros((1, n_shards, WORDS_PER_SHARD), jnp.uint32),
-                {}, 0, {})
+            host = np.zeros((1, n_shards, WORDS_PER_SHARD), np.uint32)
+            arr = self.mesh.put_bank(host) if self.mesh \
+                else jnp.asarray(host)
+            bank = ViewBank(arr, {}, 0, {})
             self._jit_cache[key] = bank
         return bank
 
@@ -407,12 +424,23 @@ class Executor:
                     return (popcount(inter, axis=(-2, -1)),
                             popcount(chunk, axis=(-2, -1)))
             else:
+                # Single output: the caller reuses it for both intersection
+                # and raw counts (one host fetch instead of two).
                 def run(chunk, filt):
                     c = popcount(chunk, axis=(-2, -1))
-                    return c, c
+                    return c
             fn = jax.jit(run)
             self._jit_cache[key] = fn
         return fn
+
+    def _run_counts(self, bank_array, filter_words):
+        """Run the counts kernel and fetch once: (counts_np, raw_np)."""
+        fn = self._counts_fn(filter_words is not None, bank_array.shape)
+        out = fn(bank_array, filter_words)
+        if filter_words is not None:
+            return np.asarray(out[0]), np.asarray(out[1])
+        c = np.asarray(out)
+        return c, c
 
     def _execute_topn(self, idx: Index, call: Call, shards) -> PairsResult:
         """Exact TopN (reference executeTopN 2-phase approximation,
@@ -463,11 +491,8 @@ class Executor:
             # Hot path: one fused popcount sweep over the whole cached bank
             # (no gather); rows map to slots host-side, unused slots are
             # zero rows and drop out naturally.
-            bank = view.device_bank(tuple(shards))
-            fn = self._counts_fn(filter_words is not None, bank.array.shape)
-            counts, raw = fn(bank.array, filter_words)
-            counts = np.asarray(counts)
-            raw = np.asarray(raw)
+            bank = view.device_bank(tuple(shards), mesh=self.mesh)
+            counts, raw = self._run_counts(bank.array, filter_words)
             for r in all_rows:
                 s = bank.slot(r)
                 totals[r] = int(counts[s])
@@ -477,12 +502,9 @@ class Executor:
             # HBM (the 50k-row ranked-cache shape).
             for c0 in range(0, len(all_rows), TOPN_CHUNK_ROWS):
                 chunk_rows = all_rows[c0:c0 + TOPN_CHUNK_ROWS]
-                bank = view.device_bank(tuple(shards), rows=chunk_rows)
-                fn = self._counts_fn(filter_words is not None,
-                                     bank.array.shape)
-                counts, raw = fn(bank.array, filter_words)
-                counts = np.asarray(counts)
-                raw = np.asarray(raw)
+                bank = view.device_bank(tuple(shards), rows=chunk_rows,
+                                        mesh=self.mesh)
+                counts, raw = self._run_counts(bank.array, filter_words)
                 for r in chunk_rows:
                     s = bank.slot(r)
                     totals[r] = int(counts[s])
@@ -567,7 +589,8 @@ class Executor:
         banks = {}
         for fname, _ in child_rows:
             f = idx.field(fname)
-            banks[fname] = f.view(VIEW_STANDARD).device_bank(tuple(shards))
+            banks[fname] = f.view(VIEW_STANDARD).device_bank(
+                tuple(shards), mesh=self.mesh)
 
         results: List[GroupCount] = []
 
@@ -706,7 +729,7 @@ class Executor:
             raise ExecutionError(
                 f"ClearRow() is not supported on {field.options.type} fields")
         row_id = self._row_id(field, row_ref)
-        shards = self._shards(idx, shards)
+        shards = self._shards(idx, shards, pad=False)  # host-side write
         changed = False
         for view in field.views.values():
             for shard in shards:
@@ -733,11 +756,14 @@ class Executor:
             raise ExecutionError(
                 f"Store() is not supported on {field.options.type} fields")
         row_id = self._row_id(field, row_ref)
-        shards = self._shards(idx, shards)
-        words = np.asarray(self._eval_tree(idx, call.children[0], shards,
+        real_shards = self._shards(idx, shards, pad=False)
+        padded = self._shards(idx, shards)
+        words = np.asarray(self._eval_tree(idx, call.children[0], padded,
                                            mode="row"))
         view = field.create_view_if_not_exists(VIEW_STANDARD)
-        for i, shard in enumerate(shards):
+        # Write only real shards — mesh padding appends at the tail and
+        # must never materialize phantom fragments.
+        for i, shard in enumerate(real_shards):
             frag = view.create_fragment_if_not_exists(shard)
             frag.set_row(row_id, words[i])
         return True

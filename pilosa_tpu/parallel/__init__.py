@@ -1,0 +1,12 @@
+"""Distribution layer: mesh placement, sharded execution, cluster state.
+
+Replaces the reference's distribution stack (cluster.go jump-hash
+placement, executor.go:2277 HTTP scatter-gather mapReduce, gossip
+membership) with the single-controller JAX model: shards map onto a
+`jax.sharding.Mesh` axis by static block placement, view banks are
+device_put with a NamedSharding over that axis, and the executor's
+compiled query programs auto-partition — XLA inserts the psum/all-gather
+collectives over ICI that the reference performed as HTTP fan-out/reduce.
+"""
+
+from pilosa_tpu.parallel.mesh import MeshContext, ShardPlacement  # noqa: F401

@@ -1,0 +1,123 @@
+"""Device mesh + shard placement.
+
+Reference mapping:
+- shard -> node placement: fnv64a(index,shard) mod 256 partitions ->
+  jump-hash -> node (cluster.go:828-913). Here placement is *static block
+  assignment onto a mesh axis*: device d owns shards where
+  (shard_position mod n_devices) == d once the shard list is padded to a
+  multiple of the mesh size. Elastic resize (cluster.go:1150's resize jobs
+  streaming fragments node-to-node) becomes: change the mesh, re-put the
+  banks — the durable store is the source of truth, so "resize" is a
+  re-shard + recompile, not a data-migration protocol.
+- mapReduce scatter-gather + reduce over HTTP (executor.go:2277-2415):
+  the executor's single compiled program runs SPMD over the mesh; the
+  shard-axis reduction (Count, TopN counts, BSI sums) lowers to psum/
+  all-reduce on ICI within a slice and DCN across slices.
+- replication (ReplicaN successor nodes, cluster.go:857): an optional
+  leading `replica` mesh axis over which banks are *replicated*
+  (PartitionSpec None on the shard axes), giving query failover the same
+  way replicas served reads in the reference.
+
+Multi-host: under `jax.distributed` initialization the same code spans
+hosts — the mesh covers all global devices and XLA routes inter-host
+collectives over DCN. No gossip/coordinator consensus is needed: the
+single controller owns schema and placement (survey §7.6).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ShardPlacement:
+    """Static block placement of a shard list onto n devices."""
+
+    def __init__(self, n_devices: int):
+        self.n = n_devices
+
+    def pad(self, shards: Sequence[int], floor: int = 0) -> List[int]:
+        """Pad the shard list to a multiple of n with provably-absent shard
+        ids (>= max(floor, max(shards)+1)); absent shards materialize as
+        all-zero bank columns and contribute nothing to any reduction.
+        `floor` must exceed every *existing* shard of the index, not just
+        the requested subset — otherwise padding could alias real shards
+        the caller excluded."""
+        shards = list(shards)
+        if not shards:
+            shards = [0]
+        rem = (-len(shards)) % self.n
+        if rem:
+            pad_base = max(floor, max(shards) + 1)
+            shards = shards + [pad_base + i for i in range(rem)]
+        return shards
+
+    def device_of(self, shards: Sequence[int], shard: int) -> int:
+        """Which device owns a shard (for diagnostics/routing)."""
+        padded = self.pad(shards)
+        return padded.index(shard) % self.n
+
+
+class MeshContext:
+    """Wraps a 1-or-2-axis mesh: optional 'replica' axis x 'shards' axis."""
+
+    SHARD_AXIS = "shards"
+    REPLICA_AXIS = "replica"
+
+    def __init__(self, devices: Optional[Sequence] = None,
+                 replicas: int = 1):
+        import jax
+        from jax.sharding import Mesh
+
+        devices = list(devices if devices is not None else jax.devices())
+        if replicas > 1:
+            if len(devices) % replicas:
+                raise ValueError(
+                    f"{len(devices)} devices not divisible by "
+                    f"{replicas} replicas")
+            arr = np.array(devices).reshape(replicas, -1)
+            self.mesh = Mesh(arr, (self.REPLICA_AXIS, self.SHARD_AXIS))
+            self.n_shard_devices = arr.shape[1]
+        else:
+            self.mesh = Mesh(np.array(devices), (self.SHARD_AXIS,))
+            self.n_shard_devices = len(devices)
+        self.replicas = replicas
+        self.placement = ShardPlacement(self.n_shard_devices)
+
+    # -- shardings ----------------------------------------------------------
+
+    def bank_sharding(self):
+        """[rows, shards, words]: shard axis split across devices, rows and
+        words replicated within a shard device."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(None, self.SHARD_AXIS, None))
+
+    def row_sharding(self):
+        """[shards, words] query-result rows."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P(self.SHARD_AXIS, None))
+
+    def replicated(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.mesh, P())
+
+    def cache_key(self) -> str:
+        dev_ids = tuple(d.id for d in self.mesh.devices.flat)
+        return f"mesh{self.replicas}x{self.n_shard_devices}:{hash(dev_ids)}"
+
+    def put_bank(self, host):
+        import jax
+        return jax.device_put(host, self.bank_sharding())
+
+    def put_row(self, arr):
+        """Commit a [shards, words] (or [k, shards, words]) array to the
+        mesh with the shard axis split."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = (P(self.SHARD_AXIS, None) if arr.ndim == 2
+                else P(None, self.SHARD_AXIS, None))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def pad_shards(self, shards: Sequence[int], floor: int = 0) -> List[int]:
+        return self.placement.pad(shards, floor)

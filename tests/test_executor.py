@@ -323,10 +323,11 @@ def test_incremental_bank_patch(ex):
     idx = h.index("i")
     assert e.execute("i", "Count(Row(f=1))") == [4]
     view = idx.field("f").view()
-    bank1 = view._bank_cache[tuple(idx.available_shards())]
+    key = (tuple(idx.available_shards()), None)
+    bank1 = view._bank_cache[key]
     e.execute("i", "Set(500, f=1)")
     assert e.execute("i", "Count(Row(f=1))") == [5]
-    bank2 = view._bank_cache[tuple(idx.available_shards())]
+    bank2 = view._bank_cache[key]
     # patched in place: same capacity array object lineage, same slots
     assert bank2.array.shape == bank1.array.shape
     assert bank2.slots == bank1.slots
