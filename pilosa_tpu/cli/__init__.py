@@ -1,0 +1,2 @@
+"""CLI (reference cmd/ + ctl/: server, import, export, check, inspect,
+config, generate-config)."""

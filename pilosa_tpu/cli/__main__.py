@@ -1,0 +1,4 @@
+from pilosa_tpu.cli.main import main
+import sys
+
+sys.exit(main())

@@ -1,0 +1,224 @@
+"""`python -m pilosa_tpu.cli` — the pilosa-tpu command.
+
+Reference command set (cmd/root.go:40-48): server, import, export, check,
+inspect, config, generate-config. Implementations mirror ctl/*.go:
+import = bulk CSV loader (ctl/import.go), check = roaring file integrity
+(ctl/check.go), inspect = container stats (ctl/inspect.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def cmd_server(args) -> int:
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.server import API, serve
+    from pilosa_tpu.utils.config import load_config
+    from pilosa_tpu.utils.logger import Logger
+    from pilosa_tpu.utils.stats import MemStatsClient, NopStatsClient
+    from pilosa_tpu.utils.tracing import RecordingTracer
+
+    cfg = load_config(args.config, {
+        "data_dir": args.data_dir, "bind": args.bind,
+        "verbose": args.verbose or None,
+    })
+    logger = Logger(verbose=cfg.verbose)
+    data_dir = os.path.expanduser(cfg.data_dir)
+    holder = Holder(data_dir)
+    holder.open()
+
+    mesh = None
+    if cfg.mesh_devices != 1:
+        import jax
+        devices = jax.devices()
+        n = cfg.mesh_devices or len(devices)
+        if n > 1 or cfg.mesh_replicas > 1:
+            from pilosa_tpu.parallel import MeshContext
+            mesh = MeshContext(devices[:n], replicas=cfg.mesh_replicas)
+
+    stats = MemStatsClient() if cfg.metric_service == "mem" \
+        else NopStatsClient()
+    api = API(holder, mesh=mesh, stats=stats, tracer=RecordingTracer())
+    api.logger = logger
+    logger.printf("pilosa-tpu server: data=%s bind=%s mesh=%s",
+                  data_dir, cfg.bind,
+                  mesh.mesh.shape if mesh else "single-device")
+    try:
+        serve(api, cfg.host, cfg.port)
+    finally:
+        holder.close()
+    return 0
+
+
+def cmd_import(args) -> int:
+    """Bulk CSV import: rows of `row,col` (or `col,value` with --field-type
+    int), straight into a local holder (reference ctl/import.go; the
+    reference also supports posting to a remote host — use the HTTP API
+    for that)."""
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+
+    holder = Holder(os.path.expanduser(args.data_dir))
+    holder.open()
+    idx = holder.create_index(args.index, error_if_exists=False)
+    rows, cols, vals = [], [], []
+    for path in args.files:
+        with open(path, newline="") as f:
+            for rec in csv.reader(f):
+                if not rec:
+                    continue
+                if args.field_type == "int":
+                    cols.append(int(rec[0]))
+                    vals.append(int(rec[1]))
+                else:
+                    rows.append(int(rec[0]))
+                    cols.append(int(rec[1]))
+    if args.field_type == "int":
+        lo, hi = (min(vals), max(vals)) if vals else (0, 0)
+        f = idx.field(args.field) or idx.create_field(
+            args.field, FieldOptions(type="int", min=lo, max=hi))
+        f.import_values(np.array(cols, np.uint64), np.array(vals, np.int64))
+    else:
+        f = idx.field(args.field) or idx.create_field(args.field)
+        f.import_bits(np.array(rows, np.uint64), np.array(cols, np.uint64))
+    idx.add_existence(np.array(cols, np.uint64))
+    holder.close()
+    print(f"imported {len(cols)} records into {args.index}/{args.field}")
+    return 0
+
+
+def cmd_export(args) -> int:
+    from pilosa_tpu.core.holder import Holder
+
+    holder = Holder(os.path.expanduser(args.data_dir))
+    holder.open()
+    idx = holder.index(args.index)
+    if idx is None or idx.field(args.field) is None:
+        print(f"not found: {args.index}/{args.field}", file=sys.stderr)
+        return 1
+    f = idx.field(args.field)
+    view = f.view()
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    for shard in (view.available_shards() if view else []):
+        frag = view.fragment(shard)
+        for row in frag.row_ids():
+            for col in frag.row_columns(row):
+                out.write(f"{row},{col}\n")
+    if out is not sys.stdout:
+        out.close()
+    holder.close()
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Verify roaring fragment file integrity (reference ctl/check.go)."""
+    from pilosa_tpu.storage.roaring import Bitmap
+
+    bad = 0
+    for path in args.files:
+        try:
+            with open(path, "rb") as f:
+                b = Bitmap.from_bytes(f.read())
+            print(f"{path}: ok ({b.count()} bits, "
+                  f"{len(b.containers)} containers, opN={b.op_n})")
+        except Exception as e:
+            bad += 1
+            print(f"{path}: CORRUPT: {e}", file=sys.stderr)
+    return 1 if bad else 0
+
+
+def cmd_inspect(args) -> int:
+    """Container stats for fragment files (reference ctl/inspect.go)."""
+    from pilosa_tpu.storage.roaring import Bitmap
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+    for path in args.files:
+        with open(path, "rb") as f:
+            b = Bitmap.from_bytes(f.read())
+        rows = {}
+        for key in sorted(b.containers):
+            row = (key << 16) // SHARD_WIDTH
+            rows.setdefault(row, [0, 0])
+            rows[row][0] += 1
+            rows[row][1] += b.container_count(key)
+        print(f"{path}: {b.count()} bits, {len(b.containers)} containers, "
+              f"{len(rows)} rows, opN={b.op_n}")
+        if args.verbose:
+            for row, (nc, nb) in sorted(rows.items()):
+                print(f"  row {row}: {nc} containers, {nb} bits")
+    return 0
+
+
+def cmd_config(args) -> int:
+    from pilosa_tpu.utils.config import load_config
+    from dataclasses import asdict
+
+    cfg = load_config(args.config, {})
+    print(json.dumps(asdict(cfg), indent=2))
+    return 0
+
+
+def cmd_generate_config(args) -> int:
+    from pilosa_tpu.utils.config import Config
+
+    print(Config().to_toml(), end="")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pilosa-tpu",
+        description="A TPU-native distributed bitmap index.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("server", help="run the server")
+    sp.add_argument("-d", "--data-dir", default=None)
+    sp.add_argument("-b", "--bind", default=None)
+    sp.add_argument("-c", "--config", default=None)
+    sp.add_argument("--verbose", action="store_true")
+    sp.set_defaults(fn=cmd_server)
+
+    ip = sub.add_parser("import", help="bulk import CSV files")
+    ip.add_argument("-d", "--data-dir", required=True)
+    ip.add_argument("-i", "--index", required=True)
+    ip.add_argument("-f", "--field", required=True)
+    ip.add_argument("--field-type", default="set", choices=["set", "int"])
+    ip.add_argument("files", nargs="+")
+    ip.set_defaults(fn=cmd_import)
+
+    ep = sub.add_parser("export", help="export a field as CSV")
+    ep.add_argument("-d", "--data-dir", required=True)
+    ep.add_argument("-i", "--index", required=True)
+    ep.add_argument("-f", "--field", required=True)
+    ep.add_argument("-o", "--output", default="-")
+    ep.set_defaults(fn=cmd_export)
+
+    cp = sub.add_parser("check", help="check fragment file integrity")
+    cp.add_argument("files", nargs="+")
+    cp.set_defaults(fn=cmd_check)
+
+    np_ = sub.add_parser("inspect", help="inspect fragment containers")
+    np_.add_argument("files", nargs="+")
+    np_.add_argument("--verbose", action="store_true")
+    np_.set_defaults(fn=cmd_inspect)
+
+    gp = sub.add_parser("config", help="print resolved configuration")
+    gp.add_argument("-c", "--config", default=None)
+    gp.set_defaults(fn=cmd_config)
+
+    gg = sub.add_parser("generate-config", help="print default TOML config")
+    gg.set_defaults(fn=cmd_generate_config)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -126,6 +126,7 @@ class Field:
         from pilosa_tpu.core.attrs import AttrStore
         self.row_attr_store = AttrStore(os.path.join(self.path, ".row_attrs"))
         self.row_attr_store.open()
+        self._row_translator = None  # lazy: only keyed fields pay for one
         if self.options.type == FIELD_TYPE_INT:
             self.bsi_groups[name] = BSIGroup(name, self.options.min,
                                              self.options.max)
@@ -159,10 +160,22 @@ class Field:
                 v.open()
                 self.views[name] = v
 
+    @property
+    def row_translator(self):
+        from pilosa_tpu.core.translate import TranslateStore
+        with self._lock:
+            if self._row_translator is None:
+                self._row_translator = TranslateStore(
+                    os.path.join(self.path, ".row_keys"))
+                self._row_translator.open()
+            return self._row_translator
+
     def close(self) -> None:
         with self._lock:
             for v in self.views.values():
                 v.close()
+            if self._row_translator is not None:
+                self._row_translator.close()
 
     def _new_view(self, name: str) -> View:
         v = View(os.path.join(self.path, "views", name), self.index,

@@ -35,6 +35,7 @@ class Index:
         from pilosa_tpu.core.attrs import AttrStore
         self.column_attr_store = AttrStore(os.path.join(path, ".col_attrs"))
         self.column_attr_store.open()
+        self._column_translator = None  # lazy: only keyed indexes pay
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -69,10 +70,22 @@ class Index:
         if self.track_existence and EXISTENCE_FIELD_NAME not in self.fields:
             self._create_existence_field()
 
+    @property
+    def column_translator(self):
+        from pilosa_tpu.core.translate import TranslateStore
+        with self._lock:
+            if self._column_translator is None:
+                self._column_translator = TranslateStore(
+                    os.path.join(self.path, ".keys"))
+                self._column_translator.open()
+            return self._column_translator
+
     def close(self) -> None:
         with self._lock:
             for f in self.fields.values():
                 f.close()
+            if self._column_translator is not None:
+                self._column_translator.close()
 
     def _notify_shard(self, field: str, shard: int) -> None:
         if self.on_new_shard is not None:

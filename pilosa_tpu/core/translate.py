@@ -1,0 +1,121 @@
+"""Key translation: string keys <-> uint64 ids.
+
+Reference: /root/reference/translate.go (TranslateStore interface :40,
+TranslateFile :56 — an append-only mmap log with an in-memory hash index,
+chained-replicated between nodes over HTTP). Here: an append-only record
+log replayed into a host dict. IDs are allocated sequentially from 1 in
+append order, so replicas that replay the same log derive the same
+mapping — the same property the reference's chained replication relies on
+(translate.go:400). The log is exposed for streaming from an offset
+(/internal/translate/data parity).
+
+Record format: uint32 length + utf-8 key bytes. Record i (0-based) maps to
+id i+1.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+class TranslateStore:
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._ids: Dict[str, int] = {}
+        self._keys: List[str] = []
+        self._file = None
+        self._lock = threading.RLock()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def open(self) -> None:
+        if self.path is None:
+            return
+        if os.path.exists(self.path):
+            with open(self.path, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos + 4 <= len(data):
+                (n,) = struct.unpack_from("<I", data, pos)
+                key = data[pos + 4: pos + 4 + n].decode("utf-8")
+                self._register(key)
+                pos += 4 + n
+        else:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        self._file = open(self.path, "ab")
+
+    def close(self) -> None:
+        if self._file is not None:
+            self._file.flush()
+            self._file.close()
+            self._file = None
+
+    def _register(self, key: str) -> int:
+        id_ = len(self._keys) + 1
+        self._keys.append(key)
+        self._ids[key] = id_
+        return id_
+
+    # -- translation --------------------------------------------------------
+
+    def translate_key(self, key: str, create: bool = True) -> Optional[int]:
+        with self._lock:
+            id_ = self._ids.get(key)
+            if id_ is None and create:
+                id_ = self._register(key)
+                if self._file is not None:
+                    raw = key.encode("utf-8")
+                    self._file.write(struct.pack("<I", len(raw)) + raw)
+                    self._file.flush()
+            return id_
+
+    def translate_keys(self, keys: Iterable[str], create: bool = True
+                       ) -> np.ndarray:
+        """(reference TranslateColumnsToUint64, translate.go:473)."""
+        return np.array([self.translate_key(k, create) or 0 for k in keys],
+                        dtype=np.uint64)
+
+    def translate_id(self, id_: int) -> Optional[str]:
+        with self._lock:
+            if 1 <= id_ <= len(self._keys):
+                return self._keys[id_ - 1]
+            return None
+
+    def translate_ids(self, ids: Iterable[int]) -> List[Optional[str]]:
+        return [self.translate_id(int(i)) for i in ids]
+
+    # -- replication --------------------------------------------------------
+
+    def log_size(self) -> int:
+        with self._lock:
+            return sum(4 + len(k.encode("utf-8")) for k in self._keys)
+
+    def read_log_from(self, offset: int) -> bytes:
+        """Serialized records from a byte offset (the replica streaming
+        endpoint /internal/translate/data, http/handler.go:273)."""
+        with self._lock:
+            out = bytearray()
+            for k in self._keys:
+                raw = k.encode("utf-8")
+                out += struct.pack("<I", len(raw)) + raw
+            return bytes(out[offset:])
+
+    def apply_log(self, data: bytes) -> int:
+        """Replay streamed records appended after our current tail
+        (replica side of chained replication, translate.go:400)."""
+        applied = 0
+        pos = 0
+        with self._lock:
+            while pos + 4 <= len(data):
+                (n,) = struct.unpack_from("<I", data, pos)
+                key = data[pos + 4: pos + 4 + n].decode("utf-8")
+                if key not in self._ids:
+                    self.translate_key(key, create=True)
+                    applied += 1
+                pos += 4 + n
+        return applied

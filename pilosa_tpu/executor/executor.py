@@ -110,8 +110,101 @@ class Executor:
             raise ExecutionError(f"index not found: {index_name}")
         results = []
         for call in query.calls:
-            results.append(self._execute_call(idx, call, shards))
+            self._translate_call(idx, call)
+            result = self._execute_call(idx, call, shards)
+            self._translate_result(idx, call, result)
+            results.append(result)
         return results
+
+    # ------------------------------------------------------- key translation
+
+    def _translate_call(self, idx: Index, call: Call) -> None:
+        """String keys -> ids in place (reference translateCall,
+        executor.go:2417-2505). Translation is call-shape-aware: only the
+        row/column-bearing args of each call form are touched — generic
+        string args (e.g. SetRowAttrs attribute values) pass through even
+        when an equally-named keyed field exists. Keys are allocated on
+        first use (TranslateColumnsToUint64 get-or-create semantics)."""
+        col = call.args.get("_col")
+        if isinstance(col, str):
+            if not idx.keys:
+                raise ExecutionError(
+                    f"index {idx.name} does not use column keys")
+            call.args["_col"] = int(
+                idx.column_translator.translate_key(col))
+        row = call.args.get("_row")
+        fname = call.args.get("_field")
+        if isinstance(row, str):
+            field = idx.field(fname) if fname else None
+            if field is None or not field.options.keys:
+                raise ExecutionError(
+                    f"string row value not allowed on field {fname}")
+            call.args["_row"] = int(field.row_translator.translate_key(row))
+        # The one field=row arg of Row/Range/Set/Clear/ClearRow/Store.
+        if call.name in ("Row", "Range", "Set", "Clear", "ClearRow",
+                         "Store"):
+            try:
+                k, v = self._row_call_field(call)
+            except ExecutionError:
+                k, v = None, None
+            if isinstance(v, str):
+                field = idx.field(k)
+                if field is None or not field.options.keys:
+                    raise ExecutionError(
+                        f"string row value not allowed on field {k}")
+                call.args[k] = int(field.row_translator.translate_key(v))
+        # Rows(previous=..., column=...) (reference executor.go:2443-2460).
+        if call.name in ("Rows", "TopN"):
+            field = idx.field(fname) if fname else None
+            prev = call.args.get("previous")
+            if isinstance(prev, str):
+                if field is None or not field.options.keys:
+                    raise ExecutionError(
+                        f"string previous not allowed on field {fname}")
+                call.args["previous"] = int(
+                    field.row_translator.translate_key(prev))
+            column = call.args.get("column")
+            if isinstance(column, str):
+                if not idx.keys:
+                    raise ExecutionError(
+                        f"index {idx.name} does not use column keys")
+                call.args["column"] = int(
+                    idx.column_translator.translate_key(column))
+        filt = call.args.get("filter")
+        if isinstance(filt, Call):
+            self._translate_call(idx, filt)
+        for child in call.children:
+            self._translate_call(idx, child)
+
+    def _translate_result(self, idx: Index, call: Call, result) -> None:
+        """Ids -> string keys on results (reference translateResults,
+        executor.go:2577)."""
+        if isinstance(result, RowResult) and idx.keys:
+            cols = result.columns()  # cached on the result for to_json
+            # Keep 1:1 alignment with columns; ids set outside the
+            # translator (raw-id imports) fall back to their decimal form.
+            result.keys = [k if k is not None else str(int(c))
+                           for c, k in zip(
+                               cols, idx.column_translator
+                               .translate_ids(cols))]
+            return
+        fname = call.args.get("_field")
+        field = idx.field(fname) if fname else None
+        keyed = field is not None and field.options.keys
+        if isinstance(result, PairsResult) and keyed:
+            result.keys = [field.row_translator.translate_id(r) or str(r)
+                           for r, _ in result.pairs]
+        elif isinstance(result, RowIdentifiers) and keyed:
+            result.keys = [field.row_translator.translate_id(r) or str(r)
+                           for r in result.rows]
+        elif isinstance(result, list):
+            for gc in result:
+                if isinstance(gc, GroupCount):
+                    for fr in gc.group:
+                        gf = idx.field(fr.field)
+                        if gf is not None and gf.options.keys:
+                            fr.row_key = gf.row_translator.translate_id(
+                                fr.row_id)
 
     # -------------------------------------------------------- call dispatch
 

@@ -26,17 +26,20 @@ class RowResult:
         self.words = words
         self.attrs: Dict[str, Any] = {}
         self.keys: Optional[List[str]] = None
+        self._columns: Optional[np.ndarray] = None
 
     def columns(self) -> np.ndarray:
+        if self._columns is not None:
+            return self._columns
         host = np.asarray(self.words)
         out = []
         for i, shard in enumerate(self.shards):
             pos = unpack_positions(host[i])
             if len(pos):
                 out.append(pos + np.uint64(shard * SHARD_WIDTH))
-        if not out:
-            return np.empty(0, dtype=np.uint64)
-        return np.concatenate(out)
+        self._columns = (np.concatenate(out) if out
+                         else np.empty(0, dtype=np.uint64))
+        return self._columns
 
     def count(self) -> int:
         from pilosa_tpu.ops.bitset import popcount

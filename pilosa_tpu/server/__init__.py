@@ -1,0 +1,4 @@
+"""API facade + HTTP surface (reference api.go, http/handler.go)."""
+
+from pilosa_tpu.server.api import API, ApiError  # noqa: F401
+from pilosa_tpu.server.http import Handler, serve  # noqa: F401

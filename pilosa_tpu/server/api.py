@@ -1,0 +1,261 @@
+"""API facade: the programmatic surface between transports and the engine.
+
+Reference: /root/reference/api.go:40 (API struct; Query :103, schema CRUD
+:130-393, Import :814, ImportValue :922, ImportRoaring :291, fragment/
+block/attr-diff sync endpoints :517-812, cluster admin :1084). Transport
+handlers (HTTP here, like the reference's gorilla/mux layer) stay thin and
+call this.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core import timeq
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.results import result_to_json
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+from pilosa_tpu import __version__
+
+
+class ApiError(ValueError):
+    def __init__(self, msg: str, status: int = 400):
+        super().__init__(msg)
+        self.status = status
+
+
+class API:
+    def __init__(self, holder: Holder, mesh=None, cluster=None,
+                 stats=None, tracer=None):
+        from pilosa_tpu.utils.stats import NopStatsClient
+        from pilosa_tpu.utils.tracing import NopTracer
+        self.holder = holder
+        self.executor = Executor(holder, mesh=mesh)
+        self.cluster = cluster
+        self.stats = stats or NopStatsClient()
+        self.tracer = tracer or NopTracer()
+
+    # ----------------------------------------------------------------- query
+
+    def query(self, index: str, query: str,
+              shards: Optional[Sequence[int]] = None) -> Dict[str, Any]:
+        """(reference API.Query, api.go:103). Returns the JSON-shaped
+        response {"results": [...]}."""
+        with self.tracer.span("API.Query", index=index):
+            self.stats.count("query", 1)
+            results = self.executor.execute(index, query, shards=shards)
+            return {"results": [result_to_json(r) for r in results]}
+
+    # ---------------------------------------------------------------- schema
+
+    def schema(self) -> Dict[str, Any]:
+        return {"indexes": self.holder.schema()}
+
+    def create_index(self, name: str, keys: bool = False,
+                     track_existence: bool = True) -> Dict[str, Any]:
+        try:
+            idx = self.holder.create_index(name, keys=keys,
+                                           track_existence=track_existence)
+        except ValueError as e:
+            raise ApiError(str(e), 409 if "exists" in str(e) else 400)
+        return {"name": idx.name}
+
+    def delete_index(self, name: str) -> None:
+        try:
+            self.holder.delete_index(name)
+        except KeyError as e:
+            raise ApiError(str(e), 404)
+
+    def create_field(self, index: str, name: str,
+                     options: Optional[dict] = None) -> Dict[str, Any]:
+        idx = self._index(index)
+        opts = FieldOptions()
+        options = dict(options or {})
+        mapping = {"type": "type", "cacheType": "cache_type",
+                   "cacheSize": "cache_size", "min": "min", "max": "max",
+                   "timeQuantum": "time_quantum", "keys": "keys",
+                   "noStandardView": "no_standard_view"}
+        for k, v in options.items():
+            if k not in mapping:
+                raise ApiError(f"unknown field option {k!r}")
+            setattr(opts, mapping[k], v)
+        try:
+            f = idx.create_field(name, opts)
+        except ValueError as e:
+            raise ApiError(str(e), 409 if "exists" in str(e) else 400)
+        return {"name": f.name}
+
+    def delete_field(self, index: str, name: str) -> None:
+        idx = self._index(index)
+        try:
+            idx.delete_field(name)
+        except KeyError as e:
+            raise ApiError(str(e), 404)
+
+    # --------------------------------------------------------------- imports
+
+    def import_bits(self, index: str, field: str, rows=None, columns=None,
+                    row_keys=None, column_keys=None, timestamps=None,
+                    clear: bool = False) -> None:
+        """Bulk bit import (reference API.Import, api.go:814): translate
+        keys, write bits, feed the existence field."""
+        idx = self._index(index)
+        f = self._field(idx, field)
+        if column_keys is not None:
+            if not idx.keys:
+                raise ApiError(f"index {index} does not use column keys")
+            columns = idx.column_translator.translate_keys(column_keys)
+        if row_keys is not None:
+            if not (f.options.keys or idx.keys):
+                raise ApiError(f"field {field} does not use row keys")
+            rows = f.row_translator.translate_keys(row_keys)
+        rows = np.asarray(rows, dtype=np.uint64)
+        columns = np.asarray(columns, dtype=np.uint64)
+        if len(rows) != len(columns):
+            raise ApiError("rows and columns length mismatch")
+        ts = None
+        if timestamps is not None:
+            ts = [datetime.fromtimestamp(t) if isinstance(t, (int, float))
+                  else (timeq.parse_timestamp(t) if isinstance(t, str) else t)
+                  for t in timestamps]
+        f.import_bits(rows, columns, timestamps=ts, clear=clear)
+        if not clear:
+            idx.add_existence(columns)
+
+    def import_values(self, index: str, field: str, columns=None,
+                      values=None, column_keys=None,
+                      clear: bool = False) -> None:
+        """(reference API.ImportValue, api.go:922)."""
+        idx = self._index(index)
+        f = self._field(idx, field)
+        if column_keys is not None:
+            columns = idx.column_translator.translate_keys(column_keys)
+        columns = np.asarray(columns, dtype=np.uint64)
+        values = np.asarray(values, dtype=np.int64)
+        if len(columns) != len(values):
+            raise ApiError("columns and values length mismatch")
+        try:
+            f.import_values(columns, values, clear=clear)
+        except ValueError as e:
+            raise ApiError(str(e))
+        if not clear:
+            idx.add_existence(columns)
+
+    def import_roaring(self, index: str, field: str, shard: int,
+                       data: bytes, clear: bool = False,
+                       view: str = "standard") -> None:
+        """Pre-serialized roaring import — the fastest path (reference
+        API.ImportRoaring, api.go:291)."""
+        idx = self._index(index)
+        f = self._field(idx, field)
+        frag = f.create_view_if_not_exists(view) \
+            .create_fragment_if_not_exists(shard)
+        try:
+            frag.import_roaring(data, clear=clear)
+        except ValueError as e:
+            raise ApiError(f"invalid roaring payload: {e}")
+        cols = frag.storage.slice() % np.uint64(SHARD_WIDTH) \
+            + np.uint64(shard * SHARD_WIDTH)
+        if len(cols):
+            idx.add_existence(np.unique(cols))
+
+    # ---------------------------------------------------------------- export
+
+    def export_csv(self, index: str, field: str, shard: int) -> str:
+        """CSV rows 'row,col' for one shard (reference handleGetExport /
+        ctl/export.go)."""
+        idx = self._index(index)
+        f = self._field(idx, field)
+        view = f.view()
+        if view is None or view.fragment(shard) is None:
+            return ""
+        frag = view.fragment(shard)
+        lines = []
+        for row in frag.row_ids():
+            for col in frag.row_columns(row):
+                lines.append(f"{row},{col}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    # ------------------------------------------------------- sync primitives
+
+    def fragment_blocks(self, index: str, field: str, view: str, shard: int):
+        frag = self._fragment(index, field, view, shard)
+        return [{"block": b, "checksum": c.hex()}
+                for b, c in frag.checksum_blocks()]
+
+    def fragment_block_data(self, index: str, field: str, view: str,
+                            shard: int, block: int):
+        frag = self._fragment(index, field, view, shard)
+        rows, cols = frag.block_data(block)
+        return {"rows": rows.tolist(), "columns": cols.tolist()}
+
+    def fragment_data(self, index: str, field: str, view: str, shard: int
+                      ) -> bytes:
+        """Full fragment stream (reference GET /internal/fragment/data)."""
+        return self._fragment(index, field, view, shard).write_bytes()
+
+    def translate_data(self, index: str, field: Optional[str] = None,
+                       offset: int = 0) -> bytes:
+        idx = self._index(index)
+        store = idx.column_translator if field is None \
+            else self._field(idx, field).row_translator
+        return store.read_log_from(offset)
+
+    def recalculate_caches(self) -> None:
+        for idx in self.holder.indexes.values():
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    for frag in v.fragments.values():
+                        frag.cache.invalidate()
+                        for r in frag.row_ids():
+                            frag.cache.add(r, frag.row_count(r))
+
+    # ---------------------------------------------------------------- status
+
+    def shards_max(self) -> Dict[str, int]:
+        return {idx.name: (max(idx.available_shards()) if
+                           idx.available_shards() else 0)
+                for idx in self.holder.indexes.values()}
+
+    def status(self) -> Dict[str, Any]:
+        if self.cluster is not None:
+            return self.cluster.status()
+        return {"state": "NORMAL",
+                "nodes": [{"id": self.holder.node_id, "isCoordinator": True,
+                           "uri": {}}]}
+
+    def info(self) -> Dict[str, Any]:
+        import os
+        return {"shardWidth": SHARD_WIDTH, "cpuPhysicalCores": os.cpu_count(),
+                "version": __version__}
+
+    def version(self) -> Dict[str, str]:
+        return {"version": __version__}
+
+    # --------------------------------------------------------------- helpers
+
+    def _index(self, name: str):
+        idx = self.holder.index(name)
+        if idx is None:
+            raise ApiError(f"index not found: {name}", 404)
+        return idx
+
+    def _field(self, idx, name: str):
+        f = idx.field(name)
+        if f is None:
+            raise ApiError(f"field not found: {name}", 404)
+        return f
+
+    def _fragment(self, index, field, view, shard):
+        idx = self._index(index)
+        f = self._field(idx, field)
+        v = f.view(view)
+        frag = v.fragment(shard) if v else None
+        if frag is None:
+            raise ApiError("fragment not found", 404)
+        return frag

@@ -1,0 +1,253 @@
+"""HTTP surface: the reference's REST routes on the stdlib HTTP server.
+
+Reference: /root/reference/http/handler.go:236-280 (route table). Bodies
+are JSON (the reference negotiates protobuf or JSON; JSON is the
+documented public surface) except import-roaring and fragment data, which
+are raw roaring bytes, exactly like the reference.
+
+Routes implemented (public):
+  GET  /                      home/info
+  POST /index/{i}/query       PQL (body: raw PQL or {"query": ...})
+  GET  /schema  /status  /info  /version  /debug/vars
+  GET  /index   /index/{i}
+  POST /index/{i}             {"options": {"keys": bool, ...}}
+  DEL  /index/{i}
+  POST /index/{i}/field/{f}   {"options": {...}}
+  DEL  /index/{i}/field/{f}
+  POST /index/{i}/field/{f}/import            {"rows": [...], ...}
+  POST /index/{i}/field/{f}/import-roaring/{s} raw roaring bytes
+  GET  /export?index&field&shard
+  POST /recalculate-caches
+Internal (node-to-node / sync):
+  GET  /internal/fragment/blocks?index&field&view&shard
+  GET  /internal/fragment/block/data?...&block
+  GET  /internal/fragment/data?...
+  GET  /internal/shards/max
+  GET  /internal/translate/data?index[&field][&offset]
+  GET  /internal/nodes
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from pilosa_tpu.server.api import API, ApiError
+
+
+class Handler(BaseHTTPRequestHandler):
+    api: API = None  # injected by serve()
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing -----------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # route through our logger
+        logger = getattr(self.api, "logger", None)
+        if logger is not None:
+            logger.debugf(fmt % args)
+
+    def _json(self, obj: Any, status: int = 200) -> None:
+        body = json.dumps(obj).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _bytes(self, data: bytes, status: int = 200,
+               ctype: str = "application/octet-stream") -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _error(self, msg: str, status: int = 400) -> None:
+        self._json({"error": msg}, status)
+
+    def _body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n else b""
+
+    def _body_json(self) -> dict:
+        raw = self._body()
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise ApiError(f"invalid JSON body: {e}")
+
+    def _route(self) -> Tuple[str, dict, dict]:
+        parsed = urlparse(self.path)
+        query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        return parsed.path.rstrip("/") or "/", query, {}
+
+    # -- dispatch -----------------------------------------------------------
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    def _dispatch(self, method: str) -> None:
+        path, q, _ = self._route()
+        if hasattr(self.api, "tracer"):
+            self.api.tracer.extract(self.headers)
+        try:
+            handled = self._handle(method, path, q)
+            if not handled:
+                self._error(f"no route for {method} {path}", 404)
+        except ApiError as e:
+            self._error(str(e), e.status)
+        except Exception as e:  # mirror the reference's panic recovery
+            self._error(f"internal error: {type(e).__name__}: {e}", 500)
+
+    def _handle(self, method: str, path: str, q: dict) -> bool:
+        api = self.api
+
+        if method == "GET":
+            if path == "/":
+                self._json({"pilosa-tpu": True, **api.info()})
+            elif path == "/schema":
+                self._json(api.schema())
+            elif path == "/status":
+                self._json(api.status())
+            elif path == "/info":
+                self._json(api.info())
+            elif path == "/version":
+                self._json(api.version())
+            elif path == "/debug/vars":
+                stats = getattr(api.stats, "snapshot", lambda: {})()
+                self._json(stats)
+            elif path == "/index":
+                self._json(api.schema()["indexes"])
+            elif m := re.fullmatch(r"/index/([^/]+)", path):
+                for idx in api.schema()["indexes"]:
+                    if idx["name"] == m.group(1):
+                        self._json(idx)
+                        return True
+                raise ApiError(f"index not found: {m.group(1)}", 404)
+            elif path == "/export":
+                csv = api.export_csv(q["index"], q["field"],
+                                     int(q.get("shard", 0)))
+                self._bytes(csv.encode(), ctype="text/csv")
+            elif path == "/internal/fragment/blocks":
+                self._json({"blocks": api.fragment_blocks(
+                    q["index"], q["field"], q.get("view", "standard"),
+                    int(q["shard"]))})
+            elif path == "/internal/fragment/block/data":
+                self._json(api.fragment_block_data(
+                    q["index"], q["field"], q.get("view", "standard"),
+                    int(q["shard"]), int(q["block"])))
+            elif path == "/internal/fragment/data":
+                self._bytes(api.fragment_data(
+                    q["index"], q["field"], q.get("view", "standard"),
+                    int(q["shard"])))
+            elif path == "/internal/shards/max":
+                self._json({"standard": api.shards_max()})
+            elif path == "/internal/translate/data":
+                self._bytes(api.translate_data(
+                    q["index"], q.get("field"), int(q.get("offset", 0))))
+            elif path == "/internal/nodes":
+                self._json(api.status().get("nodes", []))
+            else:
+                return False
+            return True
+
+        if method == "POST":
+            if m := re.fullmatch(r"/index/([^/]+)/query", path):
+                raw = self._body()
+                try:
+                    body = json.loads(raw) if raw.lstrip()[:1] == b"{" else None
+                except json.JSONDecodeError:
+                    body = None
+                pql = (body or {}).get("query") if body else raw.decode()
+                shards = None
+                if "shards" in q:
+                    shards = [int(s) for s in q["shards"].split(",")]
+                try:
+                    self._json(api.query(m.group(1), pql, shards=shards))
+                except ValueError as e:
+                    raise ApiError(str(e))
+            elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)/import",
+                                   path):
+                b = self._body_json()
+                if "values" in b:
+                    api.import_values(
+                        m.group(1), m.group(2), columns=b.get("columnIDs"),
+                        values=b["values"], column_keys=b.get("columnKeys"),
+                        clear=bool(q.get("clear")))
+                else:
+                    api.import_bits(
+                        m.group(1), m.group(2), rows=b.get("rowIDs"),
+                        columns=b.get("columnIDs"),
+                        row_keys=b.get("rowKeys"),
+                        column_keys=b.get("columnKeys"),
+                        timestamps=b.get("timestamps"),
+                        clear=bool(q.get("clear")))
+                self._json({})
+            elif m := re.fullmatch(
+                    r"/index/([^/]+)/field/([^/]+)/import-roaring/(\d+)",
+                    path):
+                api.import_roaring(m.group(1), m.group(2), int(m.group(3)),
+                                   self._body(), clear=bool(q.get("clear")),
+                                   view=q.get("view", "standard"))
+                self._json({})
+            elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)", path):
+                b = self._body_json()
+                self._json(api.create_field(m.group(1), m.group(2),
+                                            b.get("options")))
+            elif m := re.fullmatch(r"/index/([^/]+)", path):
+                b = self._body_json()
+                opts = b.get("options", {})
+                self._json(api.create_index(
+                    m.group(1), keys=opts.get("keys", False),
+                    track_existence=opts.get("trackExistence", True)))
+            elif path == "/recalculate-caches":
+                api.recalculate_caches()
+                self._json({})
+            else:
+                return False
+            return True
+
+        if method == "DELETE":
+            if m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)", path):
+                api.delete_field(m.group(1), m.group(2))
+                self._json({})
+            elif m := re.fullmatch(r"/index/([^/]+)", path):
+                api.delete_index(m.group(1))
+                self._json({})
+            else:
+                return False
+            return True
+
+        return False
+
+
+def serve(api: API, host: str = "localhost", port: int = 10101,
+          background: bool = False):
+    """Start the HTTP server (reference handler.Serve,
+    http/handler.go:150). Returns the server; blocking unless
+    background=True."""
+    handler = type("BoundHandler", (Handler,), {"api": api})
+    server = ThreadingHTTPServer((host, port), handler)
+    if background:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        return server
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return server

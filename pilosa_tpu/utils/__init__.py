@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: stats, tracing, logging, config."""

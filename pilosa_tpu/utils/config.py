@@ -1,0 +1,97 @@
+"""Server configuration.
+
+Reference: /root/reference/server/config.go:43 (TOML schema) with cobra/
+viper precedence flags > env (PILOSA_*) > TOML file (cmd/root.go:55-75).
+Same precedence here: CLI flags > PILOSA_TPU_* env > TOML file > defaults.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, asdict
+from typing import Any, Dict, Optional
+
+ENV_PREFIX = "PILOSA_TPU_"
+
+
+@dataclass
+class Config:
+    data_dir: str = "~/.pilosa_tpu"
+    bind: str = "localhost:10101"
+    verbose: bool = False
+    # Query
+    max_writes_per_request: int = 5000
+    long_query_time: float = 0.0  # seconds; 0 disables slow-query logging
+    # TPU
+    mesh_devices: int = 0         # 0 = all visible devices
+    mesh_replicas: int = 1
+    # Anti-entropy
+    anti_entropy_interval: float = 600.0
+    # Metrics
+    metric_service: str = "mem"   # mem | none
+    # Cluster
+    cluster_peers: list = field(default_factory=list)
+
+    @property
+    def host(self) -> str:
+        return self.bind.rsplit(":", 1)[0] or "localhost"
+
+    @property
+    def port(self) -> int:
+        parts = self.bind.rsplit(":", 1)
+        return int(parts[1]) if len(parts) == 2 and parts[1] else 10101
+
+    def validate(self) -> None:
+        if self.port <= 0 or self.port > 65535:
+            raise ValueError(f"invalid port {self.port}")
+        if self.mesh_replicas < 1:
+            raise ValueError("mesh_replicas must be >= 1")
+
+    def to_toml(self) -> str:
+        lines = []
+        for k, v in asdict(self).items():
+            if isinstance(v, str):
+                lines.append(f'{k} = "{v}"')
+            elif isinstance(v, bool):
+                lines.append(f"{k} = {str(v).lower()}")
+            elif isinstance(v, list):
+                items = ", ".join(f'"{x}"' for x in v)
+                lines.append(f"{k} = [{items}]")
+            else:
+                lines.append(f"{k} = {v}")
+        return "\n".join(lines) + "\n"
+
+
+def load_config(path: Optional[str] = None,
+                overrides: Optional[Dict[str, Any]] = None) -> Config:
+    """flags > env > file > defaults (reference cmd/root.go:55-75)."""
+    cfg = Config()
+    if path:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        for k, v in data.items():
+            k = k.replace("-", "_")
+            if hasattr(cfg, k):
+                setattr(cfg, k, v)
+            else:
+                raise ValueError(f"unknown config key {k!r}")
+    for k in list(vars(cfg)):
+        env = os.environ.get(ENV_PREFIX + k.upper())
+        if env is not None:
+            cur = getattr(cfg, k)
+            if isinstance(cur, bool):
+                setattr(cfg, k, env.lower() in ("1", "true", "yes"))
+            elif isinstance(cur, int):
+                setattr(cfg, k, int(env))
+            elif isinstance(cur, float):
+                setattr(cfg, k, float(env))
+            elif isinstance(cur, list):
+                setattr(cfg, k, [s for s in env.split(",") if s])
+            else:
+                setattr(cfg, k, env)
+    for k, v in (overrides or {}).items():
+        if v is not None:
+            setattr(cfg, k, v)
+    cfg.validate()
+    return cfg

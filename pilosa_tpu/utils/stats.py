@@ -1,0 +1,144 @@
+"""Metrics interface.
+
+Reference: /root/reference/stats/stats.go:31 (StatsClient: Count/Gauge/
+Histogram/Set/Timing with tags; expvar impl :84, statsd impl
+statsd/statsd.go:41, multi-client :164). Implementations here: in-memory
+(expvar-equivalent, served at /debug/vars), nop, and multi.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence
+
+
+class StatsClient:
+    def with_tags(self, *tags: str) -> "StatsClient":
+        return self
+
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
+        pass
+
+    def gauge(self, name: str, value: float, rate: float = 1.0) -> None:
+        pass
+
+    def histogram(self, name: str, value: float, rate: float = 1.0) -> None:
+        pass
+
+    def set(self, name: str, value: str, rate: float = 1.0) -> None:
+        pass
+
+    def timing(self, name: str, value: float, rate: float = 1.0) -> None:
+        pass
+
+
+class NopStatsClient(StatsClient):
+    pass
+
+
+class MemStatsClient(StatsClient):
+    """In-memory stats served at /debug/vars (the reference's expvar
+    backend, stats/stats.go:84)."""
+
+    def __init__(self, tags: Optional[Sequence[str]] = None, parent=None):
+        self._parent = parent or self
+        self.tags = tuple(tags or ())
+        if parent is None:
+            self.counters: Dict[str, int] = defaultdict(int)
+            self.gauges: Dict[str, float] = {}
+            self.timings: Dict[str, List[float]] = defaultdict(list)
+            self.sets: Dict[str, set] = defaultdict(set)
+            self._lock = threading.Lock()
+
+    def _key(self, name: str) -> str:
+        return f"{name}{{{','.join(self.tags)}}}" if self.tags else name
+
+    def with_tags(self, *tags: str) -> "MemStatsClient":
+        child = MemStatsClient(tags=self.tags + tags, parent=self._parent)
+        return child
+
+    def count(self, name, value=1, rate=1.0):
+        root = self._parent
+        with root._lock:
+            root.counters[self._key(name)] += value
+
+    def gauge(self, name, value, rate=1.0):
+        root = self._parent
+        with root._lock:
+            root.gauges[self._key(name)] = value
+
+    def histogram(self, name, value, rate=1.0):
+        self.timing(name, value, rate)
+
+    def set(self, name, value, rate=1.0):
+        root = self._parent
+        with root._lock:
+            root.sets[self._key(name)].add(value)
+
+    def timing(self, name, value, rate=1.0):
+        root = self._parent
+        with root._lock:
+            vals = root.timings[self._key(name)]
+            vals.append(value)
+            if len(vals) > 1000:
+                del vals[:-1000]
+
+    def snapshot(self) -> dict:
+        root = self._parent
+        with root._lock:
+            out = {"counters": dict(root.counters),
+                   "gauges": dict(root.gauges),
+                   "sets": {k: sorted(v) for k, v in root.sets.items()}}
+            out["timings"] = {}
+            for k, vals in root.timings.items():
+                if vals:
+                    s = sorted(vals)
+                    out["timings"][k] = {
+                        "count": len(s),
+                        "p50": s[len(s) // 2],
+                        "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
+                    }
+            return out
+
+
+class MultiStatsClient(StatsClient):
+    def __init__(self, *clients: StatsClient):
+        self.clients = clients
+
+    def with_tags(self, *tags):
+        return MultiStatsClient(*[c.with_tags(*tags) for c in self.clients])
+
+    def count(self, name, value=1, rate=1.0):
+        for c in self.clients:
+            c.count(name, value, rate)
+
+    def gauge(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.gauge(name, value, rate)
+
+    def histogram(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.histogram(name, value, rate)
+
+    def set(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.set(name, value, rate)
+
+    def timing(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.timing(name, value, rate)
+
+
+class Timer:
+    def __init__(self, stats: StatsClient, name: str):
+        self.stats = stats
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.stats.timing(self.name, time.perf_counter() - self.t0)

@@ -1,0 +1,90 @@
+"""Tracing facade.
+
+Reference: /root/reference/tracing/tracing.go:18-56 — a global tracer with
+StartSpanFromContext plus HTTP header inject/extract at node boundaries.
+Here: a minimal span tree recorder with W3C-traceparent-style header
+propagation; pluggable like the reference's opentracing adapter.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+TRACE_HEADER = "X-Trace-Id"
+
+
+class Span:
+    __slots__ = ("name", "trace_id", "start", "end", "attrs", "children")
+
+    def __init__(self, name: str, trace_id: str, attrs: dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.start = time.time()
+        self.end: Optional[float] = None
+        self.attrs = attrs
+        self.children: List["Span"] = []
+
+    def duration(self) -> float:
+        return (self.end or time.time()) - self.start
+
+
+class NopTracer:
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        yield None
+
+    def inject(self, headers: Dict[str, str]) -> None:
+        pass
+
+    def extract(self, headers) -> None:
+        pass
+
+
+class RecordingTracer:
+    """Keeps the last `keep` finished root spans for inspection (the
+    in-process analog of the reference's Jaeger wiring)."""
+
+    def __init__(self, keep: int = 128):
+        self.keep = keep
+        self.finished: List[Span] = []
+        self._local = threading.local()
+        self._lock = threading.Lock()
+
+    def _stack(self) -> List[Span]:
+        if not hasattr(self._local, "stack"):
+            self._local.stack = []
+        return self._local.stack
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        stack = self._stack()
+        trace_id = stack[0].trace_id if stack \
+            else getattr(self._local, "trace_id", None) or uuid.uuid4().hex
+        span = Span(name, trace_id, attrs)
+        if stack:
+            stack[-1].children.append(span)
+        stack.append(span)
+        try:
+            yield span
+        finally:
+            span.end = time.time()
+            stack.pop()
+            if not stack:
+                with self._lock:
+                    self.finished.append(span)
+                    if len(self.finished) > self.keep:
+                        del self.finished[: -self.keep]
+
+    def inject(self, headers: Dict[str, str]) -> None:
+        stack = self._stack()
+        if stack:
+            headers[TRACE_HEADER] = stack[0].trace_id
+
+    def extract(self, headers) -> None:
+        tid = headers.get(TRACE_HEADER)
+        if tid:
+            self._local.trace_id = tid

@@ -1,0 +1,74 @@
+"""CLI tests (reference cmd/*_test.go / ctl tests)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.cli.main import main
+from pilosa_tpu.utils.config import Config, load_config
+
+
+def test_generate_config_roundtrip(tmp_path, capsys):
+    assert main(["generate-config"]) == 0
+    toml_text = capsys.readouterr().out
+    p = tmp_path / "cfg.toml"
+    p.write_text(toml_text)
+    cfg = load_config(str(p))
+    assert cfg == Config()
+
+
+def test_config_precedence(tmp_path, monkeypatch):
+    p = tmp_path / "cfg.toml"
+    p.write_text('bind = "localhost:7777"\nverbose = true\n')
+    cfg = load_config(str(p))
+    assert cfg.port == 7777 and cfg.verbose
+    monkeypatch.setenv("PILOSA_TPU_BIND", "localhost:8888")
+    cfg = load_config(str(p))
+    assert cfg.port == 8888  # env beats file
+    cfg = load_config(str(p), {"bind": "localhost:9999"})
+    assert cfg.port == 9999  # flags beat env
+    with pytest.raises(ValueError, match="unknown config key"):
+        bad = tmp_path / "bad.toml"
+        bad.write_text('no_such_key = 1\n')
+        load_config(str(bad))
+
+
+def test_import_export_check_inspect(tmp_path, capsys):
+    csv_file = tmp_path / "data.csv"
+    csv_file.write_text("1,10\n1,20\n2,10\n")
+    data_dir = str(tmp_path / "data")
+    assert main(["import", "-d", data_dir, "-i", "idx", "-f", "f",
+                 str(csv_file)]) == 0
+    out_file = tmp_path / "out.csv"
+    assert main(["export", "-d", data_dir, "-i", "idx", "-f", "f",
+                 "-o", str(out_file)]) == 0
+    got = sorted(out_file.read_text().strip().split("\n"))
+    assert got == ["1,10", "1,20", "2,10"]
+
+    frag = os.path.join(data_dir, "idx", "f", "views", "standard",
+                        "fragments", "0")
+    assert main(["check", frag]) == 0
+    assert "ok" in capsys.readouterr().out
+    assert main(["inspect", frag, "--verbose"]) == 0
+    out = capsys.readouterr().out
+    assert "2 rows" in out
+
+    # corrupt file detected
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(b"\x00\x01\x02")
+    assert main(["check", str(bad)]) == 1
+
+
+def test_import_int_field(tmp_path, capsys):
+    csv_file = tmp_path / "vals.csv"
+    csv_file.write_text("1,100\n2,-5\n3,40\n")
+    data_dir = str(tmp_path / "data")
+    assert main(["import", "-d", data_dir, "-i", "idx", "-f", "n",
+                 "--field-type", "int", str(csv_file)]) == 0
+    from pilosa_tpu.core.holder import Holder
+    h = Holder(data_dir)
+    h.open()
+    assert h.index("idx").field("n").value(2) == (-5, True)
+    h.close()
