@@ -43,16 +43,44 @@ def cmd_server(args) -> int:
             from pilosa_tpu.parallel import MeshContext
             mesh = MeshContext(devices[:n], replicas=cfg.mesh_replicas)
 
+    cluster = None
+    if cfg.cluster_peers:
+        from pilosa_tpu.parallel.cluster import (
+            Cluster, Node, STATE_NORMAL,
+        )
+        local_uri = cfg.advertise or f"http://{cfg.bind}"
+        cluster = Cluster(
+            Node(local_uri, local_uri,
+                 is_coordinator=(local_uri == sorted(cfg.cluster_peers)[0])),
+            replica_n=cfg.cluster_replicas,
+            topology_path=os.path.join(data_dir, ".topology"))
+        for peer in cfg.cluster_peers:
+            if peer != local_uri:
+                cluster.add_node(Node(peer, peer))
+        # Re-adopt dynamically-joined nodes from the persisted topology
+        # (reference loads .topology at startup, cluster.go:1611).
+        cluster.load()
+        cluster.set_state(STATE_NORMAL)
+
     stats = MemStatsClient() if cfg.metric_service == "mem" \
         else NopStatsClient()
-    api = API(holder, mesh=mesh, stats=stats, tracer=RecordingTracer())
+    api = API(holder, mesh=mesh, cluster=cluster, stats=stats,
+              tracer=RecordingTracer())
     api.logger = logger
-    logger.printf("pilosa-tpu server: data=%s bind=%s mesh=%s",
+    anti_entropy = None
+    if cluster is not None and cfg.anti_entropy_interval > 0:
+        from pilosa_tpu.parallel.syncer import AntiEntropyLoop
+        anti_entropy = AntiEntropyLoop(api.syncer, cfg.anti_entropy_interval)
+        anti_entropy.start()
+    logger.printf("pilosa-tpu server: data=%s bind=%s mesh=%s cluster=%s",
                   data_dir, cfg.bind,
-                  mesh.mesh.shape if mesh else "single-device")
+                  mesh.mesh.shape if mesh else "single-device",
+                  f"{len(cluster.nodes())} nodes" if cluster else "no")
     try:
         serve(api, cfg.host, cfg.port)
     finally:
+        if anti_entropy is not None:
+            anti_entropy.stop()
         holder.close()
     return 0
 

@@ -1,16 +1,16 @@
 """Key translation: string keys <-> uint64 ids.
 
 Reference: /root/reference/translate.go (TranslateStore interface :40,
-TranslateFile :56 — an append-only mmap log with an in-memory hash index,
-chained-replicated between nodes over HTTP). Here: an append-only record
-log replayed into a host dict. IDs are allocated sequentially from 1 in
-append order, so replicas that replay the same log derive the same
-mapping — the same property the reference's chained replication relies on
-(translate.go:400). The log is exposed for streaming from an offset
-(/internal/translate/data parity).
+TranslateFile :56 — an append-only mmap log with an in-memory index,
+chained-replicated between nodes over HTTP: each node streams the log from
+its predecessor, translate.go:400, holder.go:626).
 
-Record format: uint32 length + utf-8 key bytes. Record i (0-based) maps to
-id i+1.
+Here: an append-only log of explicit (key, id) records. In a cluster, only
+the translation primary allocates ids (via POST /internal/translate/keys,
+the reference's handler.go:274 endpoint); replicas replay the primary's
+log — explicit ids make replication exact regardless of replay order.
+
+Record format: uint32 key length, utf-8 key bytes, uint64 id.
 """
 
 from __future__ import annotations
@@ -27,7 +27,8 @@ class TranslateStore:
     def __init__(self, path: Optional[str] = None):
         self.path = path
         self._ids: Dict[str, int] = {}
-        self._keys: List[str] = []
+        self._keys: Dict[int, str] = {}
+        self._next_id = 1
         self._file = None
         self._lock = threading.RLock()
 
@@ -38,13 +39,7 @@ class TranslateStore:
             return
         if os.path.exists(self.path):
             with open(self.path, "rb") as f:
-                data = f.read()
-            pos = 0
-            while pos + 4 <= len(data):
-                (n,) = struct.unpack_from("<I", data, pos)
-                key = data[pos + 4: pos + 4 + n].decode("utf-8")
-                self._register(key)
-                pos += 4 + n
+                self.apply_log(f.read(), _persist=False)
         else:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
         self._file = open(self.path, "ab")
@@ -55,23 +50,24 @@ class TranslateStore:
             self._file.close()
             self._file = None
 
-    def _register(self, key: str) -> int:
-        id_ = len(self._keys) + 1
-        self._keys.append(key)
-        self._ids[key] = id_
-        return id_
+    # -- core ---------------------------------------------------------------
 
-    # -- translation --------------------------------------------------------
+    def _insert(self, key: str, id_: int, persist: bool = True) -> None:
+        self._ids[key] = id_
+        self._keys[id_] = key
+        self._next_id = max(self._next_id, id_ + 1)
+        if persist and self._file is not None:
+            raw = key.encode("utf-8")
+            self._file.write(struct.pack("<I", len(raw)) + raw
+                             + struct.pack("<Q", id_))
+            self._file.flush()
 
     def translate_key(self, key: str, create: bool = True) -> Optional[int]:
         with self._lock:
             id_ = self._ids.get(key)
             if id_ is None and create:
-                id_ = self._register(key)
-                if self._file is not None:
-                    raw = key.encode("utf-8")
-                    self._file.write(struct.pack("<I", len(raw)) + raw)
-                    self._file.flush()
+                id_ = self._next_id
+                self._insert(key, id_)
             return id_
 
     def translate_keys(self, keys: Iterable[str], create: bool = True
@@ -82,40 +78,56 @@ class TranslateStore:
 
     def translate_id(self, id_: int) -> Optional[str]:
         with self._lock:
-            if 1 <= id_ <= len(self._keys):
-                return self._keys[id_ - 1]
-            return None
+            return self._keys.get(int(id_))
 
     def translate_ids(self, ids: Iterable[int]) -> List[Optional[str]]:
         return [self.translate_id(int(i)) for i in ids]
 
-    # -- replication --------------------------------------------------------
-
-    def log_size(self) -> int:
+    def apply_entries(self, pairs: Iterable[tuple]) -> None:
+        """Adopt (key, id) allocations made by the translation primary."""
         with self._lock:
-            return sum(4 + len(k.encode("utf-8")) for k in self._keys)
+            for key, id_ in pairs:
+                cur = self._ids.get(key)
+                if cur is None:
+                    self._insert(key, int(id_))
+                elif cur != id_:
+                    raise ValueError(
+                        f"translate conflict for {key!r}: {cur} != {id_}")
 
-    def read_log_from(self, offset: int) -> bytes:
-        """Serialized records from a byte offset (the replica streaming
-        endpoint /internal/translate/data, http/handler.go:273)."""
+    def entries(self) -> List[tuple]:
+        with self._lock:
+            return sorted(self._ids.items(), key=lambda kv: kv[1])
+
+    # -- replication (reference /internal/translate/data) --------------------
+
+    def log_bytes(self) -> bytes:
         with self._lock:
             out = bytearray()
-            for k in self._keys:
-                raw = k.encode("utf-8")
+            for key, id_ in self.entries():
+                raw = key.encode("utf-8")
                 out += struct.pack("<I", len(raw)) + raw
-            return bytes(out[offset:])
+                out += struct.pack("<Q", id_)
+            return bytes(out)
 
-    def apply_log(self, data: bytes) -> int:
-        """Replay streamed records appended after our current tail
-        (replica side of chained replication, translate.go:400)."""
+    def read_log_from(self, offset: int) -> bytes:
+        return self.log_bytes()[offset:]
+
+    def apply_log(self, data: bytes, _persist: bool = True) -> int:
+        """Replay streamed records (replica side of replication,
+        translate.go:400)."""
         applied = 0
         pos = 0
         with self._lock:
             while pos + 4 <= len(data):
                 (n,) = struct.unpack_from("<I", data, pos)
+                if pos + 4 + n + 8 > len(data):
+                    # Truncated tail (crash mid-append): stop here, like
+                    # the reference trimming a torn op-log record.
+                    break
                 key = data[pos + 4: pos + 4 + n].decode("utf-8")
+                (id_,) = struct.unpack_from("<Q", data, pos + 4 + n)
                 if key not in self._ids:
-                    self.translate_key(key, create=True)
+                    self._insert(key, id_, persist=_persist)
                     applied += 1
-                pos += 4 + n
+                pos += 4 + n + 8
         return applied

@@ -94,6 +94,27 @@ class Executor:
         self.holder = holder
         self.mesh = mesh
         self._jit_cache: Dict[str, Callable] = {}
+        # Cluster mode installs a resolver that allocates keys on the
+        # translation primary (reference: primary-owned TranslateFile with
+        # chained replication, translate.go:56,400). None = local stores.
+        self.key_resolver = None
+
+    def _resolve_col_keys(self, idx: Index, keys: List[str]) -> List[int]:
+        if self.key_resolver is not None:
+            return self.key_resolver(idx.name, None, keys)
+        return [int(i) for i in idx.column_translator.translate_keys(keys)]
+
+    def _resolve_row_keys(self, idx: Index, field: Field,
+                          keys: List[str]) -> List[int]:
+        if self.key_resolver is not None:
+            return self.key_resolver(idx.name, field.name, keys)
+        return [int(i) for i in field.row_translator.translate_keys(keys)]
+
+    def _resolve_col_key(self, idx: Index, key: str) -> int:
+        return self._resolve_col_keys(idx, [key])[0]
+
+    def _resolve_row_key(self, idx: Index, field: Field, key: str) -> int:
+        return self._resolve_row_keys(idx, field, [key])[0]
 
     # ------------------------------------------------------------------ API
 
@@ -130,8 +151,7 @@ class Executor:
             if not idx.keys:
                 raise ExecutionError(
                     f"index {idx.name} does not use column keys")
-            call.args["_col"] = int(
-                idx.column_translator.translate_key(col))
+            call.args["_col"] = self._resolve_col_key(idx, col)
         row = call.args.get("_row")
         fname = call.args.get("_field")
         if isinstance(row, str):
@@ -139,7 +159,7 @@ class Executor:
             if field is None or not field.options.keys:
                 raise ExecutionError(
                     f"string row value not allowed on field {fname}")
-            call.args["_row"] = int(field.row_translator.translate_key(row))
+            call.args["_row"] = self._resolve_row_key(idx, field, row)
         # The one field=row arg of Row/Range/Set/Clear/ClearRow/Store.
         if call.name in ("Row", "Range", "Set", "Clear", "ClearRow",
                          "Store"):
@@ -152,7 +172,7 @@ class Executor:
                 if field is None or not field.options.keys:
                     raise ExecutionError(
                         f"string row value not allowed on field {k}")
-                call.args[k] = int(field.row_translator.translate_key(v))
+                call.args[k] = self._resolve_row_key(idx, field, v)
         # Rows(previous=..., column=...) (reference executor.go:2443-2460).
         if call.name in ("Rows", "TopN"):
             field = idx.field(fname) if fname else None
@@ -161,15 +181,14 @@ class Executor:
                 if field is None or not field.options.keys:
                     raise ExecutionError(
                         f"string previous not allowed on field {fname}")
-                call.args["previous"] = int(
-                    field.row_translator.translate_key(prev))
+                call.args["previous"] = self._resolve_row_key(idx, field,
+                                                              prev)
             column = call.args.get("column")
             if isinstance(column, str):
                 if not idx.keys:
                     raise ExecutionError(
                         f"index {idx.name} does not use column keys")
-                call.args["column"] = int(
-                    idx.column_translator.translate_key(column))
+                call.args["column"] = self._resolve_col_key(idx, column)
         filt = call.args.get("filter")
         if isinstance(filt, Call):
             self._translate_call(idx, filt)

@@ -1,0 +1,129 @@
+"""Inter-node HTTP client.
+
+Reference: /root/reference/http/client.go (InternalClient — query fan-out
+:241, imports :439, fragment streaming :711, block sync :811-901) and the
+interface /root/reference/client.go:32. JSON bodies instead of protobuf
+(matching this rebuild's HTTP layer); roaring payloads stay raw bytes.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+
+class ClientError(RuntimeError):
+    pass
+
+
+class InternalClient:
+    def __init__(self, timeout: float = 30.0, tracer=None):
+        self.timeout = timeout
+        self.tracer = tracer
+
+    def _req(self, method: str, url: str, body: Optional[bytes] = None,
+             raw: bool = False):
+        headers = {"Content-Type": "application/json"}
+        if self.tracer is not None:
+            self.tracer.inject(headers)
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                payload = resp.read()
+                return payload if raw else json.loads(payload or b"{}")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode("utf-8", "replace")[:500]
+            raise ClientError(f"{method} {url}: {e.code}: {detail}") from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise ClientError(f"{method} {url}: {e}") from e
+
+    # -- query fan-out (reference QueryNode, http/client.go:241) -------------
+
+    def query_node(self, uri: str, index: str, pql: str,
+                   shards: List[int]) -> List[Any]:
+        q = ",".join(str(s) for s in shards)
+        res = self._req("POST", f"{uri}/index/{index}/query"
+                                f"?shards={q}&remote=true",
+                        pql.encode("utf-8"))
+        return res["results"]
+
+    # -- imports (reference importNode, http/client.go:439) ------------------
+
+    def import_node(self, uri: str, index: str, field: str,
+                    body: Dict[str, Any], clear: bool = False) -> None:
+        suffix = "?clear=1&remote=true" if clear else "?remote=true"
+        self._req("POST", f"{uri}/index/{index}/field/{field}/import{suffix}",
+                  json.dumps(body).encode())
+
+    def import_roaring_node(self, uri: str, index: str, field: str,
+                            shard: int, data: bytes,
+                            view: str = "standard") -> None:
+        self._req("POST",
+                  f"{uri}/index/{index}/field/{field}/import-roaring/{shard}"
+                  f"?view={view}&remote=true", data)
+
+    # -- fragment sync (reference :711-901) ----------------------------------
+
+    def retrieve_shard(self, uri: str, index: str, field: str, view: str,
+                       shard: int) -> bytes:
+        return self._req(
+            "GET", f"{uri}/internal/fragment/data?index={index}"
+                   f"&field={field}&view={view}&shard={shard}", raw=True)
+
+    def fragment_blocks(self, uri: str, index: str, field: str, view: str,
+                        shard: int) -> List[dict]:
+        res = self._req(
+            "GET", f"{uri}/internal/fragment/blocks?index={index}"
+                   f"&field={field}&view={view}&shard={shard}")
+        return res["blocks"]
+
+    def block_data(self, uri: str, index: str, field: str, view: str,
+                   shard: int, block: int) -> dict:
+        return self._req(
+            "GET", f"{uri}/internal/fragment/block/data?index={index}"
+                   f"&field={field}&view={view}&shard={shard}&block={block}")
+
+    # -- schema / membership --------------------------------------------------
+
+    def schema(self, uri: str) -> dict:
+        return self._req("GET", f"{uri}/schema")
+
+    def status(self, uri: str) -> dict:
+        return self._req("GET", f"{uri}/status")
+
+    def local_shards(self, uri: str) -> Dict[str, List[int]]:
+        return self._req("GET", f"{uri}/internal/local-shards")
+
+    def views(self, uri: str, index: str, field: str) -> List[str]:
+        return self._req(
+            "GET", f"{uri}/internal/views?index={index}&field={field}"
+        )["views"]
+
+    def join(self, uri: str, node: dict) -> dict:
+        return self._req("POST", f"{uri}/internal/join",
+                         json.dumps(node).encode())
+
+    def cluster_message(self, uri: str, message: dict) -> None:
+        self._req("POST", f"{uri}/internal/cluster/message",
+                  json.dumps(message).encode())
+
+    def create_index_node(self, uri: str, index: str, options: dict) -> None:
+        try:
+            self._req("POST", f"{uri}/index/{index}?remote=true",
+                      json.dumps({"options": options}).encode())
+        except ClientError as e:
+            if "409" not in str(e):
+                raise
+
+    def create_field_node(self, uri: str, index: str, field: str,
+                          options: dict) -> None:
+        try:
+            self._req("POST", f"{uri}/index/{index}/field/{field}"
+                              f"?remote=true",
+                      json.dumps({"options": options}).encode())
+        except ClientError as e:
+            if "409" not in str(e):
+                raise

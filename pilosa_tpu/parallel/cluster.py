@@ -1,0 +1,161 @@
+"""Cluster topology and state.
+
+Reference: /root/reference/cluster.go:172 (cluster struct), states
+STARTING/DEGRADED/NORMAL/RESIZING (:44-48), `.topology` persistence
+(:1611-1646), coordinator-driven joins (:1017-1148).
+
+Divergences, by design:
+- Membership is a static peer list + explicit join/remove calls over HTTP
+  (no SWIM gossip): the single-controller deployment model makes an
+  eventually-consistent membership protocol unnecessary; failure detection
+  happens at request time with replica failover (the reference does that
+  part the same way, executor.go:2313-2324).
+- Resize is pull-based: after a topology change every node fetches the
+  fragments it now owns from any current holder (reference pushes
+  ResizeInstructions from the coordinator, cluster.go:1251-1360 — same
+  data motion, simpler control flow).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from pilosa_tpu.parallel.hashing import (
+    DEFAULT_PARTITION_N, shard_nodes,
+)
+
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_DEGRADED = "DEGRADED"
+STATE_RESIZING = "RESIZING"
+
+
+@dataclass
+class Node:
+    id: str
+    uri: str  # http://host:port
+    is_coordinator: bool = False
+
+    def to_json(self) -> dict:
+        return {"id": self.id, "uri": self.uri,
+                "isCoordinator": self.is_coordinator}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Node":
+        return cls(d["id"], d["uri"], d.get("isCoordinator", False))
+
+
+class Cluster:
+    """Node set sorted by id (reference cluster.go:589) with hashed shard
+    placement and replica failover bookkeeping."""
+
+    def __init__(self, local: Node, replica_n: int = 1,
+                 partition_n: int = DEFAULT_PARTITION_N,
+                 topology_path: Optional[str] = None):
+        self.local = local
+        self.replica_n = replica_n
+        self.partition_n = partition_n
+        self.topology_path = topology_path
+        self.state = STATE_STARTING
+        self._nodes: Dict[str, Node] = {local.id: local}
+        self._lock = threading.RLock()
+
+    # -- membership ---------------------------------------------------------
+
+    def nodes(self) -> List[Node]:
+        with self._lock:
+            return [self._nodes[k] for k in sorted(self._nodes)]
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            self._nodes[node.id] = node
+            self._update_state()
+            self.save()
+
+    def remove_node(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+            self._update_state()
+            self.save()
+
+    def node_by_id(self, node_id: str) -> Optional[Node]:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def _update_state(self) -> None:
+        if self.state != STATE_STARTING:
+            self.state = STATE_NORMAL
+
+    def set_state(self, state: str) -> None:
+        with self._lock:
+            self.state = state
+
+    # -- placement ----------------------------------------------------------
+
+    def shard_nodes(self, index: str, shard: int) -> List[Node]:
+        """Primary + replicas for a shard (reference ShardNodes,
+        cluster.go:840)."""
+        nodes = self.nodes()
+        idxs = shard_nodes(index, shard, len(nodes), self.replica_n,
+                           self.partition_n)
+        return [nodes[i] for i in idxs]
+
+    def owns_shard(self, index: str, shard: int) -> bool:
+        return any(n.id == self.local.id
+                   for n in self.shard_nodes(index, shard))
+
+    def is_primary(self, index: str, shard: int) -> bool:
+        sn = self.shard_nodes(index, shard)
+        return bool(sn) and sn[0].id == self.local.id
+
+    def shards_by_node(self, index: str, shards: List[int],
+                       exclude_ids: Optional[set] = None
+                       ) -> Dict[str, List[int]]:
+        """Group shards by serving node id, preferring the primary and
+        falling back down the replica chain when primaries are excluded
+        (the mapReduce retry path, executor.go:2313-2324)."""
+        out: Dict[str, List[int]] = {}
+        for shard in shards:
+            for node in self.shard_nodes(index, shard):
+                if exclude_ids and node.id in exclude_ids:
+                    continue
+                out.setdefault(node.id, []).append(shard)
+                break
+            else:
+                raise RuntimeError(
+                    f"shard {shard} unavailable: all replicas excluded")
+        return out
+
+    # -- persistence (reference .topology, cluster.go:1611-1646) ------------
+
+    def save(self) -> None:
+        if not self.topology_path:
+            return
+        tmp = self.topology_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"nodes": [n.to_json() for n in self.nodes()],
+                       "replicaN": self.replica_n}, f)
+        os.replace(tmp, self.topology_path)
+
+    def load(self) -> None:
+        if not self.topology_path or not os.path.exists(self.topology_path):
+            return
+        with open(self.topology_path) as f:
+            data = json.load(f)
+        with self._lock:
+            for nd in data.get("nodes", []):
+                node = Node.from_json(nd)
+                if node.id != self.local.id:
+                    self._nodes[node.id] = node
+            self.replica_n = data.get("replicaN", self.replica_n)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {"state": self.state,
+                    "localID": self.local.id,
+                    "replicaN": self.replica_n,
+                    "nodes": [n.to_json() for n in self.nodes()]}

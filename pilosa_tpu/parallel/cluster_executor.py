@@ -1,0 +1,273 @@
+"""Multi-node scatter-gather execution over HTTP.
+
+Reference: /root/reference/executor.go:2277-2415 (mapReduce): group shards
+by owning node, execute local shards locally, POST the query to remote
+nodes with explicit shard lists (`opt.Remote=true` so remotes do not
+re-fan-out), stream-reduce responses, and on node failure re-map that
+node's shards onto remaining replicas (:2313-2324).
+
+Reduction here happens on the JSON result shapes (the wire format), one
+merge rule per call type — the associative reduceFn table
+(executor.go:481-488, row.go:60, cache.go:356).
+
+This HTTP path distributes across *hosts*; within a host the local
+executor still batches its shard subset on the TPU mesh. The two layers
+compose: DCN-style distribution over HTTP, ICI-style reduction inside the
+chip mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence
+
+from pilosa_tpu.executor.results import result_to_json
+from pilosa_tpu.parallel.client import ClientError, InternalClient
+from pilosa_tpu.parallel.cluster import Cluster
+from pilosa_tpu.pql import Call, parse_string
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+_WRITE_SINGLE_COL = {"Set", "Clear", "SetColumnAttrs"}
+_WRITE_BROADCAST = {"ClearRow", "Store", "SetRowAttrs"}
+
+
+def merge_results(call: Call, parts: List[Any]) -> Any:
+    """Associative merge of per-node JSON results for one call."""
+    parts = [p for p in parts if p is not None]
+    if not parts:
+        return None
+    if len(parts) == 1:
+        return parts[0]
+    name = call.name
+    if name == "Count":
+        return sum(parts)
+    if name in ("Row", "Range", "Intersect", "Union", "Difference", "Xor",
+                "Not", "Shift"):
+        out = {"columns": sorted(set().union(
+            *[set(p.get("columns", [])) for p in parts]))}
+        keys = [k for p in parts for k in p.get("keys", [])]
+        if any("keys" in p for p in parts):
+            out["keys"] = sorted(set(keys))
+        attrs = next((p["attrs"] for p in parts if p.get("attrs")), None)
+        if attrs:
+            out["attrs"] = attrs
+        return out
+    if name == "TopN":
+        acc: Dict[Any, int] = {}
+        keyed = any(p and isinstance(p[0], dict) and "key" in p[0]
+                    for p in parts if p)
+        for p in parts:
+            for pair in p:
+                k = pair.get("key", pair.get("id"))
+                acc[k] = acc.get(k, 0) + pair["count"]
+        ordered = sorted(acc.items(), key=lambda kv: (-kv[1], str(kv[0])))
+        n = call.uint_arg("n") or 0
+        if n:
+            ordered = ordered[:n]
+        if keyed:
+            return [{"key": k, "count": c} for k, c in ordered]
+        return [{"id": k, "count": c} for k, c in ordered]
+    if name == "Rows":
+        limit = call.uint_arg("limit")
+        if any("keys" in p for p in parts):
+            keys = sorted(set().union(*[set(p.get("keys", []))
+                                        for p in parts]))
+            return {"keys": keys[:limit] if limit else keys}
+        rows = sorted(set().union(*[set(p.get("rows", [])) for p in parts]))
+        return {"rows": rows[:limit] if limit else rows}
+    if name == "GroupBy":
+        acc: Dict[str, dict] = {}
+        for p in parts:
+            for gc in p:
+                key = str(gc["group"])
+                if key in acc:
+                    acc[key]["count"] += gc["count"]
+                else:
+                    acc[key] = dict(gc)
+        out = sorted(acc.values(), key=lambda g: str(g["group"]))
+        limit = call.uint_arg("limit")
+        return out[:limit] if limit else out
+    if name == "Sum":
+        return {"value": sum(p["value"] for p in parts),
+                "count": sum(p["count"] for p in parts)}
+    if name in ("Min", "Max"):
+        nonzero = [p for p in parts if p["count"] > 0]
+        if not nonzero:
+            return {"value": 0, "count": 0}
+        pick = min if name == "Min" else max
+        best = pick(p["value"] for p in nonzero)
+        return {"value": best,
+                "count": sum(p["count"] for p in nonzero
+                             if p["value"] == best)}
+    if name in _WRITE_SINGLE_COL | _WRITE_BROADCAST:
+        return any(bool(p) for p in parts)
+    return parts[0]
+
+
+class ClusterExecutor:
+    """Coordinator-side fan-out. Wraps a local Executor; remote legs use
+    InternalClient. Replica failover: a failed node's shards re-map onto
+    the next replica (reference executor.go:2313-2324)."""
+
+    def __init__(self, local_executor, cluster: Cluster,
+                 client: Optional[InternalClient] = None, logger=None):
+        self.local = local_executor
+        self.cluster = cluster
+        self.client = client or InternalClient()
+        self.logger = logger
+
+    # -- shard discovery ----------------------------------------------------
+
+    GLOBAL_SHARDS_TTL = 2.0
+
+    def global_shards(self, index: str) -> List[int]:
+        """Union of every node's locally-available shards, TTL-cached (the
+        reference instead broadcasts availableShards on change,
+        field.go:228 — a push model; a short pull cache gives the same
+        read-path behavior without a broadcast bus)."""
+        import time
+        cache = getattr(self, "_shards_cache", None)
+        if cache is None:
+            cache = self._shards_cache = {}
+        hit = cache.get(index)
+        if hit is not None and time.monotonic() - hit[0] < \
+                self.GLOBAL_SHARDS_TTL:
+            return hit[1]
+        shards = set()
+        idx = self.local.holder.index(index)
+        if idx is not None:
+            shards.update(idx.available_shards())
+        for node in self.cluster.nodes():
+            if node.id == self.cluster.local.id:
+                continue
+            try:
+                per_index = self.client.local_shards(node.uri)
+                shards.update(per_index.get(index, []))
+            except ClientError:
+                continue
+        out = sorted(shards) or [0]
+        cache[index] = (time.monotonic(), out)
+        return out
+
+    # -- query --------------------------------------------------------------
+
+    def execute(self, index: str, query: str,
+                shards: Optional[Sequence[int]] = None) -> List[Any]:
+        """Returns JSON-shaped results (one per call)."""
+        q = parse_string(query) if isinstance(query, str) else query
+        return [self._execute_call(index, call, shards) for call in q.calls]
+
+    def _execute_call(self, index: str, call: Call, shards) -> Any:
+        if call.name in _WRITE_SINGLE_COL:
+            return self._execute_write_single(index, call)
+        if call.name in _WRITE_BROADCAST:
+            return self._execute_write_broadcast(index, call)
+        all_shards = list(shards) if shards is not None \
+            else self.global_shards(index)
+        return self._map_reduce(index, call, all_shards)
+
+    def _map_reduce(self, index: str, call: Call, shards: List[int]) -> Any:
+        excluded: set = set()
+        last_err: Optional[Exception] = None
+        for _ in range(max(1, self.cluster.replica_n)):
+            try:
+                by_node = self.cluster.shards_by_node(index, shards,
+                                                      exclude_ids=excluded)
+            except RuntimeError as e:
+                raise last_err or e
+            parts: List[Any] = []
+            failed = False
+            results_lock = threading.Lock()
+            threads = []
+
+            def run_remote(node, node_shards):
+                nonlocal failed, last_err
+                try:
+                    res = self.client.query_node(node.uri, index,
+                                                 call.to_pql(), node_shards)
+                    with results_lock:
+                        parts.append(res[0])
+                except ClientError as e:
+                    with results_lock:
+                        excluded.add(node.id)
+                        failed = True
+                        last_err = e
+                    if self.logger is not None:
+                        self.logger.printf("node %s failed, failing over: %s",
+                                           node.id, e)
+
+            for node_id, node_shards in by_node.items():
+                if node_id == self.cluster.local.id:
+                    local = self.local.execute(index, call.to_pql(),
+                                               shards=node_shards)
+                    parts.append(result_to_json(local[0]))
+                else:
+                    node = self.cluster.node_by_id(node_id)
+                    t = threading.Thread(target=run_remote,
+                                         args=(node, node_shards))
+                    t.start()
+                    threads.append(t)
+            for t in threads:
+                t.join()
+            if not failed:
+                return merge_results(call, parts)
+            # retry: re-map every shard against remaining nodes
+        raise last_err or RuntimeError("map_reduce failed")
+
+    # -- writes -------------------------------------------------------------
+
+    def _execute_write_single(self, index: str, call: Call) -> Any:
+        """Route a single-column write to the owning replicas (reference
+        executeSetBitField remote fan, executor.go:1959)."""
+        col = call.args.get("_col")
+        if isinstance(col, str):
+            # Translate on the coordinator so every replica stores the
+            # same id (translation stores replicate separately).
+            self.local._translate_call(self.local.holder.index(index), call)
+            col = call.args["_col"]
+        shard = int(col) // SHARD_WIDTH
+        owners = self.cluster.shard_nodes(index, shard)
+        result = False
+        applied = 0
+        last_err: Optional[Exception] = None
+        for node in owners:
+            if node.id == self.cluster.local.id:
+                (r,) = self.local.execute(index, call.to_pql())
+                result = result or bool(r)
+                applied += 1
+            else:
+                try:
+                    res = self.client.query_node(node.uri, index, call.to_pql(),
+                                                 [shard])
+                    result = result or bool(res[0])
+                    applied += 1
+                except ClientError as e:
+                    last_err = e
+                    if self.logger is not None:
+                        self.logger.printf("write to %s failed: %s",
+                                           node.id, e)
+        if applied == 0:
+            # No replica took the write — surfacing the failure is the only
+            # honest answer; anti-entropy can only heal from a copy that
+            # exists.
+            raise last_err or ClientError("no replica accepted the write")
+        return result
+
+    def _execute_write_broadcast(self, index: str, call: Call) -> Any:
+        """Row-scoped writes apply on every node (each owns a shard
+        subset)."""
+        results = []
+        for node in self.cluster.nodes():
+            if node.id == self.cluster.local.id:
+                (r,) = self.local.execute(index, call.to_pql())
+                results.append(result_to_json(r))
+            else:
+                try:
+                    res = self.client.query_node(node.uri, index,
+                                                 call.to_pql(), [])
+                    results.append(res[0])
+                except ClientError as e:
+                    if self.logger is not None:
+                        self.logger.printf("broadcast write to %s failed: %s",
+                                           node.id, e)
+        return merge_results(call, results)

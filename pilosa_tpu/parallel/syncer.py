@@ -1,0 +1,257 @@
+"""Anti-entropy replica sync + resize fragment movement.
+
+Reference: holderSyncer (/root/reference/holder.go:637-858) walks the
+schema and, per owned fragment, runs block-checksum reconciliation against
+replicas (fragmentSyncer, fragment.go:2231-2432): fetch block lists, diff
+checksums, fetch mismatched blocks' (row, col) pairs, merge locally, push
+deltas back via imports. holderCleaner (holder.go:859) drops fragments no
+longer owned after a resize; followResizeInstruction (cluster.go:1251)
+streams newly-owned fragments from source nodes — here pull-based
+(ResizePuller).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pilosa_tpu.parallel.client import ClientError, InternalClient
+from pilosa_tpu.parallel.cluster import Cluster
+
+
+class HolderSyncer:
+    """(reference holderSyncer, holder.go:637)."""
+
+    def __init__(self, holder, cluster: Cluster,
+                 client: Optional[InternalClient] = None, logger=None):
+        self.holder = holder
+        self.cluster = cluster
+        self.client = client or InternalClient()
+        self.logger = logger
+
+    def _log(self, fmt, *args):
+        if self.logger is not None:
+            self.logger.printf(fmt, *args)
+
+    def sync_holder(self) -> Dict[str, int]:
+        """One full anti-entropy pass over every locally-held fragment this
+        node is a replica for. Returns {"merged": bits_pulled,
+        "pushed": bits_pushed} for observability."""
+        stats = {"merged": 0, "pushed": 0}
+        for iname, idx in list(self.holder.indexes.items()):
+            for fname, field in list(idx.fields.items()):
+                for vname, view in list(field.views.items()):
+                    for shard, frag in list(view.fragments.items()):
+                        if not self.cluster.owns_shard(iname, shard):
+                            continue
+                        self.sync_fragment(iname, fname, vname, shard, frag,
+                                           stats)
+        return stats
+
+    def sync_fragment(self, index: str, field: str, view: str, shard: int,
+                      frag, stats: Dict[str, int]) -> None:
+        """(reference fragmentSyncer.syncFragment, fragment.go:2253)."""
+        peers = [n for n in self.cluster.shard_nodes(index, shard)
+                 if n.id != self.cluster.local.id]
+        if not peers:
+            return
+        local_blocks = dict(frag.checksum_blocks())
+        for peer in peers:
+            try:
+                their = {b["block"]: bytes.fromhex(b["checksum"])
+                         for b in self.client.fragment_blocks(
+                             peer.uri, index, field, view, shard)}
+            except ClientError:
+                # Peer lacks the fragment entirely: push ours wholesale,
+                # creating missing schema first (heals a peer that was
+                # unreachable during a schema broadcast).
+                try:
+                    self._ensure_remote_schema(peer, index, field)
+                    self.client.import_roaring_node(
+                        peer.uri, index, field, shard, frag.write_bytes(),
+                        view=view)
+                    stats["pushed"] += frag.storage.count()
+                except ClientError as e:
+                    self._log("sync push to %s failed: %s", peer.id, e)
+                continue
+            for block in set(local_blocks) | set(their):
+                if local_blocks.get(block) == their.get(block):
+                    continue
+                self._sync_block(index, field, view, shard, frag, peer,
+                                 block, stats)
+
+    def _ensure_remote_schema(self, peer, index: str, field: str) -> None:
+        idx = self.holder.index(index)
+        if idx is None:
+            return
+        f = idx.field(field)
+        self.client.create_index_node(
+            peer.uri, index, {"keys": idx.keys,
+                              "trackExistence": idx.track_existence})
+        if f is not None and not field.startswith("_"):
+            # internal fields (_exists) auto-create with the index
+            o = f.options
+            self.client.create_field_node(
+                peer.uri, index, field,
+                {"type": o.type, "cacheType": o.cache_type,
+                 "cacheSize": o.cache_size, "min": o.min, "max": o.max,
+                 "timeQuantum": o.time_quantum, "keys": o.keys})
+
+    def _sync_block(self, index, field, view, shard, frag, peer, block,
+                    stats) -> None:
+        """(reference syncBlock, fragment.go:2333)."""
+        try:
+            data = self.client.block_data(peer.uri, index, field, view,
+                                          shard, block)
+        except ClientError:
+            data = {"rows": [], "columns": []}
+        (here_r, here_c), (there_r, there_c) = frag.merge_block(
+            block, np.asarray(data["rows"], dtype=np.uint64),
+            np.asarray(data["columns"], dtype=np.uint64))
+        stats["merged"] += len(here_r)
+        if len(there_r):
+            try:
+                self.client.import_node(
+                    peer.uri, index, field,
+                    {"rowIDs": [int(r) for r in there_r],
+                     "columnIDs": [int(c) for c in there_c]})
+                stats["pushed"] += len(there_r)
+            except ClientError as e:
+                self._log("block push to %s failed: %s", peer.id, e)
+
+
+class ResizePuller:
+    """Pull-based resize: after a topology change, fetch every fragment
+    this node now owns but does not hold (the data motion of
+    followResizeInstruction, cluster.go:1251-1360), then drop fragments no
+    longer owned (holderCleaner, holder.go:859-910)."""
+
+    def __init__(self, holder, cluster: Cluster,
+                 client: Optional[InternalClient] = None, logger=None):
+        self.holder = holder
+        self.cluster = cluster
+        self.client = client or InternalClient()
+        self.logger = logger
+
+    def _log(self, fmt, *args):
+        if self.logger is not None:
+            self.logger.printf(fmt, *args)
+
+    def pull_owned(self) -> int:
+        """Returns number of fragments fetched."""
+        from pilosa_tpu.parallel.cluster import STATE_NORMAL, STATE_RESIZING
+
+        peers = [n for n in self.cluster.nodes()
+                 if n.id != self.cluster.local.id]
+        if not peers:
+            return 0
+        self.cluster.set_state(STATE_RESIZING)
+        fetched = 0
+        try:
+            # Discover remote schema + shard holdings.
+            for peer in peers:
+                try:
+                    schema = self.client.schema(peer.uri)
+                except ClientError:
+                    continue
+                for idx_info in schema.get("indexes", []):
+                    iname = idx_info["name"]
+                    idx = self.holder.index(iname)
+                    if idx is None:
+                        idx = self.holder.create_index(
+                            iname, keys=idx_info["options"].get("keys",
+                                                                False),
+                            track_existence=idx_info["options"].get(
+                                "trackExistence", True))
+                    for f_info in idx_info.get("fields", []):
+                        if idx.field(f_info["name"]) is None:
+                            from pilosa_tpu.core.field import FieldOptions
+                            o = f_info["options"]
+                            idx.create_field(f_info["name"], FieldOptions(
+                                type=o.get("type", "set"),
+                                cache_type=o.get("cacheType", "ranked"),
+                                cache_size=o.get("cacheSize", 50000),
+                                min=o.get("min", 0), max=o.get("max", 0),
+                                time_quantum=o.get("timeQuantum", ""),
+                                keys=o.get("keys", False)))
+                    for shard in idx_info.get("shards", []):
+                        fetched += self._maybe_pull(peer, idx, shard)
+        finally:
+            self.cluster.set_state(STATE_NORMAL)
+        return fetched
+
+    def _maybe_pull(self, peer, idx, shard: int) -> int:
+        if not self.cluster.owns_shard(idx.name, shard):
+            return 0
+        fetched = 0
+        for fname, field in list(idx.fields.items()):
+            try:
+                views = self.client.views(peer.uri, idx.name, fname)
+            except ClientError:
+                continue
+            for vname in views:
+                view = field.view(vname)
+                if view is not None and view.fragment(shard) is not None:
+                    continue  # already hold it; anti-entropy reconciles
+                try:
+                    data = self.client.retrieve_shard(
+                        peer.uri, idx.name, fname, vname, shard)
+                except ClientError:
+                    continue
+                frag = field.create_view_if_not_exists(vname) \
+                    .create_fragment_if_not_exists(shard)
+                frag.import_roaring(data)
+                fetched += 1
+                self._log("resize: pulled %s/%s/%s/shard %s from %s",
+                          idx.name, fname, vname, shard, peer.id)
+        return fetched
+
+    def clean_unowned(self) -> int:
+        """Drop fragments this node no longer owns (holderCleaner)."""
+        import os
+        import shutil
+        removed = 0
+        for iname, idx in list(self.holder.indexes.items()):
+            for field in list(idx.fields.values()):
+                for view in list(field.views.values()):
+                    for shard in list(view.fragments):
+                        if self.cluster.owns_shard(iname, shard):
+                            continue
+                        frag = view.fragments.pop(shard)
+                        frag.close()
+                        for p in (frag.path, frag.cache_path()):
+                            if os.path.exists(p):
+                                os.remove(p)
+                        removed += 1
+        return removed
+
+
+class AntiEntropyLoop:
+    """Periodic sync driver (reference monitorAntiEntropy,
+    server.go:430)."""
+
+    def __init__(self, syncer: HolderSyncer, interval: float):
+        self.syncer = syncer
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self.interval <= 0:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.syncer.sync_holder()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

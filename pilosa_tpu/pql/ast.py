@@ -66,6 +66,58 @@ class Call:
         return self.name in ("Set", "Clear", "ClearRow", "Store",
                              "SetRowAttrs", "SetColumnAttrs")
 
+    def to_pql(self) -> str:
+        """Serialize back to parseable PQL (the reference serializes Calls
+        with String() for remote re-execution, pql/ast.go:418)."""
+        def val(v) -> str:
+            if v is None:
+                return "null"
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            if isinstance(v, str):
+                escaped = v.replace("\\", "\\\\").replace("'", "\\'")
+                return f"'{escaped}'"
+            if isinstance(v, list):
+                return "[" + ", ".join(val(x) for x in v) + "]"
+            if isinstance(v, Call):
+                return v.to_pql()
+            return repr(v)
+
+        def plain_args(skip=()):
+            parts = []
+            for k in self.args:
+                if k.startswith("_") or k in skip:
+                    continue
+                v = self.args[k]
+                if isinstance(v, Condition):
+                    parts.append(f"{k} {v.op} {val(v.value)}")
+                else:
+                    parts.append(f"{k}={val(v)}")
+            return parts
+
+        name = self.name
+        if name in ("Set", "Clear"):
+            parts = [val(self.args["_col"])] + plain_args()
+            if name == "Set" and self.args.get("_timestamp"):
+                parts.append(val(self.args["_timestamp"]))
+            return f"{name}({', '.join(parts)})"
+        if name == "SetColumnAttrs":
+            parts = [val(self.args["_col"])] + plain_args()
+            return f"{name}({', '.join(parts)})"
+        if name == "SetRowAttrs":
+            parts = [self.args["_field"], val(self.args["_row"])] \
+                + plain_args()
+            return f"{name}({', '.join(parts)})"
+        if name == "Store":
+            parts = [self.children[0].to_pql()] + plain_args()
+            return f"{name}({', '.join(parts)})"
+        if name in ("TopN", "Rows"):
+            parts = [self.args["_field"]] \
+                + [c.to_pql() for c in self.children] + plain_args()
+            return f"{name}({', '.join(parts)})"
+        parts = [c.to_pql() for c in self.children] + plain_args()
+        return f"{name}({', '.join(parts)})"
+
     def __str__(self) -> str:
         parts = [str(c) for c in self.children]
         for k in sorted(self.args):

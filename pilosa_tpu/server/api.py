@@ -39,15 +39,74 @@ class API:
         self.cluster = cluster
         self.stats = stats or NopStatsClient()
         self.tracer = tracer or NopTracer()
+        self.cluster_executor = None
+        self.syncer = None
+        self.resize_puller = None
+        if cluster is not None:
+            from pilosa_tpu.parallel.client import InternalClient
+            from pilosa_tpu.parallel.cluster_executor import ClusterExecutor
+            from pilosa_tpu.parallel.syncer import HolderSyncer, ResizePuller
+            client = InternalClient(tracer=self.tracer)
+            self.cluster_executor = ClusterExecutor(self.executor, cluster,
+                                                    client)
+            self.syncer = HolderSyncer(holder, cluster, client)
+            self.resize_puller = ResizePuller(holder, cluster, client)
+            self.executor.key_resolver = self._resolve_key_via_primary
+            self._client = client
+
+    # -------------------------------------------------- translation primary
+
+    def _translate_primary(self):
+        """The lexically-first node allocates all keys (the reference pins
+        the translate log primary similarly by ring position,
+        cluster.go:1908-1935)."""
+        return self.cluster.nodes()[0]
+
+    def _resolve_key_via_primary(self, index: str, field: Optional[str],
+                                 keys: List[str]) -> List[int]:
+        """Batch key allocation on the primary — one round trip per call,
+        however many keys (the bulk-import path resolves thousands)."""
+        primary = self._translate_primary()
+        if primary.id == self.cluster.local.id:
+            return self.translate_keys_local(index, field, keys)
+        import json as _json
+        body = _json.dumps({"index": index, "field": field,
+                            "keys": list(keys)}).encode()
+        res = self._client._req(
+            "POST", f"{primary.uri}/internal/translate/keys", body)
+        # Adopt the primary's allocation locally so result translation and
+        # replicas stay consistent.
+        store = self._translate_store(index, field)
+        store.apply_entries(zip(res["keys"], res["ids"]))
+        return [int(i) for i in res["ids"]]
+
+    def _translate_store(self, index: str, field: Optional[str]):
+        idx = self._index(index)
+        if field is None:
+            return idx.column_translator
+        return self._field(idx, field).row_translator
+
+    def translate_keys_local(self, index: str, field: Optional[str],
+                             keys: List[str]) -> List[int]:
+        """Allocate ids locally (primary side of /internal/translate/keys,
+        reference http/handler.go:274)."""
+        store = self._translate_store(index, field)
+        return [int(i) for i in store.translate_keys(keys)]
 
     # ----------------------------------------------------------------- query
 
     def query(self, index: str, query: str,
-              shards: Optional[Sequence[int]] = None) -> Dict[str, Any]:
+              shards: Optional[Sequence[int]] = None,
+              remote: bool = False) -> Dict[str, Any]:
         """(reference API.Query, api.go:103). Returns the JSON-shaped
-        response {"results": [...]}."""
+        response {"results": [...]}. `remote=True` marks a node-to-node
+        sub-query: execute locally only, no re-fan-out (the reference's
+        opt.Remote, executor.go:2236)."""
         with self.tracer.span("API.Query", index=index):
             self.stats.count("query", 1)
+            if self.cluster_executor is not None and not remote:
+                return {"results": self.cluster_executor.execute(
+                    index, query, shards=shards)}
             results = self.executor.execute(index, query, shards=shards)
             return {"results": [result_to_json(r) for r in results]}
 
@@ -57,13 +116,33 @@ class API:
         return {"indexes": self.holder.schema()}
 
     def create_index(self, name: str, keys: bool = False,
-                     track_existence: bool = True) -> Dict[str, Any]:
+                     track_existence: bool = True,
+                     remote: bool = False) -> Dict[str, Any]:
         try:
             idx = self.holder.create_index(name, keys=keys,
                                            track_existence=track_existence)
         except ValueError as e:
             raise ApiError(str(e), 409 if "exists" in str(e) else 400)
+        self._broadcast_schema(remote, lambda uri: self._client
+                               .create_index_node(uri, name,
+                                                  {"keys": keys,
+                                                   "trackExistence":
+                                                   track_existence}))
         return {"name": idx.name}
+
+    def _broadcast_schema(self, remote: bool, send) -> None:
+        """Schema mutations replicate to every node (reference SendSync of
+        create messages, server.go:485-620)."""
+        if remote or self.cluster is None:
+            return
+        from pilosa_tpu.parallel.client import ClientError
+        for node in self.cluster.nodes():
+            if node.id == self.cluster.local.id:
+                continue
+            try:
+                send(node.uri)
+            except ClientError:
+                pass  # healed by resize pull / anti-entropy
 
     def delete_index(self, name: str) -> None:
         try:
@@ -72,7 +151,8 @@ class API:
             raise ApiError(str(e), 404)
 
     def create_field(self, index: str, name: str,
-                     options: Optional[dict] = None) -> Dict[str, Any]:
+                     options: Optional[dict] = None,
+                     remote: bool = False) -> Dict[str, Any]:
         idx = self._index(index)
         opts = FieldOptions()
         options = dict(options or {})
@@ -88,6 +168,9 @@ class API:
             f = idx.create_field(name, opts)
         except ValueError as e:
             raise ApiError(str(e), 409 if "exists" in str(e) else 400)
+        self._broadcast_schema(remote, lambda uri: self._client
+                               .create_field_node(uri, index, name,
+                                                  dict(options)))
         return {"name": f.name}
 
     def delete_field(self, index: str, name: str) -> None:
@@ -101,19 +184,20 @@ class API:
 
     def import_bits(self, index: str, field: str, rows=None, columns=None,
                     row_keys=None, column_keys=None, timestamps=None,
-                    clear: bool = False) -> None:
+                    clear: bool = False, remote: bool = False) -> None:
         """Bulk bit import (reference API.Import, api.go:814): translate
-        keys, write bits, feed the existence field."""
+        keys, group bits by shard, forward to owner nodes, write the local
+        subset, feed the existence field."""
         idx = self._index(index)
         f = self._field(idx, field)
         if column_keys is not None:
             if not idx.keys:
                 raise ApiError(f"index {index} does not use column keys")
-            columns = idx.column_translator.translate_keys(column_keys)
+            columns = self.executor._resolve_col_keys(idx, list(column_keys))
         if row_keys is not None:
-            if not (f.options.keys or idx.keys):
+            if not f.options.keys:
                 raise ApiError(f"field {field} does not use row keys")
-            rows = f.row_translator.translate_keys(row_keys)
+            rows = self.executor._resolve_row_keys(idx, f, list(row_keys))
         rows = np.asarray(rows, dtype=np.uint64)
         columns = np.asarray(columns, dtype=np.uint64)
         if len(rows) != len(columns):
@@ -123,22 +207,69 @@ class API:
             ts = [datetime.fromtimestamp(t) if isinstance(t, (int, float))
                   else (timeq.parse_timestamp(t) if isinstance(t, str) else t)
                   for t in timestamps]
+
+        if self.cluster is not None and not remote:
+            self._import_fanout(index, field, rows, columns, timestamps,
+                                clear, values=None)
+            return
         f.import_bits(rows, columns, timestamps=ts, clear=clear)
         if not clear:
             idx.add_existence(columns)
 
+    def _import_fanout(self, index, field, rows, columns, timestamps,
+                       clear, values) -> None:
+        """Group bits by owning node and forward (reference api.go:838-888,
+        errgroup-parallel per node)."""
+        from pilosa_tpu.parallel.client import ClientError
+        shards = columns // np.uint64(SHARD_WIDTH)
+        by_node: Dict[str, List[int]] = {}
+        for i, shard in enumerate(shards.tolist()):
+            for node in self.cluster.shard_nodes(index, int(shard)):
+                by_node.setdefault(node.id, []).append(i)
+        for node_id, idxs in by_node.items():
+            node = self.cluster.node_by_id(node_id)
+            body: Dict[str, Any] = {
+                "columnIDs": [int(columns[i]) for i in idxs]}
+            if values is not None:
+                body["values"] = [int(values[i]) for i in idxs]
+            else:
+                body["rowIDs"] = [int(rows[i]) for i in idxs]
+                if timestamps is not None:
+                    body["timestamps"] = [timestamps[i] for i in idxs]
+            if node_id == self.cluster.local.id:
+                if values is not None:
+                    self.import_values(index, field,
+                                       columns=body["columnIDs"],
+                                       values=body["values"], clear=clear,
+                                       remote=True)
+                else:
+                    self.import_bits(index, field, rows=body["rowIDs"],
+                                     columns=body["columnIDs"],
+                                     timestamps=body.get("timestamps"),
+                                     clear=clear, remote=True)
+            else:
+                try:
+                    self._client.import_node(node.uri, index, field, body,
+                                             clear=clear)
+                except ClientError:
+                    pass  # healed by anti-entropy
+
     def import_values(self, index: str, field: str, columns=None,
                       values=None, column_keys=None,
-                      clear: bool = False) -> None:
+                      clear: bool = False, remote: bool = False) -> None:
         """(reference API.ImportValue, api.go:922)."""
         idx = self._index(index)
         f = self._field(idx, field)
         if column_keys is not None:
-            columns = idx.column_translator.translate_keys(column_keys)
+            columns = self.executor._resolve_col_keys(idx, list(column_keys))
         columns = np.asarray(columns, dtype=np.uint64)
         values = np.asarray(values, dtype=np.int64)
         if len(columns) != len(values):
             raise ApiError("columns and values length mismatch")
+        if self.cluster is not None and not remote:
+            self._import_fanout(index, field, None, columns, None, clear,
+                                values=values)
+            return
         try:
             f.import_values(columns, values, clear=clear)
         except ValueError as e:
@@ -216,6 +347,92 @@ class API:
                             frag.cache.add(r, frag.row_count(r))
 
     # ---------------------------------------------------------------- status
+
+    def local_shards(self) -> Dict[str, List[int]]:
+        """Shards materialized on this node, per index (feeds cluster-wide
+        shard discovery; the reference broadcasts availableShards,
+        field.go:228)."""
+        return {idx.name: idx.available_shards()
+                for idx in self.holder.indexes.values()}
+
+    def views_of(self, index: str, field: str) -> List[str]:
+        idx = self._index(index)
+        return sorted(self._field(idx, field).views.keys())
+
+    def handle_join(self, node_info: dict) -> dict:
+        """A node announces itself; topology updates and replicates
+        (reference coordinator nodeJoin, cluster.go:1017-1148)."""
+        if self.cluster is None:
+            raise ApiError("not clustered", 400)
+        from pilosa_tpu.parallel.cluster import Node
+        from pilosa_tpu.parallel.client import ClientError
+        node = Node.from_json(node_info)
+        self.cluster.add_node(node)
+        for peer in self.cluster.nodes():
+            if peer.id in (self.cluster.local.id, node.id):
+                continue
+            try:
+                self._client.cluster_message(
+                    peer.uri, {"type": "node-join", "node": node.to_json()})
+            except ClientError:
+                pass
+        return self.cluster.status()
+
+    def handle_cluster_message(self, msg: dict) -> None:
+        """(reference receiveMessage dispatch, server.go:485-580)."""
+        if self.cluster is None:
+            return
+        from pilosa_tpu.parallel.cluster import Node
+        typ = msg.get("type")
+        if typ == "node-join":
+            self.cluster.add_node(Node.from_json(msg["node"]))
+        elif typ == "node-leave":
+            self.cluster.remove_node(msg["nodeID"])
+        elif typ == "topology":
+            for nd in msg.get("nodes", []):
+                self.cluster.add_node(Node.from_json(nd))
+
+    def sync_now(self) -> dict:
+        """One synchronous anti-entropy pass (tests + admin)."""
+        if self.syncer is None:
+            raise ApiError("not clustered", 400)
+        # Reconcile translate stores from the primary first, so pushed ids
+        # mean the same thing everywhere (chained replication,
+        # translate.go:400).
+        self._sync_translate_stores()
+        return self.syncer.sync_holder()
+
+    def _sync_translate_stores(self) -> None:
+        from pilosa_tpu.parallel.client import ClientError
+        primary = self._translate_primary()
+        if primary.id == self.cluster.local.id:
+            return
+        for idx in self.holder.indexes.values():
+            try:
+                if idx.keys:
+                    idx.column_translator.apply_log(
+                        self._client._req(
+                            "GET",
+                            f"{primary.uri}/internal/translate/data"
+                            f"?index={idx.name}", raw=True))
+                for f in idx.fields.values():
+                    if f.options.keys:
+                        f.row_translator.apply_log(self._client._req(
+                            "GET",
+                            f"{primary.uri}/internal/translate/data"
+                            f"?index={idx.name}&field={f.name}", raw=True))
+            except ClientError:
+                continue
+
+    def resize_now(self) -> dict:
+        """Pull newly-owned fragments + drop unowned (tests + admin; the
+        reference runs this as coordinator-driven resize jobs,
+        cluster.go:1150)."""
+        if self.resize_puller is None:
+            raise ApiError("not clustered", 400)
+        fetched = self.resize_puller.pull_owned()
+        removed = self.resize_puller.clean_unowned()
+        return {"fetched": fetched, "removed": removed}
 
     def shards_max(self) -> Dict[str, int]:
         return {idx.name: (max(idx.available_shards()) if

@@ -159,6 +159,10 @@ class Handler(BaseHTTPRequestHandler):
                     q["index"], q.get("field"), int(q.get("offset", 0))))
             elif path == "/internal/nodes":
                 self._json(api.status().get("nodes", []))
+            elif path == "/internal/local-shards":
+                self._json(api.local_shards())
+            elif path == "/internal/views":
+                self._json({"views": api.views_of(q["index"], q["field"])})
             else:
                 return False
             return True
@@ -172,20 +176,22 @@ class Handler(BaseHTTPRequestHandler):
                     body = None
                 pql = (body or {}).get("query") if body else raw.decode()
                 shards = None
-                if "shards" in q:
+                if q.get("shards"):
                     shards = [int(s) for s in q["shards"].split(",")]
                 try:
-                    self._json(api.query(m.group(1), pql, shards=shards))
+                    self._json(api.query(m.group(1), pql, shards=shards,
+                                         remote=bool(q.get("remote"))))
                 except ValueError as e:
                     raise ApiError(str(e))
             elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)/import",
                                    path):
                 b = self._body_json()
+                remote = bool(q.get("remote"))
                 if "values" in b:
                     api.import_values(
                         m.group(1), m.group(2), columns=b.get("columnIDs"),
                         values=b["values"], column_keys=b.get("columnKeys"),
-                        clear=bool(q.get("clear")))
+                        clear=bool(q.get("clear")), remote=remote)
                 else:
                     api.import_bits(
                         m.group(1), m.group(2), rows=b.get("rowIDs"),
@@ -193,7 +199,7 @@ class Handler(BaseHTTPRequestHandler):
                         row_keys=b.get("rowKeys"),
                         column_keys=b.get("columnKeys"),
                         timestamps=b.get("timestamps"),
-                        clear=bool(q.get("clear")))
+                        clear=bool(q.get("clear")), remote=remote)
                 self._json({})
             elif m := re.fullmatch(
                     r"/index/([^/]+)/field/([^/]+)/import-roaring/(\d+)",
@@ -205,16 +211,33 @@ class Handler(BaseHTTPRequestHandler):
             elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)", path):
                 b = self._body_json()
                 self._json(api.create_field(m.group(1), m.group(2),
-                                            b.get("options")))
+                                            b.get("options"),
+                                            remote=bool(q.get("remote"))))
             elif m := re.fullmatch(r"/index/([^/]+)", path):
                 b = self._body_json()
                 opts = b.get("options", {})
                 self._json(api.create_index(
                     m.group(1), keys=opts.get("keys", False),
-                    track_existence=opts.get("trackExistence", True)))
+                    track_existence=opts.get("trackExistence", True),
+                    remote=bool(q.get("remote"))))
             elif path == "/recalculate-caches":
                 api.recalculate_caches()
                 self._json({})
+            elif path == "/internal/join":
+                self._json(api.handle_join(self._body_json()))
+            elif path == "/internal/cluster/message":
+                api.handle_cluster_message(self._body_json())
+                self._json({})
+            elif path == "/internal/translate/keys":
+                b = self._body_json()
+                keys = b.get("keys", [])
+                ids = api.translate_keys_local(b["index"], b.get("field"),
+                                               keys)
+                self._json({"keys": keys, "ids": ids})
+            elif path == "/internal/sync":
+                self._json(api.sync_now())
+            elif path == "/cluster/resize/run":
+                self._json(api.resize_now())
             else:
                 return False
             return True

@@ -30,8 +30,11 @@ class Config:
     anti_entropy_interval: float = 600.0
     # Metrics
     metric_service: str = "mem"   # mem | none
-    # Cluster
+    # Cluster: static peer URI list (must include this node's own URI) +
+    # replication factor (reference cluster.replicas, server/config.go:63)
     cluster_peers: list = field(default_factory=list)
+    cluster_replicas: int = 1
+    advertise: str = ""  # URI peers reach us at; default http://<bind>
 
     @property
     def host(self) -> str:
