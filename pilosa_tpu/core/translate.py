@@ -10,7 +10,11 @@ the translation primary allocates ids (via POST /internal/translate/keys,
 the reference's handler.go:274 endpoint); replicas replay the primary's
 log — explicit ids make replication exact regardless of replay order.
 
-Record format: uint32 key length, utf-8 key bytes, uint64 id.
+On-disk file format: an 8-byte header (magic "PTLT" + uint32 version),
+then records of: uint32 key length, utf-8 key bytes, uint64 id. The
+replication stream (`read_log_from`) carries records only. A file whose
+header does not match errors loudly on open — silently misparsing another
+format's length prefixes would map garbage keys to live ids.
 """
 
 from __future__ import annotations
@@ -34,15 +38,26 @@ class TranslateStore:
 
     # -- lifecycle ----------------------------------------------------------
 
+    MAGIC = b"PTLT" + struct.pack("<I", 1)
+
     def open(self) -> None:
         if self.path is None:
             return
-        if os.path.exists(self.path):
+        if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
             with open(self.path, "rb") as f:
-                self.apply_log(f.read(), _persist=False)
+                data = f.read()
+            if not data.startswith(self.MAGIC):
+                raise ValueError(
+                    f"{self.path}: bad translate log header "
+                    f"{data[:8]!r}; expected {self.MAGIC!r}")
+            self.apply_log(data[len(self.MAGIC):], _persist=False)
+            self._file = open(self.path, "ab")
         else:
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        self._file = open(self.path, "ab")
+            self._file = open(self.path, "ab")
+            if self._file.tell() == 0:
+                self._file.write(self.MAGIC)
+                self._file.flush()
 
     def close(self) -> None:
         if self._file is not None:

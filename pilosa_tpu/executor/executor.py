@@ -65,6 +65,35 @@ class ExecutionError(ValueError):
 
 
 @dataclass
+class ExecOptions:
+    """Per-query execution options (reference execOptions, executor.go:36,
+    set by the Options() call, executor.go:317-361)."""
+    exclude_row_attrs: bool = False
+    exclude_columns: bool = False
+    column_attrs: bool = False
+
+
+def column_attr_sets(idx: Index, ids: Sequence[int],
+                     resolve=None) -> List[Dict[str, Any]]:
+    """Non-empty column attr sets for `ids`, key-translated when the index
+    is keyed (reference readColumnAttrSets, executor.go:180-200 +
+    translation :155-162). `resolve(ids) -> keys` overrides the local
+    translator (cluster mode resolves through the primary so attr keys
+    match the result keys in the same response)."""
+    withattrs = [(int(cid), idx.column_attr_store.get(int(cid)))
+                 for cid in ids]
+    withattrs = [(cid, attrs) for cid, attrs in withattrs if attrs]
+    if not idx.keys:
+        return [{"id": cid, "attrs": attrs} for cid, attrs in withattrs]
+    if resolve is None:
+        resolve = idx.column_translator.translate_ids
+    keys = resolve([cid for cid, _ in withattrs])
+    return [({"key": key, "attrs": attrs} if key is not None
+             else {"id": cid, "attrs": attrs})
+            for (cid, attrs), key in zip(withattrs, keys)]
+
+
+@dataclass
 class _Plan:
     """Everything the jitted tree program needs, gathered in one host pass."""
     sig_parts: List[str] = dc_field(default_factory=list)
@@ -98,6 +127,9 @@ class Executor:
         # translation primary (reference: primary-owned TranslateFile with
         # chained replication, translate.go:56,400). None = local stores.
         self.key_resolver = None
+        # Reverse (id -> key) resolver with primary fallback for replicas
+        # whose translate-log replay lags the allocation.
+        self.id_resolver = None
 
     def _resolve_col_keys(self, idx: Index, keys: List[str]) -> List[int]:
         if self.key_resolver is not None:
@@ -113,6 +145,17 @@ class Executor:
     def _resolve_col_key(self, idx: Index, key: str) -> int:
         return self._resolve_col_keys(idx, [key])[0]
 
+    def _resolve_col_ids(self, idx: Index, ids) -> List[Optional[str]]:
+        if self.id_resolver is not None:
+            return self.id_resolver(idx.name, None, list(ids))
+        return idx.column_translator.translate_ids(ids)
+
+    def _resolve_row_ids(self, idx: Index, field: Field,
+                         ids) -> List[Optional[str]]:
+        if self.id_resolver is not None:
+            return self.id_resolver(idx.name, field.name, list(ids))
+        return field.row_translator.translate_ids(ids)
+
     def _resolve_row_key(self, idx: Index, field: Field, key: str) -> int:
         return self._resolve_row_keys(idx, field, [key])[0]
 
@@ -122,6 +165,11 @@ class Executor:
                 = None) -> List[Any]:
         """Execute every call in `query` (reference executor.Execute,
         executor.go:84)."""
+        results, _ = self._execute_query(index_name, query, shards)
+        return results
+
+    def _execute_query(self, index_name: str, query, shards
+                       ) -> Tuple[List[Any], "ExecOptions"]:
         if isinstance(query, str):
             query = parse_string(query)
         if isinstance(query, Call):
@@ -129,13 +177,32 @@ class Executor:
         idx = self.holder.index(index_name)
         if idx is None:
             raise ExecutionError(f"index not found: {index_name}")
+        opts = ExecOptions()
         results = []
         for call in query.calls:
             self._translate_call(idx, call)
-            result = self._execute_call(idx, call, shards)
+            result = self._execute_call(idx, call, shards, opts)
             self._translate_result(idx, call, result)
             results.append(result)
-        return results
+        return results, opts
+
+    def execute_full(self, index_name: str, query,
+                     shards: Optional[Sequence[int]] = None
+                     ) -> Dict[str, Any]:
+        """Execute and return the full JSON-shaped response, including
+        `columnAttrs` when an Options(columnAttrs=true) call requested them
+        (reference executor.Execute, executor.go:134-165)."""
+        from pilosa_tpu.executor.results import result_to_json
+        results, opts = self._execute_query(index_name, query, shards)
+        resp: Dict[str, Any] = {"results": [result_to_json(r)
+                                            for r in results]}
+        if opts.column_attrs:
+            idx = self.holder.index(index_name)
+            ids = sorted({int(c) for r in results if isinstance(r, RowResult)
+                          for c in r.columns().tolist()})
+            resp["columnAttrs"] = column_attr_sets(
+                idx, ids, resolve=lambda xs: self._resolve_col_ids(idx, xs))
+        return resp
 
     # ------------------------------------------------------- key translation
 
@@ -198,24 +265,27 @@ class Executor:
     def _translate_result(self, idx: Index, call: Call, result) -> None:
         """Ids -> string keys on results (reference translateResults,
         executor.go:2577)."""
+        while call.name == "Options" and call.children:
+            call = call.children[0]
         if isinstance(result, RowResult) and idx.keys:
             cols = result.columns()  # cached on the result for to_json
             # Keep 1:1 alignment with columns; ids set outside the
             # translator (raw-id imports) fall back to their decimal form.
             result.keys = [k if k is not None else str(int(c))
                            for c, k in zip(
-                               cols, idx.column_translator
-                               .translate_ids(cols))]
+                               cols, self._resolve_col_ids(idx, cols))]
             return
         fname = call.args.get("_field")
         field = idx.field(fname) if fname else None
         keyed = field is not None and field.options.keys
         if isinstance(result, PairsResult) and keyed:
-            result.keys = [field.row_translator.translate_id(r) or str(r)
-                           for r, _ in result.pairs]
+            result.keys = [k or str(r) for (r, _), k in zip(
+                result.pairs,
+                self._resolve_row_ids(idx, field,
+                                      [r for r, _ in result.pairs]))]
         elif isinstance(result, RowIdentifiers) and keyed:
-            result.keys = [field.row_translator.translate_id(r) or str(r)
-                           for r in result.rows]
+            result.keys = [k or str(r) for r, k in zip(
+                result.rows, self._resolve_row_ids(idx, field, result.rows))]
         elif isinstance(result, list):
             for gc in result:
                 if isinstance(gc, GroupCount):
@@ -228,12 +298,15 @@ class Executor:
     # -------------------------------------------------------- call dispatch
 
     def _execute_call(self, idx: Index, call: Call,
-                      shards: Optional[Sequence[int]]) -> Any:
+                      shards: Optional[Sequence[int]],
+                      opts: Optional["ExecOptions"] = None) -> Any:
         name = call.name
+        if name == "Options":
+            return self._execute_options(idx, call, shards, opts)
         if name == "Count":
             return self._execute_count(idx, call, shards)
         if name in _BITMAP_CALLS:
-            return self._execute_bitmap(idx, call, shards)
+            return self._execute_bitmap(idx, call, shards, opts)
         if name == "TopN":
             return self._execute_topn(idx, call, shards)
         if name == "Rows":
@@ -268,11 +341,47 @@ class Executor:
 
     # ----------------------------------------------------- bitmap call eval
 
-    def _execute_bitmap(self, idx: Index, call: Call, shards) -> RowResult:
+    def _execute_options(self, idx: Index, call: Call, shards,
+                         opts: Optional["ExecOptions"]) -> Any:
+        """Options(child, columnAttrs=…, excludeRowAttrs=…,
+        excludeColumns=…, shards=[…]) — reference executeOptionsCall,
+        executor.go:317-361. `columnAttrs` mutates the *outer* options (it
+        shapes the whole response); the exclude flags apply to a copy used
+        for the child only."""
+        if len(call.children) != 1:
+            raise ExecutionError("Options() takes exactly one child call")
+        child_opts = ExecOptions(**vars(opts)) if opts is not None \
+            else ExecOptions()
+        for arg in ("columnAttrs", "excludeRowAttrs", "excludeColumns"):
+            if arg in call.args and not isinstance(call.args[arg], bool):
+                raise ExecutionError(f"Query(): {arg} must be a bool")
+        if call.args.get("columnAttrs") and opts is not None:
+            opts.column_attrs = True
+        if "excludeRowAttrs" in call.args:
+            child_opts.exclude_row_attrs = call.args["excludeRowAttrs"]
+        if "excludeColumns" in call.args:
+            child_opts.exclude_columns = call.args["excludeColumns"]
+        if "shards" in call.args:
+            arg = call.args["shards"]
+            if not isinstance(arg, (list, tuple)) or not all(
+                    isinstance(s, int) and not isinstance(s, bool)
+                    for s in arg):
+                raise ExecutionError(
+                    "Query(): shards must be a list of unsigned integers")
+            shards = [int(s) for s in arg]
+        return self._execute_call(idx, call.children[0], shards, child_opts)
+
+    def _execute_bitmap(self, idx: Index, call: Call, shards,
+                        opts: Optional["ExecOptions"] = None) -> RowResult:
         shards = self._shards(idx, shards)
         words = self._eval_tree(idx, call, shards, mode="row")
         res = RowResult(shards, words)
-        self._attach_row_attrs(idx, call, res)
+        if opts is not None and opts.exclude_row_attrs:
+            res.attrs = {}
+        else:
+            self._attach_row_attrs(idx, call, res)
+        if opts is not None and opts.exclude_columns:
+            res.clear_columns()
         return res
 
     def _execute_count(self, idx: Index, call: Call, shards) -> int:

@@ -41,6 +41,15 @@ class RowResult:
                          else np.empty(0, dtype=np.uint64))
         return self._columns
 
+    def clear_columns(self) -> None:
+        """Drop column data, keeping attrs (reference ExcludeColumns empties
+        the row's segments, executor.go:532-534)."""
+        self.words = np.zeros((len(self.shards),
+                               self.words.shape[-1] if hasattr(
+                                   self.words, "shape") else 0),
+                              dtype=np.uint32)
+        self._columns = np.empty(0, dtype=np.uint64)
+
     def count(self) -> int:
         from pilosa_tpu.ops.bitset import popcount
         import jax.numpy as jnp

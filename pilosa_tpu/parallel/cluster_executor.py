@@ -27,8 +27,11 @@ from pilosa_tpu.parallel.cluster import Cluster
 from pilosa_tpu.pql import Call, parse_string
 from pilosa_tpu.ops.bitset import SHARD_WIDTH
 
-_WRITE_SINGLE_COL = {"Set", "Clear", "SetColumnAttrs"}
-_WRITE_BROADCAST = {"ClearRow", "Store", "SetRowAttrs"}
+_WRITE_SINGLE_COL = {"Set", "Clear"}
+# Attr writes go to every node (reference executeSetRowAttrs /
+# executeSetColumnAttrs fan to all nodes, executor.go:2063-2080,2225-2240),
+# so any coordinator can serve columnAttrs from its local store.
+_WRITE_BROADCAST = {"ClearRow", "Store", "SetRowAttrs", "SetColumnAttrs"}
 
 
 def merge_results(call: Call, parts: List[Any]) -> Any:
@@ -36,6 +39,10 @@ def merge_results(call: Call, parts: List[Any]) -> Any:
     parts = [p for p in parts if p is not None]
     if not parts:
         return None
+    # Options() wraps one child; per-node results have the child's shape,
+    # so merge by the child's rule (reference reduces on the inner call).
+    while call.name == "Options" and call.children:
+        call = call.children[0]
     if len(parts) == 1:
         return parts[0]
     name = call.name
@@ -43,11 +50,17 @@ def merge_results(call: Call, parts: List[Any]) -> Any:
         return sum(parts)
     if name in ("Row", "Range", "Intersect", "Union", "Difference", "Xor",
                 "Not", "Shift"):
-        out = {"columns": sorted(set().union(
-            *[set(p.get("columns", [])) for p in parts]))}
-        keys = [k for p in parts for k in p.get("keys", [])]
+        cols = sorted(set().union(
+            *[set(p.get("columns", [])) for p in parts]))
+        out = {"columns": cols}
         if any("keys" in p for p in parts):
-            out["keys"] = sorted(set(keys))
+            # Keep columns[i] <-> keys[i] positional alignment: merge each
+            # node's aligned pairs into one map, then emit keys in merged
+            # column order.
+            by_col = {c: k for p in parts
+                      for c, k in zip(p.get("columns", []),
+                                      p.get("keys", []))}
+            out["keys"] = [by_col.get(c, str(c)) for c in cols]
         attrs = next((p["attrs"] for p in parts if p.get("attrs")), None)
         if attrs:
             out["attrs"] = attrs
@@ -149,6 +162,13 @@ class ClusterExecutor:
         cache[index] = (time.monotonic(), out)
         return out
 
+    def invalidate_shards_cache(self, index: str) -> None:
+        """Drop the cached global shard list after a write through this
+        coordinator (read-your-own-writes for newly created shards)."""
+        cache = getattr(self, "_shards_cache", None)
+        if cache is not None:
+            cache.pop(index, None)
+
     # -- query --------------------------------------------------------------
 
     def execute(self, index: str, query: str,
@@ -158,10 +178,23 @@ class ClusterExecutor:
         return [self._execute_call(index, call, shards) for call in q.calls]
 
     def _execute_call(self, index: str, call: Call, shards) -> Any:
-        if call.name in _WRITE_SINGLE_COL:
-            return self._execute_write_single(index, call)
-        if call.name in _WRITE_BROADCAST:
-            return self._execute_write_broadcast(index, call)
+        inner = call
+        while inner.name == "Options" and inner.children:
+            # Options(shards=[...]) overrides the scatter set at the
+            # coordinator (reference executeOptionsCall, executor.go:344-359).
+            # The arg is *consumed* here: the forwarded call must not carry
+            # it, or each node would re-override its per-node shard subset
+            # with the full list and replicated shards would double-count.
+            opt_shards = inner.args.pop("shards", None)
+            if isinstance(opt_shards, (list, tuple)):
+                shards = [int(s) for s in opt_shards]
+            inner = inner.children[0]
+        if inner.name in _WRITE_SINGLE_COL:
+            self.invalidate_shards_cache(index)
+            return self._execute_write_single(index, inner)
+        if inner.name in _WRITE_BROADCAST:
+            self.invalidate_shards_cache(index)
+            return self._execute_write_broadcast(index, inner)
         all_shards = list(shards) if shards is not None \
             else self.global_shards(index)
         return self._map_reduce(index, call, all_shards)
@@ -196,17 +229,22 @@ class ClusterExecutor:
                         self.logger.printf("node %s failed, failing over: %s",
                                            node.id, e)
 
+            # Dispatch every remote leg before running the local one so the
+            # local evaluation overlaps the network round trips.
+            local_shards = None
             for node_id, node_shards in by_node.items():
                 if node_id == self.cluster.local.id:
-                    local = self.local.execute(index, call.to_pql(),
-                                               shards=node_shards)
-                    parts.append(result_to_json(local[0]))
+                    local_shards = node_shards
                 else:
                     node = self.cluster.node_by_id(node_id)
                     t = threading.Thread(target=run_remote,
                                          args=(node, node_shards))
                     t.start()
                     threads.append(t)
+            if local_shards is not None:
+                local = self.local.execute(index, call.to_pql(),
+                                           shards=local_shards)
+                parts.append(result_to_json(local[0]))
             for t in threads:
                 t.join()
             if not failed:
@@ -256,6 +294,9 @@ class ClusterExecutor:
     def _execute_write_broadcast(self, index: str, call: Call) -> Any:
         """Row-scoped writes apply on every node (each owns a shard
         subset)."""
+        if isinstance(call.args.get("_col"), str):
+            # Translate on the coordinator so every node stores the same id.
+            self.local._translate_call(self.local.holder.index(index), call)
         results = []
         for node in self.cluster.nodes():
             if node.id == self.cluster.local.id:

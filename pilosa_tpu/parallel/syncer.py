@@ -247,8 +247,9 @@ class AntiEntropyLoop:
             while not self._stop.wait(self.interval):
                 try:
                     self.syncer.sync_holder()
-                except Exception:
-                    pass
+                except Exception as e:  # keep the loop alive, but say why
+                    self.syncer._log("anti-entropy pass failed: %s: %s",
+                                     type(e).__name__, e)
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

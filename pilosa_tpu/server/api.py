@@ -52,6 +52,7 @@ class API:
             self.syncer = HolderSyncer(holder, cluster, client)
             self.resize_puller = ResizePuller(holder, cluster, client)
             self.executor.key_resolver = self._resolve_key_via_primary
+            self.executor.id_resolver = self._resolve_ids_via_primary
             self._client = client
 
     # -------------------------------------------------- translation primary
@@ -93,6 +94,41 @@ class API:
         store = self._translate_store(index, field)
         return [int(i) for i in store.translate_keys(keys)]
 
+    def translate_ids_local(self, index: str, field: Optional[str],
+                            ids: List[int]) -> List[Optional[str]]:
+        """Reverse lookup (primary side of /internal/translate/ids)."""
+        store = self._translate_store(index, field)
+        return store.translate_ids([int(i) for i in ids])
+
+    def _resolve_ids_via_primary(self, index: str, field: Optional[str],
+                                 ids: List[int]) -> List[Optional[str]]:
+        """ids -> keys with primary fallback: the local replica of the
+        translate log streams asynchronously (reference translate.go:400
+        replicate loop), so a read landing between allocation and replay
+        would otherwise miss. Local hits stay local; misses take one batch
+        round trip to the primary and are adopted into the local store."""
+        store = self._translate_store(index, field)
+        keys = store.translate_ids([int(i) for i in ids])
+        missing = [int(i) for i, k in zip(ids, keys) if k is None]
+        if not missing:
+            return keys
+        primary = self._translate_primary()
+        if primary.id == self.cluster.local.id:
+            return keys
+        import json as _json
+        body = _json.dumps({"index": index, "field": field,
+                            "ids": missing}).encode()
+        try:
+            res = self._client._req(
+                "POST", f"{primary.uri}/internal/translate/ids", body)
+        except Exception:
+            return keys
+        fetched = dict(zip(missing, res["keys"]))
+        store.apply_entries((k, i) for i, k in fetched.items()
+                            if k is not None)
+        return [k if k is not None else fetched.get(int(i))
+                for i, k in zip(ids, keys)]
+
     # ----------------------------------------------------------------- query
 
     def query(self, index: str, query: str,
@@ -104,11 +140,38 @@ class API:
         opt.Remote, executor.go:2236)."""
         with self.tracer.span("API.Query", index=index):
             self.stats.count("query", 1)
-            if self.cluster_executor is not None and not remote:
-                return {"results": self.cluster_executor.execute(
-                    index, query, shards=shards)}
-            results = self.executor.execute(index, query, shards=shards)
-            return {"results": [result_to_json(r) for r in results]}
+            if remote:
+                # Node-to-node leg: results only; the coordinator owns
+                # response shaping (columnAttrs etc).
+                results = self.executor.execute(index, query, shards=shards)
+                return {"results": [result_to_json(r) for r in results]}
+            if self.cluster_executor is not None:
+                from pilosa_tpu.pql import parse_string
+                q = parse_string(query) if isinstance(query, str) else query
+                resp = {"results": self.cluster_executor.execute(
+                    index, q, shards=shards)}
+                self._attach_column_attrs(index, q, resp)
+                return resp
+            return self.executor.execute_full(index, query, shards=shards)
+
+    def _attach_column_attrs(self, index: str, q, resp: Dict[str, Any]
+                             ) -> None:
+        """Coordinator-side columnAttrs for the cluster path: if the query
+        carries Options(columnAttrs=true), read attrs for every merged row
+        column from the local (anti-entropy-replicated) attr store
+        (reference executor.go:134-165)."""
+        from pilosa_tpu.executor.executor import column_attr_sets
+        if not any(c.name == "Options" and c.args.get("columnAttrs")
+                   for c in q.calls):
+            return
+        idx = self.holder.index(index)
+        if idx is None:
+            return
+        ids = sorted({int(c) for r in resp["results"]
+                      if isinstance(r, dict) for c in r.get("columns", [])})
+        resp["columnAttrs"] = column_attr_sets(
+            idx, ids,
+            resolve=lambda xs: self._resolve_ids_via_primary(index, None, xs))
 
     # ---------------------------------------------------------------- schema
 
@@ -208,6 +271,8 @@ class API:
                   else (timeq.parse_timestamp(t) if isinstance(t, str) else t)
                   for t in timestamps]
 
+        if self.cluster_executor is not None:
+            self.cluster_executor.invalidate_shards_cache(index)
         if self.cluster is not None and not remote:
             self._import_fanout(index, field, rows, columns, timestamps,
                                 clear, values=None)
@@ -266,6 +331,8 @@ class API:
         values = np.asarray(values, dtype=np.int64)
         if len(columns) != len(values):
             raise ApiError("columns and values length mismatch")
+        if self.cluster_executor is not None:
+            self.cluster_executor.invalidate_shards_cache(index)
         if self.cluster is not None and not remote:
             self._import_fanout(index, field, None, columns, None, clear,
                                 values=values)
@@ -284,6 +351,8 @@ class API:
         API.ImportRoaring, api.go:291)."""
         idx = self._index(index)
         f = self._field(idx, field)
+        if self.cluster_executor is not None:
+            self.cluster_executor.invalidate_shards_cache(index)
         frag = f.create_view_if_not_exists(view) \
             .create_fragment_if_not_exists(shard)
         try:
@@ -376,7 +445,30 @@ class API:
                     peer.uri, {"type": "node-join", "node": node.to_json()})
             except ClientError:
                 pass
+        self._kick_resize()
         return self.cluster.status()
+
+    def _kick_resize(self) -> None:
+        """Topology changed: pull newly-owned fragments in the background
+        (the analog of the reference coordinator turning joins into resize
+        jobs, cluster.go:1095-1230 — here each node pulls for itself)."""
+        if self.resize_puller is None:
+            return
+        import threading
+
+        def run():
+            # Pull only — cleaning unowned fragments here would race the
+            # new owner's own pull and destroy its source copy. Cleanup
+            # stays an explicit post-resize step (/cluster/resize/run, the
+            # reference's holderCleaner after the cluster returns to
+            # NORMAL, holder.go:859).
+            try:
+                self.resize_puller.pull_owned()
+            except Exception as e:
+                self.resize_puller._log("resize pull failed: %s: %s",
+                                        type(e).__name__, e)
+
+        threading.Thread(target=run, daemon=True).start()
 
     def handle_cluster_message(self, msg: dict) -> None:
         """(reference receiveMessage dispatch, server.go:485-580)."""
@@ -386,8 +478,10 @@ class API:
         typ = msg.get("type")
         if typ == "node-join":
             self.cluster.add_node(Node.from_json(msg["node"]))
+            self._kick_resize()
         elif typ == "node-leave":
             self.cluster.remove_node(msg["nodeID"])
+            self._kick_resize()
         elif typ == "topology":
             for nd in msg.get("nodes", []):
                 self.cluster.add_node(Node.from_json(nd))

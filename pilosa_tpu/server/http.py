@@ -234,6 +234,12 @@ class Handler(BaseHTTPRequestHandler):
                 ids = api.translate_keys_local(b["index"], b.get("field"),
                                                keys)
                 self._json({"keys": keys, "ids": ids})
+            elif path == "/internal/translate/ids":
+                b = self._body_json()
+                ids = b.get("ids", [])
+                keys = api.translate_ids_local(b["index"], b.get("field"),
+                                               ids)
+                self._json({"ids": ids, "keys": keys})
             elif path == "/internal/sync":
                 self._json(api.sync_now())
             elif path == "/cluster/resize/run":

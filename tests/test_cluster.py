@@ -268,3 +268,43 @@ def test_translate_log_truncation_tolerated(tmp_path):
     assert ts2.translate_key("alice", create=False) == 1
     assert ts2.translate_key("bob", create=False) is None
     ts2.close()
+
+
+def test_options_cluster_no_double_count(tmp_path):
+    """Options(shards=[...]) must be consumed at the coordinator: with
+    replication, forwarding the full shard list to every node would make
+    replicated shards count twice."""
+    nodes = run_cluster(tmp_path, 2, replica_n=2)
+    try:
+        req(nodes[0].uri, "POST", "/index/oi", {})
+        req(nodes[0].uri, "POST", "/index/oi/field/f", {})
+        sets = " ".join(f"Set({c}, f=1)" for c in (1, 2, SHARD_WIDTH + 5))
+        req(nodes[0].uri, "POST", "/index/oi/query", sets.encode())
+        for nd in nodes:
+            res = req(nd.uri, "POST", "/index/oi/query",
+                      b"Options(Count(Row(f=1)), shards=[0, 1])")
+            assert res["results"][0] == 3, (nd.uri, res)
+            res = req(nd.uri, "POST", "/index/oi/query",
+                      b"Options(Count(Row(f=1)), shards=[0])")
+            assert res["results"][0] == 2, (nd.uri, res)
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_options_cluster_column_attrs(tmp_path):
+    nodes = run_cluster(tmp_path, 2, replica_n=1)
+    try:
+        req(nodes[0].uri, "POST", "/index/ai", {})
+        req(nodes[0].uri, "POST", "/index/ai/field/f", {})
+        req(nodes[0].uri, "POST", "/index/ai/query",
+            b'Set(1, f=1) Set(2, f=1) SetColumnAttrs(2, kind="x")')
+        for nd in nodes:
+            res = req(nd.uri, "POST", "/index/ai/query",
+                      b"Options(Row(f=1), columnAttrs=true)")
+            assert res["results"][0]["columns"] == [1, 2], (nd.uri, res)
+            assert res.get("columnAttrs") == \
+                [{"id": 2, "attrs": {"kind": "x"}}], (nd.uri, res)
+    finally:
+        for nd in nodes:
+            nd.stop()

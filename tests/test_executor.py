@@ -331,3 +331,52 @@ def test_incremental_bank_patch(ex):
     # patched in place: same capacity array object lineage, same slots
     assert bank2.array.shape == bank1.array.shape
     assert bank2.slots == bank1.slots
+
+
+def test_options_exclude_columns(ex):
+    e, h = ex
+    setup_basic(h)
+    (res,) = e.execute("i", "Options(Row(f=1), excludeColumns=true)")
+    assert res.columns().tolist() == []
+    # unaffected without the flag
+    (res2,) = e.execute("i", "Row(f=1)")
+    assert len(res2.columns()) == 4
+
+
+def test_options_exclude_row_attrs(ex):
+    e, h = ex
+    setup_basic(h)
+    e.execute("i", 'SetRowAttrs(f, 1, foo="bar")')
+    (res,) = e.execute("i", "Row(f=1)")
+    assert res.attrs == {"foo": "bar"}
+    (res,) = e.execute("i", "Options(Row(f=1), excludeRowAttrs=true)")
+    assert res.attrs == {}
+    assert len(res.columns()) == 4
+
+
+def test_options_shards_override(ex):
+    e, h = ex
+    setup_basic(h)
+    (res,) = e.execute("i", "Options(Row(f=1), shards=[1])")
+    assert res.columns().tolist() == [SHARD_WIDTH + 1]
+    (cnt,) = e.execute("i", "Count(Row(f=1))")
+    assert cnt == 4
+
+
+def test_options_column_attrs_response(ex):
+    e, h = ex
+    setup_basic(h)
+    e.execute("i", 'SetColumnAttrs(2, kind="x")')
+    resp = e.execute_full("i", "Options(Row(f=1), columnAttrs=true)")
+    assert resp["columnAttrs"] == [{"id": 2, "attrs": {"kind": "x"}}]
+    resp = e.execute_full("i", "Row(f=1)")
+    assert "columnAttrs" not in resp
+
+
+def test_options_bad_args(ex):
+    e, h = ex
+    setup_basic(h)
+    with pytest.raises(ValueError):
+        e.execute("i", "Options(Row(f=1), excludeColumns=7)")
+    with pytest.raises(ValueError):
+        e.execute("i", "Options(Row(f=1), shards=3)")
