@@ -46,6 +46,14 @@ class TranslateStore:
         if os.path.exists(self.path) and os.path.getsize(self.path) > 0:
             with open(self.path, "rb") as f:
                 data = f.read()
+            if len(data) < len(self.MAGIC) \
+                    and self.MAGIC.startswith(data):
+                # Crash mid-initial-header-write: no records can exist yet,
+                # so rewrite the header and treat the log as empty.
+                with open(self.path, "wb") as f:
+                    f.write(self.MAGIC)
+                self._file = open(self.path, "ab")
+                return
             if not data.startswith(self.MAGIC):
                 raise ValueError(
                     f"{self.path}: bad translate log header "

@@ -365,7 +365,7 @@ class Executor:
             arg = call.args["shards"]
             if not isinstance(arg, (list, tuple)) or not all(
                     isinstance(s, int) and not isinstance(s, bool)
-                    for s in arg):
+                    and s >= 0 for s in arg):
                 raise ExecutionError(
                     "Query(): shards must be a list of unsigned integers")
             shards = [int(s) for s in arg]
@@ -635,21 +635,32 @@ class Executor:
         per-row popcounts [R] (for tanimoto)."""
         import jax
         import jax.numpy as jnp
+        from pilosa_tpu.ops import pallas_kernels
         from pilosa_tpu.ops.bitset import popcount
-        key = f"topn:{with_filter}:{shape}"
+        use_pallas = pallas_kernels.enabled() and self.mesh is None
+        key = f"topn:{with_filter}:{shape}:{use_pallas}"
         fn = self._jit_cache.get(key)
         if fn is None:
             if with_filter:
-                def run(chunk, filt):
-                    inter = jnp.bitwise_and(chunk, filt)
-                    return (popcount(inter, axis=(-2, -1)),
-                            popcount(chunk, axis=(-2, -1)))
+                if use_pallas:
+                    def run(chunk, filt):
+                        return pallas_kernels.bank_row_counts_masked(
+                            chunk, filt)
+                else:
+                    def run(chunk, filt):
+                        inter = jnp.bitwise_and(chunk, filt)
+                        return (popcount(inter, axis=(-2, -1)),
+                                popcount(chunk, axis=(-2, -1)))
             else:
                 # Single output: the caller reuses it for both intersection
                 # and raw counts (one host fetch instead of two).
-                def run(chunk, filt):
-                    c = popcount(chunk, axis=(-2, -1))
-                    return c
+                if use_pallas:
+                    def run(chunk, filt):
+                        return pallas_kernels.bank_row_counts(chunk)
+                else:
+                    def run(chunk, filt):
+                        c = popcount(chunk, axis=(-2, -1))
+                        return c
             fn = jax.jit(run)
             self._jit_cache[key] = fn
         return fn

@@ -32,8 +32,11 @@ class ApiError(ValueError):
 class API:
     def __init__(self, holder: Holder, mesh=None, cluster=None,
                  stats=None, tracer=None):
+        from pilosa_tpu.utils.logger import Logger
         from pilosa_tpu.utils.stats import NopStatsClient
         from pilosa_tpu.utils.tracing import NopTracer
+        self.logger = Logger()
+        self._translate_negative: Dict[Any, set] = {}
         self.holder = holder
         self.executor = Executor(holder, mesh=mesh)
         self.cluster = cluster
@@ -109,7 +112,9 @@ class API:
         round trip to the primary and are adopted into the local store."""
         store = self._translate_store(index, field)
         keys = store.translate_ids([int(i) for i in ids])
-        missing = [int(i) for i, k in zip(ids, keys) if k is None]
+        neg = self._translate_negative.setdefault((index, field), set())
+        missing = [int(i) for i, k in zip(ids, keys)
+                   if k is None and int(i) not in neg]
         if not missing:
             return keys
         primary = self._translate_primary()
@@ -121,11 +126,19 @@ class API:
         try:
             res = self._client._req(
                 "POST", f"{primary.uri}/internal/translate/ids", body)
-        except Exception:
+            fetched = dict(zip(missing, res["keys"]))
+        except Exception as e:
+            self.logger.printf(
+                "translate-id fallback to primary %s failed: %r",
+                primary.uri, e)
             return keys
-        fetched = dict(zip(missing, res["keys"]))
         store.apply_entries((k, i) for i, k in fetched.items()
                             if k is not None)
+        # The primary is the allocator: an id it cannot resolve does not
+        # exist anywhere, so cache the miss (bounded) instead of re-asking
+        # on every query (raw-id imports into a keyed index hit this).
+        if len(neg) < 100_000:
+            neg.update(i for i, k in fetched.items() if k is None)
         return [k if k is not None else fetched.get(int(i))
                 for i, k in zip(ids, keys)]
 
