@@ -1,0 +1,375 @@
+// pilosa_native: C++ host-runtime kernels for the TPU-native Pilosa rebuild.
+//
+// Scope: the HOST storage hot path — the roaring file codec (reference format
+// writer/reader /root/reference/roaring/roaring.go:963-1126, cookie 12348),
+// ops-log replay (roaring.go:3628-3691), and packed-word popcount utilities.
+// The QUERY hot path lives on TPU (pilosa_tpu/ops); this library is what the
+// reference implements as Go hot loops for durability/import, rebuilt native.
+//
+// C ABI only (consumed via ctypes from pilosa_tpu/native.py). All multi-byte
+// integers in the file format are little-endian; this code assumes a
+// little-endian host (x86-64 / aarch64), as does the mmap path in the
+// reference.
+//
+// Build: see native/Makefile (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <vector>
+
+namespace {
+
+constexpr uint16_t kMagic = 12348;
+constexpr uint16_t kVersion = 0;
+constexpr int kContainerWords = 1024;   // 2^16 bits as uint64 words
+constexpr int kHeaderBaseSize = 8;
+
+constexpr uint16_t kTypeArray = 1;
+constexpr uint16_t kTypeBitmap = 2;
+constexpr uint16_t kTypeRun = 3;
+
+constexpr uint8_t kOpAdd = 0;
+constexpr uint8_t kOpRemove = 1;
+constexpr uint8_t kOpAddBatch = 2;
+constexpr uint8_t kOpRemoveBatch = 3;
+
+inline uint16_t ru16(const uint8_t* p) { uint16_t v; std::memcpy(&v, p, 2); return v; }
+inline uint32_t ru32(const uint8_t* p) { uint32_t v; std::memcpy(&v, p, 4); return v; }
+inline uint64_t ru64(const uint8_t* p) { uint64_t v; std::memcpy(&v, p, 8); return v; }
+inline void wu16(uint8_t* p, uint16_t v) { std::memcpy(p, &v, 2); }
+inline void wu32(uint8_t* p, uint32_t v) { std::memcpy(p, &v, 4); }
+inline void wu64(uint8_t* p, uint64_t v) { std::memcpy(p, &v, 8); }
+
+// fnv1a32 over the 9-byte op header (+ batch payload) — reference op
+// checksum, roaring.go:3628-3691.
+inline uint32_t fnv1a32(const uint8_t* data, size_t n, uint32_t h = 0x811C9DC5u) {
+  for (size_t i = 0; i < n; i++) { h ^= data[i]; h *= 0x01000193u; }
+  return h;
+}
+
+inline int popcount64(uint64_t x) { return __builtin_popcountll(x); }
+
+// A loaded bitmap: sorted (key, dense-words) pairs. Keys are the 48-bit
+// container keys; every container is held dense (1024 uint64 words), the
+// same representation the Python layer uses (storage/roaring.py docstring).
+struct LoadedBitmap {
+  std::vector<uint64_t> keys;
+  std::vector<uint64_t> words;  // keys.size() * kContainerWords
+  uint64_t op_n = 0;
+  char err[128] = {0};
+
+  int find(uint64_t key) const {
+    // Binary search over sorted keys.
+    size_t lo = 0, hi = keys.size();
+    while (lo < hi) {
+      size_t mid = (lo + hi) / 2;
+      if (keys[mid] < key) lo = mid + 1; else hi = mid;
+    }
+    return (lo < keys.size() && keys[lo] == key) ? static_cast<int>(lo) : -static_cast<int>(lo) - 1;
+  }
+
+  uint64_t* container(uint64_t key, bool create) {
+    int idx = find(key);
+    if (idx >= 0) return &words[static_cast<size_t>(idx) * kContainerWords];
+    if (!create) return nullptr;
+    size_t pos = static_cast<size_t>(-idx - 1);
+    keys.insert(keys.begin() + pos, key);
+    words.insert(words.begin() + pos * kContainerWords, kContainerWords, 0);
+    return &words[pos * kContainerWords];
+  }
+};
+
+bool fail(LoadedBitmap* bm, const char* msg) {
+  std::snprintf(bm->err, sizeof(bm->err), "%s", msg);
+  return false;
+}
+
+// Parse the snapshot section. Returns ops-log offset via *ops_offset.
+bool parse_snapshot(LoadedBitmap* bm, const uint8_t* data, size_t len,
+                    size_t* ops_offset) {
+  if (len < kHeaderBaseSize) return fail(bm, "data too small");
+  if (ru16(data) != kMagic) return fail(bm, "invalid roaring file magic");
+  if (ru16(data + 2) != kVersion) return fail(bm, "wrong roaring version");
+  uint32_t n = ru32(data + 4);
+  size_t meta_pos = kHeaderBaseSize;
+  size_t off_pos = meta_pos + 12ull * n;
+  size_t payload_start = off_pos + 4ull * n;
+  // Bounds the reserve below by the file size: a header-only file cannot
+  // legitimately claim more containers than its 16-bytes-per-entry header.
+  if (payload_start > len) return fail(bm, "truncated header");
+  bm->keys.reserve(n);
+  bm->words.reserve(static_cast<size_t>(n) * kContainerWords);
+  size_t ops = payload_start;
+  uint64_t prev_key = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    uint64_t key = ru64(data + meta_pos + 12ull * i);
+    uint16_t typ = ru16(data + meta_pos + 12ull * i + 8);
+    uint16_t card_m1 = ru16(data + meta_pos + 12ull * i + 10);
+    uint32_t offset = ru32(data + off_pos + 4ull * i);
+    if (offset >= len) return fail(bm, "container offset out of bounds");
+    if (i > 0 && key <= prev_key) return fail(bm, "container keys not sorted");
+    prev_key = key;
+    uint64_t dense[kContainerWords];
+    std::memset(dense, 0, sizeof(dense));
+    size_t end;
+    if (typ == kTypeArray) {
+      uint32_t card = static_cast<uint32_t>(card_m1) + 1;
+      end = offset + 2ull * card;
+      if (end > len) return fail(bm, "array container truncated");
+      for (uint32_t j = 0; j < card; j++) {
+        uint16_t v = ru16(data + offset + 2ull * j);
+        dense[v >> 6] |= 1ull << (v & 63);
+      }
+    } else if (typ == kTypeBitmap) {
+      end = offset + 8ull * kContainerWords;
+      if (end > len) return fail(bm, "bitmap container truncated");
+      std::memcpy(dense, data + offset, 8ull * kContainerWords);
+    } else if (typ == kTypeRun) {
+      if (offset + 2ull > len) return fail(bm, "run container truncated");
+      uint16_t run_n = ru16(data + offset);
+      end = offset + 2ull + 4ull * run_n;
+      if (end > len) return fail(bm, "run container truncated");
+      for (uint16_t j = 0; j < run_n; j++) {
+        uint16_t start = ru16(data + offset + 2 + 4ull * j);
+        uint16_t last = ru16(data + offset + 2 + 4ull * j + 2);
+        // Set bits [start, last] inclusive via word-granular masks.
+        int w0 = start >> 6, w1 = last >> 6;
+        for (int w = w0; w <= w1; w++) {
+          uint64_t m = ~0ull;
+          if (w == w0) m &= ~0ull << (start & 63);
+          if (w == w1) m &= ~0ull >> (63 - (last & 63));
+          dense[w] |= m;
+        }
+      }
+    } else {
+      return fail(bm, "unknown container type");
+    }
+    // Header cardinality is untrusted — the payload is authoritative, and
+    // empty containers are never materialized (storage/roaring.py parity).
+    bool any = false;
+    for (int w = 0; w < kContainerWords; w++) if (dense[w]) { any = true; break; }
+    if (any) {
+      bm->keys.push_back(key);
+      bm->words.insert(bm->words.end(), dense, dense + kContainerWords);
+    }
+    if (end > ops) ops = end;
+  }
+  *ops_offset = ops;
+  return true;
+}
+
+inline void bit_add(LoadedBitmap* bm, uint64_t pos) {
+  uint64_t* c = bm->container(pos >> 16, true);
+  c[(pos & 0xFFFF) >> 6] |= 1ull << (pos & 63);
+}
+
+inline void bit_remove(LoadedBitmap* bm, uint64_t pos) {
+  uint64_t* c = bm->container(pos >> 16, false);
+  if (c) c[(pos & 0xFFFF) >> 6] &= ~(1ull << (pos & 63));
+}
+
+bool replay_ops(LoadedBitmap* bm, const uint8_t* data, size_t len, size_t pos) {
+  while (pos < len) {
+    if (len - pos < 13) return fail(bm, "op data out of bounds");
+    uint8_t typ = data[pos];
+    uint64_t value = ru64(data + pos + 1);
+    uint32_t chk = ru32(data + pos + 9);
+    if (typ == kOpAdd || typ == kOpRemove) {
+      if (chk != fnv1a32(data + pos, 9)) return fail(bm, "op checksum mismatch");
+      if (typ == kOpAdd) bit_add(bm, value); else bit_remove(bm, value);
+      bm->op_n += 1;
+      pos += 13;
+    } else if (typ == kOpAddBatch || typ == kOpRemoveBatch) {
+      // Guard 8*value overflow before computing the record size.
+      if (value > (len - pos - 13) / 8) return fail(bm, "op data truncated");
+      size_t size = 13 + 8ull * value;
+      uint32_t h = fnv1a32(data + pos, 9);
+      h = fnv1a32(data + pos + 13, 8ull * value, h);
+      if (chk != h) return fail(bm, "op checksum mismatch");
+      for (uint64_t j = 0; j < value; j++) {
+        uint64_t v = ru64(data + pos + 13 + 8 * j);
+        if (typ == kOpAddBatch) bit_add(bm, v); else bit_remove(bm, v);
+      }
+      bm->op_n += value;
+      pos += size;
+    } else {
+      return fail(bm, "invalid op type");
+    }
+  }
+  return true;
+}
+
+// Drop containers emptied by remove ops.
+void drop_empty(LoadedBitmap* bm) {
+  size_t out = 0;
+  for (size_t i = 0; i < bm->keys.size(); i++) {
+    const uint64_t* c = &bm->words[i * kContainerWords];
+    bool any = false;
+    for (int w = 0; w < kContainerWords; w++) if (c[w]) { any = true; break; }
+    if (any) {
+      if (out != i) {
+        bm->keys[out] = bm->keys[i];
+        std::memmove(&bm->words[out * kContainerWords], c,
+                     8ull * kContainerWords);
+      }
+      out++;
+    }
+  }
+  bm->keys.resize(out);
+  bm->words.resize(out * kContainerWords);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- load path
+
+// Parse a full roaring file (snapshot + ops log). Returns an opaque handle,
+// or nullptr on allocation failure; check rb_error() for parse errors (a
+// non-null handle with a non-empty error is a failed parse).
+void* rb_load(const uint8_t* data, uint64_t len) {
+  auto* bm = new (std::nothrow) LoadedBitmap();
+  if (!bm) return nullptr;
+  try {
+    size_t ops_offset = 0;
+    if (parse_snapshot(bm, data, len, &ops_offset)) {
+      if (replay_ops(bm, data, len, ops_offset)) drop_empty(bm);
+    }
+  } catch (const std::bad_alloc&) {
+    // Vector growth during parse/replay must not throw across the C ABI.
+    fail(bm, "out of memory");
+  }
+  return bm;
+}
+
+const char* rb_error(void* h) { return static_cast<LoadedBitmap*>(h)->err; }
+uint64_t rb_container_count(void* h) { return static_cast<LoadedBitmap*>(h)->keys.size(); }
+uint64_t rb_op_count(void* h) { return static_cast<LoadedBitmap*>(h)->op_n; }
+
+// Copy out the sorted container keys (caller allocates rb_container_count
+// u64s) and the dense payload (count * 1024 u64s, key-major).
+void rb_copy_out(void* h, uint64_t* keys_out, uint64_t* words_out) {
+  auto* bm = static_cast<LoadedBitmap*>(h);
+  std::memcpy(keys_out, bm->keys.data(), 8 * bm->keys.size());
+  std::memcpy(words_out, bm->words.data(), 8 * bm->words.size());
+}
+
+void rb_free(void* h) { delete static_cast<LoadedBitmap*>(h); }
+
+// --------------------------------------------------------------- save path
+
+// Serialize n dense containers (sorted keys[n], words[n*1024]) into the
+// reference file format, picking the smallest of array/bitmap/run per
+// container (the Optimize rule, roaring.go:1745-1805). `out` must have
+// capacity rb_serialize_cap(n). Returns bytes written, or 0 on bad input.
+uint64_t rb_serialize_cap(uint64_t n) {
+  return kHeaderBaseSize + n * (12 + 4 + 8ull * kContainerWords);
+}
+
+uint64_t rb_serialize(const uint64_t* keys, const uint64_t* words, uint64_t n,
+                      uint8_t* out) {
+  wu16(out, kMagic);
+  wu16(out + 2, kVersion);
+  wu32(out + 4, static_cast<uint32_t>(n));
+  size_t meta_pos = kHeaderBaseSize;
+  size_t off_pos = meta_pos + 12ull * n;
+  size_t payload = off_pos + 4ull * n;
+  for (uint64_t i = 0; i < n; i++) {
+    const uint64_t* dense = words + i * kContainerWords;
+    // One pass: cardinality + run count (runs = number of 0→1 transitions
+    // across the 2^16-bit container, counting bit -1 as 0).
+    int card = 0, runs = 0;
+    uint64_t prev_msb = 0;
+    for (int w = 0; w < kContainerWords; w++) {
+      uint64_t x = dense[w];
+      card += popcount64(x);
+      // starts-of-runs in this word: bits set where x has 1 and the
+      // previous bit (within word, shifted in from prev word's msb) is 0.
+      uint64_t prev_bits = (x << 1) | prev_msb;
+      runs += popcount64(x & ~prev_bits);
+      prev_msb = x >> 63;
+    }
+    if (card == 0) return 0;  // caller must pre-filter empty containers
+    size_t run_size = 2 + 4ull * runs;
+    size_t array_size = 2ull * card;
+    uint16_t typ;
+    size_t psize;
+    if (run_size < array_size && run_size < 8192) { typ = kTypeRun; psize = run_size; }
+    else if (array_size < 8192) { typ = kTypeArray; psize = array_size; }
+    else { typ = kTypeBitmap; psize = 8192; }
+    // Descriptive header + offset header.
+    wu64(out + meta_pos + 12 * i, keys[i]);
+    wu16(out + meta_pos + 12 * i + 8, typ);
+    wu16(out + meta_pos + 12 * i + 10, static_cast<uint16_t>(card - 1));
+    wu32(out + off_pos + 4 * i, static_cast<uint32_t>(payload));
+    // Payload.
+    uint8_t* p = out + payload;
+    if (typ == kTypeBitmap) {
+      std::memcpy(p, dense, 8192);
+    } else if (typ == kTypeArray) {
+      size_t j = 0;
+      for (int w = 0; w < kContainerWords; w++) {
+        uint64_t x = dense[w];
+        while (x) {
+          int b = __builtin_ctzll(x);
+          wu16(p + 2 * j++, static_cast<uint16_t>((w << 6) | b));
+          x &= x - 1;
+        }
+      }
+    } else {  // run
+      wu16(p, static_cast<uint16_t>(runs));
+      size_t j = 0;
+      int start = -1;
+      for (int bit = 0; bit < (kContainerWords << 6); bit++) {
+        bool set = (dense[bit >> 6] >> (bit & 63)) & 1;
+        if (set && start < 0) start = bit;
+        if (!set && start >= 0) {
+          wu16(p + 2 + 4 * j, static_cast<uint16_t>(start));
+          wu16(p + 2 + 4 * j + 2, static_cast<uint16_t>(bit - 1));
+          j++;
+          start = -1;
+        }
+      }
+      if (start >= 0) {
+        wu16(p + 2 + 4 * j, static_cast<uint16_t>(start));
+        wu16(p + 2 + 4 * j + 2, static_cast<uint16_t>((kContainerWords << 6) - 1));
+        j++;
+      }
+    }
+    payload += psize;
+  }
+  return payload;
+}
+
+// ----------------------------------------------------------- word kernels
+
+// Total popcount over n packed words (host-side Count / CPU baseline).
+uint64_t pn_popcount(const uint64_t* words, uint64_t n) {
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n; i++) total += popcount64(words[i]);
+  return total;
+}
+
+// popcount(a & b) over n words — the host analog of the reference's
+// intersectionCountBitmapBitmap hot loop (roaring.go:2438).
+uint64_t pn_intersection_count(const uint64_t* a, const uint64_t* b, uint64_t n) {
+  uint64_t total = 0;
+  for (uint64_t i = 0; i < n; i++) total += popcount64(a[i] & b[i]);
+  return total;
+}
+
+// Per-row popcount: words is [rows, words_per_row] row-major; out[rows].
+void pn_row_popcounts(const uint64_t* words, uint64_t rows,
+                      uint64_t words_per_row, uint64_t* out) {
+  for (uint64_t r = 0; r < rows; r++) {
+    const uint64_t* row = words + r * words_per_row;
+    uint64_t total = 0;
+    for (uint64_t i = 0; i < words_per_row; i++) total += popcount64(row[i]);
+    out[r] = total;
+  }
+}
+
+}  // extern "C"

@@ -117,6 +117,12 @@ class TranslateStore:
                     raise ValueError(
                         f"translate conflict for {key!r}: {cur} != {id_}")
 
+    def size(self) -> int:
+        """Number of allocated (key, id) entries (cheap; used to version
+        negative reverse-lookup caches)."""
+        with self._lock:
+            return len(self._ids)
+
     def entries(self) -> List[tuple]:
         with self._lock:
             return sorted(self._ids.items(), key=lambda kv: kv[1])

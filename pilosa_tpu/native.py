@@ -1,0 +1,181 @@
+"""ctypes bindings for the native C++ host-runtime library.
+
+The library (native/pilosa_native.cpp) implements the host storage hot
+path — roaring file parse/serialize (reference roaring.go:963-1126) with
+ops-log replay (roaring.go:3628-3691), and packed-word popcount kernels
+(the host analog of roaring.go:2438's intersection-count loop).
+
+The Python implementations in storage/roaring.py remain the reference
+semantics and the fallback: if the shared library is missing it is built
+on first import with `make` (g++ is in the image); if that fails, callers
+get None from load() and use the numpy paths. Set PILOSA_TPU_NO_NATIVE=1
+to force the fallback (used by tests to cross-check both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libpilosa_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+CONTAINER_WORDS = 1024
+
+
+def _build() -> bool:
+    if not os.path.isdir(_NATIVE_DIR):
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO_PATH)
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u64 = ctypes.c_uint64
+    p_u8 = ctypes.POINTER(ctypes.c_uint8)
+    p_u64 = ctypes.POINTER(u64)
+    lib.rb_load.argtypes = [p_u8, u64]
+    lib.rb_load.restype = ctypes.c_void_p
+    lib.rb_error.argtypes = [ctypes.c_void_p]
+    lib.rb_error.restype = ctypes.c_char_p
+    lib.rb_container_count.argtypes = [ctypes.c_void_p]
+    lib.rb_container_count.restype = u64
+    lib.rb_op_count.argtypes = [ctypes.c_void_p]
+    lib.rb_op_count.restype = u64
+    lib.rb_copy_out.argtypes = [ctypes.c_void_p, p_u64, p_u64]
+    lib.rb_free.argtypes = [ctypes.c_void_p]
+    lib.rb_serialize_cap.argtypes = [u64]
+    lib.rb_serialize_cap.restype = u64
+    lib.rb_serialize.argtypes = [p_u64, p_u64, u64, p_u8]
+    lib.rb_serialize.restype = u64
+    lib.pn_popcount.argtypes = [p_u64, u64]
+    lib.pn_popcount.restype = u64
+    lib.pn_intersection_count.argtypes = [p_u64, p_u64, u64]
+    lib.pn_intersection_count.restype = u64
+    lib.pn_row_popcounts.argtypes = [p_u64, u64, u64, p_u64]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Return the bound native library, building it if needed; None if
+    unavailable (missing toolchain) or disabled via PILOSA_TPU_NO_NATIVE."""
+    global _lib, _tried
+    if os.environ.get("PILOSA_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO_PATH) and not _build():
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO_PATH))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _as_u64_ptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def _as_u8_ptr(buf) -> "ctypes.POINTER(ctypes.c_uint8)":
+    return ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
+
+
+class NativeParseError(ValueError):
+    pass
+
+
+def roaring_load(data: bytes) -> Optional[Tuple[List[int], np.ndarray, int]]:
+    """Parse a roaring file (snapshot + ops log) natively.
+
+    Returns (sorted container keys, dense words [n, 1024] uint64, op count),
+    or None when the native library is unavailable. Raises NativeParseError
+    on malformed input (same conditions as the Python reader)."""
+    lib = load()
+    if lib is None:
+        return None
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+    h = lib.rb_load(buf, len(data))
+    if not h:
+        raise MemoryError("rb_load allocation failed")
+    try:
+        err = lib.rb_error(h)
+        if err:
+            raise NativeParseError(err.decode())
+        n = lib.rb_container_count(h)
+        keys = np.empty(n, dtype=np.uint64)
+        words = np.empty((n, CONTAINER_WORDS), dtype=np.uint64)
+        if n:
+            lib.rb_copy_out(h, _as_u64_ptr(keys), _as_u64_ptr(words))
+        return [int(k) for k in keys], words, int(lib.rb_op_count(h))
+    finally:
+        lib.rb_free(h)
+
+
+def roaring_serialize(keys: np.ndarray, words: np.ndarray) -> Optional[bytes]:
+    """Serialize sorted non-empty dense containers to the file format.
+    keys: uint64[n]; words: uint64[n, 1024]. None when unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(keys)
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    words = np.ascontiguousarray(words, dtype=np.uint64)
+    cap = lib.rb_serialize_cap(n)
+    out = (ctypes.c_uint8 * cap)()
+    size = lib.rb_serialize(_as_u64_ptr(keys), _as_u64_ptr(words), n,
+                            _as_u8_ptr(out))
+    if size == 0 and n > 0:
+        raise ValueError("rb_serialize: empty container passed")
+    return bytes(bytearray(out)[:size])
+
+
+def popcount(words: np.ndarray) -> Optional[int]:
+    lib = load()
+    if lib is None:
+        return None
+    words = np.ascontiguousarray(words, dtype=np.uint64)
+    return int(lib.pn_popcount(_as_u64_ptr(words), words.size))
+
+
+def intersection_count(a: np.ndarray, b: np.ndarray) -> Optional[int]:
+    lib = load()
+    if lib is None:
+        return None
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    b = np.ascontiguousarray(b, dtype=np.uint64)
+    assert a.size == b.size
+    return int(lib.pn_intersection_count(_as_u64_ptr(a), _as_u64_ptr(b),
+                                         a.size))
+
+
+def row_popcounts(words: np.ndarray) -> Optional[np.ndarray]:
+    """words: uint64[rows, words_per_row] → uint64[rows] popcounts."""
+    lib = load()
+    if lib is None:
+        return None
+    words = np.ascontiguousarray(words, dtype=np.uint64)
+    rows, wpr = words.shape
+    out = np.empty(rows, dtype=np.uint64)
+    lib.pn_row_popcounts(_as_u64_ptr(words), rows, wpr, _as_u64_ptr(out))
+    return out

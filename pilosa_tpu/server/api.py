@@ -112,7 +112,16 @@ class API:
         round trip to the primary and are adopted into the local store."""
         store = self._translate_store(index, field)
         keys = store.translate_ids([int(i) for i in ids])
-        neg = self._translate_negative.setdefault((index, field), set())
+        # The negative cache is only valid for the store state it was
+        # built against: any local growth (write, replication catch-up,
+        # adoption below) may have allocated a previously-missing id, so
+        # drop the cache and re-ask the primary once.
+        size = store.size()
+        cached_size, neg = self._translate_negative.get(
+            (index, field), (-1, set()))
+        if cached_size != size:
+            neg = set()
+            self._translate_negative[(index, field)] = (size, neg)
         missing = [int(i) for i, k in zip(ids, keys)
                    if k is None and int(i) not in neg]
         if not missing:
@@ -139,6 +148,9 @@ class API:
         # on every query (raw-id imports into a keyed index hit this).
         if len(neg) < 100_000:
             neg.update(i for i, k in fetched.items() if k is None)
+        # Re-version against the post-adoption store size so the adoption
+        # itself doesn't invalidate the misses just cached.
+        self._translate_negative[(index, field)] = (store.size(), neg)
         return [k if k is not None else fetched.get(int(i))
                 for i, k in zip(ids, keys)]
 

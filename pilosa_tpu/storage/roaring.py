@@ -36,6 +36,8 @@ from typing import Dict, Iterable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from pilosa_tpu import native
+
 MAGIC_NUMBER = 12348
 STORAGE_VERSION = 0
 COOKIE = MAGIC_NUMBER | (STORAGE_VERSION << 16)
@@ -480,8 +482,18 @@ class Bitmap:
     # -- serialization ------------------------------------------------------
 
     def write_bytes(self) -> bytes:
-        """Serialize in the reference's file format (roaring.go:963)."""
+        """Serialize in the reference's file format (roaring.go:963).
+        Uses the native C++ codec (native/pilosa_native.cpp rb_serialize)
+        when available; the Python path below is the reference semantics
+        and produces byte-identical output."""
         keys = [k for k in sorted(self.containers) if self.container_count(k) > 0]
+        if native.available():
+            nk = np.array(keys, dtype=np.uint64)
+            nw = (np.stack([self.containers[k] for k in keys])
+                  if keys else np.empty((0, CONTAINER_WORDS), dtype=np.uint64))
+            out = native.roaring_serialize(nk, nw)
+            if out is not None:
+                return out
         n = len(keys)
         header = io.BytesIO()
         header.write(struct.pack("<II", COOKIE, n))
@@ -523,6 +535,15 @@ class Bitmap:
         return b
 
     def read_bytes(self, data: bytes) -> None:
+        if native.available():
+            loaded = native.roaring_load(bytes(data))
+            if loaded is not None:
+                keys, words, op_n = loaded
+                self.containers = {k: words[i].copy()
+                                   for i, k in enumerate(keys)}
+                self._counts = {}
+                self.op_n = op_n
+                return
         if len(data) < HEADER_BASE_SIZE:
             raise ValueError("data too small")
         magic, version = struct.unpack_from("<HH", data, 0)

@@ -1,0 +1,128 @@
+"""Native C++ host-runtime library (native/pilosa_native.cpp) tests.
+
+Cross-checks the native roaring codec against the pure-Python reference
+semantics in storage/roaring.py: identical parse results, byte-identical
+serialization, identical error behavior on corrupt input."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import native
+from pilosa_tpu.storage.roaring import (
+    Bitmap, encode_op, OP_ADD, OP_ADD_BATCH, OP_REMOVE, OP_REMOVE_BATCH,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable")
+
+
+def _python_bitmap(data: bytes) -> Bitmap:
+    """Force the pure-Python reader regardless of native availability."""
+    b = Bitmap.__new__(Bitmap)
+    b.__init__()
+    avail = native.available
+    native.available = lambda: False
+    try:
+        b.read_bytes(data)
+    finally:
+        native.available = avail
+    return b
+
+
+def _mixed_bitmap() -> Bitmap:
+    rng = np.random.default_rng(7)
+    b = Bitmap()
+    # array container
+    b.add_batch(rng.choice(1 << 16, 300, replace=False).astype(np.uint64))
+    # bitmap container
+    b.add_batch((1 << 16) + rng.choice(1 << 16, 50000,
+                                       replace=False).astype(np.uint64))
+    # run container
+    b.add_batch(np.arange(5 << 16, (5 << 16) + 20000, dtype=np.uint64))
+    # full container (cardinality 65536 → card-1 wraps to uint16 max)
+    b.add_batch(np.arange(9 << 16, 10 << 16, dtype=np.uint64))
+    return b
+
+
+def test_native_parse_matches_python():
+    data = _mixed_bitmap().write_bytes()
+    keys, words, op_n = native.roaring_load(data)
+    pb = _python_bitmap(data)
+    assert keys == sorted(pb.containers)
+    assert op_n == 0
+    for i, k in enumerate(keys):
+        assert np.array_equal(words[i], pb.containers[k])
+
+
+def test_native_serialize_byte_identical():
+    b = _mixed_bitmap()
+    keys = sorted(b.containers)
+    nk = np.array(keys, dtype=np.uint64)
+    nw = np.stack([b.containers[k] for k in keys])
+    avail = native.available
+    native.available = lambda: False
+    try:
+        python_bytes = b.write_bytes()
+    finally:
+        native.available = avail
+    assert native.roaring_serialize(nk, nw) == python_bytes
+
+
+def test_native_ops_replay():
+    b = _mixed_bitmap()
+    data = b.write_bytes()
+    data += encode_op(OP_ADD, (20 << 16) + 5)
+    data += encode_op(OP_ADD_BATCH,
+                      values=np.array([1, 2, (21 << 16) + 3], dtype=np.uint64))
+    data += encode_op(OP_REMOVE, (20 << 16) + 5)
+    data += encode_op(OP_REMOVE_BATCH, values=np.array([2], dtype=np.uint64))
+    keys, words, op_n = native.roaring_load(data)
+    pb = _python_bitmap(data)
+    assert op_n == 6  # 1 add + 3 batch-adds + 1 remove + 1 batch-remove
+    assert keys == sorted(pb.containers)
+    for i, k in enumerate(keys):
+        assert np.array_equal(words[i], pb.containers[k])
+    # container 20<<16 emptied by the remove op must not be materialized
+    assert (20 << 16) >> 16 not in keys
+
+
+def test_native_rejects_bad_magic():
+    with pytest.raises(ValueError, match="magic"):
+        native.roaring_load(struct.pack("<HHI", 999, 0, 0))
+
+
+def test_native_rejects_corrupt_op_checksum():
+    data = Bitmap([1, 2, 3]).write_bytes()
+    op = bytearray(encode_op(OP_ADD, 42))
+    op[9] ^= 0xFF  # flip a checksum byte
+    with pytest.raises(ValueError, match="checksum"):
+        native.roaring_load(data + bytes(op))
+
+
+def test_native_empty_bitmap_roundtrip():
+    data = Bitmap().write_bytes()
+    keys, words, op_n = native.roaring_load(data)
+    assert keys == [] and words.shape == (0, 1024) and op_n == 0
+
+
+def test_popcount_kernels_match_numpy():
+    rng = np.random.default_rng(3)
+    a = rng.integers(0, 2**63, 2048, dtype=np.uint64)
+    b = rng.integers(0, 2**63, 2048, dtype=np.uint64)
+    assert native.popcount(a) == int(np.bitwise_count(a).sum())
+    assert native.intersection_count(a, b) == \
+        int(np.bitwise_count(a & b).sum())
+    rows = a.reshape(8, -1)
+    assert np.array_equal(native.row_popcounts(rows),
+                          np.bitwise_count(rows).sum(axis=1))
+
+
+def test_bitmap_roundtrip_through_native_paths():
+    """Full loop: Python-built bitmap → native serialize → native parse."""
+    b = _mixed_bitmap()
+    b2 = Bitmap.from_bytes(b.write_bytes())
+    assert sorted(b.containers) == sorted(b2.containers)
+    assert b.count() == b2.count()
+    assert np.array_equal(b.slice(), b2.slice())
