@@ -2,8 +2,9 @@
 
 Reference: /root/reference/http/client.go (InternalClient — query fan-out
 :241, imports :439, fragment streaming :711, block sync :811-901) and the
-interface /root/reference/client.go:32. JSON bodies instead of protobuf
-(matching this rebuild's HTTP layer); roaring payloads stay raw bytes.
+interface /root/reference/client.go:32. Bodies and responses use the
+binary wire codec (server/wire.py, the analog of the reference's protobuf
+Serializer) with JSON fallback; roaring payloads stay raw bytes.
 """
 
 from __future__ import annotations
@@ -12,6 +13,8 @@ import json
 import urllib.error
 import urllib.request
 from typing import Any, Dict, List, Optional
+
+from pilosa_tpu.server import wire
 
 
 class ClientError(RuntimeError):
@@ -24,8 +27,22 @@ class InternalClient:
         self.tracer = tracer
 
     def _req(self, method: str, url: str, body: Optional[bytes] = None,
-             raw: bool = False):
-        headers = {"Content-Type": "application/json"}
+             raw: bool = False, obj=None):
+        """One internal request. `obj` bodies and non-raw responses use the
+        binary wire codec (server/wire.py — the rebuild's analog of the
+        reference's protobuf Serializer, encoding/proto/proto.go:29);
+        JSON stays the fallback for older peers."""
+        if obj is not None:
+            try:
+                body = wire.dumps(obj)
+                headers = {"Content-Type": wire.CONTENT_TYPE}
+            except TypeError:  # e.g. >64-bit int — JSON handles it
+                body = json.dumps(obj).encode("utf-8")
+                headers = {"Content-Type": "application/json"}
+        else:
+            headers = {"Content-Type": "application/json"}
+        if not raw:
+            headers["Accept"] = f"{wire.CONTENT_TYPE}, application/json"
         if self.tracer is not None:
             self.tracer.inject(headers)
         req = urllib.request.Request(url, data=body, method=method,
@@ -33,7 +50,12 @@ class InternalClient:
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 payload = resp.read()
-                return payload if raw else json.loads(payload or b"{}")
+                if raw:
+                    return payload
+                if (resp.headers.get("Content-Type") or "").startswith(
+                        wire.CONTENT_TYPE):
+                    return wire.loads(payload)
+                return json.loads(payload or b"{}")
         except urllib.error.HTTPError as e:
             detail = e.read().decode("utf-8", "replace")[:500]
             raise ClientError(f"{method} {url}: {e.code}: {detail}") from e
@@ -56,7 +78,7 @@ class InternalClient:
                     body: Dict[str, Any], clear: bool = False) -> None:
         suffix = "?clear=1&remote=true" if clear else "?remote=true"
         self._req("POST", f"{uri}/index/{index}/field/{field}/import{suffix}",
-                  json.dumps(body).encode())
+                  obj=body)
 
     def import_roaring_node(self, uri: str, index: str, field: str,
                             shard: int, data: bytes,
@@ -103,17 +125,15 @@ class InternalClient:
         )["views"]
 
     def join(self, uri: str, node: dict) -> dict:
-        return self._req("POST", f"{uri}/internal/join",
-                         json.dumps(node).encode())
+        return self._req("POST", f"{uri}/internal/join", obj=node)
 
     def cluster_message(self, uri: str, message: dict) -> None:
-        self._req("POST", f"{uri}/internal/cluster/message",
-                  json.dumps(message).encode())
+        self._req("POST", f"{uri}/internal/cluster/message", obj=message)
 
     def create_index_node(self, uri: str, index: str, options: dict) -> None:
         try:
             self._req("POST", f"{uri}/index/{index}?remote=true",
-                      json.dumps({"options": options}).encode())
+                      obj={"options": options})
         except ClientError as e:
             if "409" not in str(e):
                 raise
@@ -123,7 +143,7 @@ class InternalClient:
         try:
             self._req("POST", f"{uri}/index/{index}/field/{field}"
                               f"?remote=true",
-                      json.dumps({"options": options}).encode())
+                      obj={"options": options})
         except ClientError as e:
             if "409" not in str(e):
                 raise

@@ -36,6 +36,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+from pilosa_tpu.server import wire
 from pilosa_tpu.server.api import API, ApiError
 
 
@@ -50,10 +51,24 @@ class Handler(BaseHTTPRequestHandler):
         if logger is not None:
             logger.debugf(fmt % args)
 
-    def _json(self, obj: Any, status: int = 200) -> None:
-        body = json.dumps(obj).encode("utf-8")
+    def _json(self, obj: Any, status: int = 200,
+              force_json: bool = False) -> None:
+        # Content negotiation (reference http/handler.go:447-489 protobuf
+        # vs JSON): internal clients ask for the binary wire codec via
+        # Accept; JSON is the public surface and the default.
+        body = None
+        if not force_json and wire.CONTENT_TYPE in (
+                self.headers.get("Accept") or ""):
+            try:
+                body = wire.dumps(obj)
+                ctype = wire.CONTENT_TYPE
+            except TypeError:
+                body = None  # e.g. >64-bit int — JSON handles it
+        if body is None:
+            body = json.dumps(obj).encode("utf-8")
+            ctype = "application/json"
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -67,7 +82,7 @@ class Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def _error(self, msg: str, status: int = 400) -> None:
-        self._json({"error": msg}, status)
+        self._json({"error": msg}, status, force_json=True)
 
     def _body(self) -> bytes:
         n = int(self.headers.get("Content-Length") or 0)
@@ -77,6 +92,12 @@ class Handler(BaseHTTPRequestHandler):
         raw = self._body()
         if not raw:
             return {}
+        if (self.headers.get("Content-Type") or "").startswith(
+                wire.CONTENT_TYPE):
+            try:
+                return wire.loads(raw)
+            except wire.WireError as e:
+                raise ApiError(f"invalid wire body: {e}")
         try:
             return json.loads(raw)
         except json.JSONDecodeError as e:

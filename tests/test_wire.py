@@ -1,0 +1,98 @@
+"""Binary wire codec (server/wire.py) tests: roundtrip fidelity with the
+JSON shapes the HTTP layer speaks, bulk integer packing, error handling."""
+
+import json
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.server import wire
+
+
+@pytest.mark.parametrize("v", [
+    None, True, False, 0, -1, 42, 2**62, -(2**62), 3.5, -0.0,
+    "", "héllo", b"", b"\x00\xffraw",
+    [], [1, 2, 3], [0, 2**20, 2**40], list(range(1000)),
+    [-5, 7, -9], ["a", 1, None, True],
+    {}, {"a": 1}, {"results": [{"columns": [1, 2, 3], "attrs": {"x": "y"}}]},
+    {"rows": [1, 1, 2], "columns": [5, 6, 7], "shard": 0},
+    [{"id": 3, "count": 2}, {"id": 4, "count": 1}],
+    {"nested": {"deep": [[1], [2, 3], []]}},
+])
+def test_roundtrip(v):
+    assert wire.loads(wire.dumps(v)) == v
+
+
+def test_u64_range_values():
+    big = 2**64 - 1
+    assert wire.loads(wire.dumps(big)) == big
+    assert wire.loads(wire.dumps([big, 1])) == [big, 1]
+    assert wire.loads(wire.dumps([big])) == [big]  # 1-elem list stays a list
+
+
+def test_numpy_arrays_decode_to_lists():
+    a = np.array([1, 5, 9], dtype=np.uint64)
+    assert wire.loads(wire.dumps(a)) == [1, 5, 9]
+    b = np.array([-3, 0, 3], dtype=np.int32)
+    assert wire.loads(wire.dumps(b)) == [-3, 0, 3]
+    assert wire.loads(wire.dumps({"columns": a})) == {"columns": [1, 5, 9]}
+
+
+def test_matches_json_semantics_on_query_response():
+    resp = {"results": [{"columns": list(range(500)),
+                         "keys": [str(i) for i in range(3)]},
+                        [{"id": 1, "count": 9}],
+                        7,
+                        {"value": -12, "count": 4},
+                        True]}
+    assert wire.loads(wire.dumps(resp)) == json.loads(json.dumps(resp))
+
+
+def test_bool_first_list_uses_generic_path():
+    assert wire.loads(wire.dumps([True, False])) == [True, False]
+
+
+def test_bad_magic_rejected():
+    with pytest.raises(wire.WireError):
+        wire.loads(b"nope")
+    with pytest.raises(wire.WireError):
+        wire.loads(b"")
+
+
+def test_truncated_rejected():
+    data = wire.dumps({"columns": list(range(100))})
+    with pytest.raises(wire.WireError):
+        wire.loads(data[:-5])
+
+
+def test_trailing_bytes_rejected():
+    with pytest.raises(wire.WireError):
+        wire.loads(wire.dumps(1) + b"x")
+
+
+def test_mixed_numeric_lists_round_trip_exactly():
+    assert wire.loads(wire.dumps([1, 2.5])) == [1, 2.5]
+    assert wire.loads(wire.dumps([1, True])) == [1, True]
+    assert wire.loads(wire.dumps([0, None, 3])) == [0, None, 3]
+
+
+def test_oversize_int_raises_typeerror():
+    with pytest.raises(TypeError):
+        wire.dumps(1 << 70)
+    with pytest.raises(TypeError):
+        wire.dumps(-(1 << 63) - 1)
+
+
+def test_truncated_headers_raise_wireerror():
+    for bad in (b"PW1\x00\x07", b"PW1\x00\x08\x01\x00\x00",
+                b"PW1\x00\x03\x01", b"PW1\x00\x05\xff\xff\xff\xff"):
+        with pytest.raises(wire.WireError):
+            wire.loads(bad)
+
+
+def test_bulk_packing_is_compact():
+    cols = list(range(100_000))
+    w = wire.dumps({"columns": cols})
+    j = json.dumps({"columns": cols}).encode()
+    assert len(w) < len(j) * 1.5  # 8B/int vs ~6.9B avg JSON digits+comma
+    assert wire.loads(w) == {"columns": cols}
