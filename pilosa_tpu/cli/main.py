@@ -67,6 +67,18 @@ def cmd_server(args) -> int:
     api = API(holder, mesh=mesh, cluster=cluster, stats=stats,
               tracer=RecordingTracer())
     api.logger = logger
+    api.long_query_time = cfg.long_query_time
+    from pilosa_tpu.utils.diagnostics import (
+        DiagnosticsCollector, RuntimeMonitor,
+    )
+    diagnostics = DiagnosticsCollector(
+        url=cfg.diagnostics_url, interval=cfg.diagnostics_interval,
+        holder=holder, logger=logger)
+    diagnostics.start()
+    runtime_monitor = None
+    if cfg.metric_service != "none" and cfg.metric_poll_interval > 0:
+        runtime_monitor = RuntimeMonitor(stats, cfg.metric_poll_interval)
+        runtime_monitor.start()
     anti_entropy = None
     if cluster is not None and cfg.anti_entropy_interval > 0:
         from pilosa_tpu.parallel.syncer import AntiEntropyLoop
@@ -81,6 +93,9 @@ def cmd_server(args) -> int:
     finally:
         if anti_entropy is not None:
             anti_entropy.stop()
+        diagnostics.stop()
+        if runtime_monitor is not None:
+            runtime_monitor.stop()
         holder.close()
     return 0
 

@@ -9,6 +9,7 @@ call this.
 
 from __future__ import annotations
 
+import time as _time
 from datetime import datetime
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -42,6 +43,7 @@ class API:
         self.cluster = cluster
         self.stats = stats or NopStatsClient()
         self.tracer = tracer or NopTracer()
+        self.long_query_time = 0.0  # seconds; 0 disables slow-query logs
         self.cluster_executor = None
         self.syncer = None
         self.resize_puller = None
@@ -163,6 +165,20 @@ class API:
         response {"results": [...]}. `remote=True` marks a node-to-node
         sub-query: execute locally only, no re-fan-out (the reference's
         opt.Remote, executor.go:2236)."""
+        t0 = _time.perf_counter()
+        try:
+            return self._query(index, query, shards, remote)
+        finally:
+            # Slow-query logging (reference api.LongQueryTime api.go:1048,
+            # enforced per request in http/handler.go:300-306).
+            dur = _time.perf_counter() - t0
+            if self.long_query_time > 0 and dur > self.long_query_time:
+                self.logger.printf("%.3fs SLOW QUERY [%s] %r",
+                                   dur, index, query)
+
+    def _query(self, index: str, query: str,
+               shards: Optional[Sequence[int]] = None,
+               remote: bool = False) -> Dict[str, Any]:
         with self.tracer.span("API.Query", index=index):
             self.stats.count("query", 1)
             if remote:

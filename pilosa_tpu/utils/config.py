@@ -30,6 +30,11 @@ class Config:
     anti_entropy_interval: float = 600.0
     # Metrics
     metric_service: str = "mem"   # mem | none
+    metric_poll_interval: float = 10.0  # runtime gauge sampling; 0 off
+    # Diagnostics phone-home (reference server/config.go:105; OFF unless
+    # both an interval and an endpoint URL are configured)
+    diagnostics_interval: float = 0.0
+    diagnostics_url: str = ""
     # Cluster: static peer URI list (must include this node's own URI) +
     # replication factor (reference cluster.replicas, server/config.go:63)
     cluster_peers: list = field(default_factory=list)

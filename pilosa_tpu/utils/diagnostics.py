@@ -1,0 +1,174 @@
+"""Diagnostics (phone-home) and runtime monitoring.
+
+Reference: /root/reference/diagnostics.go:42-263 (diagnosticsCollector —
+periodic JSON POST of version/OS/CPU/memory/schema-shape plus a version
+check against the latest release) driven by server.go:675-724, and the
+runtime monitor loop server.go:726-770 (goroutine/heap/open-FD gauges on
+GC notifications, gcnotify/gcnotify.go:30).
+
+Rebuild divergences: reporting is OFF unless an interval AND endpoint are
+configured (the reference defaults to pilosa.com; this build runs in
+zero-egress environments, so the default must be inert), and the runtime
+monitor samples on a plain timer — Python exposes gc stats without a
+GC-notify channel."""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import platform
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, Optional
+
+from pilosa_tpu import __version__
+
+
+class DiagnosticsCollector:
+    """Periodic anonymous usage report (reference diagnosticsCollector,
+    diagnostics.go:42). `set(...)` accumulates fields; `flush()` POSTs
+    them; `start()` runs flush on an interval. Inert without an URL."""
+
+    def __init__(self, url: str = "", interval: float = 0.0,
+                 holder=None, logger=None):
+        self.url = url
+        self.interval = interval
+        self.holder = holder
+        self.logger = logger
+        self._fields: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.server_version: Optional[str] = None  # from version check
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            self._fields[name] = value
+
+    def enabled(self) -> bool:
+        return bool(self.url) and self.interval > 0
+
+    def payload(self) -> Dict[str, Any]:
+        """The report body (reference diagnostics.go:80-135: version, OS,
+        arch, uptime, schema shape — never data or keys)."""
+        with self._lock:
+            fields = dict(self._fields)
+        fields.update({
+            "Version": __version__,
+            "OS": platform.system(),
+            "Arch": platform.machine(),
+            "PythonVersion": platform.python_version(),
+            "NumCPU": os.cpu_count(),
+        })
+        if self.holder is not None:
+            schema = self.holder.schema()
+            fields["NumIndexes"] = len(schema)
+            fields["NumFields"] = sum(len(ix.get("fields", []))
+                                      for ix in schema)
+        return fields
+
+    def flush(self) -> bool:
+        """POST one report; never raises (diagnostics must not disturb
+        serving)."""
+        if not self.url:
+            return False
+        try:
+            body = json.dumps(self.payload()).encode("utf-8")
+            req = urllib.request.Request(
+                self.url, data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10):
+                pass
+            return True
+        except Exception as e:  # noqa: BLE001 — best-effort by design
+            if self.logger is not None:
+                self.logger.debugf("diagnostics flush failed: %r", e)
+            return False
+
+    def check_version(self, latest: str) -> Optional[str]:
+        """Compare a reported latest version against ours (reference
+        compareVersions, diagnostics.go:183-229). Returns a human message
+        when an update exists, else None."""
+        self.server_version = latest
+        try:
+            ours = [int(x) for x in __version__.split("-")[0]
+                    .lstrip("v").split(".")]
+            theirs = [int(x) for x in latest.split("-")[0]
+                      .lstrip("v").split(".")]
+        except ValueError:
+            return None
+        if theirs > ours:
+            return (f"an update is available: {latest} "
+                    f"(running {__version__})")
+        return None
+
+    def start(self) -> None:
+        if not self.enabled() or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="diagnostics")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.flush()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class RuntimeMonitor:
+    """Samples process/runtime gauges into the stats client (reference
+    monitorRuntime, server.go:726-770: goroutines, heap, open FDs,
+    mmaps)."""
+
+    def __init__(self, stats, interval: float = 10.0):
+        self.stats = stats
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample(self) -> None:
+        self.stats.gauge("threads", threading.active_count())
+        counts = gc.get_count()
+        self.stats.gauge("gcGen0", counts[0])
+        self.stats.gauge("garbageCollection", gc.get_stats()[-1].get(
+            "collections", 0))
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        self.stats.gauge(
+                            "heapInuse", int(line.split()[1]) * 1024)
+                        break
+        except OSError:
+            pass
+        try:
+            self.stats.gauge("openFiles", len(os.listdir("/proc/self/fd")))
+        except OSError:
+            pass
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="runtime-monitor")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sample()
+            except Exception:  # noqa: BLE001 — monitoring must not crash
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,111 @@
+"""Diagnostics (utils/diagnostics.py) and slow-query logging tests."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from pilosa_tpu.utils.diagnostics import DiagnosticsCollector, RuntimeMonitor
+from pilosa_tpu.utils.stats import MemStatsClient
+
+
+def test_disabled_by_default():
+    d = DiagnosticsCollector()
+    assert not d.enabled()
+    assert d.flush() is False  # no URL → never POSTs
+
+
+def test_payload_shape(tmp_path):
+    from pilosa_tpu.core.holder import Holder
+    holder = Holder(str(tmp_path))
+    holder.open()
+    idx = holder.create_index("d1")
+    idx.create_field("f1")
+    idx.create_field("f2")
+    d = DiagnosticsCollector(holder=holder)
+    d.set("ClusterID", "abc")
+    p = d.payload()
+    assert p["NumIndexes"] == 1 and p["NumFields"] >= 2
+    assert p["Version"] and p["OS"] and p["ClusterID"] == "abc"
+    holder.close()
+
+
+def test_flush_posts_json():
+    received = []
+
+    class H(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            received.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        d = DiagnosticsCollector(
+            url=f"http://127.0.0.1:{srv.server_port}/diagnostics")
+        assert d.flush() is True
+        assert received and received[0]["Version"]
+    finally:
+        srv.shutdown()
+
+
+def test_flush_survives_unreachable_endpoint():
+    d = DiagnosticsCollector(url="http://127.0.0.1:1/nope")
+    assert d.flush() is False  # no raise
+
+
+@pytest.mark.parametrize("latest,expect_update", [
+    ("v9.9.9", True),
+    ("0.0.1", False),
+    ("garbage", False),
+])
+def test_check_version(latest, expect_update):
+    d = DiagnosticsCollector()
+    msg = d.check_version(latest)
+    assert (msg is not None) == expect_update
+    assert d.server_version == latest
+
+
+def test_runtime_monitor_samples_gauges():
+    stats = MemStatsClient()
+    mon = RuntimeMonitor(stats, interval=1000)
+    mon.sample()
+    snap = stats.snapshot()
+    assert snap["gauges"]["threads"] >= 1
+    assert snap["gauges"].get("heapInuse", 0) > 0  # /proc available on linux
+
+
+def test_slow_query_logged(tmp_path):
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.server.api import API
+
+    logged = []
+
+    class FakeLogger:
+        def printf(self, fmt, *args):
+            logged.append(fmt % args)
+
+        def debugf(self, fmt, *args):
+            pass
+
+    holder = Holder(str(tmp_path))
+    holder.open()
+    holder.create_index("q").create_field("f")
+    api = API(holder)
+    api.logger = FakeLogger()
+    api.long_query_time = 0.0000001  # everything is slow
+    api.query("q", "Set(1, f=2)")
+    assert any("SLOW QUERY" in line for line in logged)
+    logged.clear()
+    api.long_query_time = 0.0  # disabled
+    api.query("q", "Count(Row(f=2))")
+    assert not any("SLOW QUERY" in line for line in logged)
+    holder.close()
