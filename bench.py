@@ -9,6 +9,9 @@ through the full production path: PQL parse -> executor -> one fused
 popcount sweep over the HBM-resident view bank -> host top-k. This is the
 op the reference approximates with its ranked cache + heap scan
 (cache.go:136, fragment.go:1067); here it is computed exactly per query.
+Queries are issued BATCH_CALLS to a request (multi-call PQL, reference
+executor.go:84) so the executor's dispatch-then-fetch pipeline overlaps
+device sweeps with the per-call host round trip.
 
 Baseline: the identical exact computation on host numpy over the same
 packed words (vectorized popcount+reduce — a faster host baseline than the
@@ -25,13 +28,20 @@ import time
 
 import numpy as np
 
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
 N_SHARDS = 16
 N_ROWS = 1024
 TPU_ITERS = 10
 CPU_ITERS = 3
+BATCH_CALLS = 8  # TopN calls per query; dispatches pipeline before fetch
 
 
 def build_holder(tmp):
+    log("bench: building holder data")
     from pilosa_tpu.core.holder import Holder
     from pilosa_tpu.ops.bitset import SHARD_WIDTH
 
@@ -61,19 +71,28 @@ def bench_tpu(holder):
     from pilosa_tpu.executor import Executor
 
     ex = Executor(holder)
-    q = f"TopN(f, n=10)"
-    (want,) = ex.execute("bench", q)  # warm: bank upload + compile
+    log("bench: warming TPU path (bank upload + compile)")
+    (want,) = ex.execute("bench", "TopN(f, n=10)")  # warm: upload+compile
+    log("bench: warm done, timing")
+    # Measure a BATCH_CALLS-call query: the executor dispatches every
+    # call's device program before fetching any result, so per-call cost
+    # amortizes the host<->device round trip — the realistic serving shape
+    # (the reference likewise evaluates every call of a query,
+    # executor.go:84, and clients batch calls per request).
+    q = " ".join("TopN(f, n=10)" for _ in range(BATCH_CALLS))
+    ex.execute("bench", q)  # warm the batched path
     times = []
     for _ in range(TPU_ITERS):
         t0 = time.perf_counter()
-        (got,) = ex.execute("bench", q)
-        times.append(time.perf_counter() - t0)
-        assert got.pairs == want.pairs
+        got = ex.execute("bench", q)
+        times.append((time.perf_counter() - t0) / BATCH_CALLS)
+        assert all(g.pairs == want.pairs for g in got)
     return float(np.median(times)), want.pairs
 
 
 def bench_cpu(holder):
     """Host baseline: exact popcounts over the same packed rows + top-k."""
+    log("bench: running CPU baseline")
     from pilosa_tpu.ops.bitset import SHARD_WIDTH
 
     f = holder.index("bench").field("f")

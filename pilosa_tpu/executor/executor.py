@@ -60,6 +60,18 @@ TOPN_MAX_BANK_ROWS = 8192
 TOPN_CHUNK_ROWS = 1024
 
 
+class _Pending:
+    """A dispatched-but-unfetched call result. The device program is
+    already queued; finalize() blocks on the transfer and builds the
+    host-side result. Lets _execute_query overlap every read call's
+    device work and device→host drain across a multi-call query."""
+
+    __slots__ = ("finalize",)
+
+    def __init__(self, finalize):
+        self.finalize = finalize
+
+
 class ExecutionError(ValueError):
     pass
 
@@ -178,10 +190,21 @@ class Executor:
         if idx is None:
             raise ExecutionError(f"index not found: {index_name}")
         opts = ExecOptions()
-        results = []
+        # Two phases: dispatch every call's device program in call order
+        # (jax dispatch is async — programs queue on the device), then
+        # fetch/finalize. A multi-call query thus pays one pipelined
+        # device→host drain instead of a blocking round trip per call —
+        # the TPU analog of the reference streaming per-shard results
+        # into reduceFn as they arrive (executor.go:2277).
+        staged = []
         for call in query.calls:
             self._translate_call(idx, call)
-            result = self._execute_call(idx, call, shards, opts)
+            staged.append((call, self._execute_call(idx, call, shards,
+                                                    opts)))
+        results = []
+        for call, result in staged:
+            if isinstance(result, _Pending):
+                result = result.finalize()
             self._translate_result(idx, call, result)
             results.append(result)
         return results, opts
@@ -384,12 +407,13 @@ class Executor:
             res.clear_columns()
         return res
 
-    def _execute_count(self, idx: Index, call: Call, shards) -> int:
+    def _execute_count(self, idx: Index, call: Call, shards) -> "_Pending":
         if len(call.children) != 1:
             raise ExecutionError("Count() takes exactly one row argument")
         shards = self._shards(idx, shards)
         counts = self._eval_tree(idx, call.children[0], shards, mode="count")
-        return int(np.asarray(counts, dtype=np.int64).sum())
+        return _Pending(
+            lambda: int(np.asarray(counts, dtype=np.int64).sum()))
 
     def _eval_tree(self, idx: Index, call: Call, shards: List[int],
                    mode: str):
@@ -665,14 +689,27 @@ class Executor:
             self._jit_cache[key] = fn
         return fn
 
-    def _run_counts(self, bank_array, filter_words):
-        """Run the counts kernel and fetch once: (counts_np, raw_np)."""
+    def _dispatch_counts(self, bank_array, filter_words):
+        """Queue the counts kernel; returns unfetched device output."""
         fn = self._counts_fn(filter_words is not None, bank_array.shape)
-        out = fn(bank_array, filter_words)
+        return fn(bank_array, filter_words)
+
+    def _fetch_counts(self, out, filter_words):
+        """Block on a _dispatch_counts output: (counts_np, raw_np)."""
         if filter_words is not None:
             return np.asarray(out[0]), np.asarray(out[1])
         c = np.asarray(out)
         return c, c
+
+    def _popcount_row(self, words):
+        """Dispatch a total popcount over row words [S, W] (device)."""
+        import jax
+        from pilosa_tpu.ops.bitset import popcount
+        fn = self._jit_cache.get("popcount_row")
+        if fn is None:
+            fn = jax.jit(lambda w: popcount(w, axis=(-2, -1)))
+            self._jit_cache["popcount_row"] = fn
+        return fn(words)
 
     def _execute_topn(self, idx: Index, call: Call, shards) -> PairsResult:
         """Exact TopN (reference executeTopN 2-phase approximation,
@@ -681,9 +718,6 @@ class Executor:
         phase or ranked-cache dependency is needed — strictly stronger than
         the reference's cache-approximate result. Row sets larger than
         TOPN_CHUNK_ROWS stream through the device in chunks."""
-        import jax.numpy as jnp
-        from pilosa_tpu.ops.bitset import popcount
-
         field_name = call.arg("_field")
         field = idx.field(field_name)
         if field is None:
@@ -714,45 +748,68 @@ class Executor:
         if not all_rows:
             return PairsResult([])
 
-        totals: Dict[int, int] = {}
-        raws: Dict[int, int] = {}
+        # Dispatch phase: queue every device program (counts sweeps, and
+        # the tanimoto denominator popcount); nothing is fetched yet.
         # The HBM bound must consider the *bank* size (all view rows), not
         # the attr-filtered subset — the full-bank path materializes every
         # view row.
+        dispatched = []  # (rows, bank, device_out)
+        chunked: List[List[int]] = []
         if len(view_rows) <= TOPN_MAX_BANK_ROWS:
             # Hot path: one fused popcount sweep over the whole cached bank
             # (no gather); rows map to slots host-side, unused slots are
             # zero rows and drop out naturally.
             bank = view.device_bank(tuple(shards), mesh=self.mesh)
-            counts, raw = self._run_counts(bank.array, filter_words)
-            for r in all_rows:
-                s = bank.slot(r)
-                totals[r] = int(counts[s])
-                raws[r] = int(raw[s])
+            dispatched.append(
+                (all_rows, bank, self._dispatch_counts(bank.array,
+                                                       filter_words)))
         else:
             # Huge row sets stream through transient chunk banks to bound
-            # HBM (the 50k-row ranked-cache shape).
-            for c0 in range(0, len(all_rows), TOPN_CHUNK_ROWS):
-                chunk_rows = all_rows[c0:c0 + TOPN_CHUNK_ROWS]
-                bank = view.device_bank(tuple(shards), rows=chunk_rows,
-                                        mesh=self.mesh)
-                counts, raw = self._run_counts(bank.array, filter_words)
-                for r in chunk_rows:
+            # HBM (the 50k-row ranked-cache shape). Chunks are uploaded
+            # lazily in finalize with one-chunk lookahead — dispatching
+            # them all here would materialize every chunk bank in HBM at
+            # once, the exact blow-up chunking exists to avoid.
+            chunked = [all_rows[c0:c0 + TOPN_CHUNK_ROWS]
+                       for c0 in range(0, len(all_rows), TOPN_CHUNK_ROWS)]
+        src_dev = None
+        if tanimoto and filter_words is not None:
+            src_dev = self._popcount_row(filter_words)
+
+        def dispatch_chunk(rows):
+            bank = view.device_bank(tuple(shards), rows=rows,
+                                    mesh=self.mesh)
+            return (rows, bank,
+                    self._dispatch_counts(bank.array, filter_words))
+
+        def finalize() -> PairsResult:
+            totals: Dict[int, int] = {}
+            raws: Dict[int, int] = {}
+            pending = list(dispatched)
+            if chunked:
+                pending.append(dispatch_chunk(chunked[0]))
+            i = 0
+            while pending:
+                rows, bank, out = pending.pop(0)
+                # One-chunk lookahead: overlap the next upload+sweep with
+                # this fetch while keeping at most two chunk banks live.
+                i += 1
+                if i < len(chunked):
+                    pending.append(dispatch_chunk(chunked[i]))
+                counts, raw = self._fetch_counts(out, filter_words)
+                for r in rows:
                     s = bank.slot(r)
                     totals[r] = int(counts[s])
                     raws[r] = int(raw[s])
+            if tanimoto and filter_words is not None:
+                src_total = int(np.asarray(src_dev))
+                totals = {r: inter for r, inter in totals.items()
+                          if (d := raws[r] + src_total - inter) > 0
+                          and (inter * 100) // d >= tanimoto}
+            pairs = sorted(((r, c) for r, c in totals.items() if c > 0),
+                           key=lambda rc: (-rc[1], rc[0]))
+            return PairsResult(pairs[:n] if n else pairs)
 
-        if tanimoto and filter_words is not None:
-            src_total = int(np.asarray(popcount(filter_words, axis=(-2, -1))))
-            totals = {r: inter for r, inter in totals.items()
-                      if (d := raws[r] + src_total - inter) > 0
-                      and (inter * 100) // d >= tanimoto}
-
-        pairs = sorted(((r, c) for r, c in totals.items() if c > 0),
-                       key=lambda rc: (-rc[1], rc[0]))
-        if n:
-            pairs = pairs[:n]
-        return PairsResult(pairs)
+        return _Pending(finalize)
 
     # ----------------------------------------------------------------- Rows
 
@@ -902,16 +959,22 @@ class Executor:
             fn = jax.jit(run)
             self._jit_cache[key] = fn
         a, b = fn(bank.array, sel, filter_words)
-        if op == "Sum":
-            counts = np.asarray(a, dtype=np.int64)
-            cnt = int(np.asarray(b))
-            total = sum(int(c) << i for i, c in enumerate(counts.tolist()))
-            return ValCount(total + bsig.min * cnt, cnt)
-        count = int(np.asarray(b))
-        if count == 0:
-            return ValCount(0, 0)
-        base = sum(int(v) << i for i, v in enumerate(np.asarray(a).tolist()))
-        return ValCount(base + bsig.min, count)
+
+        def finalize() -> ValCount:
+            if op == "Sum":
+                counts = np.asarray(a, dtype=np.int64)
+                cnt = int(np.asarray(b))
+                total = sum(int(c) << i
+                            for i, c in enumerate(counts.tolist()))
+                return ValCount(total + bsig.min * cnt, cnt)
+            count = int(np.asarray(b))
+            if count == 0:
+                return ValCount(0, 0)
+            base = sum(int(v) << i
+                       for i, v in enumerate(np.asarray(a).tolist()))
+            return ValCount(base + bsig.min, count)
+
+        return _Pending(finalize)
 
     # --------------------------------------------------------------- writes
 

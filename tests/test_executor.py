@@ -380,3 +380,39 @@ def test_options_bad_args(ex):
         e.execute("i", "Options(Row(f=1), excludeColumns=7)")
     with pytest.raises(ValueError):
         e.execute("i", "Options(Row(f=1), shards=3)")
+
+
+def test_multicall_query_pipelines_with_correct_ordering(ex):
+    """A query mixing writes and reads evaluates in call order even
+    though read fetches are deferred: each read snapshots the state as
+    of its position (dispatch-then-fetch, _execute_query)."""
+    e, h = ex
+    setup_basic(h)
+    results = e.execute("i", (
+        "Count(Row(f=1)) "          # before the write: 4 bits
+        "Set(9, f=1) "              # write
+        "Count(Row(f=1)) "          # after: 5 bits
+        "TopN(f, n=2) "             # sees the new bit too
+        "Clear(9, f=1) "
+        "Count(Row(f=1))"           # back to 4
+    ))
+    assert results[0] == 4
+    assert results[1] is True
+    assert results[2] == 5
+    assert results[3].pairs[0] == (1, 5)
+    assert results[4] is True
+    assert results[5] == 4
+
+
+def test_multicall_all_reads_match_serial(ex):
+    """Batched multi-call results identical to one-call-at-a-time."""
+    e, h = ex
+    setup_basic(h)
+    calls = ["Count(Row(f=1))", "Count(Intersect(Row(f=1), Row(f=2)))",
+             "TopN(f, n=5)", "Row(g=1)"]
+    serial = [e.execute("i", c)[0] for c in calls]
+    batched = e.execute("i", " ".join(calls))
+    assert batched[0] == serial[0]
+    assert batched[1] == serial[1]
+    assert batched[2].pairs == serial[2].pairs
+    assert batched[3].columns().tolist() == serial[3].columns().tolist()
