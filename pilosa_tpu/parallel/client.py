@@ -108,6 +108,27 @@ class InternalClient:
             "GET", f"{uri}/internal/fragment/block/data?index={index}"
                    f"&field={field}&view={view}&shard={shard}&block={block}")
 
+    # -- attr sync (reference http/client.go:903-983 attr diff) ---------------
+
+    def attr_blocks(self, uri: str, index: str,
+                    field: Optional[str] = None) -> List[dict]:
+        f = f"&field={field}" if field else ""
+        return self._req(
+            "GET", f"{uri}/internal/attr/blocks?index={index}{f}")["blocks"]
+
+    def attr_block_data(self, uri: str, index: str, field: Optional[str],
+                        block: int) -> Dict[str, Any]:
+        f = f"&field={field}" if field else ""
+        return self._req(
+            "GET", f"{uri}/internal/attr/block/data?index={index}{f}"
+                   f"&block={block}")["attrs"]
+
+    def attr_merge(self, uri: str, index: str, field: Optional[str],
+                   attrs: Dict[str, Any]) -> None:
+        f = f"&field={field}" if field else ""
+        self._req("POST", f"{uri}/internal/attr/merge?index={index}{f}",
+                  obj={"attrs": attrs})
+
     # -- schema / membership --------------------------------------------------
 
     def schema(self, uri: str) -> dict:

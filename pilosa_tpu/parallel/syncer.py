@@ -40,9 +40,12 @@ class HolderSyncer:
         """One full anti-entropy pass over every locally-held fragment this
         node is a replica for. Returns {"merged": bits_pulled,
         "pushed": bits_pushed} for observability."""
-        stats = {"merged": 0, "pushed": 0}
+        stats = {"merged": 0, "pushed": 0, "attrs_merged": 0,
+                 "attrs_pushed": 0}
         for iname, idx in list(self.holder.indexes.items()):
+            self.sync_attrs(iname, None, idx.column_attr_store, stats)
             for fname, field in list(idx.fields.items()):
+                self.sync_attrs(iname, fname, field.row_attr_store, stats)
                 for vname, view in list(field.views.items()):
                     for shard, frag in list(view.fragments.items()):
                         if not self.cluster.owns_shard(iname, shard):
@@ -50,6 +53,46 @@ class HolderSyncer:
                         self.sync_fragment(iname, fname, vname, shard, frag,
                                            stats)
         return stats
+
+    def sync_attrs(self, index: str, field: Optional[str], store,
+                   stats: Dict[str, int]) -> None:
+        """Block-checksum attr reconciliation with every peer (reference
+        holderSyncer.syncIndex/syncField, holder.go:730-824): compare 100-id
+        block checksums, pull differing blocks, merge locally (attr merge is
+        commutative — last-writer key-wise union), and push our copy back so
+        the peer converges too."""
+        peers = [n for n in self.cluster.nodes()
+                 if n.id != self.cluster.local.id]
+        for peer in peers:
+            try:
+                theirs = {b["block"]: b["checksum"]
+                          for b in self.client.attr_blocks(peer.uri, index,
+                                                           field)}
+            except ClientError as e:
+                self._log("attr sync: blocks from %s failed: %r",
+                          peer.uri, e)
+                continue
+            ours = {b: c.hex() for b, c in store.blocks()}
+            for block in set(theirs) | set(ours):
+                if theirs.get(block) == ours.get(block):
+                    continue
+                try:
+                    if block in theirs:
+                        data = self.client.attr_block_data(
+                            peer.uri, index, field, block)
+                        if data:
+                            store.set_bulk({int(i): a
+                                            for i, a in data.items()})
+                            stats["attrs_merged"] += len(data)
+                    local = store.block_data(block)
+                    if local:
+                        self.client.attr_merge(
+                            peer.uri, index, field,
+                            {str(i): a for i, a in local.items()})
+                        stats["attrs_pushed"] += len(local)
+                except ClientError as e:
+                    self._log("attr sync: block %d with %s failed: %r",
+                              block, peer.uri, e)
 
     def sync_fragment(self, index: str, field: str, view: str, shard: int,
                       frag, stats: Dict[str, int]) -> None:

@@ -440,6 +440,32 @@ class API:
         """Full fragment stream (reference GET /internal/fragment/data)."""
         return self._fragment(index, field, view, shard).write_bytes()
 
+    def _attr_store(self, index: str, field: Optional[str]):
+        """Column attrs (field=None) or a field's row attrs (reference
+        index/field AttrStore split, index.go:35, field.go:62)."""
+        idx = self._index(index)
+        if field is None:
+            return idx.column_attr_store
+        return self._field(idx, field).row_attr_store
+
+    def attr_blocks(self, index: str, field: Optional[str] = None):
+        """(reference api.IndexAttrDiff/FieldAttrDiff block lists,
+        api.go:716-812; attr.go:80-119)."""
+        return [{"block": b, "checksum": c.hex()}
+                for b, c in self._attr_store(index, field).blocks()]
+
+    def attr_block_data(self, index: str, field: Optional[str],
+                        block: int) -> Dict[str, Any]:
+        store = self._attr_store(index, field)
+        return {"attrs": {str(i): a
+                          for i, a in store.block_data(block).items()}}
+
+    def attr_merge(self, index: str, field: Optional[str],
+                   attrs: Dict[str, Dict[str, Any]]) -> None:
+        """Adopt attrs pulled from a replica during anti-entropy."""
+        self._attr_store(index, field).set_bulk(
+            {int(i): a for i, a in attrs.items()})
+
     def translate_data(self, index: str, field: Optional[str] = None,
                        offset: int = 0) -> bytes:
         idx = self._index(index)
@@ -521,11 +547,96 @@ class API:
             self.cluster.add_node(Node.from_json(msg["node"]))
             self._kick_resize()
         elif typ == "node-leave":
-            self.cluster.remove_node(msg["nodeID"])
-            self._kick_resize()
+            if msg["nodeID"] == self.cluster.local.id:
+                # We were removed: detach to a single-node topology so we
+                # stop routing/syncing with stale membership.
+                for n in list(self.cluster.nodes()):
+                    if n.id != self.cluster.local.id:
+                        self.cluster.remove_node(n.id)
+            else:
+                self.cluster.remove_node(msg["nodeID"])
+                self._kick_resize()
         elif typ == "topology":
             for nd in msg.get("nodes", []):
                 self.cluster.add_node(Node.from_json(nd))
+        elif typ == "set-coordinator":
+            for n in self.cluster.nodes():
+                n.is_coordinator = (n.id == msg.get("nodeID"))
+            self.cluster.save()
+
+    def fragment_nodes(self, index: str, shard: int) -> List[dict]:
+        """Nodes owning a shard (reference GetFragmentNodes,
+        http/handler.go + api.ShardNodes)."""
+        self._index(index)  # 404 on unknown index
+        if self.cluster is None:
+            return [{"id": "local", "uri": "", "isCoordinator": True}]
+        return [n.to_json()
+                for n in self.cluster.shard_nodes(index, int(shard))]
+
+    def remove_node(self, node_id: str) -> dict:
+        """Remove a node from the cluster and rebalance (reference
+        api.RemoveNode, api.go:1084-1141; resize job cluster.go:1150).
+        Remaining owners pull newly-owned fragments from replicas."""
+        if self.cluster is None:
+            raise ApiError("not clustered", 400)
+        from pilosa_tpu.parallel.client import ClientError
+        if self.cluster.node_by_id(node_id) is None:
+            raise ApiError(f"node not found: {node_id}", 404)
+        if node_id == self.cluster.local.id:
+            raise ApiError("cannot remove the receiving node; send the "
+                           "request to another node", 400)
+        removed = self.cluster.node_by_id(node_id)
+        self.cluster.remove_node(node_id)
+        for peer in self.cluster.nodes():
+            if peer.id == self.cluster.local.id:
+                continue
+            try:
+                self._client.cluster_message(
+                    peer.uri, {"type": "node-leave", "nodeID": node_id})
+            except ClientError:
+                pass
+        # Tell the removed node too (it may still be alive): it detaches
+        # to a single-node topology instead of serving with stale 3-node
+        # placement and pushing anti-entropy into the survivors.
+        try:
+            self._client.cluster_message(
+                removed.uri, {"type": "node-leave", "nodeID": node_id})
+        except ClientError:
+            pass  # already dead — nothing to detach
+        self._kick_resize()
+        return self.cluster.status()
+
+    def set_coordinator(self, node_id: str) -> dict:
+        """(reference api.SetCoordinator, api.go:1104)."""
+        if self.cluster is None:
+            raise ApiError("not clustered", 400)
+        from pilosa_tpu.parallel.client import ClientError
+        target = self.cluster.node_by_id(node_id)
+        if target is None:
+            raise ApiError(f"node not found: {node_id}", 404)
+        # Apply locally through the same handler peers run, so the two
+        # paths cannot diverge.
+        self.handle_cluster_message({"type": "set-coordinator",
+                                     "nodeID": node_id})
+        for peer in self.cluster.nodes():
+            if peer.id == self.cluster.local.id:
+                continue
+            try:
+                self._client.cluster_message(
+                    peer.uri, {"type": "set-coordinator",
+                               "nodeID": node_id})
+            except ClientError:
+                pass
+        return self.cluster.status()
+
+    def resize_abort(self) -> dict:
+        """(reference api.ResizeAbort, api.go:1141). Resize here is
+        pull-based and idempotent — each owner pulls what it lacks — so
+        abort simply reports state; a re-join restores placement and the
+        next pull converges."""
+        if self.cluster is None:
+            raise ApiError("not clustered", 400)
+        return self.cluster.status()
 
     def sync_now(self) -> dict:
         """One synchronous anti-entropy pass (tests + admin)."""

@@ -151,6 +151,12 @@ class Handler(BaseHTTPRequestHandler):
                 self._json(stats)
             elif path == "/index":
                 self._json(api.schema()["indexes"])
+            elif m := re.fullmatch(r"/index/([^/]+)/field", path):
+                for idx in api.schema()["indexes"]:
+                    if idx["name"] == m.group(1):
+                        self._json({"fields": idx.get("fields", [])})
+                        return True
+                raise ApiError(f"index not found: {m.group(1)}", 404)
             elif m := re.fullmatch(r"/index/([^/]+)", path):
                 for idx in api.schema()["indexes"]:
                     if idx["name"] == m.group(1):
@@ -173,6 +179,15 @@ class Handler(BaseHTTPRequestHandler):
                 self._bytes(api.fragment_data(
                     q["index"], q["field"], q.get("view", "standard"),
                     int(q["shard"])))
+            elif path == "/internal/fragment/nodes":
+                self._json(api.fragment_nodes(q["index"],
+                                              int(q["shard"])))
+            elif path == "/internal/attr/blocks":
+                self._json({"blocks": api.attr_blocks(
+                    q["index"], q.get("field"))})
+            elif path == "/internal/attr/block/data":
+                self._json(api.attr_block_data(
+                    q["index"], q.get("field"), int(q["block"])))
             elif path == "/internal/shards/max":
                 self._json({"standard": api.shards_max()})
             elif path == "/internal/translate/data":
@@ -249,6 +264,18 @@ class Handler(BaseHTTPRequestHandler):
             elif path == "/internal/cluster/message":
                 api.handle_cluster_message(self._body_json())
                 self._json({})
+            elif path == "/internal/attr/merge":
+                b = self._body_json()
+                api.attr_merge(q["index"], q.get("field"),
+                               b.get("attrs", {}))
+                self._json({})
+            elif path == "/cluster/resize/remove-node":
+                self._json(api.remove_node(self._body_json().get("id")))
+            elif path == "/cluster/resize/set-coordinator":
+                self._json(api.set_coordinator(
+                    self._body_json().get("id")))
+            elif path == "/cluster/resize/abort":
+                self._json(api.resize_abort())
             elif path == "/internal/translate/keys":
                 b = self._body_json()
                 keys = b.get("keys", [])

@@ -167,6 +167,38 @@ def test_anti_entropy_heals_lagging_replica(tmp_path):
             nd.stop()
 
 
+def test_anti_entropy_syncs_attrs(tmp_path):
+    """Attr stores reconcile by block checksums during anti-entropy
+    (reference holderSyncer.syncIndex/syncField, holder.go:730-824)."""
+    nodes = run_cluster(tmp_path, 2, replica_n=2)
+    try:
+        base = nodes[0].uri
+        req(base, "POST", "/index/ai", {"options": {}})
+        req(base, "POST", "/index/ai/field/f", {"options": {}})
+        # Write attrs only into node 0's local stores (a replica that
+        # missed the broadcast while down).
+        nodes[0].holder.index("ai").column_attr_store.set(
+            7, {"city": "spokane"})
+        nodes[0].holder.index("ai").field("f").row_attr_store.set(
+            3, {"label": "x"})
+        assert nodes[1].holder.index("ai").column_attr_store.get(7) == {}
+        stats = req(base, "POST", "/internal/sync")
+        assert stats["attrs_pushed"] > 0  # node 0 pushed its blocks
+        assert nodes[1].holder.index("ai").column_attr_store.get(7) == \
+            {"city": "spokane"}
+        assert nodes[1].holder.index("ai").field("f").row_attr_store.get(
+            3) == {"label": "x"}
+        # And the reverse direction: node 1 pulls node-0-only attrs when
+        # IT runs the sync pass.
+        nodes[0].holder.index("ai").column_attr_store.set(8, {"n": 1})
+        req(nodes[1].uri, "POST", "/internal/sync")
+        assert nodes[1].holder.index("ai").column_attr_store.get(8) == \
+            {"n": 1}
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
 def test_resize_pull_on_join(tmp_path):
     # start single node with data, then grow to 2 and run resize
     nodes = run_cluster(tmp_path, 1)
@@ -305,6 +337,42 @@ def test_options_cluster_column_attrs(tmp_path):
             assert res["results"][0]["columns"] == [1, 2], (nd.uri, res)
             assert res.get("columnAttrs") == \
                 [{"id": 2, "attrs": {"kind": "x"}}], (nd.uri, res)
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_cluster_admin_remove_node_and_coordinator(tmp_path):
+    """remove-node rebalances onto survivors; set-coordinator broadcasts
+    (reference api.go:1084-1141, PostClusterResize* routes)."""
+    nodes = run_cluster(tmp_path, 3, replica_n=2)
+    try:
+        base = nodes[0].uri
+        req(base, "POST", "/index/rm", {"options": {}})
+        req(base, "POST", "/index/rm/field/f", {"options": {}})
+        req(base, "POST", "/index/rm/query", b"Set(1, f=1) Set(2, f=1)")
+        # owners of shard 0
+        owners = req(base, "GET", "/internal/fragment/nodes?index=rm&shard=0")
+        assert len(owners) == 2
+        # set coordinator to node 1
+        st = req(base, "POST", "/cluster/resize/set-coordinator",
+                 {"id": nodes[1].uri})
+        coords = [n for n in st["nodes"] if n.get("isCoordinator")]
+        assert [c["id"] for c in coords] == [nodes[1].uri]
+        # remove node 2 via node 0; survivors converge to 2-node topology
+        st = req(base, "POST", "/cluster/resize/remove-node",
+                 {"id": nodes[2].uri})
+        assert len(st["nodes"]) == 2
+        st1 = req(nodes[1].uri, "GET", "/status")
+        assert len(st1["nodes"]) == 2
+        # the removed node detached to a single-node topology
+        st2 = req(nodes[2].uri, "GET", "/status")
+        assert [n["id"] for n in st2["nodes"]] == [nodes[2].uri]
+        # data still queryable after rebalance
+        res = req(base, "POST", "/index/rm/query", b"Count(Row(f=1))")
+        assert res["results"] == [2]
+        # abort reports state without error
+        assert "state" in req(base, "POST", "/cluster/resize/abort")
     finally:
         for nd in nodes:
             nd.stop()
