@@ -1,0 +1,89 @@
+"""Star-trace benchmark — BASELINE.md config 1: the getting-started
+index (users star repositories), measured END-TO-END through the HTTP
+server: POST /index/{i}/query with Row / Intersect / Count / TopN,
+p50 latency per query. Baseline is the same computation on host numpy
+sets (the serving overhead the reference's "sub-second" claim includes,
+docs/faq.md:11).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_USERS = 2000
+N_REPOS = 1_000_000
+STARS_PER_USER = 2000
+ITERS = 20
+PORT = 10941
+
+
+def post(path, body):
+    req = urllib.request.Request(f"http://127.0.0.1:{PORT}{path}",
+                                 data=body.encode(), method="POST")
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def main():
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.server import API, serve
+
+    rng = np.random.default_rng(3)
+    with tempfile.TemporaryDirectory() as tmp:
+        holder = Holder(tmp)
+        holder.open()
+        api = API(holder)
+        srv = serve(api, "127.0.0.1", PORT, background=True)
+        try:
+            post("/index/repository", "{}")
+            post("/index/repository/field/stargazer", "{}")
+            users = np.repeat(np.arange(N_USERS, dtype=np.uint64),
+                              STARS_PER_USER)
+            repos = rng.integers(0, N_REPOS, N_USERS * STARS_PER_USER,
+                                 dtype=np.uint64)
+            holder.index("repository").field("stargazer").import_bits(
+                users, repos)
+
+            q = ("Count(Intersect(Row(stargazer=14), Row(stargazer=19))) "
+                 "TopN(stargazer, n=5)")
+            want = post("/index/repository/query", q)  # warm
+            times = []
+            for _ in range(ITERS):
+                t0 = time.perf_counter()
+                got = post("/index/repository/query", q)
+                times.append(time.perf_counter() - t0)
+                assert got == want
+            tpu_t = float(np.median(times)) / 2  # per call
+
+            # numpy baseline: same answers from per-user sets
+            set14 = repos[users == 14]
+            set19 = repos[users == 19]
+            t0 = time.perf_counter()
+            cnt = len(np.intersect1d(set14, set19))
+            counts = np.bincount(users[np.argsort(users)].astype(np.int64))
+            top = np.argsort(-counts, kind="stable")[:5]
+            cpu_t = (time.perf_counter() - t0) / 2
+            assert cnt == want["results"][0]
+            del top
+            print(json.dumps({
+                "metric": "startrace_http_p50_latency",
+                "value": tpu_t,
+                "unit": "seconds",
+                "vs_baseline": cpu_t / tpu_t,
+            }))
+        finally:
+            srv.shutdown()
+            holder.close()
+
+
+if __name__ == "__main__":
+    main()

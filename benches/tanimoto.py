@@ -1,0 +1,93 @@
+"""Tanimoto similarity benchmark — BASELINE.md config 4 (scaled): TopN
+with tanimotoThreshold over molecule fingerprints (reference
+docs/examples.md chemical-similarity workload; pruning
+fragment.go:1087-1093).
+
+Columns are molecules, rows 0..4095 are Morgan fingerprint bits.
+Measures p50 similarity-search latency through the production executor
+and validates against an exact numpy Tanimoto over the same data.
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_MOLECULES = 500_000
+FP_BITS = 4096
+BITS_PER_MOL = 48       # typical Morgan density
+THRESHOLD = 70          # tanimoto percent
+QUERY_MOL = 12345
+ITERS = 5
+
+
+def main():
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+
+    rng = np.random.default_rng(11)
+    # fingerprint bit rows per molecule
+    rows = rng.integers(0, FP_BITS, (N_MOLECULES, BITS_PER_MOL))
+    cols = np.repeat(np.arange(N_MOLECULES, dtype=np.uint64), BITS_PER_MOL)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder = Holder(tmp)
+        holder.open()
+        idx = holder.create_index("mole")
+        f = idx.create_field("fingerprint")
+        t0 = time.perf_counter()
+        f.import_bits(rows.reshape(-1).astype(np.uint64), cols)
+        load_s = time.perf_counter() - t0
+
+        ex = Executor(holder)
+        q = (f"TopN(fingerprint, Row(fingerprint={QUERY_MOL % FP_BITS}), "
+             f"n=50, tanimotoThreshold={THRESHOLD})")
+        (want,) = ex.execute("mole", q)  # warm: bank + compile
+
+        times = []
+        for _ in range(ITERS):
+            t0 = time.perf_counter()
+            (got,) = ex.execute("mole", q)
+            times.append(time.perf_counter() - t0)
+            assert got.pairs == want.pairs
+        tpu_t = float(np.median(times))
+
+        # Exact numpy baseline: dense bool fingerprint matrix, same
+        # tanimoto filter (matrix build excluded from baseline timing,
+        # matching the TPU side's pre-uploaded bank).
+        mat = np.zeros((FP_BITS, N_MOLECULES), dtype=bool)
+        mat[rows.reshape(-1), cols.astype(np.int64)] = True
+        filt = mat[QUERY_MOL % FP_BITS]
+        t0 = time.perf_counter()
+        inter = (mat & filt).sum(axis=1)
+        raw = mat.sum(axis=1)
+        src = int(filt.sum())
+        denom = raw + src - inter
+        keep = (denom > 0) & ((inter * 100) // np.maximum(denom, 1)
+                              >= THRESHOLD) & (inter > 0)
+        pairs = sorted(((int(r), int(inter[r]))
+                        for r in np.nonzero(keep)[0]),
+                       key=lambda rc: (-rc[1], rc[0]))[:50]
+        cpu_t = time.perf_counter() - t0
+        assert pairs == want.pairs, (pairs[:3], want.pairs[:3])
+
+        print(json.dumps({
+            "metric": "tanimoto_topn_p50_latency",
+            "value": tpu_t,
+            "unit": "seconds",
+            "vs_baseline": cpu_t / tpu_t,
+            "molecules": N_MOLECULES,
+            "load_seconds": round(load_s, 2),
+        }))
+        holder.close()
+
+
+if __name__ == "__main__":
+    main()
