@@ -344,6 +344,13 @@ uint64_t rb_serialize(const uint64_t* keys, const uint64_t* words, uint64_t n,
   return payload;
 }
 
+// fnv1a32 over a byte buffer, chainable via `seed` (pass 0x811C9DC5 to
+// start). Exposed for the Python op-log writer, whose per-byte loop is
+// the import-path bottleneck.
+uint32_t pn_fnv1a32(const uint8_t* data, uint64_t n, uint32_t seed) {
+  return fnv1a32(data, n, seed);
+}
+
 // ----------------------------------------------------------- word kernels
 
 // Total popcount over n packed words (host-side Count / CPU baseline).

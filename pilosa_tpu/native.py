@@ -62,6 +62,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rb_serialize_cap.restype = u64
     lib.rb_serialize.argtypes = [p_u64, p_u64, u64, p_u8]
     lib.rb_serialize.restype = u64
+    lib.pn_fnv1a32.argtypes = [p_u8, u64, ctypes.c_uint32]
+    lib.pn_fnv1a32.restype = ctypes.c_uint32
     lib.pn_popcount.argtypes = [p_u64, u64]
     lib.pn_popcount.restype = u64
     lib.pn_intersection_count.argtypes = [p_u64, p_u64, u64]
@@ -80,11 +82,15 @@ def load() -> Optional[ctypes.CDLL]:
         if _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_SO_PATH) and not _build():
+        # Always run make: it is mtime-based (a no-op when fresh) and
+        # rebuilds a stale .so whose symbols predate these bindings.
+        if not _build() and not os.path.exists(_SO_PATH):
             return None
         try:
             _lib = _bind(ctypes.CDLL(_SO_PATH))
-        except OSError:
+        except (OSError, AttributeError):
+            # AttributeError = missing symbol in a stale library that
+            # make could not refresh; fall back to the Python paths.
             _lib = None
         return _lib
 
@@ -148,6 +154,22 @@ def roaring_serialize(keys: np.ndarray, words: np.ndarray) -> Optional[bytes]:
     if size == 0 and n > 0:
         raise ValueError("rb_serialize: empty container passed")
     return bytes(bytearray(out)[:size])
+
+
+def fnv1a32(chunks, seed: int = 0x811C9DC5) -> Optional[int]:
+    """Chained fnv1a32 over byte chunks; None when unavailable."""
+    lib = load()
+    if lib is None:
+        return None
+    h = seed
+    for c in chunks:
+        # Zero-copy: bytes objects pin their buffer; cast the address
+        # directly instead of copying multi-MB batch payloads.
+        c = bytes(c) if not isinstance(c, bytes) else c
+        buf = ctypes.cast(ctypes.c_char_p(c),
+                          ctypes.POINTER(ctypes.c_uint8))
+        h = lib.pn_fnv1a32(buf, len(c), h)
+    return h
 
 
 def popcount(words: np.ndarray) -> Optional[int]:

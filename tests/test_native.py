@@ -107,6 +107,23 @@ def test_native_empty_bitmap_roundtrip():
     assert keys == [] and words.shape == (0, 1024) and op_n == 0
 
 
+def test_native_fnv1a32_matches_python():
+    from pilosa_tpu.storage.roaring import _FNV_OFFSET, _FNV_PRIME
+
+    def py_fnv(*chunks):
+        h = _FNV_OFFSET
+        for chunk in chunks:
+            for byte in chunk:
+                h = ((h ^ byte) * _FNV_PRIME) & 0xFFFFFFFF
+        return h
+
+    cases = [(b"",), (b"\x00",), (b"hello",), (b"abc", b"defgh"),
+             (bytes(range(256)),), (np.arange(1000, dtype="<u8")
+                                    .tobytes(),)]
+    for chunks in cases:
+        assert native.fnv1a32(chunks) == py_fnv(*chunks)
+
+
 def test_popcount_kernels_match_numpy():
     rng = np.random.default_rng(3)
     a = rng.integers(0, 2**63, 2048, dtype=np.uint64)
