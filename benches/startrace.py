@@ -64,16 +64,21 @@ def main():
                 assert got == want
             tpu_t = float(np.median(times)) / 2  # per call
 
-            # numpy baseline: same answers from per-user sets
-            set14 = repos[users == 14]
-            set19 = repos[users == 19]
+            # numpy baseline: same answers (distinct (user,repo) pairs —
+            # duplicates collapse in a bitmap) from the raw pair arrays.
+            set14 = np.unique(repos[users == 14])
+            set19 = np.unique(repos[users == 19])
+            pairs = np.unique(np.stack([users, repos], axis=1), axis=0)
             t0 = time.perf_counter()
-            cnt = len(np.intersect1d(set14, set19))
-            counts = np.bincount(users[np.argsort(users)].astype(np.int64))
-            top = np.argsort(-counts, kind="stable")[:5]
+            cnt = len(np.intersect1d(set14, set19, assume_unique=True))
+            counts = np.bincount(pairs[:, 0].astype(np.int64))
+            order = np.argsort(-counts, kind="stable")[:5]
+            top = [{"id": int(u), "count": int(counts[u])} for u in order]
             cpu_t = (time.perf_counter() - t0) / 2
             assert cnt == want["results"][0]
-            del top
+            got_top = want["results"][1]
+            assert [p["count"] for p in top] == \
+                [p["count"] for p in got_top], (top, got_top)
             print(json.dumps({
                 "metric": "startrace_http_p50_latency",
                 "value": tpu_t,

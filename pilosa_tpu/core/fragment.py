@@ -186,11 +186,36 @@ class Fragment:
         return self.storage.count_range(row_id * SHARD_WIDTH,
                                         (row_id + 1) * SHARD_WIDTH)
 
-    def row_dense(self, row_id: int) -> np.ndarray:
-        """Row as uint32 words [WORDS_PER_SHARD] (host)."""
+    def row_dense(self, row_id: int, u32_words: Optional[int] = None
+                  ) -> np.ndarray:
+        """Row as uint32 words (host). `u32_words` materializes only the
+        leading prefix — the width-trimmed bank path would otherwise
+        build (and immediately slice away) 128 KiB per row."""
+        bits = SHARD_WIDTH if u32_words is None else u32_words * 32
         u64 = self.storage.dense_range(row_id * SHARD_WIDTH,
-                                       (row_id + 1) * SHARD_WIDTH)
+                                       row_id * SHARD_WIDTH + bits)
         return u64_to_words(u64)
+
+    def max_column_offset(self) -> int:
+        """Largest in-shard column offset with any bit set in any row, or
+        -1 when empty. Drives width-trimmed TopN banks: fingerprint-style
+        fields use a tiny prefix of the 2^20-wide shard, so banks can
+        drop the all-zero word tail."""
+        with self._lock:
+            cached = getattr(self, "_max_col_cache", None)
+            if cached is not None and cached[0] == self.version:
+                return cached[1]
+            best = -1
+            for key, dense in self.storage.containers.items():
+                nz = np.nonzero(dense)[0]
+                if not len(nz):
+                    continue
+                # Word-granular bound (w*64+63) — callers round the bank
+                # width up anyway, exact bit position is not needed.
+                best = max(best, (key % CONTAINERS_PER_ROW) * CONTAINER_BITS
+                           + int(nz[-1]) * 64 + 63)
+            self._max_col_cache = (self.version, best)
+            return best
 
     def row_columns(self, row_id: int) -> np.ndarray:
         """Absolute column ids set in a row."""
@@ -296,10 +321,17 @@ class Fragment:
         """Mutex import: setting (row, col) clears any other row's bit in
         that column (reference bulkImportMutex, fragment.go:1605)."""
         with self._lock:
-            present = self.row_ids()
-            to_clear_rows, to_clear_cols = [], []
+            # Within-batch dedup first: the reference applies mutex sets
+            # sequentially, so for duplicate columns the LAST pair wins.
+            last_for_col: Dict[int, int] = {}
             for r, c in zip(np.asarray(row_ids, np.uint64).tolist(),
                             np.asarray(column_ids, np.uint64).tolist()):
+                last_for_col[c] = r
+            row_ids = np.array(list(last_for_col.values()), np.uint64)
+            column_ids = np.array(list(last_for_col.keys()), np.uint64)
+            present = self.row_ids()
+            to_clear_rows, to_clear_cols = [], []
+            for c, r in last_for_col.items():
                 cur = self.mutex_vector(c, present)
                 if cur is not None and cur != r:
                     to_clear_rows.append(cur)
@@ -333,12 +365,21 @@ class Fragment:
 
     def set_row(self, row_id: int, words: np.ndarray) -> None:
         """Replace a row's bits wholesale (reference setRow, fragment.go:522
-        — the Store() write path). `words` is uint32[WORDS_PER_SHARD]."""
+        — the Store() write path). `words` is uint32, up to
+        WORDS_PER_SHARD; a width-trimmed result clears the untouched
+        tail (overwrite semantics: bits past the operand width are 0)."""
         from pilosa_tpu.ops.bitset import words_to_u64
         with self._lock:
             self.storage.set_dense_range(
                 row_id * SHARD_WIDTH,
                 words_to_u64(np.ascontiguousarray(words, dtype=np.uint32)))
+            bits = words.size * 32
+            if bits < SHARD_WIDTH:
+                k0 = (row_id * SHARD_WIDTH + bits) >> 16
+                k1 = ((row_id + 1) * SHARD_WIDTH - 1) >> 16
+                for k in range(k0, k1 + 1):
+                    if self.storage.containers.pop(k, None) is not None:
+                        self.storage._invalidate(k)
             self._touch_row(row_id)
             if self.cache_type != cache_mod.CACHE_TYPE_NONE:
                 self.cache.add(row_id, self.row_count(row_id))

@@ -108,31 +108,59 @@ class View:
 
     # -- device bank --------------------------------------------------------
 
-    def device_bank(self, shards, rows=None, mesh=None) -> ViewBank:
+    def trimmed_words(self) -> int:
+        """Bank word width (uint32) covering every set column of every
+        fragment, rounded up to whole containers (2048 u32 words = 2^16
+        bits — the host storage's alignment granularity). Fingerprint-
+        style fields that use a tiny prefix of the 2^20-bit shard get
+        banks 16x smaller."""
+        from pilosa_tpu.core.fragment import CONTAINER_BITS
+        from pilosa_tpu.ops.bitset import WORDS_PER_SHARD
+        cwords = CONTAINER_BITS // 32
+        with self._lock:
+            frags = list(self.fragments.values())
+        max_off = -1
+        for f in frags:
+            max_off = max(max_off, f.max_column_offset())
+        if max_off < 0:
+            return cwords
+        words = (max_off // 32) + 1
+        return min(WORDS_PER_SHARD, ((words + cwords - 1) // cwords)
+                   * cwords)
+
+    def device_bank(self, shards, rows=None, mesh=None,
+                    trim: bool = False) -> ViewBank:
         """Bank for `shards` covering `rows` (default: all rows present in
-        any of the shards). Cached per (shard tuple, mesh); rebuilt when any
-        fragment's write version moved. `rows` subsets build transient
-        (uncached) banks — used by chunked TopN over huge row sets. With a
-        MeshContext the array is device_put sharded over the mesh's shard
-        axis, which is all the executor needs to run SPMD."""
+        any of the shards). Cached per (shard tuple, mesh, trim); rebuilt
+        when any fragment's write version moved. `rows` subsets build
+        transient (uncached) banks — used by chunked TopN over huge row
+        sets. trim=True narrows the word axis to trimmed_words() — valid
+        only for whole-row consumers (TopN popcount sweeps) since the
+        dropped tail is all-zero by construction. With a MeshContext the
+        array is device_put sharded over the mesh's shard axis, which is
+        all the executor needs to run SPMD."""
         import jax.numpy as jnp
         from pilosa_tpu.ops.bitset import WORDS_PER_SHARD
 
         shards = tuple(shards)
-        cache_key = (shards, mesh.cache_key() if mesh else None)
+        cache_key = (shards, mesh.cache_key() if mesh else None, trim)
         with self._lock:
             frags = {s: self.fragments.get(s) for s in shards}
             versions = {s: (f.version if f else -1) for s, f in frags.items()}
+            # Width AFTER the version snapshot: a write racing in between
+            # bumps a version, so a bank truncated by the pre-write width
+            # reads as stale and rebuilds — never silently wrong.
+            width = self.trimmed_words() if trim else WORDS_PER_SHARD
             if rows is None:
                 row_set = sorted({r for f in frags.values() if f
                                   for r in f.row_ids()})
                 cached = self._bank_cache.get(cache_key)
-                if cached is not None:
+                if cached is not None and cached.array.shape[-1] == width:
                     if (cached.versions == versions
                             and all(r in cached.slots for r in row_set)):
                         return cached
                     patched = self._patch_bank(cached, frags, versions,
-                                               row_set, shards)
+                                               row_set, shards, width)
                     if patched is not None:
                         self._bank_cache[cache_key] = patched
                         return patched
@@ -141,15 +169,14 @@ class View:
             cap = 1
             while cap < len(row_set) + 1:
                 cap *= 2
-            host = np.zeros((cap, len(shards), WORDS_PER_SHARD),
-                            dtype=np.uint32)
+            host = np.zeros((cap, len(shards), width), dtype=np.uint32)
             slots = {}
             for i, r in enumerate(row_set):
                 slots[r] = i
                 for si, s in enumerate(shards):
                     f = frags[s]
                     if f is not None:
-                        host[i, si] = f.row_dense(r)
+                        host[i, si] = f.row_dense(r, u32_words=width)
             array = mesh.put_bank(host) if mesh else jnp.asarray(host)
             bank = ViewBank(array, slots, cap - 1, versions)
             if rows is None:
@@ -157,7 +184,7 @@ class View:
             return bank
 
     def _patch_bank(self, cached: "ViewBank", frags, versions, row_set,
-                    shards):
+                    shards, width):
         """Incrementally refresh a cached bank: re-upload only (row, shard)
         cells whose fragment reports a newer row version. Returns None when
         a rebuild is required (new rows exceed capacity, or the patch would
@@ -174,7 +201,8 @@ class View:
                 continue
             for r in f.rows_changed_since(cached.versions.get(s, -1)):
                 if r in cached.slots:
-                    patches.append((cached.slots[r], si, f.row_dense(r)))
+                    patches.append((cached.slots[r], si,
+                                    f.row_dense(r, u32_words=width)))
         slots = dict(cached.slots)
         for r in new_rows:
             slot = len(slots)
@@ -182,7 +210,8 @@ class View:
             for si, s in enumerate(shards):
                 f = frags[s]
                 if f is not None:
-                    patches.append((slot, si, f.row_dense(r)))
+                    patches.append((slot, si,
+                                    f.row_dense(r, u32_words=width)))
         total_cells = cached.array.shape[0] * cached.array.shape[1]
         if len(patches) > max(16, total_cells // 2):
             return None

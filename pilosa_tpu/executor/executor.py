@@ -23,6 +23,7 @@ dispatch), :2277 (mapReduce). Structural translation to TPU:
 from __future__ import annotations
 
 import functools
+import os
 from dataclasses import dataclass, field as dc_field
 from datetime import datetime
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -53,10 +54,12 @@ _BITMAP_CALLS = {"Row", "Range", "Intersect", "Union", "Difference", "Xor",
 # bounded for hour-grain multi-year ranges).
 MAX_STATIC_RANGE_VIEWS = 8
 
-# TopN uses the cached full view bank up to this many rows; beyond it rows
-# stream through transient chunk banks (bounds HBM for 50k-row ranked-cache
-# workloads: 8192 rows x 1 shard = 1 GiB bank).
-TOPN_MAX_BANK_ROWS = 8192
+# TopN uses the cached full view bank while it fits this HBM byte budget
+# (banks are width-trimmed, so fingerprint-style fields with small column
+# spans cache hundreds of thousands of rows); beyond it rows stream
+# through transient chunk banks.
+TOPN_MAX_BANK_BYTES = int(os.environ.get("PILOSA_TPU_TOPN_BANK_BYTES",
+                                         2 << 30))
 TOPN_CHUNK_ROWS = 1024
 
 
@@ -105,6 +108,29 @@ def column_attr_sets(idx: Index, ids: Sequence[int],
             for (cid, attrs), key in zip(withattrs, keys)]
 
 
+def _align_words(words, width: int):
+    """Slice or zero-pad the trailing word axis to exactly `width`
+    (None passes through). Both directions are semantically safe for
+    intersection-style consumers — see _dispatch_counts."""
+    if words is None or words.shape[-1] == width:
+        return words
+    if words.shape[-1] > width:
+        return words[..., :width]
+    return _pad_words(words, width)
+
+
+def _pad_words(words, width: int):
+    """Zero-pad the trailing word axis up to `width` (no-op when equal).
+    Leaves gather from width-trimmed banks (view.trimmed_words) and pad to
+    the plan-wide width, so operands of one tree always align while each
+    bank stays as narrow as its data."""
+    import jax.numpy as jnp
+    d = width - words.shape[-1]
+    if d <= 0:
+        return words
+    return jnp.pad(words, [(0, 0)] * (words.ndim - 1) + [(0, d)])
+
+
 @dataclass
 class _Plan:
     """Everything the jitted tree program needs, gathered in one host pass."""
@@ -114,6 +140,9 @@ class _Plan:
     idxs: List[int] = dc_field(default_factory=list)       # traced gather slots
     params: List[int] = dc_field(default_factory=list)     # traced u32 scalars
     literals: List[Any] = dc_field(default_factory=list)   # eager [S, W] ops
+    widths: List[int] = dc_field(default_factory=list)     # operand widths
+    shift_bits: int = 0    # total Shift() distance; widens the plan
+    width: int = 0         # resolved by _eval_tree before tracing
 
     def bank(self, key: Tuple[str, str]) -> int:
         pos = self.bank_pos.get(key)
@@ -122,6 +151,17 @@ class _Plan:
             self.bank_pos[key] = pos
             self.bank_keys.append(key)
         return pos
+
+    def resolve_width(self) -> int:
+        from pilosa_tpu.ops.bitset import WORDS_PER_SHARD
+        from pilosa_tpu.core.fragment import CONTAINER_BITS
+        w = max(self.widths, default=CONTAINER_BITS // 32)
+        if self.shift_bits:
+            # Shifted bits may cross the trim boundary; widen to cover.
+            extra = (self.shift_bits + CONTAINER_BITS - 1) // CONTAINER_BITS
+            w += extra * (CONTAINER_BITS // 32)
+        self.width = min(WORDS_PER_SHARD, w)
+        return self.width
 
 
 class Executor:
@@ -423,14 +463,16 @@ class Executor:
 
         plan = _Plan()
         expr = self._plan_call(idx, call, shards, plan)
+        plan.resolve_width()
         banks = [self._get_bank(idx, key, shards) for key in plan.bank_keys]
         bank_arrays = tuple(b.array for b in banks)
         lits = None
         if plan.literals:
-            lits = jnp.stack(plan.literals)
+            lits = jnp.stack([_pad_words(a, plan.width)
+                              for a in plan.literals])
             if self.mesh is not None:
                 lits = self.mesh.put_row(lits)
-        sig = (f"{mode}|{''.join(plan.sig_parts)}"
+        sig = (f"{mode}|{''.join(plan.sig_parts)}|W{plan.width}"
                f"|B{[a.shape for a in bank_arrays]}"
                f"|L{None if lits is None else lits.shape}|S{len(shards)}")
         fn = self._jit_cache.get(sig)
@@ -474,6 +516,7 @@ class Executor:
             n = call.uint_arg("n") or 1
             sub = self._plan_call(idx, call.children[0], shards, plan)
             plan.sig_parts.append(f"S{n}")
+            plan.shift_bits += n  # widen the plan so bits can't fall off
             from pilosa_tpu.ops.bitset import shift_bits
             return lambda b, i, p, l: shift_bits(sub(b, i, p, l), n)
         if name in ("Intersect", "Union", "Difference", "Xor"):
@@ -493,13 +536,16 @@ class Executor:
 
     def _plan_slot_leaf(self, field: Field, view_name: str, row_id: int,
                         shards, plan: _Plan):
-        """A single-row leaf: bank[slot] with the slot traced."""
+        """A single-row leaf: bank[slot] with the slot traced, padded to
+        the plan width (banks are width-trimmed per view)."""
         pos = plan.bank((field.name, view_name))
         bank = self._get_bank_for(field, view_name, shards)
+        plan.widths.append(bank.array.shape[-1])
         i = len(plan.idxs)
         plan.idxs.append(bank.slot(row_id))
         plan.sig_parts.append(f"r{pos}")
-        return lambda b, idxs, p, l: b[pos][idxs[i]]
+        return lambda b, idxs, p, l: _pad_words(b[pos][idxs[i]],
+                                                plan.width)
 
     def _plan_row_leaf(self, idx: Index, call: Call, shards, plan: _Plan):
         import jax.numpy as jnp
@@ -523,7 +569,7 @@ class Executor:
                      if field.view(v) is not None]
             if not views:
                 return (lambda b, i, p, l:
-                        jnp.zeros((len(shards), WORDS_PER_SHARD), jnp.uint32))
+                        jnp.zeros((len(shards), plan.width), jnp.uint32))
             if len(views) <= MAX_STATIC_RANGE_VIEWS:
                 subs = [self._plan_slot_leaf(field, vn, row_id, shards, plan)
                         for vn in views]
@@ -533,8 +579,11 @@ class Executor:
             # Literal: precompute the union eagerly, pass as one operand.
             from pilosa_tpu.ops.bitset import union_many
             stacks = [self._get_bank_for(field, vn, shards) for vn in views]
+            wmax = max(bk.array.shape[-1] for bk in stacks)
+            plan.widths.append(wmax)
             arr = union_many(jnp.stack(
-                [bk.array[bk.slot(row_id)] for bk in stacks]), axis=0)
+                [_pad_words(bk.array[bk.slot(row_id)], wmax)
+                 for bk in stacks]), axis=0)
             k = len(plan.literals)
             plan.literals.append(arr)
             plan.sig_parts.append(f"l{k}")
@@ -554,15 +603,16 @@ class Executor:
         view_name = view_bsi_name(field.name)
         pos = plan.bank((field.name, view_name))
         bank = self._get_bank_for(field, view_name, shards)
+        plan.widths.append(bank.array.shape[-1])
         i0 = len(plan.idxs)
         plan.idxs.extend(bank.slot(r) for r in range(depth + 1))
 
         def planes_of(b, idxs):
-            return b[pos][idxs[i0:i0 + depth + 1]]
+            return _pad_words(b[pos][idxs[i0:i0 + depth + 1]], plan.width)
 
         op = cond.op
         zeros = (lambda b, i, p, l:
-                 jnp.zeros((len(shards), WORDS_PER_SHARD), jnp.uint32))
+                 jnp.zeros((len(shards), plan.width), jnp.uint32))
         if op == BETWEEN:
             lo_hi = cond.int_slice()
             lo, ok_lo = bsig.base_value_clamped(lo_hi[0], ">=")
@@ -617,7 +667,7 @@ class Executor:
         if view is None:
             # Reads must not create views; absent view = all-zero rows.
             return self._empty_bank(len(shards))
-        return view.device_bank(tuple(shards), mesh=self.mesh)
+        return view.device_bank(tuple(shards), mesh=self.mesh, trim=True)
 
     def _empty_bank(self, n_shards: int):
         import jax.numpy as jnp
@@ -626,7 +676,8 @@ class Executor:
         key = f"emptybank:{n_shards}:{mesh_key}"
         bank = self._jit_cache.get(key)
         if bank is None:
-            host = np.zeros((1, n_shards, WORDS_PER_SHARD), np.uint32)
+            from pilosa_tpu.core.fragment import CONTAINER_BITS
+            host = np.zeros((1, n_shards, CONTAINER_BITS // 32), np.uint32)
             arr = self.mesh.put_bank(host) if self.mesh \
                 else jnp.asarray(host)
             bank = ViewBank(arr, {}, 0, {})
@@ -690,7 +741,12 @@ class Executor:
         return fn
 
     def _dispatch_counts(self, bank_array, filter_words):
-        """Queue the counts kernel; returns unfetched device output."""
+        """Queue the counts kernel; returns unfetched device output.
+        Width-trimmed banks intersect against the same prefix of the
+        filter: slicing a wider filter is safe (bank rows have no bits
+        past their width), and padding a narrower one is safe (zeros
+        cannot intersect)."""
+        filter_words = _align_words(filter_words, bank_array.shape[-1])
         fn = self._counts_fn(filter_words is not None, bank_array.shape)
         return fn(bank_array, filter_words)
 
@@ -755,11 +811,19 @@ class Executor:
         # view row.
         dispatched = []  # (rows, bank, device_out)
         chunked: List[List[int]] = []
-        if len(view_rows) <= TOPN_MAX_BANK_ROWS:
+        # Banks are width-trimmed for the sweep: only whole-row popcounts
+        # are computed, and the dropped word tail is all-zero.
+        width = view.trimmed_words()
+        bank_cap = 1
+        while bank_cap < len(view_rows) + 1:
+            bank_cap *= 2
+        bank_bytes = bank_cap * len(shards) * width * 4
+        if bank_bytes <= TOPN_MAX_BANK_BYTES:
             # Hot path: one fused popcount sweep over the whole cached bank
             # (no gather); rows map to slots host-side, unused slots are
             # zero rows and drop out naturally.
-            bank = view.device_bank(tuple(shards), mesh=self.mesh)
+            bank = view.device_bank(tuple(shards), mesh=self.mesh,
+                                    trim=True)
             dispatched.append(
                 (all_rows, bank, self._dispatch_counts(bank.array,
                                                        filter_words)))
@@ -777,13 +841,12 @@ class Executor:
 
         def dispatch_chunk(rows):
             bank = view.device_bank(tuple(shards), rows=rows,
-                                    mesh=self.mesh)
+                                    mesh=self.mesh, trim=True)
             return (rows, bank,
                     self._dispatch_counts(bank.array, filter_words))
 
         def finalize() -> PairsResult:
-            totals: Dict[int, int] = {}
-            raws: Dict[int, int] = {}
+            parts = []  # (rows_arr, counts_arr, raws_arr)
             pending = list(dispatched)
             if chunked:
                 pending.append(dispatch_chunk(chunked[0]))
@@ -796,18 +859,30 @@ class Executor:
                 if i < len(chunked):
                     pending.append(dispatch_chunk(chunked[i]))
                 counts, raw = self._fetch_counts(out, filter_words)
-                for r in rows:
-                    s = bank.slot(r)
-                    totals[r] = int(counts[s])
-                    raws[r] = int(raw[s])
+                slot_idx = np.fromiter(
+                    (bank.slots.get(r, bank.zero_slot) for r in rows),
+                    dtype=np.int64, count=len(rows))
+                parts.append((np.asarray(rows, dtype=np.uint64),
+                              counts[slot_idx].astype(np.int64),
+                              raw[slot_idx].astype(np.int64)))
+            rows_arr = np.concatenate([p[0] for p in parts])
+            counts_arr = np.concatenate([p[1] for p in parts])
+            raws_arr = np.concatenate([p[2] for p in parts])
             if tanimoto and filter_words is not None:
                 src_total = int(np.asarray(src_dev))
-                totals = {r: inter for r, inter in totals.items()
-                          if (d := raws[r] + src_total - inter) > 0
-                          and (inter * 100) // d >= tanimoto}
-            pairs = sorted(((r, c) for r, c in totals.items() if c > 0),
-                           key=lambda rc: (-rc[1], rc[0]))
-            return PairsResult(pairs[:n] if n else pairs)
+                denom = raws_arr + src_total - counts_arr
+                keep = (denom > 0) & (
+                    (counts_arr * 100) // np.maximum(denom, 1) >= tanimoto)
+                rows_arr, counts_arr = rows_arr[keep], counts_arr[keep]
+            keep = counts_arr > 0
+            rows_arr, counts_arr = rows_arr[keep], counts_arr[keep]
+            # Sort by (-count, row) — vectorized; Python-loop-free even
+            # for 10^5-row fingerprint sweeps.
+            order = np.lexsort((rows_arr, -counts_arr))
+            if n:
+                order = order[:n]
+            pairs = [(int(rows_arr[o]), int(counts_arr[o])) for o in order]
+            return PairsResult(pairs)
 
         return _Pending(finalize)
 
@@ -879,7 +954,13 @@ class Executor:
         for fname, _ in child_rows:
             f = idx.field(fname)
             banks[fname] = f.view(VIEW_STANDARD).device_bank(
-                tuple(shards), mesh=self.mesh)
+                tuple(shards), mesh=self.mesh, trim=True)
+        # GroupBy only intersects, so all operands can slice down to the
+        # NARROWEST width: bits past the narrowest operand AND to zero.
+        wmin = min(b.array.shape[-1] for b in banks.values())
+        if filter_words is not None:
+            wmin = min(wmin, filter_words.shape[-1])
+            filter_words = filter_words[..., :wmin]
 
         results: List[GroupCount] = []
 
@@ -892,7 +973,7 @@ class Executor:
             if last:
                 sel = jnp.asarray(np.asarray([bank.slot(r) for r in ids],
                                              dtype=np.int32))
-                stacks = bank.array[sel]  # [R, S, W]
+                stacks = bank.array[sel][..., :wmin]  # [R, S, Wmin]
                 inter = stacks if prefix_words is None else \
                     jnp.bitwise_and(stacks, prefix_words)
                 counts = np.asarray(popcount(inter, axis=(-2, -1)))
@@ -906,7 +987,7 @@ class Executor:
                     results.append(GroupCount(group, int(c)))
                 return
             for r in ids:
-                words = bank.array[bank.slot(r)]
+                words = bank.array[bank.slot(r)][..., :wmin]
                 merged = words if prefix_words is None else \
                     jnp.bitwise_and(words, prefix_words)
                 rec(depth + 1, merged, prefix_rows + [r])
@@ -939,8 +1020,9 @@ class Executor:
                                      dtype=np.int32))
         filter_words = None
         if call.children:
-            filter_words = self._eval_tree(idx, call.children[0], shards,
-                                           mode="row")
+            filter_words = _align_words(
+                self._eval_tree(idx, call.children[0], shards, mode="row"),
+                bank.array.shape[-1])
 
         key = f"val:{op}:{bank.array.shape}:d{depth}:" \
               f"{filter_words is not None}"

@@ -228,3 +228,92 @@ def test_import_roaring(tmp_path):
     assert frag.bit(2, 10) and frag.bit(2, 11)
     np.testing.assert_array_equal(frag.row_columns(2), [10, 11])
     h.close()
+
+
+def test_topn_cache_persists_and_reloads(tmp_path):
+    """.cache sidecar flush + reload (reference flushCache fragment.go:1858,
+    openCache :252)."""
+    import os
+    from pilosa_tpu.core.fragment import Fragment
+
+    path = str(tmp_path / "frag")
+    f = Fragment(path, "i", "f", "standard", 0)
+    f.open()
+    for row, n in [(1, 3), (2, 5), (9, 1)]:
+        for c in range(n):
+            f.set_bit(row, c)
+    f.close()  # flushes cache
+    assert os.path.exists(f.cache_path())
+    g = Fragment(path, "i", "f", "standard", 0)
+    g.open()
+    assert g.cache.get(2) == 5
+    assert g.cache.get(1) == 3
+    top = g.cache.top()
+    assert top[0] == (2, 5)
+    g.close()
+
+
+def test_time_field_bulk_import_with_timestamps(tmp_path):
+    """Timestamped bulk import fans bits into quantum views (reference
+    field.Import routing per RowTime, field.go:1054, time.go:91)."""
+    from datetime import datetime
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.executor import Executor
+
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("t")
+    f = idx.create_field("e", FieldOptions(type="time",
+                                           time_quantum="YMD"))
+    ts = [datetime(2018, 1, 2), datetime(2018, 1, 5), datetime(2018, 2, 1)]
+    f.import_bits(np.array([1, 1, 1], np.uint64),
+                  np.array([10, 11, 12], np.uint64),
+                  timestamps=ts)
+    names = set(f.views.keys())
+    assert "standard_2018" in names and "standard_201801" in names \
+        and "standard_20180102" in names
+    ex = Executor(h)
+    (res,) = ex.execute(
+        "t", "Row(e=1, from='2018-01-01T00:00', to='2018-02-01T00:00')")
+    assert res.columns().tolist() == [10, 11]
+    (res,) = ex.execute(
+        "t", "Row(e=1, from='2018-01-03T00:00', to='2018-03-01T00:00')")
+    assert res.columns().tolist() == [11, 12]
+    h.close()
+
+
+def test_bulk_import_clear_flag(tmp_path):
+    """Import with clear=True removes the given bits (reference
+    fragment.bulkImport clear path / Import clear arg)."""
+    from pilosa_tpu.core.holder import Holder
+
+    h = Holder(str(tmp_path))
+    h.open()
+    f = h.create_index("c").create_field("f")
+    f.import_bits(np.array([1, 1, 1], np.uint64),
+                  np.array([5, 6, 7], np.uint64))
+    f.import_bits(np.array([1, 1], np.uint64),
+                  np.array([6, 7], np.uint64), clear=True)
+    frag = f.view().fragment(0)
+    assert frag.bit(1, 5) and not frag.bit(1, 6) and not frag.bit(1, 7)
+    h.close()
+
+
+def test_mutex_bulk_import_last_wins(tmp_path):
+    """Mutex bulk import keeps one row per column — later value wins
+    (reference bulkImportMutex, fragment.go:1605)."""
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+
+    h = Holder(str(tmp_path))
+    h.open()
+    f = h.create_index("m").create_field("mx", FieldOptions(type="mutex"))
+    f.import_bits(np.array([1, 2, 3], np.uint64),
+                  np.array([7, 7, 7], np.uint64))
+    frag = f.view().fragment(0)
+    assert not frag.bit(1, 7) and not frag.bit(2, 7) and frag.bit(3, 7)
+    # and a fresh write still clears the previous value
+    f.set_bit(1, 7)
+    assert frag.bit(1, 7) and not frag.bit(3, 7)
+    h.close()

@@ -323,7 +323,7 @@ def test_incremental_bank_patch(ex):
     idx = h.index("i")
     assert e.execute("i", "Count(Row(f=1))") == [4]
     view = idx.field("f").view()
-    key = (tuple(idx.available_shards()), None)
+    key = (tuple(idx.available_shards()), None, True)
     bank1 = view._bank_cache[key]
     e.execute("i", "Set(500, f=1)")
     assert e.execute("i", "Count(Row(f=1))") == [5]
@@ -416,3 +416,33 @@ def test_multicall_all_reads_match_serial(ex):
     assert batched[1] == serial[1]
     assert batched[2].pairs == serial[2].pairs
     assert batched[3].columns().tolist() == serial[3].columns().tolist()
+
+
+def test_mixed_width_filter_alignment(ex):
+    """TopN/Sum filters whose trimmed width differs from the target
+    bank's width align by slice/pad (width-trimmed banks)."""
+    e, h = ex
+    idx = h.create_index("i")
+    wide = idx.create_field("wide")
+    narrow = idx.create_field("narrow")
+    iv = idx.create_field("iv", FieldOptions(type="int", min=0, max=100))
+    # wide has a bit far out (wide trimmed width >> narrow's)
+    wide.import_bits(np.array([1, 1, 1], np.uint64),
+                     np.array([3, 5, 200_000], np.uint64))
+    narrow.import_bits(np.array([7, 7], np.uint64),
+                       np.array([3, 9], np.uint64))
+    iv.import_values(np.array([3, 5, 200_000], np.uint64),
+                     np.array([10, 20, 30], np.int64))
+    # narrow filter over wide field
+    (res,) = e.execute("i", "TopN(wide, Row(narrow=7), n=5)")
+    assert res.pairs == [(1, 1)]  # only column 3 intersects
+    # wide filter over narrow field
+    (res,) = e.execute("i", "TopN(narrow, Row(wide=1), n=5)")
+    assert res.pairs == [(7, 1)]
+    # narrow filter over a wider BSI bank and vice versa
+    (res,) = e.execute("i", 'Sum(Row(narrow=7), field="iv")')
+    assert (res.value, res.count) == (10, 1)
+    (res,) = e.execute("i", 'Sum(Row(wide=1), field="iv")')
+    assert (res.value, res.count) == (60, 3)
+    (res,) = e.execute("i", 'Min(Row(wide=1), field="iv")')
+    assert (res.value, res.count) == (10, 1)
