@@ -1,0 +1,116 @@
+"""Micro-benchmarks mirroring the reference's benchmark suites
+(roaring/roaring_test.go:1392-1620 kernel ops;
+fragment_internal_test.go:663-2280 import/snapshot/blocks).
+
+Each line: {"metric", "value", "unit", ...}. Device numbers use the
+default backend (TPU under axon; CPU otherwise); host numbers exercise
+the native C++ codec and the numpy storage paths."""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timeit(fn, iters=5):
+    fn()
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def emit(metric, value, unit, **extra):
+    print(json.dumps({"metric": metric, "value": value, "unit": unit,
+                      **extra}))
+
+
+def bench_roaring_kernels():
+    """IntersectionCount / Union / serialization on the host paths
+    (reference BenchmarkIntersectionCount*, BenchmarkUnion*)."""
+    from pilosa_tpu.storage.roaring import Bitmap
+    from pilosa_tpu import native
+
+    rng = np.random.default_rng(0)
+    n = 1 << 22  # 4M-bit universe
+    a = Bitmap(np.unique(rng.integers(0, n, 500_000, dtype=np.uint64)))
+    b = Bitmap(np.unique(rng.integers(0, n, 500_000, dtype=np.uint64)))
+
+    t = timeit(lambda: a.intersection_count(b))
+    emit("host_intersection_count", 1 / t, "ops/sec")
+    t = timeit(lambda: a.union(b))
+    emit("host_union", 1 / t, "ops/sec")
+    data = a.write_bytes()
+    t = timeit(lambda: a.write_bytes())
+    emit("host_roaring_serialize", len(data) / t / 1e6, "MB/sec",
+         native=native.available())
+    t = timeit(lambda: Bitmap.from_bytes(data))
+    emit("host_roaring_parse", len(data) / t / 1e6, "MB/sec",
+         native=native.available())
+
+
+def bench_fragment_paths():
+    """Import / snapshot / block checksums (reference BenchmarkFragment_*)."""
+    from pilosa_tpu.core.fragment import Fragment
+
+    rng = np.random.default_rng(1)
+    n_bits = 1_000_000
+    rows = rng.integers(0, 100, n_bits, dtype=np.uint64)
+    cols = rng.integers(0, 1 << 20, n_bits, dtype=np.uint64)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        frag = Fragment(os.path.join(tmp, "f"), "i", "f", "standard", 0)
+        frag.open()
+        t0 = time.perf_counter()
+        frag.bulk_import(rows, cols)
+        emit("fragment_bulk_import", n_bits / (time.perf_counter() - t0),
+             "bits/sec")
+        t = timeit(lambda: frag._snapshot(), iters=3)
+        emit("fragment_snapshot", 1 / t, "ops/sec")
+        t = timeit(lambda: frag.checksum_blocks(), iters=3)
+        emit("fragment_blocks_checksum", 1 / t, "ops/sec")
+        frag.close()
+
+        # reopen replays snapshot via the native codec
+        frag2 = Fragment(os.path.join(tmp, "f"), "i", "f", "standard", 0)
+        t = timeit(lambda: (frag2.open(), frag2.close()), iters=3)
+        emit("fragment_open", 1 / t, "ops/sec")
+
+
+def bench_device_kernels():
+    """Fused device sweeps (the reference's per-container kernels land
+    here as one XLA op)."""
+    import jax
+    import jax.numpy as jnp
+    from pilosa_tpu.ops.bitset import popcount, WORDS_PER_SHARD
+
+    rng = np.random.default_rng(2)
+    shape = (64, 4, WORDS_PER_SHARD)  # 64 rows x 4 shards
+    a = jnp.asarray(rng.integers(0, 2**32, shape, dtype=np.uint32))
+    b = jnp.asarray(rng.integers(0, 2**32, shape, dtype=np.uint32))
+    jax.block_until_ready((a, b))
+    nbytes = a.nbytes + b.nbytes
+
+    f = jax.jit(lambda x, y: popcount(jnp.bitwise_and(x, y),
+                                      axis=(-2, -1)))
+    np.asarray(f(a, b))
+    t = timeit(lambda: np.asarray(f(a, b)))
+    emit("device_and_popcount", nbytes / t / 1e9, "GB/sec",
+         backend=jax.devices()[0].platform)
+
+
+def main():
+    bench_roaring_kernels()
+    bench_fragment_paths()
+    bench_device_kernels()
+
+
+if __name__ == "__main__":
+    main()

@@ -205,15 +205,15 @@ class Fragment:
             cached = getattr(self, "_max_col_cache", None)
             if cached is not None and cached[0] == self.version:
                 return cached[1]
+            # Container-granular bound: the only consumer
+            # (View.trimmed_words) rounds up to whole containers anyway,
+            # so the container key alone decides the width — no dense
+            # scans. A lingering all-zero container only widens the
+            # bank, never corrupts it.
             best = -1
-            for key, dense in self.storage.containers.items():
-                nz = np.nonzero(dense)[0]
-                if not len(nz):
-                    continue
-                # Word-granular bound (w*64+63) — callers round the bank
-                # width up anyway, exact bit position is not needed.
-                best = max(best, (key % CONTAINERS_PER_ROW) * CONTAINER_BITS
-                           + int(nz[-1]) * 64 + 63)
+            for key in self.storage.containers:
+                best = max(best, ((key % CONTAINERS_PER_ROW) + 1)
+                           * CONTAINER_BITS - 1)
             self._max_col_cache = (self.version, best)
             return best
 

@@ -35,6 +35,9 @@ class TranslateStore:
         self._next_id = 1
         self._file = None
         self._lock = threading.RLock()
+        # Byte cursor into the replication PRIMARY's log (see apply_log);
+        # in-memory only — a restart re-replays from 0, idempotently.
+        self.replica_offset = 0
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -141,9 +144,16 @@ class TranslateStore:
     def read_log_from(self, offset: int) -> bytes:
         return self.log_bytes()[offset:]
 
-    def apply_log(self, data: bytes, _persist: bool = True) -> int:
+    def apply_log(self, data: bytes, _persist: bool = True,
+                  resume: bool = False) -> int:
         """Replay streamed records (replica side of replication,
-        translate.go:400)."""
+        translate.go:400). `resume=True` advances `replica_offset` by the
+        bytes fully consumed — the cursor into the PRIMARY's log stream.
+        The cursor, not our own log size, is the resume point: replicas
+        also adopt out-of-order entries from primary-fallback lookups
+        (apply_entries), so the local log is not a prefix of the
+        primary's. Replay is idempotent (known keys skip), so a stale or
+        reset cursor only costs re-download, never correctness."""
         applied = 0
         pos = 0
         with self._lock:
@@ -159,4 +169,6 @@ class TranslateStore:
                     self._insert(key, id_, persist=_persist)
                     applied += 1
                 pos += 4 + n + 8
+            if resume:
+                self.replica_offset += pos
         return applied

@@ -48,6 +48,16 @@ def view_bsi_name(field: str) -> str:
     return VIEW_BSI_PREFIX + field
 
 
+def bank_capacity(n_rows: int) -> int:
+    """Slot capacity for a bank of n_rows: next power of two above
+    n_rows + 1 (one all-zero slot) — the single source of truth shared
+    with the executor's HBM budget check."""
+    cap = 1
+    while cap < n_rows + 1:
+        cap *= 2
+    return cap
+
+
 class View:
     def __init__(self, path: str, index: str, field: str, name: str,
                  cache_type: str = cache_mod.CACHE_TYPE_RANKED,
@@ -166,9 +176,7 @@ class View:
                         return patched
             else:
                 row_set = sorted(set(rows))
-            cap = 1
-            while cap < len(row_set) + 1:
-                cap *= 2
+            cap = bank_capacity(len(row_set))
             host = np.zeros((cap, len(shards), width), dtype=np.uint32)
             slots = {}
             for i, r in enumerate(row_set):

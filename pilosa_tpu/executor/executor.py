@@ -468,7 +468,7 @@ class Executor:
         bank_arrays = tuple(b.array for b in banks)
         lits = None
         if plan.literals:
-            lits = jnp.stack([_pad_words(a, plan.width)
+            lits = jnp.stack([_align_words(a, plan.width)
                               for a in plan.literals])
             if self.mesh is not None:
                 lits = self.mesh.put_row(lits)
@@ -544,8 +544,8 @@ class Executor:
         i = len(plan.idxs)
         plan.idxs.append(bank.slot(row_id))
         plan.sig_parts.append(f"r{pos}")
-        return lambda b, idxs, p, l: _pad_words(b[pos][idxs[i]],
-                                                plan.width)
+        return lambda b, idxs, p, l: _align_words(b[pos][idxs[i]],
+                                                  plan.width)
 
     def _plan_row_leaf(self, idx: Index, call: Call, shards, plan: _Plan):
         import jax.numpy as jnp
@@ -608,7 +608,8 @@ class Executor:
         plan.idxs.extend(bank.slot(r) for r in range(depth + 1))
 
         def planes_of(b, idxs):
-            return _pad_words(b[pos][idxs[i0:i0 + depth + 1]], plan.width)
+            return _align_words(b[pos][idxs[i0:i0 + depth + 1]],
+                                plan.width)
 
         op = cond.op
         zeros = (lambda b, i, p, l:
@@ -813,11 +814,9 @@ class Executor:
         chunked: List[List[int]] = []
         # Banks are width-trimmed for the sweep: only whole-row popcounts
         # are computed, and the dropped word tail is all-zero.
+        from pilosa_tpu.core.view import bank_capacity
         width = view.trimmed_words()
-        bank_cap = 1
-        while bank_cap < len(view_rows) + 1:
-            bank_cap *= 2
-        bank_bytes = bank_cap * len(shards) * width * 4
+        bank_bytes = bank_capacity(len(view_rows)) * len(shards) * width * 4
         if bank_bytes <= TOPN_MAX_BANK_BYTES:
             # Hot path: one fused popcount sweep over the whole cached bank
             # (no gather); rows map to slots host-side, unused slots are

@@ -655,18 +655,25 @@ class API:
             return
         for idx in self.holder.indexes.values():
             try:
+                # Incremental: resume from our replica log's byte offset
+                # (reference streams the log tail from an offset,
+                # /internal/translate/data, translate.go:400).
                 if idx.keys:
-                    idx.column_translator.apply_log(
-                        self._client._req(
-                            "GET",
-                            f"{primary.uri}/internal/translate/data"
-                            f"?index={idx.name}", raw=True))
+                    st = idx.column_translator
+                    st.apply_log(self._client._req(
+                        "GET",
+                        f"{primary.uri}/internal/translate/data"
+                        f"?index={idx.name}&offset={st.replica_offset}",
+                        raw=True), resume=True)
                 for f in idx.fields.values():
                     if f.options.keys:
-                        f.row_translator.apply_log(self._client._req(
+                        st = f.row_translator
+                        st.apply_log(self._client._req(
                             "GET",
                             f"{primary.uri}/internal/translate/data"
-                            f"?index={idx.name}&field={f.name}", raw=True))
+                            f"?index={idx.name}&field={f.name}"
+                            f"&offset={st.replica_offset}", raw=True),
+                            resume=True)
             except ClientError:
                 continue
 

@@ -317,3 +317,29 @@ def test_mutex_bulk_import_last_wins(tmp_path):
     f.set_bit(1, 7)
     assert frag.bit(1, 7) and not frag.bit(3, 7)
     h.close()
+
+
+def test_translate_replica_cursor_survives_out_of_order_adoption():
+    """Incremental translate replication resumes from an explicit cursor
+    into the primary's log, not the replica's own log size — replicas
+    adopt out-of-order entries via primary-fallback lookups, so their
+    logs are not prefixes of the primary's (reference replicate loop,
+    translate.go:400)."""
+    from pilosa_tpu.core.translate import TranslateStore
+
+    primary, replica = TranslateStore(), TranslateStore()
+    a = primary.translate_key("alpha")
+    b = primary.translate_key("beta")
+    replica.apply_entries([("beta", b)])  # out-of-order adoption
+    replica.apply_log(primary.read_log_from(replica.replica_offset),
+                      resume=True)
+    assert replica.translate_id(a) == "alpha"  # not skipped by the offset
+    assert replica.replica_offset == len(primary.log_bytes())
+    # resumed pass is a no-op
+    assert replica.apply_log(
+        primary.read_log_from(replica.replica_offset), resume=True) == 0
+    # new allocations stream incrementally
+    c = primary.translate_key("gamma")
+    applied = replica.apply_log(
+        primary.read_log_from(replica.replica_offset), resume=True)
+    assert applied == 1 and replica.translate_id(c) == "gamma"
