@@ -795,6 +795,11 @@ class Executor:
             allowed_rows = set(field.row_attr_store.ids_matching(
                 attr_name, call.arg("attrValues", [])))
         tanimoto = call.uint_arg("tanimotoThreshold") or 0
+        # Candidate restriction + absolute count floor (reference
+        # topOptions.RowIDs / MinThreshold, fragment.go:1248,
+        # executor.go:698).
+        ids_arg = call.arg("ids")
+        min_threshold = call.uint_arg("threshold") or 0
 
         view_rows = sorted({r for s in shards
                             for f_ in [view.fragment(s)] if f_
@@ -802,6 +807,9 @@ class Executor:
         all_rows = view_rows
         if allowed_rows is not None:
             all_rows = [r for r in all_rows if r in allowed_rows]
+        if ids_arg:
+            wanted = {int(i) for i in ids_arg}
+            all_rows = [r for r in all_rows if r in wanted]
         if not all_rows:
             return PairsResult([])
 
@@ -873,7 +881,7 @@ class Executor:
                 keep = (denom > 0) & (
                     (counts_arr * 100) // np.maximum(denom, 1) >= tanimoto)
                 rows_arr, counts_arr = rows_arr[keep], counts_arr[keep]
-            keep = counts_arr > 0
+            keep = counts_arr > max(0, min_threshold - 1)
             rows_arr, counts_arr = rows_arr[keep], counts_arr[keep]
             # Sort by (-count, row) — vectorized; Python-loop-free even
             # for 10^5-row fingerprint sweeps.

@@ -446,3 +446,16 @@ def test_mixed_width_filter_alignment(ex):
     assert (res.value, res.count) == (60, 3)
     (res,) = e.execute("i", 'Min(Row(wide=1), field="iv")')
     assert (res.value, res.count) == (10, 1)
+
+
+def test_topn_ids_and_threshold(ex):
+    """TopN ids= candidate restriction and threshold= count floor
+    (reference topOptions.RowIDs/MinThreshold, fragment.go:1240)."""
+    e, h = ex
+    setup_basic(h)
+    (res,) = e.execute("i", "TopN(f, n=5, ids=[2])")
+    assert res.pairs == [(2, 3)]
+    (res,) = e.execute("i", "TopN(f, n=5, threshold=4)")
+    assert res.pairs == [(1, 4)]
+    (res,) = e.execute("i", "TopN(f, n=5, threshold=99)")
+    assert res.pairs == []
