@@ -143,25 +143,48 @@ def new_cache(cache_type: str, size: int):
     raise ValueError(f"invalid cache type: {cache_type}")
 
 
-def save_cache(cache, path: str) -> None:
+# Sidecar header magic; bumped when the format changes. v2 adds a stamp
+# binding the sidecar to the exact storage bytes it was computed from, so
+# a cache written before ops that reached disk without a clean close can
+# never be mistaken for complete (TopN's warm-cache shortcut relies on
+# completeness implying exactness).
+CACHE_MAGIC = 0x70635632  # "pcV2"
+
+
+def save_cache(cache, path: str, stamp: bytes = b"") -> None:
     pairs = cache.top()
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
+        f.write(struct.pack("<IH", CACHE_MAGIC, len(stamp)))
+        f.write(stamp)
         f.write(struct.pack("<Q", len(pairs)))
         for row_id, count in pairs:
             f.write(struct.pack("<QQ", row_id, count))
     os.replace(tmp, path)
 
 
-def load_cache(cache, path: str) -> None:
+def load_cache(cache, path: str, stamp: bytes = b"") -> bool:
+    """Load the sidecar into `cache`. Returns False (loading nothing) when
+    the file is absent, pre-v2, or its stamp does not match `stamp` —
+    i.e. the storage bytes changed since the cache was saved."""
     if not os.path.exists(path):
-        return
+        return False
     with open(path, "rb") as f:
         data = f.read()
-    (n,) = struct.unpack_from("<Q", data, 0)
+    if len(data) < 6:
+        return False
+    magic, stamp_len = struct.unpack_from("<IH", data, 0)
+    if magic != CACHE_MAGIC:
+        return False  # legacy/foreign sidecar: treat as cold
+    off = 6 + stamp_len
+    if data[6:off] != stamp:
+        return False
+    (n,) = struct.unpack_from("<Q", data, off)
+    off += 8
     for i in range(n):
-        row_id, count = struct.unpack_from("<QQ", data, 8 + 16 * i)
+        row_id, count = struct.unpack_from("<QQ", data, off + 16 * i)
         cache.add(row_id, count)
+    return True
 
 
 class Pairs:

@@ -84,7 +84,8 @@ class Fragment:
                     f.write(self.storage.write_bytes())
             self._file = open(self.path, "ab")
             self.storage.op_writer = self._file
-            cache_mod.load_cache(self.cache, self.cache_path())
+            cache_mod.load_cache(self.cache, self.cache_path(),
+                                 stamp=self._storage_stamp())
             # If the op log had grown past the limit, fold it into a snapshot.
             if self.storage.op_n >= self.max_op_n:
                 self._snapshot()
@@ -101,10 +102,34 @@ class Fragment:
     def cache_path(self) -> str:
         return self.path + ".cache"
 
+    def _storage_stamp(self) -> bytes:
+        """Fingerprint of the on-disk storage bytes: size + FNV of the
+        final 64 bytes. Binds the .cache sidecar to the exact storage
+        state it was computed from — ops append and snapshots rewrite, so
+        any write that reached disk after the sidecar was saved changes
+        the stamp and the loaded cache is treated as cold (an unclean
+        shutdown must not let TopN's warm-cache shortcut serve stale
+        counts)."""
+        import struct
+        from pilosa_tpu.storage.roaring import fnv1a32
+        try:
+            size = os.path.getsize(self.path)
+            with open(self.path, "rb") as f:
+                f.seek(max(0, size - 64))
+                tail = f.read(64)
+        except OSError:
+            return b""
+        return struct.pack("<QI", size, fnv1a32(tail))
+
     def flush_cache(self) -> None:
         if self.cache_type != cache_mod.CACHE_TYPE_NONE:
             try:
-                cache_mod.save_cache(self.cache, self.cache_path())
+                # The stamp must cover every op already issued: drain the
+                # op-writer buffer to disk before fingerprinting.
+                if self._file is not None:
+                    self._file.flush()
+                cache_mod.save_cache(self.cache, self.cache_path(),
+                                     stamp=self._storage_stamp())
             except OSError:
                 pass
 
@@ -319,7 +344,15 @@ class Fragment:
     def bulk_import_mutex(self, row_ids: np.ndarray, column_ids: np.ndarray
                           ) -> None:
         """Mutex import: setting (row, col) clears any other row's bit in
-        that column (reference bulkImportMutex, fragment.go:1605)."""
+        that column (reference bulkImportMutex, fragment.go:1605).
+
+        Vectorized: pack the incoming column set into one dense word mask,
+        then make ONE dense AND pass per present row to find conflicting
+        bits — O(rows × words) word ops instead of the reference's (and a
+        prior revision's) per-column row probes, which degrade to
+        O(columns × rows) single-bit reads on wide imports."""
+        from pilosa_tpu.ops.bitset import pack_positions
+
         with self._lock:
             # Within-batch dedup first: the reference applies mutex sets
             # sequentially, so for duplicate columns the LAST pair wins.
@@ -329,13 +362,27 @@ class Fragment:
                 last_for_col[c] = r
             row_ids = np.array(list(last_for_col.values()), np.uint64)
             column_ids = np.array(list(last_for_col.keys()), np.uint64)
-            present = self.row_ids()
+            offsets = column_ids % np.uint64(SHARD_WIDTH)
+            incoming_mask = pack_positions(offsets)
+            # Conflict offsets skip clearing when the existing bit IS the
+            # incoming target row; map offset -> target row for that test.
+            target_of = dict(zip(offsets.tolist(),
+                                 row_ids.astype(np.int64).tolist()))
+            shard_base = np.uint64(self.shard * SHARD_WIDTH)
             to_clear_rows, to_clear_cols = [], []
-            for c, r in last_for_col.items():
-                cur = self.mutex_vector(c, present)
-                if cur is not None and cur != r:
-                    to_clear_rows.append(cur)
-                    to_clear_cols.append(c)
+            for r in self.row_ids():
+                hit = self.row_dense(r) & incoming_mask
+                nz = np.nonzero(hit)[0]
+                if not len(nz):
+                    continue
+                bits = np.unpackbits(hit[nz].view(np.uint8),
+                                     bitorder="little")
+                local = np.nonzero(bits)[0]
+                conflict = nz[local // 32] * 32 + local % 32
+                for off in conflict.tolist():
+                    if target_of.get(off) != r:
+                        to_clear_rows.append(r)
+                        to_clear_cols.append(off + int(shard_base))
             if to_clear_rows:
                 self.bulk_import(np.array(to_clear_rows, np.uint64),
                                  np.array(to_clear_cols, np.uint64), clear=True)
@@ -375,6 +422,13 @@ class Fragment:
                 words_to_u64(np.ascontiguousarray(words, dtype=np.uint32)))
             bits = words.size * 32
             if bits < SHARD_WIDTH:
+                # The tail-clear below pops whole containers starting at
+                # the container holding bit `bits`; a non-container-aligned
+                # width would silently drop just-written words from that
+                # container. All callers pass container multiples (trimmed
+                # bank widths and plan widths are container-aligned).
+                assert bits % CONTAINER_BITS == 0, \
+                    f"set_row width {bits} not container-aligned"
                 k0 = (row_id * SHARD_WIDTH + bits) >> 16
                 k1 = ((row_id + 1) * SHARD_WIDTH - 1) >> 16
                 for k in range(k0, k1 + 1):

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 from dataclasses import dataclass, field as dc_field
 from datetime import datetime
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -48,6 +49,18 @@ from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
 
 _BITMAP_CALLS = {"Row", "Range", "Intersect", "Union", "Difference", "Xor",
                  "Not", "Shift"}
+
+# Calls that mutate fragment bitmaps. Used to decide whether a deferred
+# read in the same multi-call query may lazily re-read fragment state in
+# finalize (safe only when no later call writes — reference executes calls
+# strictly sequentially, executor.go:245).
+_WRITE_CALLS = {"Set", "Clear", "ClearRow", "Store"}
+
+
+def _peel_options(call: "Call") -> "Call":
+    while call.name == "Options" and call.children:
+        call = call.children[0]
+    return call
 
 # Expand time-range unions statically up to this many views; beyond it the
 # union is precomputed eagerly into a literal operand (keeps compile sizes
@@ -175,6 +188,12 @@ class Executor:
         self.holder = holder
         self.mesh = mesh
         self._jit_cache: Dict[str, Callable] = {}
+        # Per-thread dispatch context (one executor serves all request
+        # threads): whether calls after the one being dispatched write.
+        self._tls = threading.local()
+        # Observability: TopN answers served from warm ranked caches
+        # without any device work (reference fragment.top, fragment.go:1067).
+        self.topn_cache_hits = 0
         # Cluster mode installs a resolver that allocates keys on the
         # translation primary (reference: primary-owned TranslateFile with
         # chained replication, translate.go:56,400). None = local stores.
@@ -237,10 +256,17 @@ class Executor:
         # the TPU analog of the reference streaming per-shard results
         # into reduceFn as they arrive (executor.go:2277).
         staged = []
-        for call in query.calls:
+        calls = list(query.calls)
+        for i, call in enumerate(calls):
             self._translate_call(idx, call)
+            # Deferred reads (TopN chunking) consult this to know whether
+            # lazily re-reading fragment state in finalize is still safe.
+            self._tls.later_writes = any(
+                _peel_options(c).name in _WRITE_CALLS
+                for c in calls[i + 1:])
             staged.append((call, self._execute_call(idx, call, shards,
                                                     opts)))
+        self._tls.later_writes = False
         results = []
         for call, result in staged:
             if isinstance(result, _Pending):
@@ -813,6 +839,29 @@ class Executor:
         if not all_rows:
             return PairsResult([])
 
+        # Warm-cache shortcut (reference fragment.top over rankCache,
+        # fragment.go:1067, cache.go:136): when every fragment's cache
+        # still holds EVERY present row (cardinality within the cache
+        # bound, so nothing was ever evicted), the cached per-row counts
+        # are exact — every write path refreshes them — and TopN needs no
+        # device work at all. Filters and tanimoto need real bitmaps, so
+        # they always take the sweep.
+        if filter_words is None and not tanimoto:
+            cached = self._topn_cached_counts(view, shards)
+            if cached is not None:
+                self.topn_cache_hits += 1
+                rows_arr = np.asarray(all_rows, dtype=np.uint64)
+                counts_arr = np.fromiter(
+                    (cached.get(r, 0) for r in all_rows),
+                    dtype=np.int64, count=len(all_rows))
+                keep = counts_arr > max(0, min_threshold - 1)
+                rows_arr, counts_arr = rows_arr[keep], counts_arr[keep]
+                order = np.lexsort((rows_arr, -counts_arr))
+                if n:
+                    order = order[:n]
+                return PairsResult([(int(rows_arr[o]), int(counts_arr[o]))
+                                    for o in order])
+
         # Dispatch phase: queue every device program (counts sweeps, and
         # the tanimoto denominator popcount); nothing is fetched yet.
         # The HBM bound must consider the *bank* size (all view rows), not
@@ -891,7 +940,42 @@ class Executor:
             pairs = [(int(rows_arr[o]), int(counts_arr[o])) for o in order]
             return PairsResult(pairs)
 
+        if chunked and getattr(self._tls, "later_writes", False):
+            # A later call in this query writes fragments. Chunk banks
+            # upload lazily inside finalize — which would run AFTER those
+            # writes and read post-write state, breaking sequential
+            # semantics (reference executes calls in order,
+            # executor.go:245). Materialize now, before any write runs;
+            # the full-bank path needs no such care because its device
+            # arrays snapshot at dispatch.
+            return finalize()
         return _Pending(finalize)
+
+    def _topn_cached_counts(self, view, shards) -> Optional[Dict[int, int]]:
+        """Summed per-row counts from fragment caches, or None when any
+        fragment's cache cannot prove completeness (cache disabled, rows
+        evicted, or counts missing)."""
+        from pilosa_tpu.core import cache as cache_mod
+
+        total: Dict[int, int] = {}
+        for s in shards:
+            frag = view.fragment(s)
+            if frag is None:
+                continue
+            if frag.cache_type == cache_mod.CACHE_TYPE_NONE:
+                return None
+            counts = getattr(frag.cache, "counts", None)
+            if counts is None:
+                return None
+            rows = frag.row_ids()
+            if len(counts) < len(rows):
+                return None
+            for r in rows:
+                c = counts.get(r)
+                if c is None:  # evicted: cache incomplete for this frag
+                    return None
+                total[r] = total.get(r, 0) + c
+        return total
 
     # ----------------------------------------------------------------- Rows
 

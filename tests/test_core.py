@@ -319,6 +319,73 @@ def test_mutex_bulk_import_last_wins(tmp_path):
     h.close()
 
 
+def test_cache_sidecar_rejected_after_unclean_shutdown(tmp_path):
+    """A .cache sidecar saved before later ops reached disk must load as
+    COLD on reopen — a complete-looking stale cache would let TopN's
+    warm-cache shortcut serve wrong counts. The sidecar is stamped with
+    the storage bytes it was computed from (size + tail checksum)."""
+    from pilosa_tpu.core.fragment import Fragment
+
+    path = str(tmp_path / "frag")
+    f1 = Fragment(path, "i", "f", "standard", 0)
+    f1.open()
+    f1.bulk_import(np.array([1, 1, 1], np.uint64),
+                   np.array([1, 2, 3], np.uint64))
+    f1.close()  # clean: sidecar saved, stamp matches
+
+    # Clean reopen loads the cache.
+    f2 = Fragment(path, "i", "f", "standard", 0)
+    f2.open()
+    assert len(f2.cache) == 1 and f2.cache.get(1) == 3
+    # More writes reach the op log on disk...
+    f2.bulk_import(np.array([2, 2, 2, 2], np.uint64),
+                   np.array([1, 2, 3, 4], np.uint64))
+    f2._file.flush()
+    # ...but the process dies without close(): no sidecar update.
+    f2._file.close()
+    f2.storage.op_writer = None
+
+    f3 = Fragment(path, "i", "f", "standard", 0)
+    f3.open()
+    assert f3.row_count(2) == 4  # ops replayed: storage is current
+    # Stale sidecar rejected — cache cold, so the TopN shortcut is
+    # ineligible and the exact sweep answers.
+    assert len(f3.cache) == 0
+    f3.close()
+
+
+def test_mutex_bulk_import_vectorized_conflicts(tmp_path):
+    """Wide mutex import against pre-existing assignments: the dense
+    conflict pass must clear exactly the columns whose row changes and
+    keep columns re-asserting their current row (reference
+    bulkImportMutex, fragment.go:1605). Cross-checked against a dict
+    model."""
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+
+    rng = np.random.default_rng(7)
+    h = Holder(str(tmp_path))
+    h.open()
+    f = h.create_index("m").create_field("mx", FieldOptions(type="mutex"))
+
+    model = {}
+    for _ in range(3):
+        cols = rng.integers(0, 5000, 800, dtype=np.uint64)
+        rows = rng.integers(0, 20, 800, dtype=np.uint64)
+        f.import_bits(rows, cols)
+        for r, c in zip(rows.tolist(), cols.tolist()):
+            model[c] = r
+
+    frag = f.view().fragment(0)
+    got = {}
+    for r in frag.row_ids():
+        for c in frag.row_columns(r).tolist():
+            assert c not in got, f"column {c} set in rows {got[c]} and {r}"
+            got[c] = r
+    assert got == model
+    h.close()
+
+
 def test_translate_replica_cursor_survives_out_of_order_adoption():
     """Incremental translate replication resumes from an explicit cursor
     into the primary's log, not the replica's own log size — replicas

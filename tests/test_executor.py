@@ -404,6 +404,55 @@ def test_multicall_query_pipelines_with_correct_ordering(ex):
     assert results[5] == 4
 
 
+def test_topn_warm_cache_shortcut(ex):
+    """Unfiltered TopN on a field whose ranked cache still holds every
+    present row is answered from the cache with no device sweep
+    (reference fragment.top over rankCache, fragment.go:1067); filtered
+    TopN always sweeps."""
+    e, h = ex
+    setup_basic(h)
+    before = e.topn_cache_hits
+    (res,) = e.execute("i", "TopN(f, n=2)")
+    assert res.pairs == [(1, 4), (2, 3)]
+    assert e.topn_cache_hits == before + 1
+    # threshold/ids are host-side filters — still cache-served
+    (res,) = e.execute("i", "TopN(f, n=5, threshold=4)")
+    assert res.pairs == [(1, 4)]
+    assert e.topn_cache_hits == before + 2
+    # a bitmap filter needs the real rows: no cache hit
+    (res,) = e.execute("i", "TopN(f, Row(g=1), n=1)")
+    assert res.pairs == [(2, 2)]
+    assert e.topn_cache_hits == before + 2
+    # writes keep the cached counts exact
+    e.execute("i", "Set(100, f=2) Set(101, f=2) Set(102, f=2)")
+    (res,) = e.execute("i", "TopN(f, n=2)")
+    assert res.pairs == [(2, 6), (1, 4)]
+    assert e.topn_cache_hits == before + 3
+
+
+def test_topn_chunked_respects_later_writes(ex, monkeypatch):
+    """A chunked TopN in a query with later writes must snapshot
+    pre-write state (sequential call semantics, reference
+    executor.go:245) even though chunk banks normally upload lazily
+    after all dispatches."""
+    import pilosa_tpu.executor.executor as ex_mod
+
+    e, h = ex
+    idx = h.create_index("i")
+    f = idx.create_field("f", FieldOptions(cache_type="none"))
+    f.import_bits(np.array([1, 1, 1, 2, 2, 3], np.uint64),
+                  np.array([1, 2, 3, 2, 3, 5], np.uint64))
+    monkeypatch.setattr(ex_mod, "TOPN_MAX_BANK_BYTES", 0)
+    monkeypatch.setattr(ex_mod, "TOPN_CHUNK_ROWS", 1)
+    results = e.execute("i", (
+        "TopN(f, n=5) "
+        "Set(10, f=3) Set(11, f=3) Set(12, f=3) Set(13, f=3) "
+        "TopN(f, n=5)"
+    ))
+    assert results[0].pairs == [(1, 3), (2, 2), (3, 1)]  # pre-write
+    assert results[5].pairs == [(3, 5), (1, 3), (2, 2)]  # post-write
+
+
 def test_multicall_all_reads_match_serial(ex):
     """Batched multi-call results identical to one-call-at-a-time."""
     e, h = ex
