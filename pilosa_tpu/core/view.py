@@ -21,6 +21,58 @@ VIEW_STANDARD = "standard"
 VIEW_BSI_PREFIX = "bsig_"
 
 
+class BankBudget:
+    """Process-wide LRU accounting of cached device banks, bounding total
+    HBM spent on operand banks. The reference never needs this because it
+    streams one shard at a time from mmap (executor.go:2377); here banks
+    persist in HBM across queries for reuse, so an explicit budget decides
+    what stays resident. Evicted banks drop out of their view's cache (the
+    device array frees once the last query referencing it drains)."""
+
+    def __init__(self, budget_bytes: int):
+        self.budget = budget_bytes
+        self._lock = threading.Lock()
+        # (id(view), key) -> (view, nbytes), in LRU order (oldest first).
+        from collections import OrderedDict
+        self._entries: "OrderedDict" = OrderedDict()
+        self.total = 0
+        self.evictions = 0
+
+    def admit(self, view: "View", key) -> None:
+        bank = view._bank_cache.get(key)
+        if bank is None:
+            return
+        nbytes = int(np.prod(bank.array.shape)) * 4
+        ek = (id(view), key)
+        with self._lock:
+            old = self._entries.pop(ek, None)
+            if old is not None:
+                self.total -= old[1]
+            while self._entries and self.total + nbytes > self.budget:
+                (vid, vkey), (v, nb) = self._entries.popitem(last=False)
+                self.total -= nb
+                self.evictions += 1
+                v._bank_cache.pop(vkey, None)
+            self._entries[ek] = (view, nbytes)
+            self.total += nbytes
+
+    def touch(self, view: "View", key) -> None:
+        ek = (id(view), key)
+        with self._lock:
+            if ek in self._entries:
+                self._entries.move_to_end(ek)
+
+    def forget(self, view: "View", key) -> None:
+        with self._lock:
+            old = self._entries.pop((id(view), key), None)
+            if old is not None:
+                self.total -= old[1]
+
+
+BANK_BUDGET = BankBudget(
+    int(os.environ.get("PILOSA_TPU_HBM_BUDGET_BYTES", 8 << 30)))
+
+
 class ViewBank:
     """A view's rows stacked across shards as ONE device array
     [row_capacity, n_shards, WORDS_PER_SHARD] (uint32) in HBM.
@@ -90,6 +142,9 @@ class View:
 
     def close(self) -> None:
         with self._lock:
+            for key in list(self._bank_cache):
+                BANK_BUDGET.forget(self, key)
+            self._bank_cache.clear()
             for frag in self.fragments.values():
                 frag.close()
 
@@ -139,21 +194,27 @@ class View:
                    * cwords)
 
     def device_bank(self, shards, rows=None, mesh=None,
-                    trim: bool = False) -> ViewBank:
+                    trim: bool = False, cache_rows: bool = False
+                    ) -> ViewBank:
         """Bank for `shards` covering `rows` (default: all rows present in
         any of the shards). Cached per (shard tuple, mesh, trim); rebuilt
         when any fragment's write version moved. `rows` subsets build
-        transient (uncached) banks — used by chunked TopN over huge row
-        sets. trim=True narrows the word axis to trimmed_words() — valid
-        only for whole-row consumers (TopN popcount sweeps) since the
-        dropped tail is all-zero by construction. With a MeshContext the
-        array is device_put sharded over the mesh's shard axis, which is
-        all the executor needs to run SPMD."""
+        transient banks (chunked TopN) unless cache_rows=True, which caches
+        them under a rows-inclusive key — the executor's Row-leaf path uses
+        this when the FULL view bank would blow the HBM budget (a single
+        Row(f=x) on a million-row field must not upload the whole field;
+        reference never faces this because it streams per-shard,
+        executor.go:2377). All cached banks are LRU-accounted against
+        BANK_BUDGET. trim=True narrows the word axis to trimmed_words() —
+        valid only for whole-row consumers since the dropped tail is
+        all-zero by construction. With a MeshContext the array is
+        device_put sharded over the mesh's shard axis, which is all the
+        executor needs to run SPMD."""
         import jax.numpy as jnp
         from pilosa_tpu.ops.bitset import WORDS_PER_SHARD
 
         shards = tuple(shards)
-        cache_key = (shards, mesh.cache_key() if mesh else None, trim)
+        mesh_key = mesh.cache_key() if mesh else None
         with self._lock:
             frags = {s: self.fragments.get(s) for s in shards}
             versions = {s: (f.version if f else -1) for s, f in frags.items()}
@@ -162,20 +223,31 @@ class View:
             # reads as stale and rebuilds — never silently wrong.
             width = self.trimmed_words() if trim else WORDS_PER_SHARD
             if rows is None:
+                cache_key = (shards, mesh_key, trim)
                 row_set = sorted({r for f in frags.values() if f
                                   for r in f.row_ids()})
                 cached = self._bank_cache.get(cache_key)
                 if cached is not None and cached.array.shape[-1] == width:
                     if (cached.versions == versions
                             and all(r in cached.slots for r in row_set)):
+                        BANK_BUDGET.touch(self, cache_key)
                         return cached
                     patched = self._patch_bank(cached, frags, versions,
                                                row_set, shards, width)
                     if patched is not None:
                         self._bank_cache[cache_key] = patched
+                        BANK_BUDGET.touch(self, cache_key)
                         return patched
             else:
                 row_set = sorted(set(rows))
+                cache_key = (shards, mesh_key, trim, tuple(row_set))
+                if cache_rows:
+                    cached = self._bank_cache.get(cache_key)
+                    if cached is not None \
+                            and cached.array.shape[-1] == width \
+                            and cached.versions == versions:
+                        BANK_BUDGET.touch(self, cache_key)
+                        return cached
             cap = bank_capacity(len(row_set))
             host = np.zeros((cap, len(shards), width), dtype=np.uint32)
             slots = {}
@@ -187,8 +259,9 @@ class View:
                         host[i, si] = f.row_dense(r, u32_words=width)
             array = mesh.put_bank(host) if mesh else jnp.asarray(host)
             bank = ViewBank(array, slots, cap - 1, versions)
-            if rows is None:
+            if rows is None or cache_rows:
                 self._bank_cache[cache_key] = bank
+                BANK_BUDGET.admit(self, cache_key)
             return bank
 
     def _patch_bank(self, cached: "ViewBank", frags, versions, row_set,

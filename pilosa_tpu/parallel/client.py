@@ -148,6 +148,23 @@ class InternalClient:
     def join(self, uri: str, node: dict) -> dict:
         return self._req("POST", f"{uri}/internal/join", obj=node)
 
+    def resize_pull(self, uri: str, timeout: float = 600.0) -> dict:
+        """Synchronous pull pass on a member during a resize job (the data
+        motion of the reference's ResizeInstruction, cluster.go:1251).
+        Long timeout: the node streams every fragment it now owns."""
+        req = urllib.request.Request(f"{uri}/internal/resize/pull",
+                                     data=b"", method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode("utf-8", "replace")[:500]
+            raise ClientError(
+                f"POST {uri}/internal/resize/pull: {e.code}: {detail}") \
+                from e
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            raise ClientError(f"POST {uri}/internal/resize/pull: {e}") from e
+
     def cluster_message(self, uri: str, message: dict) -> None:
         self._req("POST", f"{uri}/internal/cluster/message", obj=message)
 

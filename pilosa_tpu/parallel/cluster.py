@@ -62,6 +62,12 @@ class Cluster:
         self.topology_path = topology_path
         self.state = STATE_STARTING
         self._nodes: Dict[str, Node] = {local.id: local}
+        # While RESIZING, reads route against this pre-change snapshot of
+        # the node list — the nodes that actually hold the data — until the
+        # resize job reports completion (the safety the reference gets from
+        # rejecting queries in state RESIZING, api.go:76-99; here the query
+        # path stays available instead).
+        self.prev_nodes: Optional[List[Node]] = None
         self._lock = threading.RLock()
 
     # -- membership ---------------------------------------------------------
@@ -84,25 +90,76 @@ class Cluster:
 
     def node_by_id(self, node_id: str) -> Optional[Node]:
         with self._lock:
-            return self._nodes.get(node_id)
+            hit = self._nodes.get(node_id)
+            if hit is None and self.prev_nodes is not None:
+                # A node can be routable-by-previous-placement (reads
+                # during a remove-node resize) without being a member.
+                hit = next((n for n in self.prev_nodes if n.id == node_id),
+                           None)
+            return hit
 
     def _update_state(self) -> None:
-        if self.state != STATE_STARTING:
+        if self.state not in (STATE_STARTING, STATE_RESIZING):
             self.state = STATE_NORMAL
 
     def set_state(self, state: str) -> None:
         with self._lock:
             self.state = state
 
+    # -- resize lifecycle ----------------------------------------------------
+
+    def begin_resize(self, prev: Optional[List[Node]] = None) -> None:
+        """Enter RESIZING, pinning the pre-change placement (reference
+        broadcasts ClusterStatus{state: RESIZING}, cluster.go:1070). If a
+        second topology change arrives mid-resize the ORIGINAL snapshot is
+        kept — data still lives where the oldest placement says."""
+        with self._lock:
+            if self.prev_nodes is None:
+                self.prev_nodes = (list(prev) if prev is not None
+                                   else self.nodes())
+            self.state = STATE_RESIZING
+            self.save()
+
+    def end_resize(self) -> None:
+        """Resize complete (or aborted): adopt the current placement for
+        reads and return to NORMAL (reference broadcasts NORMAL after the
+        job completes, cluster.go:1048-1060)."""
+        with self._lock:
+            self.prev_nodes = None
+            if self.state == STATE_RESIZING:
+                self.state = STATE_NORMAL
+            self.save()
+
     # -- placement ----------------------------------------------------------
 
-    def shard_nodes(self, index: str, shard: int) -> List[Node]:
+    def shard_nodes(self, index: str, shard: int,
+                    previous: bool = False) -> List[Node]:
         """Primary + replicas for a shard (reference ShardNodes,
-        cluster.go:840)."""
-        nodes = self.nodes()
+        cluster.go:840). previous=True computes against the pre-resize
+        snapshot (falls back to current when not resizing)."""
+        with self._lock:
+            if previous and self.prev_nodes is not None:
+                nodes = sorted(self.prev_nodes, key=lambda n: n.id)
+            else:
+                nodes = self.nodes()
         idxs = shard_nodes(index, shard, len(nodes), self.replica_n,
                            self.partition_n)
         return [nodes[i] for i in idxs]
+
+    def write_nodes(self, index: str, shard: int) -> List[Node]:
+        """Nodes a write must reach: current owners, plus — during a
+        resize — the pre-change owners (old owners still serve reads, new
+        owners may already have pulled; writing to the union closes the
+        window where a write lands only on one side)."""
+        cur = self.shard_nodes(index, shard)
+        with self._lock:
+            resizing = self.state == STATE_RESIZING and \
+                self.prev_nodes is not None
+        if not resizing:
+            return cur
+        prev = self.shard_nodes(index, shard, previous=True)
+        seen = {n.id for n in prev}
+        return prev + [n for n in cur if n.id not in seen]
 
     def owns_shard(self, index: str, shard: int) -> bool:
         return any(n.id == self.local.id
@@ -113,14 +170,14 @@ class Cluster:
         return bool(sn) and sn[0].id == self.local.id
 
     def shards_by_node(self, index: str, shards: List[int],
-                       exclude_ids: Optional[set] = None
-                       ) -> Dict[str, List[int]]:
+                       exclude_ids: Optional[set] = None,
+                       previous: bool = False) -> Dict[str, List[int]]:
         """Group shards by serving node id, preferring the primary and
         falling back down the replica chain when primaries are excluded
         (the mapReduce retry path, executor.go:2313-2324)."""
         out: Dict[str, List[int]] = {}
         for shard in shards:
-            for node in self.shard_nodes(index, shard):
+            for node in self.shard_nodes(index, shard, previous=previous):
                 if exclude_ids and node.id in exclude_ids:
                     continue
                 out.setdefault(node.id, []).append(shard)
@@ -136,9 +193,15 @@ class Cluster:
         if not self.topology_path:
             return
         tmp = self.topology_path + ".tmp"
+        doc = {"nodes": [n.to_json() for n in self.nodes()],
+               "replicaN": self.replica_n}
+        if self.prev_nodes is not None:
+            # Survive a restart mid-resize: reads keep the safe pre-change
+            # placement until the job (or an abort) finishes.
+            doc["resizing"] = True
+            doc["prevNodes"] = [n.to_json() for n in self.prev_nodes]
         with open(tmp, "w") as f:
-            json.dump({"nodes": [n.to_json() for n in self.nodes()],
-                       "replicaN": self.replica_n}, f)
+            json.dump(doc, f)
         os.replace(tmp, self.topology_path)
 
     def load(self) -> None:
@@ -152,10 +215,17 @@ class Cluster:
                 if node.id != self.local.id:
                     self._nodes[node.id] = node
             self.replica_n = data.get("replicaN", self.replica_n)
+            if data.get("resizing"):
+                self.prev_nodes = [Node.from_json(nd)
+                                   for nd in data.get("prevNodes", [])]
+                self.state = STATE_RESIZING
 
     def status(self) -> dict:
         with self._lock:
-            return {"state": self.state,
-                    "localID": self.local.id,
-                    "replicaN": self.replica_n,
-                    "nodes": [n.to_json() for n in self.nodes()]}
+            out = {"state": self.state,
+                   "localID": self.local.id,
+                   "replicaN": self.replica_n,
+                   "nodes": [n.to_json() for n in self.nodes()]}
+            if self.prev_nodes is not None:
+                out["prevNodes"] = [n.to_json() for n in self.prev_nodes]
+            return out

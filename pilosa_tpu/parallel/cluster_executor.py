@@ -150,7 +150,13 @@ class ClusterExecutor:
         idx = self.local.holder.index(index)
         if idx is not None:
             shards.update(idx.available_shards())
-        for node in self.cluster.nodes():
+        # During a resize, data may live only on a pre-change member (e.g.
+        # a just-removed node) — ask the union of current and previous
+        # membership so discovery cannot miss shards mid-move.
+        nodes = {n.id: n for n in self.cluster.nodes()}
+        for n in (self.cluster.prev_nodes or []):
+            nodes.setdefault(n.id, n)
+        for node in nodes.values():
             if node.id == self.cluster.local.id:
                 continue
             try:
@@ -200,12 +206,20 @@ class ClusterExecutor:
         return self._map_reduce(index, call, all_shards)
 
     def _map_reduce(self, index: str, call: Call, shards: List[int]) -> Any:
+        from pilosa_tpu.parallel.cluster import STATE_RESIZING
+        # While RESIZING, route reads against the pre-change placement:
+        # those nodes are guaranteed to still hold the data (pulls never
+        # delete source copies), where the new placement may point at an
+        # owner that has not pulled yet and would silently undercount
+        # (reference instead rejects queries in RESIZING, api.go:76-99).
+        previous = self.cluster.state == STATE_RESIZING
         excluded: set = set()
         last_err: Optional[Exception] = None
         for _ in range(max(1, self.cluster.replica_n)):
             try:
                 by_node = self.cluster.shards_by_node(index, shards,
-                                                      exclude_ids=excluded)
+                                                      exclude_ids=excluded,
+                                                      previous=previous)
             except RuntimeError as e:
                 raise last_err or e
             parts: List[Any] = []
@@ -264,7 +278,9 @@ class ClusterExecutor:
             self.local._translate_call(self.local.holder.index(index), call)
             col = call.args["_col"]
         shard = int(col) // SHARD_WIDTH
-        owners = self.cluster.shard_nodes(index, shard)
+        # write_nodes = current owners ∪ pre-resize owners while RESIZING,
+        # so a write can't land only on the side a reader won't consult.
+        owners = self.cluster.write_nodes(index, shard)
         result = False
         applied = 0
         last_err: Optional[Exception] = None

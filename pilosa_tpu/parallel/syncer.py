@@ -184,46 +184,50 @@ class ResizePuller:
             self.logger.printf(fmt, *args)
 
     def pull_owned(self) -> int:
-        """Returns number of fragments fetched."""
-        from pilosa_tpu.parallel.cluster import STATE_NORMAL, STATE_RESIZING
-
-        peers = [n for n in self.cluster.nodes()
+        """Returns number of fragments fetched. Cluster state is owned by
+        the resize job protocol (server/api.py _start_resize_job), not
+        here: during the pull the cluster stays RESIZING so reads keep
+        routing against the pre-change placement."""
+        # Pull sources: current members ∪ pre-resize members. After a
+        # remove-node resize the only holder of a shard may be the node
+        # being removed (alive, detached) — it is still reachable via the
+        # prev snapshot, exactly like the reference sourcing resize
+        # instructions from the pre-change owners (cluster.go:741-826).
+        sources = {n.id: n for n in self.cluster.nodes()}
+        for n in (self.cluster.prev_nodes or []):
+            sources.setdefault(n.id, n)
+        peers = [n for n in sources.values()
                  if n.id != self.cluster.local.id]
         if not peers:
             return 0
-        self.cluster.set_state(STATE_RESIZING)
         fetched = 0
-        try:
-            # Discover remote schema + shard holdings.
-            for peer in peers:
-                try:
-                    schema = self.client.schema(peer.uri)
-                except ClientError:
-                    continue
-                for idx_info in schema.get("indexes", []):
-                    iname = idx_info["name"]
-                    idx = self.holder.index(iname)
-                    if idx is None:
-                        idx = self.holder.create_index(
-                            iname, keys=idx_info["options"].get("keys",
-                                                                False),
-                            track_existence=idx_info["options"].get(
-                                "trackExistence", True))
-                    for f_info in idx_info.get("fields", []):
-                        if idx.field(f_info["name"]) is None:
-                            from pilosa_tpu.core.field import FieldOptions
-                            o = f_info["options"]
-                            idx.create_field(f_info["name"], FieldOptions(
-                                type=o.get("type", "set"),
-                                cache_type=o.get("cacheType", "ranked"),
-                                cache_size=o.get("cacheSize", 50000),
-                                min=o.get("min", 0), max=o.get("max", 0),
-                                time_quantum=o.get("timeQuantum", ""),
-                                keys=o.get("keys", False)))
-                    for shard in idx_info.get("shards", []):
-                        fetched += self._maybe_pull(peer, idx, shard)
-        finally:
-            self.cluster.set_state(STATE_NORMAL)
+        # Discover remote schema + shard holdings.
+        for peer in peers:
+            try:
+                schema = self.client.schema(peer.uri)
+            except ClientError:
+                continue
+            for idx_info in schema.get("indexes", []):
+                iname = idx_info["name"]
+                idx = self.holder.index(iname)
+                if idx is None:
+                    idx = self.holder.create_index(
+                        iname, keys=idx_info["options"].get("keys", False),
+                        track_existence=idx_info["options"].get(
+                            "trackExistence", True))
+                for f_info in idx_info.get("fields", []):
+                    if idx.field(f_info["name"]) is None:
+                        from pilosa_tpu.core.field import FieldOptions
+                        o = f_info["options"]
+                        idx.create_field(f_info["name"], FieldOptions(
+                            type=o.get("type", "set"),
+                            cache_type=o.get("cacheType", "ranked"),
+                            cache_size=o.get("cacheSize", 50000),
+                            min=o.get("min", 0), max=o.get("max", 0),
+                            time_quantum=o.get("timeQuantum", ""),
+                            keys=o.get("keys", False)))
+                for shard in idx_info.get("shards", []):
+                    fetched += self._maybe_pull(peer, idx, shard)
         return fetched
 
     def _maybe_pull(self, peer, idx, shard: int) -> int:
@@ -253,9 +257,16 @@ class ResizePuller:
         return fetched
 
     def clean_unowned(self) -> int:
-        """Drop fragments this node no longer owns (holderCleaner)."""
+        """Drop fragments this node no longer owns (holderCleaner). Never
+        runs while RESIZING: reads still route against the pre-change
+        placement, so an old owner's copy is live data (the reference's
+        holderCleaner likewise runs only after the cluster returns to
+        NORMAL, holder.go:859)."""
         import os
         import shutil
+        from pilosa_tpu.parallel.cluster import STATE_RESIZING
+        if self.cluster.state == STATE_RESIZING:
+            return 0
         removed = 0
         for iname, idx in list(self.holder.indexes.items()):
             for field in list(idx.fields.values()):

@@ -219,9 +219,23 @@ class API:
     def schema(self) -> Dict[str, Any]:
         return {"indexes": self.holder.schema()}
 
+    def _validate_normal(self, method: str) -> None:
+        """Schema mutations are not allowed while RESIZING (reference
+        api.validate against methodsNormal, api.go:76-99: only cluster
+        messages, fragment streaming and abort run in that state; queries
+        and imports additionally stay available here because reads route
+        via the pre-change placement and writes go to the owner union)."""
+        if self.cluster is None:
+            return
+        from pilosa_tpu.parallel.cluster import STATE_RESIZING
+        if self.cluster.state == STATE_RESIZING:
+            raise ApiError(
+                f"api method {method} not allowed in state RESIZING", 409)
+
     def create_index(self, name: str, keys: bool = False,
                      track_existence: bool = True,
                      remote: bool = False) -> Dict[str, Any]:
+        self._validate_normal("CreateIndex")
         try:
             idx = self.holder.create_index(name, keys=keys,
                                            track_existence=track_existence)
@@ -249,6 +263,7 @@ class API:
                 pass  # healed by resize pull / anti-entropy
 
     def delete_index(self, name: str) -> None:
+        self._validate_normal("DeleteIndex")
         try:
             self.holder.delete_index(name)
         except KeyError as e:
@@ -257,6 +272,7 @@ class API:
     def create_field(self, index: str, name: str,
                      options: Optional[dict] = None,
                      remote: bool = False) -> Dict[str, Any]:
+        self._validate_normal("CreateField")
         idx = self._index(index)
         opts = FieldOptions()
         options = dict(options or {})
@@ -278,6 +294,7 @@ class API:
         return {"name": f.name}
 
     def delete_field(self, index: str, name: str) -> None:
+        self._validate_normal("DeleteField")
         idx = self._index(index)
         try:
             idx.delete_field(name)
@@ -330,7 +347,8 @@ class API:
         shards = columns // np.uint64(SHARD_WIDTH)
         by_node: Dict[str, List[int]] = {}
         for i, shard in enumerate(shards.tolist()):
-            for node in self.cluster.shard_nodes(index, int(shard)):
+            # write_nodes: current ∪ pre-resize owners while RESIZING.
+            for node in self.cluster.write_nodes(index, int(shard)):
                 by_node.setdefault(node.id, []).append(i)
         for node_id, idxs in by_node.items():
             node = self.cluster.node_by_id(node_id)
@@ -496,46 +514,105 @@ class API:
         return sorted(self._field(idx, field).views.keys())
 
     def handle_join(self, node_info: dict) -> dict:
-        """A node announces itself; topology updates and replicates
-        (reference coordinator nodeJoin, cluster.go:1017-1148)."""
+        """A node announces itself; topology updates and replicates, and
+        this node drives the resize job (reference coordinator nodeJoin →
+        generateResizeJob, cluster.go:1017-1230). The cluster enters
+        RESIZING with the pre-join placement pinned for reads; every node
+        pulls its newly-owned fragments; on completion NORMAL is broadcast
+        and the new placement takes over."""
         if self.cluster is None:
             raise ApiError("not clustered", 400)
         from pilosa_tpu.parallel.cluster import Node
         from pilosa_tpu.parallel.client import ClientError
         node = Node.from_json(node_info)
+        prev = [n.to_json() for n in self.cluster.nodes()]
+        self.cluster.begin_resize()
         self.cluster.add_node(node)
         for peer in self.cluster.nodes():
             if peer.id in (self.cluster.local.id, node.id):
                 continue
             try:
                 self._client.cluster_message(
-                    peer.uri, {"type": "node-join", "node": node.to_json()})
+                    peer.uri, {"type": "node-join", "node": node.to_json(),
+                               "prev": prev})
             except ClientError:
                 pass
-        self._kick_resize()
+        # The joining node adopts the full topology AND the in-flight
+        # resize state, so queries it coordinates keep routing reads via
+        # the pre-join placement too.
+        try:
+            self._client.cluster_message(
+                node.uri, {"type": "topology",
+                           "nodes": [n.to_json()
+                                     for n in self.cluster.nodes()],
+                           "prev": prev})
+        except ClientError:
+            pass
+        self._start_resize_job()
         return self.cluster.status()
 
-    def _kick_resize(self) -> None:
-        """Topology changed: pull newly-owned fragments in the background
-        (the analog of the reference coordinator turning joins into resize
-        jobs, cluster.go:1095-1230 — here each node pulls for itself)."""
+    def _start_resize_job(self) -> None:
+        """Run the data motion for a topology change: every member pulls
+        the fragments it now owns (POST /internal/resize/pull — the analog
+        of the reference's ResizeInstruction fan-out + ACKs,
+        cluster.go:1458-1530), then broadcast resize-complete. On any pull
+        failure the cluster STAYS RESIZING — reads keep the safe
+        pre-change placement — until a retry succeeds or an operator
+        aborts (/cluster/resize/abort)."""
         if self.resize_puller is None:
             return
         import threading
 
-        def run():
-            # Pull only — cleaning unowned fragments here would race the
-            # new owner's own pull and destroy its source copy. Cleanup
-            # stays an explicit post-resize step (/cluster/resize/run, the
-            # reference's holderCleaner after the cluster returns to
-            # NORMAL, holder.go:859).
+        def pull_one(node, errors):
             try:
-                self.resize_puller.pull_owned()
+                if node.id == self.cluster.local.id:
+                    self.resize_puller.pull_owned()
+                else:
+                    self._client.resize_pull(node.uri)
             except Exception as e:
-                self.resize_puller._log("resize pull failed: %s: %s",
-                                        type(e).__name__, e)
+                errors.append((node.id, e))
+                self.logger.printf("resize: pull on %s failed: %r",
+                                   node.id, e)
+
+        def run():
+            errors: list = []
+            threads = [threading.Thread(target=pull_one, args=(n, errors))
+                       for n in self.cluster.nodes()]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                self.logger.printf(
+                    "resize: %d node(s) failed to pull; cluster stays "
+                    "RESIZING (reads keep pre-change placement); retry "
+                    "with /internal/join or /cluster/resize/abort",
+                    len(errors))
+                return
+            self._finish_resize()
 
         threading.Thread(target=run, daemon=True).start()
+
+    def _finish_resize(self) -> None:
+        """Adopt the new placement everywhere (reference: job DONE → save
+        topology, broadcast NORMAL, cluster.go:1048-1060)."""
+        from pilosa_tpu.parallel.client import ClientError
+        self.cluster.end_resize()
+        for peer in self.cluster.nodes():
+            if peer.id == self.cluster.local.id:
+                continue
+            try:
+                self._client.cluster_message(peer.uri,
+                                             {"type": "resize-complete"})
+            except ClientError:
+                pass
+
+    def resize_pull(self) -> dict:
+        """One synchronous pull pass (the receiving side of the resize
+        job; reference followResizeInstruction, cluster.go:1251-1360)."""
+        if self.resize_puller is None:
+            return {"fetched": 0}
+        return {"fetched": self.resize_puller.pull_owned()}
 
     def handle_cluster_message(self, msg: dict) -> None:
         """(reference receiveMessage dispatch, server.go:485-580)."""
@@ -544,19 +621,29 @@ class API:
         from pilosa_tpu.parallel.cluster import Node
         typ = msg.get("type")
         if typ == "node-join":
+            prev = [Node.from_json(nd) for nd in msg["prev"]] \
+                if msg.get("prev") else None
+            self.cluster.begin_resize(prev)
             self.cluster.add_node(Node.from_json(msg["node"]))
-            self._kick_resize()
         elif typ == "node-leave":
             if msg["nodeID"] == self.cluster.local.id:
                 # We were removed: detach to a single-node topology so we
                 # stop routing/syncing with stale membership.
+                self.cluster.end_resize()
                 for n in list(self.cluster.nodes()):
                     if n.id != self.cluster.local.id:
                         self.cluster.remove_node(n.id)
             else:
+                prev = [Node.from_json(nd) for nd in msg["prev"]] \
+                    if msg.get("prev") else None
+                self.cluster.begin_resize(prev)
                 self.cluster.remove_node(msg["nodeID"])
-                self._kick_resize()
+        elif typ == "resize-complete":
+            self.cluster.end_resize()
         elif typ == "topology":
+            if msg.get("prev"):
+                self.cluster.begin_resize(
+                    [Node.from_json(nd) for nd in msg["prev"]])
             for nd in msg.get("nodes", []):
                 self.cluster.add_node(Node.from_json(nd))
         elif typ == "set-coordinator":
@@ -586,24 +673,29 @@ class API:
             raise ApiError("cannot remove the receiving node; send the "
                            "request to another node", 400)
         removed = self.cluster.node_by_id(node_id)
+        prev = [n.to_json() for n in self.cluster.nodes()]
+        self.cluster.begin_resize()
         self.cluster.remove_node(node_id)
         for peer in self.cluster.nodes():
             if peer.id == self.cluster.local.id:
                 continue
             try:
                 self._client.cluster_message(
-                    peer.uri, {"type": "node-leave", "nodeID": node_id})
+                    peer.uri, {"type": "node-leave", "nodeID": node_id,
+                               "prev": prev})
             except ClientError:
                 pass
         # Tell the removed node too (it may still be alive): it detaches
         # to a single-node topology instead of serving with stale 3-node
-        # placement and pushing anti-entropy into the survivors.
+        # placement and pushing anti-entropy into the survivors. It keeps
+        # its data: reads route to it via the pre-change placement until
+        # the survivors' pulls complete.
         try:
             self._client.cluster_message(
                 removed.uri, {"type": "node-leave", "nodeID": node_id})
         except ClientError:
             pass  # already dead — nothing to detach
-        self._kick_resize()
+        self._start_resize_job()
         return self.cluster.status()
 
     def set_coordinator(self, node_id: str) -> dict:
@@ -630,13 +722,21 @@ class API:
         return self.cluster.status()
 
     def resize_abort(self) -> dict:
-        """(reference api.ResizeAbort, api.go:1141). Resize here is
-        pull-based and idempotent — each owner pulls what it lacks — so
-        abort simply reports state; a re-join restores placement and the
-        next pull converges."""
+        """(reference api.ResizeAbort, api.go:1141). Divergence, stated in
+        the response: resize here is pull-based, so "abort" cannot undo a
+        topology change — it accepts the NEW placement immediately
+        (cluster-wide), dropping the pre-change read routing. Any data
+        motion that had not completed heals via anti-entropy."""
         if self.cluster is None:
             raise ApiError("not clustered", 400)
-        return self.cluster.status()
+        from pilosa_tpu.parallel.cluster import STATE_RESIZING
+        aborted = self.cluster.state == STATE_RESIZING
+        self._finish_resize()
+        st = self.cluster.status()
+        st["aborted"] = bool(aborted)
+        st["note"] = ("pull-based resize: abort adopts the new placement "
+                      "now; incomplete data motion heals via anti-entropy")
+        return st
 
     def sync_now(self) -> dict:
         """One synchronous anti-entropy pass (tests + admin)."""

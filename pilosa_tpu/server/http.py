@@ -290,6 +290,8 @@ class Handler(BaseHTTPRequestHandler):
                 self._json({"ids": ids, "keys": keys})
             elif path == "/internal/sync":
                 self._json(api.sync_now())
+            elif path == "/internal/resize/pull":
+                self._json(api.resize_pull())
             elif path == "/cluster/resize/run":
                 self._json(api.resize_now())
             else:

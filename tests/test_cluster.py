@@ -231,6 +231,138 @@ def test_resize_pull_on_join(tmp_path):
         nodes[0].stop()
 
 
+def test_query_during_resize_window_no_undercount(tmp_path):
+    """Queries issued WHILE the resize pull is in flight must not
+    undercount: during RESIZING reads route via the pre-change placement
+    (old owners still hold the data), and the new placement takes over
+    only after every node's pull completes (reference holds the cluster
+    in RESIZING and gates API methods on state, cluster.go:44-48,
+    api.go:94)."""
+    import threading
+    import time
+
+    nodes = run_cluster(tmp_path, 1)
+    base = nodes[0].uri
+    req(base, "POST", "/index/rz", {"options": {}})
+    req(base, "POST", "/index/rz/field/f", {"options": {}})
+    cols = [s * SHARD_WIDTH for s in range(6)]
+    req(base, "POST", "/index/rz/field/f/import",
+        {"rowIDs": [1] * 6, "columnIDs": cols})
+
+    newcomer = ClusterNode(tmp_path, "n9")
+    newcomer.start(None, 1)
+    newcomer.attach_cluster([nodes[0].uri, newcomer.uri], 1)
+    try:
+        # Block the newcomer's pull so the resize window stays open.
+        release = threading.Event()
+        pulled = threading.Event()
+        orig_pull = newcomer.api.resize_puller.pull_owned
+
+        def slow_pull():
+            release.wait(timeout=30)
+            n = orig_pull()
+            pulled.set()
+            return n
+
+        newcomer.api.resize_puller.pull_owned = slow_pull
+
+        req(base, "POST", "/internal/join",
+            {"id": newcomer.uri, "uri": newcomer.uri})
+        # The window is open: base is RESIZING, newcomer owns shards it
+        # has not pulled yet.
+        assert req(base, "GET", "/status")["state"] == "RESIZING"
+        assert any(newcomer.cluster.owns_shard("rz", s) for s in range(6))
+        assert newcomer.holder.index("rz") is None or \
+            newcomer.holder.index("rz").available_shards() == []
+        # Queries from EITHER node during the window see every bit.
+        for uri in (base, newcomer.uri):
+            r = req(uri, "POST", "/index/rz/query", b"Count(Row(f=1))")
+            assert r["results"] == [6], uri
+        # Writes during the window are not lost either side of the move.
+        req(base, "POST", "/index/rz/query", b"Set(99, f=1)")
+        r = req(base, "POST", "/index/rz/query", b"Count(Row(f=1))")
+        assert r["results"] == [7]
+
+        # Close the window; the job finishes and placement flips.
+        release.set()
+        assert pulled.wait(timeout=30)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            states = {req(u, "GET", "/status")["state"]
+                      for u in (base, newcomer.uri)}
+            if states == {"NORMAL"}:
+                break
+            time.sleep(0.05)
+        assert states == {"NORMAL"}
+        owned = [s for s in range(6) if newcomer.cluster.owns_shard("rz", s)]
+        held = newcomer.holder.index("rz").available_shards()
+        assert set(owned) <= set(held)
+        for uri in (base, newcomer.uri):
+            r = req(uri, "POST", "/index/rz/query", b"Count(Row(f=1))")
+            assert r["results"] == [7], uri
+    finally:
+        newcomer.stop()
+        nodes[0].stop()
+
+
+def test_resize_abort_is_honest(tmp_path):
+    """Abort cannot undo a pull-based resize; the response says so and
+    the cluster adopts the new placement (divergence from reference
+    api.go:1141, documented in the response note)."""
+    nodes = run_cluster(tmp_path, 2)
+    try:
+        nodes[0].cluster.begin_resize()
+        assert req(nodes[0].uri, "GET", "/status")["state"] == "RESIZING"
+        # Schema mutations are rejected while RESIZING (reference
+        # api.validate, api.go:76-99).
+        with pytest.raises(urllib.error.HTTPError):
+            req(nodes[0].uri, "POST", "/index/nope", {"options": {}})
+        res = req(nodes[0].uri, "POST", "/cluster/resize/abort")
+        assert res["aborted"] is True and "note" in res
+        assert req(nodes[0].uri, "GET", "/status")["state"] == "NORMAL"
+        res = req(nodes[0].uri, "POST", "/cluster/resize/abort")
+        assert res["aborted"] is False
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_remove_live_node_pulls_its_data(tmp_path):
+    """Removing an ALIVE node with replica_n=1: survivors must pull the
+    removed node's exclusive shards from it (it stays reachable through
+    the pre-resize snapshot) before the new placement takes over
+    (reference sources resize instructions from pre-change owners,
+    cluster.go:741-826)."""
+    import time
+    nodes = run_cluster(tmp_path, 2, replica_n=1)
+    try:
+        base = nodes[0].uri
+        req(base, "POST", "/index/rl", {"options": {}})
+        req(base, "POST", "/index/rl/field/f", {"options": {}})
+        cols = [s * SHARD_WIDTH + 2 for s in range(8)]
+        req(base, "POST", "/index/rl/field/f/import",
+            {"rowIDs": [1] * 8, "columnIDs": cols})
+        # node 1 must hold at least one shard exclusively
+        assert nodes[1].holder.index("rl").available_shards()
+        st = req(base, "POST", "/cluster/resize/remove-node",
+                 {"id": nodes[1].uri})
+        assert len(st["nodes"]) == 1
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if req(base, "GET", "/status")["state"] == "NORMAL":
+                break
+            time.sleep(0.05)
+        assert req(base, "GET", "/status")["state"] == "NORMAL"
+        # every bit now lives on the survivor
+        assert sorted(nodes[0].holder.index("rl").available_shards()) == \
+            list(range(8))
+        r = req(base, "POST", "/index/rl/query", b"Count(Row(f=1))")
+        assert r["results"] == [8]
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
 def test_keyed_cluster(tmp_path):
     nodes = run_cluster(tmp_path, 2)
     try:

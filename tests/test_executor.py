@@ -155,6 +155,73 @@ def test_group_by(ex):
     assert len(res) == 1 and res[0].count == 1
 
 
+def test_group_by_deep_matches_bruteforce(ex):
+    """3-field GroupBy over multiple shards, checked against a host-side
+    brute force — exercises the level-synchronous batched expansion
+    (one [P, R, S, W] kernel per depth instead of one dispatch per prefix,
+    reference groupByIterator executor.go:2820-2996)."""
+    e, h = ex
+    idx = h.create_index("gb")
+    rng = np.random.RandomState(7)
+    data = {}
+    for fname, nrows in (("a", 4), ("b", 3), ("c", 5)):
+        f = idx.create_field(fname)
+        rows_l, cols_l = [], []
+        for r in range(nrows):
+            cols = rng.choice(2 * SHARD_WIDTH, size=30, replace=False)
+            data[(fname, r)] = set(int(c) for c in cols)
+            rows_l.extend([r] * len(cols))
+            cols_l.extend(cols.tolist())
+        f.import_bits(np.array(rows_l, np.uint64),
+                      np.array(cols_l, np.uint64))
+    (res,) = e.execute("gb", "GroupBy(Rows(a), Rows(b), Rows(c))")
+    got = {tuple(fr.row_id for fr in gc.group): gc.count for gc in res}
+    want = {}
+    for ra in range(4):
+        for rb in range(3):
+            for rc in range(5):
+                n = len(data[("a", ra)] & data[("b", rb)] & data[("c", rc)])
+                if n:
+                    want[(ra, rb, rc)] = n
+    assert got == want
+    # limit truncates in (prefix-major, row) order
+    (res,) = e.execute("gb", "GroupBy(Rows(a), Rows(b), Rows(c), limit=3)")
+    ordered = sorted(want.items())[:3]
+    assert [(tuple(fr.row_id for fr in gc.group), gc.count)
+            for gc in res] == ordered
+    # filter applies to every group
+    (res,) = e.execute("gb", "GroupBy(Rows(a), Rows(b), filter=Row(c=0))")
+    got = {tuple(fr.row_id for fr in gc.group): gc.count for gc in res}
+    want2 = {}
+    for ra in range(4):
+        for rb in range(3):
+            n = len(data[("a", ra)] & data[("b", rb)] & data[("c", 0)])
+            if n:
+                want2[(ra, rb)] = n
+    assert got == want2
+
+
+def test_group_by_chunked_expansion(ex, monkeypatch):
+    """Force a tiny chunk budget so the prefix expansion streams through
+    several device batches; result must be identical."""
+    e, h = ex
+    idx = h.create_index("gc")
+    for fname in ("x", "y"):
+        f = idx.create_field(fname)
+        rows = np.repeat(np.arange(6, dtype=np.uint64), 10)
+        cols = np.tile(np.arange(10, dtype=np.uint64) * 3, 6) + \
+            np.repeat(np.arange(6, dtype=np.uint64), 10)
+        f.import_bits(rows, cols)
+    (want,) = e.execute("gc", "GroupBy(Rows(x), Rows(y))")
+    monkeypatch.setattr(type(e), "GROUPBY_CHUNK_BYTES", 4096)
+    e._jit_cache = {k: v for k, v in e._jit_cache.items()
+                    if not k.startswith("gb_")}
+    (got,) = e.execute("gc", "GroupBy(Rows(x), Rows(y))")
+    as_set = lambda res: {(tuple(fr.row_id for fr in gc.group), gc.count)
+                          for gc in res}
+    assert as_set(got) == as_set(want) and len(got) > 0
+
+
 def test_bsi_conditions(ex):
     e, h = ex
     idx = h.create_index("i")
@@ -508,3 +575,79 @@ def test_topn_ids_and_threshold(ex):
     assert res.pairs == [(1, 4)]
     (res,) = e.execute("i", "TopN(f, n=5, threshold=99)")
     assert res.pairs == []
+
+
+def test_hbm_budget_subset_banks(ex, monkeypatch):
+    """A Row leaf on a view whose full bank exceeds BANK_MAX_BYTES must
+    build a cached row-subset bank, not materialize every row (VERDICT r1
+    missing #4; reference streams per-shard and never materializes,
+    executor.go:2377)."""
+    e, h = ex
+    idx = h.create_index("hb")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    n_rows = 64
+    rows = np.repeat(np.arange(n_rows, dtype=np.uint64), 4)
+    cols = np.tile(np.array([1, 2, 3, SHARD_WIDTH + 1], np.uint64), n_rows)
+    f.import_bits(rows, cols)
+    g.import_bits(np.array([1, 1], np.uint64), np.array([2, 4], np.uint64))
+    idx.add_existence(np.unique(cols))
+
+    (want,) = e.execute("hb", "Count(Intersect(Row(f=3), Row(g=1)))")
+    view = f.view("standard")
+    view._bank_cache.clear()
+
+    # Budget smaller than the full f bank: leaf must go subset.
+    monkeypatch.setattr(type(e), "BANK_MAX_BYTES", 4096)
+    (got,) = e.execute("hb", "Count(Intersect(Row(f=3), Row(g=1)))")
+    assert got == want
+    subset_keys = [k for k in view._bank_cache if len(k) == 4]
+    assert subset_keys, "expected a cached row-subset bank"
+    bank = view._bank_cache[subset_keys[0]]
+    assert bank.array.shape[0] <= 2  # capacity for 1 row + zero slot
+    # Re-running hits the cached subset bank and stays correct.
+    (got,) = e.execute("hb", "Count(Intersect(Row(f=3), Row(g=1)))")
+    assert got == want
+    # A write invalidates the cached subset (versions moved).
+    (before,) = e.execute("hb", "Count(Row(f=3))")
+    e.execute("hb", "Set(5, f=3)")
+    (after,) = e.execute("hb", "Count(Row(f=3))")
+    assert after == before + 1
+
+
+def test_bank_budget_lru_eviction(tmp_path):
+    """Total cached-bank HBM is bounded: admitting past the budget evicts
+    the least recently used bank from its owning view."""
+    from pilosa_tpu.core.view import BankBudget, BANK_BUDGET
+    h = Holder(str(tmp_path))
+    h.open()
+    try:
+        idx = h.create_index("ev")
+        fields = []
+        for name in ("a", "b", "c"):
+            f = idx.create_field(name)
+            f.import_bits(np.arange(8, dtype=np.uint64),
+                          np.arange(8, dtype=np.uint64) * 7)
+            fields.append(f)
+        views = [f.view("standard") for f in fields]
+        one_bank = None
+        budget = BankBudget(1)  # resized after measuring one bank
+        import pilosa_tpu.core.view as view_mod
+        orig = view_mod.BANK_BUDGET
+        view_mod.BANK_BUDGET = budget
+        try:
+            b = views[0].device_bank((0,), trim=True)
+            one_bank = int(np.prod(b.array.shape)) * 4
+            # room for exactly two banks
+            budget.budget = 2 * one_bank
+            views[1].device_bank((0,), trim=True)
+            views[2].device_bank((0,), trim=True)
+            assert budget.total <= budget.budget
+            assert budget.evictions >= 1
+            # view a's bank (LRU) was dropped from its cache
+            assert not views[0]._bank_cache
+            assert views[2]._bank_cache
+        finally:
+            view_mod.BANK_BUDGET = orig
+    finally:
+        h.close()
