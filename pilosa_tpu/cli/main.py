@@ -68,6 +68,7 @@ def cmd_server(args) -> int:
               tracer=RecordingTracer())
     api.logger = logger
     api.long_query_time = cfg.long_query_time
+    api.executor.max_writes_per_request = cfg.max_writes_per_request
     from pilosa_tpu.utils.diagnostics import (
         DiagnosticsCollector, RuntimeMonitor,
     )
@@ -84,6 +85,21 @@ def cmd_server(args) -> int:
         from pilosa_tpu.parallel.syncer import AntiEntropyLoop
         anti_entropy = AntiEntropyLoop(api.syncer, cfg.anti_entropy_interval)
         anti_entropy.start()
+    heartbeat = translate_repl = None
+    if cluster is not None:
+        from pilosa_tpu.parallel.heartbeat import (
+            Heartbeater, TranslateReplicationLoop,
+        )
+        if cfg.heartbeat_interval > 0:
+            heartbeat = Heartbeater(cluster,
+                                    interval=cfg.heartbeat_interval,
+                                    suspect_after=cfg.heartbeat_suspect,
+                                    logger=logger)
+            heartbeat.start()
+        if cfg.translate_replication_interval > 0:
+            translate_repl = TranslateReplicationLoop(
+                api, cfg.translate_replication_interval)
+            translate_repl.start()
     logger.printf("pilosa-tpu server: data=%s bind=%s mesh=%s cluster=%s",
                   data_dir, cfg.bind,
                   mesh.mesh.shape if mesh else "single-device",
@@ -91,6 +107,10 @@ def cmd_server(args) -> int:
     try:
         serve(api, cfg.host, cfg.port)
     finally:
+        if heartbeat is not None:
+            heartbeat.stop()
+        if translate_repl is not None:
+            translate_repl.stop()
         if anti_entropy is not None:
             anti_entropy.stop()
         diagnostics.stop()

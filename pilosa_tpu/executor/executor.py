@@ -55,6 +55,14 @@ _BITMAP_CALLS = {"Row", "Range", "Intersect", "Union", "Difference", "Xor",
 # finalize (safe only when no later call writes — reference executes calls
 # strictly sequentially, executor.go:245).
 _WRITE_CALLS = {"Set", "Clear", "ClearRow", "Store"}
+# All writes, for the max-writes-per-request limit (reference
+# Query.WriteCallN counts these, pql/ast.go).
+ALL_WRITE_CALLS = _WRITE_CALLS | {"SetRowAttrs", "SetColumnAttrs"}
+
+
+def write_call_count(query) -> int:
+    return sum(1 for c in query.calls
+               if _peel_options(c).name in ALL_WRITE_CALLS)
 
 
 def _peel_options(call: "Call") -> "Call":
@@ -196,6 +204,9 @@ class Executor:
     def __init__(self, holder: Holder, mesh=None):
         self.holder = holder
         self.mesh = mesh
+        # Reject queries carrying more write calls than this; 0 = no limit
+        # (reference executor.MaxWritesPerRequest, executor.go:53,106).
+        self.max_writes_per_request = 0
         self._jit_cache: Dict[str, Callable] = {}
         # Per-thread dispatch context (one executor serves all request
         # threads): whether calls after the one being dispatched write.
@@ -254,6 +265,9 @@ class Executor:
             query = parse_string(query)
         if isinstance(query, Call):
             query = Query([query])
+        if self.max_writes_per_request > 0 and \
+                write_call_count(query) > self.max_writes_per_request:
+            raise ExecutionError("too many write commands")
         idx = self.holder.index(index_name)
         if idx is None:
             raise ExecutionError(f"index not found: {index_name}")
@@ -498,12 +512,17 @@ class Executor:
 
         plan = _Plan()
         expr = self._plan_call(idx, call, shards, plan)
-        plan.resolve_width()
         banks = [self._get_bank(idx, key, shards,
                                 rows_needed=plan.rows_for.get(key))
                  for key in plan.bank_keys]
         for i, key, row in plan.slot_refs:
             plan.idxs[i] = banks[plan.bank_pos[key]].slot(row)
+        # Width resolves AFTER banks are built: a write landing between
+        # planning and bank build can widen a view, and the plan width
+        # must cover every actual bank width or _align_words would slice
+        # off real set bits (plan-time widths alone are a TOCTOU).
+        plan.widths.extend(b.array.shape[-1] for b in banks)
+        plan.resolve_width()
         bank_arrays = tuple(b.array for b in banks)
         lits = None
         if plan.literals:
@@ -1108,14 +1127,17 @@ class Executor:
             if not ids:
                 return []
 
-        banks = {}
+        # Keyed by child INDEX, not field name: GroupBy(Rows(f), Rows(f))
+        # is legal, and with subset banks the two children may need
+        # different row sets of the same field.
+        banks = []
         for fname, ids_ in child_rows:
             f = idx.field(fname)
-            banks[fname] = self._get_bank_for(f, VIEW_STANDARD, shards,
-                                              rows_needed=set(ids_))
+            banks.append(self._get_bank_for(f, VIEW_STANDARD, shards,
+                                            rows_needed=set(ids_)))
         # GroupBy only intersects, so all operands can slice down to the
         # NARROWEST width: bits past the narrowest operand AND to zero.
-        wmin = min(b.array.shape[-1] for b in banks.values())
+        wmin = min(b.array.shape[-1] for b in banks)
         if filter_words is not None:
             wmin = min(wmin, filter_words.shape[-1])
             filter_words = filter_words[..., :wmin]
@@ -1128,8 +1150,8 @@ class Executor:
             return fn
 
         def stacks_at(depth):
-            fname, ids = child_rows[depth]
-            bank = banks[fname]
+            _, ids = child_rows[depth]
+            bank = banks[depth]
             sel = jnp.asarray(np.asarray([bank.slot(r) for r in ids],
                                          dtype=np.int32))
             return bank.array[sel][..., :wmin]  # [R, S, Wmin]

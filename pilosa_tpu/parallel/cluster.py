@@ -68,6 +68,16 @@ class Cluster:
         # rejecting queries in state RESIZING, api.go:76-99; here the query
         # path stays available instead).
         self.prev_nodes: Optional[List[Node]] = None
+        # Members the failure detector currently believes are dead
+        # (reference: memberlist SWIM drives node state, gossip/gossip.go;
+        # DEGRADED when members are missing, cluster.go:522-533). Routing
+        # prefers up replicas, so a down node costs zero request timeouts.
+        self.down_ids: set = set()
+        # Bumped on every begin_resize: an in-flight resize job refuses to
+        # finalize if a newer topology change superseded it (overlapping
+        # joins must not adopt the new placement until the LAST job's
+        # pulls complete).
+        self.resize_gen = 0
         self._lock = threading.RLock()
 
     # -- membership ---------------------------------------------------------
@@ -75,6 +85,20 @@ class Cluster:
     def nodes(self) -> List[Node]:
         with self._lock:
             return [self._nodes[k] for k in sorted(self._nodes)]
+
+    def known_nodes(self) -> List[Node]:
+        """Current members ∪ pre-resize members, sorted by id — every node
+        that may still hold or serve data mid-resize (pull sources, shard
+        discovery, write fan-out all use this union)."""
+        with self._lock:
+            out = dict(self._nodes)
+            for n in (self.prev_nodes or []):
+                out.setdefault(n.id, n)
+            return [out[k] for k in sorted(out)]
+
+    def member_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._nodes)
 
     def add_node(self, node: Node) -> None:
         with self._lock:
@@ -85,6 +109,7 @@ class Cluster:
     def remove_node(self, node_id: str) -> None:
         with self._lock:
             self._nodes.pop(node_id, None)
+            self.down_ids.discard(node_id)
             self._update_state()
             self.save()
 
@@ -99,12 +124,38 @@ class Cluster:
             return hit
 
     def _update_state(self) -> None:
-        if self.state not in (STATE_STARTING, STATE_RESIZING):
-            self.state = STATE_NORMAL
+        if self.state in (STATE_STARTING, STATE_RESIZING):
+            return
+        self.state = STATE_DEGRADED if self.down_ids else STATE_NORMAL
 
     def set_state(self, state: str) -> None:
         with self._lock:
             self.state = state
+
+    # -- failure detection ---------------------------------------------------
+
+    def mark_down(self, node_id: str) -> bool:
+        """Failure detector verdict: peer unreachable. DEGRADED while any
+        member is down (reference cluster.go:522-533). Returns True when
+        this changed the node's state."""
+        with self._lock:
+            if node_id == self.local.id or node_id not in self._nodes \
+                    or node_id in self.down_ids:
+                return False
+            self.down_ids.add(node_id)
+            if self.state == STATE_NORMAL:
+                self.state = STATE_DEGRADED
+            return True
+
+    def mark_up(self, node_id: str) -> bool:
+        with self._lock:
+            if node_id not in self.down_ids:
+                return False
+            self.down_ids.discard(node_id)
+            self.down_ids &= set(self._nodes)
+            if self.state == STATE_DEGRADED and not self.down_ids:
+                self.state = STATE_NORMAL
+            return True
 
     # -- resize lifecycle ----------------------------------------------------
 
@@ -118,6 +169,7 @@ class Cluster:
                 self.prev_nodes = (list(prev) if prev is not None
                                    else self.nodes())
             self.state = STATE_RESIZING
+            self.resize_gen += 1
             self.save()
 
     def end_resize(self) -> None:
@@ -161,6 +213,12 @@ class Cluster:
         seen = {n.id for n in prev}
         return prev + [n for n in cur if n.id not in seen]
 
+    def owners_match_membership(self, member_ids: List[str]) -> bool:
+        """True when this node's membership equals `member_ids` — used to
+        ignore a resize-complete broadcast for a topology this node has
+        already moved past (overlapping resizes)."""
+        return self.member_ids() == sorted(member_ids)
+
     def owns_shard(self, index: str, shard: int) -> bool:
         return any(n.id == self.local.id
                    for n in self.shard_nodes(index, shard))
@@ -174,17 +232,24 @@ class Cluster:
                        previous: bool = False) -> Dict[str, List[int]]:
         """Group shards by serving node id, preferring the primary and
         falling back down the replica chain when primaries are excluded
-        (the mapReduce retry path, executor.go:2313-2324)."""
+        (the mapReduce retry path, executor.go:2313-2324). Replicas the
+        failure detector marks down are deprioritized — proactive
+        failover: a dead node costs zero request timeouts — but still
+        usable as a last resort (the detector may be stale)."""
+        with self._lock:
+            down = set(self.down_ids)
         out: Dict[str, List[int]] = {}
         for shard in shards:
-            for node in self.shard_nodes(index, shard, previous=previous):
-                if exclude_ids and node.id in exclude_ids:
-                    continue
-                out.setdefault(node.id, []).append(shard)
-                break
-            else:
+            cands = [n for n in self.shard_nodes(index, shard,
+                                                 previous=previous)
+                     if not (exclude_ids and n.id in exclude_ids)]
+            pick = next((n for n in cands if n.id not in down), None)
+            if pick is None and cands:
+                pick = cands[0]
+            if pick is None:
                 raise RuntimeError(
                     f"shard {shard} unavailable: all replicas excluded")
+            out.setdefault(pick.id, []).append(shard)
         return out
 
     # -- persistence (reference .topology, cluster.go:1611-1646) ------------
@@ -225,7 +290,10 @@ class Cluster:
             out = {"state": self.state,
                    "localID": self.local.id,
                    "replicaN": self.replica_n,
-                   "nodes": [n.to_json() for n in self.nodes()]}
+                   "nodes": [{**n.to_json(),
+                              "state": ("DOWN" if n.id in self.down_ids
+                                        else "READY")}
+                             for n in self.nodes()]}
             if self.prev_nodes is not None:
                 out["prevNodes"] = [n.to_json() for n in self.prev_nodes]
             return out

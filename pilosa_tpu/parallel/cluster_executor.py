@@ -153,10 +153,7 @@ class ClusterExecutor:
         # During a resize, data may live only on a pre-change member (e.g.
         # a just-removed node) — ask the union of current and previous
         # membership so discovery cannot miss shards mid-move.
-        nodes = {n.id: n for n in self.cluster.nodes()}
-        for n in (self.cluster.prev_nodes or []):
-            nodes.setdefault(n.id, n)
-        for node in nodes.values():
+        for node in self.cluster.known_nodes():
             if node.id == self.cluster.local.id:
                 continue
             try:
@@ -180,7 +177,14 @@ class ClusterExecutor:
     def execute(self, index: str, query: str,
                 shards: Optional[Sequence[int]] = None) -> List[Any]:
         """Returns JSON-shaped results (one per call)."""
+        from pilosa_tpu.executor.executor import (
+            ExecutionError, write_call_count,
+        )
         q = parse_string(query) if isinstance(query, str) else query
+        limit = self.local.max_writes_per_request
+        if limit > 0 and write_call_count(q) > limit:
+            # (reference ErrTooManyWrites, executor.go:106)
+            raise ExecutionError("too many write commands")
         return [self._execute_call(index, call, shards) for call in q.calls]
 
     def _execute_call(self, index: str, call: Call, shards) -> Any:

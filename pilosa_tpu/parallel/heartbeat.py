@@ -1,0 +1,121 @@
+"""Failure detection + standing translate replication.
+
+Reference: membership liveness comes from hashicorp/memberlist SWIM
+gossip (/root/reference/gossip/gossip.go:43,246): probes mark nodes
+dead, the cluster goes DEGRADED, and queries avoid dead members.
+With a single-controller deployment a full SWIM protocol is
+unnecessary; a direct heartbeat prober gives the same observable
+behavior — peers marked down after N consecutive probe failures,
+DEGRADED status, proactive query failover — without the gossip fabric
+(divergence documented in parallel/cluster.py).
+
+Translate replication: the reference runs a standing loop per replica
+streaming the primary's translate log (monitorReplication/replicate,
+/root/reference/translate.go:359-400). TranslateReplicationLoop is that
+loop: incremental log pulls from the primary on an interval, so replicas
+converge without waiting for anti-entropy or a read-path fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from pilosa_tpu.parallel.client import ClientError, InternalClient
+from pilosa_tpu.parallel.cluster import Cluster
+
+
+class Heartbeater:
+    """Probes every peer on an interval; after `suspect_after` consecutive
+    failures the peer is marked down (cluster DEGRADED, routing prefers
+    live replicas); one successful probe marks it back up."""
+
+    def __init__(self, cluster: Cluster, interval: float = 2.0,
+                 suspect_after: int = 3, timeout: Optional[float] = None,
+                 logger=None):
+        self.cluster = cluster
+        self.interval = interval
+        self.suspect_after = suspect_after
+        # Short probe timeout: a hung peer must not stall the prober.
+        self.client = InternalClient(timeout=timeout or max(interval, 1.0))
+        self.logger = logger
+        self._fails: Dict[str, int] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _log(self, fmt, *args):
+        if self.logger is not None:
+            self.logger.printf(fmt, *args)
+
+    def probe_once(self) -> None:
+        """One probe round over every peer (tests call this directly)."""
+        for node in self.cluster.nodes():
+            if node.id == self.cluster.local.id:
+                continue
+            try:
+                self.client.status(node.uri)
+            except ClientError:
+                n = self._fails.get(node.id, 0) + 1
+                self._fails[node.id] = n
+                if n >= self.suspect_after and \
+                        self.cluster.mark_down(node.id):
+                    self._log("heartbeat: node %s DOWN after %d failed "
+                              "probes; cluster %s", node.id, n,
+                              self.cluster.state)
+            else:
+                self._fails.pop(node.id, None)
+                if self.cluster.mark_up(node.id):
+                    self._log("heartbeat: node %s recovered; cluster %s",
+                              node.id, self.cluster.state)
+
+    def start(self) -> None:
+        if self.interval <= 0:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.probe_once()
+                except Exception as e:  # keep the detector alive
+                    self._log("heartbeat round failed: %s: %s",
+                              type(e).__name__, e)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class TranslateReplicationLoop:
+    """Standing replica-side loop pulling the primary's translate logs
+    incrementally (reference replicate loop, translate.go:359-400; here
+    pull-based from byte offsets instead of a held-open stream)."""
+
+    def __init__(self, api, interval: float = 10.0):
+        self.api = api
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def replicate_once(self) -> None:
+        self.api._sync_translate_stores()
+
+    def start(self) -> None:
+        if self.interval <= 0:
+            return
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.replicate_once()
+                except Exception as e:
+                    self.api.logger.printf(
+                        "translate replication pass failed: %s: %s",
+                        type(e).__name__, e)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()

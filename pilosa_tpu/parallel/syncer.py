@@ -193,10 +193,7 @@ class ResizePuller:
         # being removed (alive, detached) — it is still reachable via the
         # prev snapshot, exactly like the reference sourcing resize
         # instructions from the pre-change owners (cluster.go:741-826).
-        sources = {n.id: n for n in self.cluster.nodes()}
-        for n in (self.cluster.prev_nodes or []):
-            sources.setdefault(n.id, n)
-        peers = [n for n in sources.values()
+        peers = [n for n in self.cluster.known_nodes()
                  if n.id != self.cluster.local.id]
         if not peers:
             return 0

@@ -563,6 +563,12 @@ class API:
             return
         import threading
 
+        # Captured AFTER the topology change this job serves: if a newer
+        # change bumps the generation while pulls run, this job must NOT
+        # finalize — the newer job's completion (whose pulls cover the
+        # newest placement) will.
+        gen0 = self.cluster.resize_gen
+
         def pull_one(node, errors):
             try:
                 if node.id == self.cluster.local.id:
@@ -589,21 +595,30 @@ class API:
                     "with /internal/join or /cluster/resize/abort",
                     len(errors))
                 return
+            if self.cluster.resize_gen != gen0:
+                self.logger.printf(
+                    "resize: superseded by a newer topology change; "
+                    "leaving finalization to the newer job")
+                return
             self._finish_resize()
 
         threading.Thread(target=run, daemon=True).start()
 
     def _finish_resize(self) -> None:
         """Adopt the new placement everywhere (reference: job DONE → save
-        topology, broadcast NORMAL, cluster.go:1048-1060)."""
+        topology, broadcast NORMAL, cluster.go:1048-1060). The broadcast
+        carries the membership it completes, so a peer that already saw a
+        newer topology change ignores it and stays safely RESIZING."""
         from pilosa_tpu.parallel.client import ClientError
+        members = self.cluster.member_ids()
         self.cluster.end_resize()
         for peer in self.cluster.nodes():
             if peer.id == self.cluster.local.id:
                 continue
             try:
-                self._client.cluster_message(peer.uri,
-                                             {"type": "resize-complete"})
+                self._client.cluster_message(
+                    peer.uri, {"type": "resize-complete",
+                               "members": members})
             except ClientError:
                 pass
 
@@ -639,7 +654,10 @@ class API:
                 self.cluster.begin_resize(prev)
                 self.cluster.remove_node(msg["nodeID"])
         elif typ == "resize-complete":
-            self.cluster.end_resize()
+            members = msg.get("members")
+            if members is None or \
+                    self.cluster.owners_match_membership(members):
+                self.cluster.end_resize()
         elif typ == "topology":
             if msg.get("prev"):
                 self.cluster.begin_resize(

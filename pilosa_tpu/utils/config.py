@@ -28,6 +28,13 @@ class Config:
     mesh_replicas: int = 1
     # Anti-entropy
     anti_entropy_interval: float = 600.0
+    # Failure detection (reference: memberlist SWIM probing,
+    # gossip/gossip.go:246; here a direct heartbeat prober)
+    heartbeat_interval: float = 5.0     # 0 disables
+    heartbeat_suspect: int = 3          # consecutive failures -> DOWN
+    # Standing translate-log replication from the primary (reference
+    # monitorReplication, translate.go:359); 0 disables
+    translate_replication_interval: float = 10.0
     # Metrics
     metric_service: str = "mem"   # mem | none
     metric_poll_interval: float = 10.0  # runtime gauge sampling; 0 off

@@ -416,6 +416,107 @@ def test_sync_creates_missing_schema(tmp_path):
             nd.stop()
 
 
+def test_heartbeat_marks_down_and_recovers(tmp_path):
+    """Failure detector: N failed probes -> node DOWN + cluster DEGRADED
+    + queries avoid the dead replica proactively; a successful probe
+    marks it READY again (reference memberlist SWIM driving node state,
+    gossip/gossip.go:246; DEGRADED cluster.go:522-533)."""
+    from pilosa_tpu.parallel.heartbeat import Heartbeater
+
+    nodes = run_cluster(tmp_path, 3, replica_n=2)
+    try:
+        base = nodes[0].uri
+        req(base, "POST", "/index/hb", {"options": {}})
+        req(base, "POST", "/index/hb/field/f", {"options": {}})
+        cols = [s * SHARD_WIDTH for s in range(6)]
+        req(base, "POST", "/index/hb/field/f/import",
+            {"rowIDs": [1] * 6, "columnIDs": cols})
+
+        hb = Heartbeater(nodes[0].cluster, interval=0.1, suspect_after=2,
+                         timeout=2.0)
+        hb.probe_once()
+        assert nodes[0].cluster.state == STATE_NORMAL
+
+        victim_addr = nodes[2].server.server_address
+        nodes[2].stop()
+        hb.probe_once()
+        assert nodes[0].cluster.state == STATE_NORMAL  # 1 failure: suspect
+        hb.probe_once()
+        st = req(base, "GET", "/status")
+        assert st["state"] == "DEGRADED"
+        down = [n for n in st["nodes"] if n["state"] == "DOWN"]
+        assert [n["id"] for n in down] == [nodes[2].uri]
+        # Proactive failover: routing never selects the down node.
+        by_node = nodes[0].cluster.shards_by_node("hb", list(range(6)))
+        assert nodes[2].uri not in by_node
+        r = req(base, "POST", "/index/hb/query", b"Count(Row(f=1))")
+        assert r["results"] == [6]
+
+        # Node comes back on the same port: one good probe -> READY.
+        revived = ClusterNode(tmp_path, "n2b")
+        revived.api = nodes[2].api
+        import http.server as _hs
+        from pilosa_tpu.server.http import Handler
+        handler = type("H", (Handler,), {"api": nodes[2].api})
+        import threading as _t
+        srv = _hs.ThreadingHTTPServer(victim_addr, handler)
+        _t.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            hb.probe_once()
+            assert nodes[0].cluster.state == STATE_NORMAL
+            st = req(base, "GET", "/status")
+            assert all(n["state"] == "READY" for n in st["nodes"])
+        finally:
+            srv.shutdown()
+            srv.server_close()
+    finally:
+        for nd in nodes[:2]:
+            nd.stop()
+
+
+def test_translate_replication_loop(tmp_path):
+    """Replicas converge on the primary's translate log via the standing
+    replication loop, without anti-entropy or a read-path fallback
+    (reference replicate loop, translate.go:359-400)."""
+    from pilosa_tpu.parallel.heartbeat import TranslateReplicationLoop
+
+    nodes = run_cluster(tmp_path, 2)
+    try:
+        primary = sorted(nodes, key=lambda n: n.uri)[0]
+        replica = next(n for n in nodes if n is not primary)
+        req(primary.uri, "POST", "/index/tr", {"options": {"keys": True}})
+        req(primary.uri, "POST", "/index/tr/field/f", {"options": {}})
+        req(primary.uri, "POST", "/index/tr/query", b"Set('k1', f=1)")
+        # The replica's local store may not know k1 yet (only via primary
+        # fallback). One replication pass adopts the log directly.
+        loop = TranslateReplicationLoop(replica.api, interval=0.0)
+        loop.replicate_once()
+        store = replica.holder.index("tr").column_translator
+        assert store.translate_key("k1", create=False) is not None
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_max_writes_per_request(tmp_path):
+    """(reference ErrTooManyWrites, executor.go:106; config
+    max_writes_per_request server/config.go)."""
+    nodes = run_cluster(tmp_path, 2)
+    try:
+        req(nodes[0].uri, "POST", "/index/mw", {"options": {}})
+        req(nodes[0].uri, "POST", "/index/mw/field/f", {"options": {}})
+        nodes[0].api.executor.max_writes_per_request = 3
+        q = b"Set(1, f=1) Set(2, f=1) Set(3, f=1) Set(4, f=1)"
+        with pytest.raises(urllib.error.HTTPError):
+            req(nodes[0].uri, "POST", "/index/mw/query", q)
+        # At the limit passes; reads don't count as writes.
+        req(nodes[0].uri, "POST", "/index/mw/query",
+            b"Set(1, f=1) Set(2, f=1) Set(3, f=1) Count(Row(f=1))")
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
 def test_translate_log_truncation_tolerated(tmp_path):
     from pilosa_tpu.core.translate import TranslateStore
     p = str(tmp_path / "keys")
