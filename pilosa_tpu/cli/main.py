@@ -62,8 +62,19 @@ def cmd_server(args) -> int:
         cluster.load()
         cluster.set_state(STATE_NORMAL)
 
-    stats = MemStatsClient() if cfg.metric_service == "mem" \
-        else NopStatsClient()
+    if cfg.metric_service == "mem":
+        stats = MemStatsClient()
+    elif cfg.metric_service == "statsd":
+        # Mem rides along so /debug/vars keeps working (the reference's
+        # multi-client, stats/stats.go:164).
+        from pilosa_tpu.utils.stats import (
+            MultiStatsClient, StatsdStatsClient,
+        )
+        stats = MultiStatsClient(
+            MemStatsClient(),
+            StatsdStatsClient(cfg.metric_host, logger=logger))
+    else:
+        stats = NopStatsClient()
     api = API(holder, mesh=mesh, cluster=cluster, stats=stats,
               tracer=RecordingTracer())
     api.logger = logger

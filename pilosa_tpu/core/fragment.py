@@ -89,6 +89,16 @@ class Fragment:
             # If the op log had grown past the limit, fold it into a snapshot.
             if self.storage.op_n >= self.max_op_n:
                 self._snapshot()
+            # Replay may have materialized containers the snapshot stored
+            # as arrays; re-compress sparse ones (reference Optimize,
+            # roaring.go:1745).
+            self.storage.optimize()
+
+    def optimize_storage(self) -> int:
+        """Re-encode sparse containers as u16 arrays (host-memory
+        compaction for fingerprint-shaped data; see Bitmap.optimize)."""
+        with self._lock:
+            return self.storage.optimize()
 
     def close(self) -> None:
         with self._lock:
@@ -217,9 +227,52 @@ class Fragment:
         leading prefix — the width-trimmed bank path would otherwise
         build (and immediately slice away) 128 KiB per row."""
         bits = SHARD_WIDTH if u32_words is None else u32_words * 32
+        # dense_range is container-aligned; fetch the covering superset
+        # and slice (sub-container trim widths, e.g. 4096-bit
+        # fingerprint banks).
+        aligned = (bits + CONTAINER_BITS - 1) // CONTAINER_BITS \
+            * CONTAINER_BITS
         u64 = self.storage.dense_range(row_id * SHARD_WIDTH,
-                                       row_id * SHARD_WIDTH + bits)
-        return u64_to_words(u64)
+                                       row_id * SHARD_WIDTH + aligned)
+        return u64_to_words(u64)[:bits // 32]
+
+    def rows_dense(self, row_ids, u32_words: int) -> np.ndarray:
+        """Bulk [len(row_ids), u32_words] u32 prefix block — the chunk-bank
+        fast path. One dict probe + one memcpy per (row, container)
+        instead of a full row_dense call per row: chunked TopN streams
+        65k-row chunks, where per-row Python overhead would dominate the
+        sweep itself."""
+        bits = u32_words * 32
+        assert bits % 64 == 0
+        n_containers = (bits + CONTAINER_BITS - 1) // CONTAINER_BITS
+        cwords64 = CONTAINER_BITS // 64
+        total64 = u32_words // 2
+        out = np.zeros((len(row_ids), total64), dtype=np.uint64)
+        one = np.uint64(1)
+        with self._lock:
+            containers = self.storage.containers
+            for i, r in enumerate(row_ids):
+                k0 = r * CONTAINERS_PER_ROW
+                row = out[i]
+                for j in range(n_containers):
+                    c = containers.get(k0 + j)
+                    if c is None:
+                        continue
+                    lo = j * cwords64
+                    n = min(cwords64, total64 - lo)
+                    if c.dtype == np.uint16:
+                        # Array-encoded: scatter positions straight into
+                        # the output row, no dense materialization.
+                        v = c if n == cwords64 else c[c < n * 64]
+                        v = v.astype(np.uint32)
+                        np.bitwise_or.at(
+                            row, lo + (v >> 6),
+                            np.left_shift(one,
+                                          (v & 63).astype(np.uint64)))
+                    else:
+                        row[lo:lo + n] = c[:n]
+        from pilosa_tpu.ops.bitset import u64_to_words
+        return u64_to_words(out).reshape(len(row_ids), u32_words)
 
     def max_column_offset(self) -> int:
         """Largest in-shard column offset with any bit set in any row, or
@@ -396,9 +449,11 @@ class Fragment:
         other = Bitmap.from_bytes(data)
         with self._lock:
             if clear:
+                from pilosa_tpu.storage.roaring import _as_dense
                 for key in list(self.storage.containers):
                     if key in other.containers:
-                        self.storage.containers[key] &= ~other.containers[key]
+                        c = self.storage._container(key)
+                        c &= ~_as_dense(other.containers[key])
                         self.storage._invalidate(key)
                         self.storage._drop_empty(key)
             else:
@@ -417,18 +472,19 @@ class Fragment:
         tail (overwrite semantics: bits past the operand width are 0)."""
         from pilosa_tpu.ops.bitset import words_to_u64
         with self._lock:
-            self.storage.set_dense_range(
-                row_id * SHARD_WIDTH,
-                words_to_u64(np.ascontiguousarray(words, dtype=np.uint32)))
+            words = np.ascontiguousarray(words, dtype=np.uint32)
+            cw = CONTAINER_BITS // 32
+            if words.size % cw:
+                # Sub-container widths (128-word-granular trimmed banks)
+                # zero-pad up to the container boundary: identical
+                # overwrite semantics, and the tail-clear below can keep
+                # popping whole containers.
+                words = np.concatenate(
+                    [words, np.zeros(cw - words.size % cw, np.uint32)])
+            self.storage.set_dense_range(row_id * SHARD_WIDTH,
+                                         words_to_u64(words))
             bits = words.size * 32
             if bits < SHARD_WIDTH:
-                # The tail-clear below pops whole containers starting at
-                # the container holding bit `bits`; a non-container-aligned
-                # width would silently drop just-written words from that
-                # container. All callers pass container multiples (trimmed
-                # bank widths and plan widths are container-aligned).
-                assert bits % CONTAINER_BITS == 0, \
-                    f"set_row width {bits} not container-aligned"
                 k0 = (row_id * SHARD_WIDTH + bits) >> 16
                 k1 = ((row_id + 1) * SHARD_WIDTH - 1) >> 16
                 for k in range(k0, k1 + 1):

@@ -113,13 +113,15 @@ def bank_capacity(n_rows: int) -> int:
 class View:
     def __init__(self, path: str, index: str, field: str, name: str,
                  cache_type: str = cache_mod.CACHE_TYPE_RANKED,
-                 cache_size: int = cache_mod.DEFAULT_CACHE_SIZE):
+                 cache_size: int = cache_mod.DEFAULT_CACHE_SIZE,
+                 max_columns: int = 0):
         self.path = path  # .../<field>/views/<name>
         self.index = index
         self.field = field
         self.name = name
         self.cache_type = cache_type
         self.cache_size = cache_size
+        self.max_columns = max_columns  # declared column bound (0 = full)
         self.fragments: Dict[int, Fragment] = {}
         self._lock = threading.RLock()
         self.on_new_shard = None  # callback(shard) for shard broadcasts
@@ -173,14 +175,23 @@ class View:
 
     # -- device bank --------------------------------------------------------
 
+    # Word granularity of declared-bound trims: 128 u32 words = 4096
+    # bits = one full VPU lane row, and exactly a Morgan fingerprint.
+    TRIM_GRANULE = 128
+
     def trimmed_words(self) -> int:
         """Bank word width (uint32) covering every set column of every
-        fragment, rounded up to whole containers (2048 u32 words = 2^16
-        bits — the host storage's alignment granularity). Fingerprint-
-        style fields that use a tiny prefix of the 2^20-bit shard get
-        banks 16x smaller."""
+        fragment. With a declared max_columns the width is exact to a
+        128-word granule (a 4096-bit fingerprint field stores 512 B/row
+        in HBM); otherwise it derives from fragment container keys,
+        rounded up to whole containers (2048 u32 words = 2^16 bits — the
+        container granularity of the key-based bound)."""
         from pilosa_tpu.core.fragment import CONTAINER_BITS
         from pilosa_tpu.ops.bitset import WORDS_PER_SHARD
+        if self.max_columns:
+            words = (self.max_columns + 31) // 32
+            g = self.TRIM_GRANULE
+            return min(WORDS_PER_SHARD, (words + g - 1) // g * g)
         cwords = CONTAINER_BITS // 32
         with self._lock:
             frags = list(self.fragments.values())
@@ -250,13 +261,11 @@ class View:
                         return cached
             cap = bank_capacity(len(row_set))
             host = np.zeros((cap, len(shards), width), dtype=np.uint32)
-            slots = {}
-            for i, r in enumerate(row_set):
-                slots[r] = i
-                for si, s in enumerate(shards):
-                    f = frags[s]
-                    if f is not None:
-                        host[i, si] = f.row_dense(r, u32_words=width)
+            slots = {r: i for i, r in enumerate(row_set)}
+            for si, s in enumerate(shards):
+                f = frags[s]
+                if f is not None:
+                    host[:len(row_set), si] = f.rows_dense(row_set, width)
             array = mesh.put_bank(host) if mesh else jnp.asarray(host)
             bank = ViewBank(array, slots, cap - 1, versions)
             if rows is None or cache_rows:

@@ -81,7 +81,11 @@ MAX_STATIC_RANGE_VIEWS = 8
 # through transient chunk banks.
 TOPN_MAX_BANK_BYTES = int(os.environ.get("PILOSA_TPU_TOPN_BANK_BYTES",
                                          2 << 30))
-TOPN_CHUNK_ROWS = 1024
+# Rows per streamed chunk on the over-budget TopN path. Larger chunks
+# amortize dispatch/transfer overhead (100M-fingerprint sweeps want
+# 64k-row chunks); the default keeps at most two ~modest chunk banks
+# live at narrow widths.
+TOPN_CHUNK_ROWS = int(os.environ.get("PILOSA_TPU_TOPN_CHUNK_ROWS", 1024))
 
 
 class _Pending:

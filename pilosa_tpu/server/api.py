@@ -279,7 +279,8 @@ class API:
         mapping = {"type": "type", "cacheType": "cache_type",
                    "cacheSize": "cache_size", "min": "min", "max": "max",
                    "timeQuantum": "time_quantum", "keys": "keys",
-                   "noStandardView": "no_standard_view"}
+                   "noStandardView": "no_standard_view",
+                   "maxColumns": "max_columns"}
         for k, v in options.items():
             if k not in mapping:
                 raise ApiError(f"unknown field option {k!r}")

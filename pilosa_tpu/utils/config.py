@@ -35,8 +35,10 @@ class Config:
     # Standing translate-log replication from the primary (reference
     # monitorReplication, translate.go:359); 0 disables
     translate_replication_interval: float = 10.0
-    # Metrics
-    metric_service: str = "mem"   # mem | none
+    # Metrics (reference server/config.go Metric.Service/Host: expvar |
+    # statsd | none — "mem" is the expvar equivalent)
+    metric_service: str = "mem"   # mem | statsd | none
+    metric_host: str = "localhost:8125"  # statsd agent address
     metric_poll_interval: float = 10.0  # runtime gauge sampling; 0 off
     # Diagnostics phone-home (reference server/config.go:105; OFF unless
     # both an interval and an endpoint URL are configured)

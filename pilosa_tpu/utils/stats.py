@@ -110,6 +110,17 @@ class MultiStatsClient(StatsClient):
     def with_tags(self, *tags):
         return MultiStatsClient(*[c.with_tags(*tags) for c in self.clients])
 
+    def snapshot(self) -> dict:
+        for c in self.clients:
+            if hasattr(c, "snapshot"):
+                return c.snapshot()
+        return {}
+
+    def flush(self) -> None:
+        for c in self.clients:
+            if hasattr(c, "flush"):
+                c.flush()
+
     def count(self, name, value=1, rate=1.0):
         for c in self.clients:
             c.count(name, value, rate)
@@ -142,3 +153,119 @@ class Timer:
 
     def __exit__(self, *exc):
         self.stats.timing(self.name, time.perf_counter() - self.t0)
+
+
+class StatsdStatsClient(StatsClient):
+    """DataDog-flavored statsd over UDP (reference statsd/statsd.go:41,
+    dogstatsd wire format `prefix.name:value|type|@rate|#tag,tag`).
+    Fire-and-forget datagrams with a small in-process buffer flushed by
+    size or interval (the reference uses statsd.NewBuffered, bufferLen
+    datagrams per packet); send errors are logged once and never raised
+    into the serving path."""
+
+    PREFIX = "pilosa."
+    BUFFER_LEN = 16
+    FLUSH_INTERVAL = 1.0
+
+    def __init__(self, host: str, tags: Optional[Sequence[str]] = None,
+                 logger=None, _shared=None):
+        import socket
+
+        self.tags = tuple(tags or ())
+        if _shared is not None:
+            self._shared = _shared
+            return
+        addr = host.rsplit(":", 1)
+        self._shared = {
+            "addr": (addr[0] or "localhost",
+                     int(addr[1]) if len(addr) == 2 else 8125),
+            "sock": socket.socket(socket.AF_INET, socket.SOCK_DGRAM),
+            "buf": [],
+            "lock": threading.Lock(),
+            "logger": logger,
+            "warned": False,
+            "last_flush": time.monotonic(),
+            "stop": threading.Event(),
+        }
+        # Periodic drain: without it, tail datagrams after a burst would
+        # sit in the buffer until the next _emit (or forever).
+        t = threading.Thread(target=self._flush_loop, daemon=True)
+        t.start()
+
+    def _flush_loop(self) -> None:
+        stop = self._shared["stop"]
+        while not stop.wait(self.FLUSH_INTERVAL):
+            self.flush()
+
+    def close(self) -> None:
+        self._shared["stop"].set()
+        self.flush()
+
+    def with_tags(self, *tags: str) -> "StatsdStatsClient":
+        # Sorted-union like the reference's unionStringSlice.
+        merged = tuple(sorted(set(self.tags) | set(tags)))
+        return StatsdStatsClient("", tags=merged, _shared=self._shared)
+
+    def _emit(self, name: str, payload: str, rate: float) -> None:
+        if rate < 1.0:
+            import random
+            if random.random() > rate:
+                return
+        line = f"{self.PREFIX}{name}:{payload}"
+        if rate < 1.0:
+            line += f"|@{rate}"
+        if self.tags:
+            line += "|#" + ",".join(self.tags)
+        s = self._shared
+        with s["lock"]:
+            s["buf"].append(line)
+            now = time.monotonic()
+            if len(s["buf"]) < self.BUFFER_LEN and \
+                    now - s["last_flush"] < self.FLUSH_INTERVAL:
+                return
+            data = "\n".join(s["buf"]).encode()
+            s["buf"].clear()
+            s["last_flush"] = now
+            try:
+                s["sock"].sendto(data, s["addr"])
+            except OSError as e:
+                if not s["warned"] and s["logger"] is not None:
+                    s["logger"].printf("statsd send failed: %s", e)
+                    s["warned"] = True
+
+    def flush(self) -> None:
+        s = self._shared
+        with s["lock"]:
+            if not s["buf"]:
+                return
+            data = "\n".join(s["buf"]).encode()
+            s["buf"].clear()
+            s["last_flush"] = time.monotonic()
+            try:
+                s["sock"].sendto(data, s["addr"])
+            except OSError:
+                pass
+
+    @staticmethod
+    def _num(value) -> str:
+        """Exact decimal formatting: integral values print as integers
+        (no %g 6-digit truncation, no exponent notation that non-DataDog
+        statsd servers may reject)."""
+        f = float(value)
+        return str(int(f)) if f.is_integer() else repr(f)
+
+    def count(self, name, value=1, rate=1.0):
+        self._emit(name, f"{int(value)}|c", rate)
+
+    def gauge(self, name, value, rate=1.0):
+        self._emit(name, f"{self._num(value)}|g", rate)
+
+    def histogram(self, name, value, rate=1.0):
+        self._emit(name, f"{self._num(value)}|h", rate)
+
+    def set(self, name, value, rate=1.0):
+        self._emit(name, f"{value}|s", rate)
+
+    def timing(self, name, value, rate=1.0):
+        # seconds -> ms, the statsd timing unit.
+        self._emit(name, f"{self._num(value * 1000.0)}|ms", rate)

@@ -410,3 +410,115 @@ def test_translate_replica_cursor_survives_out_of_order_adoption():
     applied = replica.apply_log(
         primary.read_log_from(replica.replica_offset), resume=True)
     assert applied == 1 and replica.translate_id(c) == "gamma"
+
+
+def test_max_columns_trimmed_banks(tmp_path):
+    """Declared column bound: banks trim to a 128-word granule instead of
+    the 8 KiB container floor (TPU-first extension, no reference
+    counterpart; motivates the 4096-bit fingerprint workload,
+    docs/examples.md chem use case)."""
+    import pytest as _pytest
+
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+
+    h = Holder(str(tmp_path))
+    h.open()
+    try:
+        idx = h.create_index("mc")
+        f = idx.create_field("fp", FieldOptions(max_columns=4096))
+        rows = np.repeat(np.arange(50, dtype=np.uint64), 8)
+        cols = np.tile(np.arange(8, dtype=np.uint64) * 512 + 3, 50)
+        f.import_bits(rows, cols)
+        view = f.view()
+        assert view.trimmed_words() == 128  # 4096 bits exactly
+        bank = view.device_bank((0,), trim=True)
+        assert bank.array.shape[-1] == 128
+        # Row data survives the narrow round trip.
+        got = np.asarray(bank.array[bank.slot(7)][0])
+        import numpy as _np
+        want = f.view().fragment(0).row_dense(7, u32_words=128)
+        _np.testing.assert_array_equal(got, want)
+        # Writes past the bound fail loudly.
+        with _pytest.raises(ValueError, match="max_columns"):
+            f.set_bit(1, 4096)
+        with _pytest.raises(ValueError, match="max_columns"):
+            f.import_bits(np.array([1], np.uint64),
+                          np.array([5000], np.uint64))
+        # In another shard the per-shard offset is what's bounded.
+        from pilosa_tpu.ops.bitset import SHARD_WIDTH
+        assert f.set_bit(1, SHARD_WIDTH + 100)
+        # Reopen: the bound persists via .meta.
+        h.close()
+        h2 = Holder(str(tmp_path))
+        h2.open()
+        f2 = h2.index("mc").field("fp")
+        assert f2.options.max_columns == 4096
+        assert f2.view().trimmed_words() == 128
+        h2.close()
+    finally:
+        try:
+            h.close()
+        except Exception:
+            pass
+
+
+def test_sub_container_row_dense_and_set_row(tmp_path):
+    """row_dense/rows_dense/set_row at sub-container widths."""
+    from pilosa_tpu.core.fragment import Fragment
+
+    frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0)
+    frag.open()
+    frag.bulk_import(np.array([2, 2, 3], np.uint64),
+                     np.array([0, 4095, 70000], np.uint64))
+    d = frag.row_dense(2, u32_words=128)
+    assert d.shape == (128,) and d[0] & 1 and (d[127] >> 31) & 1
+    bulk = frag.rows_dense([2, 3], 128)
+    np.testing.assert_array_equal(bulk[0], d)
+    assert bulk[1].any() == False  # row 3's bit is past 4096
+    bulk_wide = frag.rows_dense([3], 4096)
+    assert bulk_wide[0][70000 // 32] >> (70000 % 32) & 1
+    # set_row with a 128-word operand clears the whole rest of the row.
+    words = np.zeros(128, np.uint32)
+    words[1] = 0b100
+    frag.set_row(3, words)
+    assert frag.bit(3, 34) and not frag.bit(3, 70000)
+    frag.close()
+
+
+def test_time_field_requires_quantum_and_bsi_bound(tmp_path):
+    """Regressions from review: time fields must still demand a quantum,
+    and max_columns binds BSI writes too."""
+    import pytest as _pytest
+
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+
+    with _pytest.raises(ValueError, match="quantum"):
+        FieldOptions(type="time", time_quantum="").validate()
+    h = Holder(str(tmp_path))
+    h.open()
+    try:
+        idx = h.create_index("tb")
+        f = idx.create_field("v", FieldOptions(type="int", min=0, max=100,
+                                               max_columns=4096))
+        f.set_value(10, 5)
+        with _pytest.raises(ValueError, match="max_columns"):
+            f.set_value(5000, 7)
+        with _pytest.raises(ValueError, match="max_columns"):
+            f.import_values(np.array([4096], np.uint64),
+                            np.array([1], np.int64))
+    finally:
+        h.close()
+
+
+def test_noop_remove_keeps_array_encoding(tmp_path):
+    import numpy as np
+
+    from pilosa_tpu.storage.roaring import Bitmap
+
+    b = Bitmap([1, 5, 9])
+    b.optimize()
+    assert not b.remove(6)  # no-op: must not materialize dense
+    assert b.containers[0].dtype == np.uint16
+    assert b.remove(5) and b.containers[0].dtype == np.uint64

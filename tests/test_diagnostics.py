@@ -109,3 +109,42 @@ def test_slow_query_logged(tmp_path):
     api.query("q", "Count(Row(f=2))")
     assert not any("SLOW QUERY" in line for line in logged)
     holder.close()
+
+
+def test_statsd_client_wire_format():
+    """DataDog-flavored statsd datagrams over UDP (reference
+    statsd/statsd.go:41: prefix 'pilosa.', |c/|g/|ms types, #tags)."""
+    import socket
+
+    from pilosa_tpu.utils.stats import StatsdStatsClient
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    srv.bind(("localhost", 0))
+    srv.settimeout(5)
+    port = srv.getsockname()[1]
+
+    c = StatsdStatsClient(f"localhost:{port}")
+    tagged = c.with_tags("index:i", "field:f")
+    tagged.count("query", 3)
+    c.gauge("goroutines", 12.5)
+    c.timing("exec", 0.25)  # seconds -> 250 ms
+    c.flush()
+    tagged.flush()
+
+    data = b""
+    while b"exec" not in data or b"query" not in data:
+        data += srv.recv(65536) + b"\n"
+    lines = data.decode().split("\n")
+    assert any(l == "pilosa.query:3|c|#field:f,index:i" for l in lines), lines
+    assert any(l == "pilosa.goroutines:12.5|g" for l in lines), lines
+    assert any(l == "pilosa.exec:250|ms" for l in lines), lines
+    srv.close()
+
+
+def test_statsd_send_failure_never_raises():
+    from pilosa_tpu.utils.stats import StatsdStatsClient
+
+    c = StatsdStatsClient("localhost:1")  # nothing listening; UDP is
+    for _ in range(64):                   # fire-and-forget either way
+        c.count("x")
+    c.flush()

@@ -52,8 +52,9 @@ def test_native_parse_matches_python():
     pb = _python_bitmap(data)
     assert keys == sorted(pb.containers)
     assert op_n == 0
+    from pilosa_tpu.storage.roaring import _as_dense
     for i, k in enumerate(keys):
-        assert np.array_equal(words[i], pb.containers[k])
+        assert np.array_equal(words[i], _as_dense(pb.containers[k]))
 
 
 def test_native_serialize_byte_identical():
@@ -82,8 +83,9 @@ def test_native_ops_replay():
     pb = _python_bitmap(data)
     assert op_n == 6  # 1 add + 3 batch-adds + 1 remove + 1 batch-remove
     assert keys == sorted(pb.containers)
+    from pilosa_tpu.storage.roaring import _as_dense
     for i, k in enumerate(keys):
-        assert np.array_equal(words[i], pb.containers[k])
+        assert np.array_equal(words[i], _as_dense(pb.containers[k]))
     # container 20<<16 emptied by the remove op must not be materialized
     assert (20 << 16) >> 16 not in keys
 
