@@ -273,13 +273,8 @@ def tpu_child():
     import tempfile
     import threading
 
-    # The axon sitecustomize hook force-selects its platform through
-    # jax.config (overriding JAX_PLATFORMS); PILOSA_BENCH_PLATFORM gives
-    # smoke tests a handle to force CPU the same way.
-    if os.environ.get("PILOSA_BENCH_PLATFORM"):
-        import jax
-        jax.config.update("jax_platforms",
-                          os.environ["PILOSA_BENCH_PLATFORM"])
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
 
     partial = {}
     done = threading.Event()

@@ -22,6 +22,8 @@ ITERS = 5
 
 
 def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
     from pilosa_tpu.core.holder import Holder
     from pilosa_tpu.executor import Executor
 

@@ -145,6 +145,25 @@ def bench_device_time_table():
                             jnp.bitwise_not(y)), axis=(-2, -1))),
     }
 
+    from pilosa_tpu.ops import pallas_kernels
+    if pallas_kernels.available():
+        # Same sweeps through the hand-tiled Pallas kernels, so the
+        # XLA-vs-Pallas call in ops/pallas_kernels.py's docstring rests
+        # on device-time (slope) evidence, not tunnel-dominated timing.
+        kernels["pallas_sweep_popcount"] = (1, lambda x, y, i: (
+            pallas_kernels.bank_row_counts(jnp.bitwise_xor(x, i))))
+        # Filter-mask sweep: streams ONE bank plus a broadcast [S, W]
+        # filter row (nbanks=1 — crediting two banks would inflate its
+        # GB/s ~2x vs what it actually moves). Compare against the
+        # XLA equivalent of the same workload below, not against the
+        # two-full-bank sweep_and_popcount.
+        kernels["pallas_sweep_filter_popcount"] = (1, lambda x, y, i: (
+            pallas_kernels.bank_row_counts_masked(
+                jnp.bitwise_xor(x, i), y[0])[0]))
+        kernels["sweep_filter_popcount"] = (1, lambda x, y, i: popcount(
+            jnp.bitwise_and(jnp.bitwise_xor(x, i), y[0]),
+            axis=(-2, -1)))
+
     for name, (nbanks, kern) in kernels.items():
         @functools.partial(jax.jit, static_argnums=2)
         def chain(x, y, k, kern=kern):
@@ -170,6 +189,8 @@ def bench_device_time_table():
 
 
 def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
     bench_roaring_kernels()
     bench_fragment_paths()
     bench_device_kernels()

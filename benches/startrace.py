@@ -34,6 +34,8 @@ def post(path, body):
 
 
 def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
     from pilosa_tpu.core.holder import Holder
     from pilosa_tpu.server import API, serve
 

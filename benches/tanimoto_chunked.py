@@ -12,13 +12,15 @@ benchmark measures. Reported `mols_per_sec` is linear in N (each chunk
 is independent), so `projected_100m_s` = 1e8 / mols_per_sec is the
 honest extrapolation to the full BASELINE config.
 
-Scale knob: PILOSA_TANIMOTO_N (default 1_000_000). The bound on this
-box is HOST storage, not the device: the dict-of-dense container
-backend spends one 8 KiB container per molecule row (16x the 512 B of
-fingerprint payload), so 100M molecules needs ~800 GB host RAM — the
-reference's array-encoded containers would hold the same data in ~10 GB
-(roaring/roaring.go:55-63). The device side is already narrow: banks
-trim to 128 u32 words/row, and the chunked sweep touches only real
+Scale knob: PILOSA_TANIMOTO_N (default 1_000_000). Host memory per
+molecule: one sorted-u16 array container (~100 B data+overhead; the
+array encoding of SURVEY component #3, reference roaring.go:55-63) plus
+~200 B of dict/row bookkeeping — 100M molecules ≈ 15-30 GB host RAM,
+versus ~800 GB if containers were dense. The generation-side positions
+array is uint16 (~9.6 GB at 100M), and the numpy baseline streams in
+1M-row packed chunks, so no stage materializes O(N) dense data. The
+device side is narrow too: banks trim to 128 u32 words/row
+(max_columns=4096), and the chunked sweep touches only real
 fingerprint bytes.
 
 Prints one JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
@@ -43,20 +45,30 @@ ITERS = int(os.environ.get("PILOSA_TANIMOTO_ITERS", 3))
 CHUNK_ROWS = 65536
 
 
-def build_fingerprints(rng, n):
-    """Dense 64-word fingerprint blocks [n, FP_BITS//64] (u64)."""
-    bits = rng.integers(0, FP_BITS, (n, BITS_PER_MOL))
+def build_positions(rng, n):
+    """Sorted fingerprint bit positions [n, BITS_PER_MOL] (may repeat).
+    uint16: at 100M molecules this array is ~9.6 GB, not the ~38 GB an
+    int64 default would cost."""
+    return np.sort(rng.integers(0, FP_BITS, (n, BITS_PER_MOL),
+                                dtype=np.uint16), axis=1)
+
+
+def pack_chunk(pos_chunk):
+    """Packed u64 words [rows, FP_BITS//64] for a positions chunk."""
+    n = len(pos_chunk)
     words = np.zeros((n, FP_BITS // 64), dtype=np.uint64)
     flat = words.reshape(-1)
-    np.bitwise_or.at(flat,
-                     np.arange(n).repeat(BITS_PER_MOL) * (FP_BITS // 64)
-                     + (bits >> 6).reshape(-1),
-                     np.uint64(1) << (bits & 63).astype(np.uint64)
-                     .reshape(-1))
+    np.bitwise_or.at(
+        flat,
+        np.arange(n).repeat(BITS_PER_MOL) * (FP_BITS // 64)
+        + (pos_chunk >> 6).reshape(-1),
+        np.uint64(1) << (pos_chunk & 63).astype(np.uint64).reshape(-1))
     return words
 
 
 def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
     # Chunked path knobs must be set before the executor module loads.
     os.environ.setdefault("PILOSA_TPU_TOPN_CHUNK_ROWS", str(CHUNK_ROWS))
     from pilosa_tpu.core.holder import Holder
@@ -71,7 +83,7 @@ def main():
 
     rng = np.random.default_rng(11)
     t0 = time.perf_counter()
-    fp_words = build_fingerprints(rng, N_MOLECULES)
+    positions = build_positions(rng, N_MOLECULES)
     gen_s = time.perf_counter() - t0
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -85,21 +97,20 @@ def main():
                              FieldOptions(max_columns=FP_BITS))
         view = f.create_view_if_not_exists("standard")
         frag = view.create_fragment_if_not_exists(0)
-        # Direct dense container writes (the ImportRoaring-class fast
-        # path): molecule i's fingerprint words land at the head of its
-        # row span i*2^20; the rest of each row stays absent.
+        # Direct array-encoded container writes (the ImportRoaring-class
+        # fast path at bulk scale): molecule i's sorted fingerprint
+        # positions become the u16 array container at the head of its
+        # row span; ~100 B per molecule host-side, the memory story that
+        # makes 100M molecules ~15 GB instead of ~800 GB dense.
         t0 = time.perf_counter()
         store = frag.storage
+        containers = store.containers
+        cpr = SHARD_WIDTH // 65536
         for i in range(N_MOLECULES):
-            c = store._container(i * (SHARD_WIDTH // 65536), create=True)
-            c[:FP_BITS // 64] = fp_words[i]
-            store._invalidate(i * (SHARD_WIDTH // 65536))
+            containers[i * cpr] = np.unique(positions[i]).astype(np.uint16)
         for i in range(N_MOLECULES):
             frag._touch_row(i)
-        # Re-encode sparse containers as u16 arrays: 96 B vs 8 KiB per
-        # molecule host-side (Bitmap.optimize; completes the memory story
-        # that makes 100M molecules ~10 GB instead of ~800 GB).
-        converted = frag.optimize_storage()
+        converted = N_MOLECULES
         load_s = time.perf_counter() - t0
 
         ex = Executor(holder)
@@ -117,12 +128,18 @@ def main():
             assert got.pairs == want.pairs
         tpu_t = float(np.median(times))
 
-        # Exact numpy baseline over the same packed words (one core).
+        # Exact numpy baseline over the same data (one core), streamed in
+        # packed chunks so baseline memory stays bounded at any N.
         t0 = time.perf_counter()
-        filt = fp_words[QUERY_MOL]
-        inter = np.bitwise_count(fp_words & filt).sum(axis=1)
-        raw = np.bitwise_count(fp_words).sum(axis=1)
+        filt = pack_chunk(positions[QUERY_MOL:QUERY_MOL + 1])[0]
         src = int(np.bitwise_count(filt).sum())
+        inter_parts, raw_parts = [], []
+        for c0 in range(0, N_MOLECULES, 1_000_000):
+            pw = pack_chunk(positions[c0:c0 + 1_000_000])
+            inter_parts.append(np.bitwise_count(pw & filt).sum(axis=1))
+            raw_parts.append(np.bitwise_count(pw).sum(axis=1))
+        inter = np.concatenate(inter_parts)
+        raw = np.concatenate(raw_parts)
         denom = raw + src - inter
         keep = (denom > 0) & ((inter * 100) // np.maximum(denom, 1)
                               >= THRESHOLD) & (inter > 0)

@@ -42,6 +42,8 @@ def emit(metric, tpu_t, cpu_t, **extra):
 
 
 def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
     from pilosa_tpu.core.field import FieldOptions
     from pilosa_tpu.core.holder import Holder
     from pilosa_tpu.executor import Executor
@@ -118,7 +120,7 @@ def main():
         want_c = int((cab == 1).sum())
         c3 = time.perf_counter() - t0
         assert (got.value, got.count) == (want_v, want_c)
-        emit("taxi_sum_filtered_p50", t, c3, value=got.value)
+        emit("taxi_sum_filtered_p50", t, c3, sum=got.value)
 
         # 4. TopN over passenger_count
         t, got = p50("TopN(passenger_count, n=3)")

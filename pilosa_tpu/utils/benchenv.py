@@ -1,0 +1,14 @@
+"""Benchmark environment helpers shared by bench.py and benches/*."""
+
+import os
+
+
+def apply_bench_platform() -> None:
+    """Honor PILOSA_BENCH_PLATFORM (e.g. 'cpu' for smoke runs): the axon
+    sitecustomize hook force-selects its platform through jax.config,
+    overriding JAX_PLATFORMS, so benches must override it back the same
+    way tests/conftest.py does."""
+    if os.environ.get("PILOSA_BENCH_PLATFORM"):
+        import jax
+        jax.config.update("jax_platforms",
+                          os.environ["PILOSA_BENCH_PLATFORM"])

@@ -305,6 +305,124 @@ def test_query_during_resize_window_no_undercount(tmp_path):
         nodes[0].stop()
 
 
+def test_failed_pull_leaves_cluster_resizing(tmp_path):
+    """A node that cannot complete its pull keeps the cluster RESIZING:
+    reads keep the safe pre-change placement until an operator aborts
+    (reference keeps the cluster in RESIZING while the job is live,
+    cluster.go:1458-1530)."""
+    import time
+
+    nodes = run_cluster(tmp_path, 1)
+    base = nodes[0].uri
+    req(base, "POST", "/index/fz", {"options": {}})
+    req(base, "POST", "/index/fz/field/f", {"options": {}})
+    cols = [s * SHARD_WIDTH for s in range(4)]
+    req(base, "POST", "/index/fz/field/f/import",
+        {"rowIDs": [1] * 4, "columnIDs": cols})
+
+    newcomer = ClusterNode(tmp_path, "n9")
+    newcomer.start(None, 1)
+    newcomer.attach_cluster([nodes[0].uri, newcomer.uri], 1)
+    try:
+        def broken_pull():
+            raise RuntimeError("disk full")
+
+        newcomer.api.resize_puller.pull_owned = broken_pull
+        req(base, "POST", "/internal/join",
+            {"id": newcomer.uri, "uri": newcomer.uri})
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            time.sleep(0.05)  # give the job thread time to fail
+            if req(base, "GET", "/status")["state"] == "RESIZING":
+                break
+        # The job failed; the cluster STAYS RESIZING and reads stay
+        # complete via the pre-change placement.
+        assert req(base, "GET", "/status")["state"] == "RESIZING"
+        for uri in (base, newcomer.uri):
+            r = req(uri, "POST", "/index/fz/query", b"Count(Row(f=1))")
+            assert r["results"] == [4], uri
+        # Operator abort adopts the new placement everywhere.
+        res = req(base, "POST", "/cluster/resize/abort")
+        assert res["aborted"] is True
+        assert req(newcomer.uri, "GET", "/status")["state"] == "NORMAL"
+    finally:
+        newcomer.stop()
+        nodes[0].stop()
+
+
+def test_overlapping_resizes_finalize_only_latest(tmp_path):
+    """A resize job superseded by a newer topology change must NOT adopt
+    the new placement when it finishes first; only the newest job's
+    completion ends RESIZING (generation guard + membership-tagged
+    resize-complete)."""
+    import threading
+    import time
+
+    nodes = run_cluster(tmp_path, 1)
+    base = nodes[0].uri
+    req(base, "POST", "/index/ov", {"options": {}})
+    req(base, "POST", "/index/ov/field/f", {"options": {}})
+    cols = [s * SHARD_WIDTH for s in range(6)]
+    req(base, "POST", "/index/ov/field/f/import",
+        {"rowIDs": [1] * 6, "columnIDs": cols})
+
+    n1 = ClusterNode(tmp_path, "na")
+    n1.start(None, 1)
+    n1.attach_cluster([nodes[0].uri, n1.uri], 1)
+    n2 = ClusterNode(tmp_path, "nb")
+    n2.start(None, 1)
+    try:
+        # First join: n1's pull blocks until released.
+        release1 = threading.Event()
+        orig1 = n1.api.resize_puller.pull_owned
+
+        def slow1():
+            release1.wait(timeout=30)
+            return orig1()
+
+        n1.api.resize_puller.pull_owned = slow1
+        req(base, "POST", "/internal/join", {"id": n1.uri, "uri": n1.uri})
+        assert req(base, "GET", "/status")["state"] == "RESIZING"
+
+        # Second join arrives mid-resize.
+        n2.attach_cluster([nodes[0].uri, n1.uri, n2.uri], 1)
+        req(base, "POST", "/internal/join", {"id": n2.uri, "uri": n2.uri})
+
+        # Let job 1 finish: it is superseded, so the cluster must STAY
+        # RESIZING (job 2's pulls — n2's among them — may not be done).
+        release1.set()
+        time.sleep(1.0)
+        st = req(base, "GET", "/status")
+        # Either job 2 also finished (fine: all pulls done) or the state
+        # is still RESIZING; what must NEVER happen is NORMAL while n2
+        # lacks its shards.
+        if st["state"] == "NORMAL":
+            owned = [s for s in range(6)
+                     if n2.cluster.owns_shard("ov", s)]
+            held = n2.holder.index("ov").available_shards() \
+                if n2.holder.index("ov") else []
+            assert set(owned) <= set(held)
+        for uri in (base, n1.uri, n2.uri):
+            r = req(uri, "POST", "/index/ov/query", b"Count(Row(f=1))")
+            assert r["results"] == [6], uri
+        # Eventually everything settles NORMAL with data in place.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            states = {req(u, "GET", "/status")["state"]
+                      for u in (base, n1.uri, n2.uri)}
+            if states == {"NORMAL"}:
+                break
+            time.sleep(0.1)
+        assert states == {"NORMAL"}
+        for uri in (base, n1.uri, n2.uri):
+            r = req(uri, "POST", "/index/ov/query", b"Count(Row(f=1))")
+            assert r["results"] == [6], uri
+    finally:
+        for nd in (n1, n2):
+            nd.stop()
+        nodes[0].stop()
+
+
 def test_resize_abort_is_honest(tmp_path):
     """Abort cannot undo a pull-based resize; the response says so and
     the cluster adopts the new placement (divergence from reference
