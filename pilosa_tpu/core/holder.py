@@ -109,6 +109,8 @@ class Holder:
                         "max": f.options.max,
                         "timeQuantum": f.options.time_quantum,
                         "keys": f.options.keys,
+                        "noStandardView": f.options.no_standard_view,
+                        "maxColumns": f.options.max_columns,
                     },
                 })
             out.append({"name": iname,

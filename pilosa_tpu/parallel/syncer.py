@@ -141,7 +141,9 @@ class HolderSyncer:
                 peer.uri, index, field,
                 {"type": o.type, "cacheType": o.cache_type,
                  "cacheSize": o.cache_size, "min": o.min, "max": o.max,
-                 "timeQuantum": o.time_quantum, "keys": o.keys})
+                 "timeQuantum": o.time_quantum, "keys": o.keys,
+                 "noStandardView": o.no_standard_view,
+                 "maxColumns": o.max_columns})
 
     def _sync_block(self, index, field, view, shard, frag, peer, block,
                     stats) -> None:
@@ -222,7 +224,10 @@ class ResizePuller:
                             cache_size=o.get("cacheSize", 50000),
                             min=o.get("min", 0), max=o.get("max", 0),
                             time_quantum=o.get("timeQuantum", ""),
-                            keys=o.get("keys", False)))
+                            keys=o.get("keys", False),
+                            no_standard_view=o.get("noStandardView",
+                                                   False),
+                            max_columns=o.get("maxColumns", 0)))
                 for shard in idx_info.get("shards", []):
                     fetched += self._maybe_pull(peer, idx, shard)
         return fetched

@@ -117,14 +117,25 @@ def _dense_to_array(dense: np.ndarray) -> np.ndarray:
     return np.nonzero(bits)[0].astype(np.uint16)
 
 
-def _array_to_dense(values: np.ndarray) -> np.ndarray:
+def _low_mask(low: np.ndarray) -> np.ndarray:
+    """Dense u64[1024] mask from in-container positions. Size-adaptive:
+    bool-scatter + packbits beats np.bitwise_or.at (~100 ns/element)
+    once groups get dense — the fragment bulk-import hot path."""
+    if len(low) >= 256:
+        bits = np.zeros(CONTAINER_BITS, dtype=bool)
+        bits[low] = True
+        return np.packbits(bits, bitorder="little").view(np.uint64).copy()
     dense = _new_container()
-    if len(values):
-        v = values.astype(np.uint32)
+    if len(low):
+        v = low.astype(np.uint32)
         np.bitwise_or.at(
             dense, v >> 6, np.left_shift(np.uint64(1), (v & 63).astype(np.uint64))
         )
     return dense
+
+
+def _array_to_dense(values: np.ndarray) -> np.ndarray:
+    return _low_mask(np.asarray(values))
 
 
 def _runs_to_dense(runs: np.ndarray) -> np.ndarray:
@@ -296,21 +307,16 @@ class Bitmap:
         for i, key in enumerate(uniq.tolist()):
             group = positions[bounds[i]:bounds[i + 1]]
             low = (group & np.uint64(0xFFFF)).astype(np.uint32)
-            fresh = key not in self.containers
-            c = self._container(key, create=True)
-            if fresh:
+            if key not in self.containers:
                 # New container + unique positions: count is len(group),
                 # no popcounts needed.
-                np.bitwise_or.at(
-                    c, low >> 6,
-                    np.left_shift(np.uint64(1), (low & 63).astype(np.uint64)))
+                self.containers[key] = _low_mask(low)
                 self._counts[key] = len(group)
                 changed += len(group)
                 continue
+            c = self._container(key)
             before = self.container_count(key)
-            np.bitwise_or.at(
-                c, low >> 6, np.left_shift(np.uint64(1), (low & 63).astype(np.uint64))
-            )
+            c |= _low_mask(low)
             self._invalidate(key)
             changed += self.container_count(key) - before
         return changed
@@ -329,10 +335,7 @@ class Bitmap:
             c = self._container(key)
             group = positions[bounds[i]:bounds[i + 1]]
             low = (group & np.uint64(0xFFFF)).astype(np.uint32)
-            mask = _new_container()
-            np.bitwise_or.at(
-                mask, low >> 6, np.left_shift(np.uint64(1), (low & 63).astype(np.uint64))
-            )
+            mask = _low_mask(low)
             before = self.container_count(key)
             c &= ~mask
             self._invalidate(key)

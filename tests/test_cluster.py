@@ -324,17 +324,21 @@ def test_failed_pull_leaves_cluster_resizing(tmp_path):
     newcomer.start(None, 1)
     newcomer.attach_cluster([nodes[0].uri, newcomer.uri], 1)
     try:
+        import threading
+        failed = threading.Event()
+
         def broken_pull():
+            failed.set()
             raise RuntimeError("disk full")
 
         newcomer.api.resize_puller.pull_owned = broken_pull
         req(base, "POST", "/internal/join",
             {"id": newcomer.uri, "uri": newcomer.uri})
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:
-            time.sleep(0.05)  # give the job thread time to fail
-            if req(base, "GET", "/status")["state"] == "RESIZING":
-                break
+        # Wait for the FAILURE to be observable (not the RESIZING
+        # precondition, which is set synchronously before the job runs),
+        # then give the job thread time to handle it.
+        assert failed.wait(timeout=10)
+        time.sleep(1.0)  # let the job thread run its failure handling
         # The job failed; the cluster STAYS RESIZING and reads stay
         # complete via the pre-change placement.
         assert req(base, "GET", "/status")["state"] == "RESIZING"
@@ -724,6 +728,27 @@ def test_cluster_admin_remove_node_and_coordinator(tmp_path):
         assert res["results"] == [2]
         # abort reports state without error
         assert "state" in req(base, "POST", "/cluster/resize/abort")
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_schema_sync_preserves_all_field_options(tmp_path):
+    """maxColumns/noStandardView must survive anti-entropy schema
+    creation — a replica without the declared bound would accept
+    out-of-range writes the owner rejects."""
+    nodes = run_cluster(tmp_path, 2, replica_n=2)
+    try:
+        from pilosa_tpu.core.field import FieldOptions
+        nodes[0].holder.create_index("sp").create_field(
+            "fp", FieldOptions(max_columns=4096, cache_size=123))
+        nodes[0].holder.index("sp").field("fp").import_bits(
+            np.array([1], np.uint64), np.array([9], np.uint64))
+        req(nodes[0].uri, "POST", "/internal/sync")
+        f = nodes[1].holder.index("sp").field("fp")
+        assert f is not None
+        assert f.options.max_columns == 4096
+        assert f.options.cache_size == 123
     finally:
         for nd in nodes:
             nd.stop()
