@@ -316,7 +316,15 @@ class Bitmap:
                 continue
             c = self._container(key)
             before = self.container_count(key)
-            c |= _low_mask(low)
+            if len(low) >= 256:
+                c |= _low_mask(low)
+            else:
+                # Sparse group into an existing container: scatter in
+                # place, no 8 KiB temp mask.
+                np.bitwise_or.at(
+                    c, low >> 6,
+                    np.left_shift(np.uint64(1),
+                                  (low & 63).astype(np.uint64)))
             self._invalidate(key)
             changed += self.container_count(key) - before
         return changed

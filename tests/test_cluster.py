@@ -325,22 +325,28 @@ def test_failed_pull_leaves_cluster_resizing(tmp_path):
     newcomer.attach_cluster([nodes[0].uri, newcomer.uri], 1)
     try:
         import threading
-        failed = threading.Event()
 
         def broken_pull():
-            failed.set()
             raise RuntimeError("disk full")
 
         newcomer.api.resize_puller.pull_owned = broken_pull
+        # The deterministic completion signal: the job's failure handler
+        # logs "stays RESIZING". Wrap the coordinator's logger so the
+        # test waits for the handler itself, not a timing guess.
+        handled = threading.Event()
+        orig_printf = nodes[0].api.logger.printf
+
+        def recording_printf(fmt, *args):
+            if "stays" in fmt and "RESIZING" in fmt:
+                handled.set()
+            return orig_printf(fmt, *args)
+
+        nodes[0].api.logger.printf = recording_printf
         req(base, "POST", "/internal/join",
             {"id": newcomer.uri, "uri": newcomer.uri})
-        # Wait for the FAILURE to be observable (not the RESIZING
-        # precondition, which is set synchronously before the job runs),
-        # then give the job thread time to handle it.
-        assert failed.wait(timeout=10)
-        time.sleep(1.0)  # let the job thread run its failure handling
-        # The job failed; the cluster STAYS RESIZING and reads stay
-        # complete via the pre-change placement.
+        assert handled.wait(timeout=15)
+        # The job's failure handler ran; the cluster STAYS RESIZING and
+        # reads stay complete via the pre-change placement.
         assert req(base, "GET", "/status")["state"] == "RESIZING"
         for uri in (base, newcomer.uri):
             r = req(uri, "POST", "/index/fz/query", b"Count(Row(f=1))")

@@ -104,3 +104,51 @@ def test_concurrent_bulk_import_and_topn(world):
     (cnt,) = ex.execute("c", "Count(Row(f=1))")
     assert cnt == f.view().fragment(0).row_count(1) + sum(
         fr.row_count(1) for s, fr in f.view().fragments.items() if s != 0)
+
+
+def test_concurrent_queries_under_tiny_bank_budget(world):
+    """Queries racing while the global bank budget constantly evicts
+    other threads' cached banks: results must stay exact (evicted banks
+    are rebuilt; a query holding a device array keeps it alive via its
+    own reference regardless of cache eviction)."""
+    import pilosa_tpu.core.view as view_mod
+
+    ex, h = world
+    idx = h.index("c")
+    for fname in ("a", "b", "d"):
+        f = idx.create_field(fname)
+        f.import_bits(np.repeat(np.arange(4, dtype=np.uint64), 25),
+                      np.tile(np.arange(25, dtype=np.uint64) * 7, 4))
+    idx.add_existence(np.arange(200, dtype=np.uint64))
+    want = {}
+    for fname in ("a", "b", "d"):
+        (want[fname],) = ex.execute("c", f"Count(Row({fname}=2))")
+
+    orig = view_mod.BANK_BUDGET
+    view_mod.BANK_BUDGET = view_mod.BankBudget(1 << 16)  # ~one bank
+    for fname in ("a", "b", "d"):
+        view = idx.field(fname).view()
+        for key in list(view._bank_cache):
+            orig.forget(view, key)  # keep the global budget's accounting
+        view._bank_cache.clear()
+    errors = []
+
+    def worker(fname):
+        try:
+            for _ in range(N_OPS):
+                (got,) = ex.execute("c", f"Count(Row({fname}=2))")
+                assert got == want[fname], (fname, got, want[fname])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(fn,))
+                   for fn in ("a", "b", "d") for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert view_mod.BANK_BUDGET.evictions > 0
+    finally:
+        view_mod.BANK_BUDGET = orig
