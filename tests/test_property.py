@@ -108,3 +108,45 @@ def test_shard_scoped_queries_match(world):
     (got,) = ex.execute("p", f"Options({pql}, shards=[0])")
     want = {c for c in full if c // SHARD_WIDTH == 0}
     assert set(got.columns().tolist()) == want
+
+
+def test_random_ops_with_interleaved_optimize(tmp_path):
+    """Random add/remove batches interleaved with optimize() (encoding
+    flips) must always match a python-set model — the dual-encoding
+    equivalence property (reference container conversions,
+    roaring.go:1927-2100)."""
+    from pilosa_tpu.storage.roaring import ARRAY_MAX_SIZE, Bitmap
+
+    rng = np.random.default_rng(3)
+    b = Bitmap()
+    model = set()
+    universe = 5 << 16
+    for step in range(60):
+        kind = rng.random()
+        batch = rng.integers(0, universe,
+                             rng.integers(1, 2000), dtype=np.uint64)
+        if kind < 0.45:
+            b.direct_add_n(batch)
+            model |= set(batch.tolist())
+        elif kind < 0.8:
+            b.direct_remove_n(batch)
+            model -= set(batch.tolist())
+        elif kind < 0.9:
+            # dense run to push some containers past ARRAY_MAX_SIZE
+            start = int(rng.integers(0, universe - ARRAY_MAX_SIZE * 2))
+            run = np.arange(start, start + ARRAY_MAX_SIZE * 2,
+                            dtype=np.uint64)
+            b.direct_add_n(run)
+            model |= set(run.tolist())
+        else:
+            b.optimize()
+        if step % 7 == 0:
+            b.optimize()
+            assert b.count() == len(model)
+            got = set(b.slice().tolist())
+            assert got == model, (len(got), len(model))
+            # spot-check point reads across encodings
+            for p in rng.integers(0, universe, 20, dtype=np.uint64):
+                assert b.contains(int(p)) == (int(p) in model)
+    # serialization equivalence at the end state
+    assert set(Bitmap.from_bytes(b.write_bytes()).slice().tolist()) == model
