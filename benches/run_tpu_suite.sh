@@ -1,0 +1,32 @@
+#!/bin/bash
+# Waits for the TPU tunnel to answer, then runs every bench serially,
+# recording outputs. Between benches it WAITS for the tunnel to return
+# rather than aborting — it must survive the tunnel's known flakiness.
+cd /root/repo
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp
+print(int(jnp.ones((8,), jnp.uint32).sum()))" >/dev/null 2>&1
+}
+wait_tpu() {
+  until probe; do
+    echo "$(date -u +%H:%M:%S) waiting for TPU..." >&2
+    sleep 120
+  done
+}
+run() {  # run <name> <timeout> <cmd...>
+  local name=$1 to=$2; shift 2
+  wait_tpu
+  echo "$(date -u +%H:%M:%S) bench: $name" >&2
+  timeout "$to" "$@" > "benches/${name}_r02_tpu.jsonl" 2> "benches/${name}_r02_tpu.err"
+  echo "$(date -u +%H:%M:%S) bench: $name rc=$?" >&2
+}
+run tanimoto_chunked 2400 env PILOSA_TANIMOTO_N=2000000 PILOSA_TANIMOTO_ITERS=3 python benches/tanimoto_chunked.py
+run taxi 2400 env PILOSA_TAXI_N=2000000 PILOSA_TAXI_ITERS=3 python benches/taxi.py
+run micro 1800 python benches/micro.py
+run startrace 1200 python benches/startrace.py
+run bsi 1800 python benches/bsi.py
+wait_tpu
+echo "$(date -u +%H:%M:%S) final bench.py" >&2
+python bench.py > BENCH_late.json 2> bench_late.err
+echo "$(date -u +%H:%M:%S) suite done rc=$?" >&2
