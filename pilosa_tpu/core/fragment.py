@@ -251,26 +251,55 @@ class Fragment:
         one = np.uint64(1)
         with self._lock:
             containers = self.storage.containers
-            for i, r in enumerate(row_ids):
-                k0 = r * CONTAINERS_PER_ROW
-                row = out[i]
-                for j in range(n_containers):
-                    c = containers.get(k0 + j)
+            # Fast path for the narrow single-container layout (declared
+            # max_columns <= 2^16, e.g. fingerprints): gather every
+            # row's u16 array and do ONE flat scatter over the whole
+            # block — no per-row Python work beyond the dict probe.
+            if n_containers == 1:
+                flat = out.reshape(-1)
+                arrays, rows_at = [], []
+                for i, r in enumerate(row_ids):
+                    c = containers.get(r * CONTAINERS_PER_ROW)
                     if c is None:
                         continue
-                    lo = j * cwords64
-                    n = min(cwords64, total64 - lo)
-                    if c.dtype == np.uint16:
-                        # Array-encoded: scatter positions straight into
-                        # the output row, no dense materialization.
-                        v = c if n == cwords64 else c[c < n * 64]
-                        v = v.astype(np.uint32)
-                        np.bitwise_or.at(
-                            row, lo + (v >> 6),
-                            np.left_shift(one,
-                                          (v & 63).astype(np.uint64)))
-                    else:
-                        row[lo:lo + n] = c[:n]
+                    if c.dtype != np.uint16:
+                        n = min(cwords64, total64)
+                        out[i, :n] = c[:n]
+                        continue
+                    v = c if total64 == cwords64 else c[c < total64 * 64]
+                    arrays.append(v)
+                    rows_at.append(i)
+                if arrays:
+                    lens = np.fromiter((len(a) for a in arrays),
+                                       dtype=np.int64, count=len(arrays))
+                    pos = np.concatenate(arrays).astype(np.uint32)
+                    base = np.repeat(
+                        np.asarray(rows_at, dtype=np.int64) * total64,
+                        lens)
+                    np.bitwise_or.at(
+                        flat, base + (pos >> 6),
+                        np.left_shift(one, (pos & 63).astype(np.uint64)))
+            else:
+                for i, r in enumerate(row_ids):
+                    k0 = r * CONTAINERS_PER_ROW
+                    row = out[i]
+                    for j in range(n_containers):
+                        c = containers.get(k0 + j)
+                        if c is None:
+                            continue
+                        lo = j * cwords64
+                        n = min(cwords64, total64 - lo)
+                        if c.dtype == np.uint16:
+                            # Array-encoded: scatter positions straight
+                            # into the output row, no materialization.
+                            v = c if n == cwords64 else c[c < n * 64]
+                            v = v.astype(np.uint32)
+                            np.bitwise_or.at(
+                                row, lo + (v >> 6),
+                                np.left_shift(one,
+                                              (v & 63).astype(np.uint64)))
+                        else:
+                            row[lo:lo + n] = c[:n]
         from pilosa_tpu.ops.bitset import u64_to_words
         return u64_to_words(out).reshape(len(row_ids), u32_words)
 
