@@ -64,13 +64,12 @@ class FieldOptions:
         if self.type == FIELD_TYPE_INT:
             if self.max < self.min:
                 raise ValueError("int field max must be >= min")
-            # BSI predicate operands ride in uint32 device params (JAX runs
-            # without x64 on TPU); spans needing >32 bit planes would
-            # silently truncate, so reject them up front. (Two-limb params
-            # would lift this to the reference's 2^63 range.)
-            if (self.max - self.min).bit_length() > 32:
+            # Predicates ride as two u32 limbs in device params
+            # (executor/bsi.py _vbit), covering the reference's int64
+            # range (bsiGroup, field.go:1360): up to 63 bit planes.
+            if (self.max - self.min).bit_length() > 63:
                 raise ValueError(
-                    "int field range too large: max-min must fit in 32 bits")
+                    "int field range too large: max-min must fit in 63 bits")
         if self.type == FIELD_TYPE_TIME:
             timeq.validate_quantum(self.time_quantum)
             if not self.time_quantum:

@@ -24,7 +24,14 @@ def not_null(planes):
 
 def _vbit(value, i):
     """Bit i of a (possibly traced) comparison value, as a bool scalar —
-    keeps predicate values out of the compile cache key."""
+    keeps predicate values out of the compile cache key. `value` may be
+    a single u32 scalar (depth <= 32) or a (lo, hi) pair of u32 limbs
+    carrying a 64-bit base value (JAX runs without x64 on TPU, so wide
+    predicates ride as two u32 params; the limb choice is static because
+    the plane index is)."""
+    if isinstance(value, tuple):
+        limb, j = (value[0], i) if i < 32 else (value[1], i - 32)
+        return _vbit(limb, j)
     return (jnp.right_shift(jnp.uint32(value) if isinstance(value, int)
                             else value.astype(jnp.uint32),
                             jnp.uint32(i)) & jnp.uint32(1)).astype(bool)

@@ -696,6 +696,19 @@ class Executor:
         op = cond.op
         zeros = (lambda b, i, p, l:
                  jnp.zeros((len(shards), plan.width), jnp.uint32))
+
+        def push_value(base: int) -> int:
+            """Base values ride as two u32 limbs in the traced params
+            (depth can reach 63 planes; reference int fields span int64,
+            field.go:1360)."""
+            j = len(plan.params)
+            plan.params.extend([base & 0xFFFFFFFF,
+                                (base >> 32) & 0xFFFFFFFF])
+            return j
+
+        def limbs(p, j):
+            return (p[j], p[j + 1])
+
         if op == BETWEEN:
             lo_hi = cond.int_slice()
             lo, ok_lo = bsig.base_value_clamped(lo_hi[0], ">=")
@@ -703,11 +716,11 @@ class Executor:
             if not (ok_lo and ok_hi) or lo > hi:
                 plan.sig_parts.append("z")
                 return zeros
-            j = len(plan.params)
-            plan.params.extend([lo, hi])
+            j = push_value(lo)
+            k = push_value(hi)
             plan.sig_parts.append(f"c><{pos}d{depth}")
-            return lambda b, i, p, l: bsi.between(planes_of(b, i), p[j],
-                                                  p[j + 1])
+            return lambda b, i, p, l: bsi.between(
+                planes_of(b, i), limbs(p, j), limbs(p, k))
         value = int(cond.value)
         base, in_range = bsig.base_value_clamped(value, op)
         if op in (EQ, NEQ) and not in_range:
@@ -725,8 +738,7 @@ class Executor:
             allow_eq = (op == GTE) or (value < bsig.min)
         else:
             allow_eq = False
-        j = len(plan.params)
-        plan.params.append(base)
+        j = push_value(base)
         kernels = {
             EQ: lambda pl, v: bsi.eq(pl, v),
             NEQ: lambda pl, v: bsi.neq(pl, v),
@@ -737,7 +749,7 @@ class Executor:
         }
         kern = kernels[op]
         plan.sig_parts.append(f"c{op}{int(allow_eq)}{pos}d{depth}")
-        return lambda b, i, p, l: kern(planes_of(b, i), p[j])
+        return lambda b, i, p, l: kern(planes_of(b, i), limbs(p, j))
 
     # ----------------------------------------------------------- bank fetch
 

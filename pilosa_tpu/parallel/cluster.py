@@ -159,18 +159,23 @@ class Cluster:
 
     # -- resize lifecycle ----------------------------------------------------
 
-    def begin_resize(self, prev: Optional[List[Node]] = None) -> None:
+    def begin_resize(self, prev: Optional[List[Node]] = None) -> List[Node]:
         """Enter RESIZING, pinning the pre-change placement (reference
         broadcasts ClusterStatus{state: RESIZING}, cluster.go:1070). If a
         second topology change arrives mid-resize the ORIGINAL snapshot is
-        kept — data still lives where the oldest placement says."""
+        kept — data still lives where the oldest placement says. Returns
+        the pinned snapshot so callers broadcast EXACTLY what this node
+        pinned (reading prev_nodes separately would race a concurrent
+        end_resize clearing it)."""
         with self._lock:
             if self.prev_nodes is None:
                 self.prev_nodes = (list(prev) if prev is not None
-                                   else self.nodes())
+                                   else [self._nodes[k]
+                                         for k in sorted(self._nodes)])
             self.state = STATE_RESIZING
             self.resize_gen += 1
             self.save()
+            return list(self.prev_nodes)
 
     def end_resize(self) -> None:
         """Resize complete (or aborted): adopt the current placement for

@@ -180,6 +180,10 @@ class ResizePuller:
         self.cluster = cluster
         self.client = client or InternalClient()
         self.logger = logger
+        # Overlapping resize jobs may both ask this node to pull; the
+        # passes are idempotent but their schema-discovery writes race
+        # (create_field "already exists"), so serialize them.
+        self._pull_lock = threading.Lock()
 
     def _log(self, fmt, *args):
         if self.logger is not None:
@@ -190,6 +194,10 @@ class ResizePuller:
         the resize job protocol (server/api.py _start_resize_job), not
         here: during the pull the cluster stays RESIZING so reads keep
         routing against the pre-change placement."""
+        with self._pull_lock:
+            return self._pull_owned_locked()
+
+    def _pull_owned_locked(self) -> int:
         # Pull sources: current members ∪ pre-resize members. After a
         # remove-node resize the only holder of a shard may be the node
         # being removed (alive, detached) — it is still reachable via the

@@ -526,8 +526,13 @@ class API:
         from pilosa_tpu.parallel.cluster import Node
         from pilosa_tpu.parallel.client import ClientError
         node = Node.from_json(node_info)
-        prev = [n.to_json() for n in self.cluster.nodes()]
-        self.cluster.begin_resize()
+        # The safe read placement to broadcast is the OLDEST in-flight
+        # snapshot (begin_resize pins and returns it atomically), not the
+        # current membership: with overlapping joins, a node added by an
+        # unfinished earlier resize may not hold its shards yet, so late
+        # joiners must route reads all the way back to where the data is
+        # guaranteed to live.
+        prev = [n.to_json() for n in self.cluster.begin_resize()]
         self.cluster.add_node(node)
         for peer in self.cluster.nodes():
             if peer.id in (self.cluster.local.id, node.id):
@@ -692,8 +697,7 @@ class API:
             raise ApiError("cannot remove the receiving node; send the "
                            "request to another node", 400)
         removed = self.cluster.node_by_id(node_id)
-        prev = [n.to_json() for n in self.cluster.nodes()]
-        self.cluster.begin_resize()
+        prev = [n.to_json() for n in self.cluster.begin_resize()]
         self.cluster.remove_node(node_id)
         for peer in self.cluster.nodes():
             if peer.id == self.cluster.local.id:

@@ -651,3 +651,39 @@ def test_bank_budget_lru_eviction(tmp_path):
             view_mod.BANK_BUDGET = orig
     finally:
         h.close()
+
+
+def test_bsi_64bit_range(ex):
+    """Int fields spanning more than 32 bits: predicates ride as two u32
+    limbs (reference bsiGroup int64 range, field.go:1360)."""
+    e, h = ex
+    idx = h.create_index("wide")
+    lo, hi = -(1 << 40), (1 << 40)
+    idx.create_field("v", FieldOptions(type="int", min=lo, max=hi))
+    cols = np.arange(8, dtype=np.uint64)
+    vals = np.array([lo, -(1 << 35), -1, 0, 1, (1 << 33) + 7,
+                     (1 << 39), hi], np.int64)
+    idx.field("v").import_values(cols, vals)
+    idx.add_existence(cols)
+
+    cases = [
+        (f"Row(v > {1 << 33})", [5, 6, 7]),
+        (f"Row(v >= {(1 << 33) + 7})", [5, 6, 7]),
+        (f"Row(v < {-(1 << 34)})", [0, 1]),
+        (f"Row(v == {(1 << 33) + 7})", [5]),
+        (f"Row(v != {(1 << 33) + 7})", [0, 1, 2, 3, 4, 6, 7]),
+        (f"Row({-(1 << 36)} < v < {1 << 36})", [1, 2, 3, 4, 5]),
+        ("Row(v > 0)", [4, 5, 6, 7]),
+    ]
+    for pql, want in cases:
+        (res,) = e.execute("wide", pql)
+        np.testing.assert_array_equal(res.columns(), want, err_msg=pql)
+    (s,) = e.execute("wide", "Sum(field=v)")
+    assert s.value == int(vals.sum()) and s.count == 8
+    (mn,) = e.execute("wide", "Min(field=v)")
+    assert (mn.value, mn.count) == (lo, 1)
+    (mx,) = e.execute("wide", "Max(field=v)")
+    assert (mx.value, mx.count) == (hi, 1)
+    # spans past 63 bits are still rejected up front
+    with pytest.raises(ValueError, match="63 bits"):
+        FieldOptions(type="int", min=-(1 << 62), max=1 << 62).validate()

@@ -158,7 +158,8 @@ def test_int_field_range_guard(tmp_path):
     h = Holder(str(tmp_path))
     h.open()
     idx = h.create_index("i")
-    with pytest.raises(ValueError, match="32 bits"):
-        idx.create_field("big", FieldOptions(type="int", min=0, max=2**40))
-    idx.create_field("ok", FieldOptions(type="int", min=-2**31, max=2**31 - 1))
+    with pytest.raises(ValueError, match="63 bits"):
+        idx.create_field("big", FieldOptions(type="int", min=-2**62,
+                                             max=2**62))
+    idx.create_field("ok", FieldOptions(type="int", min=0, max=2**40))
     h.close()
