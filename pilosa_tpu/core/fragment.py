@@ -494,6 +494,27 @@ class Fragment:
                     self.cache.bulk_add(int(r), self.row_count(int(r)))
             self._snapshot()
 
+    def replace_with_bytes(self, data: bytes) -> None:
+        """Overwrite the whole fragment from serialized roaring bytes —
+        the reference's resize data motion (followResizeInstruction
+        streams the fragment file in place, cluster.go:1251,
+        http/client.go:711). Unlike import_roaring's union, bits absent
+        from `data` are dropped: a stale local copy must not resurrect
+        columns cleared in epochs this node missed."""
+        other = Bitmap.from_bytes(data)
+        with self._lock:
+            old_rows = set(self.row_ids())
+            self.storage.containers = other.containers
+            self.storage._counts = {}
+            self.storage.optimize()
+            rows = old_rows | {k // CONTAINERS_PER_ROW
+                               for k in self.storage.containers}
+            for r in rows:
+                self._touch_row(int(r))
+                if self.cache_type != cache_mod.CACHE_TYPE_NONE:
+                    self.cache.bulk_add(int(r), self.row_count(int(r)))
+            self._snapshot()
+
     def set_row(self, row_id: int, words: np.ndarray) -> None:
         """Replace a row's bits wholesale (reference setRow, fragment.go:522
         — the Store() write path). `words` is uint32, up to

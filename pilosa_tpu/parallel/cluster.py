@@ -170,8 +170,7 @@ class Cluster:
         with self._lock:
             if self.prev_nodes is None:
                 self.prev_nodes = (list(prev) if prev is not None
-                                   else [self._nodes[k]
-                                         for k in sorted(self._nodes)])
+                                   else self.nodes())  # RLock: safe
             self.state = STATE_RESIZING
             self.resize_gen += 1
             self.save()

@@ -172,6 +172,38 @@ class ClusterExecutor:
         if cache is not None:
             cache.pop(index, None)
 
+    def note_written_shards(self, index: str, shards) -> None:
+        """A completed write touched `shards`: invalidate locally and —
+        when any shard is NEW to this coordinator — tell every routable
+        node (current ∪ pre-resize members: a departing node still
+        serving reads mid-resize needs the push too) to drop its cached
+        list. Without the push, another node could serve an undercount
+        for up to GLOBAL_SHARDS_TTL after the first write lands in a
+        brand-new shard (the reference instead broadcasts
+        CreateShardMessage on fragment creation, view.go:221).
+        Suppression uses a MONOTONE per-index known-shards set — not the
+        TTL cache, which this method itself invalidates — so steady-
+        state writes into known shards genuinely broadcast nothing.
+        Call AFTER the write has been applied/fanned out: peers
+        re-discover on their next read, which must find the data."""
+        known = getattr(self, "_known_shards", None)
+        if known is None:
+            known = self._known_shards = {}
+        seen = known.setdefault(index, set())
+        fresh = [int(s) for s in shards if int(s) not in seen]
+        seen.update(int(s) for s in shards)
+        self.invalidate_shards_cache(index)
+        if not fresh:
+            return
+        for node in self.cluster.known_nodes():
+            if node.id == self.cluster.local.id:
+                continue
+            try:
+                self.client.cluster_message(
+                    node.uri, {"type": "shards-changed", "index": index})
+            except ClientError:
+                pass
+
     # -- query --------------------------------------------------------------
 
     def execute(self, index: str, query: str,
@@ -200,7 +232,6 @@ class ClusterExecutor:
                 shards = [int(s) for s in opt_shards]
             inner = inner.children[0]
         if inner.name in _WRITE_SINGLE_COL:
-            self.invalidate_shards_cache(index)
             return self._execute_write_single(index, inner)
         if inner.name in _WRITE_BROADCAST:
             self.invalidate_shards_cache(index)
@@ -309,6 +340,9 @@ class ClusterExecutor:
             # honest answer; anti-entropy can only heal from a copy that
             # exists.
             raise last_err or ClientError("no replica accepted the write")
+        # After the write landed (keyed columns translated above): push
+        # the shard-list invalidation so no peer undercounts a new shard.
+        self.note_written_shards(index, [shard])
         return result
 
     def _execute_write_broadcast(self, index: str, call: Call) -> Any:

@@ -208,7 +208,8 @@ class ResizePuller:
         if not peers:
             return 0
         fetched = 0
-        # Discover remote schema + shard holdings.
+        # Discover remote schema + per-shard holders first.
+        holders: Dict[tuple, list] = {}  # (index, shard) -> [node, ...]
         for peer in peers:
             try:
                 schema = self.client.schema(peer.uri)
@@ -237,10 +238,56 @@ class ResizePuller:
                                                    False),
                             max_columns=o.get("maxColumns", 0)))
                 for shard in idx_info.get("shards", []):
-                    fetched += self._maybe_pull(peer, idx, shard)
+                    holders.setdefault((iname, int(shard)), []).append(peer)
+        # Pull each owned shard from the most AUTHORITATIVE holder:
+        # pre-change owners first (they served every write of the ending
+        # epoch; reference fragSources computes exactly these,
+        # cluster.go:741-826), then current owners, then any holder.
+        # Old non-owner copies can linger (cleanup is a separate step)
+        # and may be epochs stale — pulling from "whoever lists the
+        # shard" silently resurrects them.
+        for (iname, shard), hold in holders.items():
+            idx = self.holder.index(iname)
+            if idx is None or not self.cluster.owns_shard(iname, shard):
+                continue
+            # A node REGAINING ownership may still hold a copy from an
+            # older epoch that missed every write in between — it must
+            # refresh (union-merge) from the authoritative holder, not
+            # trust its own fragment. Previous-epoch owners served all
+            # of the ending epoch's writes, so their copies are current
+            # and need no refresh.
+            local = self.cluster.local.id
+            was_owner = any(
+                n.id == local
+                for n in self.cluster.shard_nodes(iname, shard,
+                                                  previous=True))
+            for peer in self._source_order(iname, shard, hold):
+                got = self._maybe_pull(peer, idx, shard,
+                                       refresh=not was_owner)
+                fetched += got
+                if got:
+                    # Refreshed from the most authoritative holder;
+                    # later candidates only fill views it lacked.
+                    was_owner = True
         return fetched
 
-    def _maybe_pull(self, peer, idx, shard: int) -> int:
+    def _source_order(self, index: str, shard: int, holders: list) -> list:
+        by_id = {n.id: n for n in holders}
+        ordered = []
+        for previous in (True, False):
+            for n in self.cluster.shard_nodes(index, shard,
+                                              previous=previous):
+                if n.id in by_id:
+                    ordered.append(by_id.pop(n.id))
+        ordered.extend(by_id.values())
+        return ordered
+
+    def _maybe_pull(self, peer, idx, shard: int,
+                    refresh: bool = False) -> int:
+        """Pull shard fragments this node lacks from `peer`.
+        refresh=True also union-merges fragments it already holds —
+        used when ownership was just (re)gained and the local copy may
+        be stale."""
         if not self.cluster.owns_shard(idx.name, shard):
             return 0
         fetched = 0
@@ -251,7 +298,8 @@ class ResizePuller:
                 continue
             for vname in views:
                 view = field.view(vname)
-                if view is not None and view.fragment(shard) is not None:
+                held = view is not None and view.fragment(shard) is not None
+                if held and not refresh:
                     continue  # already hold it; anti-entropy reconciles
                 try:
                     data = self.client.retrieve_shard(
@@ -260,7 +308,14 @@ class ResizePuller:
                     continue
                 frag = field.create_view_if_not_exists(vname) \
                     .create_fragment_if_not_exists(shard)
-                frag.import_roaring(data)
+                # REPLACE, don't union: a stale local copy must not
+                # resurrect bits cleared while this node wasn't an
+                # owner. (Narrow caveat, documented: a write that
+                # reached ONLY this node during the resize window —
+                # i.e. every other owner's leg failed — is dropped
+                # here; the reference avoids this by rejecting writes
+                # while RESIZING, api.go:76-99.)
+                frag.replace_with_bytes(data)
                 fetched += 1
                 self._log("resize: pulled %s/%s/%s/shard %s from %s",
                           idx.name, fname, vname, shard, peer.id)

@@ -330,15 +330,20 @@ class API:
                   else (timeq.parse_timestamp(t) if isinstance(t, str) else t)
                   for t in timestamps]
 
-        if self.cluster_executor is not None:
-            self.cluster_executor.invalidate_shards_cache(index)
+        touched = np.unique(columns // np.uint64(SHARD_WIDTH)).tolist()
         if self.cluster is not None and not remote:
             self._import_fanout(index, field, rows, columns, timestamps,
                                 clear, values=None)
+            # AFTER the fan-out: peers invalidated now will re-discover
+            # lists that already include the new shards.
+            self.cluster_executor.note_written_shards(index, touched)
             return
         f.import_bits(rows, columns, timestamps=ts, clear=clear)
         if not clear:
             idx.add_existence(columns)
+        if self.cluster_executor is not None:
+            # Remote leg: local cache only; the coordinator pushes.
+            self.cluster_executor.invalidate_shards_cache(index)
 
     def _import_fanout(self, index, field, rows, columns, timestamps,
                        clear, values) -> None:
@@ -391,11 +396,11 @@ class API:
         values = np.asarray(values, dtype=np.int64)
         if len(columns) != len(values):
             raise ApiError("columns and values length mismatch")
-        if self.cluster_executor is not None:
-            self.cluster_executor.invalidate_shards_cache(index)
+        touched = np.unique(columns // np.uint64(SHARD_WIDTH)).tolist()
         if self.cluster is not None and not remote:
             self._import_fanout(index, field, None, columns, None, clear,
                                 values=values)
+            self.cluster_executor.note_written_shards(index, touched)
             return
         try:
             f.import_values(columns, values, clear=clear)
@@ -403,16 +408,17 @@ class API:
             raise ApiError(str(e))
         if not clear:
             idx.add_existence(columns)
+        if self.cluster_executor is not None:
+            self.cluster_executor.invalidate_shards_cache(index)
 
     def import_roaring(self, index: str, field: str, shard: int,
                        data: bytes, clear: bool = False,
-                       view: str = "standard") -> None:
+                       view: str = "standard",
+                       remote: bool = False) -> None:
         """Pre-serialized roaring import — the fastest path (reference
         API.ImportRoaring, api.go:291)."""
         idx = self._index(index)
         f = self._field(idx, field)
-        if self.cluster_executor is not None:
-            self.cluster_executor.invalidate_shards_cache(index)
         frag = f.create_view_if_not_exists(view) \
             .create_fragment_if_not_exists(shard)
         try:
@@ -423,6 +429,12 @@ class API:
             + np.uint64(shard * SHARD_WIDTH)
         if len(cols):
             idx.add_existence(np.unique(cols))
+        if self.cluster_executor is not None:
+            if remote:
+                self.cluster_executor.invalidate_shards_cache(index)
+            else:
+                self.cluster_executor.note_written_shards(index,
+                                                          [int(shard)])
 
     # ---------------------------------------------------------------- export
 
@@ -659,6 +671,12 @@ class API:
                     if msg.get("prev") else None
                 self.cluster.begin_resize(prev)
                 self.cluster.remove_node(msg["nodeID"])
+        elif typ == "shards-changed":
+            # A peer created new shards: drop the cached global shard
+            # list so the next read re-discovers (the pull-model
+            # counterpart of the reference's CreateShardMessage).
+            if self.cluster_executor is not None:
+                self.cluster_executor.invalidate_shards_cache(msg["index"])
         elif typ == "resize-complete":
             members = msg.get("members")
             if members is None or \

@@ -242,7 +242,8 @@ class Handler(BaseHTTPRequestHandler):
                     path):
                 api.import_roaring(m.group(1), m.group(2), int(m.group(3)),
                                    self._body(), clear=bool(q.get("clear")),
-                                   view=q.get("view", "standard"))
+                                   view=q.get("view", "standard"),
+                                   remote=bool(q.get("remote")))
                 self._json({})
             elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)", path):
                 b = self._body_json()

@@ -205,9 +205,13 @@ def test_resize_pull_on_join(tmp_path):
     base = nodes[0].uri
     req(base, "POST", "/index/ci", {"options": {}})
     req(base, "POST", "/index/ci/field/f", {"options": {}})
-    cols = [s * SHARD_WIDTH for s in range(4)]
+    # Enough shards that the newcomer owns at least one with
+    # overwhelming probability under any port-derived node ids; the
+    # assertions below still hold exactly if it happens to own none.
+    n_shards = 16
+    cols = [s * SHARD_WIDTH for s in range(n_shards)]
     req(base, "POST", "/index/ci/field/f/import",
-        {"rowIDs": [1] * 4, "columnIDs": cols})
+        {"rowIDs": [1] * n_shards, "columnIDs": cols})
 
     newcomer = ClusterNode(tmp_path, "n9")
     newcomer.start(None, 1)
@@ -217,15 +221,17 @@ def test_resize_pull_on_join(tmp_path):
             {"id": newcomer.uri, "uri": newcomer.uri})
         newcomer.attach_cluster([nodes[0].uri, newcomer.uri], 1)
         # newcomer pulls what it now owns
-        res = req(newcomer.uri, "POST", "/cluster/resize/run")
-        assert res["fetched"] > 0
-        owned = [s for s in range(4)
+        req(newcomer.uri, "POST", "/cluster/resize/run")
+        owned = [s for s in range(n_shards)
                  if newcomer.cluster.owns_shard("ci", s)]
+        # `fetched` is indeterminate: the join-triggered background job
+        # may have already pulled some fragments. Holdings are the
+        # contract.
         assert newcomer.holder.index("ci").available_shards() == owned
         # cluster-wide query still complete from either node
         for uri in (base, newcomer.uri):
             r = req(uri, "POST", "/index/ci/query", b"Count(Row(f=1))")
-            assert r["results"] == [4]
+            assert r["results"] == [n_shards]
     finally:
         newcomer.stop()
         nodes[0].stop()
@@ -853,5 +859,88 @@ def test_cluster_with_per_node_mesh_composes(tmp_path):
             assert r["results"][1] == 0
             assert r["results"][2][0]["count"] == 10
     finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_cluster_soak_random_schedule(tmp_path):
+    """Deterministic soak: a seeded schedule of imports, point writes,
+    membership changes (join + remove with resize jobs), anti-entropy
+    passes, and per-node reads — every read from every node must match a
+    host-side model at every step (the querygenerator + clustertests
+    combination, internal/test/querygenerator.go +
+    internal/clustertests/)."""
+    import time
+
+    rng = np.random.RandomState(1234)
+    nodes = run_cluster(tmp_path, 3, replica_n=2)
+    extra = None
+    model = {}  # row -> set(cols)
+
+    def wait_normal(uris, timeout=30):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(req(u, "GET", "/status")["state"] == "NORMAL"
+                   for u in uris):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def verify(uris):
+        for row in sorted(model):
+            want = len(model[row])
+            for u in uris:
+                r = req(u, "POST", "/index/sk/query",
+                        f"Count(Row(f={row}))".encode())
+                assert r["results"] == [want], (u, row, r, want)
+
+    try:
+        base = nodes[0].uri
+        req(base, "POST", "/index/sk", {"options": {}})
+        req(base, "POST", "/index/sk/field/f", {"options": {}})
+        uris = [nd.uri for nd in nodes]
+        for step in range(12):
+            via = uris[rng.randint(len(uris))]
+            if step == 3:
+                # grow to 4 nodes via a real join + resize job
+                # (membership steps are pinned so the schedule is
+                # guaranteed to exercise BOTH resize directions under
+                # data, whatever the seed does elsewhere)
+                extra = ClusterNode(tmp_path, f"extra{step}")
+                extra.start(None, 2)
+                extra.attach_cluster(uris + [extra.uri], 2)
+                req(base, "POST", "/internal/join",
+                    {"id": extra.uri, "uri": extra.uri})
+                assert wait_normal(uris + [extra.uri]), "join resize hung"
+                uris = uris + [extra.uri]
+            elif step == 8:
+                # shrink back to 3
+                req(base, "POST", "/cluster/resize/remove-node",
+                    {"id": extra.uri})
+                uris = [u for u in uris if u != extra.uri]
+                assert wait_normal(uris), "remove resize hung"
+                extra.stop()
+                extra = None
+            elif rng.rand() < 0.6:
+                rows = rng.randint(0, 4, 30)
+                cols = rng.randint(0, 4 * SHARD_WIDTH, 30)
+                req(via, "POST", "/index/sk/field/f/import",
+                    {"rowIDs": rows.tolist(), "columnIDs": cols.tolist()})
+                for r_, c_ in zip(rows.tolist(), cols.tolist()):
+                    model.setdefault(r_, set()).add(c_)
+            elif rng.rand() < 0.7:
+                r_, c_ = int(rng.randint(0, 4)), int(
+                    rng.randint(0, 4 * SHARD_WIDTH))
+                req(via, "POST", "/index/sk/query",
+                    f"Set({c_}, f={r_})".encode())
+                model.setdefault(r_, set()).add(c_)
+            else:
+                req(via, "POST", "/internal/sync")
+            verify(uris)
+        req(base, "POST", "/internal/sync")
+        verify(uris)
+    finally:
+        if extra is not None:
+            extra.stop()
         for nd in nodes:
             nd.stop()
