@@ -379,4 +379,47 @@ void pn_row_popcounts(const uint64_t* words, uint64_t rows,
   }
 }
 
+// Dense container masks from SORTED positions, grouped by key = pos>>16 —
+// the bulk-import hot loop (the reference's DirectAddN container fill,
+// roaring.go:228-ish). keys_out[m], words_out[m*1024] (caller zeroes and
+// sizes by the precomputed distinct-key count m). Returns groups written,
+// or 0 on a group-count mismatch.
+uint64_t pn_build_masks(const uint64_t* positions, uint64_t n, uint64_t m,
+                        uint64_t* keys_out, uint64_t* words_out) {
+  if (n == 0 || m == 0) return 0;
+  uint64_t w = 0;
+  uint64_t cur = positions[0] >> 16;
+  keys_out[0] = cur;
+  for (uint64_t i = 0; i < n; i++) {
+    uint64_t key = positions[i] >> 16;
+    if (key != cur) {
+      if (++w >= m) return 0;
+      keys_out[w] = key;
+      cur = key;
+    }
+    uint64_t low = positions[i] & 0xFFFF;
+    words_out[w * 1024 + (low >> 6)] |= 1ull << (low & 63);
+  }
+  return w + 1;
+}
+
+// Scatter per-row u16 in-container positions into a [*, words64] u64
+// block — the chunk-bank gather for array-encoded (fingerprint-style)
+// containers. pos holds the rows' positions back to back (lens[r] each);
+// row_index[r] is the target row in `out`. Positions at or beyond the
+// trimmed width are skipped (sub-container bank widths).
+void pn_scatter_rows(const uint16_t* pos, const uint64_t* lens,
+                     uint64_t rows, const uint64_t* row_index,
+                     uint64_t words64, uint64_t* out) {
+  uint64_t off = 0;
+  for (uint64_t r = 0; r < rows; r++) {
+    uint64_t* row = out + row_index[r] * words64;
+    for (uint64_t j = 0; j < lens[r]; j++) {
+      uint16_t p = pos[off + j];
+      if ((uint64_t)(p >> 6) < words64) row[p >> 6] |= 1ull << (p & 63);
+    }
+    off += lens[r];
+  }
+}
+
 }  // extern "C"

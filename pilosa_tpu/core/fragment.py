@@ -270,15 +270,22 @@ class Fragment:
                     arrays.append(v)
                     rows_at.append(i)
                 if arrays:
+                    from pilosa_tpu import native
                     lens = np.fromiter((len(a) for a in arrays),
                                        dtype=np.int64, count=len(arrays))
-                    pos = np.concatenate(arrays).astype(np.uint32)
-                    base = np.repeat(
-                        np.asarray(rows_at, dtype=np.int64) * total64,
-                        lens)
-                    np.bitwise_or.at(
-                        flat, base + (pos >> 6),
-                        np.left_shift(one, (pos & 63).astype(np.uint64)))
+                    pos16 = np.concatenate(arrays)
+                    if not native.scatter_rows(
+                            pos16, lens,
+                            np.asarray(rows_at, dtype=np.uint64),
+                            total64, out):
+                        pos = pos16.astype(np.uint32)
+                        base = np.repeat(
+                            np.asarray(rows_at, dtype=np.int64) * total64,
+                            lens)
+                        np.bitwise_or.at(
+                            flat, base + (pos >> 6),
+                            np.left_shift(one,
+                                          (pos & 63).astype(np.uint64)))
             else:
                 for i, r in enumerate(row_ids):
                     k0 = r * CONTAINERS_PER_ROW

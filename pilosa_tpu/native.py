@@ -69,6 +69,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pn_intersection_count.argtypes = [p_u64, p_u64, u64]
     lib.pn_intersection_count.restype = u64
     lib.pn_row_popcounts.argtypes = [p_u64, u64, u64, p_u64]
+    p_u16 = ctypes.POINTER(ctypes.c_uint16)
+    lib.pn_build_masks.argtypes = [p_u64, u64, u64, p_u64, p_u64]
+    lib.pn_build_masks.restype = u64
+    lib.pn_scatter_rows.argtypes = [p_u16, p_u64, u64, p_u64, u64, p_u64]
     return lib
 
 
@@ -201,3 +205,38 @@ def row_popcounts(words: np.ndarray) -> Optional[np.ndarray]:
     out = np.empty(rows, dtype=np.uint64)
     lib.pn_row_popcounts(_as_u64_ptr(words), rows, wpr, _as_u64_ptr(out))
     return out
+
+
+def build_masks(positions: np.ndarray, m: int):
+    """Dense container masks for sorted positions grouped by pos>>16.
+    Returns (keys uint64[m], words uint64[m, 1024]) or None when the
+    native library is unavailable. `m` = distinct key count (callers have
+    it from np.unique)."""
+    lib = load()
+    if lib is None:
+        return None
+    positions = np.ascontiguousarray(positions, dtype=np.uint64)
+    keys = np.empty(m, dtype=np.uint64)
+    words = np.zeros((m, CONTAINER_WORDS), dtype=np.uint64)
+    got = lib.pn_build_masks(_as_u64_ptr(positions), len(positions), m,
+                             _as_u64_ptr(keys), _as_u64_ptr(words))
+    if got != m:
+        raise ValueError(f"pn_build_masks: {got} groups, expected {m}")
+    return keys, words
+
+
+def scatter_rows(pos: np.ndarray, lens: np.ndarray, row_index: np.ndarray,
+                 words64: int, out: np.ndarray) -> bool:
+    """Scatter concatenated per-row u16 positions into `out` (u64,
+    row-major, width words64). Returns False when unavailable."""
+    lib = load()
+    if lib is None:
+        return False
+    pos = np.ascontiguousarray(pos, dtype=np.uint16)
+    lens = np.ascontiguousarray(lens, dtype=np.uint64)
+    row_index = np.ascontiguousarray(row_index, dtype=np.uint64)
+    lib.pn_scatter_rows(
+        pos.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        _as_u64_ptr(lens), len(lens), _as_u64_ptr(row_index),
+        words64, _as_u64_ptr(out))
+    return True

@@ -304,21 +304,42 @@ class Bitmap:
         # unique(return_index) pass — O(N), not O(N x keys).
         uniq, starts = np.unique(keys, return_index=True)
         bounds = np.append(starts, len(positions))
+        # Native path: ONE C pass builds every group's dense mask (the
+        # data-loader hot loop); Python then only merges per container.
+        masks = None
+        # Gate on group density and count: the mask block is m x 8 KiB,
+        # so a key-sparse import (a bit or two per container) must keep
+        # the in-place scatter path instead of allocating gigabytes.
+        if len(positions) >= 4096 and len(uniq) <= 65536 and \
+                len(positions) >= 64 * len(uniq):
+            built = native.build_masks(positions, len(uniq))
+            if built is not None:
+                masks = built[1]
         for i, key in enumerate(uniq.tolist()):
-            group = positions[bounds[i]:bounds[i + 1]]
-            low = (group & np.uint64(0xFFFF)).astype(np.uint32)
+            group_len = int(bounds[i + 1] - bounds[i])
             if key not in self.containers:
-                # New container + unique positions: count is len(group),
+                # New container + unique positions: count is group_len,
                 # no popcounts needed.
-                self.containers[key] = _low_mask(low)
-                self._counts[key] = len(group)
-                changed += len(group)
+                if masks is not None:
+                    self.containers[key] = masks[i].copy()
+                else:
+                    group = positions[bounds[i]:bounds[i + 1]]
+                    low = (group & np.uint64(0xFFFF)).astype(np.uint32)
+                    self.containers[key] = _low_mask(low)
+                self._counts[key] = group_len
+                changed += group_len
                 continue
             c = self._container(key)
             before = self.container_count(key)
-            if len(low) >= 256:
-                c |= _low_mask(low)
+            if masks is not None:
+                c |= masks[i]
+            elif group_len >= 256:
+                group = positions[bounds[i]:bounds[i + 1]]
+                c |= _low_mask((group & np.uint64(0xFFFF))
+                               .astype(np.uint32))
             else:
+                group = positions[bounds[i]:bounds[i + 1]]
+                low = (group & np.uint64(0xFFFF)).astype(np.uint32)
                 # Sparse group into an existing container: scatter in
                 # place, no 8 KiB temp mask.
                 np.bitwise_or.at(
