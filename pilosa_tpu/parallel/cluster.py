@@ -78,7 +78,32 @@ class Cluster:
         # joins must not adopt the new placement until the LAST job's
         # pulls complete).
         self.resize_gen = 0
+        # Pinned key-translation primary. None = lexically-first member
+        # (single-node / static bootstrap). Pinned before the first
+        # dynamic membership change so a joiner with a smaller id cannot
+        # steal primacy with an EMPTY key store and mint colliding ids
+        # (the reference pins the translate source by ring position,
+        # cluster.go:1908-1935).
+        self.translate_primary_id: Optional[str] = None
         self._lock = threading.RLock()
+
+    def translate_primary(self) -> Node:
+        with self._lock:
+            if self.translate_primary_id is not None:
+                n = self._nodes.get(self.translate_primary_id)
+                if n is not None:
+                    return n
+            return self._nodes[sorted(self._nodes)[0]]
+
+    def pin_translate_primary(self, node_id: Optional[str] = None) -> str:
+        """Pin (or re-pin) the translation primary; defaults to the
+        current effective primary. Returns the pinned id."""
+        with self._lock:
+            if node_id is None:
+                node_id = self.translate_primary().id
+            self.translate_primary_id = node_id
+            self.save()
+            return node_id
 
     # -- membership ---------------------------------------------------------
 
@@ -264,6 +289,8 @@ class Cluster:
         tmp = self.topology_path + ".tmp"
         doc = {"nodes": [n.to_json() for n in self.nodes()],
                "replicaN": self.replica_n}
+        if self.translate_primary_id is not None:
+            doc["translatePrimary"] = self.translate_primary_id
         if self.prev_nodes is not None:
             # Survive a restart mid-resize: reads keep the safe pre-change
             # placement until the job (or an abort) finishes.
@@ -284,6 +311,8 @@ class Cluster:
                 if node.id != self.local.id:
                     self._nodes[node.id] = node
             self.replica_n = data.get("replicaN", self.replica_n)
+            if data.get("translatePrimary"):
+                self.translate_primary_id = data["translatePrimary"]
             if data.get("resizing"):
                 self.prev_nodes = [Node.from_json(nd)
                                    for nd in data.get("prevNodes", [])]
@@ -300,4 +329,6 @@ class Cluster:
                              for n in self.nodes()]}
             if self.prev_nodes is not None:
                 out["prevNodes"] = [n.to_json() for n in self.prev_nodes]
+            if self.translate_primary_id is not None:
+                out["translatePrimary"] = self.translate_primary_id
             return out

@@ -63,10 +63,12 @@ class API:
     # -------------------------------------------------- translation primary
 
     def _translate_primary(self):
-        """The lexically-first node allocates all keys (the reference pins
-        the translate log primary similarly by ring position,
+        """The pinned primary allocates all keys (default: lexically-
+        first member; pinned before any dynamic membership change so a
+        joiner cannot steal primacy with an empty store — the reference
+        pins the translate source by ring position,
         cluster.go:1908-1935)."""
-        return self.cluster.nodes()[0]
+        return self.cluster.translate_primary()
 
     def _resolve_key_via_primary(self, index: str, field: Optional[str],
                                  keys: List[str]) -> List[int]:
@@ -545,6 +547,9 @@ class API:
         # joiners must route reads all the way back to where the data is
         # guaranteed to live.
         prev = [n.to_json() for n in self.cluster.begin_resize()]
+        # Pin the translation primary to a PRE-join member: the joiner's
+        # empty key store must never become the allocator.
+        tp = self.cluster.pin_translate_primary()
         self.cluster.add_node(node)
         for peer in self.cluster.nodes():
             if peer.id in (self.cluster.local.id, node.id):
@@ -552,7 +557,7 @@ class API:
             try:
                 self._client.cluster_message(
                     peer.uri, {"type": "node-join", "node": node.to_json(),
-                               "prev": prev})
+                               "prev": prev, "translatePrimary": tp})
             except ClientError:
                 pass
         # The joining node adopts the full topology AND the in-flight
@@ -563,7 +568,7 @@ class API:
                 node.uri, {"type": "topology",
                            "nodes": [n.to_json()
                                      for n in self.cluster.nodes()],
-                           "prev": prev})
+                           "prev": prev, "translatePrimary": tp})
         except ClientError:
             pass
         self._start_resize_job()
@@ -630,13 +635,18 @@ class API:
         from pilosa_tpu.parallel.client import ClientError
         members = self.cluster.member_ids()
         self.cluster.end_resize()
+        # The pinned translate primary rides along as a second chance for
+        # any peer that missed the node-join/leave broadcast carrying it
+        # (divergent pins would mint colliding ids indefinitely).
+        tp = self.cluster.translate_primary_id
         for peer in self.cluster.nodes():
             if peer.id == self.cluster.local.id:
                 continue
             try:
                 self._client.cluster_message(
                     peer.uri, {"type": "resize-complete",
-                               "members": members})
+                               "members": members,
+                               **({"translatePrimary": tp} if tp else {})})
             except ClientError:
                 pass
 
@@ -653,6 +663,8 @@ class API:
             return
         from pilosa_tpu.parallel.cluster import Node
         typ = msg.get("type")
+        if msg.get("translatePrimary"):
+            self.cluster.pin_translate_primary(msg["translatePrimary"])
         if typ == "node-join":
             prev = [Node.from_json(nd) for nd in msg["prev"]] \
                 if msg.get("prev") else None
@@ -716,6 +728,33 @@ class API:
                            "request to another node", 400)
         removed = self.cluster.node_by_id(node_id)
         prev = [n.to_json() for n in self.cluster.begin_resize()]
+        was_primary = self.cluster.translate_primary().id == node_id
+        tp = None
+        if was_primary:
+            # Catch our replica up from the departing primary while it is
+            # still reachable, then promote OURSELVES: this node's store
+            # is the one we just made complete — promoting any other
+            # survivor could crown a lagging replica that would mint
+            # colliding ids. Known limits without a consensus protocol
+            # (accepted, logged): a key allocated on the old primary
+            # AFTER this sync and before peers learn of the removal can
+            # collide; and if the old primary is already dead the sync
+            # fails and our replica may lag — both heal only by operator
+            # intervention, exactly like the reference's unreplicated
+            # TranslateFile (translate.go:56).
+            try:
+                self._sync_translate_stores()
+            except Exception as e:
+                self.logger.printf(
+                    "remove-node: translate catch-up from departing "
+                    "primary failed (%s: %s); promoting %s with its "
+                    "current replica — ids allocated on the old primary "
+                    "but not yet replicated may be lost",
+                    type(e).__name__, e, self.cluster.local.id)
+            # Pin BEFORE removing the node: otherwise a concurrent
+            # allocation between removal and pin would route to the
+            # lexically-first fallback, which may lag.
+            tp = self.cluster.pin_translate_primary(self.cluster.local.id)
         self.cluster.remove_node(node_id)
         for peer in self.cluster.nodes():
             if peer.id == self.cluster.local.id:
@@ -723,7 +762,8 @@ class API:
             try:
                 self._client.cluster_message(
                     peer.uri, {"type": "node-leave", "nodeID": node_id,
-                               "prev": prev})
+                               "prev": prev,
+                               **({"translatePrimary": tp} if tp else {})})
             except ClientError:
                 pass
         # Tell the removed node too (it may still be alive): it detaches

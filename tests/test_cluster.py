@@ -33,8 +33,8 @@ class ClusterNode:
         self.uri = f"http://localhost:{self.server.server_address[1]}"
         return self.uri
 
-    def attach_cluster(self, uris, replica_n):
-        cluster = Cluster(Node(self.uri, self.uri),
+    def attach_cluster(self, uris, replica_n, node_id=None):
+        cluster = Cluster(Node(node_id or self.uri, self.uri),
                           replica_n=replica_n)
         for uri in uris:
             if uri != self.uri:
@@ -944,3 +944,56 @@ def test_cluster_soak_random_schedule(tmp_path):
             extra.stop()
         for nd in nodes:
             nd.stop()
+
+
+def test_translate_primary_pinned_across_membership(tmp_path):
+    """A joiner whose id sorts FIRST must not become the key allocator
+    with an empty store (id collisions); removing the primary promotes
+    the node that just caught up from it."""
+    import time
+
+    nodes = run_cluster(tmp_path, 2)
+    newcomer = ClusterNode(tmp_path, "na")
+    newcomer.start(None, 1)
+    try:
+        base = nodes[0].uri
+        req(base, "POST", "/index/ki", {"options": {"keys": True}})
+        req(base, "POST", "/index/ki/field/f", {"options": {}})
+        req(base, "POST", "/index/ki/query", b"Set('alice', f=1)")
+
+        # Join with an id that sorts before every http:// URI.
+        newcomer.attach_cluster([nodes[0].uri, nodes[1].uri], 1,
+                                node_id="aaa-first")
+        req(base, "POST", "/internal/join",
+            {"id": "aaa-first", "uri": newcomer.uri})
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if req(base, "GET", "/status")["state"] == "NORMAL":
+                break
+            time.sleep(0.1)
+        st = req(base, "GET", "/status")
+        # Primary stayed a pre-join member.
+        assert st.get("translatePrimary") != "aaa-first"
+        assert st.get("translatePrimary") in (nodes[0].uri, nodes[1].uri)
+        # New key allocation still goes through the original primary:
+        # 'bob' must get a FRESH id, not collide with 'alice'.
+        req(nodes[1].uri, "POST", "/index/ki/query", b"Set('bob', f=1)")
+        r = req(base, "POST", "/index/ki/query", b"Row(f=1)")
+        assert sorted(r["results"][0]["keys"]) == ["alice", "bob"]
+
+        # Remove the primary: the remover catches up and promotes itself.
+        primary = st["translatePrimary"]
+        via = nodes[0].uri if primary != nodes[0].uri else nodes[1].uri
+        st2 = req(via, "POST", "/cluster/resize/remove-node",
+                  {"id": primary})
+        assert st2.get("translatePrimary") == via
+        req(via, "POST", "/index/ki/query", b"Set('carol', f=1)")
+        r = req(via, "POST", "/index/ki/query", b"Row(f=1)")
+        assert sorted(r["results"][0]["keys"]) == ["alice", "bob", "carol"]
+    finally:
+        newcomer.stop()
+        for nd in nodes:
+            try:
+                nd.stop()
+            except Exception:
+                pass
