@@ -124,6 +124,8 @@ def cmd_server(args) -> int:
             translate_repl.stop()
         if anti_entropy is not None:
             anti_entropy.stop()
+        if hasattr(stats, "flush"):
+            stats.flush()  # drain buffered statsd datagrams
         diagnostics.stop()
         if runtime_monitor is not None:
             runtime_monitor.stop()

@@ -28,6 +28,7 @@ class Holder:
 
     def open(self) -> None:
         os.makedirs(self.path, exist_ok=True)
+        self._raise_file_limit()
         self.node_id = self._load_node_id()
         for name in sorted(os.listdir(self.path)):
             ipath = os.path.join(self.path, name)
@@ -37,6 +38,22 @@ class Holder:
             idx.open()
             idx.on_new_shard = self._notify_shard
             self.indexes[name] = idx
+
+    @staticmethod
+    def _raise_file_limit() -> None:
+        """Raise RLIMIT_NOFILE to its hard limit (reference
+        holder.go:532): every open fragment keeps an op-log append
+        handle, and a 1024-shard index breaches the common 1024-fd
+        default immediately."""
+        try:
+            import resource
+            soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+            # RLIM_INFINITY is -1: a signed soft < hard comparison would
+            # skip the raise exactly when the hard limit is unlimited.
+            if hard == resource.RLIM_INFINITY or soft < hard:
+                resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+        except (ImportError, ValueError, OSError):
+            pass  # best effort; not available on all platforms
 
     def close(self) -> None:
         with self._lock:

@@ -329,6 +329,8 @@ class Cluster:
                              for n in self.nodes()]}
             if self.prev_nodes is not None:
                 out["prevNodes"] = [n.to_json() for n in self.prev_nodes]
-            if self.translate_primary_id is not None:
-                out["translatePrimary"] = self.translate_primary_id
+            # Always report the EFFECTIVE allocator (falls back to the
+            # lexically-first member before any explicit pin) so an
+            # operator can identify it on a static cluster too.
+            out["translatePrimary"] = self.translate_primary().id
             return out
