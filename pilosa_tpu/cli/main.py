@@ -124,12 +124,14 @@ def cmd_server(args) -> int:
             translate_repl.stop()
         if anti_entropy is not None:
             anti_entropy.stop()
-        if hasattr(stats, "flush"):
-            stats.flush()  # drain buffered statsd datagrams
         diagnostics.stop()
         if runtime_monitor is not None:
             runtime_monitor.stop()
         holder.close()
+        if hasattr(stats, "flush"):
+            # Drain buffered statsd datagrams last, after every
+            # stats-producing loop above has stopped.
+            stats.flush()
     return 0
 
 

@@ -51,7 +51,17 @@ class Holder:
             # RLIM_INFINITY is -1: a signed soft < hard comparison would
             # skip the raise exactly when the hard limit is unlimited.
             if hard == resource.RLIM_INFINITY or soft < hard:
-                resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+                try:
+                    resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+                except (ValueError, OSError):
+                    # Some kernels refuse an infinite soft limit (macOS
+                    # caps at kern.maxfilesperproc); fall back to a large
+                    # finite value below the refusal point.
+                    finite = 10240 if hard == resource.RLIM_INFINITY \
+                        else min(hard, 10240)
+                    if finite > soft:
+                        resource.setrlimit(resource.RLIMIT_NOFILE,
+                                           (finite, hard))
         except (ImportError, ValueError, OSError):
             pass  # best effort; not available on all platforms
 

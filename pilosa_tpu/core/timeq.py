@@ -127,6 +127,45 @@ def views_by_time_range(name: str, start: datetime, end: datetime,
     return results
 
 
+def view_time_part(v: str) -> str:
+    """Time suffix of a view name, e.g. "standard_201901" -> "201901"
+    (reference viewTimePart, time.go:330)."""
+    return v.rsplit("_", 1)[1] if "_" in v else ""
+
+
+def min_max_views(views: List[str], quantum: str) -> tuple:
+    """(min, max) views among `views` at the quantum's coarsest unit
+    (reference minMaxViews, time.go:240 — "chars" picks the first unit of
+    YMDH present in the quantum; views sort chronologically because the
+    time suffix is zero-padded)."""
+    chars = 0
+    for unit, n in (("Y", 4), ("M", 6), ("D", 8), ("H", 10)):
+        if unit in quantum:
+            chars = n
+            break
+    lo = hi = ""
+    for v in sorted(views):
+        if len(view_time_part(v)) == chars:
+            if not lo:
+                lo = v
+            hi = v
+    return lo, hi
+
+
+def time_of_view(v: str, adj: bool) -> datetime:
+    """Start time of a view name; with adj=True the exclusive end
+    (reference timeOfView, time.go:279)."""
+    part = view_time_part(v)
+    fmt = {4: "%Y", 6: "%Y%m", 8: "%Y%m%d", 10: "%Y%m%d%H"}.get(len(part))
+    if fmt is None:
+        raise ValueError(f"invalid time format on view: {v}")
+    t = datetime.strptime(part, fmt)
+    if adj:
+        t = {4: _next_year, 6: _add_month, 8: _next_day,
+             10: _next_hour}[len(part)](t)
+    return t
+
+
 def parse_timestamp(s: str) -> datetime:
     """PQL timestamp formats (reference pql.peg timestampfmt)."""
     for fmt in ("%Y-%m-%dT%H:%M", "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d %H:%M",

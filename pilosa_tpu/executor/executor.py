@@ -372,6 +372,27 @@ class Executor:
                     raise ExecutionError(
                         f"index {idx.name} does not use column keys")
                 call.args["column"] = self._resolve_col_key(idx, column)
+        # GroupBy(previous=[...]): one entry per Rows child, translated
+        # against that child's field (reference translateGroupByCall,
+        # executor.go:2522-2577).
+        if call.name == "GroupBy":
+            prev = call.args.get("previous")
+            if prev is not None:
+                if not isinstance(prev, list):
+                    raise ExecutionError(
+                        "'previous' argument must be a list")
+                if len(prev) != len(call.children):
+                    raise ExecutionError(
+                        f"mismatched lengths for previous: {len(prev)} "
+                        f"and children: {len(call.children)}")
+                for i, (p, child) in enumerate(zip(prev, call.children)):
+                    if isinstance(p, str):
+                        field = idx.field(child.args.get("_field"))
+                        if field is None or not field.options.keys:
+                            raise ExecutionError(
+                                "prev value must be a row id (int) when "
+                                "field doesn't have keys")
+                        prev[i] = self._resolve_row_key(idx, field, p)
         filt = call.args.get("filter")
         if isinstance(filt, Call):
             self._translate_call(idx, filt)
@@ -1072,8 +1093,10 @@ class Executor:
 
     def _execute_rows(self, idx: Index, call: Call, shards
                       ) -> RowIdentifiers:
-        """Row-id enumeration with previous/limit/column filters (reference
-        executeRowsShard, executor.go:1143)."""
+        """Row-id enumeration with previous/limit/column filters and, for
+        time fields, a from/to view-range filter (reference
+        executeRowsShard, executor.go:1143; time-view selection
+        executor.go:1160-1218)."""
         field_name = call.arg("_field")
         field = idx.field(field_name)
         if field is None:
@@ -1082,21 +1105,51 @@ class Executor:
         previous = call.arg("previous")
         limit = call.uint_arg("limit")
         column = call.arg("column")
+        frm, to = call.arg("from"), call.arg("to")
+        if (frm is not None or to is not None) and \
+                field.options.type != FIELD_TYPE_TIME:
+            raise ExecutionError(f"from/to on non-time field {field_name}")
 
-        view = field.view(VIEW_STANDARD)
+        view_names = [VIEW_STANDARD]
+        if field.options.type == FIELD_TYPE_TIME and (
+                frm is not None or to is not None
+                or field.options.no_standard_view):
+            # Clamp the requested range to the min/max existing time
+            # views, then take the minimal view cover — exactly the
+            # reference's shape (minMaxViews + viewsByTimeRange).
+            q = field.options.time_quantum
+            if not q:
+                return RowIdentifiers([])
+            vmin, vmax = timeq.min_max_views(list(field.views), q)
+            if not vmin or not vmax:
+                return RowIdentifiers([])
+            start = timeq.parse_timestamp(frm) if frm else None
+            end = timeq.parse_timestamp(to) if to else None
+            min_t = timeq.time_of_view(vmin, False)
+            max_t = timeq.time_of_view(vmax, True)
+            if start is None or start < min_t:
+                start = min_t
+            if end is None or end > max_t:
+                end = max_t
+            view_names = field.views_for_range(start, end)
+
         rows: set = set()
-        for shard in shards:
-            frag = view.fragment(shard) if view else None
-            if frag is None:
+        for vname in view_names:
+            view = field.view(vname)
+            if view is None:
                 continue
-            if column is not None:
-                if column // SHARD_WIDTH != shard:
+            for shard in shards:
+                frag = view.fragment(shard)
+                if frag is None:
                     continue
-                for r in frag.row_ids():
-                    if frag.bit(r, column):
-                        rows.add(r)
-            else:
-                rows.update(frag.row_ids())
+                if column is not None:
+                    if column // SHARD_WIDTH != shard:
+                        continue
+                    for r in frag.row_ids():
+                        if frag.bit(r, column):
+                            rows.add(r)
+                else:
+                    rows.update(frag.row_ids())
         out = sorted(rows)
         if previous is not None:
             out = [r for r in out if r > previous]
@@ -1130,6 +1183,14 @@ class Executor:
             raise ExecutionError("GroupBy requires Rows() arguments")
         shards = self._shards(idx, shards)
         limit = call.uint_arg("limit") or 0
+        previous = call.arg("previous")
+        if previous is not None:
+            if not isinstance(previous, list) or \
+                    len(previous) != len(call.children):
+                raise ExecutionError(
+                    "'previous' must be a list with one entry per Rows "
+                    "child")
+            previous = tuple(int(p) for p in previous)
         filter_call = call.arg("filter")
         filter_words = None
         if isinstance(filter_call, Call):
@@ -1247,12 +1308,22 @@ class Executor:
                 chunk_counts = counts[c0:c0 + chunk_p]
             for pi in range(chunk_counts.shape[0]):
                 row_pre = prefix_rows[c0 + pi]
+                # Paging: results are lexicographic by row-id tuple, so a
+                # prefix strictly below previous's prefix can't produce
+                # anything after `previous` (reference groupByIterator
+                # seek, executor.go:2878-2900).
+                if previous is not None and \
+                        row_pre < previous[:len(row_pre)]:
+                    continue
                 crow = chunk_counts[pi]
                 for ri in np.nonzero(crow)[0]:
                     if limit and len(results) >= limit:
                         break
+                    tup = row_pre + (int(ids[ri]),)
+                    if previous is not None and tup <= previous:
+                        continue
                     group = [FieldRow(f, rid) for f, rid in
-                             zip(fields, row_pre + (int(ids[ri]),))]
+                             zip(fields, tup)]
                     results.append(GroupCount(group, int(crow[ri])))
         return results
 

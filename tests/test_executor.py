@@ -155,6 +155,45 @@ def test_group_by(ex):
     assert len(res) == 1 and res[0].count == 1
 
 
+def test_group_by_previous_paging(ex):
+    """GroupBy(previous=[...]) resumes after the named group in
+    lexicographic order (reference translateGroupByCall executor.go:2522
+    + groupByIterator seek :2878)."""
+    e, h = ex
+    idx = h.create_index("gp")
+    rng = np.random.RandomState(3)
+    for fname, nrows in (("a", 3), ("b", 4)):
+        f = idx.create_field(fname)
+        rows_l, cols_l = [], []
+        for r in range(nrows):
+            cols = rng.choice(200, size=40, replace=False)
+            rows_l.extend([r] * len(cols))
+            cols_l.extend(cols.tolist())
+        f.import_bits(np.array(rows_l, np.uint64),
+                      np.array(cols_l, np.uint64))
+    (full,) = e.execute("gp", "GroupBy(Rows(a), Rows(b))")
+    tuples = [tuple(fr.row_id for fr in gc.group) for gc in full]
+    assert tuples == sorted(tuples)
+    for k in (0, 1, len(full) - 2):
+        prev = tuples[k]
+        (res,) = e.execute(
+            "gp", f"GroupBy(Rows(a), Rows(b), previous={list(prev)})")
+        got = [(tuple(fr.row_id for fr in gc.group), gc.count)
+               for gc in res]
+        want = [(tuple(fr.row_id for fr in gc.group), gc.count)
+                for gc in full[k + 1:]]
+        assert got == want
+    # limit counts post-skip groups
+    (res,) = e.execute(
+        "gp", f"GroupBy(Rows(a), Rows(b), previous={list(tuples[0])}, "
+              "limit=2)")
+    assert len(res) == 2
+    assert tuple(fr.row_id for fr in res[0].group) == tuples[1]
+    # mismatched length errors
+    with pytest.raises(Exception, match="previous"):
+        e.execute("gp", "GroupBy(Rows(a), Rows(b), previous=[1])")
+
+
 def test_group_by_deep_matches_bruteforce(ex):
     """3-field GroupBy over multiple shards, checked against a host-side
     brute force — exercises the level-synchronous batched expansion
@@ -319,6 +358,79 @@ def test_time_range_query(ex):
     np.testing.assert_array_equal(res.columns(), [1, 2])
     (res,) = e.execute("i", "Row(t=7)")  # standard view: everything
     np.testing.assert_array_equal(res.columns(), [1, 2, 3])
+
+
+def test_rows_time_filter(ex):
+    """Rows(f, from=, to=) on a noStandardView time field (reference
+    TestExecutor_Execute_RowsTime, executor_test.go)."""
+    e, h = ex
+    idx = h.create_index("i")
+    idx.create_field("f", FieldOptions(type="time", time_quantum="YMD",
+                                       no_standard_view=True))
+    e.execute("i", "Set(9, f=1, 2001-01-01T00:00)")
+    e.execute("i", "Set(9, f=2, 2002-01-01T00:00)")
+    e.execute("i", "Set(9, f=3, 2003-01-01T00:00)")
+    e.execute("i", "Set(9, f=4, 2004-01-01T00:00)")
+    e.execute("i", f"Set({SHARD_WIDTH + 9}, f=13, 2003-02-02T00:00)")
+    cases = [
+        ("Rows(f, from=1999-12-31T00:00, to=2002-01-01T03:00)", [1]),
+        ("Rows(f, from=2002-01-01T00:00, to=2004-01-01T00:00)", [2, 3, 13]),
+        ("Rows(f, from=1990-01-01T00:00, to=1999-01-01T00:00)", []),
+        ("Rows(f)", [1, 2, 3, 4, 13]),
+        ("Rows(f, from=2002-01-01T00:00)", [2, 3, 4, 13]),
+        ("Rows(f, to=2003-02-03T00:00)", [1, 2, 3, 13]),
+    ]
+    for pql, want in cases:
+        (res,) = e.execute("i", pql)
+        assert list(res.rows) == want, pql
+
+
+def test_rows_time_empty(ex):
+    """No data: a ranged Rows returns empty, not an error (reference
+    TestExecutor_Execute_RowsTimeEmpty)."""
+    e, h = ex
+    idx = h.create_index("i")
+    idx.create_field("x", FieldOptions(type="time", time_quantum="YMD",
+                                       no_standard_view=True))
+    (res,) = e.execute(
+        "i", "Rows(x, from=1999-12-31T00:00, to=2002-01-01T03:00)")
+    assert list(res.rows) == []
+
+
+@pytest.mark.parametrize("quantum,expected", [
+    ("Y", [3, 4, 5, 6]), ("M", [3, 4, 5, 6]), ("D", [3, 4, 5, 6]),
+    ("H", [3, 4, 5, 6, 7]), ("YM", [3, 4, 5, 6]), ("YMD", [3, 4, 5, 6]),
+    ("YMDH", [3, 4, 5, 6, 7]), ("MD", [3, 4, 5, 6]),
+    ("MDH", [3, 4, 5, 6, 7]), ("DH", [3, 4, 5, 6, 7]),
+])
+def test_time_clear_quantums(ex, quantum, expected):
+    """Clear removes the column from every quantum view (reference
+    TestExecutor_Time_Clear_Quantums, executor_test.go)."""
+    e, h = ex
+    idx = h.create_index("i")
+    idx.create_field("f", FieldOptions(type="time", time_quantum=quantum))
+    e.execute("i", """
+        Set(2, f=1, 1999-12-31T00:00)
+        Set(3, f=1, 2000-01-01T00:00)
+        Set(4, f=1, 2000-01-02T00:00)
+        Set(5, f=1, 2000-02-01T00:00)
+        Set(6, f=1, 2001-01-01T00:00)
+        Set(7, f=1, 2002-01-01T02:00)
+        Set(2, f=1, 1999-12-30T00:00)
+        Set(2, f=1, 2002-02-01T00:00)
+        Set(2, f=10, 2001-01-01T00:00)
+    """)
+    e.execute("i", "Clear(2, f=1)")
+    (res,) = e.execute(
+        "i", "Row(f=1, from=1999-12-31T00:00, to=2002-01-01T03:00)")
+    assert list(res.columns()) == expected
+
+
+def test_rows_from_to_on_non_time_field_errors(ex):
+    e, h = ex
+    setup_basic(h)
+    with pytest.raises(Exception, match="non-time"):
+        e.execute("i", "Rows(f, from=2001-01-01T00:00)")
 
 
 def test_count_across_shards(ex):
