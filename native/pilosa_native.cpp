@@ -58,6 +58,7 @@ struct LoadedBitmap {
   std::vector<uint64_t> keys;
   std::vector<uint64_t> words;  // keys.size() * kContainerWords
   uint64_t op_n = 0;
+  uint64_t tail_dropped = 0;  // torn-tail bytes discarded on replay
   char err[128] = {0};
 
   int find(uint64_t key) const {
@@ -172,7 +173,13 @@ inline void bit_remove(LoadedBitmap* bm, uint64_t pos) {
 
 bool replay_ops(LoadedBitmap* bm, const uint8_t* data, size_t len, size_t pos) {
   while (pos < len) {
-    if (len - pos < 13) return fail(bm, "op data out of bounds");
+    // A record extending past EOF is a torn tail append (crash mid-write):
+    // discard it and report how many bytes were dropped so the caller can
+    // truncate the file. A checksum mismatch on a COMPLETE record is data
+    // corruption and still fails hard (the reference fails on both,
+    // op.UnmarshalBinary roaring.go:3659 — tolerating the torn tail is a
+    // deliberate durability improvement).
+    if (len - pos < 13) { bm->tail_dropped = len - pos; return true; }
     uint8_t typ = data[pos];
     uint64_t value = ru64(data + pos + 1);
     uint32_t chk = ru32(data + pos + 9);
@@ -183,7 +190,7 @@ bool replay_ops(LoadedBitmap* bm, const uint8_t* data, size_t len, size_t pos) {
       pos += 13;
     } else if (typ == kOpAddBatch || typ == kOpRemoveBatch) {
       // Guard 8*value overflow before computing the record size.
-      if (value > (len - pos - 13) / 8) return fail(bm, "op data truncated");
+      if (value > (len - pos - 13) / 8) { bm->tail_dropped = len - pos; return true; }
       size_t size = 13 + 8ull * value;
       uint32_t h = fnv1a32(data + pos, 9);
       h = fnv1a32(data + pos + 13, 8ull * value, h);
@@ -248,6 +255,7 @@ void* rb_load(const uint8_t* data, uint64_t len) {
 const char* rb_error(void* h) { return static_cast<LoadedBitmap*>(h)->err; }
 uint64_t rb_container_count(void* h) { return static_cast<LoadedBitmap*>(h)->keys.size(); }
 uint64_t rb_op_count(void* h) { return static_cast<LoadedBitmap*>(h)->op_n; }
+uint64_t rb_tail_dropped(void* h) { return static_cast<LoadedBitmap*>(h)->tail_dropped; }
 
 // Copy out the sorted container keys (caller allocates rb_container_count
 // u64s) and the dense payload (count * 1024 u64s, key-major).
@@ -420,6 +428,40 @@ void pn_scatter_rows(const uint16_t* pos, const uint64_t* lens,
     }
     off += lens[r];
   }
+}
+
+// Set-bit position extraction over independently-allocated dense
+// containers: chunks[i] points at one container's words; position =
+// bases[i] + bit-index-in-chunk. Replaces the per-container
+// unpackbits+nonzero loop on the slice()/anti-entropy checksum path.
+// Callers size `out` with pn_popcount_ptrs over the same chunks.
+uint64_t pn_popcount_ptrs(const uint64_t* const* chunks, uint64_t n_chunks,
+                          uint64_t words_per_chunk) {
+  uint64_t cnt = 0;
+  for (uint64_t c = 0; c < n_chunks; c++)
+    for (uint64_t w = 0; w < words_per_chunk; w++)
+      cnt += popcount64(chunks[c][w]);
+  return cnt;
+}
+
+uint64_t pn_dense_positions_ptrs(const uint64_t* const* chunks,
+                                 uint64_t n_chunks,
+                                 uint64_t words_per_chunk,
+                                 const uint64_t* bases, uint64_t* out) {
+  uint64_t cnt = 0;
+  for (uint64_t c = 0; c < n_chunks; c++) {
+    const uint64_t* chunk = chunks[c];
+    uint64_t base = bases[c];
+    for (uint64_t w = 0; w < words_per_chunk; w++) {
+      uint64_t x = chunk[w];
+      uint64_t b = base + (w << 6);
+      while (x) {
+        out[cnt++] = b + (uint64_t)__builtin_ctzll(x);
+        x &= x - 1;
+      }
+    }
+  }
+  return cnt;
 }
 
 }  // extern "C"

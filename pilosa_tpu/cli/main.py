@@ -203,9 +203,15 @@ def cmd_check(args) -> int:
     for path in args.files:
         try:
             with open(path, "rb") as f:
-                b = Bitmap.from_bytes(f.read())
-            print(f"{path}: ok ({b.count()} bits, "
-                  f"{len(b.containers)} containers, opN={b.op_n})")
+                b = Bitmap.from_bytes(f.read(), tolerate_torn_tail=True)
+            if b.tail_dropped:
+                bad += 1
+                print(f"{path}: TORN TAIL: last op record truncated "
+                      f"({b.tail_dropped} bytes; server open would "
+                      f"sidecar+truncate)", file=sys.stderr)
+            else:
+                print(f"{path}: ok ({b.count()} bits, "
+                      f"{len(b.containers)} containers, opN={b.op_n})")
         except Exception as e:
             bad += 1
             print(f"{path}: CORRUPT: {e}", file=sys.stderr)
@@ -219,7 +225,7 @@ def cmd_inspect(args) -> int:
 
     for path in args.files:
         with open(path, "rb") as f:
-            b = Bitmap.from_bytes(f.read())
+            b = Bitmap.from_bytes(f.read(), tolerate_torn_tail=True)
         rows = {}
         for key in sorted(b.containers):
             row = (key << 16) // SHARD_WIDTH

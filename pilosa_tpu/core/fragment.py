@@ -31,6 +31,7 @@ from pilosa_tpu.ops.bitset import (
 )
 from pilosa_tpu.storage.roaring import Bitmap, CONTAINER_BITS
 from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.utils.logger import default_logger
 
 # Snapshot after this many logged ops (reference MaxOpN, fragment.go:79).
 DEFAULT_MAX_OP_N = 10000
@@ -77,7 +78,24 @@ class Fragment:
                 with open(self.path, "rb") as f:
                     data = f.read()
                 if data:
-                    self.storage.read_bytes(data)
+                    self.storage.read_bytes(data, tolerate_torn_tail=True)
+                    if self.storage.tail_dropped:
+                        # Torn tail append from a crash: move the partial
+                        # record to a .torn sidecar (never destroy bytes —
+                        # a corrupted batch-length field is classified the
+                        # same way and the tail may hold valid ops an
+                        # operator can salvage), then truncate so new
+                        # appends start at a clean boundary. Divergence:
+                        # the reference refuses to open on any op error
+                        # (op.UnmarshalBinary roaring.go:3659).
+                        nd = self.storage.tail_dropped
+                        default_logger.printf(
+                            "%s: moving %d-byte torn op-log tail to "
+                            "sidecar", self.path, nd)
+                        with open(self.path + ".torn", "ab") as f:
+                            f.write(data[len(data) - nd:])
+                        with open(self.path, "r+b") as f:
+                            f.truncate(len(data) - nd)
             else:
                 os.makedirs(os.path.dirname(self.path), exist_ok=True)
                 with open(self.path, "wb") as f:
@@ -633,17 +651,23 @@ class Fragment:
         """Per-block digests over 100-row blocks (reference Blocks,
         fragment.go:1275). Hash input is the sorted absolute positions in
         the block, so equal bit-sets hash equal regardless of encoding."""
+        # One whole-bitmap extraction + searchsorted split beats a
+        # per-block range scan: for_each_range would touch the container
+        # dict once per 100-row block (O(blocks x containers)).
+        pos = self.storage.slice()
+        if not len(pos):
+            return []
+        span = np.uint64(HASH_BLOCK_SIZE * SHARD_WIDTH)
+        blk_of = pos // span
+        # slice() output is sorted, so block segments are contiguous:
+        # O(n) boundary scan, no sort.
+        cuts = np.nonzero(np.diff(blk_of))[0] + 1
+        bounds = np.concatenate(([0], cuts, [len(pos)]))
         out = []
-        rows = self.row_ids()
-        blocks = sorted({r // HASH_BLOCK_SIZE for r in rows})
-        for blk in blocks:
-            lo = blk * HASH_BLOCK_SIZE * SHARD_WIDTH
-            hi = (blk + 1) * HASH_BLOCK_SIZE * SHARD_WIDTH
-            pos = self.storage.for_each_range(lo, hi)
-            if not len(pos):
-                continue
-            h = hashlib.blake2b(pos.astype("<u8").tobytes(), digest_size=16)
-            out.append((blk, h.digest()))
+        for i in range(len(bounds) - 1):
+            seg = pos[bounds[i]:bounds[i + 1]]
+            h = hashlib.blake2b(seg.astype("<u8").tobytes(), digest_size=16)
+            out.append((int(blk_of[bounds[i]]), h.digest()))
         return out
 
     def block_data(self, block: int) -> Tuple[np.ndarray, np.ndarray]:

@@ -56,6 +56,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rb_container_count.restype = u64
     lib.rb_op_count.argtypes = [ctypes.c_void_p]
     lib.rb_op_count.restype = u64
+    lib.rb_tail_dropped.argtypes = [ctypes.c_void_p]
+    lib.rb_tail_dropped.restype = u64
     lib.rb_copy_out.argtypes = [ctypes.c_void_p, p_u64, p_u64]
     lib.rb_free.argtypes = [ctypes.c_void_p]
     lib.rb_serialize_cap.argtypes = [u64]
@@ -73,6 +75,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pn_build_masks.argtypes = [p_u64, u64, u64, p_u64, p_u64]
     lib.pn_build_masks.restype = u64
     lib.pn_scatter_rows.argtypes = [p_u16, p_u64, u64, p_u64, u64, p_u64]
+    # The chunk-pointer arrays ride as uint64 address arrays (same ABI as
+    # const uint64_t* const* and ~100x cheaper than building per-element
+    # ctypes pointer objects).
+    lib.pn_popcount_ptrs.argtypes = [p_u64, u64, u64]
+    lib.pn_popcount_ptrs.restype = u64
+    lib.pn_dense_positions_ptrs.argtypes = [p_u64, u64, u64, p_u64, p_u64]
+    lib.pn_dense_positions_ptrs.restype = u64
     return lib
 
 
@@ -115,12 +124,15 @@ class NativeParseError(ValueError):
     pass
 
 
-def roaring_load(data: bytes) -> Optional[Tuple[List[int], np.ndarray, int]]:
+def roaring_load(data: bytes
+                 ) -> Optional[Tuple[List[int], np.ndarray, int, int]]:
     """Parse a roaring file (snapshot + ops log) natively.
 
-    Returns (sorted container keys, dense words [n, 1024] uint64, op count),
-    or None when the native library is unavailable. Raises NativeParseError
-    on malformed input (same conditions as the Python reader)."""
+    Returns (sorted container keys, dense words [n, 1024] uint64, op count,
+    torn-tail bytes dropped), or None when the native library is
+    unavailable. Raises NativeParseError on malformed input (same
+    conditions as the Python reader; a truncated FINAL op is tolerated
+    and reported via the last tuple element instead)."""
     lib = load()
     if lib is None:
         return None
@@ -137,7 +149,8 @@ def roaring_load(data: bytes) -> Optional[Tuple[List[int], np.ndarray, int]]:
         words = np.empty((n, CONTAINER_WORDS), dtype=np.uint64)
         if n:
             lib.rb_copy_out(h, _as_u64_ptr(keys), _as_u64_ptr(words))
-        return [int(k) for k in keys], words, int(lib.rb_op_count(h))
+        return ([int(k) for k in keys], words, int(lib.rb_op_count(h)),
+                int(lib.rb_tail_dropped(h)))
     finally:
         lib.rb_free(h)
 
@@ -223,6 +236,33 @@ def build_masks(positions: np.ndarray, m: int):
     if got != m:
         raise ValueError(f"pn_build_masks: {got} groups, expected {m}")
     return keys, words
+
+
+def dense_positions_of(containers, bases: np.ndarray
+                       ) -> Optional[np.ndarray]:
+    """Like dense_positions but over a list of independently-allocated
+    dense containers (uint64, C-contiguous, equal length) — avoids
+    stacking them into one copy. None when unavailable."""
+    lib = load()
+    if lib is None or not containers:
+        return None if lib is None else np.empty(0, dtype=np.uint64)
+    wpc = containers[0].size
+    # __array_interface__ hands back the raw address without building a
+    # ctypes pointer object per container (the hot-loop cost at ~10k
+    # containers per call).
+    addrs = np.fromiter(
+        (c.__array_interface__["data"][0] for c in containers),
+        dtype=np.uint64, count=len(containers))
+    ptrs = _as_u64_ptr(addrs)
+    bases = np.ascontiguousarray(bases, dtype=np.uint64)
+    n = int(lib.pn_popcount_ptrs(ptrs, len(containers), wpc))
+    out = np.empty(n, dtype=np.uint64)
+    got = lib.pn_dense_positions_ptrs(ptrs, len(containers), wpc,
+                                      _as_u64_ptr(bases), _as_u64_ptr(out))
+    if got != n:
+        raise ValueError(f"pn_dense_positions_ptrs wrote {got}, "
+                         f"expected {n}")
+    return out
 
 
 def scatter_rows(pos: np.ndarray, lens: np.ndarray, row_index: np.ndarray,

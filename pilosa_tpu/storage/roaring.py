@@ -165,13 +165,15 @@ class Bitmap:
     serialization, and the append-only ops log (OpWriter, roaring.go:1128).
     """
 
-    __slots__ = ("containers", "_counts", "op_writer", "op_n")
+    __slots__ = ("containers", "_counts", "op_writer", "op_n",
+                 "tail_dropped")
 
     def __init__(self, positions: Optional[Iterable[int]] = None):
         self.containers: Dict[int, np.ndarray] = {}
         self._counts: Dict[int, int] = {}
         self.op_writer: Optional[io.RawIOBase] = None
         self.op_n = 0
+        self.tail_dropped = 0  # torn-tail bytes discarded by read_bytes
         if positions is not None:
             self.direct_add_n(np.asarray(list(positions), dtype=np.uint64))
 
@@ -413,12 +415,37 @@ class Bitmap:
         return (key << 16) | int(arr[0])
 
     def slice(self) -> np.ndarray:
-        """All set positions, sorted (reference Slice, roaring.go:393)."""
+        """All set positions, sorted (reference Slice, roaring.go:393).
+        Runs of consecutive dense containers extract through one native
+        ctz sweep (pn_dense_positions_ptrs) instead of per-container
+        unpackbits+nonzero — the anti-entropy checksum hot path."""
+        keys = sorted(self.containers)
         out: List[np.ndarray] = []
-        for key in sorted(self.containers):
-            arr = self._positions(self.containers[key])
-            if len(arr):
-                out.append((np.uint64(key << 16) + arr.astype(np.uint64)))
+        i = 0
+        while i < len(keys):
+            c = self.containers[keys[i]]
+            if _is_array(c):
+                if len(c):
+                    out.append(np.uint64(keys[i] << 16)
+                               + c.astype(np.uint64))
+                i += 1
+                continue
+            j = i
+            while j < len(keys) and not _is_array(self.containers[keys[j]]):
+                j += 1
+            run = keys[i:j]
+            pos = native.dense_positions_of(
+                [self.containers[k] for k in run],
+                np.array(run, np.uint64) << np.uint64(16))
+            if pos is None:  # numpy fallback
+                for k in run:
+                    arr = _dense_to_array(self.containers[k])
+                    if len(arr):
+                        out.append(np.uint64(k << 16)
+                                   + arr.astype(np.uint64))
+            elif len(pos):
+                out.append(pos)
+            i = j
         if not out:
             return np.empty(0, dtype=np.uint64)
         return np.concatenate(out)
@@ -500,8 +527,17 @@ class Bitmap:
                 self._invalidate(key)
 
     def for_each_range(self, start: int, end: int):
-        s = self.slice()
-        return s[(s >= start) & (s < end)]
+        # Touch only containers intersecting [start, end): block-scoped
+        # callers (checksum_blocks walks 100-row blocks) must not pay a
+        # whole-bitmap extraction per block.
+        k0, k1 = start >> 16, (end - 1) >> 16
+        sub = Bitmap()
+        sub.containers = {k: c for k, c in self.containers.items()
+                          if k0 <= k <= k1}
+        s = sub.slice()
+        if len(s) and (start & 0xFFFF or end & 0xFFFF):
+            s = s[(s >= start) & (s < end)]
+        return s
 
     # -- set algebra (host path / CPU baseline) -----------------------------
 
@@ -657,22 +693,34 @@ class Bitmap:
         return header.getvalue() + b"".join(payloads)
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "Bitmap":
+    def from_bytes(cls, data: bytes,
+                   tolerate_torn_tail: bool = False) -> "Bitmap":
         """Deserialize (reference unmarshalPilosaRoaring, roaring.go:1037),
         including ops-log replay from the file tail."""
         b = cls()
-        b.read_bytes(data)
+        b.read_bytes(data, tolerate_torn_tail=tolerate_torn_tail)
         return b
 
-    def read_bytes(self, data: bytes) -> None:
+    def read_bytes(self, data: bytes,
+                   tolerate_torn_tail: bool = False) -> None:
+        """Deserialize. tolerate_torn_tail=True (Fragment.open recovering
+        its OWN file after a crash) drops a final op record torn at EOF
+        and reports it via self.tail_dropped; the default keeps fail-hard
+        semantics for wire-received bytes (a truncated import payload
+        must error, not silently half-apply)."""
+        self.tail_dropped = 0
         if native.available():
             loaded = native.roaring_load(bytes(data))
             if loaded is not None:
-                keys, words, op_n = loaded
+                keys, words, op_n, tail_dropped = loaded
+                if tail_dropped and not tolerate_torn_tail:
+                    raise OpTruncatedError(
+                        f"op data truncated ({tail_dropped} tail bytes)")
                 self.containers = {k: words[i].copy()
                                    for i, k in enumerate(keys)}
                 self._counts = {}
                 self.op_n = op_n
+                self.tail_dropped = tail_dropped
                 return
         if len(data) < HEADER_BASE_SIZE:
             raise ValueError("data too small")
@@ -726,11 +774,21 @@ class Bitmap:
                 # present container has at least one bit).
                 del self.containers[key]
             ops_offset = max(ops_offset, end)
-        # Ops log replay.
+        # Ops log replay. A record extending past EOF is a torn tail
+        # append (crash mid-write): tolerated, dropped, and reported via
+        # tail_dropped so the owner can truncate the file. Checksum
+        # mismatches on complete records still raise (data corruption;
+        # reference fails on both, op.UnmarshalBinary roaring.go:3659).
         self.op_n = 0
         buf = memoryview(data)[ops_offset:]
         while len(buf):
-            op_typ, value, values, size = decode_op(buf)
+            try:
+                op_typ, value, values, size = decode_op(buf)
+            except OpTruncatedError:
+                if not tolerate_torn_tail:
+                    raise
+                self.tail_dropped = len(buf)
+                break
             if op_typ == OP_ADD:
                 self._direct_add(value)
                 self.op_n += 1
@@ -758,10 +816,14 @@ def encode_op(typ: int, value: int = 0, values: Optional[np.ndarray] = None) -> 
     return head + struct.pack("<I", chk) + vals
 
 
+class OpTruncatedError(ValueError):
+    """An op record extends past EOF — a torn tail append."""
+
+
 def decode_op(buf) -> Tuple[int, int, Optional[np.ndarray], int]:
     """Decode one op record; returns (type, value, values, encoded_size)."""
     if len(buf) < 13:
-        raise ValueError(f"op data out of bounds: len={len(buf)}")
+        raise OpTruncatedError(f"op data out of bounds: len={len(buf)}")
     typ, value = struct.unpack_from("<BQ", buf, 0)
     (chk,) = struct.unpack_from("<I", buf, 9)
     if typ in (OP_ADD, OP_REMOVE):
@@ -772,7 +834,7 @@ def decode_op(buf) -> Tuple[int, int, Optional[np.ndarray], int]:
         n = value
         size = 13 + 8 * n
         if len(buf) < size:
-            raise ValueError("op data truncated")
+            raise OpTruncatedError("op data truncated")
         if chk != fnv1a32(bytes(buf[0:9]), bytes(buf[13:size])):
             raise ValueError("op checksum mismatch")
         values = np.frombuffer(buf, dtype="<u8", count=n, offset=13).copy()

@@ -30,3 +30,8 @@ class NopLogger(Logger):
 
     def debugf(self, fmt, *args):
         pass
+
+
+# Module-level logger for components without an injected one (storage
+# recovery warnings); servers inject their own into API/cluster objects.
+default_logger = Logger()

@@ -18,14 +18,14 @@ pytestmark = pytest.mark.skipif(
     not native.available(), reason="native library unavailable")
 
 
-def _python_bitmap(data: bytes) -> Bitmap:
+def _python_bitmap(data: bytes, tolerate_torn_tail: bool = False) -> Bitmap:
     """Force the pure-Python reader regardless of native availability."""
     b = Bitmap.__new__(Bitmap)
     b.__init__()
     avail = native.available
     native.available = lambda: False
     try:
-        b.read_bytes(data)
+        b.read_bytes(data, tolerate_torn_tail=tolerate_torn_tail)
     finally:
         native.available = avail
     return b
@@ -48,7 +48,7 @@ def _mixed_bitmap() -> Bitmap:
 
 def test_native_parse_matches_python():
     data = _mixed_bitmap().write_bytes()
-    keys, words, op_n = native.roaring_load(data)
+    keys, words, op_n, _ = native.roaring_load(data)
     pb = _python_bitmap(data)
     assert keys == sorted(pb.containers)
     assert op_n == 0
@@ -79,7 +79,7 @@ def test_native_ops_replay():
                       values=np.array([1, 2, (21 << 16) + 3], dtype=np.uint64))
     data += encode_op(OP_REMOVE, (20 << 16) + 5)
     data += encode_op(OP_REMOVE_BATCH, values=np.array([2], dtype=np.uint64))
-    keys, words, op_n = native.roaring_load(data)
+    keys, words, op_n, _ = native.roaring_load(data)
     pb = _python_bitmap(data)
     assert op_n == 6  # 1 add + 3 batch-adds + 1 remove + 1 batch-remove
     assert keys == sorted(pb.containers)
@@ -105,7 +105,7 @@ def test_native_rejects_corrupt_op_checksum():
 
 def test_native_empty_bitmap_roundtrip():
     data = Bitmap().write_bytes()
-    keys, words, op_n = native.roaring_load(data)
+    keys, words, op_n, _ = native.roaring_load(data)
     assert keys == [] and words.shape == (0, 1024) and op_n == 0
 
 
@@ -189,3 +189,80 @@ def test_scatter_rows_bound_filtering():
     assert out[2][0] & 1 and out[2][7] >> 63
     assert not (out[2][0] >> 1) & 1  # 512 filtered (>= 8*64)
     assert out[0][0] == np.uint64(1) << 63
+
+
+def test_torn_tail_tolerated_both_codecs():
+    """A record torn at EOF (crash mid-append) is dropped, not fatal;
+    everything before it replays (divergence from the reference, which
+    refuses to open — op.UnmarshalBinary roaring.go:3659)."""
+    b = Bitmap([1, 2, 3])
+    data = b.write_bytes()
+    data += encode_op(OP_ADD, 42)
+    good_len = len(data)
+    data += encode_op(OP_ADD_BATCH,
+                      values=np.arange(10, dtype=np.uint64))[:-5]
+    # native
+    keys, words, op_n, dropped = native.roaring_load(data)
+    assert op_n == 1 and dropped == len(data) - good_len
+    # python fallback (opt-in tolerance)
+    pb = _python_bitmap(data, tolerate_torn_tail=True)
+    assert pb.op_n == 1 and pb.tail_dropped == len(data) - good_len
+    assert pb.contains(42)
+    # short torn head (< 13 bytes) also tolerated
+    data2 = b.write_bytes() + encode_op(OP_ADD, 7)[:6]
+    _, _, op_n, dropped = native.roaring_load(data2)
+    assert op_n == 0 and dropped == 6
+    pb2 = _python_bitmap(data2, tolerate_torn_tail=True)
+    assert pb2.op_n == 0 and pb2.tail_dropped == 6
+
+
+def test_torn_tail_fail_hard_by_default():
+    """Wire-received bytes (imports, Bitmap.from_bytes) keep fail-hard
+    semantics: a truncated payload errors instead of half-applying."""
+    data = Bitmap([1, 2, 3]).write_bytes() + encode_op(OP_ADD, 42)[:-5]
+    with pytest.raises(ValueError, match="truncated|out of bounds"):
+        Bitmap.from_bytes(data)          # native path
+    with pytest.raises(ValueError, match="truncated|out of bounds"):
+        _python_bitmap(data)             # python path
+
+
+def test_torn_tail_mid_log_corruption_still_fatal():
+    """A checksum mismatch on a COMPLETE record is corruption, not a torn
+    write — both codecs must still refuse it."""
+    data = Bitmap([1]).write_bytes()
+    op = bytearray(encode_op(OP_ADD, 42))
+    op[9] ^= 0xFF
+    data = data + bytes(op) + encode_op(OP_ADD, 43)
+    with pytest.raises(ValueError, match="checksum"):
+        native.roaring_load(data)
+    with pytest.raises(ValueError, match="checksum"):
+        _python_bitmap(data)
+
+
+def test_fragment_truncates_torn_tail_on_open(tmp_path):
+    """Fragment.open drops the torn bytes from the file so later appends
+    start at a clean boundary, and the fragment keeps working."""
+    import os
+    from pilosa_tpu.core.fragment import Fragment
+
+    p = str(tmp_path / "f")
+    f = Fragment(p, "i", "f", "standard", 0)
+    f.open()
+    for c in range(50):
+        f.set_bit(1, c)
+    f.close()
+    size = os.path.getsize(p)
+    with open(p, "r+b") as fh:
+        fh.truncate(size - 3)
+
+    f2 = Fragment(p, "i", "f", "standard", 0)
+    f2.open()
+    assert f2.row_count(1) == 49        # last torn Set dropped
+    assert os.path.getsize(p) == size - 3 - 10  # torn record removed
+    assert os.path.getsize(p + ".torn") == 10   # bytes preserved, not lost
+    f2.set_bit(1, 49)                   # appends work after truncation
+    f2.close()
+    f3 = Fragment(p, "i", "f", "standard", 0)
+    f3.open()
+    assert f3.row_count(1) == 50
+    f3.close()

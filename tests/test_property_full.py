@@ -1,0 +1,189 @@
+"""Extended property tests: randomized PQL over the FULL call surface —
+set rows, BSI conditions, time ranges, aggregates, TopN — checked against
+a naive host model (the analog of the reference's programmatic query
+generators, internal/test/querygenerator.go, widened past bitmap algebra)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+N_SHARDS = 2
+SET_ROWS = 4
+DENSITY = 50
+INT_MIN, INT_MAX = -120, 900
+DAYS = [f"200{y}-{m:02d}-{d:02d}"
+        for y in (1, 2) for m in (1, 6) for d in (1, 15)]
+
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("propfull")
+    h = Holder(str(tmp))
+    h.open()
+    idx = h.create_index("q")
+    rng = np.random.default_rng(41)
+    universe_n = N_SHARDS * SHARD_WIDTH
+
+    sets = {}  # (field, row) -> set(cols)
+    for fi in range(2):
+        f = idx.create_field(f"s{fi}")
+        for row in range(SET_ROWS):
+            cols = np.unique(rng.integers(0, universe_n, DENSITY,
+                                          dtype=np.uint64))
+            f.import_bits(np.full(len(cols), row, np.uint64), cols)
+            sets[(f"s{fi}", row)] = set(cols.tolist())
+
+    # int field over a random column subset
+    ints = {}  # col -> value
+    iv = idx.create_field("v", FieldOptions(type="int", min=INT_MIN,
+                                            max=INT_MAX))
+    vcols = np.unique(rng.integers(0, universe_n, 300, dtype=np.uint64))
+    vvals = rng.integers(INT_MIN, INT_MAX + 1, len(vcols), dtype=np.int64)
+    iv.import_values(vcols, vvals)
+    ints = dict(zip(vcols.tolist(), vvals.tolist()))
+
+    # time field: one row, bits stamped on day boundaries
+    times = {}  # col -> day string
+    tf = idx.create_field("t", FieldOptions(type="time", time_quantum="YMD"))
+    tcols = np.unique(rng.integers(0, universe_n, 200, dtype=np.uint64))
+    ex = Executor(h)
+    from datetime import datetime
+    tdays = rng.integers(0, len(DAYS), len(tcols))
+    rows_l, cols_l, stamps = [], [], []
+    for c, di in zip(tcols.tolist(), tdays.tolist()):
+        times[c] = DAYS[di]
+        rows_l.append(0)
+        cols_l.append(c)
+        stamps.append(datetime.strptime(DAYS[di], "%Y-%m-%d"))
+    tf.import_bits(np.array(rows_l, np.uint64), np.array(cols_l, np.uint64),
+                   timestamps=stamps)
+
+    universe = set()
+    for s in sets.values():
+        universe |= s
+    universe |= set(ints)
+    universe |= set(times)
+    idx.add_existence(np.array(sorted(universe), np.uint64))
+    yield ex, sets, ints, times, universe
+    h.close()
+
+
+def gen_leaf(rng, sets, ints, times, universe):
+    kind = rng.random()
+    if kind < 0.45:
+        fi, row = int(rng.integers(0, 2)), int(rng.integers(0, SET_ROWS))
+        return (f"Row(s{fi}={row})",
+                lambda: set(sets[(f"s{fi}", row)]))
+    if kind < 0.8:
+        op = rng.choice(["<", "<=", ">", ">=", "==", "!="])
+        val = int(rng.integers(INT_MIN - 20, INT_MAX + 20))
+        pql = f"Row(v {op} {val})"
+        import operator as _op
+        fn = {"<": _op.lt, "<=": _op.le, ">": _op.gt, ">=": _op.ge,
+              "==": _op.eq, "!=": _op.ne}[op]
+        return pql, lambda: {c for c, v in ints.items() if fn(v, val)}
+    if kind < 0.9:
+        lo = int(rng.integers(INT_MIN, INT_MAX - 10))
+        hi = lo + int(rng.integers(1, 200))
+        return (f"Row(v >< [{lo}, {hi}])",
+                lambda: {c for c, v in ints.items() if lo <= v <= hi})
+    # time-range leaf, day-aligned bounds
+    i0 = int(rng.integers(0, len(DAYS) - 1))
+    i1 = int(rng.integers(i0 + 1, len(DAYS)))
+    frm, to = DAYS[i0], DAYS[i1]
+    return (f"Row(t=0, from='{frm}T00:00', to='{to}T00:00')",
+            lambda: {c for c, d in times.items() if frm <= d < to})
+
+
+def gen_tree(rng, depth, sets, ints, times, universe):
+    if depth == 0 or rng.random() < 0.35:
+        return gen_leaf(rng, sets, ints, times, universe)
+    op = rng.choice(["Intersect", "Union", "Difference", "Xor", "Not"])
+    if op == "Not":
+        pql, fn = gen_tree(rng, depth - 1, sets, ints, times, universe)
+        return f"Not({pql})", lambda: universe - fn()
+    k = int(rng.integers(2, 4))
+    subs = [gen_tree(rng, depth - 1, sets, ints, times, universe)
+            for _ in range(k)]
+    pql = f"{op}({', '.join(s[0] for s in subs)})"
+
+    def ev():
+        vals = [s[1]() for s in subs]
+        out = vals[0]
+        for s in vals[1:]:
+            out = {"Intersect": out.__and__, "Union": out.__or__,
+                   "Difference": out.__sub__, "Xor": out.__xor__}[op](s)
+        return out
+
+    return pql, ev
+
+
+def test_full_surface_trees(world):
+    ex, sets, ints, times, universe = world
+    rng = np.random.default_rng(17)
+    for i in range(50):
+        pql, ev = gen_tree(rng, 3, sets, ints, times, universe)
+        want = ev()
+        (got,) = ex.execute("q", pql)
+        assert set(got.columns().tolist()) == want, f"iter {i}: {pql}"
+        (cnt,) = ex.execute("q", f"Count({pql})")
+        assert cnt == len(want), f"iter {i}: Count({pql})"
+
+
+def test_aggregates_with_random_filters(world):
+    ex, sets, ints, times, universe = world
+    rng = np.random.default_rng(29)
+    for i in range(25):
+        pql, ev = gen_tree(rng, 2, sets, ints, times, universe)
+        domain = {c: v for c, v in ints.items() if c in ev()}
+        (s,) = ex.execute("q", f'Sum({pql}, field="v")')
+        assert s.value == sum(domain.values()), f"iter {i}: Sum({pql})"
+        assert s.count == len(domain), f"iter {i}: Sum({pql}) count"
+        if domain:
+            (mn,) = ex.execute("q", f'Min({pql}, field="v")')
+            vmin = min(domain.values())
+            assert mn.value == vmin, f"iter {i}: Min({pql})"
+            assert mn.count == sum(1 for v in domain.values() if v == vmin)
+            (mx,) = ex.execute("q", f'Max({pql}, field="v")')
+            vmax = max(domain.values())
+            assert mx.value == vmax, f"iter {i}: Max({pql})"
+            assert mx.count == sum(1 for v in domain.values() if v == vmax)
+
+
+def test_topn_with_random_filters(world):
+    ex, sets, ints, times, universe = world
+    rng = np.random.default_rng(31)
+    for i in range(15):
+        pql, ev = gen_tree(rng, 2, sets, ints, times, universe)
+        filt = ev()
+        (res,) = ex.execute("q", f"TopN(s0, {pql}, n=4)")
+        want = sorted(
+            ((r, len(sets[("s0", r)] & filt)) for r in range(SET_ROWS)),
+            key=lambda p: (-p[1], p[0]))
+        want = [(r, n) for r, n in want if n][:4]
+        got = sorted(res.pairs, key=lambda p: (-p[1], p[0]))
+        # counts must match exactly; ties may order differently
+        assert {r: n for r, n in got} == {r: n for r, n in want}, \
+            f"iter {i}: TopN filter {pql}"
+
+
+def test_groupby_with_random_filter(world):
+    ex, sets, ints, times, universe = world
+    rng = np.random.default_rng(37)
+    for i in range(10):
+        pql, ev = gen_tree(rng, 1, sets, ints, times, universe)
+        filt = ev()
+        (res,) = ex.execute("q", f"GroupBy(Rows(s0), Rows(s1), "
+                                 f"filter={pql})")
+        got = {tuple(fr.row_id for fr in gc.group): gc.count for gc in res}
+        want = {}
+        for r0 in range(SET_ROWS):
+            for r1 in range(SET_ROWS):
+                n = len(sets[("s0", r0)] & sets[("s1", r1)] & filt)
+                if n:
+                    want[(r0, r1)] = n
+        assert got == want, f"iter {i}: filter {pql}"

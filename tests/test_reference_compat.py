@@ -64,7 +64,7 @@ def test_native_codec_agrees_with_python(ref_bytes):
         pytest.skip("native codec not built")
     out = native.roaring_load(ref_bytes)
     assert out is not None
-    keys, words, op_n = out
+    keys, words, op_n, _ = out
     assert len(keys) == 14207 and op_n == 0
     # Expand (key, dense-words) to absolute positions and compare with
     # the Python parse bit-for-bit.
