@@ -28,7 +28,14 @@ def cmd_server(args) -> int:
     cfg = load_config(args.config, {
         "data_dir": args.data_dir, "bind": args.bind,
         "verbose": args.verbose or None,
+        "platform": getattr(args, "platform", None),
     })
+    if cfg.platform:
+        # Must land before the first jax device touch. jax.config (not
+        # the env var) because the axon sitecustomize hook force-selects
+        # its platform through jax.config, overriding JAX_PLATFORMS.
+        import jax
+        jax.config.update("jax_platforms", cfg.platform)
     logger = Logger(verbose=cfg.verbose)
     data_dir = os.path.expanduser(cfg.data_dir)
     holder = Holder(data_dir)
@@ -181,14 +188,12 @@ def cmd_export(args) -> int:
     if idx is None or idx.field(args.field) is None:
         print(f"not found: {args.index}/{args.field}", file=sys.stderr)
         return 1
+    from pilosa_tpu.server.api import export_fragment_csv
     f = idx.field(args.field)
     view = f.view()
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     for shard in (view.available_shards() if view else []):
-        frag = view.fragment(shard)
-        for row in frag.row_ids():
-            for col in frag.row_columns(row):
-                out.write(f"{row},{col}\n")
+        out.write(export_fragment_csv(idx, args.field, shard))
     if out is not sys.stdout:
         out.close()
     holder.close()
@@ -267,6 +272,8 @@ def main(argv=None) -> int:
     sp.add_argument("-b", "--bind", default=None)
     sp.add_argument("-c", "--config", default=None)
     sp.add_argument("--verbose", action="store_true")
+    sp.add_argument("--platform", default=None,
+                    help="JAX platform override (e.g. cpu)")
     sp.set_defaults(fn=cmd_server)
 
     ip = sub.add_parser("import", help="bulk import CSV files")

@@ -24,6 +24,40 @@ from pilosa_tpu.ops.bitset import SHARD_WIDTH
 from pilosa_tpu import __version__
 
 
+def export_fragment_csv(idx, field_name: str, shard: int) -> str:
+    """CSV 'row,col' lines for one (field, standard-view, shard), keys
+    translated on keyed fields/indexes with a decimal-id fallback for
+    unmapped ids, csv-module quoting for keys containing delimiters
+    (reference api.ExportCSV, api.go:430-500). Shared by the HTTP
+    /export handler and the CLI export command."""
+    import csv as _csv
+    import io as _io
+
+    f = idx.field(field_name) if idx is not None else None
+    if f is None:
+        raise ApiError(f"field not found: {field_name}", 404)
+    view = f.view()
+    frag = view.fragment(shard) if view is not None else None
+    if frag is None:
+        return ""
+    row_tx = (f.row_translator.translate_id if f.options.keys and
+              f.row_translator is not None else None)
+    col_tx = (idx.column_translator.translate_id if idx.keys and
+              idx.column_translator is not None else None)
+    buf = _io.StringIO()
+    w = _csv.writer(buf, lineterminator="\n")
+    for row in frag.row_ids():
+        r = row_tx(row) if row_tx else row
+        if r is None:
+            r = row
+        for col in frag.row_columns(row):
+            c = col_tx(int(col)) if col_tx else col
+            if c is None:
+                c = int(col)
+            w.writerow([r, c])
+    return buf.getvalue()
+
+
 class ApiError(ValueError):
     def __init__(self, msg: str, status: int = 400):
         super().__init__(msg)
@@ -308,12 +342,22 @@ class API:
 
     def import_bits(self, index: str, field: str, rows=None, columns=None,
                     row_keys=None, column_keys=None, timestamps=None,
-                    clear: bool = False, remote: bool = False) -> None:
+                    clear: bool = False, remote: bool = False,
+                    ignore_key_check: bool = False) -> None:
         """Bulk bit import (reference API.Import, api.go:814): translate
         keys, group bits by shard, forward to owner nodes, write the local
-        subset, feed the existence field."""
+        subset, feed the existence field. Keyed index/field rejects raw
+        ids unless ignore_key_check (reference api.go:836-860; forwarded
+        legs are pre-translated, so remote implies it)."""
         idx = self._index(index)
         f = self._field(idx, field)
+        if not remote and not ignore_key_check:
+            if f.options.keys and row_keys is None and rows is not None:
+                raise ApiError("row ids cannot be used because field uses "
+                               "string keys")
+            if idx.keys and column_keys is None and columns is not None:
+                raise ApiError("column ids cannot be used because index "
+                               "uses string keys")
         if column_keys is not None:
             if not idx.keys:
                 raise ApiError(f"index {index} does not use column keys")
@@ -388,11 +432,18 @@ class API:
 
     def import_values(self, index: str, field: str, columns=None,
                       values=None, column_keys=None,
-                      clear: bool = False, remote: bool = False) -> None:
-        """(reference API.ImportValue, api.go:922)."""
+                      clear: bool = False, remote: bool = False,
+                      ignore_key_check: bool = False) -> None:
+        """(reference API.ImportValue, api.go:922; key check :944)."""
         idx = self._index(index)
         f = self._field(idx, field)
+        if not remote and not ignore_key_check and idx.keys \
+                and column_keys is None and columns is not None:
+            raise ApiError("column ids cannot be used because index uses "
+                           "string keys")
         if column_keys is not None:
+            if not idx.keys:
+                raise ApiError(f"index {index} does not use column keys")
             columns = self.executor._resolve_col_keys(idx, list(column_keys))
         columns = np.asarray(columns, dtype=np.uint64)
         values = np.asarray(values, dtype=np.int64)
@@ -441,19 +492,12 @@ class API:
     # ---------------------------------------------------------------- export
 
     def export_csv(self, index: str, field: str, shard: int) -> str:
-        """CSV rows 'row,col' for one shard (reference handleGetExport /
-        ctl/export.go)."""
-        idx = self._index(index)
-        f = self._field(idx, field)
-        view = f.view()
-        if view is None or view.fragment(shard) is None:
-            return ""
-        frag = view.fragment(shard)
-        lines = []
-        for row in frag.row_ids():
-            for col in frag.row_columns(row):
-                lines.append(f"{row},{col}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        """CSV rows 'row,col' for one shard, ids translated to keys on
+        keyed fields/indexes (reference api.ExportCSV, api.go:430-500 —
+        the per-bit translate in its write fn). Proper CSV quoting (the
+        reference uses encoding/csv); untranslatable ids fall back to
+        the decimal id, matching _translate_result's convention."""
+        return export_fragment_csv(self._index(index), field, shard)
 
     # ------------------------------------------------------- sync primitives
 

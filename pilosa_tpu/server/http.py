@@ -108,6 +108,15 @@ class Handler(BaseHTTPRequestHandler):
         query = {k: v[0] for k, v in parse_qs(parsed.query).items()}
         return parsed.path.rstrip("/") or "/", query, {}
 
+    @staticmethod
+    def _check_args(q: dict, *allowed: str) -> None:
+        """Reject unknown query-string args with 400 (reference
+        queryArgValidator middleware, http/handler.go:171-235)."""
+        unknown = set(q) - set(allowed)
+        if unknown:
+            raise ApiError(
+                f"invalid query params: {' '.join(sorted(unknown))}")
+
     # -- dispatch -----------------------------------------------------------
 
     def do_GET(self):
@@ -164,10 +173,12 @@ class Handler(BaseHTTPRequestHandler):
                         return True
                 raise ApiError(f"index not found: {m.group(1)}", 404)
             elif path == "/export":
+                self._check_args(q, "index", "field", "shard")
                 csv = api.export_csv(q["index"], q["field"],
                                      int(q.get("shard", 0)))
                 self._bytes(csv.encode(), ctype="text/csv")
             elif path == "/internal/fragment/blocks":
+                self._check_args(q, "index", "field", "view", "shard")
                 self._json({"blocks": api.fragment_blocks(
                     q["index"], q["field"], q.get("view", "standard"),
                     int(q["shard"]))})
@@ -176,10 +187,12 @@ class Handler(BaseHTTPRequestHandler):
                     q["index"], q["field"], q.get("view", "standard"),
                     int(q["shard"]), int(q["block"])))
             elif path == "/internal/fragment/data":
+                self._check_args(q, "index", "field", "view", "shard")
                 self._bytes(api.fragment_data(
                     q["index"], q["field"], q.get("view", "standard"),
                     int(q["shard"])))
             elif path == "/internal/fragment/nodes":
+                self._check_args(q, "index", "shard")
                 self._json(api.fragment_nodes(q["index"],
                                               int(q["shard"])))
             elif path == "/internal/attr/blocks":
@@ -205,6 +218,8 @@ class Handler(BaseHTTPRequestHandler):
 
         if method == "POST":
             if m := re.fullmatch(r"/index/([^/]+)/query", path):
+                self._check_args(q, "shards", "remote", "columnAttrs",
+                                 "excludeRowAttrs", "excludeColumns")
                 raw = self._body()
                 try:
                     body = json.loads(raw) if raw.lstrip()[:1] == b"{" else None
@@ -214,6 +229,19 @@ class Handler(BaseHTTPRequestHandler):
                 shards = None
                 if q.get("shards"):
                     shards = [int(s) for s in q["shards"].split(",")]
+                # URL-arg execution options apply to every call, same as
+                # the reference's request-level ExecOptions
+                # (http/handler.go:186 PostQuery optional args).
+                optargs = {k: True for k in
+                           ("columnAttrs", "excludeRowAttrs",
+                            "excludeColumns")
+                           if (q.get(k) or "").lower() in ("1", "true")}
+                if optargs:
+                    from pilosa_tpu.pql import parse_string
+                    from pilosa_tpu.pql.ast import Call, Query
+                    parsed = parse_string(pql)
+                    pql = Query([Call("Options", dict(optargs), [c])
+                                 for c in parsed.calls])
                 try:
                     self._json(api.query(m.group(1), pql, shards=shards,
                                          remote=bool(q.get("remote"))))
@@ -221,13 +249,17 @@ class Handler(BaseHTTPRequestHandler):
                     raise ApiError(str(e))
             elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)/import",
                                    path):
+                self._check_args(q, "clear", "remote", "ignoreKeyCheck")
                 b = self._body_json()
                 remote = bool(q.get("remote"))
+                ignore_keys = (q.get("ignoreKeyCheck") or "").lower() \
+                    in ("1", "true")
                 if "values" in b:
                     api.import_values(
                         m.group(1), m.group(2), columns=b.get("columnIDs"),
                         values=b["values"], column_keys=b.get("columnKeys"),
-                        clear=bool(q.get("clear")), remote=remote)
+                        clear=bool(q.get("clear")), remote=remote,
+                        ignore_key_check=ignore_keys)
                 else:
                     api.import_bits(
                         m.group(1), m.group(2), rows=b.get("rowIDs"),
@@ -235,11 +267,13 @@ class Handler(BaseHTTPRequestHandler):
                         row_keys=b.get("rowKeys"),
                         column_keys=b.get("columnKeys"),
                         timestamps=b.get("timestamps"),
-                        clear=bool(q.get("clear")), remote=remote)
+                        clear=bool(q.get("clear")), remote=remote,
+                        ignore_key_check=ignore_keys)
                 self._json({})
             elif m := re.fullmatch(
                     r"/index/([^/]+)/field/([^/]+)/import-roaring/(\d+)",
                     path):
+                self._check_args(q, "remote", "clear", "view")
                 api.import_roaring(m.group(1), m.group(2), int(m.group(3)),
                                    self._body(), clear=bool(q.get("clear")),
                                    view=q.get("view", "standard"),

@@ -26,6 +26,10 @@ class Config:
     # TPU
     mesh_devices: int = 0         # 0 = all visible devices
     mesh_replicas: int = 1
+    # JAX platform override ("" = default). "cpu" keeps the server
+    # serving host-path queries when the accelerator transport is down —
+    # without it, the first jax.devices() blocks on a hung backend.
+    platform: str = ""
     # Anti-entropy
     anti_entropy_interval: float = 600.0
     # Failure detection (reference: memberlist SWIM probing,

@@ -159,8 +159,14 @@ def test_translation_scoping(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         req(base, "POST", "/index/k2/query", b"Row(plain='oops')")
     assert e.value.code == 400
+    # raw ids on a keyed field are rejected unless explicitly allowed
+    # (reference api.go:836-860 + ignoreKeyCheck escape hatch)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "POST", "/index/k2/field/city/import",
+            {"rowIDs": [1], "columnIDs": [99]})
+    assert e.value.code == 400
     # keys align with columns even for raw-id imports
-    req(base, "POST", "/index/k2/field/city/import",
+    req(base, "POST", "/index/k2/field/city/import?ignoreKeyCheck=true",
         {"rowIDs": [1], "columnIDs": [99]})  # bypasses the translator
     req(base, "POST", "/index/k2/query", b"Set('alice', city='a')")
     st, res = req(base, "POST", "/index/k2/query",
@@ -177,3 +183,61 @@ def test_rows_previous_key(server):
         b"Set('c1', f='apple') Set('c2', f='banana')")
     st, res = req(base, "POST", "/index/k3/query", b"Rows(f, previous='apple')")
     assert res["results"][0]["keys"] == ["banana"]
+
+
+def test_query_url_exec_options(server):
+    """columnAttrs/excludeColumns as URL args, reference PostQuery
+    optional args (http/handler.go:186)."""
+    base, _ = server
+    req(base, "POST", "/index/u", {})
+    req(base, "POST", "/index/u/field/f", {})
+    req(base, "POST", "/index/u/query", b"Set(7, f=1)")
+    st, res = req(base, "POST", "/index/u/query?excludeColumns=true",
+                  b"Row(f=1)")
+    assert st == 200 and res["results"][0]["columns"] == []
+    st, res = req(base, "POST", "/index/u/query", b"Row(f=1)")
+    assert res["results"][0]["columns"] == [7]
+
+
+def test_unknown_query_args_rejected(server):
+    """Unknown query-string args get 400 (reference queryArgValidator,
+    http/handler.go:171-235)."""
+    import urllib.error
+    base, _ = server
+    req(base, "POST", "/index/v", {})
+    req(base, "POST", "/index/v/field/f", {})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req(base, "POST", "/index/v/query?bogus=1", b"Count(Row(f=1))")
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        req(base, "GET", "/export?index=v&field=f&bad=2")
+    assert ei.value.code == 400
+
+
+def test_export_csv_translates_keys(server):
+    """Export writes keys, not raw ids, for keyed fields/indexes
+    (reference api.ExportCSV per-bit translation, api.go:430-500)."""
+    base, _ = server
+    req(base, "POST", "/index/ek", {"options": {"keys": True}})
+    req(base, "POST", "/index/ek/field/tag", {"options": {"keys": True}})
+    req(base, "POST", "/index/ek/query", b"Set('alice', tag='red')")
+    st, body = req(base, "GET", "/export?index=ek&field=tag&shard=0",
+                   raw=True)
+    assert st == 200 and body.decode().strip() == "red,alice"
+
+
+def test_export_csv_quoting_and_fallback(server):
+    """Keys with commas are csv-quoted; unmapped ids fall back to the
+    decimal id instead of 'None'."""
+    base, _ = server
+    req(base, "POST", "/index/eq", {"options": {"keys": True}})
+    req(base, "POST", "/index/eq/field/tag", {"options": {"keys": True}})
+    req(base, "POST", "/index/eq/query", b"Set('a,b', tag='red')")
+    # raw-id bit with no key mapping, via the escape hatch
+    req(base, "POST", "/index/eq/field/tag/import?ignoreKeyCheck=true",
+        {"rowIDs": [55], "columnIDs": [7]})
+    st, body = req(base, "GET", "/export?index=eq&field=tag&shard=0",
+                   raw=True)
+    lines = sorted(body.decode().strip().split("\n"))
+    assert 'red,"a,b"' in lines
+    assert "55,7" in lines
