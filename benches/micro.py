@@ -84,6 +84,33 @@ def bench_fragment_paths():
         emit("fragment_open", 1 / t, "ops/sec")
 
 
+def bench_query_qps():
+    """Warm end-to-end PQL dispatch rate (parse -> compiled-tree cache
+    hit -> device exec -> fetch) for a small Count(Intersect) — the
+    per-query host overhead floor (reference executor.Execute,
+    executor.go:84)."""
+    import tempfile
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+
+    rng = np.random.default_rng(5)
+    with tempfile.TemporaryDirectory() as tmp:
+        h = Holder(tmp)
+        h.open()
+        idx = h.create_index("q")
+        for name in ("f", "g"):
+            fld = idx.create_field(name)
+            cols = rng.integers(0, 4 << 20, 200_000, dtype=np.uint64)
+            fld.import_bits(rng.integers(0, 50, len(cols), dtype=np.uint64),
+                            cols)
+        ex = Executor(h)
+        q = "Count(Intersect(Row(f=3), Row(g=7)))"
+        ex.execute("q", q)  # compile + bank upload
+        t = timeit(lambda: ex.execute("q", q), iters=100)
+        emit("pql_count_qps", 1 / t, "queries/sec")
+        h.close()
+
+
 def bench_device_kernels():
     """Fused device sweeps (the reference's per-container kernels land
     here as one XLA op)."""
@@ -193,6 +220,7 @@ def main():
     apply_bench_platform()
     bench_roaring_kernels()
     bench_fragment_paths()
+    bench_query_qps()
     bench_device_kernels()
     bench_device_time_table()
 

@@ -188,12 +188,13 @@ def cmd_export(args) -> int:
     if idx is None or idx.field(args.field) is None:
         print(f"not found: {args.index}/{args.field}", file=sys.stderr)
         return 1
-    from pilosa_tpu.server.api import export_fragment_csv
+    from pilosa_tpu.server.api import export_fragment_lines
     f = idx.field(args.field)
     view = f.view()
     out = sys.stdout if args.output == "-" else open(args.output, "w")
     for shard in (view.available_shards() if view else []):
-        out.write(export_fragment_csv(idx, args.field, shard))
+        for line in export_fragment_lines(idx, args.field, shard):
+            out.write(line)
     if out is not sys.stdout:
         out.close()
     holder.close()

@@ -24,12 +24,14 @@ from pilosa_tpu.ops.bitset import SHARD_WIDTH
 from pilosa_tpu import __version__
 
 
-def export_fragment_csv(idx, field_name: str, shard: int) -> str:
-    """CSV 'row,col' lines for one (field, standard-view, shard), keys
-    translated on keyed fields/indexes with a decimal-id fallback for
-    unmapped ids, csv-module quoting for keys containing delimiters
-    (reference api.ExportCSV, api.go:430-500). Shared by the HTTP
-    /export handler and the CLI export command."""
+def export_fragment_lines(idx, field_name: str, shard: int):
+    """Yield CSV 'row,col' lines (with trailing newline) for one
+    (field, standard-view, shard): keys translated on keyed
+    fields/indexes with a decimal-id fallback for unmapped ids,
+    csv-module quoting for keys containing delimiters (reference
+    api.ExportCSV, api.go:430-500). A generator so the CLI can stream
+    shard after shard without buffering; the HTTP handler joins (it
+    needs the body for Content-Length anyway)."""
     import csv as _csv
     import io as _io
 
@@ -39,7 +41,7 @@ def export_fragment_csv(idx, field_name: str, shard: int) -> str:
     view = f.view()
     frag = view.fragment(shard) if view is not None else None
     if frag is None:
-        return ""
+        return
     row_tx = (f.row_translator.translate_id if f.options.keys and
               f.row_translator is not None else None)
     col_tx = (idx.column_translator.translate_id if idx.keys and
@@ -54,8 +56,10 @@ def export_fragment_csv(idx, field_name: str, shard: int) -> str:
             c = col_tx(int(col)) if col_tx else col
             if c is None:
                 c = int(col)
+            buf.seek(0)
+            buf.truncate()
             w.writerow([r, c])
-    return buf.getvalue()
+            yield buf.getvalue()
 
 
 class ApiError(ValueError):
@@ -497,7 +501,8 @@ class API:
         the per-bit translate in its write fn). Proper CSV quoting (the
         reference uses encoding/csv); untranslatable ids fall back to
         the decimal id, matching _translate_result's convention."""
-        return export_fragment_csv(self._index(index), field, shard)
+        return "".join(export_fragment_lines(self._index(index), field,
+                                             shard))
 
     # ------------------------------------------------------- sync primitives
 

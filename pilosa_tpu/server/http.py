@@ -109,6 +109,12 @@ class Handler(BaseHTTPRequestHandler):
         return parsed.path.rstrip("/") or "/", query, {}
 
     @staticmethod
+    def _qbool(q: dict, name: str) -> bool:
+        """Boolean query-string arg: on for '1'/'true' (case-insensitive),
+        off otherwise — so ?clear=false doesn't silently enable."""
+        return (q.get(name) or "").lower() in ("1", "true")
+
+    @staticmethod
     def _check_args(q: dict, *allowed: str) -> None:
         """Reject unknown query-string args with 400 (reference
         queryArgValidator middleware, http/handler.go:171-235)."""
@@ -234,31 +240,29 @@ class Handler(BaseHTTPRequestHandler):
                 # (http/handler.go:186 PostQuery optional args).
                 optargs = {k: True for k in
                            ("columnAttrs", "excludeRowAttrs",
-                            "excludeColumns")
-                           if (q.get(k) or "").lower() in ("1", "true")}
-                if optargs:
-                    from pilosa_tpu.pql import parse_string
-                    from pilosa_tpu.pql.ast import Call, Query
-                    parsed = parse_string(pql)
-                    pql = Query([Call("Options", dict(optargs), [c])
-                                 for c in parsed.calls])
+                            "excludeColumns") if self._qbool(q, k)}
                 try:
+                    if optargs:
+                        from pilosa_tpu.pql import parse_string
+                        from pilosa_tpu.pql.ast import Call, Query
+                        parsed = parse_string(pql)
+                        pql = Query([Call("Options", dict(optargs), [c])
+                                     for c in parsed.calls])
                     self._json(api.query(m.group(1), pql, shards=shards,
-                                         remote=bool(q.get("remote"))))
+                                         remote=self._qbool(q, "remote")))
                 except ValueError as e:
                     raise ApiError(str(e))
             elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)/import",
                                    path):
                 self._check_args(q, "clear", "remote", "ignoreKeyCheck")
                 b = self._body_json()
-                remote = bool(q.get("remote"))
-                ignore_keys = (q.get("ignoreKeyCheck") or "").lower() \
-                    in ("1", "true")
+                remote = self._qbool(q, "remote")
+                ignore_keys = self._qbool(q, "ignoreKeyCheck")
                 if "values" in b:
                     api.import_values(
                         m.group(1), m.group(2), columns=b.get("columnIDs"),
                         values=b["values"], column_keys=b.get("columnKeys"),
-                        clear=bool(q.get("clear")), remote=remote,
+                        clear=self._qbool(q, "clear"), remote=remote,
                         ignore_key_check=ignore_keys)
                 else:
                     api.import_bits(
@@ -267,7 +271,7 @@ class Handler(BaseHTTPRequestHandler):
                         row_keys=b.get("rowKeys"),
                         column_keys=b.get("columnKeys"),
                         timestamps=b.get("timestamps"),
-                        clear=bool(q.get("clear")), remote=remote,
+                        clear=self._qbool(q, "clear"), remote=remote,
                         ignore_key_check=ignore_keys)
                 self._json({})
             elif m := re.fullmatch(
@@ -275,22 +279,23 @@ class Handler(BaseHTTPRequestHandler):
                     path):
                 self._check_args(q, "remote", "clear", "view")
                 api.import_roaring(m.group(1), m.group(2), int(m.group(3)),
-                                   self._body(), clear=bool(q.get("clear")),
+                                   self._body(),
+                                   clear=self._qbool(q, "clear"),
                                    view=q.get("view", "standard"),
-                                   remote=bool(q.get("remote")))
+                                   remote=self._qbool(q, "remote"))
                 self._json({})
             elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)", path):
                 b = self._body_json()
                 self._json(api.create_field(m.group(1), m.group(2),
                                             b.get("options"),
-                                            remote=bool(q.get("remote"))))
+                                            remote=self._qbool(q, "remote")))
             elif m := re.fullmatch(r"/index/([^/]+)", path):
                 b = self._body_json()
                 opts = b.get("options", {})
                 self._json(api.create_index(
                     m.group(1), keys=opts.get("keys", False),
                     track_existence=opts.get("trackExistence", True),
-                    remote=bool(q.get("remote"))))
+                    remote=self._qbool(q, "remote")))
             elif path == "/recalculate-caches":
                 api.recalculate_caches()
                 self._json({})

@@ -241,3 +241,17 @@ def test_export_csv_quoting_and_fallback(server):
     lines = sorted(body.decode().strip().split("\n"))
     assert 'red,"a,b"' in lines
     assert "55,7" in lines
+
+
+def test_parse_error_with_url_options_is_400(server):
+    base, _ = server
+    req(base, "POST", "/index/pe", {})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        req(base, "POST", "/index/pe/query?excludeColumns=true", b"Row(")
+    assert e.value.code == 400
+    # boolean URL args: explicit false stays off
+    req(base, "POST", "/index/pe/field/f", {})
+    req(base, "POST", "/index/pe/query", b"Set(3, f=1)")
+    st, res = req(base, "POST", "/index/pe/query?excludeColumns=false",
+                  b"Row(f=1)")
+    assert res["results"][0]["columns"] == [3]
