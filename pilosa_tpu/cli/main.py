@@ -36,6 +36,16 @@ def cmd_server(args) -> int:
         # its platform through jax.config, overriding JAX_PLATFORMS.
         import jax
         jax.config.update("jax_platforms", cfg.platform)
+    if cfg.jax_coordinator and cfg.jax_num_processes > 1:
+        # Multi-host SPMD: after initialize, jax.devices() is global
+        # across hosts and the shard mesh spans the whole pod slice
+        # (collectives ride ICI within a slice, DCN across; survey §7.6).
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=cfg.jax_coordinator,
+            num_processes=cfg.jax_num_processes,
+            process_id=(cfg.jax_process_id
+                        if cfg.jax_process_id >= 0 else None))
     logger = Logger(verbose=cfg.verbose)
     data_dir = os.path.expanduser(cfg.data_dir)
     holder = Holder(data_dir)
@@ -255,6 +265,71 @@ def cmd_config(args) -> int:
     return 0
 
 
+def cmd_backup(args) -> int:
+    """Tar the data directory (snapshots, op-logs, caches, .meta,
+    .topology, .id, translate logs) — the offline analog of the
+    reference's tar-stream backup of fragment files over HTTP
+    (fragment.go:1885-2230, ctl/export.go). Consistent when the server
+    is stopped; a live backup may catch a torn op-log tail, which
+    restore+open tolerates (sidecar+truncate)."""
+    import tarfile
+
+    data_dir = os.path.expanduser(args.data_dir)
+    if not os.path.isdir(data_dir):
+        print(f"not a directory: {data_dir}", file=sys.stderr)
+        return 1
+    out_real = os.path.realpath(args.output)
+    n = 0
+    with tarfile.open(args.output, "w:gz") as tar:
+        for root, _dirs, files in os.walk(data_dir):
+            for name in files:
+                if name.endswith(".torn"):
+                    continue
+                full = os.path.join(root, name)
+                if os.path.realpath(full) == out_real:
+                    continue  # -o inside the data dir: skip ourselves
+                tar.add(full, arcname=os.path.relpath(full, data_dir))
+                n += 1
+    print(f"backed up {n} files from {data_dir} to {args.output}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    """Unpack a backup tar into a data directory (must not already hold
+    an index tree unless --force)."""
+    import tarfile
+
+    import shutil
+
+    data_dir = os.path.expanduser(args.data_dir)
+    if os.path.isdir(data_dir) and os.listdir(data_dir):
+        if not args.force:
+            print(f"refusing to restore into non-empty {data_dir} "
+                  f"(use --force)", file=sys.stderr)
+            return 1
+        # --force REPLACES: leftover post-backup files must not mix
+        # with backup-time state.
+        shutil.rmtree(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
+    with tarfile.open(args.input, "r:*") as tar:
+        # Refuse traversal and non-file members (symlinks could point
+        # outside) up front, instead of trusting the archive and
+        # aborting half-extracted.
+        for m in tar.getmembers():
+            dest = os.path.realpath(os.path.join(data_dir, m.name))
+            if not dest.startswith(os.path.realpath(data_dir) + os.sep):
+                print(f"unsafe path in archive: {m.name}", file=sys.stderr)
+                return 1
+            if not (m.isreg() or m.isdir()):
+                print(f"unsafe member type in archive: {m.name}",
+                      file=sys.stderr)
+                return 1
+        tar.extractall(data_dir, filter="data")
+        n = len(tar.getmembers())
+    print(f"restored {n} files into {data_dir}")
+    return 0
+
+
 def cmd_generate_config(args) -> int:
     from pilosa_tpu.utils.config import Config
 
@@ -300,6 +375,17 @@ def main(argv=None) -> int:
     np_.add_argument("files", nargs="+")
     np_.add_argument("--verbose", action="store_true")
     np_.set_defaults(fn=cmd_inspect)
+
+    bp = sub.add_parser("backup", help="tar a data directory")
+    bp.add_argument("-d", "--data-dir", required=True)
+    bp.add_argument("-o", "--output", required=True)
+    bp.set_defaults(fn=cmd_backup)
+
+    rp = sub.add_parser("restore", help="unpack a backup tar")
+    rp.add_argument("-d", "--data-dir", required=True)
+    rp.add_argument("-i", "--input", required=True)
+    rp.add_argument("--force", action="store_true")
+    rp.set_defaults(fn=cmd_restore)
 
     gp = sub.add_parser("config", help="print resolved configuration")
     gp.add_argument("-c", "--config", default=None)

@@ -30,6 +30,14 @@ class Config:
     # serving host-path queries when the accelerator transport is down —
     # without it, the first jax.devices() blocks on a hung backend.
     platform: str = ""
+    # Multi-host SPMD (jax.distributed): when coordinator is set, the
+    # server calls jax.distributed.initialize before building the mesh,
+    # so the mesh spans every host's devices and XLA routes inter-host
+    # collectives over DCN (the reference's NCCL/MPI analog is its HTTP
+    # scatter-gather, executor.go:2277; see docs/administration.md).
+    jax_coordinator: str = ""   # host:port of process 0
+    jax_num_processes: int = 0  # 0 = single process
+    jax_process_id: int = -1    # -1 = auto/unset
     # Anti-entropy
     anti_entropy_interval: float = 600.0
     # Failure detection (reference: memberlist SWIM probing,

@@ -72,3 +72,69 @@ def test_import_int_field(tmp_path, capsys):
     h.open()
     assert h.index("idx").field("n").value(2) == (-5, True)
     h.close()
+
+
+def test_backup_restore_roundtrip(tmp_path, capsys):
+    """backup tars the data dir; restore unpacks it; the restored holder
+    answers the same query (offline analog of the reference's tar-stream
+    backup, fragment.go:1885-2230)."""
+    src = str(tmp_path / "src")
+    csvf = tmp_path / "in.csv"
+    csvf.write_text("1,5\n1,9\n2,5\n")
+    assert main(["import", "-d", src, "-i", "idx", "-f", "f",
+                 str(csvf)]) == 0
+    tar = str(tmp_path / "bk.tgz")
+    assert main(["backup", "-d", src, "-o", tar]) == 0
+    dst = str(tmp_path / "dst")
+    assert main(["restore", "-d", dst, "-i", tar]) == 0
+    out1 = str(tmp_path / "a.csv")
+    out2 = str(tmp_path / "b.csv")
+    assert main(["export", "-d", src, "-i", "idx", "-f", "f",
+                 "-o", out1]) == 0
+    assert main(["export", "-d", dst, "-i", "idx", "-f", "f",
+                 "-o", out2]) == 0
+    assert open(out1).read() == open(out2).read() != ""
+    # refuse restore into non-empty without --force
+    assert main(["restore", "-d", dst, "-i", tar]) == 1
+    assert main(["restore", "-d", dst, "-i", tar, "--force"]) == 0
+
+
+def test_restore_force_replaces_and_rejects_bad_members(tmp_path):
+    """--force replaces (post-backup files don't survive); symlink
+    members are rejected before extraction."""
+    import tarfile
+    src = str(tmp_path / "s")
+    csvf = tmp_path / "in.csv"
+    csvf.write_text("1,5\n")
+    assert main(["import", "-d", src, "-i", "idx", "-f", "f",
+                 str(csvf)]) == 0
+    tar = str(tmp_path / "bk.tgz")
+    assert main(["backup", "-d", src, "-o", tar]) == 0
+    dst = tmp_path / "d"
+    assert main(["restore", "-d", str(dst), "-i", tar]) == 0
+    stray = dst / "idx" / "stray.bin"
+    stray.write_text("post-backup junk")
+    assert main(["restore", "-d", str(dst), "-i", tar, "--force"]) == 0
+    assert not stray.exists()  # replaced, not merged
+    # symlink member refused up front
+    evil = str(tmp_path / "evil.tgz")
+    with tarfile.open(evil, "w:gz") as t:
+        info = tarfile.TarInfo("link")
+        info.type = tarfile.SYMTYPE
+        info.linkname = "/etc/passwd"
+        t.addfile(info)
+    empty = str(tmp_path / "e")
+    assert main(["restore", "-d", empty, "-i", evil]) == 1
+
+
+def test_backup_output_inside_data_dir(tmp_path):
+    src = tmp_path / "s"
+    csvf = tmp_path / "in.csv"
+    csvf.write_text("1,5\n")
+    assert main(["import", "-d", str(src), "-i", "idx", "-f", "f",
+                 str(csvf)]) == 0
+    tar = str(src / "bk.tgz")
+    assert main(["backup", "-d", str(src), "-o", tar]) == 0
+    import tarfile
+    with tarfile.open(tar) as t:
+        assert "bk.tgz" not in t.getnames()
