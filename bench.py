@@ -68,10 +68,33 @@ TIMING_BUDGET_S = 90.0  # stop the timing loop early past this (>=2 samples)
 CHAIN_K1 = 4
 CHAIN_K2 = 16
 
-# Assumed HBM roofline for roofline_frac. The attached chip reports as a
-# v5-lite part; v5e HBM is ~819 GB/s. If the chip differs the absolute
-# GB/s figure still stands on its own.
-ROOFLINE_GBPS = 819.0
+# HBM roofline for roofline_frac, resolved from the attached chip's
+# device_kind (public per-chip HBM BW figures); falls back to v5e-class
+# 819 GB/s for unknown kinds. A measured device_gbps above the resolved
+# figure means the kind wasn't recognized — the absolute GB/s number
+# still stands on its own.
+# Ordered: longer probes precede their prefixes (v4i before v4).
+ROOFLINE_GBPS_BY_KIND = (
+    ("v6", 1640.0),      # Trillium
+    ("v5p", 2765.0),
+    ("v5e", 819.0),
+    ("v5 lite", 819.0),
+    ("v5lite", 819.0),
+    ("v4i", 614.0),
+    ("v4", 1228.0),
+    ("v3", 900.0),
+    ("v2", 700.0),
+)
+ROOFLINE_GBPS_DEFAULT = 819.0
+
+
+def resolve_roofline(device) -> tuple:
+    """(gbps, kind_str) for a jax device; default when unrecognized."""
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for probe, gbps in ROOFLINE_GBPS_BY_KIND:
+        if probe in kind:
+            return gbps, kind
+    return ROOFLINE_GBPS_DEFAULT, kind or "unknown"
 
 PROBE_TIMEOUT_S = 150
 PROBE_RETRIES = 2
@@ -223,12 +246,14 @@ def bench_device_time(holder):
     np.asarray(jnp.sum(tiny))
     rtt = time.perf_counter() - t0
     gbps = bank_bytes / per_iter / 1e9
+    roofline, kind = resolve_roofline(jax.devices()[0])
     return {
         "device_sweep_s": per_iter,
         "device_bits_per_sec": bank_bytes * 8 / per_iter,
         "device_gbps": gbps,
-        "roofline_gbps_assumed": ROOFLINE_GBPS,
-        "roofline_frac": gbps / ROOFLINE_GBPS,
+        "device_kind": kind,
+        "roofline_gbps_assumed": roofline,
+        "roofline_frac": gbps / roofline,
         "fetch_rtt_s": rtt,
         "bank_bytes": bank_bytes,
     }
@@ -400,8 +425,9 @@ def main():
             "cpu_value": baseline,
         }
         for k in ("device_bits_per_sec", "device_gbps", "device_sweep_s",
-                  "roofline_gbps_assumed", "roofline_frac", "fetch_rtt_s",
-                  "device_time_error", "partial", "tpu_timing"):
+                  "device_kind", "roofline_gbps_assumed", "roofline_frac",
+                  "fetch_rtt_s", "device_time_error", "partial",
+                  "tpu_timing"):
             if k in child:
                 result[k] = child[k]
     else:
