@@ -26,6 +26,7 @@ import numpy as np
 
 from pilosa_tpu.ops.bitset import (
     SHARD_WIDTH,
+    SHARD_WIDTH_EXP,
     WORDS_PER_SHARD,
     u64_to_words,
 )
@@ -436,12 +437,42 @@ class Fragment:
         column_ids = np.asarray(column_ids, dtype=np.uint64)
         positions = (row_ids * np.uint64(SHARD_WIDTH)
                      + (column_ids % np.uint64(SHARD_WIDTH)))
+        # Sort+dedup ONCE; the storage layer and the touched-row scan
+        # both reuse it (direct_add_n would otherwise re-unique, and
+        # np.unique(row_ids) would re-sort 8 bytes/bit).
+        positions = np.unique(positions)
         with self._lock:
             if clear:
                 self.storage.remove_batch(positions)
             else:
-                self.storage.add_batch(positions)
-            touched = np.unique(row_ids)
+                # A batch that immediately triggers the synchronous
+                # snapshot below would have its op-log record rewritten
+                # away before bulk_import returns — skip the redundant
+                # multi-MB append (same process-crash durability: a
+                # crash mid-import loses the in-flight batch under
+                # either scheme, as a torn/absent record).
+                will_snapshot = (self.storage.op_n + len(positions)
+                                 >= self.max_op_n)
+                self.storage.add_batch(positions, presorted=True,
+                                       log_op=not will_snapshot)
+                if will_snapshot:
+                    # Snapshot NOW, before any other work can raise: with
+                    # the op record skipped, the synchronous snapshot IS
+                    # the batch's durability. If it fails, append the
+                    # record after all so a clean close still persists
+                    # the batch.
+                    try:
+                        self._snapshot()
+                    except BaseException:
+                        self.storage.append_batch_record(positions)
+                        raise
+            rows_sorted = positions >> np.uint64(SHARD_WIDTH_EXP)
+            if len(rows_sorted):
+                keep = np.concatenate(
+                    ([True], rows_sorted[1:] != rows_sorted[:-1]))
+                touched = rows_sorted[keep]
+            else:
+                touched = rows_sorted
             for r in touched.tolist():
                 self._touch_row(int(r))
                 if self.cache_type != cache_mod.CACHE_TYPE_NONE:

@@ -317,11 +317,20 @@ class Bitmap:
 
     # -- batch ops (the import path; reference DirectAddN / bulkImport) -----
 
-    def direct_add_n(self, positions: np.ndarray) -> int:
-        """Bulk add without op-log (reference DirectAddN). Returns #changed."""
+    def direct_add_n(self, positions: np.ndarray,
+                     presorted: bool = False) -> int:
+        """Bulk add without op-log (reference DirectAddN). Returns
+        #changed. presorted=True asserts positions are already sorted
+        unique uint64 (bulk_import sorts once and reuses it for the
+        touched-row scan)."""
         if len(positions) == 0:
             return 0
-        positions = np.unique(np.asarray(positions, dtype=np.uint64))
+        if presorted:
+            # Contract: sorted unique; the dtype half is enforced here
+            # (an int64 array would break the uint64 shifts below).
+            positions = np.ascontiguousarray(positions, dtype=np.uint64)
+        else:
+            positions = np.unique(np.asarray(positions, dtype=np.uint64))
         changed = 0
         keys = (positions >> np.uint64(16)).astype(np.int64)
         # positions are sorted, so group boundaries come from one
@@ -396,12 +405,30 @@ class Bitmap:
             self._drop_empty(key)
         return changed
 
-    def add_batch(self, positions: np.ndarray) -> int:
-        """Bulk add *with* one batch op-log record (op type 2)."""
-        n = self.direct_add_n(positions)
+    def add_batch(self, positions: np.ndarray,
+                  presorted: bool = False, log_op: bool = True) -> int:
+        """Bulk add *with* one batch op-log record (op type 2).
+        log_op=False skips the record — only valid when the caller
+        synchronously snapshots before returning (the record would be
+        rewritten away immediately; see Fragment.bulk_import)."""
+        n = self.direct_add_n(positions, presorted=presorted)
         if len(positions):
-            self._write_op(OP_ADD_BATCH, values=np.asarray(positions, dtype=np.uint64))
+            if log_op:
+                self._write_op(OP_ADD_BATCH,
+                               values=np.asarray(positions,
+                                                 dtype=np.uint64))
+            else:
+                self.op_n += len(positions)
         return n
+
+    def append_batch_record(self, positions: np.ndarray) -> None:
+        """Append an ADD_BATCH record for ALREADY-applied,
+        already-op-counted positions (the add_batch(log_op=False)
+        failure fallback — does not bump op_n again)."""
+        if self.op_writer is not None and len(positions):
+            self.op_writer.write(encode_op(
+                OP_ADD_BATCH,
+                values=np.asarray(positions, dtype=np.uint64)))
 
     def remove_batch(self, positions: np.ndarray) -> int:
         n = self.direct_remove_n(positions)

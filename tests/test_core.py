@@ -522,3 +522,37 @@ def test_noop_remove_keeps_array_encoding(tmp_path):
     assert not b.remove(6)  # no-op: must not materialize dense
     assert b.containers[0].dtype == np.uint16
     assert b.remove(5) and b.containers[0].dtype == np.uint64
+
+
+def test_bulk_import_snapshot_failure_keeps_durability(tmp_path):
+    """When the snapshot-triggering import path skips the op-log record
+    and the snapshot itself fails, the record is appended after all so a
+    clean close still persists the batch."""
+    import numpy as np
+    from pilosa_tpu.core.fragment import Fragment
+
+    p = str(tmp_path / "f")
+    f = Fragment(p, "i", "f", "standard", 0)
+    f.open()
+    f.max_op_n = 10  # any real batch triggers the snapshot path
+    orig = f._snapshot
+    calls = {"n": 0}
+
+    def failing_snapshot():
+        calls["n"] += 1
+        raise OSError("disk full (simulated)")
+
+    f._snapshot = failing_snapshot
+    rows = np.zeros(50, np.uint64)
+    cols = np.arange(50, dtype=np.uint64)
+    try:
+        f.bulk_import(rows, cols)
+    except OSError:
+        pass
+    assert calls["n"] == 1
+    f._snapshot = orig
+    f.close()
+    f2 = Fragment(p, "i", "f", "standard", 0)
+    f2.open()
+    assert f2.row_count(0) == 50  # batch survived via the fallback record
+    f2.close()
