@@ -169,14 +169,22 @@ class Fragment:
         if self._file is not None:
             self._file.flush()
             self._file.close()
-        with open(tmp, "wb") as f:
-            f.write(self.storage.write_bytes())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
-        self.storage.op_n = 0
-        self._file = open(self.path, "ab")
-        self.storage.op_writer = self._file
+            self._file = None
+            self.storage.op_writer = None
+        try:
+            with open(tmp, "wb") as f:
+                f.write(self.storage.write_bytes())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            self.storage.op_n = 0
+        finally:
+            # Restore the append handle even on failure: the old file is
+            # still in place and later op appends — including
+            # bulk_import's durability-fallback record — must keep
+            # working on a fragment whose snapshot failed.
+            self._file = open(self.path, "ab")
+            self.storage.op_writer = self._file
 
     def _maybe_snapshot(self) -> None:
         if self.storage.op_n >= self.max_op_n:

@@ -9,10 +9,11 @@ Serializer) with JSON fallback; roaring payloads stay raw bytes.
 
 from __future__ import annotations
 
+import http.client
 import json
-import urllib.error
-import urllib.request
+import threading
 from typing import Any, Dict, List, Optional
+from urllib.parse import urlsplit
 
 from pilosa_tpu.server import wire
 
@@ -21,17 +22,73 @@ class ClientError(RuntimeError):
     pass
 
 
+class _ConnPool:
+    """Keep-alive HTTP/1.1 connections per (host, port). The reference
+    gets this from Go's default http.Transport pooling; without it every
+    scatter-gather leg pays a TCP handshake."""
+
+    MAX_IDLE_PER_HOST = 8
+
+    def __init__(self, timeout: float):
+        self.timeout = timeout
+        self._idle: Dict[tuple, list] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _new_conn(host: str, port: int,
+                  timeout: float) -> http.client.HTTPConnection:
+        import socket as _socket
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        conn.connect()
+        # Nagle + delayed-ACK on a reused connection turns every small
+        # header+body request pair into a ~40 ms stall; disable it.
+        conn.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        return conn
+
+    def get(self, host: str, port: int):
+        """-> (connection, reused): reused=True means it came from the
+        idle pool and may have been closed server-side while idle."""
+        with self._lock:
+            idle = self._idle.get((host, port))
+            if idle:
+                return idle.pop(), True
+        return self._new_conn(host, port, self.timeout), False
+
+    def put(self, host: str, port: int,
+            conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            idle = self._idle.setdefault((host, port), [])
+            if len(idle) < self.MAX_IDLE_PER_HOST:
+                idle.append(conn)
+                return
+        conn.close()
+
+    def clear(self) -> None:
+        with self._lock:
+            conns = [c for idle in self._idle.values() for c in idle]
+            self._idle.clear()
+        for c in conns:
+            c.close()
+
+
 class InternalClient:
     def __init__(self, timeout: float = 30.0, tracer=None):
         self.timeout = timeout
         self.tracer = tracer
+        self._pool = _ConnPool(timeout)
+
+    def drop_idle(self) -> None:
+        """Close every idle pooled connection (test harnesses use this to
+        sever keep-alive sockets when simulating a dead peer)."""
+        self._pool.clear()
 
     def _req(self, method: str, url: str, body: Optional[bytes] = None,
-             raw: bool = False, obj=None):
-        """One internal request. `obj` bodies and non-raw responses use the
-        binary wire codec (server/wire.py — the rebuild's analog of the
-        reference's protobuf Serializer, encoding/proto/proto.go:29);
-        JSON stays the fallback for older peers."""
+             raw: bool = False, obj=None, timeout: Optional[float] = None):
+        """One internal request over a pooled keep-alive connection.
+        `obj` bodies and non-raw responses use the binary wire codec
+        (server/wire.py — the rebuild's analog of the reference's
+        protobuf Serializer, encoding/proto/proto.go:29); JSON stays the
+        fallback for older peers."""
         if obj is not None:
             try:
                 body = wire.dumps(obj)
@@ -45,21 +102,62 @@ class InternalClient:
             headers["Accept"] = f"{wire.CONTENT_TYPE}, application/json"
         if self.tracer is not None:
             self.tracer.inject(headers)
-        req = urllib.request.Request(url, data=body, method=method,
-                                     headers=headers)
+        parts = urlsplit(url)
+        host = parts.hostname or "localhost"
+        port = parts.port or 80
+        path = parts.path + (f"?{parts.query}" if parts.query else "")
+        one_off = timeout is not None
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                payload = resp.read()
-                if raw:
-                    return payload
-                if (resp.headers.get("Content-Type") or "").startswith(
-                        wire.CONTENT_TYPE):
-                    return wire.loads(payload)
-                return json.loads(payload or b"{}")
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode("utf-8", "replace")[:500]
-            raise ClientError(f"{method} {url}: {e.code}: {detail}") from e
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            if one_off:  # non-default timeout: dedicated connection
+                conn, reused = _ConnPool._new_conn(host, port,
+                                                   timeout), False
+            else:
+                conn, reused = self._pool.get(host, port)
+        except OSError as e:  # eager connect: refused/unreachable
+            raise ClientError(f"{method} {url}: {e}") from e
+        try:
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+            except (http.client.HTTPException, ConnectionError,
+                    OSError) as e:
+                # A REUSED connection may have gone stale (server closed
+                # the idle socket); retry once on a fresh one — but never
+                # after a timeout (a slow-but-alive peer must not be hit
+                # twice) and never for fresh connections, matching Go's
+                # transport semantics (retry only reused conns). The
+                # narrow duplicate-POST race (server processed AND closed
+                # before our read) is safe for these internal endpoints:
+                # imports and cluster messages are idempotent.
+                conn.close()
+                if not reused or isinstance(e, TimeoutError):
+                    raise
+                conn = _ConnPool._new_conn(host, port,
+                                           timeout or self.timeout)
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+            payload = resp.read()
+            status = resp.status
+            ctype = resp.headers.get("Content-Type") or ""
+            reusable = not one_off and not resp.will_close
+            if reusable:
+                self._pool.put(host, port, conn)
+            else:
+                conn.close()
+            if status >= 400:
+                raise ClientError(
+                    f"{method} {url}: {status}: "
+                    f"{payload.decode('utf-8', 'replace')[:500]}")
+            if raw:
+                return payload
+            if ctype.startswith(wire.CONTENT_TYPE):
+                return wire.loads(payload)
+            return json.loads(payload or b"{}")
+        except ClientError:
+            raise
+        except (http.client.HTTPException, ConnectionError, OSError,
+                TimeoutError) as e:
+            conn.close()
             raise ClientError(f"{method} {url}: {e}") from e
 
     # -- query fan-out (reference QueryNode, http/client.go:241) -------------
@@ -151,19 +249,10 @@ class InternalClient:
     def resize_pull(self, uri: str, timeout: float = 600.0) -> dict:
         """Synchronous pull pass on a member during a resize job (the data
         motion of the reference's ResizeInstruction, cluster.go:1251).
-        Long timeout: the node streams every fragment it now owns."""
-        req = urllib.request.Request(f"{uri}/internal/resize/pull",
-                                     data=b"", method="POST")
-        try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
-                return json.loads(resp.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode("utf-8", "replace")[:500]
-            raise ClientError(
-                f"POST {uri}/internal/resize/pull: {e.code}: {detail}") \
-                from e
-        except (urllib.error.URLError, OSError, TimeoutError) as e:
-            raise ClientError(f"POST {uri}/internal/resize/pull: {e}") from e
+        Long timeout (dedicated connection): the node streams every
+        fragment it now owns."""
+        return self._req("POST", f"{uri}/internal/resize/pull", body=b"",
+                         timeout=timeout)
 
     def cluster_message(self, uri: str, message: dict) -> None:
         self._req("POST", f"{uri}/internal/cluster/message", obj=message)

@@ -43,6 +43,10 @@ from pilosa_tpu.server.api import API, ApiError
 class Handler(BaseHTTPRequestHandler):
     api: API = None  # injected by serve()
     protocol_version = "HTTP/1.1"
+    # Response headers and body go out in separate writes; with Nagle on,
+    # a keep-alive internal client pays a ~40 ms delayed-ACK stall per
+    # response. (The client side sets TCP_NODELAY on its pooled sockets.)
+    disable_nagle_algorithm = True
 
     # -- plumbing -----------------------------------------------------------
 
@@ -352,13 +356,56 @@ class Handler(BaseHTTPRequestHandler):
         return False
 
 
+class PilosaHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that tracks open connection sockets so
+    server_close severs lingering keep-alive connections too — without
+    this, a 'stopped' node keeps answering pooled internal-client
+    connections through its still-alive handler threads."""
+
+    daemon_threads = True
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._open_conns = set()
+        self._conns_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._open_conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._open_conns.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self) -> None:
+        import socket as _socket
+        with self._conns_lock:
+            conns = list(self._open_conns)
+            self._open_conns.clear()
+        for s in conns:
+            try:
+                s.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def server_close(self):
+        super().server_close()
+        self.close_all_connections()
+
+
 def serve(api: API, host: str = "localhost", port: int = 10101,
           background: bool = False):
     """Start the HTTP server (reference handler.Serve,
     http/handler.go:150). Returns the server; blocking unless
     background=True."""
     handler = type("BoundHandler", (Handler,), {"api": api})
-    server = ThreadingHTTPServer((host, port), handler)
+    server = PilosaHTTPServer((host, port), handler)
     if background:
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()

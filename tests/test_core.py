@@ -535,22 +535,29 @@ def test_bulk_import_snapshot_failure_keeps_durability(tmp_path):
     f = Fragment(p, "i", "f", "standard", 0)
     f.open()
     f.max_op_n = 10  # any real batch triggers the snapshot path
-    orig = f._snapshot
+    # Fail INSIDE the real _snapshot, after it has already closed the
+    # op-log append handle — the hard case: the fallback must reopen the
+    # handle (restored by _snapshot's finally) and append the record.
+    import os as _os
     calls = {"n": 0}
+    orig_replace = _os.replace
 
-    def failing_snapshot():
-        calls["n"] += 1
-        raise OSError("disk full (simulated)")
+    def failing_replace(src, dst):
+        if dst.endswith("f") and "snapshotting" in src:
+            calls["n"] += 1
+            raise OSError("disk full (simulated)")
+        return orig_replace(src, dst)
 
-    f._snapshot = failing_snapshot
     rows = np.zeros(50, np.uint64)
     cols = np.arange(50, dtype=np.uint64)
+    _os.replace = failing_replace
     try:
         f.bulk_import(rows, cols)
     except OSError:
         pass
+    finally:
+        _os.replace = orig_replace
     assert calls["n"] == 1
-    f._snapshot = orig
     f.close()
     f2 = Fragment(p, "i", "f", "standard", 0)
     f2.open()
