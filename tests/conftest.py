@@ -34,3 +34,22 @@ def tmp_holder(tmp_path):
     h.open()
     yield h
     h.close()
+
+
+@pytest.fixture
+def live_server(tmp_path):
+    """One live HTTP server on a random port: (base_url, api, holder).
+    Shared by the HTTP-surface, docs-walkthrough, and endpoint tests so
+    startup/teardown stays in one place."""
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.server import API, serve
+    from pilosa_tpu.utils.stats import MemStatsClient
+
+    h = Holder(str(tmp_path / "srv"))
+    h.open()
+    api = API(h, stats=MemStatsClient())
+    srv = serve(api, "localhost", 0, background=True)
+    yield f"http://localhost:{srv.server_address[1]}", api, h
+    srv.shutdown()
+    srv.server_close()
+    h.close()

@@ -8,21 +8,11 @@ import urllib.request
 
 import pytest
 
-from pilosa_tpu.core.holder import Holder
-from pilosa_tpu.server import API, serve
-from pilosa_tpu.utils.stats import MemStatsClient
 
 
 @pytest.fixture
-def base(tmp_path):
-    h = Holder(str(tmp_path))
-    h.open()
-    api = API(h, stats=MemStatsClient())
-    srv = serve(api, "localhost", 0, background=True)
-    yield f"http://localhost:{srv.server_address[1]}"
-    srv.shutdown()
-    srv.server_close()
-    h.close()
+def base(live_server):
+    yield live_server[0]
 
 
 def post(base, path, body):

@@ -15,16 +15,9 @@ from pilosa_tpu.utils.stats import MemStatsClient
 
 
 @pytest.fixture
-def server(tmp_path):
-    h = Holder(str(tmp_path))
-    h.open()
-    api = API(h, stats=MemStatsClient())
-    srv = serve(api, "localhost", 0, background=True)
-    port = srv.server_address[1]
-    yield f"http://localhost:{port}", api
-    srv.shutdown()
-    srv.server_close()
-    h.close()
+def server(live_server):
+    base, api, _h = live_server
+    yield base, api
 
 
 def req(base, method, path, body=None, raw=False):

@@ -14,16 +14,9 @@ from pilosa_tpu.server import API, serve
 
 
 @pytest.fixture
-def srv(tmp_path):
-    h = Holder(str(tmp_path))
-    h.open()
-    api = API(h)
-    server = serve(api, "localhost", 0, background=True)
-    base = f"http://localhost:{server.server_address[1]}"
+def srv(live_server):
+    base, _api, h = live_server
     yield base, h
-    server.shutdown()
-    server.server_close()
-    h.close()
 
 
 def req(base, method, path, body=None, expect=200):
