@@ -168,6 +168,10 @@ class Handler(BaseHTTPRequestHandler):
             elif path == "/debug/vars":
                 stats = getattr(api.stats, "snapshot", lambda: {})()
                 self._json(stats)
+            elif path == "/metrics":
+                from pilosa_tpu.utils.stats import prometheus_text
+                self._bytes(prometheus_text(api.stats).encode(),
+                            ctype="text/plain; version=0.0.4")
             elif path == "/index":
                 self._json(api.schema()["indexes"])
             elif m := re.fullmatch(r"/index/([^/]+)/field", path):

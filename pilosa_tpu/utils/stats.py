@@ -269,3 +269,64 @@ class StatsdStatsClient(StatsClient):
     def timing(self, name, value, rate=1.0):
         # seconds -> ms, the statsd timing unit.
         self._emit(name, f"{self._num(value * 1000.0)}|ms", rate)
+
+
+def prometheus_text(stats) -> str:
+    """Prometheus text exposition (v0.0.4) of a snapshot()-capable stats
+    client — the modern pull-based complement to /debug/vars and the
+    statsd push backend (reference metric backends, stats/stats.go:84,
+    statsd/statsd.go:41)."""
+    import re as _re
+
+    snap = getattr(stats, "snapshot", lambda: {})()
+
+    def clean(name: str) -> str:
+        return _re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+    def split_key(k: str):
+        """'name{tag1,k:v}' (MemStatsClient._key) -> (name, labelstr):
+        tags become proper Prometheus labels, never part of the metric
+        name (tag values must not explode name cardinality)."""
+        m = _re.fullmatch(r"([^{]+)\{(.*)\}", k)
+        if not m:
+            return clean(k), ""
+        name, raw = m.groups()
+        labels = []
+        for i, t in enumerate(x for x in raw.split(",") if x):
+            if "=" in t:
+                lk, lv = t.split("=", 1)
+            elif ":" in t:
+                lk, lv = t.split(":", 1)
+            else:
+                lk, lv = f"tag{i}", t
+            lv = lv.replace("\\", "\\\\").replace('"', '\\"')
+            labels.append(f'{clean(lk)}="{lv}"')
+        return clean(name), "{" + ",".join(labels) + "}" if labels else ""
+
+    lines = []
+    typed = set()
+
+    def emit(name: str, typ: str, sample_lines):
+        if name not in typed:  # one TYPE line per metric name
+            typed.add(name)
+            lines.append(f"# TYPE {name} {typ}")
+        lines.extend(sample_lines)
+
+    for k, v in sorted(snap.get("counters", {}).items()):
+        name, lab = split_key(k)
+        n = f"pilosa_{name}_total"
+        emit(n, "counter", [f"{n}{lab} {v}"])
+    for k, v in sorted(snap.get("gauges", {}).items()):
+        name, lab = split_key(k)
+        n = f"pilosa_{name}"
+        emit(n, "gauge", [f"{n}{lab} {v}"])
+    for k, t in sorted(snap.get("timings", {}).items()):
+        name, lab = split_key(k)
+        n = f"pilosa_{name}_seconds"
+        inner = lab[1:-1] + "," if lab else ""
+        emit(n, "summary", [
+            f'{n}{{{inner}quantile="0.5"}} {t["p50"]}',
+            f'{n}{{{inner}quantile="0.99"}} {t["p99"]}',
+            f"{n}_count{lab} {t['count']}",
+        ])
+    return "\n".join(lines) + ("\n" if lines else "")

@@ -248,3 +248,16 @@ def test_parse_error_with_url_options_is_400(server):
     st, res = req(base, "POST", "/index/pe/query?excludeColumns=false",
                   b"Row(f=1)")
     assert res["results"][0]["columns"] == [3]
+
+
+def test_prometheus_metrics_endpoint(server):
+    base, _ = server
+    req(base, "POST", "/index/pm", {})
+    req(base, "POST", "/index/pm/field/f", {})
+    req(base, "POST", "/index/pm/query", b"Set(1, f=2)")
+    req(base, "POST", "/index/pm/query", b"Count(Row(f=2))")
+    st, body = req(base, "GET", "/metrics", raw=True)
+    text = body.decode()
+    assert st == 200
+    assert "# TYPE pilosa_query_total counter" in text
+    assert "pilosa_query_total" in text
