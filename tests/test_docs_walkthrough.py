@@ -57,3 +57,75 @@ def test_readme_quickstart(base):
                 "Set(1, stars=14)")["results"] == [True]
     r = post(base, "/index/repo/query", "TopN(stars, n=5)")
     assert r["results"][0] == [{"id": 14, "count": 1}]
+
+
+def test_query_language_reference_table(base):
+    """Every call form from docs/query-language.md's tables executes and
+    returns the documented shape."""
+    post(base, "/index/ql", '{"options": {"trackExistence": true}}')
+    post(base, "/index/ql/field/f", "{}")
+    post(base, "/index/ql/field/g", "{}")
+    post(base, "/index/ql/field/iv",
+         '{"options": {"type": "int", "min": -100, "max": 1000}}')
+    post(base, "/index/ql/field/t",
+         '{"options": {"type": "time", "timeQuantum": "YMD"}}')
+
+    # write calls
+    assert post(base, "/index/ql/query",
+                "Set(1, f=10) Set(2, f=10) Set(2, g=4)")["results"] == \
+        [True, True, True]
+    assert post(base, "/index/ql/query",
+                "Set(1, t=3, 2018-01-15T00:00)")["results"] == [True]
+    assert post(base, "/index/ql/query", "Set(1, iv=-3)")["results"] == \
+        [True]
+    post(base, "/index/ql/query", "Set(2, iv=500)")
+    post(base, "/index/ql/query", 'SetRowAttrs(f, 10, color="red")')
+    post(base, "/index/ql/query", 'SetColumnAttrs(7, city="spokane")')
+
+    # read calls
+    r = post(base, "/index/ql/query", "Row(f=10)")
+    assert r["results"][0]["columns"] == [1, 2]
+    r = post(base, "/index/ql/query",
+             "Row(t=3, from='2018-01-01T00:00', to='2018-02-01T00:00')")
+    assert r["results"][0]["columns"] == [1]
+    r = post(base, "/index/ql/query", "Range(iv > 100)")
+    assert r["results"][0]["columns"] == [2]
+    r = post(base, "/index/ql/query", "Range(iv >< [-10, 0])")
+    assert r["results"][0]["columns"] == [1]
+    r = post(base, "/index/ql/query",
+             "Intersect(Row(f=10), Row(g=4)) Union(Row(f=10), Row(g=4)) "
+             "Difference(Row(f=10), Row(g=4)) Xor(Row(f=10), Row(g=4))")
+    assert [x["columns"] for x in r["results"]] == \
+        [[2], [1, 2], [1], [1]]
+    r = post(base, "/index/ql/query", "Not(Row(g=4))")
+    # existence {1,2} minus {2}; attrs-only columns don't join existence
+    assert r["results"][0]["columns"] == [1]
+    r = post(base, "/index/ql/query", "Shift(Row(g=4), n=1)")
+    assert r["results"][0]["columns"] == [3]
+    r = post(base, "/index/ql/query", "Count(Row(f=10))")
+    assert r["results"] == [2]
+    r = post(base, "/index/ql/query", "TopN(f, n=5)")
+    assert r["results"][0] == [{"id": 10, "count": 2}]
+    r = post(base, "/index/ql/query", "Rows(f)")
+    assert r["results"][0]["rows"] == [10]
+    r = post(base, "/index/ql/query", "GroupBy(Rows(f), Rows(g))")
+    assert r["results"][0][0]["count"] == 1
+    r = post(base, "/index/ql/query", 'Sum(field="iv") Min(field="iv") '
+                                      'Max(field="iv")')
+    assert r["results"][0] == {"value": 497, "count": 2}
+    assert r["results"][1] == {"value": -3, "count": 1}
+    assert r["results"][2] == {"value": 500, "count": 1}
+    r = post(base, "/index/ql/query",
+             "Options(Row(f=10), excludeColumns=true)")
+    assert r["results"][0]["columns"] == []
+
+    # remaining write calls
+    post(base, "/index/ql/query", "Store(Row(f=10), g=20)")
+    r = post(base, "/index/ql/query", "Row(g=20)")
+    assert r["results"][0]["columns"] == [1, 2]
+    post(base, "/index/ql/query", "Clear(1, f=10)")
+    r = post(base, "/index/ql/query", "Row(f=10)")
+    assert r["results"][0]["columns"] == [2]
+    post(base, "/index/ql/query", "ClearRow(f=10)")
+    r = post(base, "/index/ql/query", "Count(Row(f=10))")
+    assert r["results"] == [0]
