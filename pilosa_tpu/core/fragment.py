@@ -284,21 +284,33 @@ class Fragment:
             # block — no per-row Python work beyond the dict probe.
             if n_containers == 1:
                 flat = out.reshape(-1)
+                # Bulk probe: map(dict.get, ...) runs the 65k-per-chunk
+                # lookup loop in C — the pure-Python for/get/append form
+                # was the dominant host cost of the whole chunked sweep.
+                keys = (np.asarray(row_ids, dtype=np.uint64)
+                        * np.uint64(CONTAINERS_PER_ROW)).tolist()
+                cs = list(map(containers.get, keys))
                 arrays, rows_at = [], []
-                for i, r in enumerate(row_ids):
-                    c = containers.get(r * CONTAINERS_PER_ROW)
+                u16dt = np.dtype(np.uint16)
+                trim = total64 != cwords64
+                lim = np.uint16(total64 * 64 - 1) if trim else None
+                n_dense = min(cwords64, total64)
+                ap_a, ap_r = arrays.append, rows_at.append
+                for i, c in enumerate(cs):
                     if c is None:
                         continue
-                    if c.dtype != np.uint16:
-                        n = min(cwords64, total64)
-                        out[i, :n] = c[:n]
+                    if c.dtype is not u16dt:
+                        out[i, :n_dense] = c[:n_dense]
                         continue
-                    v = c if total64 == cwords64 else c[c < total64 * 64]
-                    arrays.append(v)
-                    rows_at.append(i)
+                    if trim and c[-1] > lim:
+                        # Sorted array: slice the in-range prefix rather
+                        # than boolean-masking every element.
+                        c = c[:np.searchsorted(c, lim, "right")]
+                    ap_a(c)
+                    ap_r(i)
                 if arrays:
                     from pilosa_tpu import native
-                    lens = np.fromiter((len(a) for a in arrays),
+                    lens = np.fromiter(map(len, arrays),
                                        dtype=np.int64, count=len(arrays))
                     pos16 = np.concatenate(arrays)
                     if not native.scatter_rows(

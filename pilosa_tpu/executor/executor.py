@@ -23,6 +23,7 @@ dispatch), :2277 (mapReduce). Structural translation to TPU:
 from __future__ import annotations
 
 import functools
+import itertools
 import os
 import threading
 from dataclasses import dataclass, field as dc_field
@@ -1047,8 +1048,10 @@ class Executor:
                 if i < len(chunked):
                     pending.append(dispatch_chunk(chunked[i]))
                 counts, raw = self._fetch_counts(out, filter_words)
+                # map(dict.get, ...) keeps the 65k-row probe loop in C.
                 slot_idx = np.fromiter(
-                    (bank.slots.get(r, bank.zero_slot) for r in rows),
+                    map(bank.slots.get, rows,
+                        itertools.repeat(bank.zero_slot)),
                     dtype=np.int64, count=len(rows))
                 parts.append((np.asarray(rows, dtype=np.uint64),
                               counts[slot_idx].astype(np.int64),
