@@ -29,8 +29,9 @@ class BankBudget:
     what stays resident. Evicted banks drop out of their view's cache (the
     device array frees once the last query referencing it drains)."""
 
-    def __init__(self, budget_bytes: int):
+    def __init__(self, budget_bytes: int, cache_attr: str = "_bank_cache"):
         self.budget = budget_bytes
+        self.cache_attr = cache_attr
         self._lock = threading.Lock()
         # (id(view), key) -> (view, nbytes), in LRU order (oldest first).
         from collections import OrderedDict
@@ -38,11 +39,14 @@ class BankBudget:
         self.total = 0
         self.evictions = 0
 
-    def admit(self, view: "View", key) -> None:
-        bank = view._bank_cache.get(key)
-        if bank is None:
-            return
-        nbytes = int(np.prod(bank.array.shape)) * 4
+    def admit(self, view: "View", key, nbytes: Optional[int] = None
+              ) -> None:
+        cache = getattr(view, self.cache_attr)
+        if nbytes is None:
+            bank = cache.get(key)
+            if bank is None:
+                return
+            nbytes = int(np.prod(bank.array.shape)) * 4
         ek = (id(view), key)
         with self._lock:
             old = self._entries.pop(ek, None)
@@ -52,7 +56,7 @@ class BankBudget:
                 (vid, vkey), (v, nb) = self._entries.popitem(last=False)
                 self.total -= nb
                 self.evictions += 1
-                v._bank_cache.pop(vkey, None)
+                getattr(v, self.cache_attr).pop(vkey, None)
             self._entries[ek] = (view, nbytes)
             self.total += nbytes
 
@@ -71,6 +75,12 @@ class BankBudget:
 
 BANK_BUDGET = BankBudget(
     int(os.environ.get("PILOSA_TPU_HBM_BUDGET_BYTES", 8 << 30)))
+
+# Process-wide host-RAM budget for cached packed chunk blocks (the
+# chunked-TopN repeat-query shortcut). 0 disables caching.
+HOST_BLOCK_BUDGET = BankBudget(
+    int(os.environ.get("PILOSA_TPU_HOST_BLOCK_CACHE_BYTES", 1 << 30)),
+    cache_attr="_host_blocks")
 
 
 class ViewBank:
@@ -126,6 +136,11 @@ class View:
         self._lock = threading.RLock()
         self.on_new_shard = None  # callback(shard) for shard broadcasts
         self._bank_cache: Dict[tuple, ViewBank] = {}
+        # Host-side packed blocks for transient row-subset banks (the
+        # chunked-TopN stream): repeated sweeps over an unchanged
+        # fragment skip the whole container gather and go straight to
+        # device_put. LRU-bounded process-wide by HOST_BLOCK_BUDGET.
+        self._host_blocks: Dict[tuple, tuple] = {}  # key -> (arr, vers)
 
     def open(self) -> None:
         frag_dir = os.path.join(self.path, "fragments")
@@ -147,6 +162,9 @@ class View:
             for key in list(self._bank_cache):
                 BANK_BUDGET.forget(self, key)
             self._bank_cache.clear()
+            for key in list(self._host_blocks):
+                HOST_BLOCK_BUDGET.forget(self, key)
+            self._host_blocks.clear()
             for frag in self.fragments.values():
                 frag.close()
 
@@ -260,12 +278,33 @@ class View:
                         BANK_BUDGET.touch(self, cache_key)
                         return cached
             cap = bank_capacity(len(row_set))
-            host = np.zeros((cap, len(shards), width), dtype=np.uint32)
-            slots = {r: i for i, r in enumerate(row_set)}
-            for si, s in enumerate(shards):
-                f = frags[s]
-                if f is not None:
-                    host[:len(row_set), si] = f.rows_dense(row_set, width)
+            hb_key = None
+            host = slots = None
+            if rows is not None and not cache_rows:
+                hb_key = (shards, width, tuple(row_set))
+                entry = self._host_blocks.get(hb_key)
+                if entry is not None:
+                    if entry[1] == versions:
+                        host, _v, slots = entry
+                        HOST_BLOCK_BUDGET.touch(self, hb_key)
+                    else:
+                        self._host_blocks.pop(hb_key, None)
+                        HOST_BLOCK_BUDGET.forget(self, hb_key)
+            if host is None:
+                host = np.zeros((cap, len(shards), width), dtype=np.uint32)
+                for si, s in enumerate(shards):
+                    f = frags[s]
+                    if f is not None:
+                        host[:len(row_set), si] = f.rows_dense(row_set,
+                                                               width)
+                # Cached alongside so a hit is O(1) host-side — no
+                # 65k-entry dict rebuild per chunk per repeat query.
+                slots = {r: i for i, r in enumerate(row_set)}
+                if hb_key is not None and \
+                        0 < host.nbytes <= HOST_BLOCK_BUDGET.budget:
+                    self._host_blocks[hb_key] = (host, versions, slots)
+                    HOST_BLOCK_BUDGET.admit(self, hb_key,
+                                            nbytes=host.nbytes)
             array = mesh.put_bank(host) if mesh else jnp.asarray(host)
             bank = ViewBank(array, slots, cap - 1, versions)
             if rows is None or cache_rows:

@@ -799,3 +799,39 @@ def test_bsi_64bit_range(ex):
     # spans past 63 bits are still rejected up front
     with pytest.raises(ValueError, match="63 bits"):
         FieldOptions(type="int", min=-(1 << 62), max=1 << 62).validate()
+
+
+def test_host_block_cache_hits_and_invalidates(ex, monkeypatch):
+    """Chunked-TopN host blocks are cached per (shards,width,rows) and
+    keyed by fragment versions: repeat queries reuse them; a write
+    rebuilds; close() releases the budget."""
+    from pilosa_tpu.core import view as view_mod
+    from pilosa_tpu.executor import executor as executor_mod
+
+    e, h = ex
+    idx = h.create_index("hb")
+    f = idx.create_field("f")
+    cols = np.arange(3000, dtype=np.uint64)
+    f.import_bits(cols % np.uint64(200), cols)
+    monkeypatch.setattr(executor_mod, "TOPN_MAX_BANK_BYTES", 1)
+    monkeypatch.setattr(executor_mod, "TOPN_CHUNK_ROWS", 64)
+    view = f.view()
+    # Filtered TopN: the warm ranked-cache shortcut doesn't apply, so
+    # the over-budget path streams chunk banks.
+    q = "TopN(f, Row(f=0), n=5)"
+    (want,) = e.execute("hb", q)
+    assert view._host_blocks, "expected cached host blocks"
+    n_blocks = len(view._host_blocks)
+    (again,) = e.execute("hb", q)
+    assert again.pairs == want.pairs
+    assert len(view._host_blocks) == n_blocks  # reused, not regrown
+    # a write invalidates via versions and the result reflects it
+    e.execute("hb", "Set(3000, f=0) Set(3000, f=1)")
+    (after,) = e.execute("hb", q)
+    assert dict(after.pairs)[0] == dict(want.pairs)[0] + 1
+    # close releases all accounted bytes for this view
+    before_total = view_mod.HOST_BLOCK_BUDGET.total
+    assert before_total > 0
+    view.close()
+    assert all(e2[0] is not view for e2 in
+               view_mod.HOST_BLOCK_BUDGET._entries.values())
