@@ -228,12 +228,14 @@ class View:
         """Bank for `shards` covering `rows` (default: all rows present in
         any of the shards). Cached per (shard tuple, mesh, trim); rebuilt
         when any fragment's write version moved. `rows` subsets build
-        transient banks (chunked TopN) unless cache_rows=True, which caches
-        them under a rows-inclusive key — the executor's Row-leaf path uses
-        this when the FULL view bank would blow the HBM budget (a single
-        Row(f=x) on a million-row field must not upload the whole field;
-        reference never faces this because it streams per-shard,
-        executor.go:2377). All cached banks are LRU-accounted against
+        transient banks unless cache_rows=True, which caches them under a
+        rows-inclusive key — used by the executor's Row-leaf path when
+        the FULL view bank would blow the HBM budget (a single Row(f=x)
+        on a million-row field must not upload the whole field; reference
+        never faces this because it streams per-shard, executor.go:2377)
+        and by chunked TopN when its whole stream fits the budget.
+        Either way the packed HOST block is cached (HOST_BLOCK_BUDGET)
+        so a device-side eviction rebuilds by re-upload, not re-gather. All cached banks are LRU-accounted against
         BANK_BUDGET. trim=True narrows the word axis to trimmed_words() —
         valid only for whole-row consumers since the dropped tail is
         all-zero by construction. With a MeshContext the array is
@@ -276,11 +278,19 @@ class View:
                             and cached.array.shape[-1] == width \
                             and cached.versions == versions:
                         BANK_BUDGET.touch(self, cache_key)
+                        # Keep the backing host block warm too: if HBM
+                        # pressure later evicts this bank, the rebuild
+                        # should re-upload, not re-gather.
+                        HOST_BLOCK_BUDGET.touch(
+                            self, (shards, width, tuple(row_set)))
                         return cached
             cap = bank_capacity(len(row_set))
+            # Host blocks back ALL row-subset builds (cache_rows device
+            # banks included): when HBM pressure evicts the device bank,
+            # the rebuild skips the container gather and only re-uploads.
             hb_key = None
             host = slots = None
-            if rows is not None and not cache_rows:
+            if rows is not None:
                 hb_key = (shards, width, tuple(row_set))
                 entry = self._host_blocks.get(hb_key)
                 if entry is not None:

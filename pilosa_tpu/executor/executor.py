@@ -1028,9 +1028,21 @@ class Executor:
         if tanimoto and filter_words is not None:
             src_dev = self._popcount_row(filter_words)
 
+        # Chunk banks are admitted to the BANK_BUDGET HBM LRU only when
+        # the WHOLE stream fits in half the budget: a repeat query over
+        # an unchanged fragment then skips every chunk re-upload (on a
+        # tunneled chip the upload dominates the sweep). An over-budget
+        # stream would be a sequential scan over an LRU — ~0% repeat
+        # hits while evicting every other view's banks — so it stays
+        # transient. Row churn shifts chunk boundaries and orphans old
+        # keys; orphans are bounded by (and aged out of) the budget.
+        from pilosa_tpu.core.view import BANK_BUDGET
+        cache_chunks = bank_bytes <= BANK_BUDGET.budget // 2
+
         def dispatch_chunk(rows):
             bank = view.device_bank(tuple(shards), rows=rows,
-                                    mesh=self.mesh, trim=True)
+                                    mesh=self.mesh, trim=True,
+                                    cache_rows=cache_chunks)
             return (rows, bank,
                     self._dispatch_counts(bank.array, filter_words))
 
