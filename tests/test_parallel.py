@@ -163,3 +163,38 @@ def test_int_field_range_guard(tmp_path):
                                              max=2**62))
     idx.create_field("ok", FieldOptions(type="int", min=0, max=2**40))
     h.close()
+
+
+def test_chunked_topn_under_mesh(tmp_path, mesh8, monkeypatch):
+    """The over-budget TopN stream (chunk banks, host-block + HBM-LRU
+    caches) must agree with local execution when sharded over the mesh,
+    and repeat queries must agree after cache warm-up."""
+    from pilosa_tpu.executor import executor as executor_mod
+
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("ct")
+    f = idx.create_field("f")
+    rng = np.random.default_rng(5)
+    cols = rng.choice(10 * SHARD_WIDTH, size=30000,
+                      replace=False).astype(np.uint64)
+    rows = np.arange(30000, dtype=np.uint64) % 300
+    f.import_bits(rows, cols)
+    monkeypatch.setattr(executor_mod, "TOPN_MAX_BANK_BYTES", 1)
+    monkeypatch.setattr(executor_mod, "TOPN_CHUNK_ROWS", 64)
+
+    local = Executor(h)
+    dist = Executor(h, mesh=mesh8)
+    q = "TopN(f, Row(f=0), n=10)"
+    with mesh8.mesh:
+        (a,) = local.execute("ct", q)
+        (b,) = dist.execute("ct", q)
+        assert a.pairs == b.pairs
+        (b2,) = dist.execute("ct", q)   # warm: cached chunk banks
+        assert b2.pairs == b.pairs
+        # write between queries: caches must invalidate
+        dist.execute("ct", "Set(10000000, f=0) Set(10000000, f=1)")
+        (b3,) = dist.execute("ct", q)
+        (a3,) = local.execute("ct", q)
+        assert b3.pairs == a3.pairs != b.pairs
+    h.close()
