@@ -997,3 +997,59 @@ def test_translate_primary_pinned_across_membership(tmp_path):
                 nd.stop()
             except Exception:
                 pass
+
+
+def test_cluster_queries_after_restart(tmp_path):
+    """Restart a node (same data dir, same port): it reopens its
+    fragments from disk, rejoins the topology, and serves the same
+    results (reference TestClusterQueriesAfterRestart,
+    server/server_test.go)."""
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.server import API, serve
+    from pilosa_tpu.server.http import PilosaHTTPServer
+    from pilosa_tpu.utils.stats import MemStatsClient
+
+    nodes = run_cluster(tmp_path, 2, replica_n=1)
+    try:
+        base = nodes[0].uri
+        req(base, "POST", "/index/rs", {"options": {}})
+        req(base, "POST", "/index/rs/field/f", {"options": {}})
+        cols = [s * SHARD_WIDTH + 3 for s in range(8)]
+        req(base, "POST", "/index/rs/field/f/import",
+            {"rowIDs": [1] * 8, "columnIDs": cols})
+        (before,) = req(base, "POST", "/index/rs/query",
+                        b"Count(Row(f=1))")["results"]
+        assert before == 8
+
+        # restart node 1: close everything, reopen from the same dir on
+        # the same port, re-attach the same cluster identity
+        port = nodes[1].server.server_address[1]
+        uris = [nodes[0].uri, nodes[1].uri]
+        nodes[1].server.shutdown()
+        nodes[1].server.server_close()
+        nodes[1].holder.close()
+
+        nodes[1].holder = Holder(str(tmp_path / "n1"))
+        nodes[1].holder.open()
+        nodes[1].api = API(nodes[1].holder, stats=MemStatsClient())
+        nodes[1].server = serve(nodes[1].api, "localhost", port,
+                                background=True)
+        nodes[1].attach_cluster(uris, replica_n=1)
+
+        # both nodes answer with the full pre-restart count
+        for uri in uris:
+            (after,) = req(uri, "POST", "/index/rs/query",
+                           b"Count(Row(f=1))")["results"]
+            assert after == 8, uri
+        # and writes keep working post-restart
+        req(base, "POST", "/index/rs/query",
+            f"Set({9 * SHARD_WIDTH}, f=1)".encode())
+        (after,) = req(base, "POST", "/index/rs/query",
+                       b"Count(Row(f=1))")["results"]
+        assert after == 9
+    finally:
+        for nd in nodes:
+            try:
+                nd.stop()
+            except Exception:
+                pass
