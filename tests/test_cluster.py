@@ -1053,3 +1053,42 @@ def test_cluster_queries_after_restart(tmp_path):
                 nd.stop()
             except Exception:
                 pass
+
+
+def test_cluster_connection_burst(tmp_path):
+    """Concurrent query burst through the coordinator (reference
+    TestClusterExhaustingConnections, server/server_test.go): pooled
+    internal connections + threaded handlers must survive parallel
+    fan-out without fd exhaustion or cross-talk."""
+    import threading as _t
+
+    nodes = run_cluster(tmp_path, 2, replica_n=1)
+    try:
+        base = nodes[0].uri
+        req(base, "POST", "/index/cb", {"options": {}})
+        req(base, "POST", "/index/cb/field/f", {"options": {}})
+        cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+        req(base, "POST", "/index/cb/field/f/import",
+            {"rowIDs": [1] * 6, "columnIDs": cols})
+        errors = []
+        barrier = _t.Barrier(8)
+
+        def worker():
+            try:
+                barrier.wait()
+                for _ in range(25):
+                    (cnt,) = req(base, "POST", "/index/cb/query",
+                                 b"Count(Row(f=1))")["results"]
+                    assert cnt == 6, cnt
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [_t.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+    finally:
+        for nd in nodes:
+            nd.stop()
