@@ -24,6 +24,10 @@ ITERS = 5
 def main():
     from pilosa_tpu.utils.benchenv import apply_bench_platform
     apply_bench_platform()
+    from pilosa_tpu.utils.benchenv import \
+        install_partial_record_handler
+    install_partial_record_handler(
+        "bsi_ops_per_sec", "ops/sec")
     from pilosa_tpu.core.holder import Holder
     from pilosa_tpu.executor import Executor
 
@@ -88,3 +92,7 @@ def main():
 
 if __name__ == "__main__":
     main()
+    # Real records are out; a late TERM during interpreter
+    # teardown must not append a zero-value partial.
+    import signal as _signal
+    _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)

@@ -218,6 +218,10 @@ def bench_device_time_table():
 def main():
     from pilosa_tpu.utils.benchenv import apply_bench_platform
     apply_bench_platform()
+    from pilosa_tpu.utils.benchenv import \
+        install_partial_record_handler
+    install_partial_record_handler(
+        "micro_suite", "mixed")
     bench_roaring_kernels()
     bench_fragment_paths()
     bench_query_qps()
@@ -227,3 +231,7 @@ def main():
 
 if __name__ == "__main__":
     main()
+    # Real records are out; a late TERM during interpreter
+    # teardown must not append a zero-value partial.
+    import signal as _signal
+    _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)

@@ -36,6 +36,10 @@ def post(path, body):
 def main():
     from pilosa_tpu.utils.benchenv import apply_bench_platform
     apply_bench_platform()
+    from pilosa_tpu.utils.benchenv import \
+        install_partial_record_handler
+    install_partial_record_handler(
+        "startrace_http_p50_latency", "seconds")
     from pilosa_tpu.core.holder import Holder
     from pilosa_tpu.server import API, serve
 
@@ -94,3 +98,7 @@ def main():
 
 if __name__ == "__main__":
     main()
+    # Real records are out; a late TERM during interpreter
+    # teardown must not append a zero-value partial.
+    import signal as _signal
+    _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)

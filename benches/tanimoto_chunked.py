@@ -69,6 +69,11 @@ def pack_chunk(pos_chunk):
 def main():
     from pilosa_tpu.utils.benchenv import apply_bench_platform
     apply_bench_platform()
+
+    from pilosa_tpu.utils.benchenv import \
+        install_partial_record_handler
+    install_partial_record_handler(
+        "tanimoto_chunked_mols_per_sec", "molecules/sec")
     # Chunked path knobs must be set before the executor module loads.
     os.environ.setdefault("PILOSA_TPU_TOPN_CHUNK_ROWS", str(CHUNK_ROWS))
     from pilosa_tpu.core.holder import Holder
@@ -170,3 +175,7 @@ def main():
 
 if __name__ == "__main__":
     main()
+    # Real records are out; a late TERM during interpreter
+    # teardown must not append a zero-value partial.
+    import signal as _signal
+    _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)

@@ -44,6 +44,11 @@ def emit(metric, tpu_t, cpu_t, **extra):
 def main():
     from pilosa_tpu.utils.benchenv import apply_bench_platform
     apply_bench_platform()
+
+    from pilosa_tpu.utils.benchenv import \
+        install_partial_record_handler
+    install_partial_record_handler(
+        "taxi_workload_total", "rides")
     from pilosa_tpu.core.field import FieldOptions
     from pilosa_tpu.core.holder import Holder
     from pilosa_tpu.executor import Executor
@@ -160,3 +165,7 @@ def main():
 
 if __name__ == "__main__":
     main()
+    # Real records are out; a late TERM during interpreter
+    # teardown must not append a zero-value partial.
+    import signal as _signal
+    _signal.signal(_signal.SIGTERM, _signal.SIG_DFL)

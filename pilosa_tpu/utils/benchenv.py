@@ -12,3 +12,34 @@ def apply_bench_platform() -> None:
         import jax
         jax.config.update("jax_platforms",
                           os.environ["PILOSA_BENCH_PLATFORM"])
+
+
+def install_partial_record_handler(metric: str, unit: str):
+    """SIGTERM -> print a partial JSON record and exit 0, so a
+    suite-level `timeout` kill still leaves a parseable line (the axon
+    client can swallow the default TERM disposition and die silently).
+    Returns a `done()` callback: call it after the real record prints to
+    restore SIG_DFL — a late TERM during teardown must not append a
+    contradictory zero-value record."""
+    import json
+    import signal
+    import sys
+
+    partial = {"metric": metric, "value": 0.0, "unit": unit,
+               "vs_baseline": 0.0, "partial": True,
+               "error": "killed before completion (suite timeout)"}
+
+    def _on_term(signum, frame):
+        # Leading newline: if TERM lands mid-print of another record,
+        # the partial line still starts clean (consumers skip the
+        # severed fragment line).
+        sys.stdout.write("\n" + json.dumps(partial) + "\n")
+        sys.stdout.flush()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _on_term)
+
+    def done():
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+    return done
