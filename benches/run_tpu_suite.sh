@@ -30,3 +30,7 @@ wait_tpu
 echo "$(date -u +%H:%M:%S) final bench.py" >&2
 python bench.py > BENCH_late.json 2> bench_late.err
 echo "$(date -u +%H:%M:%S) suite done rc=$?" >&2
+# Appended mid-round: retry tanimoto_chunked (its first slot hit a hung
+# tunnel) at a smaller N that fits the window, then refresh micro.
+run tanimoto_chunked_retry 2000 env PILOSA_TANIMOTO_N=1000000 PILOSA_TANIMOTO_ITERS=3 python benches/tanimoto_chunked.py
+echo "$(date -u +%H:%M:%S) appended-retry done" >&2
