@@ -34,6 +34,13 @@ constexpr uint8_t kOpAdd = 0;
 constexpr uint8_t kOpRemove = 1;
 constexpr uint8_t kOpAddBatch = 2;
 constexpr uint8_t kOpRemoveBatch = 3;
+// Extension op (not in the reference's format, roaring.go:3594-3597): the
+// payload is a self-contained roaring snapshot of the batch — ~2 bytes/bit
+// for sparse imports vs 8 for kOpAddBatch — checksummed with crc32 (fnv1a32
+// is byte-serial, ~0.8 GB/s, and was the import path's bottleneck).
+// Reference-written files never contain it, so read compatibility with the
+// reference's own files is unaffected.
+constexpr uint8_t kOpAddRoaring = 4;
 
 inline uint16_t ru16(const uint8_t* p) { uint16_t v; std::memcpy(&v, p, 2); return v; }
 inline uint32_t ru32(const uint8_t* p) { uint32_t v; std::memcpy(&v, p, 4); return v; }
@@ -51,6 +58,43 @@ inline uint32_t fnv1a32(const uint8_t* data, size_t n, uint32_t h = 0x811C9DC5u)
 
 inline int popcount64(uint64_t x) { return __builtin_popcountll(x); }
 
+// crc32 (IEEE reflected, poly 0xEDB88320), slice-by-8 — bit-identical to
+// Python's zlib.crc32 including the chaining convention
+// crc32(b, crc32(a)) == crc32(a||b). Tables built once at first use.
+struct Crc32Tables {
+  uint32_t t[8][256];
+  Crc32Tables() {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; i++)
+      for (int s = 1; s < 8; s++)
+        t[s][i] = t[0][t[s - 1][i] & 0xFF] ^ (t[s - 1][i] >> 8);
+  }
+};
+
+inline uint32_t crc32_update(uint32_t crc, const uint8_t* p, size_t n) {
+  static const Crc32Tables tables;
+  const auto& t = tables.t;
+  crc = ~crc;
+  while (n >= 8) {
+    uint32_t lo;
+    std::memcpy(&lo, p, 4);
+    lo ^= crc;
+    uint32_t hi;
+    std::memcpy(&hi, p + 4, 4);
+    crc = t[7][lo & 0xFF] ^ t[6][(lo >> 8) & 0xFF] ^ t[5][(lo >> 16) & 0xFF] ^
+          t[4][lo >> 24] ^ t[3][hi & 0xFF] ^ t[2][(hi >> 8) & 0xFF] ^
+          t[1][(hi >> 16) & 0xFF] ^ t[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = t[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
 // A loaded bitmap: sorted (key, dense-words) pairs. Keys are the 48-bit
 // container keys; every container is held dense (1024 uint64 words), the
 // same representation the Python layer uses (storage/roaring.py docstring).
@@ -58,6 +102,9 @@ struct LoadedBitmap {
   std::vector<uint64_t> keys;
   std::vector<uint64_t> words;  // keys.size() * kContainerWords
   uint64_t op_n = 0;
+  uint64_t op_n_small = 0;   // single-bit op records only (types 0/1)
+  uint64_t ops_bytes = 0;    // bytes of valid op records applied
+  uint64_t snapshot_bytes = 0;  // size of the snapshot section
   uint64_t tail_dropped = 0;  // torn-tail bytes discarded on replay
   char err[128] = {0};
 
@@ -171,6 +218,41 @@ inline void bit_remove(LoadedBitmap* bm, uint64_t pos) {
   if (c) c[(pos & 0xFFFF) >> 6] &= ~(1ull << (pos & 63));
 }
 
+// Union `other` into `bm` by sorted-merge (O(total) — repeated
+// binary-search inserts would memmove the whole words vector per new key).
+void merge_union(LoadedBitmap* bm, const LoadedBitmap& other) {
+  if (other.keys.empty()) return;
+  std::vector<uint64_t> keys;
+  std::vector<uint64_t> words;
+  keys.reserve(bm->keys.size() + other.keys.size());
+  words.reserve((bm->keys.size() + other.keys.size()) * kContainerWords);
+  size_t i = 0, j = 0;
+  const size_t an = bm->keys.size(), bn = other.keys.size();
+  while (i < an || j < bn) {
+    size_t at = words.size();
+    words.resize(at + kContainerWords);
+    uint64_t* dst = &words[at];
+    if (j >= bn || (i < an && bm->keys[i] < other.keys[j])) {
+      keys.push_back(bm->keys[i]);
+      std::memcpy(dst, &bm->words[i * kContainerWords], 8 * kContainerWords);
+      i++;
+    } else if (i >= an || other.keys[j] < bm->keys[i]) {
+      keys.push_back(other.keys[j]);
+      std::memcpy(dst, &other.words[j * kContainerWords], 8 * kContainerWords);
+      j++;
+    } else {  // same key: copy ours, OR theirs in
+      keys.push_back(bm->keys[i]);
+      std::memcpy(dst, &bm->words[i * kContainerWords], 8 * kContainerWords);
+      const uint64_t* src = &other.words[j * kContainerWords];
+      for (int w = 0; w < kContainerWords; w++) dst[w] |= src[w];
+      i++;
+      j++;
+    }
+  }
+  bm->keys.swap(keys);
+  bm->words.swap(words);
+}
+
 bool replay_ops(LoadedBitmap* bm, const uint8_t* data, size_t len, size_t pos) {
   while (pos < len) {
     // A record extending past EOF is a torn tail append (crash mid-write):
@@ -187,7 +269,9 @@ bool replay_ops(LoadedBitmap* bm, const uint8_t* data, size_t len, size_t pos) {
       if (chk != fnv1a32(data + pos, 9)) return fail(bm, "op checksum mismatch");
       if (typ == kOpAdd) bit_add(bm, value); else bit_remove(bm, value);
       bm->op_n += 1;
+      bm->op_n_small += 1;
       pos += 13;
+      bm->ops_bytes += 13;
     } else if (typ == kOpAddBatch || typ == kOpRemoveBatch) {
       // Guard 8*value overflow before computing the record size.
       if (value > (len - pos - 13) / 8) { bm->tail_dropped = len - pos; return true; }
@@ -201,6 +285,23 @@ bool replay_ops(LoadedBitmap* bm, const uint8_t* data, size_t len, size_t pos) {
       }
       bm->op_n += value;
       pos += size;
+      bm->ops_bytes += size;
+    } else if (typ == kOpAddRoaring) {
+      // value = payload byte length; payload = roaring snapshot of the
+      // batch; checksum = crc32 over header+payload (zlib convention).
+      if (value > len - pos - 13) { bm->tail_dropped = len - pos; return true; }
+      size_t size = 13 + value;
+      uint32_t h = crc32_update(0, data + pos, 9);
+      h = crc32_update(h, data + pos + 13, value);
+      if (chk != h) return fail(bm, "op checksum mismatch");
+      LoadedBitmap batch;
+      size_t batch_ops = 0;
+      if (!parse_snapshot(&batch, data + pos + 13, value, &batch_ops))
+        return fail(bm, batch.err);
+      for (uint64_t w : batch.words) bm->op_n += popcount64(w);
+      merge_union(bm, batch);
+      pos += size;
+      bm->ops_bytes += size;
     } else {
       return fail(bm, "invalid op type");
     }
@@ -228,57 +329,9 @@ void drop_empty(LoadedBitmap* bm) {
   bm->words.resize(out * kContainerWords);
 }
 
-}  // namespace
-
-extern "C" {
-
-// ---------------------------------------------------------------- load path
-
-// Parse a full roaring file (snapshot + ops log). Returns an opaque handle,
-// or nullptr on allocation failure; check rb_error() for parse errors (a
-// non-null handle with a non-empty error is a failed parse).
-void* rb_load(const uint8_t* data, uint64_t len) {
-  auto* bm = new (std::nothrow) LoadedBitmap();
-  if (!bm) return nullptr;
-  try {
-    size_t ops_offset = 0;
-    if (parse_snapshot(bm, data, len, &ops_offset)) {
-      if (replay_ops(bm, data, len, ops_offset)) drop_empty(bm);
-    }
-  } catch (const std::bad_alloc&) {
-    // Vector growth during parse/replay must not throw across the C ABI.
-    fail(bm, "out of memory");
-  }
-  return bm;
-}
-
-const char* rb_error(void* h) { return static_cast<LoadedBitmap*>(h)->err; }
-uint64_t rb_container_count(void* h) { return static_cast<LoadedBitmap*>(h)->keys.size(); }
-uint64_t rb_op_count(void* h) { return static_cast<LoadedBitmap*>(h)->op_n; }
-uint64_t rb_tail_dropped(void* h) { return static_cast<LoadedBitmap*>(h)->tail_dropped; }
-
-// Copy out the sorted container keys (caller allocates rb_container_count
-// u64s) and the dense payload (count * 1024 u64s, key-major).
-void rb_copy_out(void* h, uint64_t* keys_out, uint64_t* words_out) {
-  auto* bm = static_cast<LoadedBitmap*>(h);
-  std::memcpy(keys_out, bm->keys.data(), 8 * bm->keys.size());
-  std::memcpy(words_out, bm->words.data(), 8 * bm->words.size());
-}
-
-void rb_free(void* h) { delete static_cast<LoadedBitmap*>(h); }
-
-// --------------------------------------------------------------- save path
-
-// Serialize n dense containers (sorted keys[n], words[n*1024]) into the
-// reference file format, picking the smallest of array/bitmap/run per
-// container (the Optimize rule, roaring.go:1745-1805). `out` must have
-// capacity rb_serialize_cap(n). Returns bytes written, or 0 on bad input.
-uint64_t rb_serialize_cap(uint64_t n) {
-  return kHeaderBaseSize + n * (12 + 4 + 8ull * kContainerWords);
-}
-
-uint64_t rb_serialize(const uint64_t* keys, const uint64_t* words, uint64_t n,
-                      uint8_t* out) {
+template <typename GetContainer>
+static uint64_t serialize_impl(const uint64_t* keys, GetContainer get,
+                               uint64_t n, uint8_t* out) {
   wu16(out, kMagic);
   wu16(out + 2, kVersion);
   wu32(out + 4, static_cast<uint32_t>(n));
@@ -286,7 +339,7 @@ uint64_t rb_serialize(const uint64_t* keys, const uint64_t* words, uint64_t n,
   size_t off_pos = meta_pos + 12ull * n;
   size_t payload = off_pos + 4ull * n;
   for (uint64_t i = 0; i < n; i++) {
-    const uint64_t* dense = words + i * kContainerWords;
+    const uint64_t* dense = get(i);
     // One pass: cardinality + run count (runs = number of 0→1 transitions
     // across the 2^16-bit container, counting bit -1 as 0).
     int card = 0, runs = 0;
@@ -350,6 +403,67 @@ uint64_t rb_serialize(const uint64_t* keys, const uint64_t* words, uint64_t n,
     payload += psize;
   }
   return payload;
+}
+
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------- load path
+
+// Parse a full roaring file (snapshot + ops log). Returns an opaque handle,
+// or nullptr on allocation failure; check rb_error() for parse errors (a
+// non-null handle with a non-empty error is a failed parse).
+void* rb_load(const uint8_t* data, uint64_t len) {
+  auto* bm = new (std::nothrow) LoadedBitmap();
+  if (!bm) return nullptr;
+  try {
+    size_t ops_offset = 0;
+    if (parse_snapshot(bm, data, len, &ops_offset)) {
+      bm->snapshot_bytes = ops_offset;
+      if (replay_ops(bm, data, len, ops_offset)) drop_empty(bm);
+    }
+  } catch (const std::bad_alloc&) {
+    // Vector growth during parse/replay must not throw across the C ABI.
+    fail(bm, "out of memory");
+  }
+  return bm;
+}
+
+const char* rb_error(void* h) { return static_cast<LoadedBitmap*>(h)->err; }
+uint64_t rb_container_count(void* h) { return static_cast<LoadedBitmap*>(h)->keys.size(); }
+uint64_t rb_op_count(void* h) { return static_cast<LoadedBitmap*>(h)->op_n; }
+uint64_t rb_op_small_count(void* h) { return static_cast<LoadedBitmap*>(h)->op_n_small; }
+uint64_t rb_ops_bytes(void* h) { return static_cast<LoadedBitmap*>(h)->ops_bytes; }
+uint64_t rb_snapshot_bytes(void* h) { return static_cast<LoadedBitmap*>(h)->snapshot_bytes; }
+uint64_t rb_tail_dropped(void* h) { return static_cast<LoadedBitmap*>(h)->tail_dropped; }
+
+// Copy out the sorted container keys (caller allocates rb_container_count
+// u64s) and the dense payload (count * 1024 u64s, key-major).
+void rb_copy_out(void* h, uint64_t* keys_out, uint64_t* words_out) {
+  auto* bm = static_cast<LoadedBitmap*>(h);
+  std::memcpy(keys_out, bm->keys.data(), 8 * bm->keys.size());
+  std::memcpy(words_out, bm->words.data(), 8 * bm->words.size());
+}
+
+void rb_free(void* h) { delete static_cast<LoadedBitmap*>(h); }
+
+// --------------------------------------------------------------- save path
+
+// Serialize n dense containers (sorted keys[n], words[n*1024]) into the
+// reference file format, picking the smallest of array/bitmap/run per
+// container (the Optimize rule, roaring.go:1745-1805). `out` must have
+// capacity rb_serialize_cap(n). Returns bytes written, or 0 on bad input.
+uint64_t rb_serialize_cap(uint64_t n) {
+  return kHeaderBaseSize + n * (12 + 4 + 8ull * kContainerWords);
+}
+
+uint64_t rb_serialize(const uint64_t* keys, const uint64_t* words, uint64_t n,
+                      uint8_t* out) {
+  return serialize_impl(
+      keys, [words](uint64_t i) { return words + i * kContainerWords; }, n,
+      out);
 }
 
 // fnv1a32 over a byte buffer, chainable via `seed` (pass 0x811C9DC5 to
@@ -462,6 +576,180 @@ uint64_t pn_dense_positions_ptrs(const uint64_t* const* chunks,
     }
   }
   return cnt;
+}
+
+// crc32 (zlib-compatible, chainable: pass the previous return as `seed`,
+// 0 to start) — the checksum for kOpAddRoaring records.
+uint32_t pn_crc32(const uint8_t* data, uint64_t n, uint32_t seed) {
+  return crc32_update(seed, data, n);
+}
+
+// Per-chunk popcounts (pn_popcount_ptrs gives only the total).
+void pn_popcount_each(const uint64_t* const* chunks, uint64_t n_chunks,
+                      uint64_t words_per_chunk, uint64_t* out) {
+  for (uint64_t c = 0; c < n_chunks; c++) {
+    uint64_t cnt = 0;
+    for (uint64_t w = 0; w < words_per_chunk; w++)
+      cnt += popcount64(chunks[c][w]);
+    out[c] = cnt;
+  }
+}
+
+// ------------------------------------------------------- import fast path
+
+// Fused bulk import (replaces the reference's sort + DirectAddN import
+// shape, fragment.go:1494-1604): ONE native call computes positions
+// row*2^swidth_exp + (col & (2^swidth_exp-1)), scatters them into
+// dense container masks direct-indexed over the [min_row, max_row]
+// container range (no sort, no hashing — lazily-zeroed calloc pages
+// make the range allocation nearly free), popcounts each container,
+// and builds the OP_ADD_ROARING payload (array/bitmap encoding by
+// cardinality; runs are never smaller for import batches and their
+// detection pass isn't worth it on an op record).
+//
+// Accessors: ib_error (non-empty => unsuited batch, caller falls back),
+// ib_count (non-empty containers), ib_nbits (distinct bits),
+// ib_keys_counts(h, keys_out, counts_out), ib_words(h, out[m*1024]),
+// ib_payload_size, ib_payload(h, out), ib_free.
+struct ImportBuild {
+  uint64_t* masks = nullptr;  // full container range, calloc'd
+  uint64_t range = 0, kmin = 0;
+  std::vector<uint64_t> keys;    // non-empty container keys, ascending
+  std::vector<uint64_t> counts;  // cardinality per non-empty container
+  std::vector<uint8_t> payload;  // OP_ADD_ROARING record payload
+  uint64_t nbits = 0;
+  char err[128] = {0};
+  ~ImportBuild() { std::free(masks); }
+};
+
+void* pn_import_build(const uint64_t* rows, const uint64_t* cols,
+                      uint64_t n, uint32_t swidth_exp) {
+  auto* ib = new (std::nothrow) ImportBuild();
+  if (!ib) return nullptr;
+  auto bail = [ib](const char* msg) -> void* {
+    std::snprintf(ib->err, sizeof(ib->err), "%s", msg);
+    return ib;
+  };
+  if (n == 0) return ib;
+  if (swidth_exp < 16) return bail("shard width below container width");
+  try {
+    uint64_t rmin = ~0ull, rmax = 0;
+    for (uint64_t i = 0; i < n; i++) {
+      if (rows[i] < rmin) rmin = rows[i];
+      if (rows[i] > rmax) rmax = rows[i];
+    }
+    const int keys_per_row = 1 << (swidth_exp - 16);
+    // Overflow-safe guards BEFORE any multiply/shift: the row span cap
+    // (8 KiB of mask per container in range, 1 GiB total) and a
+    // position-fits-in-u64 bound on the row ids themselves. Unsuited
+    // batches fall back to the Python grouped path, which stays
+    // O(batch).
+    if (rmax - rmin >= (1ull << 17) / keys_per_row)
+      return bail("row range too wide for dense scatter");
+    if (rmax >= (1ull << (64 - swidth_exp)))
+      return bail("row id too large for 64-bit positions");
+    const uint64_t range = (rmax - rmin + 1) * keys_per_row;
+    ib->masks = static_cast<uint64_t*>(
+        std::calloc(range * kContainerWords, 8));
+    if (!ib->masks) return bail("out of memory");
+    ib->range = range;
+    ib->kmin = (rmin << swidth_exp) >> 16;
+    const uint64_t col_mask = (1ull << swidth_exp) - 1;
+    // The masks block is the contiguous bit space from row rmin: flat
+    // word index of position p (relative to rmin's base) is simply
+    // p>>6, because containers are 1024 contiguous words each.
+    for (uint64_t i = 0; i < n; i++) {
+      uint64_t p = ((rows[i] - rmin) << swidth_exp) + (cols[i] & col_mask);
+      ib->masks[(p >> 6)] |= 1ull << (p & 63);
+    }
+    // Count pass: cardinality per container, non-empty keys.
+    for (uint64_t k = 0; k < range; k++) {
+      const uint64_t* c = ib->masks + k * kContainerWords;
+      uint64_t cnt = 0;
+      for (int w = 0; w < kContainerWords; w++) cnt += popcount64(c[w]);
+      if (cnt) {
+        ib->keys.push_back(ib->kmin + k);
+        ib->counts.push_back(cnt);
+        ib->nbits += cnt;
+      }
+    }
+    // Payload build.
+    const uint64_t m = ib->keys.size();
+    size_t psize = kHeaderBaseSize + m * 16;
+    for (uint64_t i = 0; i < m; i++)
+      psize += ib->counts[i] < 4096 ? 2 * ib->counts[i] : 8192;
+    ib->payload.resize(psize);
+    uint8_t* out = ib->payload.data();
+    wu16(out, kMagic);
+    wu16(out + 2, kVersion);
+    wu32(out + 4, static_cast<uint32_t>(m));
+    size_t meta_pos = kHeaderBaseSize;
+    size_t off_pos = meta_pos + 12 * m;
+    size_t payload_at = off_pos + 4 * m;
+    for (uint64_t i = 0; i < m; i++) {
+      const uint64_t* c = ib->masks + (ib->keys[i] - ib->kmin) * kContainerWords;
+      uint64_t card = ib->counts[i];
+      uint16_t typ = card < 4096 ? kTypeArray : kTypeBitmap;
+      wu64(out + meta_pos + 12 * i, ib->keys[i]);
+      wu16(out + meta_pos + 12 * i + 8, typ);
+      wu16(out + meta_pos + 12 * i + 10, static_cast<uint16_t>(card - 1));
+      wu32(out + off_pos + 4 * i, static_cast<uint32_t>(payload_at));
+      uint8_t* p = out + payload_at;
+      if (typ == kTypeBitmap) {
+        std::memcpy(p, c, 8192);
+        payload_at += 8192;
+      } else {
+        size_t j = 0;
+        for (int w = 0; w < kContainerWords; w++) {
+          uint64_t x = c[w];
+          while (x) {
+            wu16(p + 2 * j++, static_cast<uint16_t>((w << 6) | __builtin_ctzll(x)));
+            x &= x - 1;
+          }
+        }
+        payload_at += 2 * card;
+      }
+    }
+  } catch (const std::bad_alloc&) {
+    return bail("out of memory");
+  }
+  return ib;
+}
+
+const char* ib_error(void* h) { return static_cast<ImportBuild*>(h)->err; }
+uint64_t ib_count(void* h) { return static_cast<ImportBuild*>(h)->keys.size(); }
+uint64_t ib_nbits(void* h) { return static_cast<ImportBuild*>(h)->nbits; }
+uint64_t ib_payload_size(void* h) { return static_cast<ImportBuild*>(h)->payload.size(); }
+
+void ib_keys_counts(void* h, uint64_t* keys_out, uint64_t* counts_out) {
+  auto* ib = static_cast<ImportBuild*>(h);
+  std::memcpy(keys_out, ib->keys.data(), 8 * ib->keys.size());
+  std::memcpy(counts_out, ib->counts.data(), 8 * ib->counts.size());
+}
+
+void ib_words(void* h, uint64_t* out) {
+  auto* ib = static_cast<ImportBuild*>(h);
+  for (size_t i = 0; i < ib->keys.size(); i++)
+    std::memcpy(out + i * kContainerWords,
+                ib->masks + (ib->keys[i] - ib->kmin) * kContainerWords,
+                8 * kContainerWords);
+}
+
+void ib_payload(void* h, uint8_t* out) {
+  auto* ib = static_cast<ImportBuild*>(h);
+  std::memcpy(out, ib->payload.data(), ib->payload.size());
+}
+
+void ib_free(void* h) { delete static_cast<ImportBuild*>(h); }
+
+// Serialize from independently-allocated dense containers (pointer per
+// container) — the snapshot path without np.stack's copy. Same output as
+// rb_serialize.
+uint64_t rb_serialize_ptrs(const uint64_t* keys,
+                           const uint64_t* const* words_ptrs, uint64_t n,
+                           uint8_t* out) {
+  return serialize_impl(
+      keys, [words_ptrs](uint64_t i) { return words_ptrs[i]; }, n, out);
 }
 
 }  // extern "C"

@@ -34,8 +34,30 @@ from pilosa_tpu.storage.roaring import Bitmap, CONTAINER_BITS
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.utils.logger import default_logger
 
-# Snapshot after this many logged ops (reference MaxOpN, fragment.go:79).
+# Snapshot after this many logged single-bit ops (reference MaxOpN,
+# fragment.go:79).
 DEFAULT_MAX_OP_N = 10000
+
+# Batch import records (compact roaring payloads) fold into a snapshot by
+# SIZE, not count: snapshot when the op-log tail since the last snapshot
+# exceeds max(this floor, half the last snapshot's size). Divergence from
+# the reference, which snapshots after every >MaxOpN-bit import
+# (fragment.go:1769) — an O(fragment) rewrite per batch that made ingest
+# the bottleneck; the byte-based rule keeps reopen replay O(snapshot
+# size) while amortizing rewrites across many batches.
+OPLOG_FOLD_MIN_BYTES = 32 << 20
+
+# Torn-tail tolerance bound (ADVICE r2): a dangling tail larger than any
+# plausible single record is mid-file corruption, not a torn append —
+# refuse to open rather than silently sidecar a huge valid suffix.
+# bulk_import chunks batches at IMPORT_CHUNK_PAIRS, which bounds a
+# single OP_ADD_ROARING record payload well under this.
+MAX_TORN_TAIL_BYTES = 64 << 20
+
+# Bulk imports are split into chunks of this many (row, col) pairs: caps
+# a single op record (so MAX_TORN_TAIL_BYTES really does exceed any
+# legitimate record) and bounds the scatter's peak working memory.
+IMPORT_CHUNK_PAIRS = 4 << 20
 
 # Containers per shard row: 2^20 / 2^16.
 CONTAINERS_PER_ROW = SHARD_WIDTH // CONTAINER_BITS
@@ -57,6 +79,12 @@ class Fragment:
         self.shard = shard
         self.max_op_n = max_op_n
         self.storage = Bitmap()
+        # Size of the last on-disk snapshot section; drives the
+        # byte-based op-log fold policy for batch imports.
+        self._last_snapshot_bytes = 0
+        # Cumulative torn-tail bytes sidecarred at open (ADVICE r2:
+        # surfaced through holder stats/health, not just a log line).
+        self.tail_dropped_bytes = 0
         self.cache = cache_mod.new_cache(cache_type, cache_size)
         self.cache_type = cache_type
         self._file = None
@@ -80,16 +108,28 @@ class Fragment:
                     data = f.read()
                 if data:
                     self.storage.read_bytes(data, tolerate_torn_tail=True)
+                    if self.storage.tail_dropped > MAX_TORN_TAIL_BYTES:
+                        # A dangling "record" bigger than any plausible
+                        # single append is a corrupted mid-file length
+                        # field swallowing a valid suffix — fail hard
+                        # like the reference (roaring.go:3659) instead
+                        # of silently sidecarring megabytes of data
+                        # (ADVICE r2).
+                        raise ValueError(
+                            f"{self.path}: {self.storage.tail_dropped}"
+                            "-byte dangling op tail exceeds the torn-"
+                            "append bound; refusing to truncate")
                     if self.storage.tail_dropped:
                         # Torn tail append from a crash: move the partial
                         # record to a .torn sidecar (never destroy bytes —
-                        # a corrupted batch-length field is classified the
-                        # same way and the tail may hold valid ops an
-                        # operator can salvage), then truncate so new
-                        # appends start at a clean boundary. Divergence:
-                        # the reference refuses to open on any op error
-                        # (op.UnmarshalBinary roaring.go:3659).
+                        # the tail may hold salvageable ops), then
+                        # truncate so new appends start at a clean
+                        # boundary. Divergence: the reference refuses to
+                        # open on any op error (roaring.go:3659). The
+                        # drop is surfaced via tail_dropped_bytes for
+                        # stats/health, not just this log line.
                         nd = self.storage.tail_dropped
+                        self.tail_dropped_bytes += nd
                         default_logger.printf(
                             "%s: moving %d-byte torn op-log tail to "
                             "sidecar", self.path, nd)
@@ -100,13 +140,16 @@ class Fragment:
             else:
                 os.makedirs(os.path.dirname(self.path), exist_ok=True)
                 with open(self.path, "wb") as f:
-                    f.write(self.storage.write_bytes())
+                    data = self.storage.write_bytes()
+                    f.write(data)
+                self.storage.snapshot_bytes = len(data)
+            self._last_snapshot_bytes = self.storage.snapshot_bytes
             self._file = open(self.path, "ab")
             self.storage.op_writer = self._file
             cache_mod.load_cache(self.cache, self.cache_path(),
                                  stamp=self._storage_stamp())
-            # If the op log had grown past the limit, fold it into a snapshot.
-            if self.storage.op_n >= self.max_op_n:
+            # If the op log had grown past either limit, fold it now.
+            if self._oplog_over_limit():
                 self._snapshot()
             # Replay may have materialized containers the snapshot stored
             # as arrays; re-compress sparse ones (reference Optimize,
@@ -178,6 +221,10 @@ class Fragment:
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
             self.storage.op_n = 0
+            self.storage.op_n_small = 0
+            self.storage.oplog_bytes = 0
+            self._last_snapshot_bytes = os.path.getsize(self.path)
+            self.storage.snapshot_bytes = self._last_snapshot_bytes
         finally:
             # Restore the append handle even on failure: the old file is
             # still in place and later op appends — including
@@ -186,8 +233,19 @@ class Fragment:
             self._file = open(self.path, "ab")
             self.storage.op_writer = self._file
 
+    def _oplog_over_limit(self) -> bool:
+        """Snapshot policy: single-bit ops by COUNT (reference MaxOpN
+        semantics, fragment.go:79), batch records by op-log BYTES
+        relative to the snapshot size (amortized O(1) per imported bit;
+        see OPLOG_FOLD_MIN_BYTES)."""
+        s = self.storage
+        if s.op_n_small >= self.max_op_n:
+            return True
+        return s.oplog_bytes >= max(OPLOG_FOLD_MIN_BYTES,
+                                    self._last_snapshot_bytes // 2)
+
     def _maybe_snapshot(self) -> None:
-        if self.storage.op_n >= self.max_op_n:
+        if self._oplog_over_limit():
             self._snapshot()
 
     # -- position helpers ---------------------------------------------------
@@ -451,48 +509,29 @@ class Fragment:
     def bulk_import(self, row_ids: np.ndarray, column_ids: np.ndarray,
                     clear: bool = False) -> None:
         """Bulk bit import (reference bulkImportStandard → importPositions,
-        fragment.go:1508-1604): one batched bitmap op + one batch op-log
-        record, then per-row cache refresh and snapshot check."""
+        fragment.go:1508-1604): the fused storage scatter builds
+        per-container masks without sorting, appends ONE compact
+        roaring-payload op record, and merges — then per-row cache
+        refresh and the amortized snapshot check (_oplog_over_limit)."""
         row_ids = np.asarray(row_ids, dtype=np.uint64)
         column_ids = np.asarray(column_ids, dtype=np.uint64)
-        positions = (row_ids * np.uint64(SHARD_WIDTH)
-                     + (column_ids % np.uint64(SHARD_WIDTH)))
-        # Sort+dedup ONCE; the storage layer and the touched-row scan
-        # both reuse it (direct_add_n would otherwise re-unique, and
-        # np.unique(row_ids) would re-sort 8 bytes/bit).
-        positions = np.unique(positions)
         with self._lock:
             if clear:
+                positions = np.unique(
+                    row_ids * np.uint64(SHARD_WIDTH)
+                    + (column_ids % np.uint64(SHARD_WIDTH)))
                 self.storage.remove_batch(positions)
+                touched = np.unique(positions >> np.uint64(SHARD_WIDTH_EXP))
             else:
-                # A batch that immediately triggers the synchronous
-                # snapshot below would have its op-log record rewritten
-                # away before bulk_import returns — skip the redundant
-                # multi-MB append (same process-crash durability: a
-                # crash mid-import loses the in-flight batch under
-                # either scheme, as a torn/absent record).
-                will_snapshot = (self.storage.op_n + len(positions)
-                                 >= self.max_op_n)
-                self.storage.add_batch(positions, presorted=True,
-                                       log_op=not will_snapshot)
-                if will_snapshot:
-                    # Snapshot NOW, before any other work can raise: with
-                    # the op record skipped, the synchronous snapshot IS
-                    # the batch's durability. If it fails, append the
-                    # record after all so a clean close still persists
-                    # the batch.
-                    try:
-                        self._snapshot()
-                    except BaseException:
-                        self.storage.append_batch_record(positions)
-                        raise
-            rows_sorted = positions >> np.uint64(SHARD_WIDTH_EXP)
-            if len(rows_sorted):
-                keep = np.concatenate(
-                    ([True], rows_sorted[1:] != rows_sorted[:-1]))
-                touched = rows_sorted[keep]
-            else:
-                touched = rows_sorted
+                key_chunks = [
+                    self.storage.import_batch(
+                        row_ids[i:i + IMPORT_CHUNK_PAIRS],
+                        column_ids[i:i + IMPORT_CHUNK_PAIRS],
+                        SHARD_WIDTH_EXP)
+                    for i in range(0, len(row_ids), IMPORT_CHUNK_PAIRS)]
+                keys = (np.concatenate(key_chunks) if len(key_chunks) > 1
+                        else key_chunks[0])
+                touched = np.unique(keys // np.uint64(CONTAINERS_PER_ROW))
             for r in touched.tolist():
                 self._touch_row(int(r))
                 if self.cache_type != cache_mod.CACHE_TYPE_NONE:

@@ -56,6 +56,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rb_container_count.restype = u64
     lib.rb_op_count.argtypes = [ctypes.c_void_p]
     lib.rb_op_count.restype = u64
+    lib.rb_op_small_count.argtypes = [ctypes.c_void_p]
+    lib.rb_op_small_count.restype = u64
+    lib.rb_ops_bytes.argtypes = [ctypes.c_void_p]
+    lib.rb_ops_bytes.restype = u64
+    lib.rb_snapshot_bytes.argtypes = [ctypes.c_void_p]
+    lib.rb_snapshot_bytes.restype = u64
     lib.rb_tail_dropped.argtypes = [ctypes.c_void_p]
     lib.rb_tail_dropped.restype = u64
     lib.rb_copy_out.argtypes = [ctypes.c_void_p, p_u64, p_u64]
@@ -64,6 +70,25 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rb_serialize_cap.restype = u64
     lib.rb_serialize.argtypes = [p_u64, p_u64, u64, p_u8]
     lib.rb_serialize.restype = u64
+    lib.rb_serialize_ptrs.argtypes = [p_u64, p_u64, u64, p_u8]
+    lib.rb_serialize_ptrs.restype = u64
+    lib.pn_crc32.argtypes = [p_u8, u64, ctypes.c_uint32]
+    lib.pn_crc32.restype = ctypes.c_uint32
+    lib.pn_popcount_each.argtypes = [p_u64, u64, u64, p_u64]
+    lib.pn_import_build.argtypes = [p_u64, p_u64, u64, ctypes.c_uint32]
+    lib.pn_import_build.restype = ctypes.c_void_p
+    lib.ib_error.argtypes = [ctypes.c_void_p]
+    lib.ib_error.restype = ctypes.c_char_p
+    lib.ib_count.argtypes = [ctypes.c_void_p]
+    lib.ib_count.restype = u64
+    lib.ib_nbits.argtypes = [ctypes.c_void_p]
+    lib.ib_nbits.restype = u64
+    lib.ib_payload_size.argtypes = [ctypes.c_void_p]
+    lib.ib_payload_size.restype = u64
+    lib.ib_keys_counts.argtypes = [ctypes.c_void_p, p_u64, p_u64]
+    lib.ib_words.argtypes = [ctypes.c_void_p, p_u64]
+    lib.ib_payload.argtypes = [ctypes.c_void_p, p_u8]
+    lib.ib_free.argtypes = [ctypes.c_void_p]
     lib.pn_fnv1a32.argtypes = [p_u8, u64, ctypes.c_uint32]
     lib.pn_fnv1a32.restype = ctypes.c_uint32
     lib.pn_popcount.argtypes = [p_u64, u64]
@@ -133,6 +158,16 @@ def roaring_load(data: bytes
     unavailable. Raises NativeParseError on malformed input (same
     conditions as the Python reader; a truncated FINAL op is tolerated
     and reported via the last tuple element instead)."""
+    ex = roaring_load_ex(data)
+    if ex is None:
+        return None
+    return ex["keys"], ex["words"], ex["op_n"], ex["tail_dropped"]
+
+
+def roaring_load_ex(data: bytes) -> Optional[dict]:
+    """roaring_load plus the op-log accounting the snapshot policy needs:
+    {keys, words, op_n, op_n_small, ops_bytes, snapshot_bytes,
+    tail_dropped}. None when unavailable."""
     lib = load()
     if lib is None:
         return None
@@ -149,8 +184,15 @@ def roaring_load(data: bytes
         words = np.empty((n, CONTAINER_WORDS), dtype=np.uint64)
         if n:
             lib.rb_copy_out(h, _as_u64_ptr(keys), _as_u64_ptr(words))
-        return ([int(k) for k in keys], words, int(lib.rb_op_count(h)),
-                int(lib.rb_tail_dropped(h)))
+        return {
+            "keys": [int(k) for k in keys],
+            "words": words,
+            "op_n": int(lib.rb_op_count(h)),
+            "op_n_small": int(lib.rb_op_small_count(h)),
+            "ops_bytes": int(lib.rb_ops_bytes(h)),
+            "snapshot_bytes": int(lib.rb_snapshot_bytes(h)),
+            "tail_dropped": int(lib.rb_tail_dropped(h)),
+        }
     finally:
         lib.rb_free(h)
 
@@ -164,13 +206,87 @@ def roaring_serialize(keys: np.ndarray, words: np.ndarray) -> Optional[bytes]:
     n = len(keys)
     keys = np.ascontiguousarray(keys, dtype=np.uint64)
     words = np.ascontiguousarray(words, dtype=np.uint64)
-    cap = lib.rb_serialize_cap(n)
-    out = (ctypes.c_uint8 * cap)()
+    # numpy buffer + one slicing copy out — (ctypes array; bytearray(out))
+    # copied the full worst-case capacity twice and dominated snapshot
+    # time for large fragments.
+    out = np.empty(int(lib.rb_serialize_cap(n)), dtype=np.uint8)
     size = lib.rb_serialize(_as_u64_ptr(keys), _as_u64_ptr(words), n,
-                            _as_u8_ptr(out))
+                            out.ctypes.data_as(
+                                ctypes.POINTER(ctypes.c_uint8)))
     if size == 0 and n > 0:
         raise ValueError("rb_serialize: empty container passed")
-    return bytes(bytearray(out)[:size])
+    return out[:size].tobytes()
+
+
+def roaring_serialize_ptrs(keys: np.ndarray, containers) -> Optional[bytes]:
+    """Like roaring_serialize but over independently-allocated dense
+    containers (a list of uint64[1024] arrays) — no stacking copy."""
+    lib = load()
+    if lib is None:
+        return None
+    n = len(keys)
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    addrs = np.fromiter(
+        (c.__array_interface__["data"][0] for c in containers),
+        dtype=np.uint64, count=n)
+    out = np.empty(int(lib.rb_serialize_cap(n)), dtype=np.uint8)
+    size = lib.rb_serialize_ptrs(_as_u64_ptr(keys), _as_u64_ptr(addrs), n,
+                                 out.ctypes.data_as(
+                                     ctypes.POINTER(ctypes.c_uint8)))
+    if size == 0 and n > 0:
+        raise ValueError("rb_serialize_ptrs: empty container passed")
+    return out[:size].tobytes()
+
+
+def import_build(row_ids: np.ndarray, col_ids: np.ndarray,
+                 swidth_exp: int):
+    """Fused bulk import build: positions = row*2^swidth_exp +
+    (col mod 2^swidth_exp) scattered into dense container masks (no
+    sort), popcounted, and pre-serialized as an OP_ADD_ROARING payload
+    — one native call. Returns (keys uint64[m] sorted,
+    words uint64[m, 1024], counts uint64[m], payload bytes, n_bits) or
+    None when unavailable / the batch's row range is unsuited to dense
+    scatter (caller falls back to the grouped numpy path)."""
+    lib = load()
+    if lib is None:
+        return None
+    row_ids = np.ascontiguousarray(row_ids, dtype=np.uint64)
+    col_ids = np.ascontiguousarray(col_ids, dtype=np.uint64)
+    h = lib.pn_import_build(_as_u64_ptr(row_ids), _as_u64_ptr(col_ids),
+                            len(row_ids), swidth_exp)
+    if not h:
+        raise MemoryError("pn_import_build allocation failed")
+    try:
+        if lib.ib_error(h):
+            return None
+        m = int(lib.ib_count(h))
+        keys = np.empty(m, dtype=np.uint64)
+        counts = np.empty(m, dtype=np.uint64)
+        words = np.empty((m, CONTAINER_WORDS), dtype=np.uint64)
+        payload = np.empty(int(lib.ib_payload_size(h)), dtype=np.uint8)
+        if m:
+            lib.ib_keys_counts(h, _as_u64_ptr(keys), _as_u64_ptr(counts))
+            lib.ib_words(h, _as_u64_ptr(words))
+            lib.ib_payload(h, payload.ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8)))
+        return keys, words, counts, payload.tobytes(), int(lib.ib_nbits(h))
+    finally:
+        lib.ib_free(h)
+
+
+def popcount_each(containers) -> Optional[np.ndarray]:
+    """Per-container popcounts over independently-allocated dense
+    containers (uint64, equal length). None when unavailable."""
+    lib = load()
+    if lib is None or not containers:
+        return None if lib is None else np.empty(0, dtype=np.uint64)
+    addrs = np.fromiter(
+        (c.__array_interface__["data"][0] for c in containers),
+        dtype=np.uint64, count=len(containers))
+    out = np.empty(len(containers), dtype=np.uint64)
+    lib.pn_popcount_each(_as_u64_ptr(addrs), len(containers),
+                         containers[0].size, _as_u64_ptr(out))
+    return out
 
 
 def fnv1a32(chunks, seed: int = 0x811C9DC5) -> Optional[int]:

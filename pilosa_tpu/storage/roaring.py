@@ -18,6 +18,13 @@ writer/reader /root/reference/roaring/roaring.go:963-1126):
                   byte type (0 add, 1 remove, 2 addBatch, 3 removeBatch)
                   uint64 value-or-count, uint32 fnv1a checksum,
                   batch ops: count x uint64 values
+                Extension type 4 (addRoaring; NOT in the reference's
+                format — reference-written files never contain it, so
+                read compatibility is unaffected): uint64 payload byte
+                length, uint32 zlib-crc32 over header+payload, then a
+                self-contained roaring snapshot of the batch. ~2 bytes
+                per sparse bit vs 8 for addBatch, and crc32 streams at
+                GB/s where byte-serial fnv1a was the import bottleneck.
 
 In-memory representation: every non-empty container is held *dense* as
 uint64[1024] in a dict keyed by the 48-bit container key. Dense-only is a
@@ -57,6 +64,7 @@ OP_ADD = 0
 OP_REMOVE = 1
 OP_ADD_BATCH = 2
 OP_REMOVE_BATCH = 3
+OP_ADD_ROARING = 4  # extension: roaring-snapshot payload, crc32 checksum
 
 _FNV_OFFSET = 0x811C9DC5
 _FNV_PRIME = 0x01000193
@@ -166,6 +174,7 @@ class Bitmap:
     """
 
     __slots__ = ("containers", "_counts", "op_writer", "op_n",
+                 "op_n_small", "oplog_bytes", "snapshot_bytes",
                  "tail_dropped")
 
     def __init__(self, positions: Optional[Iterable[int]] = None):
@@ -173,6 +182,9 @@ class Bitmap:
         self._counts: Dict[int, int] = {}
         self.op_writer: Optional[io.RawIOBase] = None
         self.op_n = 0
+        self.op_n_small = 0   # single-bit op records (types 0/1) only
+        self.oplog_bytes = 0  # bytes of op records (replayed + appended)
+        self.snapshot_bytes = 0  # size of the snapshot section on read
         self.tail_dropped = 0  # torn-tail bytes discarded by read_bytes
         if positions is not None:
             self.direct_add_n(np.asarray(list(positions), dtype=np.uint64))
@@ -436,6 +448,94 @@ class Bitmap:
             self._write_op(OP_REMOVE_BATCH, values=np.asarray(positions, dtype=np.uint64))
         return n
 
+    def import_batch(self, row_ids: np.ndarray, col_ids: np.ndarray,
+                     swidth_exp: int) -> np.ndarray:
+        """Fused bulk import (replaces the reference's bulkImportStandard
+        sort + DirectAddN shape, fragment.go:1494-1604): scatter
+        (row, col) pairs into dense per-container masks WITHOUT sorting
+        (native radix bucket; numpy unique-group fallback), append ONE
+        compact OP_ADD_ROARING record whose payload is the batch's own
+        roaring snapshot, then merge the masks in. Returns the sorted
+        touched container keys. Duplicates within the batch are
+        harmless (mask OR)."""
+        row_ids = np.ascontiguousarray(row_ids, dtype=np.uint64)
+        col_ids = np.ascontiguousarray(col_ids, dtype=np.uint64)
+        if len(row_ids) == 0:
+            return np.empty(0, dtype=np.uint64)
+        nat = None
+        if native.available():
+            nat = native.import_build(row_ids, col_ids, swidth_exp)
+        if nat is not None:
+            keys, masks, counts, payload, n_bits = nat
+            self._append_roaring_record(payload, n_bits)
+            # Merge. Rows of `masks` are views into one freshly-allocated
+            # block no one else holds, so when most keys are NEW the
+            # containers adopt the views copy-free; when most keys
+            # already exist, adopted rows are copied instead so a few
+            # survivors don't pin the whole m x 8 KiB parent alive.
+            key_list = [int(k) for k in keys.tolist()]
+            n_new = sum(1 for k in key_list if k not in self.containers)
+            adopt_views = n_new * 2 >= len(key_list)
+            count_list = counts.tolist()
+            for i, key in enumerate(key_list):
+                if key not in self.containers:
+                    self.containers[key] = (masks[i] if adopt_views
+                                            else masks[i].copy())
+                    # Batch cardinality is exact for a fresh container —
+                    # seed the count cache instead of re-popcounting on
+                    # the row_count pass that follows every import.
+                    self._counts[key] = int(count_list[i])
+                else:
+                    c = self._container(key)
+                    c |= masks[i]
+                    self._invalidate(key)
+            return keys
+        # Grouped numpy path (no native library, or a batch shape unsuited
+        # to dense scatter): sort+unique once, then work per group as
+        # sorted-u16 arrays — no dense mask block, so a pathologically
+        # sparse batch (a bit per container) stays O(batch) in memory.
+        positions = np.unique(
+            (row_ids << np.uint64(swidth_exp))
+            + (col_ids & np.uint64((1 << swidth_exp) - 1)))
+        gkeys = (positions >> np.uint64(16)).astype(np.int64)
+        starts = np.concatenate(
+            ([0], np.flatnonzero(gkeys[1:] != gkeys[:-1]) + 1))
+        bounds = np.append(starts, len(positions))
+        keys = positions[starts] >> np.uint64(16)
+        key_list = [int(k) for k in keys.tolist()]
+        groups = [
+            (positions[bounds[i]:bounds[i + 1]]
+             & np.uint64(0xFFFF)).astype(np.uint16)
+            for i in range(len(starts))]
+        payload = _serialize_container_seq(
+            ((k, g, len(g)) for k, g in zip(key_list, groups)),
+            len(key_list))
+        self._append_roaring_record(payload, len(positions))
+        for k, g in zip(key_list, groups):
+            if k not in self.containers:
+                if len(g) <= ARRAY_MAX_SIZE:
+                    # Sorted unique in-container positions — a valid
+                    # array-encoded container as-is.
+                    self.containers[k] = g
+                else:
+                    # Above the array bound the u16 encoding costs up
+                    # to 16x a dense container — keep the invariant.
+                    self.containers[k] = _low_mask(g.astype(np.uint32))
+            else:
+                c = self._container(k)
+                c |= _low_mask(g.astype(np.uint32))
+            self._invalidate(k)
+        return keys
+
+    def _append_roaring_record(self, payload: bytes, n_bits: int) -> None:
+        """Append an OP_ADD_ROARING record for an already-built batch
+        payload; bumps the op accounting the snapshot policy reads."""
+        rec = encode_op_roaring(payload)
+        self.op_n += n_bits
+        self.oplog_bytes += len(rec)
+        if self.op_writer is not None:
+            self.op_writer.write(rec)
+
     # -- queries ------------------------------------------------------------
 
     def count(self) -> int:
@@ -686,6 +786,11 @@ class Bitmap:
 
     def _write_op(self, typ: int, value: int = 0, values: Optional[np.ndarray] = None):
         self.op_n += 1 if values is None else len(values)
+        if values is None:
+            self.op_n_small += 1
+        # Record length is closed-form — don't encode (fnv over the
+        # whole payload) just for accounting when nothing is logging.
+        self.oplog_bytes += 13 if values is None else 13 + 8 * len(values)
         if self.op_writer is None:
             return
         self.op_writer.write(encode_op(typ, value, values))
@@ -694,52 +799,26 @@ class Bitmap:
 
     def write_bytes(self) -> bytes:
         """Serialize in the reference's file format (roaring.go:963).
-        Uses the native C++ codec (native/pilosa_native.cpp rb_serialize)
-        when available; the Python path below is the reference semantics
-        and produces byte-identical output."""
+        Uses the native C++ codec (native/pilosa_native.cpp
+        rb_serialize_ptrs — per-container pointers, no stacking copy)
+        when available; the Python path is the reference semantics and
+        produces byte-identical output."""
         keys = [k for k in sorted(self.containers) if self.container_count(k) > 0]
-        if native.available() and not any(
-                self.containers[k].dtype == np.uint16 for k in keys):
-            # Native fast path needs a dense stack; with array-encoded
-            # containers present, the Python path below serializes them
-            # without materializing everything dense at once.
-            nk = np.array(keys, dtype=np.uint64)
-            nw = (np.stack([self.containers[k] for k in keys])
-                  if keys else np.empty((0, CONTAINER_WORDS), dtype=np.uint64))
-            out = native.roaring_serialize(nk, nw)
+        n_u16 = sum(1 for k in keys
+                    if self.containers[k].dtype == np.uint16)
+        # The native path needs dense temps for array-encoded
+        # containers; cap their footprint so an all-sparse
+        # million-container bitmap doesn't materialize gigabytes at
+        # once (the Python path streams one temp at a time).
+        if native.available() and n_u16 * 8 * CONTAINER_WORDS <= (256 << 20):
+            dense = [_as_dense(self.containers[k]) for k in keys]
+            out = native.roaring_serialize_ptrs(
+                np.array(keys, dtype=np.uint64), dense)
             if out is not None:
                 return out
-        n = len(keys)
-        header = io.BytesIO()
-        header.write(struct.pack("<II", COOKIE, n))
-        payloads: List[bytes] = []
-        for key in keys:
-            dense = _as_dense(self.containers[key])  # 8 KiB temp at most
-            card = self.container_count(key)
-            runs = _dense_to_runs(dense)
-            # Pick smallest encoding: sizes are 2*card (array),
-            # 8192 (bitmap), 2 + 4*n_runs (run) — the Optimize rule,
-            # roaring.go:1745-1805.
-            run_size = RUN_COUNT_HEADER_SIZE + 4 * len(runs)
-            array_size = 2 * card
-            if run_size < min(array_size, 8192):
-                typ = CONTAINER_RUN
-                payloads.append(
-                    struct.pack("<H", len(runs))
-                    + runs.astype("<u2").tobytes()
-                )
-            elif array_size < 8192:
-                typ = CONTAINER_ARRAY
-                payloads.append(_dense_to_array(dense).astype("<u2").tobytes())
-            else:
-                typ = CONTAINER_BITMAP
-                payloads.append(dense.astype("<u8").tobytes())
-            header.write(struct.pack("<QHH", key, typ, card - 1))
-        offset = HEADER_BASE_SIZE + n * 12 + n * 4
-        for p in payloads:
-            header.write(struct.pack("<I", offset))
-            offset += len(p)
-        return header.getvalue() + b"".join(payloads)
+        return _serialize_container_seq(
+            ((key, self.containers[key], self.container_count(key))
+             for key in keys), len(keys))
 
     @classmethod
     def from_bytes(cls, data: bytes,
@@ -759,17 +838,21 @@ class Bitmap:
         must error, not silently half-apply)."""
         self.tail_dropped = 0
         if native.available():
-            loaded = native.roaring_load(bytes(data))
+            loaded = native.roaring_load_ex(bytes(data))
             if loaded is not None:
-                keys, words, op_n, tail_dropped = loaded
-                if tail_dropped and not tolerate_torn_tail:
+                if loaded["tail_dropped"] and not tolerate_torn_tail:
                     raise OpTruncatedError(
-                        f"op data truncated ({tail_dropped} tail bytes)")
+                        f"op data truncated ({loaded['tail_dropped']} "
+                        "tail bytes)")
+                words = loaded["words"]
                 self.containers = {k: words[i].copy()
-                                   for i, k in enumerate(keys)}
+                                   for i, k in enumerate(loaded["keys"])}
                 self._counts = {}
-                self.op_n = op_n
-                self.tail_dropped = tail_dropped
+                self.op_n = loaded["op_n"]
+                self.op_n_small = loaded["op_n_small"]
+                self.oplog_bytes = loaded["ops_bytes"]
+                self.snapshot_bytes = loaded["snapshot_bytes"]
+                self.tail_dropped = loaded["tail_dropped"]
                 return
         if len(data) < HEADER_BASE_SIZE:
             raise ValueError("data too small")
@@ -829,6 +912,9 @@ class Bitmap:
         # mismatches on complete records still raise (data corruption;
         # reference fails on both, op.UnmarshalBinary roaring.go:3659).
         self.op_n = 0
+        self.op_n_small = 0
+        self.oplog_bytes = 0
+        self.snapshot_bytes = ops_offset
         buf = memoryview(data)[ops_offset:]
         while len(buf):
             try:
@@ -841,16 +927,65 @@ class Bitmap:
             if op_typ == OP_ADD:
                 self._direct_add(value)
                 self.op_n += 1
+                self.op_n_small += 1
             elif op_typ == OP_REMOVE:
                 self._direct_remove(value)
                 self.op_n += 1
+                self.op_n_small += 1
             elif op_typ == OP_ADD_BATCH:
                 self.direct_add_n(values)
                 self.op_n += len(values)
             elif op_typ == OP_REMOVE_BATCH:
                 self.direct_remove_n(values)
                 self.op_n += len(values)
+            elif op_typ == OP_ADD_ROARING:
+                batch = Bitmap.from_bytes(values)
+                self.op_n += batch.count()
+                self.union_in_place(batch)
+            self.oplog_bytes += size
             buf = buf[size:]
+
+
+def _serialize_container_seq(items, n: int) -> bytes:
+    """Serialize (key, container, count) triples — sorted, non-empty —
+    to the file format, one dense temp at a time (the Python writer
+    shared by write_bytes and the import-batch fallback). Encoding
+    choice mirrors Optimize, roaring.go:1745-1805."""
+    header = io.BytesIO()
+    header.write(struct.pack("<II", COOKIE, n))
+    payloads: List[bytes] = []
+    for key, c, card in items:
+        dense = _as_dense(c)  # 8 KiB temp at most
+        runs = _dense_to_runs(dense)
+        run_size = RUN_COUNT_HEADER_SIZE + 4 * len(runs)
+        array_size = 2 * card
+        if run_size < min(array_size, 8192):
+            typ = CONTAINER_RUN
+            payloads.append(
+                struct.pack("<H", len(runs)) + runs.astype("<u2").tobytes())
+        elif array_size < 8192:
+            typ = CONTAINER_ARRAY
+            payloads.append(_dense_to_array(dense).astype("<u2").tobytes())
+        else:
+            typ = CONTAINER_BITMAP
+            payloads.append(dense.astype("<u8").tobytes())
+        header.write(struct.pack("<QHH", int(key), typ, card - 1))
+    offset = HEADER_BASE_SIZE + n * 12 + n * 4
+    for p in payloads:
+        header.write(struct.pack("<I", offset))
+        offset += len(p)
+    return header.getvalue() + b"".join(payloads)
+
+
+def _serialize_keys_words(keys: np.ndarray, words: np.ndarray) -> bytes:
+    """Serialize sorted dense (keys, words[m, 1024]) — the import-batch
+    payload builder when the native codec is unavailable."""
+    if hasattr(np, "bitwise_count"):
+        counts = np.bitwise_count(words).sum(axis=1).tolist()
+    else:  # pragma: no cover
+        counts = [_popcount_words(w) for w in words]
+    return _serialize_container_seq(
+        zip(keys.tolist(), words, counts), len(keys))
 
 
 def encode_op(typ: int, value: int = 0, values: Optional[np.ndarray] = None) -> bytes:
@@ -865,12 +1000,23 @@ def encode_op(typ: int, value: int = 0, values: Optional[np.ndarray] = None) -> 
     return head + struct.pack("<I", chk) + vals
 
 
+def encode_op_roaring(payload: bytes) -> bytes:
+    """Encode an OP_ADD_ROARING record: crc32 (zlib) over head+payload —
+    fnv1a is byte-serial and too slow for multi-MB batch payloads."""
+    import zlib
+
+    head = struct.pack("<BQ", OP_ADD_ROARING, len(payload))
+    chk = zlib.crc32(payload, zlib.crc32(head))
+    return head + struct.pack("<I", chk) + payload
+
+
 class OpTruncatedError(ValueError):
     """An op record extends past EOF — a torn tail append."""
 
 
 def decode_op(buf) -> Tuple[int, int, Optional[np.ndarray], int]:
-    """Decode one op record; returns (type, value, values, encoded_size)."""
+    """Decode one op record; returns (type, value, values, encoded_size).
+    For OP_ADD_ROARING, `values` is the raw payload bytes."""
     if len(buf) < 13:
         raise OpTruncatedError(f"op data out of bounds: len={len(buf)}")
     typ, value = struct.unpack_from("<BQ", buf, 0)
@@ -888,4 +1034,14 @@ def decode_op(buf) -> Tuple[int, int, Optional[np.ndarray], int]:
             raise ValueError("op checksum mismatch")
         values = np.frombuffer(buf, dtype="<u8", count=n, offset=13).copy()
         return typ, 0, values, size
+    if typ == OP_ADD_ROARING:
+        import zlib
+
+        size = 13 + value
+        if len(buf) < size:
+            raise OpTruncatedError("op data truncated")
+        payload = bytes(buf[13:size])
+        if chk != zlib.crc32(payload, zlib.crc32(bytes(buf[0:9]))):
+            raise ValueError("op checksum mismatch")
+        return typ, 0, payload, size
     raise ValueError(f"invalid op type {typ}")

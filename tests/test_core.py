@@ -524,20 +524,22 @@ def test_noop_remove_keeps_array_encoding(tmp_path):
     assert b.remove(5) and b.containers[0].dtype == np.uint64
 
 
-def test_bulk_import_snapshot_failure_keeps_durability(tmp_path):
-    """When the snapshot-triggering import path skips the op-log record
-    and the snapshot itself fails, the record is appended after all so a
-    clean close still persists the batch."""
+def test_bulk_import_snapshot_failure_keeps_durability(tmp_path, monkeypatch):
+    """Batch imports append their op record BEFORE the amortized fold
+    check, so even a snapshot that fails mid-rewrite (disk full during
+    the byte-triggered fold) leaves the batch durable in the log."""
     import numpy as np
+    from pilosa_tpu.core import fragment as fragment_mod
     from pilosa_tpu.core.fragment import Fragment
 
+    # Any batch record trips the byte-based fold immediately.
+    monkeypatch.setattr(fragment_mod, "OPLOG_FOLD_MIN_BYTES", 1)
     p = str(tmp_path / "f")
     f = Fragment(p, "i", "f", "standard", 0)
     f.open()
-    f.max_op_n = 10  # any real batch triggers the snapshot path
     # Fail INSIDE the real _snapshot, after it has already closed the
-    # op-log append handle — the hard case: the fallback must reopen the
-    # handle (restored by _snapshot's finally) and append the record.
+    # op-log append handle — the hard case: _snapshot's finally must
+    # restore the handle so later appends still work.
     import os as _os
     calls = {"n": 0}
     orig_replace = _os.replace
@@ -557,9 +559,9 @@ def test_bulk_import_snapshot_failure_keeps_durability(tmp_path):
         pass
     finally:
         _os.replace = orig_replace
-    assert calls["n"] == 1
+    assert calls["n"] == 1  # the fold fired and failed
     f.close()
     f2 = Fragment(p, "i", "f", "standard", 0)
     f2.open()
-    assert f2.row_count(0) == 50  # batch survived via the fallback record
+    assert f2.row_count(0) == 50  # batch survived via its own op record
     f2.close()

@@ -1,0 +1,216 @@
+"""Fused bulk-import path: OP_ADD_ROARING records, the byte-based
+snapshot fold policy, and the torn-tail tolerance bound.
+
+Reference anchors: bulkImportStandard/importPositions
+(/root/reference/fragment.go:1494-1604), MaxOpN snapshot trigger
+(fragment.go:79,1769), op log format (roaring.go:3628-3691). The
+OP_ADD_ROARING record (type 4) and the byte-based fold are documented
+divergences — see storage/roaring.py and core/fragment.py docstrings.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import native
+from pilosa_tpu.core import fragment as fragment_mod
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.storage import roaring as roaring_mod
+from pilosa_tpu.storage.roaring import (
+    Bitmap,
+    OP_ADD_ROARING,
+    encode_op_roaring,
+)
+
+
+def _bits(frag):
+    return {(r, int(c)) for r in frag.row_ids()
+            for c in frag.row_columns(r).tolist()}
+
+
+def _mk(tmp_path, name="f"):
+    f = Fragment(str(tmp_path / name), "i", "f", "standard", 0)
+    f.open()
+    return f
+
+
+def test_import_batch_native_and_fallback_agree(tmp_path, monkeypatch):
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, 50, 20_000, dtype=np.uint64)
+    cols = rng.integers(0, 1 << 20, 20_000, dtype=np.uint64)
+
+    f1 = _mk(tmp_path, "native")
+    f1.bulk_import(rows, cols)
+
+    monkeypatch.setattr(roaring_mod.native, "available", lambda: False)
+    f2 = _mk(tmp_path, "fallback")
+    f2.bulk_import(rows, cols)
+
+    assert sorted(f1.storage.containers) == sorted(f2.storage.containers)
+    for k in f1.storage.containers:
+        assert (f1.storage.container_count(k)
+                == f2.storage.container_count(k))
+    assert f1.storage.op_n == f2.storage.op_n
+    f1.close()
+    f2.close()
+
+
+def test_op_add_roaring_cross_reader(tmp_path, monkeypatch):
+    """A file written with the native fused path replays identically
+    through the pure-Python reader, and vice versa."""
+    rng = np.random.default_rng(8)
+    rows = rng.integers(0, 20, 5_000, dtype=np.uint64)
+    cols = rng.integers(0, 1 << 20, 5_000, dtype=np.uint64)
+
+    f = _mk(tmp_path)
+    f.bulk_import(rows, cols)
+    want = _bits(f)
+    f.close()
+    data = open(f.path, "rb").read()
+
+    # Python-only read of the natively-written file.
+    monkeypatch.setattr(roaring_mod.native, "available", lambda: False)
+    pb = Bitmap.from_bytes(data)
+    got = {(p // (1 << 20), p % (1 << 20)) for p in pb.slice().tolist()}
+    assert got == want
+    assert pb.op_n == f.storage.op_n
+
+    # Python-only WRITE, then native read.
+    f2 = _mk(tmp_path, "pyw")
+    f2.bulk_import(rows, cols)
+    assert _bits(f2) == want
+    f2.close()
+    monkeypatch.undo()
+    if native.available():
+        f3 = Fragment(f2.path, "i", "f", "standard", 0)
+        f3.open()
+        assert _bits(f3) == want
+        f3.close()
+
+
+def test_batch_does_not_snapshot_small_oplog(tmp_path):
+    """Batches below the byte threshold append a record and do NOT
+    rewrite the file (the reference would snapshot on every >MaxOpN-bit
+    import, fragment.go:1769 — the amortized divergence under test)."""
+    f = _mk(tmp_path)
+    size0 = os.path.getsize(f.path)
+    rows = np.zeros(20_000, np.uint64)
+    cols = np.arange(20_000, dtype=np.uint64)
+    f.bulk_import(rows, cols)
+    f._file.flush()
+    assert f.storage.op_n == 20_000
+    assert f.storage.op_n_small == 0
+    # File grew by ~the record, not a rewrite; snapshot section unchanged.
+    assert f.storage.snapshot_bytes == size0
+    assert os.path.getsize(f.path) - size0 == f.storage.oplog_bytes
+    f.close()
+
+
+def test_oplog_bytes_fold_triggers_snapshot(tmp_path, monkeypatch):
+    monkeypatch.setattr(fragment_mod, "OPLOG_FOLD_MIN_BYTES", 1024)
+    f = _mk(tmp_path)
+    rows = np.zeros(5_000, np.uint64)
+    cols = np.arange(5_000, dtype=np.uint64)
+    f.bulk_import(rows, cols)  # record >> 1 KiB => fold
+    assert f.storage.oplog_bytes == 0  # folded
+    assert f.storage.op_n == 0
+    assert f._last_snapshot_bytes == os.path.getsize(f.path)
+    f.close()
+    f2 = Fragment(f.path, "i", "f", "standard", 0)
+    f2.open()
+    assert f2.row_count(0) == 5_000
+    f2.close()
+
+
+def test_single_ops_still_fold_by_count(tmp_path):
+    f = _mk(tmp_path)
+    f.max_op_n = 10
+    for i in range(12):
+        f.set_bit(0, i)
+    assert f.storage.op_n_small < 10  # folded at least once
+    assert f.row_count(0) == 12
+    f.close()
+
+
+def test_op_add_roaring_torn_tail_recovered(tmp_path):
+    f = _mk(tmp_path)
+    rows = np.zeros(1_000, np.uint64)
+    cols = np.arange(1_000, dtype=np.uint64)
+    f.bulk_import(rows, cols)
+    f.close()
+    data = open(f.path, "rb").read()
+    # Append a second record torn mid-payload.
+    payload = Bitmap(np.arange(100, dtype=np.uint64)).write_bytes()
+    rec = encode_op_roaring(payload)
+    torn = rec[:len(rec) // 2]
+    with open(f.path, "ab") as fh:
+        fh.write(torn)
+    f2 = Fragment(f.path, "i", "f", "standard", 0)
+    f2.open()
+    assert f2.row_count(0) == 1_000  # intact ops preserved
+    assert f2.tail_dropped_bytes == len(torn)
+    assert os.path.exists(f.path + ".torn")
+    assert os.path.getsize(f.path) == len(data)  # truncated to clean
+    f2.close()
+
+
+def test_op_add_roaring_crc_mismatch_fails(tmp_path):
+    f = _mk(tmp_path)
+    f.bulk_import(np.zeros(500, np.uint64),
+                  np.arange(500, dtype=np.uint64))
+    f.close()
+    data = bytearray(open(f.path, "rb").read())
+    data[-3] ^= 0xFF  # corrupt inside the final record's payload
+    err = (native.NativeParseError if native.available() else ValueError)
+    with pytest.raises((err, ValueError)):
+        Bitmap.from_bytes(bytes(data))
+
+
+def test_torn_tail_bound_fails_hard(tmp_path, monkeypatch):
+    """A dangling tail larger than any plausible record is mid-file
+    corruption: refuse to open instead of silently sidecarring it
+    (ADVICE r2 low #1)."""
+    monkeypatch.setattr(fragment_mod, "MAX_TORN_TAIL_BYTES", 16)
+    f = _mk(tmp_path)
+    f.bulk_import(np.zeros(200, np.uint64),
+                  np.arange(200, dtype=np.uint64))
+    f.close()
+    # A truncated record whose dangling bytes exceed the bound.
+    payload = Bitmap(np.arange(500, dtype=np.uint64)).write_bytes()
+    rec = encode_op_roaring(payload)
+    with open(f.path, "ab") as fh:
+        fh.write(rec[:-10])
+    f2 = Fragment(f.path, "i", "f", "standard", 0)
+    with pytest.raises(ValueError, match="torn"):
+        f2.open()
+    assert not os.path.exists(f.path + ".torn")  # nothing destroyed
+
+
+def test_import_batch_merges_into_existing(tmp_path):
+    f = _mk(tmp_path)
+    f.bulk_import(np.zeros(10, np.uint64), np.arange(10, dtype=np.uint64))
+    f.bulk_import(np.zeros(10, np.uint64),
+                  np.arange(5, 15, dtype=np.uint64))
+    assert f.row_count(0) == 15
+    # Duplicate pairs within one batch are idempotent.
+    f.bulk_import(np.zeros(4, np.uint64),
+                  np.array([100, 100, 101, 101], np.uint64))
+    assert f.row_count(0) == 17
+    f.close()
+
+
+def test_import_batch_wide_row_range_falls_back(tmp_path):
+    """A batch spanning a huge sparse row range is unsuited to dense
+    scatter; the grouped path must still import it correctly."""
+    f = _mk(tmp_path)
+    rows = np.array([0, 1 << 30, (1 << 30) + 5], dtype=np.uint64)
+    cols = np.array([3, 4, 5], dtype=np.uint64)
+    f.bulk_import(rows, cols)
+    assert f.bit(0, 3)
+    assert f.bit((1 << 30) + 5, 5)
+    f.close()
+    f2 = Fragment(f.path, "i", "f", "standard", 0)
+    f2.open()
+    assert f2.bit(1 << 30, 4)
+    f2.close()
