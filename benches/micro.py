@@ -57,12 +57,19 @@ def bench_roaring_kernels():
 
 
 def bench_fragment_paths():
-    """Import / snapshot / block checksums (reference BenchmarkFragment_*)."""
+    """Import / snapshot / block checksums (reference BenchmarkFragment_*).
+
+    Two data shapes: 100 rows (dense containers, ~625 bits each — the
+    round-2-comparable shape, dense-scatter import path) and 1000 rows
+    (10 hash blocks, ~62 bits/container — array-encoded containers,
+    sorted-group import path; also what makes the dirty-one-block
+    checksum meaningfully incremental)."""
     from pilosa_tpu.core.fragment import Fragment
 
     rng = np.random.default_rng(1)
     n_bits = 1_000_000
     rows = rng.integers(0, 100, n_bits, dtype=np.uint64)
+    wide_rows = rng.integers(0, 1000, n_bits, dtype=np.uint64)
     cols = rng.integers(0, 1 << 20, n_bits, dtype=np.uint64)
 
     with tempfile.TemporaryDirectory() as tmp:
@@ -74,14 +81,33 @@ def bench_fragment_paths():
              "bits/sec")
         t = timeit(lambda: frag._snapshot(), iters=3)
         emit("fragment_snapshot", 1 / t, "ops/sec")
-        t = timeit(lambda: frag.checksum_blocks(), iters=3)
-        emit("fragment_blocks_checksum", 1 / t, "ops/sec")
         frag.close()
 
         # reopen replays snapshot via the native codec
         frag2 = Fragment(os.path.join(tmp, "f"), "i", "f", "standard", 0)
         t = timeit(lambda: (frag2.open(), frag2.close()), iters=3)
         emit("fragment_open", 1 / t, "ops/sec")
+
+        wide = Fragment(os.path.join(tmp, "w"), "i", "w", "standard", 0)
+        wide.open()
+        t0 = time.perf_counter()
+        wide.bulk_import(wide_rows, cols)
+        emit("fragment_bulk_import_wide",
+             n_bits / (time.perf_counter() - t0), "bits/sec")
+        t = timeit(lambda: wide._snapshot(), iters=3)
+        emit("fragment_snapshot_sparse", 1 / t, "ops/sec")
+        # Cold pass (cache invalidated each run: the reference's
+        # every-sync cost, fragment.go:1259-1355) vs the incremental
+        # path: idle (nothing dirty) and one dirty block of ten.
+        t = timeit(lambda: (wide._invalidate_block_checksums(),
+                            wide.checksum_blocks()), iters=3)
+        emit("fragment_blocks_checksum", 1 / t, "ops/sec")
+        t = timeit(lambda: wide.checksum_blocks(), iters=3)
+        emit("fragment_blocks_checksum_idle", 1 / t, "ops/sec")
+        t = timeit(lambda: (wide.set_bit(1, 1), wide.clear_bit(1, 1),
+                            wide.checksum_blocks()), iters=3)
+        emit("fragment_blocks_checksum_dirty1", 1 / t, "ops/sec")
+        wide.close()
 
 
 def bench_query_qps():

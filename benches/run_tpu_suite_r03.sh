@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-3 TPU suite: waits for the tunnel, then runs every bench
+# serially, committing nothing itself — results land in benches/*.jsonl
+# for the round record. Priority order: bench.py first (persists the
+# last_good_tpu.json carry-forward sidecar), then micro (the validated
+# AND+popcount roofline table — VERDICT r2 item 1), then the BASELINE
+# suite configs (VERDICT r2 item 3). Between benches it WAITS for the
+# tunnel to return rather than aborting.
+cd /root/repo
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp
+print(int(jnp.ones((8,), jnp.uint32).sum()))" >/dev/null 2>&1
+}
+wait_tpu() {
+  until probe; do
+    echo "$(date -u +%H:%M:%S) waiting for TPU..." >&2
+    sleep 120
+  done
+  echo "$(date -u +%H:%M:%S) TPU answered" >&2
+}
+run() {  # run <name> <timeout> <cmd...>
+  local name=$1 to=$2; shift 2
+  wait_tpu
+  echo "$(date -u +%H:%M:%S) bench: $name" >&2
+  timeout "$to" "$@" > "benches/${name}_r03_tpu.jsonl" 2> "benches/${name}_r03_tpu.err"
+  echo "$(date -u +%H:%M:%S) bench: $name rc=$?" >&2
+}
+wait_tpu
+echo "$(date -u +%H:%M:%S) early bench.py (sidecar capture)" >&2
+python bench.py > BENCH_early_r03.json 2> bench_early_r03.err
+echo "$(date -u +%H:%M:%S) bench.py rc=$?" >&2
+run micro 2400 python benches/micro.py
+run startrace 1200 python benches/startrace.py
+run bsi 1800 python benches/bsi.py
+run tanimoto_chunked 2400 env PILOSA_TANIMOTO_N=1000000 PILOSA_TANIMOTO_ITERS=3 python benches/tanimoto_chunked.py
+run taxi 2400 env PILOSA_TAXI_N=2000000 PILOSA_TAXI_ITERS=3 python benches/taxi.py
+run tanimoto 1800 python benches/tanimoto.py
+echo "$(date -u +%H:%M:%S) suite done" >&2

@@ -48,6 +48,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     u64 = ctypes.c_uint64
     p_u8 = ctypes.POINTER(ctypes.c_uint8)
     p_u64 = ctypes.POINTER(u64)
+    p_u16 = ctypes.POINTER(ctypes.c_uint16)
     lib.rb_load.argtypes = [p_u8, u64]
     lib.rb_load.restype = ctypes.c_void_p
     lib.rb_error.argtypes = [ctypes.c_void_p]
@@ -74,7 +75,6 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rb_serialize_ptrs.restype = u64
     lib.pn_crc32.argtypes = [p_u8, u64, ctypes.c_uint32]
     lib.pn_crc32.restype = ctypes.c_uint32
-    lib.pn_popcount_each.argtypes = [p_u64, u64, u64, p_u64]
     lib.pn_import_build.argtypes = [p_u64, p_u64, u64, ctypes.c_uint32]
     lib.pn_import_build.restype = ctypes.c_void_p
     lib.ib_error.argtypes = [ctypes.c_void_p]
@@ -89,6 +89,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ib_words.argtypes = [ctypes.c_void_p, p_u64]
     lib.ib_payload.argtypes = [ctypes.c_void_p, p_u8]
     lib.ib_free.argtypes = [ctypes.c_void_p]
+    lib.pn_serialize_groups_cap.argtypes = [u64, u64]
+    lib.pn_serialize_groups_cap.restype = u64
+    lib.pn_serialize_groups.argtypes = [p_u64, p_u16, p_u64, u64, p_u8]
+    lib.pn_serialize_groups.restype = u64
     lib.pn_fnv1a32.argtypes = [p_u8, u64, ctypes.c_uint32]
     lib.pn_fnv1a32.restype = ctypes.c_uint32
     lib.pn_popcount.argtypes = [p_u64, u64]
@@ -96,7 +100,6 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pn_intersection_count.argtypes = [p_u64, p_u64, u64]
     lib.pn_intersection_count.restype = u64
     lib.pn_row_popcounts.argtypes = [p_u64, u64, u64, p_u64]
-    p_u16 = ctypes.POINTER(ctypes.c_uint16)
     lib.pn_build_masks.argtypes = [p_u64, u64, u64, p_u64, p_u64]
     lib.pn_build_masks.restype = u64
     lib.pn_scatter_rows.argtypes = [p_u16, p_u64, u64, p_u64, u64, p_u64]
@@ -274,19 +277,28 @@ def import_build(row_ids: np.ndarray, col_ids: np.ndarray,
         lib.ib_free(h)
 
 
-def popcount_each(containers) -> Optional[np.ndarray]:
-    """Per-container popcounts over independently-allocated dense
-    containers (uint64, equal length). None when unavailable."""
+def serialize_groups(keys: np.ndarray, lows: np.ndarray,
+                     bounds: np.ndarray) -> Optional[bytes]:
+    """Roaring snapshot payload from pre-grouped sorted-unique
+    positions: keys uint64[m] ascending, lows uint16[n] (all groups
+    back to back), bounds uint64[m+1] offsets. None when unavailable."""
     lib = load()
-    if lib is None or not containers:
-        return None if lib is None else np.empty(0, dtype=np.uint64)
-    addrs = np.fromiter(
-        (c.__array_interface__["data"][0] for c in containers),
-        dtype=np.uint64, count=len(containers))
-    out = np.empty(len(containers), dtype=np.uint64)
-    lib.pn_popcount_each(_as_u64_ptr(addrs), len(containers),
-                         containers[0].size, _as_u64_ptr(out))
-    return out
+    if lib is None:
+        return None
+    m = len(keys)
+    keys = np.ascontiguousarray(keys, dtype=np.uint64)
+    lows = np.ascontiguousarray(lows, dtype=np.uint16)
+    bounds = np.ascontiguousarray(bounds, dtype=np.uint64)
+    out = np.empty(int(lib.pn_serialize_groups_cap(m, len(lows))),
+                   dtype=np.uint8)
+    size = lib.pn_serialize_groups(
+        _as_u64_ptr(keys),
+        lows.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+        _as_u64_ptr(bounds), m,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if size == 0 and m > 0:
+        raise ValueError("pn_serialize_groups: bad group bounds")
+    return out[:size].tobytes()
 
 
 def fnv1a32(chunks, seed: int = 0x811C9DC5) -> Optional[int]:

@@ -433,15 +433,6 @@ class Bitmap:
                 self.op_n += len(positions)
         return n
 
-    def append_batch_record(self, positions: np.ndarray) -> None:
-        """Append an ADD_BATCH record for ALREADY-applied,
-        already-op-counted positions (the add_batch(log_op=False)
-        failure fallback — does not bump op_n again)."""
-        if self.op_writer is not None and len(positions):
-            self.op_writer.write(encode_op(
-                OP_ADD_BATCH,
-                values=np.asarray(positions, dtype=np.uint64)))
-
     def remove_batch(self, positions: np.ndarray) -> int:
         n = self.direct_remove_n(positions)
         if len(positions):
@@ -490,26 +481,30 @@ class Bitmap:
                     c |= masks[i]
                     self._invalidate(key)
             return keys
-        # Grouped numpy path (no native library, or a batch shape unsuited
-        # to dense scatter): sort+unique once, then work per group as
-        # sorted-u16 arrays — no dense mask block, so a pathologically
-        # sparse batch (a bit per container) stays O(batch) in memory.
+        # Grouped path (no native library, or a batch shape unsuited to
+        # dense scatter — sparse/wide row ranges): sort+unique once,
+        # then work per group as sorted-u16 arrays — no dense mask
+        # block, so a pathologically sparse batch (a bit per container)
+        # stays O(batch) in memory.
         positions = np.unique(
             (row_ids << np.uint64(swidth_exp))
             + (col_ids & np.uint64((1 << swidth_exp) - 1)))
         gkeys = (positions >> np.uint64(16)).astype(np.int64)
         starts = np.concatenate(
             ([0], np.flatnonzero(gkeys[1:] != gkeys[:-1]) + 1))
-        bounds = np.append(starts, len(positions))
+        bounds = np.append(starts, len(positions)).astype(np.uint64)
         keys = positions[starts] >> np.uint64(16)
         key_list = [int(k) for k in keys.tolist()]
-        groups = [
-            (positions[bounds[i]:bounds[i + 1]]
-             & np.uint64(0xFFFF)).astype(np.uint16)
-            for i in range(len(starts))]
-        payload = _serialize_container_seq(
-            ((k, g, len(g)) for k, g in zip(key_list, groups)),
-            len(key_list))
+        lows = (positions & np.uint64(0xFFFF)).astype(np.uint16)
+        groups = [lows[bounds[i]:bounds[i + 1]]
+                  for i in range(len(starts))]
+        payload = None
+        if native.available():
+            payload = native.serialize_groups(keys, lows, bounds)
+        if payload is None:
+            payload = _serialize_container_seq(
+                ((k, g, len(g)) for k, g in zip(key_list, groups)),
+                len(key_list))
         self._append_roaring_record(payload, len(positions))
         for k, g in zip(key_list, groups):
             if k not in self.containers:
@@ -806,10 +801,17 @@ class Bitmap:
         keys = [k for k in sorted(self.containers) if self.container_count(k) > 0]
         n_u16 = sum(1 for k in keys
                     if self.containers[k].dtype == np.uint16)
-        # The native path needs dense temps for array-encoded
-        # containers; cap their footprint so an all-sparse
-        # million-container bitmap doesn't materialize gigabytes at
-        # once (the Python path streams one temp at a time).
+        if native.available() and n_u16 * 4 > len(keys):
+            # u16-heavy (fingerprint-shaped) bitmaps: serialize from
+            # sorted position groups — densifying every array container
+            # first costs ~30 us each and dominated snapshot time at
+            # ~16k sparse containers.
+            out = self._write_bytes_groups(keys)
+            if out is not None:
+                return out
+        # Dense-heavy: per-container pointers, temps only for the few
+        # array-encoded ones; cap their footprint so an all-sparse
+        # million-container bitmap can't materialize gigabytes at once.
         if native.available() and n_u16 * 8 * CONTAINER_WORDS <= (256 << 20):
             dense = [_as_dense(self.containers[k]) for k in keys]
             out = native.roaring_serialize_ptrs(
@@ -819,6 +821,48 @@ class Bitmap:
         return _serialize_container_seq(
             ((key, self.containers[key], self.container_count(key))
              for key in keys), len(keys))
+
+    def _write_bytes_groups(self, keys: List[int]) -> Optional[bytes]:
+        """Native groups serializer over mixed containers: u16 arrays
+        contribute their positions verbatim; dense containers extract
+        through one native ctz sweep. Returns None if unavailable.
+
+        Note: groups with >=4096 positions are written bitmap-encoded
+        (pn_serialize_groups never picks run encoding — for the dense
+        side this matches rb_serialize only when runs wouldn't win, so
+        this path is gated to u16-heavy bitmaps where dense containers
+        are rare and byte-exactness of encoding CHOICE is not part of
+        the format contract — any valid encoding reads back equal)."""
+        lows_parts: List[np.ndarray] = []
+        counts: List[int] = []
+        dense_chunks: List[np.ndarray] = []
+        dense_slots: List[int] = []
+        for i, k in enumerate(keys):
+            c = self.containers[k]
+            if c.dtype == np.uint16:
+                lows_parts.append(c)
+                counts.append(len(c))
+            else:
+                lows_parts.append(None)  # patched below
+                dense_chunks.append(c)
+                dense_slots.append(i)
+                counts.append(self.container_count(k))
+        if dense_chunks:
+            pos = native.dense_positions_of(
+                dense_chunks, np.zeros(len(dense_chunks), np.uint64))
+            if pos is None:
+                return None
+            dcounts = [self.container_count(keys[i]) for i in dense_slots]
+            for arr, slot in zip(
+                    np.split(pos.astype(np.uint16),
+                             np.cumsum(dcounts)[:-1]), dense_slots):
+                lows_parts[slot] = arr
+        lows = (np.concatenate(lows_parts) if lows_parts
+                else np.empty(0, dtype=np.uint16))
+        bounds = np.concatenate(
+            ([0], np.cumsum(counts, dtype=np.uint64)))
+        return native.serialize_groups(
+            np.array(keys, dtype=np.uint64), lows, bounds)
 
     @classmethod
     def from_bytes(cls, data: bytes,
@@ -975,17 +1019,6 @@ def _serialize_container_seq(items, n: int) -> bytes:
         header.write(struct.pack("<I", offset))
         offset += len(p)
     return header.getvalue() + b"".join(payloads)
-
-
-def _serialize_keys_words(keys: np.ndarray, words: np.ndarray) -> bytes:
-    """Serialize sorted dense (keys, words[m, 1024]) — the import-batch
-    payload builder when the native codec is unavailable."""
-    if hasattr(np, "bitwise_count"):
-        counts = np.bitwise_count(words).sum(axis=1).tolist()
-    else:  # pragma: no cover
-        counts = [_popcount_words(w) for w in words]
-    return _serialize_container_seq(
-        zip(keys.tolist(), words, counts), len(keys))
 
 
 def encode_op(typ: int, value: int = 0, values: Optional[np.ndarray] = None) -> bytes:
