@@ -106,7 +106,8 @@ def cmd_server(args) -> int:
     diagnostics.start()
     runtime_monitor = None
     if cfg.metric_service != "none" and cfg.metric_poll_interval > 0:
-        runtime_monitor = RuntimeMonitor(stats, cfg.metric_poll_interval)
+        runtime_monitor = RuntimeMonitor(stats, cfg.metric_poll_interval,
+                                         holder=holder)
         runtime_monitor.start()
     anti_entropy = None
     if cluster is not None and cfg.anti_entropy_interval > 0:

@@ -270,8 +270,7 @@ class Fragment:
             changed = self.storage.add(self.pos(row_id, column_id))
             if changed:
                 self._touch_row(row_id)
-                if self.cache_type != cache_mod.CACHE_TYPE_NONE:
-                    self.cache.add(row_id, self.row_count(row_id))
+                self._cache_update(row_id)
                 self._maybe_snapshot()
             return changed
 
@@ -280,8 +279,7 @@ class Fragment:
             changed = self.storage.remove(self.pos(row_id, column_id))
             if changed:
                 self._touch_row(row_id)
-                if self.cache_type != cache_mod.CACHE_TYPE_NONE:
-                    self.cache.add(row_id, self.row_count(row_id))
+                self._cache_update(row_id)
                 self._maybe_snapshot()
             return changed
 
@@ -453,6 +451,19 @@ class Fragment:
 
     # -- device bank --------------------------------------------------------
 
+    def _cache_update(self, row_id: int) -> None:
+        """Refresh the TopN cache entry for a written row. Skipped when
+        the ranked cache has saturated (cardinality exceeded its bound):
+        the warm-read path can never fire again, so neither the
+        row_count recount nor the cache upkeep buys anything — reads
+        take the exact device sweep (cache.RankedCache docstring;
+        reference keeps paying this cost, fragment.go:1067/cache.go:136)."""
+        if self.cache_type == cache_mod.CACHE_TYPE_NONE:
+            return
+        if getattr(self.cache, "saturated", False):
+            return
+        self.cache.bulk_add(row_id, self.row_count(row_id))
+
     def _touch_row(self, row_id: int) -> None:
         self._dirty.add(row_id)
         self.version += 1
@@ -549,8 +560,7 @@ class Fragment:
                 touched = np.unique(keys // np.uint64(CONTAINERS_PER_ROW))
             for r in touched.tolist():
                 self._touch_row(int(r))
-                if self.cache_type != cache_mod.CACHE_TYPE_NONE:
-                    self.cache.bulk_add(int(r), self.row_count(int(r)))
+                self._cache_update(int(r))
             self._maybe_snapshot()
 
     def bulk_import_mutex(self, row_ids: np.ndarray, column_ids: np.ndarray
@@ -620,8 +630,7 @@ class Fragment:
             for key in other.containers:
                 self._touch_row(key // CONTAINERS_PER_ROW)
             for r in {k // CONTAINERS_PER_ROW for k in other.containers}:
-                if self.cache_type != cache_mod.CACHE_TYPE_NONE:
-                    self.cache.bulk_add(int(r), self.row_count(int(r)))
+                self._cache_update(int(r))
             self._snapshot()
 
     def replace_with_bytes(self, data: bytes) -> None:
@@ -641,8 +650,7 @@ class Fragment:
                                for k in self.storage.containers}
             for r in rows:
                 self._touch_row(int(r))
-                if self.cache_type != cache_mod.CACHE_TYPE_NONE:
-                    self.cache.bulk_add(int(r), self.row_count(int(r)))
+                self._cache_update(int(r))
             self._snapshot()
 
     def set_row(self, row_id: int, words: np.ndarray) -> None:
@@ -671,8 +679,7 @@ class Fragment:
                     if self.storage.containers.pop(k, None) is not None:
                         self.storage._invalidate(k)
             self._touch_row(row_id)
-            if self.cache_type != cache_mod.CACHE_TYPE_NONE:
-                self.cache.add(row_id, self.row_count(row_id))
+            self._cache_update(row_id)
             # A whole-row overwrite isn't representable as an op-log record;
             # fold it into a snapshot for durability.
             self._snapshot()
@@ -767,21 +774,34 @@ class Fragment:
                      else len(self._block_digests))
             if self._block_digests is None or \
                     len(self._dirty_blocks) * 4 > known + 4:
-                # Cold, or enough churn that per-block range scans
-                # (each O(containers)) would cost more than one full
-                # extraction.
+                # Cold, or enough churn that re-extracting most of the
+                # bitmap anyway makes the full pass cheaper.
                 self._block_digests = self._checksum_all_blocks()
-            else:
-                span = HASH_BLOCK_SIZE * SHARD_WIDTH
-                for blk in self._dirty_blocks:
-                    pos = self.storage.for_each_range(blk * span,
-                                                      (blk + 1) * span)
-                    if len(pos):
-                        h = hashlib.blake2b(pos.astype("<u8").tobytes(),
+            elif self._dirty_blocks:
+                # ONE container scan selects every dirty block's
+                # containers (a per-block for_each_range would pay an
+                # O(containers) dict walk per dirty block), then one
+                # extraction + boundary split re-hashes them.
+                keys_per_block = (HASH_BLOCK_SIZE * SHARD_WIDTH) >> 16
+                dirty = self._dirty_blocks
+                sub = Bitmap()
+                sub.containers = {
+                    k: c for k, c in self.storage.containers.items()
+                    if k // keys_per_block in dirty}
+                pos = sub.slice()
+                for blk in dirty:
+                    self._block_digests.pop(blk, None)
+                if len(pos):
+                    span = np.uint64(HASH_BLOCK_SIZE * SHARD_WIDTH)
+                    blk_of = pos // span
+                    cuts = np.nonzero(np.diff(blk_of))[0] + 1
+                    bounds = np.concatenate(([0], cuts, [len(pos)]))
+                    for i in range(len(bounds) - 1):
+                        seg = pos[bounds[i]:bounds[i + 1]]
+                        h = hashlib.blake2b(seg.astype("<u8").tobytes(),
                                             digest_size=16)
-                        self._block_digests[blk] = h.digest()
-                    else:
-                        self._block_digests.pop(blk, None)
+                        self._block_digests[int(blk_of[bounds[i]])] = \
+                            h.digest()
             self._dirty_blocks.clear()
             return sorted(self._block_digests.items())
 

@@ -153,3 +153,15 @@ class Holder:
                 for v in f.views.values():
                     for frag in v.fragments.values():
                         frag.flush_cache()
+
+    def tail_dropped_bytes(self) -> int:
+        """Total torn op-log tail bytes sidecarred across all open
+        fragments (ADVICE r2: losing data to a torn tail must be visible
+        to operators through stats/health, not only a log line)."""
+        total = 0
+        for idx in self.indexes.values():
+            for f in idx.fields.values():
+                for v in f.views.values():
+                    for frag in v.fragments.values():
+                        total += frag.tail_dropped_bytes
+        return total

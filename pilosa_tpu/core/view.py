@@ -310,11 +310,16 @@ class View:
                 # Cached alongside so a hit is O(1) host-side — no
                 # 65k-entry dict rebuild per chunk per repeat query.
                 slots = {r: i for i, r in enumerate(row_set)}
+                # The slots dict is real host RAM too (~100 B/entry of
+                # dict overhead + int pair; several MB at 65k rows):
+                # account it, or a budget-full cache overshoots by the
+                # sum of its mappings (ADVICE r2).
+                entry_bytes = host.nbytes + 100 * len(row_set)
                 if hb_key is not None and \
-                        0 < host.nbytes <= HOST_BLOCK_BUDGET.budget:
+                        0 < entry_bytes <= HOST_BLOCK_BUDGET.budget:
                     self._host_blocks[hb_key] = (host, versions, slots)
                     HOST_BLOCK_BUDGET.admit(self, hb_key,
-                                            nbytes=host.nbytes)
+                                            nbytes=entry_bytes)
             array = mesh.put_bank(host) if mesh else jnp.asarray(host)
             bank = ViewBank(array, slots, cap - 1, versions)
             if rows is None or cache_rows:

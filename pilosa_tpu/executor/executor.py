@@ -223,6 +223,9 @@ class Executor:
         # Observability: TopN answers served from warm ranked caches
         # without any device work (reference fragment.top, fragment.go:1067).
         self.topn_cache_hits = 0
+        # Times a GroupBy frontier outgrew GROUPBY_CHUNK_BYTES and was
+        # spilled to host memory (re-uploaded per expansion chunk).
+        self.groupby_spill_events = 0
         # Cluster mode installs a resolver that allocates keys on the
         # translation primary (reference: primary-owned TranslateFile with
         # chained replication, translate.go:56,400). None = local stores.
@@ -1111,6 +1114,11 @@ class Executor:
                 continue
             if frag.cache_type == cache_mod.CACHE_TYPE_NONE:
                 return None
+            if getattr(frag.cache, "saturated", False):
+                # Saturated caches stop tracking writes entirely, so
+                # their counts may be stale even when len() happens to
+                # match (e.g. after mass clears).
+                return None
             counts = getattr(frag.cache, "counts", None)
             if counts is None:
                 return None
@@ -1269,10 +1277,19 @@ class Executor:
             return bank.array[sel][..., :wmin]  # [R, S, Wmin]
 
         n_shards, depth_n = len(shards), len(child_rows)
-        # prefixes: device [P, S, W]; None means the full universe (no
-        # filter, before the first level). prefix_rows[i] = row-id tuple.
+        # prefixes: the surviving frontier [P, S, W] — a jnp array while
+        # its total bytes fit GROUPBY_CHUNK_BYTES, spilled to a host
+        # numpy array beyond that and re-uploaded chunk by chunk (the
+        # frontier of a deep high-cardinality GroupBy is P*S*W words and
+        # must not live unbudgeted in HBM; the reference iterates
+        # host-side throughout, executor.go:2820-2996). None means the
+        # full universe. prefix_rows[i] = row-id tuple.
         prefixes = filter_words[None] if filter_words is not None else None
         prefix_rows: List[tuple] = [()]
+
+        def frontier_chunk(frontier, c0, c1):
+            sub = frontier[c0:c1]
+            return sub if isinstance(sub, jnp.ndarray) else jnp.asarray(sub)
 
         for depth in range(depth_n - 1):
             stacks = stacks_at(depth)
@@ -1289,8 +1306,10 @@ class Executor:
                 per_new = n_shards * wmin * 4
                 chunk_p = max(1, self.GROUPBY_CHUNK_BYTES // (per_new * R))
                 kept_words, kept_rows = [], []
+                kept_bytes = 0
+                spilled = False
                 for c0 in range(0, len(prefix_rows), chunk_p):
-                    sub = prefixes[c0:c0 + chunk_p]  # [p, S, W]
+                    sub = frontier_chunk(prefixes, c0, c0 + chunk_p)
                     expand = _jit(
                         f"gb_exp:{sub.shape}:{stacks.shape}",
                         lambda s, st: (
@@ -1302,16 +1321,28 @@ class Executor:
                     keep_idx = np.where(nz)[0]
                     if len(keep_idx) == 0:
                         continue
-                    kept_words.append(
-                        new[jnp.asarray(keep_idx.astype(np.int32))])
+                    kept = new[jnp.asarray(keep_idx.astype(np.int32))]
+                    kept_bytes += kept.nbytes
+                    if not spilled and kept_bytes > self.GROUPBY_CHUNK_BYTES:
+                        # Survivors exceed the device budget: collect
+                        # the rest of this depth's frontier in host
+                        # memory (chunks re-upload at the next depth).
+                        spilled = True
+                        self.groupby_spill_events += 1
+                        kept_words = [np.asarray(w) for w in kept_words]
+                    kept_words.append(np.asarray(kept) if spilled else kept)
                     ids = child_rows[depth][1]
                     kept_rows.extend(
                         prefix_rows[c0 + int(k) // R] + (int(ids[k % R]),)
                         for k in keep_idx)
                 if not kept_words:
                     return []
-                prefixes = kept_words[0] if len(kept_words) == 1 \
-                    else jnp.concatenate(kept_words)
+                if len(kept_words) == 1:
+                    prefixes = kept_words[0]
+                elif spilled:
+                    prefixes = np.concatenate(kept_words)
+                else:
+                    prefixes = jnp.concatenate(kept_words)
                 prefix_rows = kept_rows
 
         # Final depth: count every (prefix × row) pair in chunked batches.
@@ -1332,7 +1363,7 @@ class Executor:
             if limit and len(results) >= limit:
                 break
             if counts is None:
-                sub = prefixes[c0:c0 + chunk_p]
+                sub = frontier_chunk(prefixes, c0, c0 + chunk_p)
                 cntk = _jit(
                     f"gb_cntN:{sub.shape}:{stacks.shape}",
                     lambda s, st: popcount(

@@ -127,8 +127,11 @@ class InternalClient:
                 # twice) and never for fresh connections, matching Go's
                 # transport semantics (retry only reused conns). The
                 # narrow duplicate-POST race (server processed AND closed
-                # before our read) is safe for these internal endpoints:
-                # imports and cluster messages are idempotent.
+                # before our read) is safe for every endpoint this path
+                # carries: imports/cluster messages/attr merges are
+                # idempotent, translate allocation is get-or-allocate,
+                # and the schema create legs treat already-exists as
+                # success (see create_index_node).
                 conn.close()
                 if not reused or isinstance(e, TimeoutError):
                     raise
@@ -257,12 +260,23 @@ class InternalClient:
     def cluster_message(self, uri: str, message: dict) -> None:
         self._req("POST", f"{uri}/internal/cluster/message", obj=message)
 
+    @staticmethod
+    def _is_already_exists(e: ClientError) -> bool:
+        # 409 alone is not enough: the API also answers 409 for "method
+        # not allowed in state RESIZING" (server/api.py), which must NOT
+        # read as success.
+        return "409" in str(e) and "exists" in str(e)
+
     def create_index_node(self, uri: str, index: str, options: dict) -> None:
+        """Remote create leg. Already-exists reads as success: the
+        stale-connection retry in _req can duplicate a POST when the
+        peer processed the first request but closed the socket before
+        the response was read (ADVICE r2)."""
         try:
             self._req("POST", f"{uri}/index/{index}?remote=true",
                       obj={"options": options})
         except ClientError as e:
-            if "409" not in str(e):
+            if not self._is_already_exists(e):
                 raise
 
     def create_field_node(self, uri: str, index: str, field: str,
@@ -272,5 +286,5 @@ class InternalClient:
                               f"?remote=true",
                       obj={"options": options})
         except ClientError as e:
-            if "409" not in str(e):
+            if not self._is_already_exists(e):
                 raise

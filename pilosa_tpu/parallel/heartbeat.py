@@ -18,28 +18,41 @@ converge without waiting for anti-entropy or a read-path fallback.
 
 from __future__ import annotations
 
+import random
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from pilosa_tpu.parallel.client import ClientError, InternalClient
 from pilosa_tpu.parallel.cluster import Cluster
 
 
 class Heartbeater:
-    """Probes every peer on an interval; after `suspect_after` consecutive
-    failures the peer is marked down (cluster DEGRADED, routing prefers
-    live replicas); one successful probe marks it back up."""
+    """Rotating-subset failure detector: each round probes at most
+    `probes_per_round` healthy peers from a shuffled ring (O(N) probe
+    load cluster-wide, like SWIM's one-peer-per-round — the reference's
+    memberlist config, gossip/gossip.go:246 — where an all-peers mesh
+    would be O(N^2)), PLUS every currently-suspect peer (so detection
+    still takes `suspect_after` consecutive rounds, not a full ring
+    rotation) and one known-down peer (so recovery is noticed within a
+    round). After `suspect_after` consecutive failures a peer is marked
+    down (cluster DEGRADED, routing prefers live replicas); one
+    successful probe marks it back up."""
 
     def __init__(self, cluster: Cluster, interval: float = 2.0,
                  suspect_after: int = 3, timeout: Optional[float] = None,
-                 logger=None):
+                 logger=None, probes_per_round: int = 2):
         self.cluster = cluster
         self.interval = interval
         self.suspect_after = suspect_after
+        self.probes_per_round = probes_per_round
         # Short probe timeout: a hung peer must not stall the prober.
         self.client = InternalClient(timeout=timeout or max(interval, 1.0))
         self.logger = logger
         self._fails: Dict[str, int] = {}
+        self._ring: List[str] = []
+        self._ring_pos = 0
+        self._down_pos = 0
+        self.last_round_probes = 0  # observability / tests
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -47,11 +60,43 @@ class Heartbeater:
         if self.logger is not None:
             self.logger.printf(fmt, *args)
 
+    def _round_targets(self, peers):
+        """Suspects + one rotating down peer + ring rotation filling up
+        to probes_per_round."""
+        by_id = {n.id: n for n in peers}
+        if set(self._ring) != set(by_id):
+            self._ring = list(by_id)
+            random.shuffle(self._ring)
+            self._ring_pos = 0
+        down_ids = sorted(self.cluster.down_ids & set(by_id))
+        targets: Dict[str, object] = {
+            nid: by_id[nid] for nid in self._fails
+            if nid in by_id and nid not in self.cluster.down_ids}
+        if down_ids:
+            pick = down_ids[self._down_pos % len(down_ids)]
+            self._down_pos += 1
+            targets.setdefault(pick, by_id[pick])
+        budget = min(self.probes_per_round, len(peers))
+        for _ in range(len(self._ring)):
+            if len(targets) >= budget:
+                break
+            nid = self._ring[self._ring_pos % len(self._ring)]
+            self._ring_pos += 1
+            if nid in self.cluster.down_ids:
+                continue  # down peers probe via the rotating slot above
+            targets.setdefault(nid, by_id[nid])
+        return list(targets.values())
+
     def probe_once(self) -> None:
-        """One probe round over every peer (tests call this directly)."""
-        for node in self.cluster.nodes():
-            if node.id == self.cluster.local.id:
-                continue
+        """One probe round (tests call this directly)."""
+        peers = [n for n in self.cluster.nodes()
+                 if n.id != self.cluster.local.id]
+        if not peers:
+            self.last_round_probes = 0
+            return
+        targets = self._round_targets(peers)
+        self.last_round_probes = len(targets)
+        for node in targets:
             try:
                 self.client.status(node.uri)
             except ClientError:

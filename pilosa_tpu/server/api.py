@@ -931,8 +931,11 @@ class API:
 
     def info(self) -> Dict[str, Any]:
         import os
+        # tailDroppedBytes > 0 means torn op-log tails were sidecarred at
+        # open — data the operator should know was dropped (ADVICE r2).
         return {"shardWidth": SHARD_WIDTH, "cpuPhysicalCores": os.cpu_count(),
-                "version": __version__}
+                "version": __version__,
+                "tailDroppedBytes": self.holder.tail_dropped_bytes()}
 
     def version(self) -> Dict[str, str]:
         return {"version": __version__}

@@ -127,14 +127,20 @@ class RuntimeMonitor:
     monitorRuntime, server.go:726-770: goroutines, heap, open FDs,
     mmaps)."""
 
-    def __init__(self, stats, interval: float = 10.0):
+    def __init__(self, stats, interval: float = 10.0, holder=None):
         self.stats = stats
         self.interval = interval
+        self.holder = holder
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def sample(self) -> None:
         self.stats.gauge("threads", threading.active_count())
+        if self.holder is not None:
+            # Torn op-log tails sidecarred at open: operators must see
+            # dropped-data events in metrics, not only a log line.
+            self.stats.gauge("tailDroppedBytes",
+                             self.holder.tail_dropped_bytes())
         counts = gc.get_count()
         self.stats.gauge("gcGen0", counts[0])
         self.stats.gauge("garbageCollection", gc.get_stats()[-1].get(
