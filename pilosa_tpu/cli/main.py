@@ -92,8 +92,16 @@ def cmd_server(args) -> int:
             StatsdStatsClient(cfg.metric_host, logger=logger))
     else:
         stats = NopStatsClient()
+    if cfg.tracing_endpoint:
+        from pilosa_tpu.utils.tracing import ExportingTracer
+        tracer = ExportingTracer(cfg.tracing_endpoint,
+                                 service_name=cfg.tracing_service_name,
+                                 logger=logger)
+        tracer.start()
+    else:
+        tracer = RecordingTracer()
     api = API(holder, mesh=mesh, cluster=cluster, stats=stats,
-              tracer=RecordingTracer())
+              tracer=tracer)
     api.logger = logger
     api.long_query_time = cfg.long_query_time
     api.executor.max_writes_per_request = cfg.max_writes_per_request
@@ -145,6 +153,8 @@ def cmd_server(args) -> int:
         diagnostics.stop()
         if runtime_monitor is not None:
             runtime_monitor.stop()
+        if hasattr(tracer, "stop"):
+            tracer.stop()  # final flush of pending spans
         holder.close()
         if hasattr(stats, "flush"):
             # Drain buffered statsd datagrams last, after every

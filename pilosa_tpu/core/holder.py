@@ -147,21 +147,20 @@ class Holder:
                         "shards": idx.available_shards()})
         return out
 
-    def flush_caches(self) -> None:
+    def iter_fragments(self):
+        """Every open fragment across all indexes/fields/views."""
         for idx in self.indexes.values():
             for f in idx.fields.values():
                 for v in f.views.values():
-                    for frag in v.fragments.values():
-                        frag.flush_cache()
+                    yield from v.fragments.values()
+
+    def flush_caches(self) -> None:
+        for frag in self.iter_fragments():
+            frag.flush_cache()
 
     def tail_dropped_bytes(self) -> int:
         """Total torn op-log tail bytes sidecarred across all open
         fragments (ADVICE r2: losing data to a torn tail must be visible
         to operators through stats/health, not only a log line)."""
-        total = 0
-        for idx in self.indexes.values():
-            for f in idx.fields.values():
-                for v in f.views.values():
-                    for frag in v.fragments.values():
-                        total += frag.tail_dropped_bytes
-        return total
+        return sum(frag.tail_dropped_bytes
+                   for frag in self.iter_fragments())

@@ -19,7 +19,15 @@ from pilosa_tpu.server import wire
 
 
 class ClientError(RuntimeError):
-    pass
+    """status/body are set for HTTP >=400 responses (None for transport
+    errors), so callers can match on the response rather than substring-
+    scanning a string that also contains the request URL."""
+
+    def __init__(self, msg: str, status: Optional[int] = None,
+                 body: str = ""):
+        super().__init__(msg)
+        self.status = status
+        self.body = body
 
 
 class _ConnPool:
@@ -148,9 +156,9 @@ class InternalClient:
             else:
                 conn.close()
             if status >= 400:
-                raise ClientError(
-                    f"{method} {url}: {status}: "
-                    f"{payload.decode('utf-8', 'replace')[:500]}")
+                body = payload.decode("utf-8", "replace")[:500]
+                raise ClientError(f"{method} {url}: {status}: {body}",
+                                  status=status, body=body)
             if raw:
                 return payload
             if ctype.startswith(wire.CONTENT_TYPE):
@@ -264,8 +272,10 @@ class InternalClient:
     def _is_already_exists(e: ClientError) -> bool:
         # 409 alone is not enough: the API also answers 409 for "method
         # not allowed in state RESIZING" (server/api.py), which must NOT
-        # read as success.
-        return "409" in str(e) and "exists" in str(e)
+        # read as success. Match the response BODY, never the whole
+        # string — it contains the URL, and an index named "exists"
+        # would alias.
+        return e.status == 409 and "exists" in e.body
 
     def create_index_node(self, uri: str, index: str, options: dict) -> None:
         """Remote create leg. Already-exists reads as success: the

@@ -56,6 +56,12 @@ class Config:
     # both an interval and an endpoint URL are configured)
     diagnostics_interval: float = 0.0
     diagnostics_url: str = ""
+    # Tracing export (reference Jaeger wiring, server/config.go:110-118):
+    # OTLP/HTTP JSON endpoint, e.g. http://localhost:4318/v1/traces
+    # (Jaeger >=1.35 and the OTel collector both ingest it). "" = record
+    # spans in memory only.
+    tracing_endpoint: str = ""
+    tracing_service_name: str = "pilosa-tpu"
     # Cluster: static peer URI list (must include this node's own URI) +
     # replication factor (reference cluster.replicas, server/config.go:63)
     cluster_peers: list = field(default_factory=list)

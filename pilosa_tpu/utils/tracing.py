@@ -1,14 +1,19 @@
 """Tracing facade.
 
 Reference: /root/reference/tracing/tracing.go:18-56 — a global tracer with
-StartSpanFromContext plus HTTP header inject/extract at node boundaries.
+StartSpanFromContext plus HTTP header inject/extract at node boundaries,
+exported to Jaeger via server config (server/config.go:110-118).
 Here: a minimal span tree recorder with W3C-traceparent-style header
-propagation; pluggable like the reference's opentracing adapter.
+propagation, pluggable like the reference's opentracing adapter, plus an
+OTLP/HTTP JSON exporter (ExportingTracer) — the modern wire format both
+Jaeger (:4318) and the OpenTelemetry collector ingest natively, so the
+reference's Jaeger wiring is covered without a thrift dependency.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
 import threading
 import time
 import uuid
@@ -18,11 +23,13 @@ TRACE_HEADER = "X-Trace-Id"
 
 
 class Span:
-    __slots__ = ("name", "trace_id", "start", "end", "attrs", "children")
+    __slots__ = ("name", "trace_id", "span_id", "start", "end", "attrs",
+                 "children")
 
     def __init__(self, name: str, trace_id: str, attrs: dict):
         self.name = name
         self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:16]
         self.start = time.time()
         self.end: Optional[float] = None
         self.attrs = attrs
@@ -87,4 +94,141 @@ class RecordingTracer:
     def extract(self, headers) -> None:
         tid = headers.get(TRACE_HEADER)
         if tid:
-            self._local.trace_id = tid
+            self._local.trace_id = _sanitize_trace_id(tid)
+
+
+def _sanitize_trace_id(tid: str) -> str:
+    """Trace ids must be 32 hex chars on the OTLP wire. Our own nodes
+    propagate uuid hex, but the header is client-settable; a non-hex
+    value is re-hashed deterministically (same junk id on every node
+    still correlates) instead of poisoning a whole export batch."""
+    t = tid.strip().lower()
+    if len(t) == 32 and all(c in "0123456789abcdef" for c in t):
+        return t
+    import hashlib
+    return hashlib.md5(tid.encode()).hexdigest()
+
+
+def spans_to_otlp(spans: List[Span], service_name: str) -> dict:
+    """Encode finished span trees as an OTLP/HTTP JSON
+    ExportTraceServiceRequest (the opentelemetry-proto JSON mapping:
+    hex ids, stringified uint64 nanos, keyed attribute values). This is
+    the rebuild's analog of the reference's Jaeger span reporter
+    (server/config.go:110-118 wires jaeger-client-go)."""
+    flat = []
+
+    def walk(span: Span, parent_id: str):
+        entry = {
+            "traceId": span.trace_id[:32].ljust(32, "0"),
+            "spanId": span.span_id,
+            "name": span.name,
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(int(span.start * 1e9)),
+            "endTimeUnixNano": str(int((span.end or span.start) * 1e9)),
+            "attributes": [
+                {"key": str(k), "value": {"stringValue": str(v)}}
+                for k, v in span.attrs.items()],
+        }
+        if parent_id:
+            entry["parentSpanId"] = parent_id
+        flat.append(entry)
+        for child in span.children:
+            walk(child, span.span_id)
+
+    for s in spans:
+        walk(s, "")
+    return {"resourceSpans": [{
+        "resource": {"attributes": [
+            {"key": "service.name",
+             "value": {"stringValue": service_name}}]},
+        "scopeSpans": [{"scope": {"name": "pilosa_tpu"},
+                        "spans": flat}],
+    }]}
+
+
+class ExportingTracer(RecordingTracer):
+    """RecordingTracer that ships finished root span trees to an
+    OTLP/HTTP endpoint (e.g. Jaeger's :4318/v1/traces) from a background
+    thread. Batches up to `batch_size` spans or `flush_interval`
+    seconds, whichever first; export failures are dropped after a log
+    line — tracing must never stall queries."""
+
+    def __init__(self, endpoint: str, service_name: str = "pilosa-tpu",
+                 keep: int = 128, batch_size: int = 64,
+                 flush_interval: float = 5.0, logger=None):
+        super().__init__(keep=keep)
+        self.endpoint = endpoint
+        self.service_name = service_name
+        self.batch_size = batch_size
+        self.flush_interval = flush_interval
+        self.logger = logger
+        self._pending: List[Span] = []
+        self._pending_lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        stack = self._stack()
+        try:
+            with super().span(name, **attrs) as s:
+                yield s
+        finally:
+            # Queue on the error path too: traces of FAILED requests are
+            # the ones operators need most.
+            if not stack:  # a root span just finished
+                with self._pending_lock:
+                    self._pending.append(s)
+                    full = len(self._pending) >= self.batch_size
+                if full:
+                    self._wake.set()
+
+    def _drain(self) -> List[Span]:
+        with self._pending_lock:
+            out, self._pending = self._pending, []
+        return out
+
+    def flush(self) -> bool:
+        """Export everything pending now. Returns False on failure
+        (spans are dropped, not retried — bounded memory)."""
+        spans = self._drain()
+        if not spans:
+            return True
+        body = json.dumps(
+            spans_to_otlp(spans, self.service_name)).encode()
+        try:
+            import urllib.request
+            req = urllib.request.Request(
+                self.endpoint, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                resp.read()
+            return True
+        except Exception as e:
+            if self.logger is not None:
+                self.logger.printf("otlp export failed (%d spans "
+                                   "dropped): %s", len(spans), e)
+            return False
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.is_set():
+                self._wake.wait(self.flush_interval)
+                self._wake.clear()
+                self.flush()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="otlp-exporter")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.flush()

@@ -1,0 +1,26 @@
+"""Two-process jax.distributed CPU dryrun (SURVEY §7 step 6; VERDICT r2
+missing #5): the cross-host code path — one global mesh over two
+processes' devices, shard-axis reductions lowered to cross-process
+collectives — must compile and reduce correctly."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.timeout(360)
+def test_two_process_jax_distributed_dryrun():
+    env = dict(os.environ)
+    # The parent re-spawns children with its own platform/device flags;
+    # scrub this test process's conftest-driven settings.
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pilosa_tpu.parallel.multihost"],
+        cwd=repo, env=env, capture_output=True, timeout=330)
+    out = proc.stdout.decode() + proc.stderr.decode()
+    assert proc.returncode == 0, out
+    assert "multihost dryrun: OK" in out, out
+    assert out.count("OK counts=") == 2, out  # both processes verified
