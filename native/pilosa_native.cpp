@@ -101,6 +101,7 @@ inline uint32_t crc32_update(uint32_t crc, const uint8_t* p, size_t n) {
 struct LoadedBitmap {
   std::vector<uint64_t> keys;
   std::vector<uint64_t> words;  // keys.size() * kContainerWords
+  std::vector<uint64_t> counts;  // cached by rb_counts for rb_export_split
   uint64_t op_n = 0;
   uint64_t op_n_small = 0;   // single-bit op records only (types 0/1)
   uint64_t ops_bytes = 0;    // bytes of valid op records applied
@@ -470,6 +471,62 @@ void rb_copy_out(void* h, uint64_t* keys_out, uint64_t* words_out) {
 }
 
 void rb_free(void* h) { delete static_cast<LoadedBitmap*>(h); }
+
+// Keys only (no dense payload copy) — pairs with the split export.
+void rb_keys(void* h, uint64_t* out) {
+  auto* bm = static_cast<LoadedBitmap*>(h);
+  std::memcpy(out, bm->keys.data(), 8 * bm->keys.size());
+}
+
+// Per-container cardinalities (key order) — sizes the split export,
+// cached on the handle so rb_export_split doesn't re-sweep the words.
+void rb_counts(void* h, uint64_t* out) {
+  auto* bm = static_cast<LoadedBitmap*>(h);
+  bm->counts.resize(bm->keys.size());
+  for (size_t i = 0; i < bm->keys.size(); i++) {
+    uint64_t cnt = 0;
+    const uint64_t* c = &bm->words[i * kContainerWords];
+    for (int w = 0; w < kContainerWords; w++) cnt += popcount64(c[w]);
+    bm->counts[i] = out[i] = cnt;
+  }
+}
+
+// Split export: containers at or below `max_array_card` emit their
+// sorted in-container positions into `lows_out` (u16, concatenated in
+// key order; caller sizes it from rb_counts), the rest memcpy dense
+// into `dense_out` ([n_dense, 1024], key order). Saves the dense
+// materialization + re-optimize round trip that made sparse
+// (fingerprint-shaped) fragment opens O(8 KiB per tiny container).
+void rb_export_split(void* h, uint64_t max_array_card,
+                     uint16_t* lows_out, uint64_t* dense_out) {
+  auto* bm = static_cast<LoadedBitmap*>(h);
+  size_t lo = 0, dn = 0;
+  const bool cached = bm->counts.size() == bm->keys.size();
+  for (size_t i = 0; i < bm->keys.size(); i++) {
+    const uint64_t* c = &bm->words[i * kContainerWords];
+    uint64_t card;
+    if (cached) {
+      card = bm->counts[i];
+    } else {
+      card = 0;
+      for (int w = 0; w < kContainerWords; w++) card += popcount64(c[w]);
+    }
+    if (card <= max_array_card) {
+      for (int w = 0; w < kContainerWords; w++) {
+        uint64_t x = c[w];
+        while (x) {
+          lows_out[lo++] =
+              static_cast<uint16_t>((w << 6) | __builtin_ctzll(x));
+          x &= x - 1;
+        }
+      }
+    } else {
+      std::memcpy(dense_out + dn * kContainerWords, c,
+                  8ull * kContainerWords);
+      dn++;
+    }
+  }
+}
 
 // --------------------------------------------------------------- save path
 

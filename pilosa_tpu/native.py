@@ -66,6 +66,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rb_tail_dropped.argtypes = [ctypes.c_void_p]
     lib.rb_tail_dropped.restype = u64
     lib.rb_copy_out.argtypes = [ctypes.c_void_p, p_u64, p_u64]
+    lib.rb_keys.argtypes = [ctypes.c_void_p, p_u64]
+    lib.rb_counts.argtypes = [ctypes.c_void_p, p_u64]
+    lib.rb_export_split.argtypes = [ctypes.c_void_p, u64, p_u16, p_u64]
     lib.rb_free.argtypes = [ctypes.c_void_p]
     lib.rb_serialize_cap.argtypes = [u64]
     lib.rb_serialize_cap.restype = u64
@@ -167,10 +170,20 @@ def roaring_load(data: bytes
     return ex["keys"], ex["words"], ex["op_n"], ex["tail_dropped"]
 
 
-def roaring_load_ex(data: bytes) -> Optional[dict]:
+def roaring_load_ex(data: bytes,
+                    split_max_card: Optional[int] = None
+                    ) -> Optional[dict]:
     """roaring_load plus the op-log accounting the snapshot policy needs:
-    {keys, words, op_n, op_n_small, ops_bytes, snapshot_bytes,
-    tail_dropped}. None when unavailable."""
+    {keys, op_n, op_n_small, ops_bytes, snapshot_bytes, tail_dropped}
+    and the container payload. None when unavailable.
+
+    Default payload: "words" — every container dense [n, 1024]. With
+    split_max_card set, the payload is encoding-split instead: "counts"
+    (u64[n]), "lows" (u16 positions of all containers whose cardinality
+    is <= split_max_card, concatenated in key order) and "dense"
+    ([n_dense, 1024] for the rest) — a sparse 16k-container fragment
+    then loads ~2 MB instead of materializing 128 MB dense and
+    re-optimizing."""
     lib = load()
     if lib is None:
         return None
@@ -184,18 +197,38 @@ def roaring_load_ex(data: bytes) -> Optional[dict]:
             raise NativeParseError(err.decode())
         n = lib.rb_container_count(h)
         keys = np.empty(n, dtype=np.uint64)
-        words = np.empty((n, CONTAINER_WORDS), dtype=np.uint64)
-        if n:
-            lib.rb_copy_out(h, _as_u64_ptr(keys), _as_u64_ptr(words))
-        return {
-            "keys": [int(k) for k in keys],
-            "words": words,
+        out = {
             "op_n": int(lib.rb_op_count(h)),
             "op_n_small": int(lib.rb_op_small_count(h)),
             "ops_bytes": int(lib.rb_ops_bytes(h)),
             "snapshot_bytes": int(lib.rb_snapshot_bytes(h)),
             "tail_dropped": int(lib.rb_tail_dropped(h)),
         }
+        if split_max_card is None:
+            words = np.empty((n, CONTAINER_WORDS), dtype=np.uint64)
+            if n:
+                lib.rb_copy_out(h, _as_u64_ptr(keys), _as_u64_ptr(words))
+            out["keys"] = [int(k) for k in keys]
+            out["words"] = words
+            return out
+        counts = np.empty(n, dtype=np.uint64)
+        if n:
+            lib.rb_keys(h, _as_u64_ptr(keys))
+            lib.rb_counts(h, _as_u64_ptr(counts))
+        arr_mask = counts <= split_max_card
+        lows = np.empty(int(counts[arr_mask].sum()), dtype=np.uint16)
+        dense = np.empty((int((~arr_mask).sum()), CONTAINER_WORDS),
+                         dtype=np.uint64)
+        if n:
+            lib.rb_export_split(
+                h, split_max_card,
+                lows.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                _as_u64_ptr(dense))
+        out["keys"] = [int(k) for k in keys]
+        out["counts"] = counts
+        out["lows"] = lows
+        out["dense"] = dense
+        return out
     finally:
         lib.rb_free(h)
 

@@ -882,16 +882,41 @@ class Bitmap:
         must error, not silently half-apply)."""
         self.tail_dropped = 0
         if native.available():
-            loaded = native.roaring_load_ex(bytes(data))
+            # Encoding-split load: array-eligible containers arrive as
+            # u16 position spans of ONE compact buffer (the in-memory
+            # encoding optimize() would produce anyway), dense ones as
+            # rows of one block — a sparse fingerprint-shaped fragment
+            # loads its ~2 MB of real data instead of materializing
+            # 8 KiB per tiny container and re-optimizing.
+            loaded = native.roaring_load_ex(bytes(data),
+                                            split_max_card=ARRAY_MAX_SIZE)
             if loaded is not None:
                 if loaded["tail_dropped"] and not tolerate_torn_tail:
                     raise OpTruncatedError(
                         f"op data truncated ({loaded['tail_dropped']} "
                         "tail bytes)")
-                words = loaded["words"]
-                self.containers = {k: words[i].copy()
-                                   for i, k in enumerate(loaded["keys"])}
+                counts = loaded["counts"]
+                lows, dense = loaded["lows"], loaded["dense"]
+                # Containers are VIEWS into the two exactly-sized load
+                # blocks (deliberate: per-container copies were the
+                # sparse-open bottleneck). Trade-off: dropping a
+                # container keeps its parent block alive while any
+                # sibling view survives — acceptable because the blocks
+                # hold only real data and fragments rarely shrink;
+                # mutation is safe (u16 views densify into fresh arrays
+                # via _container(); dense rows are disjoint).
+                self.containers = {}
                 self._counts = {}
+                lo = dn = 0
+                for i, k in enumerate(loaded["keys"]):
+                    c = int(counts[i])
+                    if c <= ARRAY_MAX_SIZE:
+                        self.containers[k] = lows[lo:lo + c]
+                        lo += c
+                    else:
+                        self.containers[k] = dense[dn]
+                        dn += 1
+                    self._counts[k] = c
                 self.op_n = loaded["op_n"]
                 self.op_n_small = loaded["op_n_small"]
                 self.oplog_bytes = loaded["ops_bytes"]

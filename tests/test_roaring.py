@@ -208,12 +208,10 @@ def test_array_encoding_roundtrip_and_ops():
     data = b.write_bytes()
     b2 = Bitmap.from_bytes(data)
     assert b2.slice().tolist() == b.slice().tolist()
-    # The pure-Python parser keeps array payloads array-encoded (no dense
-    # blowup on open); the native parser returns dense and relies on the
-    # caller's optimize() (Fragment.open does this).
-    from pilosa_tpu import native as native_mod
-    if not native_mod.available():
-        assert any(c.dtype == np.uint16 for c in b2.containers.values())
+    # Both parsers keep array-eligible payloads array-encoded on load
+    # (the native path via the encoding-split export) — no dense blowup
+    # on open.
+    assert any(c.dtype == np.uint16 for c in b2.containers.values())
     b2.optimize()
     assert any(c.dtype == np.uint16 for c in b2.containers.values())
     # large containers stay dense through optimize
