@@ -201,6 +201,20 @@ def bench_device_time(holder):
     r = validated_chain_slope(
         lambda k: timed_fetch(lambda: chain(arr, arr, k)),
         bank_bytes, jax.devices()[0])
+
+    # The headline hot op — AND+popcount, i.e. Count(Intersect(...))
+    # (reference intersectionCountBitmapBitmap, roaring.go:2438) — as a
+    # two-operand salted chain: both operands perturbed independently,
+    # 2x bank traffic credited.
+    and_chain = make_salted_chain(
+        lambda x, y, sx, sy: popcount(
+            jnp.bitwise_and(x + sx, y + sy), axis=-1))
+    try:
+        r_and = validated_chain_slope(
+            lambda k: timed_fetch(lambda: and_chain(arr, arr, k)),
+            2 * bank_bytes, jax.devices()[0])
+    except RuntimeError:
+        r_and = None
     # RTT estimate: what one tiny fetch costs (for the report only).
     tiny = jnp.zeros((8,), dtype=jnp.uint32)
     t0 = time.perf_counter()
@@ -221,6 +235,13 @@ def bench_device_time(holder):
     if r.get("invalid"):
         out["device_time_invalid"] = True
         out["device_time_error"] = r["error"]
+    if r_and is not None:
+        out["device_and_gbps"] = r_and["gbps_median"]
+        out["device_and_gbps_min"] = r_and["gbps_min"]
+        out["device_and_gbps_max"] = r_and["gbps_max"]
+        out["device_and_roofline_frac"] = r_and["roofline_frac"]
+        if r_and.get("invalid"):
+            out["device_and_invalid"] = True
     return out
 
 
@@ -411,6 +432,9 @@ def main():
         for k in ("platform", "device_bits_per_sec", "device_gbps",
                   "device_gbps_min", "device_gbps_max", "device_sweep_s",
                   "device_kind", "roofline_gbps_assumed", "roofline_frac",
+                  "device_and_gbps", "device_and_gbps_min",
+                  "device_and_gbps_max", "device_and_roofline_frac",
+                  "device_and_invalid",
                   "fetch_rtt_s", "device_time_error", "device_time_invalid",
                   "partial", "tpu_timing"):
             if k in child:
@@ -451,7 +475,8 @@ def main():
                     **{k: payload[k] for k in
                        ("device_gbps", "device_gbps_min", "device_gbps_max",
                         "roofline_frac", "device_kind", "tpu_timing",
-                        "device_time_invalid")
+                        "device_time_invalid", "device_and_gbps",
+                        "device_and_roofline_frac", "device_and_invalid")
                        if k in payload},
                     "note": ("TPU measurement <24h old carried from "
                              "benches/last_good_tpu.json; value field "
