@@ -332,6 +332,43 @@ def test_saturated_cache_never_serves_topn(tmp_path):
         h.close()
 
 
+def test_import_values_overwrite_and_dups(tmp_path):
+    """BSI import: re-imported columns clear their old zero planes
+    (fresh columns skip every remove pass), and duplicate columns in a
+    batch resolve last-wins like the reference's sequential column
+    loop (fragment.go:679)."""
+    f = _mk(tmp_path)
+    depth = 8
+    cols = np.arange(10, dtype=np.uint64)
+    vals = np.arange(10, dtype=np.uint64) + 100  # 100..109
+    f.import_values(cols, vals, depth)
+    for c in range(10):
+        v, ok = f.value(c, depth)
+        assert ok and v == 100 + c
+    # Overwrite a subset with SMALLER values (old high bits must clear).
+    f.import_values(np.array([2, 3], np.uint64),
+                    np.array([1, 0], np.uint64), depth)
+    assert f.value(2, depth) == (1, True)
+    assert f.value(3, depth) == (0, True)
+    assert f.value(4, depth) == (104, True)
+    # Duplicates: last occurrence wins.
+    f.import_values(np.array([5, 5, 5], np.uint64),
+                    np.array([7, 9, 42], np.uint64), depth)
+    assert f.value(5, depth) == (42, True)
+    # clear drops the value entirely.
+    f.import_values(np.array([5], np.uint64), np.array([0], np.uint64),
+                    depth, clear=True)
+    assert f.value(5, depth) == (0, False)
+    f.close()
+    # Reopen: everything durable through the fused records.
+    f2 = Fragment(f.path, "i", "f", "standard", 0)
+    f2.open()
+    assert f2.value(2, depth) == (1, True)
+    assert f2.value(4, depth) == (104, True)
+    assert f2.value(5, depth) == (0, False)
+    f2.close()
+
+
 def test_import_batch_wide_row_range_falls_back(tmp_path):
     """A batch spanning a huge sparse row range is unsuited to dense
     scatter; the grouped path must still import it correctly."""
