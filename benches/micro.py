@@ -111,7 +111,14 @@ def bench_fragment_paths():
         t = timeit(lambda: (wide.set_bit(1, 1), wide.clear_bit(1, 1),
                             wide.checksum_blocks()), iters=3)
         emit("fragment_blocks_checksum_dirty1", 1 / t, "ops/sec")
+        wide._snapshot()
         wide.close()
+
+        # Sparse-shape open: ~16k array-encoded containers through the
+        # encoding-split native load.
+        wide2 = Fragment(os.path.join(tmp, "w"), "i", "w", "standard", 0)
+        t = timeit(lambda: (wide2.open(), wide2.close()), iters=3)
+        emit("fragment_open_sparse", 1 / t, "ops/sec")
 
 
 def bench_query_qps():

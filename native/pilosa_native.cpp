@@ -108,6 +108,22 @@ struct LoadedBitmap {
   uint64_t snapshot_bytes = 0;  // size of the snapshot section
   uint64_t tail_dropped = 0;  // torn-tail bytes discarded on replay
   char err[128] = {0};
+  // Compact mode (snapshot-only files, no op tail): containers stay as
+  // refs into the caller's input buffer — no 8 KiB dense expansion per
+  // container. `src` is only valid for the duration of the caller's
+  // rb_load..rb_free scope (the Python wrapper keeps the buffer alive
+  // across its accessor calls). keys/counts are filled; words stays
+  // empty; ops never ran, so the dense mutation paths are unreachable.
+  bool compact = false;
+  const uint8_t* src = nullptr;
+  struct Ref {
+    uint32_t off;   // payload offset in src
+    uint32_t card;
+    uint16_t typ;
+  };
+  std::vector<Ref> refs;
+  std::vector<uint64_t> run_dense;  // expanded run containers
+  std::vector<uint32_t> run_slot;   // ref index -> run_dense block (or ~0)
 
   int find(uint64_t key) const {
     // Binary search over sorted keys.
@@ -133,6 +149,149 @@ struct LoadedBitmap {
 bool fail(LoadedBitmap* bm, const char* msg) {
   std::snprintf(bm->err, sizeof(bm->err), "%s", msg);
   return false;
+}
+
+
+// Shared payload decoders (compact and dense parsers must agree).
+inline void scatter_array(const uint8_t* data, uint32_t offset,
+                          uint32_t card, uint64_t* dense) {
+  for (uint32_t j = 0; j < card; j++) {
+    uint16_t v = ru16(data + offset + 2ull * j);
+    dense[v >> 6] |= 1ull << (v & 63);
+  }
+}
+
+inline void expand_runs(const uint8_t* data, uint32_t offset,
+                        uint16_t run_n, uint64_t* dense) {
+  for (uint16_t j = 0; j < run_n; j++) {
+    uint16_t start = ru16(data + offset + 2 + 4ull * j);
+    uint16_t last = ru16(data + offset + 2 + 4ull * j + 2);
+    int w0 = start >> 6, w1 = last >> 6;
+    for (int w = w0; w <= w1; w++) {
+      uint64_t m = ~0ull;
+      if (w == w0) m &= ~0ull << (start & 63);
+      if (w == w1) m &= ~0ull >> (63 - (last & 63));
+      dense[w] |= m;
+    }
+  }
+}
+
+// Compact parse attempt for snapshot-only files (no op tail — the
+// common shape after a fold): containers become refs into `data`,
+// arrays validated sorted-unique, bitmaps popcounted (empties dropped),
+// runs pre-expanded. Returns false — with NO error set — whenever the
+// file needs the dense path instead (op tail present, invalid array
+// payload, any format anomaly): the dense parser then renders the
+// authoritative verdict.
+bool parse_snapshot_compact(LoadedBitmap* bm, const uint8_t* data,
+                            size_t len) {
+  if (len < kHeaderBaseSize) return false;
+  if (ru16(data) != kMagic || ru16(data + 2) != kVersion) return false;
+  uint32_t n = ru32(data + 4);
+  size_t meta_pos = kHeaderBaseSize;
+  size_t off_pos = meta_pos + 12ull * n;
+  size_t payload_start = off_pos + 4ull * n;
+  if (payload_start > len) return false;
+  // Metadata-only pre-pass: bail out BEFORE any payload validation when
+  // the file carries an op tail (container ends are computable from the
+  // headers plus a run container's 2-byte count) — an op-tailed reopen
+  // must not pay a wasted full snapshot scan here.
+  {
+    size_t end_max0 = payload_start;
+    for (uint32_t i = 0; i < n; i++) {
+      uint16_t typ = ru16(data + meta_pos + 12ull * i + 8);
+      uint32_t card = static_cast<uint32_t>(
+          ru16(data + meta_pos + 12ull * i + 10)) + 1;
+      uint32_t offset = ru32(data + off_pos + 4ull * i);
+      if (offset >= len) return false;
+      size_t end;
+      if (typ == kTypeArray) end = offset + 2ull * card;
+      else if (typ == kTypeBitmap) end = offset + 8ull * kContainerWords;
+      else if (typ == kTypeRun) {
+        if (offset + 2ull > len) return false;
+        end = offset + 2ull + 4ull * ru16(data + offset);
+      } else return false;
+      if (end > len) return false;
+      if (end > end_max0) end_max0 = end;
+    }
+    if (end_max0 != len) return false;  // op tail: dense path
+  }
+  bm->keys.reserve(n);
+  bm->counts.reserve(n);
+  bm->refs.reserve(n);
+  size_t end_max = payload_start;
+  uint64_t prev_key = 0;
+  for (uint32_t i = 0; i < n; i++) {
+    uint64_t key = ru64(data + meta_pos + 12ull * i);
+    uint16_t typ = ru16(data + meta_pos + 12ull * i + 8);
+    uint32_t card = static_cast<uint32_t>(
+        ru16(data + meta_pos + 12ull * i + 10)) + 1;
+    uint32_t offset = ru32(data + off_pos + 4ull * i);
+    if (offset >= len) return false;
+    if (i > 0 && key <= prev_key) return false;
+    prev_key = key;
+    size_t end;
+    uint64_t count = 0;
+    uint32_t run_slot = ~0u;
+    if (typ == kTypeArray) {
+      end = offset + 2ull * card;
+      if (end > len) return false;
+      // Sorted strictly-increasing or the dense path must sanitize.
+      uint16_t prev = 0;
+      for (uint32_t j = 0; j < card; j++) {
+        uint16_t v = ru16(data + offset + 2ull * j);
+        if (j > 0 && v <= prev) return false;
+        prev = v;
+      }
+      count = card;
+    } else if (typ == kTypeBitmap) {
+      end = offset + 8ull * kContainerWords;
+      if (end > len) return false;
+      for (int w = 0; w < kContainerWords; w++)
+        count += popcount64(ru64(data + offset + 8ull * w));
+    } else if (typ == kTypeRun) {
+      if (offset + 2ull > len) return false;
+      uint16_t run_n = ru16(data + offset);
+      end = offset + 2ull + 4ull * run_n;
+      if (end > len) return false;
+      run_slot = static_cast<uint32_t>(bm->run_dense.size() /
+                                       kContainerWords);
+      bm->run_dense.resize(bm->run_dense.size() + kContainerWords, 0);
+      uint64_t* dense = &bm->run_dense[static_cast<size_t>(run_slot) *
+                                       kContainerWords];
+      expand_runs(data, offset, run_n, dense);
+      count = 0;
+      for (int w = 0; w < kContainerWords; w++) count += popcount64(dense[w]);
+    } else {
+      return false;
+    }
+    if (end > end_max) end_max = end;
+    if (count == 0) continue;  // never materialize empty containers
+    bm->keys.push_back(key);
+    bm->counts.push_back(count);
+    bm->refs.push_back({offset, static_cast<uint32_t>(count), typ});
+    bm->run_slot.push_back(run_slot);
+  }
+  if (end_max != len) return false;  // op tail present: dense path
+  bm->compact = true;
+  bm->src = data;
+  bm->snapshot_bytes = end_max;
+  return true;
+}
+
+// Expand one compact ref into a dense 1024-word block.
+void compact_expand(const LoadedBitmap* bm, size_t i, uint64_t* out) {
+  const auto& r = bm->refs[i];
+  std::memset(out, 0, 8ull * kContainerWords);
+  if (r.typ == kTypeArray) {
+    scatter_array(bm->src, r.off, r.card, out);
+  } else if (r.typ == kTypeBitmap) {
+    std::memcpy(out, bm->src + r.off, 8ull * kContainerWords);
+  } else {
+    std::memcpy(out, &bm->run_dense[static_cast<size_t>(bm->run_slot[i]) *
+                                    kContainerWords],
+                8ull * kContainerWords);
+  }
 }
 
 // Parse the snapshot section. Returns ops-log offset via *ops_offset.
@@ -167,10 +326,7 @@ bool parse_snapshot(LoadedBitmap* bm, const uint8_t* data, size_t len,
       uint32_t card = static_cast<uint32_t>(card_m1) + 1;
       end = offset + 2ull * card;
       if (end > len) return fail(bm, "array container truncated");
-      for (uint32_t j = 0; j < card; j++) {
-        uint16_t v = ru16(data + offset + 2ull * j);
-        dense[v >> 6] |= 1ull << (v & 63);
-      }
+      scatter_array(data, offset, card, dense);
     } else if (typ == kTypeBitmap) {
       end = offset + 8ull * kContainerWords;
       if (end > len) return fail(bm, "bitmap container truncated");
@@ -180,18 +336,7 @@ bool parse_snapshot(LoadedBitmap* bm, const uint8_t* data, size_t len,
       uint16_t run_n = ru16(data + offset);
       end = offset + 2ull + 4ull * run_n;
       if (end > len) return fail(bm, "run container truncated");
-      for (uint16_t j = 0; j < run_n; j++) {
-        uint16_t start = ru16(data + offset + 2 + 4ull * j);
-        uint16_t last = ru16(data + offset + 2 + 4ull * j + 2);
-        // Set bits [start, last] inclusive via word-granular masks.
-        int w0 = start >> 6, w1 = last >> 6;
-        for (int w = w0; w <= w1; w++) {
-          uint64_t m = ~0ull;
-          if (w == w0) m &= ~0ull << (start & 63);
-          if (w == w1) m &= ~0ull >> (63 - (last & 63));
-          dense[w] |= m;
-        }
-      }
+      expand_runs(data, offset, run_n, dense);
     } else {
       return fail(bm, "unknown container type");
     }
@@ -442,6 +587,15 @@ void* rb_load(const uint8_t* data, uint64_t len) {
   auto* bm = new (std::nothrow) LoadedBitmap();
   if (!bm) return nullptr;
   try {
+    if (parse_snapshot_compact(bm, data, len)) return bm;
+    // Not snapshot-only (or a shape the compact pass won't vouch for):
+    // reset and take the dense parse + replay path.
+    bm->keys.clear();
+    bm->counts.clear();
+    bm->refs.clear();
+    bm->run_dense.clear();
+    bm->run_slot.clear();
+    bm->snapshot_bytes = 0;
     size_t ops_offset = 0;
     if (parse_snapshot(bm, data, len, &ops_offset)) {
       bm->snapshot_bytes = ops_offset;
@@ -467,6 +621,11 @@ uint64_t rb_tail_dropped(void* h) { return static_cast<LoadedBitmap*>(h)->tail_d
 void rb_copy_out(void* h, uint64_t* keys_out, uint64_t* words_out) {
   auto* bm = static_cast<LoadedBitmap*>(h);
   std::memcpy(keys_out, bm->keys.data(), 8 * bm->keys.size());
+  if (bm->compact) {
+    for (size_t i = 0; i < bm->refs.size(); i++)
+      compact_expand(bm, i, words_out + i * kContainerWords);
+    return;
+  }
   std::memcpy(words_out, bm->words.data(), 8 * bm->words.size());
 }
 
@@ -482,6 +641,10 @@ void rb_keys(void* h, uint64_t* out) {
 // cached on the handle so rb_export_split doesn't re-sweep the words.
 void rb_counts(void* h, uint64_t* out) {
   auto* bm = static_cast<LoadedBitmap*>(h);
+  if (bm->compact) {  // precomputed during the compact parse
+    std::memcpy(out, bm->counts.data(), 8 * bm->counts.size());
+    return;
+  }
   bm->counts.resize(bm->keys.size());
   for (size_t i = 0; i < bm->keys.size(); i++) {
     uint64_t cnt = 0;
@@ -501,6 +664,32 @@ void rb_export_split(void* h, uint64_t max_array_card,
                      uint16_t* lows_out, uint64_t* dense_out) {
   auto* bm = static_cast<LoadedBitmap*>(h);
   size_t lo = 0, dn = 0;
+  if (bm->compact) {
+    for (size_t i = 0; i < bm->refs.size(); i++) {
+      const auto& r = bm->refs[i];
+      if (r.card <= max_array_card) {
+        if (r.typ == kTypeArray) {  // payload IS the u16 positions
+          std::memcpy(lows_out + lo, bm->src + r.off, 2ull * r.card);
+          lo += r.card;
+        } else {
+          uint64_t tmp[kContainerWords];
+          compact_expand(bm, i, tmp);
+          for (int w = 0; w < kContainerWords; w++) {
+            uint64_t x = tmp[w];
+            while (x) {
+              lows_out[lo++] =
+                  static_cast<uint16_t>((w << 6) | __builtin_ctzll(x));
+              x &= x - 1;
+            }
+          }
+        }
+      } else {
+        compact_expand(bm, i, dense_out + dn * kContainerWords);
+        dn++;
+      }
+    }
+    return;
+  }
   const bool cached = bm->counts.size() == bm->keys.size();
   for (size_t i = 0; i < bm->keys.size(); i++) {
     const uint64_t* c = &bm->words[i * kContainerWords];
