@@ -131,6 +131,7 @@ def cmd_server(args) -> int:
             heartbeat = Heartbeater(cluster,
                                     interval=cfg.heartbeat_interval,
                                     suspect_after=cfg.heartbeat_suspect,
+                                    probes_per_round=cfg.heartbeat_probes,
                                     logger=logger)
             heartbeat.start()
         if cfg.translate_replication_interval > 0:

@@ -44,6 +44,7 @@ class Config:
     # gossip/gossip.go:246; here a direct heartbeat prober)
     heartbeat_interval: float = 5.0     # 0 disables
     heartbeat_suspect: int = 3          # consecutive failures -> DOWN
+    heartbeat_probes: int = 2           # healthy peers probed per round
     # Standing translate-log replication from the primary (reference
     # monitorReplication, translate.go:359); 0 disables
     translate_replication_interval: float = 10.0
