@@ -2,12 +2,16 @@
 a naive numpy-set reference model (the analog of the reference's
 programmatic query generators, internal/test/querygenerator.go)."""
 
+import os
+
 import numpy as np
 import pytest
 
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.executor import Executor
 from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+SEED_OFFSET = int(os.environ.get("PILOSA_TEST_SEED", 0))
 
 N_FIELDS = 3
 ROWS_PER_FIELD = 4
@@ -21,7 +25,7 @@ def world(tmp_path_factory):
     h = Holder(str(tmp))
     h.open()
     idx = h.create_index("p")
-    rng = np.random.default_rng(99)
+    rng = np.random.default_rng(99 + SEED_OFFSET)
     model = {}  # (field, row) -> set of columns
     universe = set()
     for fi in range(N_FIELDS):
@@ -77,7 +81,7 @@ def gen_tree(rng, depth):
 
 def test_random_trees_match_set_model(world):
     ex, model, universe = world
-    rng = np.random.default_rng(123)
+    rng = np.random.default_rng(123 + SEED_OFFSET)
     for i in range(40):
         pql, ev = gen_tree(rng, depth=3)
         want = ev(model, universe)
@@ -93,7 +97,7 @@ def test_random_trees_batched_query(world):
     """All trees in ONE multi-call query string — exercises the
     dispatch-then-fetch pipeline shape at property scale."""
     ex, model, universe = world
-    rng = np.random.default_rng(7)
+    rng = np.random.default_rng(7 + SEED_OFFSET)
     trees = [gen_tree(rng, depth=2) for _ in range(12)]
     results = ex.execute("p", " ".join(f"Count({p})" for p, _ in trees))
     for (pql, ev), got in zip(trees, results):
@@ -117,7 +121,7 @@ def test_random_ops_with_interleaved_optimize(tmp_path):
     roaring.go:1927-2100)."""
     from pilosa_tpu.storage.roaring import ARRAY_MAX_SIZE, Bitmap
 
-    rng = np.random.default_rng(3)
+    rng = np.random.default_rng(3 + SEED_OFFSET)
     b = Bitmap()
     model = set()
     universe = 5 << 16

@@ -3,6 +3,8 @@ set rows, BSI conditions, time ranges, aggregates, TopN — checked against
 a naive host model (the analog of the reference's programmatic query
 generators, internal/test/querygenerator.go, widened past bitmap algebra)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -10,6 +12,10 @@ from pilosa_tpu.core.field import FieldOptions
 from pilosa_tpu.core.holder import Holder
 from pilosa_tpu.executor import Executor
 from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+# Seed offset: a CI/burn-in loop can sweep PILOSA_TEST_SEED to fuzz
+# fresh schedules; default 0 keeps runs deterministic.
+SEED_OFFSET = int(os.environ.get("PILOSA_TEST_SEED", 0))
 
 N_SHARDS = 2
 SET_ROWS = 4
@@ -25,7 +31,7 @@ def world(tmp_path_factory):
     h = Holder(str(tmp))
     h.open()
     idx = h.create_index("q")
-    rng = np.random.default_rng(41)
+    rng = np.random.default_rng(41 + SEED_OFFSET)
     universe_n = N_SHARDS * SHARD_WIDTH
 
     sets = {}  # (field, row) -> set(cols)
@@ -124,7 +130,7 @@ def gen_tree(rng, depth, sets, ints, times, universe):
 
 def test_full_surface_trees(world):
     ex, sets, ints, times, universe = world
-    rng = np.random.default_rng(17)
+    rng = np.random.default_rng(17 + SEED_OFFSET)
     for i in range(50):
         pql, ev = gen_tree(rng, 3, sets, ints, times, universe)
         want = ev()
@@ -136,7 +142,7 @@ def test_full_surface_trees(world):
 
 def test_aggregates_with_random_filters(world):
     ex, sets, ints, times, universe = world
-    rng = np.random.default_rng(29)
+    rng = np.random.default_rng(29 + SEED_OFFSET)
     for i in range(25):
         pql, ev = gen_tree(rng, 2, sets, ints, times, universe)
         domain = {c: v for c, v in ints.items() if c in ev()}
@@ -156,7 +162,7 @@ def test_aggregates_with_random_filters(world):
 
 def test_topn_with_random_filters(world):
     ex, sets, ints, times, universe = world
-    rng = np.random.default_rng(31)
+    rng = np.random.default_rng(31 + SEED_OFFSET)
     for i in range(15):
         pql, ev = gen_tree(rng, 2, sets, ints, times, universe)
         filt = ev()
@@ -173,7 +179,7 @@ def test_topn_with_random_filters(world):
 
 def test_groupby_with_random_filter(world):
     ex, sets, ints, times, universe = world
-    rng = np.random.default_rng(37)
+    rng = np.random.default_rng(37 + SEED_OFFSET)
     for i in range(10):
         pql, ev = gen_tree(rng, 1, sets, ints, times, universe)
         filt = ev()
