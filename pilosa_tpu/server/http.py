@@ -36,7 +36,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
-from pilosa_tpu.server import wire
+from pilosa_tpu.server import proto_compat, wire
 from pilosa_tpu.server.api import API, ApiError
 
 
@@ -106,6 +106,77 @@ class Handler(BaseHTTPRequestHandler):
             return json.loads(raw)
         except json.JSONDecodeError as e:
             raise ApiError(f"invalid JSON body: {e}")
+
+    @staticmethod
+    def _wrap_options(pql, optargs: dict):
+        """Wrap every call of a PQL string in Options(...) — the
+        request-level ExecOptions shape (reference PostQuery optional
+        args, http/handler.go:186)."""
+        if not optargs:
+            return pql
+        from pilosa_tpu.pql import parse_string
+        from pilosa_tpu.pql.ast import Call, Query
+        parsed = parse_string(pql)
+        return Query([Call("Options", dict(optargs), [c])
+                      for c in parsed.calls])
+
+    def _exec_optargs(self, q: dict, req: Optional[dict] = None) -> dict:
+        """Exec options from URL args, OR'd with protobuf request flags."""
+        return {k: True for k in
+                ("columnAttrs", "excludeRowAttrs", "excludeColumns")
+                if self._qbool(q, k) or (req or {}).get(k)}
+
+    def _query_proto(self, api, index: str, raw: bytes, q: dict) -> None:
+        """Reference-client protobuf query: decode internal.QueryRequest,
+        execute, answer internal.QueryResponse
+        (http/handler.go:916-995)."""
+        try:
+            req = proto_compat.decode_query_request(raw)
+        except proto_compat.ProtoError as e:
+            raise ApiError(f"invalid protobuf body: {e}")
+        shards = req["shards"] or None
+        if q.get("shards"):
+            shards = [int(s) for s in q["shards"].split(",")]
+        try:
+            pql = self._wrap_options(req["query"],
+                                     self._exec_optargs(q, req))
+            res = api.query(index, pql, shards=shards,
+                            remote=req["remote"] or self._qbool(q, "remote"))
+            body = proto_compat.encode_query_response(
+                res["results"], column_attr_sets=res.get("columnAttrs"))
+        except ValueError as e:
+            body = proto_compat.encode_query_response([], err=str(e))
+            self._bytes(body, status=400,
+                        ctype=proto_compat.RESPONSE_CONTENT_TYPE)
+            return
+        self._bytes(body, ctype=proto_compat.RESPONSE_CONTENT_TYPE)
+
+    def _proto_import_body(self, api, index: str, field: str) -> dict:
+        """Decode a reference-client import body by field type
+        (http/handler.go:1036-1060): int fields carry
+        ImportValueRequest, everything else ImportRequest. Timestamps
+        are unix nanos (api.go:901) — converted to the seconds floats
+        the JSON path accepts."""
+        raw = self._body()
+        idx = api.holder.index(index)
+        f = idx.field(field) if idx is not None else None
+        try:
+            if f is not None and f.options.type == "int":
+                b = proto_compat.decode_import_value_request(raw)
+            else:
+                b = proto_compat.decode_import_request(raw)
+        except proto_compat.ProtoError as e:
+            raise ApiError(f"invalid protobuf body: {e}")
+        out = {k: v for k, v in b.items()
+               if k in ("rowIDs", "columnIDs", "values") and len(v)}
+        for k in ("rowKeys", "columnKeys"):
+            if b.get(k):
+                out[k] = b[k]
+        if b.get("timestamps"):
+            out["timestamps"] = [t / 1e9 for t in b["timestamps"]]
+        if "values" in b and "values" not in out:
+            out["values"] = []  # int-field import keeps the values path
+        return out
 
     def _route(self) -> Tuple[str, dict, dict]:
         parsed = urlparse(self.path)
@@ -235,6 +306,12 @@ class Handler(BaseHTTPRequestHandler):
                 self._check_args(q, "shards", "remote", "columnAttrs",
                                  "excludeRowAttrs", "excludeColumns")
                 raw = self._body()
+                # Reference-client protobuf surface
+                # (http/handler.go:916-995, internal/public.proto).
+                if self.headers.get("Content-Type", "").startswith(
+                        proto_compat.CONTENT_TYPE):
+                    self._query_proto(api, m.group(1), raw, q)
+                    return True
                 try:
                     body = json.loads(raw) if raw.lstrip()[:1] == b"{" else None
                 except json.JSONDecodeError:
@@ -246,16 +323,8 @@ class Handler(BaseHTTPRequestHandler):
                 # URL-arg execution options apply to every call, same as
                 # the reference's request-level ExecOptions
                 # (http/handler.go:186 PostQuery optional args).
-                optargs = {k: True for k in
-                           ("columnAttrs", "excludeRowAttrs",
-                            "excludeColumns") if self._qbool(q, k)}
                 try:
-                    if optargs:
-                        from pilosa_tpu.pql import parse_string
-                        from pilosa_tpu.pql.ast import Call, Query
-                        parsed = parse_string(pql)
-                        pql = Query([Call("Options", dict(optargs), [c])
-                                     for c in parsed.calls])
+                    pql = self._wrap_options(pql, self._exec_optargs(q))
                     self._json(api.query(m.group(1), pql, shards=shards,
                                          remote=self._qbool(q, "remote")))
                 except ValueError as e:
@@ -263,7 +332,15 @@ class Handler(BaseHTTPRequestHandler):
             elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)/import",
                                    path):
                 self._check_args(q, "clear", "remote", "ignoreKeyCheck")
-                b = self._body_json()
+                if self.headers.get("Content-Type", "").startswith(
+                        proto_compat.CONTENT_TYPE):
+                    # Reference clients: message type follows the field
+                    # type (int -> ImportValueRequest, else
+                    # ImportRequest; http/handler.go:1036-1060).
+                    b = self._proto_import_body(api, m.group(1),
+                                                m.group(2))
+                else:
+                    b = self._body_json()
                 remote = self._qbool(q, "remote")
                 ignore_keys = self._qbool(q, "ignoreKeyCheck")
                 if "values" in b:
@@ -286,11 +363,28 @@ class Handler(BaseHTTPRequestHandler):
                     r"/index/([^/]+)/field/([^/]+)/import-roaring/(\d+)",
                     path):
                 self._check_args(q, "remote", "clear", "view")
-                api.import_roaring(m.group(1), m.group(2), int(m.group(3)),
-                                   self._body(),
-                                   clear=self._qbool(q, "clear"),
-                                   view=q.get("view", "standard"),
-                                   remote=self._qbool(q, "remote"))
+                raw = self._body()
+                if self.headers.get("Content-Type", "").startswith(
+                        proto_compat.CONTENT_TYPE):
+                    # Reference-client ImportRoaringRequest: per-view
+                    # roaring payloads + clear flag
+                    # (http/handler.go:1554, public.proto).
+                    try:
+                        b = proto_compat.decode_import_roaring_request(raw)
+                    except proto_compat.ProtoError as e:
+                        raise ApiError(f"invalid protobuf body: {e}")
+                    for view_name, blob in b["views"]:
+                        api.import_roaring(
+                            m.group(1), m.group(2), int(m.group(3)), blob,
+                            clear=b["clear"] or self._qbool(q, "clear"),
+                            view=view_name or q.get("view", "standard"),
+                            remote=self._qbool(q, "remote"))
+                else:
+                    api.import_roaring(m.group(1), m.group(2),
+                                       int(m.group(3)), raw,
+                                       clear=self._qbool(q, "clear"),
+                                       view=q.get("view", "standard"),
+                                       remote=self._qbool(q, "remote"))
                 self._json({})
             elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)", path):
                 b = self._body_json()
