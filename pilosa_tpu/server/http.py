@@ -419,6 +419,20 @@ class Handler(BaseHTTPRequestHandler):
             elif path == "/cluster/resize/abort":
                 self._json(api.resize_abort())
             elif path == "/internal/translate/keys":
+                if self.headers.get("Content-Type", "").startswith(
+                        proto_compat.CONTENT_TYPE):
+                    # Reference protobuf leg (http/handler.go:1617).
+                    try:
+                        b = proto_compat.decode_translate_keys_request(
+                            self._body())
+                    except proto_compat.ProtoError as e:
+                        raise ApiError(f"invalid protobuf body: {e}")
+                    ids = api.translate_keys_local(
+                        b["index"], b.get("field") or None, b["keys"])
+                    self._bytes(
+                        proto_compat.encode_translate_keys_response(ids),
+                        ctype=proto_compat.RESPONSE_CONTENT_TYPE)
+                    return True
                 b = self._body_json()
                 keys = b.get("keys", [])
                 ids = api.translate_keys_local(b["index"], b.get("field"),

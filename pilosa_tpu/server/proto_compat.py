@@ -232,6 +232,24 @@ def decode_import_roaring_request(data: bytes) -> Dict[str, Any]:
     return out
 
 
+def decode_translate_keys_request(data: bytes) -> Dict[str, Any]:
+    """internal.TranslateKeysRequest: Index=1, Field=2, Keys=3."""
+    out: Dict[str, Any] = {"index": "", "field": "", "keys": []}
+    for f, wt, v in _fields(data):
+        if f == 1 and wt == _WIRE_LEN:
+            out["index"] = _utf8(v)
+        elif f == 2 and wt == _WIRE_LEN:
+            out["field"] = _utf8(v)
+        elif f == 3 and wt == _WIRE_LEN:
+            out["keys"].append(_utf8(v))
+    return out
+
+
+def encode_translate_keys_response(ids) -> bytes:
+    """internal.TranslateKeysResponse: IDs=3 (packed uint64)."""
+    return _packed_uint64(3, ids)
+
+
 # ------------------------------------------------------ response encode
 
 def _encode_attr(key: str, value) -> bytes:

@@ -16,6 +16,42 @@ import pytest
 from pilosa_tpu.server import proto_compat
 
 
+def test_translate_keys_protobuf_leg(live_server):
+    """Reference clients translate keys over protobuf
+    (http/handler.go:1617): TranslateKeysRequest in,
+    TranslateKeysResponse (packed IDs) out."""
+    from pilosa_tpu.server.proto_compat import (
+        decode_translate_keys_request,
+        encode_translate_keys_response,
+        _fields,
+    )
+
+    base, api, _h = live_server
+    api.create_index("tk", {"keys": True})
+    api.create_field("tk", "f", {})
+    body = (b"\x0a\x02tk"            # Index=1 "tk"
+            b"\x1a\x05alpha"         # Keys=3 "alpha"
+            b"\x1a\x04beta")         # Keys=3 "beta"
+    assert decode_translate_keys_request(body) == {
+        "index": "tk", "field": "", "keys": ["alpha", "beta"]}
+    r = urllib.request.Request(
+        base + "/internal/translate/keys", data=body, method="POST",
+        headers={"Content-Type": "application/x-protobuf"})
+    with urllib.request.urlopen(r) as resp:
+        payload = resp.read()
+        assert resp.headers["Content-Type"] == "application/protobuf"
+    # Parse the packed-IDs response with the hand codec's field walker.
+    from pilosa_tpu.server.proto_compat import _repeated_uint64
+    ids = _repeated_uint64(_fields(payload), 3)
+    assert len(ids) == 2 and len(set(ids)) == 2
+    # Same keys again -> same ids (get-or-allocate).
+    with urllib.request.urlopen(urllib.request.Request(
+            base + "/internal/translate/keys", data=body, method="POST",
+            headers={"Content-Type": "application/x-protobuf"})) as resp:
+        assert _repeated_uint64(_fields(resp.read()), 3) == ids
+    assert encode_translate_keys_response(ids) == payload
+
+
 def _build_messages():
     """Dynamic protobuf message classes matching internal/public.proto."""
     from google.protobuf import descriptor_pb2, descriptor_pool
