@@ -306,10 +306,12 @@ class Field:
         column_ids = np.asarray(column_ids, dtype=np.uint64)
         self._check_column_bound(column_ids)
 
-        # Route (row, col) pairs per target view.
-        by_view: Dict[str, List[int]] = {}
+        # Route (row, col) pairs per target view. None = every pair (no
+        # index array, no copy: a 10M-pair fingerprint import must not
+        # build a 10M-entry Python list just to select "all").
+        by_view: Dict[str, Optional[List[int]]] = {}
         if timestamps is None or self.options.no_standard_view is False:
-            by_view[VIEW_STANDARD] = list(range(len(row_ids)))
+            by_view[VIEW_STANDARD] = None
         if timestamps is not None:
             if self.options.type != FIELD_TYPE_TIME:
                 raise ValueError("timestamps on non-time field")
@@ -324,8 +326,12 @@ class Field:
             if vname == VIEW_STANDARD and self.options.no_standard_view:
                 continue
             view = self.create_view_if_not_exists(vname)
-            rows = row_ids[idxs]
-            cols = column_ids[idxs]
+            if idxs is None:
+                rows, cols = row_ids, column_ids
+            else:
+                sel = np.asarray(idxs, dtype=np.int64)
+                rows = row_ids[sel]
+                cols = column_ids[sel]
             shards = cols // np.uint64(SHARD_WIDTH)
             for shard in np.unique(shards):
                 m = shards == shard

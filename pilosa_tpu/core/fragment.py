@@ -558,10 +558,25 @@ class Fragment:
                 keys = (np.concatenate(key_chunks) if len(key_chunks) > 1
                         else key_chunks[0])
                 touched = np.unique(keys // np.uint64(CONTAINERS_PER_ROW))
+            self._prelatch_cache_saturation(touched)
             for r in touched.tolist():
                 self._touch_row(int(r))
                 self._cache_update(int(r))
             self._maybe_snapshot()
+
+    def _prelatch_cache_saturation(self, touched) -> None:
+        """If this batch's row set will blow the ranked-cache bound
+        anyway, latch saturation up front: the per-row recount loop is
+        pure waste when the cache can never prove completeness
+        afterwards (see RankedCache — adds past the bound would latch
+        it during the loop regardless)."""
+        cache = self.cache
+        if not isinstance(cache, cache_mod.RankedCache) or cache.saturated:
+            return
+        total = len(cache.counts.keys()
+                    | {int(r) for r in touched.tolist()})
+        if total > cache.size * cache_mod.THRESHOLD_FACTOR:
+            cache.saturated = True
 
     def bulk_import_mutex(self, row_ids: np.ndarray, column_ids: np.ndarray
                           ) -> None:

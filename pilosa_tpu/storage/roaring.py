@@ -496,6 +496,7 @@ class Bitmap:
         keys = positions[starts] >> np.uint64(16)
         key_list = [int(k) for k in keys.tolist()]
         lows = (positions & np.uint64(0xFFFF)).astype(np.uint16)
+        counts_arr = np.diff(bounds.astype(np.int64))
         groups = [lows[bounds[i]:bounds[i + 1]]
                   for i in range(len(starts))]
         payload = None
@@ -506,6 +507,15 @@ class Bitmap:
                 ((k, g, len(g)) for k, g in zip(key_list, groups)),
                 len(key_list))
         self._append_roaring_record(payload, len(positions))
+        if self.containers.keys().isdisjoint(key_list) and \
+                int(counts_arr.max(initial=0)) <= ARRAY_MAX_SIZE:
+            # All-new sorted-unique array containers (the
+            # fingerprint-import shape: a million one-container rows):
+            # one C-level dict build instead of a per-key Python loop,
+            # counts seeded from the group lengths.
+            self.containers.update(zip(key_list, groups))
+            self._counts.update(zip(key_list, counts_arr.tolist()))
+            return keys
         for k, g in zip(key_list, groups):
             if k not in self.containers:
                 if len(g) <= ARRAY_MAX_SIZE:
