@@ -1,0 +1,60 @@
+#!/bin/bash
+# Round-4 TPU suite: waits for the tunnel, then runs every bench
+# serially — results land in benches/*_r04_tpu.jsonl. Order matters:
+# bench.py first (persists the benches/last_good_tpu.json carry-forward
+# sidecar so the round can never again lose its TPU evidence to a
+# later tunnel outage — VERDICT r3 item 1), then micro (the validated
+# AND+popcount roofline table + the Pallas-vs-XLA re-measurement,
+# VERDICT r3 item 9), then the BASELINE suite configs, then the
+# flagship-SCALE legs (VERDICT r3 item 2): tanimoto at 10M (safety
+# leg, 3 iters) and the full 100M (1 iter), taxi at 100M rides
+# (100 shards). Between benches it WAITS for the tunnel to return
+# rather than aborting, so a mid-suite outage costs one leg, not the
+# round.
+cd /root/repo
+probe() {
+  timeout 75 python -c "
+import jax, jax.numpy as jnp
+print(int(jnp.ones((8,), jnp.uint32).sum()))" >/dev/null 2>&1
+}
+wait_tpu() {
+  until probe; do
+    echo "$(date -u +%H:%M:%S) waiting for TPU..." >&2
+    sleep 120
+  done
+  echo "$(date -u +%H:%M:%S) TPU answered" >&2
+}
+run() {  # run <name> <timeout> <cmd...>
+  local name=$1 to=$2; shift 2
+  # Skip legs that already completed (marker file), so the watcher can
+  # be restarted without redoing hours of work.
+  if [ -e "benches/.${name}_r04_done" ]; then
+    echo "$(date -u +%H:%M:%S) bench: $name already done, skipping" >&2
+    return
+  fi
+  wait_tpu
+  echo "$(date -u +%H:%M:%S) bench: $name" >&2
+  timeout "$to" "$@" > "benches/${name}_r04_tpu.jsonl" 2> "benches/${name}_r04_tpu.err"
+  local rc=$?
+  echo "$(date -u +%H:%M:%S) bench: $name rc=$rc" >&2
+  # A leg counts as done when it produced at least one JSON record.
+  if [ -s "benches/${name}_r04_tpu.jsonl" ]; then
+    touch "benches/.${name}_r04_done"
+  fi
+}
+wait_tpu
+if [ ! -e benches/.bench_early_r04_done ]; then
+  echo "$(date -u +%H:%M:%S) early bench.py (sidecar capture)" >&2
+  timeout 1800 python bench.py > BENCH_early_r04.json 2> bench_early_r04.err
+  echo "$(date -u +%H:%M:%S) bench.py rc=$?" >&2
+  [ -s BENCH_early_r04.json ] && touch benches/.bench_early_r04_done
+fi
+run micro 3600 python benches/micro.py
+run startrace 1200 python benches/startrace.py
+run bsi 1800 python benches/bsi.py
+run tanimoto_chunked_10m 3600 env PILOSA_TANIMOTO_N=10000000 PILOSA_TANIMOTO_ITERS=3 python benches/tanimoto_chunked.py
+run taxi_100m 7200 env PILOSA_TAXI_N=100000000 PILOSA_TAXI_ITERS=3 python benches/taxi.py
+run tanimoto_chunked_100m 14400 env PILOSA_TANIMOTO_N=100000000 PILOSA_TANIMOTO_ITERS=1 python benches/tanimoto_chunked.py
+run tanimoto 1800 python benches/tanimoto.py
+run taxi_10m 3600 env PILOSA_TAXI_N=10000000 PILOSA_TAXI_ITERS=3 python benches/taxi.py
+echo "$(date -u +%H:%M:%S) suite done" >&2

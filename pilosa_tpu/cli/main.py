@@ -342,6 +342,59 @@ def cmd_restore(args) -> int:
     return 0
 
 
+def cmd_fold(args) -> int:
+    """Rewrite fragment files as pure reference-format snapshots.
+
+    This framework's bulk imports append OP_ADD_ROARING extension
+    records (storage/roaring.py OP_ADD_ROARING) that the reference
+    implementation rejects as an unknown op type — data files are
+    one-way compatible until folded (ADVICE r3). Folding replays the
+    op-log into the snapshot and rewrites the file with no op tail, so
+    a reference node (roaring.go:1037 unmarshalPilosaRoaring) can open
+    it: the downgrade/rollback path. Atomic per file (tmp + rename);
+    idempotent."""
+    from pilosa_tpu.storage.roaring import Bitmap
+
+    bad = 0
+    for path in args.files:
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+            b = Bitmap.from_bytes(raw, tolerate_torn_tail=True)
+            if b.tail_dropped and not args.force:
+                bad += 1
+                print(f"{path}: torn op tail ({b.tail_dropped} bytes); "
+                      "re-run with --force to fold anyway",
+                      file=sys.stderr)
+                continue
+            if b.tail_dropped:
+                # Same never-destroy-bytes rule as Fragment.open: the
+                # dropped tail (a torn append — or, past the torn-append
+                # bound, a possibly-salvageable suffix swallowed by a
+                # corrupt length field) goes to a .torn sidecar BEFORE
+                # the rewrite discards it from the main file.
+                side = path + ".torn"
+                with open(side, "ab") as f:
+                    f.write(raw[len(raw) - b.tail_dropped:])
+                    f.flush()
+                    os.fsync(f.fileno())
+                print(f"{path}: sidecarred {b.tail_dropped} torn tail "
+                      f"bytes to {side}", file=sys.stderr)
+            out = b.write_bytes()
+            tmp = path + ".folding"
+            with open(tmp, "wb") as f:
+                f.write(out)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            print(f"{path}: folded to pure snapshot "
+                  f"({len(out)} bytes, {b.count()} bits)")
+        except Exception as e:
+            bad += 1
+            print(f"{path}: FOLD FAILED: {e}", file=sys.stderr)
+    return 1 if bad else 0
+
+
 def cmd_generate_config(args) -> int:
     from pilosa_tpu.utils.config import Config
 
@@ -402,6 +455,14 @@ def main(argv=None) -> int:
     gp = sub.add_parser("config", help="print resolved configuration")
     gp.add_argument("-c", "--config", default=None)
     gp.set_defaults(fn=cmd_config)
+
+    fp = sub.add_parser(
+        "fold", help="rewrite fragment files as pure snapshots "
+        "(reference-readable: drops OP_ADD_ROARING extension records)")
+    fp.add_argument("files", nargs="+")
+    fp.add_argument("--force", action="store_true",
+                    help="fold even files with a torn op tail")
+    fp.set_defaults(fn=cmd_fold)
 
     gg = sub.add_parser("generate-config", help="print default TOML config")
     gg.set_defaults(fn=cmd_generate_config)

@@ -47,17 +47,22 @@ DEFAULT_MAX_OP_N = 10000
 # size) while amortizing rewrites across many batches.
 OPLOG_FOLD_MIN_BYTES = 32 << 20
 
-# Torn-tail tolerance bound (ADVICE r2): a dangling tail larger than any
-# plausible single record is mid-file corruption, not a torn append —
-# refuse to open rather than silently sidecar a huge valid suffix.
-# bulk_import chunks batches at IMPORT_CHUNK_PAIRS, which bounds a
-# single OP_ADD_ROARING record payload well under this.
-MAX_TORN_TAIL_BYTES = 64 << 20
-
 # Bulk imports are split into chunks of this many (row, col) pairs: caps
 # a single op record (so MAX_TORN_TAIL_BYTES really does exceed any
 # legitimate record) and bounds the scatter's peak working memory.
 IMPORT_CHUNK_PAIRS = 4 << 20
+
+# Torn-tail tolerance bound (ADVICE r2): a dangling tail larger than any
+# plausible single record is mid-file corruption, not a torn append —
+# refuse to open rather than silently sidecar a huge valid suffix. The
+# worst legitimate OP_ADD_ROARING record is an IMPORT_CHUNK_PAIRS batch
+# where every pair lands in a distinct container: 18 bytes/container
+# (12-byte descriptor + 4-byte offset + one 2-byte array value,
+# roaring._serialize_container_seq) ≈ 72 MiB at 4M pairs — so the bound
+# is sized FROM that worst case with 2x headroom (ADVICE r3: the old
+# fixed 64 MiB sat below it, making a crash mid-append of a legitimate
+# record unopenable).
+MAX_TORN_TAIL_BYTES = 2 * (18 * IMPORT_CHUNK_PAIRS + (1 << 16))
 
 # Containers per shard row: 2^20 / 2^16.
 CONTAINERS_PER_ROW = SHARD_WIDTH // CONTAINER_BITS

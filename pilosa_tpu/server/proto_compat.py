@@ -95,9 +95,13 @@ def _fields(buf: bytes) -> List[Tuple[int, int, Any]]:
             v = buf[i:i + n]
             i += n
         elif wt == _WIRE_I64:
+            if i + 8 > len(buf):
+                raise ProtoError("truncated fixed64 field")
             v = buf[i:i + 8]
             i += 8
         elif wt == _WIRE_I32:
+            if i + 4 > len(buf):
+                raise ProtoError("truncated fixed32 field")
             v = buf[i:i + 4]
             i += 4
         else:

@@ -171,13 +171,14 @@ class ExportingTracer(RecordingTracer):
     @contextlib.contextmanager
     def span(self, name: str, **attrs):
         stack = self._stack()
+        s = None  # super().span may raise before yielding (ADVICE r3)
         try:
             with super().span(name, **attrs) as s:
                 yield s
         finally:
             # Queue on the error path too: traces of FAILED requests are
             # the ones operators need most.
-            if not stack:  # a root span just finished
+            if not stack and s is not None:  # a root span just finished
                 with self._pending_lock:
                     self._pending.append(s)
                     full = len(self._pending) >= self.batch_size

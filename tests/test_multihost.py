@@ -10,6 +10,26 @@ import sys
 import pytest
 
 
+def test_timeout_mark_is_enforced():
+    """The vendored SIGALRM timeout (conftest.alarm_timeout) actually
+    interrupts a blocking wait — a hung distributed child must fail the
+    suite, not hang it (VERDICT r3 weak #4). The helper is taken from
+    the conftest module pytest ALREADY loaded (its import name varies
+    with rootdir/package layout, and a fresh `import tests.conftest`
+    would execute it a second time)."""
+    import time
+
+    alarm_timeout = next(
+        m.alarm_timeout for name, m in sorted(sys.modules.items())
+        if name.endswith("conftest") and hasattr(m, "alarm_timeout"))
+
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError, match="exceeded 1s"):
+        with alarm_timeout(1, what="sleeper"):
+            time.sleep(30)
+    assert time.monotonic() - t0 < 5
+
+
 @pytest.mark.timeout(360)
 def test_two_process_jax_distributed_dryrun():
     env = dict(os.environ)
