@@ -80,10 +80,10 @@ def main():
                                   FieldOptions(type="time",
                                                time_quantum="YMD"))
         from datetime import datetime
+        days = rng.integers(0, 28, N_TIMED)  # kept for the numpy baseline
         pickup.import_bits(
             np.zeros(N_TIMED, np.uint64), cols[:N_TIMED],
-            timestamps=[datetime(2019, 1, 1 + int(d))
-                        for d in rng.integers(0, 28, N_TIMED)])
+            timestamps=[datetime(2019, 1, 1 + int(d)) for d in days])
         idx.add_existence(cols)
         load_s = time.perf_counter() - t0
         log(f"taxi: loaded in {load_s:.1f}s")
@@ -148,10 +148,18 @@ def main():
             assert gc.count == int(((cab == c) & (pax == p)).sum())
         emit("taxi_groupby_p50", t, c5, groups=len(got))
 
-        # 6. time-range row count
+        # 6. time-range row count. Baseline: the same [from, to) date
+        # filter vectorized over the drawn days (this leg shipped with
+        # emit(t, t) — i.e. no baseline at all — through r03, which is
+        # why it sat at vs_baseline 1.0 in every record; VERDICT r3
+        # item 10).
         t, got = p50("Count(Row(pickup=0, from='2019-01-05', "
                      "to='2019-01-12'))")
-        emit("taxi_time_range_count_p50", t, t, count=got)
+        t0 = time.perf_counter()
+        want = int(((days >= 4) & (days < 11)).sum())  # days 5..11 Jan
+        c6 = time.perf_counter() - t0
+        assert got == want, (got, want)
+        emit("taxi_time_range_count_p50", t, c6, count=got)
 
         print(json.dumps({
             "metric": "taxi_workload_total",
