@@ -65,7 +65,7 @@ def cmd_server(args) -> int:
         from pilosa_tpu.parallel.cluster import (
             Cluster, Node, STATE_NORMAL,
         )
-        local_uri = cfg.advertise or f"http://{cfg.bind}"
+        local_uri = cfg.advertise or f"{cfg.scheme}://{cfg.bind}"
         cluster = Cluster(
             Node(local_uri, local_uri,
                  is_coordinator=(local_uri == sorted(cfg.cluster_peers)[0])),
@@ -101,7 +101,7 @@ def cmd_server(args) -> int:
     else:
         tracer = RecordingTracer()
     api = API(holder, mesh=mesh, cluster=cluster, stats=stats,
-              tracer=tracer)
+              tracer=tracer, client_ssl_context=cfg.client_ssl_context())
     api.logger = logger
     api.long_query_time = cfg.long_query_time
     api.executor.max_writes_per_request = cfg.max_writes_per_request
@@ -132,18 +132,21 @@ def cmd_server(args) -> int:
                                     interval=cfg.heartbeat_interval,
                                     suspect_after=cfg.heartbeat_suspect,
                                     probes_per_round=cfg.heartbeat_probes,
-                                    logger=logger)
+                                    logger=logger,
+                                    ssl_context=cfg.client_ssl_context())
             heartbeat.start()
         if cfg.translate_replication_interval > 0:
             translate_repl = TranslateReplicationLoop(
                 api, cfg.translate_replication_interval)
             translate_repl.start()
-    logger.printf("pilosa-tpu server: data=%s bind=%s mesh=%s cluster=%s",
-                  data_dir, cfg.bind,
+    logger.printf("pilosa-tpu server: data=%s bind=%s tls=%s mesh=%s "
+                  "cluster=%s", data_dir, cfg.bind,
+                  "on" if cfg.tls_enabled else "off",
                   mesh.mesh.shape if mesh else "single-device",
                   f"{len(cluster.nodes())} nodes" if cluster else "no")
     try:
-        serve(api, cfg.host, cfg.port)
+        serve(api, cfg.host, cfg.port,
+              ssl_context=cfg.server_ssl_context())
     finally:
         if heartbeat is not None:
             heartbeat.stop()

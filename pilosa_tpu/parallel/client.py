@@ -31,41 +31,55 @@ class ClientError(RuntimeError):
 
 
 class _ConnPool:
-    """Keep-alive HTTP/1.1 connections per (host, port). The reference
-    gets this from Go's default http.Transport pooling; without it every
-    scatter-gather leg pays a TCP handshake."""
+    """Keep-alive HTTP/1.1 connections per (scheme, host, port). The
+    reference gets this from Go's default http.Transport pooling (TLS
+    included); without it every scatter-gather leg pays a TCP — and for
+    https a TLS — handshake."""
 
     MAX_IDLE_PER_HOST = 8
 
-    def __init__(self, timeout: float):
+    def __init__(self, timeout: float, ssl_context=None):
         self.timeout = timeout
+        self.ssl_context = ssl_context
         self._idle: Dict[tuple, list] = {}
         self._lock = threading.Lock()
 
-    @staticmethod
-    def _new_conn(host: str, port: int,
+    def _new_conn(self, scheme: str, host: str, port: int,
                   timeout: float) -> http.client.HTTPConnection:
         import socket as _socket
-        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        if scheme == "https":
+            ctx = self.ssl_context
+            if ctx is None:
+                # https peer with no configured context: strict default
+                # verification (system CA bundle) — never silently
+                # downgrade to unverified.
+                import ssl
+                ctx = ssl.create_default_context()
+                self.ssl_context = ctx
+            conn = http.client.HTTPSConnection(host, port, timeout=timeout,
+                                               context=ctx)
+        else:
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
         conn.connect()
         # Nagle + delayed-ACK on a reused connection turns every small
         # header+body request pair into a ~40 ms stall; disable it.
-        conn.sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        raw = getattr(conn.sock, "socket", conn.sock)  # unwrap SSLSocket
+        raw.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         return conn
 
-    def get(self, host: str, port: int):
+    def get(self, scheme: str, host: str, port: int):
         """-> (connection, reused): reused=True means it came from the
         idle pool and may have been closed server-side while idle."""
         with self._lock:
-            idle = self._idle.get((host, port))
+            idle = self._idle.get((scheme, host, port))
             if idle:
                 return idle.pop(), True
-        return self._new_conn(host, port, self.timeout), False
+        return self._new_conn(scheme, host, port, self.timeout), False
 
-    def put(self, host: str, port: int,
+    def put(self, scheme: str, host: str, port: int,
             conn: http.client.HTTPConnection) -> None:
         with self._lock:
-            idle = self._idle.setdefault((host, port), [])
+            idle = self._idle.setdefault((scheme, host, port), [])
             if len(idle) < self.MAX_IDLE_PER_HOST:
                 idle.append(conn)
                 return
@@ -80,10 +94,15 @@ class _ConnPool:
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0, tracer=None):
+    def __init__(self, timeout: float = 30.0, tracer=None,
+                 ssl_context=None):
+        """`ssl_context` verifies https peers (config.client_ssl_context
+        builds it: CA bundle or skip-verify, reference
+        server/server.go:244 InsecureSkipVerify). None + an https URI =
+        strict system-CA verification."""
         self.timeout = timeout
         self.tracer = tracer
-        self._pool = _ConnPool(timeout)
+        self._pool = _ConnPool(timeout, ssl_context=ssl_context)
 
     def drop_idle(self) -> None:
         """Close every idle pooled connection (test harnesses use this to
@@ -111,16 +130,17 @@ class InternalClient:
         if self.tracer is not None:
             self.tracer.inject(headers)
         parts = urlsplit(url)
+        scheme = parts.scheme or "http"
         host = parts.hostname or "localhost"
-        port = parts.port or 80
+        port = parts.port or (443 if scheme == "https" else 80)
         path = parts.path + (f"?{parts.query}" if parts.query else "")
         one_off = timeout is not None
         try:
             if one_off:  # non-default timeout: dedicated connection
-                conn, reused = _ConnPool._new_conn(host, port,
-                                                   timeout), False
+                conn, reused = self._pool._new_conn(scheme, host, port,
+                                                    timeout), False
             else:
-                conn, reused = self._pool.get(host, port)
+                conn, reused = self._pool.get(scheme, host, port)
         except OSError as e:  # eager connect: refused/unreachable
             raise ClientError(f"{method} {url}: {e}") from e
         try:
@@ -143,8 +163,8 @@ class InternalClient:
                 conn.close()
                 if not reused or isinstance(e, TimeoutError):
                     raise
-                conn = _ConnPool._new_conn(host, port,
-                                           timeout or self.timeout)
+                conn = self._pool._new_conn(scheme, host, port,
+                                            timeout or self.timeout)
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
             payload = resp.read()
@@ -152,7 +172,7 @@ class InternalClient:
             ctype = resp.headers.get("Content-Type") or ""
             reusable = not one_off and not resp.will_close
             if reusable:
-                self._pool.put(host, port, conn)
+                self._pool.put(scheme, host, port, conn)
             else:
                 conn.close()
             if status >= 400:

@@ -40,13 +40,15 @@ class Heartbeater:
 
     def __init__(self, cluster: Cluster, interval: float = 2.0,
                  suspect_after: int = 3, timeout: Optional[float] = None,
-                 logger=None, probes_per_round: int = 2):
+                 logger=None, probes_per_round: int = 2,
+                 ssl_context=None):
         self.cluster = cluster
         self.interval = interval
         self.suspect_after = suspect_after
         self.probes_per_round = probes_per_round
         # Short probe timeout: a hung peer must not stall the prober.
-        self.client = InternalClient(timeout=timeout or max(interval, 1.0))
+        self.client = InternalClient(timeout=timeout or max(interval, 1.0),
+                                     ssl_context=ssl_context)
         self.logger = logger
         self._fails: Dict[str, int] = {}
         self._ring: List[str] = []

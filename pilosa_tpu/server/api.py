@@ -70,7 +70,7 @@ class ApiError(ValueError):
 
 class API:
     def __init__(self, holder: Holder, mesh=None, cluster=None,
-                 stats=None, tracer=None):
+                 stats=None, tracer=None, client_ssl_context=None):
         from pilosa_tpu.utils.logger import Logger
         from pilosa_tpu.utils.stats import NopStatsClient
         from pilosa_tpu.utils.tracing import NopTracer
@@ -89,7 +89,8 @@ class API:
             from pilosa_tpu.parallel.client import InternalClient
             from pilosa_tpu.parallel.cluster_executor import ClusterExecutor
             from pilosa_tpu.parallel.syncer import HolderSyncer, ResizePuller
-            client = InternalClient(tracer=self.tracer)
+            client = InternalClient(tracer=self.tracer,
+                                    ssl_context=client_ssl_context)
             self.cluster_executor = ClusterExecutor(self.executor, cluster,
                                                     client)
             self.syncer = HolderSyncer(holder, cluster, client)

@@ -512,12 +512,21 @@ class PilosaHTTPServer(ThreadingHTTPServer):
 
 
 def serve(api: API, host: str = "localhost", port: int = 10101,
-          background: bool = False):
+          background: bool = False, ssl_context=None):
     """Start the HTTP server (reference handler.Serve,
     http/handler.go:150). Returns the server; blocking unless
-    background=True."""
+    background=True. `ssl_context` (config.server_ssl_context) wraps the
+    listener for HTTPS — the reference's TLS listener,
+    server/server.go:244; one listener carries client AND intra-cluster
+    traffic either way."""
     handler = type("BoundHandler", (Handler,), {"api": api})
     server = PilosaHTTPServer((host, port), handler)
+    if ssl_context is not None:
+        # Handshake deferred to the per-connection handler thread (first
+        # read), so a slow TLS client cannot stall the accept loop.
+        server.socket = ssl_context.wrap_socket(
+            server.socket, server_side=True,
+            do_handshake_on_connect=False)
     if background:
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()

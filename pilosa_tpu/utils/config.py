@@ -67,7 +67,25 @@ class Config:
     # replication factor (reference cluster.replicas, server/config.go:63)
     cluster_peers: list = field(default_factory=list)
     cluster_replicas: int = 1
-    advertise: str = ""  # URI peers reach us at; default http://<bind>
+    advertise: str = ""  # URI peers reach us at; default <scheme>://<bind>
+    # TLS (reference server/config.go:120-166: TLS.CertificatePath,
+    # TLS.CertificateKeyPath, TLS.SkipCertificateVerification; listener
+    # wrap at server/server.go:244). When certificate+key are set the
+    # listener serves HTTPS — client AND intra-cluster traffic, like the
+    # reference — and peers are dialed as https. ca_certificate lets
+    # nodes verify a private CA without skip_verify.
+    tls_certificate: str = ""       # PEM server certificate (chain)
+    tls_key: str = ""               # PEM private key
+    tls_ca_certificate: str = ""    # PEM CA bundle for verifying peers
+    tls_skip_verify: bool = False   # disable peer cert verification
+
+    @property
+    def tls_enabled(self) -> bool:
+        return bool(self.tls_certificate or self.tls_key)
+
+    @property
+    def scheme(self) -> str:
+        return "https" if self.tls_enabled else "http"
 
     @property
     def host(self) -> str:
@@ -83,6 +101,39 @@ class Config:
             raise ValueError(f"invalid port {self.port}")
         if self.mesh_replicas < 1:
             raise ValueError("mesh_replicas must be >= 1")
+        if bool(self.tls_certificate) != bool(self.tls_key):
+            raise ValueError(
+                "tls_certificate and tls_key must be set together")
+
+    def server_ssl_context(self):
+        """ssl.SSLContext for the listener, or None when TLS is off
+        (reference getListener, server/server.go:244)."""
+        if not self.tls_enabled:
+            return None
+        import ssl
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(os.path.expanduser(self.tls_certificate),
+                            os.path.expanduser(self.tls_key))
+        return ctx
+
+    def client_ssl_context(self):
+        """ssl.SSLContext for dialing https peers, or None for plain
+        http clusters. skip_verify mirrors the reference's
+        InsecureSkipVerify (server/server.go:244)."""
+        if not (self.tls_enabled or self.tls_ca_certificate
+                or self.tls_skip_verify):
+            return None
+        import ssl
+        if self.tls_skip_verify:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            return ctx
+        ctx = ssl.create_default_context()
+        if self.tls_ca_certificate:
+            ctx.load_verify_locations(
+                os.path.expanduser(self.tls_ca_certificate))
+        return ctx
 
     def to_toml(self) -> str:
         lines = []
