@@ -61,14 +61,22 @@ def cmd_server(args) -> int:
             mesh = MeshContext(devices[:n], replicas=cfg.mesh_replicas)
 
     cluster = None
-    if cfg.cluster_peers:
+    if cfg.cluster_peers or cfg.cluster_seeds:
         from pilosa_tpu.parallel.cluster import (
             Cluster, Node, STATE_NORMAL,
         )
         local_uri = cfg.advertise or f"{cfg.scheme}://{cfg.bind}"
+        # Static peer lists name nodes by URI on every member, so the id
+        # must BE the URI there. Seed-joined nodes introduce themselves
+        # (the topology replicates their node record), so they use the
+        # holder's persisted `.id` — a restart on a new address then
+        # rejoins as the SAME member instead of ghosting its old entry.
+        local_id = local_uri if cfg.cluster_peers else holder.node_id
         cluster = Cluster(
-            Node(local_uri, local_uri,
-                 is_coordinator=(local_uri == sorted(cfg.cluster_peers)[0])),
+            Node(local_id, local_uri,
+                 is_coordinator=bool(
+                     cfg.cluster_peers
+                     and local_uri == sorted(cfg.cluster_peers)[0])),
             replica_n=cfg.cluster_replicas,
             topology_path=os.path.join(data_dir, ".topology"))
         for peer in cfg.cluster_peers:
@@ -139,6 +147,41 @@ def cmd_server(args) -> int:
             translate_repl = TranslateReplicationLoop(
                 api, cfg.translate_replication_interval)
             translate_repl.start()
+    seed_stop = None
+    if cfg.cluster_seeds:
+        # Seed-based dynamic join (reference: memberlist seed join →
+        # coordinator resize, gossip/gossip.go:65, cluster.go:1676).
+        # Runs beside the accept loop: the join must wait until this
+        # node answers HTTP (the seed's resize job calls back with
+        # /internal/resize/pull), and must retry while seeds boot.
+        import threading
+
+        seed_stop = threading.Event()
+
+        def _seed_join():
+            import socket as _socket
+            while not seed_stop.is_set():
+                try:  # wait for our own LISTENER (a plain TCP connect:
+                    # advertise may be an external address this host
+                    # cannot reach, and TLS certs need not cover
+                    # localhost)
+                    _socket.create_connection(("127.0.0.1", cfg.port),
+                                              timeout=1.0).close()
+                    break
+                except OSError:
+                    seed_stop.wait(0.3)
+            while not seed_stop.is_set():
+                try:
+                    api.join_via_seeds(cfg.cluster_seeds)
+                    logger.printf("seed join ok: cluster has %d node(s)",
+                                  len(cluster.nodes()))
+                    return
+                except Exception as e:
+                    logger.printf("seed join: %s; retrying in 5s", e)
+                    seed_stop.wait(5.0)
+
+        threading.Thread(target=_seed_join, daemon=True,
+                         name="seed-join").start()
     logger.printf("pilosa-tpu server: data=%s bind=%s tls=%s mesh=%s "
                   "cluster=%s", data_dir, cfg.bind,
                   "on" if cfg.tls_enabled else "off",
@@ -148,6 +191,10 @@ def cmd_server(args) -> int:
         serve(api, cfg.host, cfg.port,
               ssl_context=cfg.server_ssl_context())
     finally:
+        if seed_stop is not None:
+            seed_stop.set()
+        if api.broadcaster is not None:
+            api.broadcaster.stop()
         if heartbeat is not None:
             heartbeat.stop()
         if translate_repl is not None:

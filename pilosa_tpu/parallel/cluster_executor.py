@@ -123,11 +123,16 @@ class ClusterExecutor:
     the next replica (reference executor.go:2313-2324)."""
 
     def __init__(self, local_executor, cluster: Cluster,
-                 client: Optional[InternalClient] = None, logger=None):
+                 client: Optional[InternalClient] = None, logger=None,
+                 broadcaster=None):
         self.local = local_executor
         self.cluster = cluster
         self.client = client or InternalClient()
         self.logger = logger
+        # Optional queued-retry path for the shards-changed push (a
+        # briefly-down peer otherwise serves undercounts for up to the
+        # TTL after it returns).
+        self.broadcaster = broadcaster
 
     # -- shard discovery ----------------------------------------------------
 
@@ -198,9 +203,14 @@ class ClusterExecutor:
         for node in self.cluster.known_nodes():
             if node.id == self.cluster.local.id:
                 continue
+            msg = {"type": "shards-changed", "index": index}
+            if self.broadcaster is not None:
+                # coalesce: N queued copies of a cache-invalidation do
+                # what one does — a down peer gets one, not a backlog.
+                self.broadcaster.send(node.uri, msg, coalesce=True)
+                continue
             try:
-                self.client.cluster_message(
-                    node.uri, {"type": "shards-changed", "index": index})
+                self.client.cluster_message(node.uri, msg)
             except ClientError:
                 pass
 

@@ -85,14 +85,22 @@ class API:
         self.cluster_executor = None
         self.syncer = None
         self.resize_puller = None
+        self.broadcaster = None
         if cluster is not None:
             from pilosa_tpu.parallel.client import InternalClient
             from pilosa_tpu.parallel.cluster_executor import ClusterExecutor
             from pilosa_tpu.parallel.syncer import HolderSyncer, ResizePuller
+            from pilosa_tpu.parallel.broadcast import AsyncBroadcaster
             client = InternalClient(tracer=self.tracer,
                                     ssl_context=client_ssl_context)
-            self.cluster_executor = ClusterExecutor(self.executor, cluster,
-                                                    client)
+            # Membership/cache messages ride a queued, retried async
+            # path so a briefly-down peer doesn't miss them (reference
+            # SendAsync over the gossip retransmit queue,
+            # broadcast.go:30, gossip/gossip.go:306).
+            self.broadcaster = AsyncBroadcaster(client, logger=self.logger)
+            self.cluster_executor = ClusterExecutor(
+                self.executor, cluster, client,
+                broadcaster=self.broadcaster)
             self.syncer = HolderSyncer(holder, cluster, client)
             self.resize_puller = ResizePuller(holder, cluster, client)
             self.executor.key_resolver = self._resolve_key_via_primary
@@ -587,7 +595,7 @@ class API:
         and the new placement takes over."""
         if self.cluster is None:
             raise ApiError("not clustered", 400)
-        from pilosa_tpu.parallel.cluster import Node
+        from pilosa_tpu.parallel.cluster import Node, STATE_RESIZING
         from pilosa_tpu.parallel.client import ClientError
         node = Node.from_json(node_info)
         # The safe read placement to broadcast is the OLDEST in-flight
@@ -596,6 +604,25 @@ class API:
         # unfinished earlier resize may not hold its shards yet, so late
         # joiners must route reads all the way back to where the data is
         # guaranteed to live.
+        existing = self.cluster.node_by_id(node.id)
+        if existing is not None and self.cluster.state != STATE_RESIZING:
+            # Idempotent rejoin (a restarted member re-announcing through
+            # its seeds, reference cluster.go:1028 nodeJoin "node already
+            # in cluster"): no data moved, so no resize — just hand back
+            # the current topology. A changed URI (restart on a new
+            # address with a stable holder id) must replicate, or every
+            # other member keeps dialing the dead one.
+            if existing.uri != node.uri:
+                existing.uri = node.uri
+                self.cluster.save()
+                for peer in self.cluster.nodes():
+                    if peer.id in (self.cluster.local.id, node.id):
+                        continue
+                    self.broadcaster.send_now_or_queue(
+                        peer.uri, {"type": "topology",
+                                   "nodes": [n.to_json() for n in
+                                             self.cluster.nodes()]})
+            return self.cluster.status()
         prev = [n.to_json() for n in self.cluster.begin_resize()]
         # Pin the translation primary to a PRE-join member: the joiner's
         # empty key store must never become the allocator.
@@ -604,15 +631,18 @@ class API:
         for peer in self.cluster.nodes():
             if peer.id in (self.cluster.local.id, node.id):
                 continue
-            try:
-                self._client.cluster_message(
-                    peer.uri, {"type": "node-join", "node": node.to_json(),
-                               "prev": prev, "translatePrimary": tp})
-            except ClientError:
-                pass
+            # Sync-first with queued fallback: a reachable peer MUST see
+            # the membership change before the resize job's direct
+            # resize_pull RPC reaches it, or it pulls against stale
+            # placement and the job can finalize with data unmoved.
+            self.broadcaster.send_now_or_queue(
+                peer.uri, {"type": "node-join", "node": node.to_json(),
+                           "prev": prev, "translatePrimary": tp})
         # The joining node adopts the full topology AND the in-flight
         # resize state, so queries it coordinates keep routing reads via
-        # the pre-join placement too.
+        # the pre-join placement too. (It also gets the same payload in
+        # the join RESPONSE — this push covers operator-driven joins
+        # where the joiner never called /internal/join itself.)
         try:
             self._client.cluster_message(
                 node.uri, {"type": "topology",
@@ -623,6 +653,51 @@ class API:
             pass
         self._start_resize_job()
         return self.cluster.status()
+
+    def join_via_seeds(self, seeds, attempts: int = 1,
+                       retry_delay: float = 2.0) -> dict:
+        """Announce this node to an existing cluster through any seed —
+        the reference's memberlist seed join (gossip/gossip.go:65
+        memberlist.Join; join event → coordinator resize,
+        cluster.go:1676-1715) without gossip: POST /internal/join to the
+        first reachable seed and adopt the returned topology + in-flight
+        resize state synchronously (the seed also pushes the same
+        payload as a topology message — either arrival order works; the
+        handlers are idempotent). The seed drives the resize; this node
+        answers its /internal/resize/pull once its server is listening.
+
+        Raises ApiError when every seed is unreachable after
+        `attempts` passes over the list (callers that must not fail the
+        boot run this in a retry loop — cli cmd_server)."""
+        if self.cluster is None:
+            raise ApiError("not clustered", 400)
+        import json as _json
+        import time as _time
+
+        from pilosa_tpu.parallel.client import ClientError
+        body = _json.dumps(self.cluster.local.to_json()).encode()
+        last: Optional[Exception] = None
+        for attempt in range(max(1, attempts)):
+            if attempt:
+                _time.sleep(retry_delay)
+            for seed in seeds:
+                if not seed or seed == self.cluster.local.uri:
+                    continue
+                try:
+                    status = self._client._req(
+                        "POST", f"{seed}/internal/join", body)
+                except ClientError as e:
+                    last = e
+                    continue
+                self.handle_cluster_message({
+                    "type": "topology",
+                    "nodes": status.get("nodes", []),
+                    "prev": status.get("prevNodes"),
+                    "translatePrimary": status.get("translatePrimary"),
+                })
+                return status
+        raise ApiError(f"no seed reachable (tried {list(seeds)}): {last}",
+                       503)
 
     def _start_resize_job(self) -> None:
         """Run the data motion for a topology change: every member pulls
@@ -681,8 +756,9 @@ class API:
         """Adopt the new placement everywhere (reference: job DONE → save
         topology, broadcast NORMAL, cluster.go:1048-1060). The broadcast
         carries the membership it completes, so a peer that already saw a
-        newer topology change ignores it and stays safely RESIZING."""
-        from pilosa_tpu.parallel.client import ClientError
+        newer topology change ignores it and stays safely RESIZING; it
+        rides the retried async queue so a briefly-down peer converges
+        instead of sticking RESIZING forever."""
         members = self.cluster.member_ids()
         self.cluster.end_resize()
         # The pinned translate primary rides along as a second chance for
@@ -692,13 +768,9 @@ class API:
         for peer in self.cluster.nodes():
             if peer.id == self.cluster.local.id:
                 continue
-            try:
-                self._client.cluster_message(
-                    peer.uri, {"type": "resize-complete",
-                               "members": members,
-                               **({"translatePrimary": tp} if tp else {})})
-            except ClientError:
-                pass
+            self.broadcaster.send_now_or_queue(
+                peer.uri, {"type": "resize-complete", "members": members,
+                           **({"translatePrimary": tp} if tp else {})})
 
     def resize_pull(self) -> dict:
         """One synchronous pull pass (the receiving side of the resize
@@ -809,13 +881,12 @@ class API:
         for peer in self.cluster.nodes():
             if peer.id == self.cluster.local.id:
                 continue
-            try:
-                self._client.cluster_message(
-                    peer.uri, {"type": "node-leave", "nodeID": node_id,
-                               "prev": prev,
-                               **({"translatePrimary": tp} if tp else {})})
-            except ClientError:
-                pass
+            # Sync-first (queued fallback): survivors must apply the
+            # removal before this job's direct resize_pull hits them.
+            self.broadcaster.send_now_or_queue(
+                peer.uri, {"type": "node-leave", "nodeID": node_id,
+                           "prev": prev,
+                           **({"translatePrimary": tp} if tp else {})})
         # Tell the removed node too (it may still be alive): it detaches
         # to a single-node topology instead of serving with stale 3-node
         # placement and pushing anti-entropy into the survivors. It keeps
@@ -844,12 +915,8 @@ class API:
         for peer in self.cluster.nodes():
             if peer.id == self.cluster.local.id:
                 continue
-            try:
-                self._client.cluster_message(
-                    peer.uri, {"type": "set-coordinator",
-                               "nodeID": node_id})
-            except ClientError:
-                pass
+            self.broadcaster.send_now_or_queue(
+                peer.uri, {"type": "set-coordinator", "nodeID": node_id})
         return self.cluster.status()
 
     def resize_abort(self) -> dict:

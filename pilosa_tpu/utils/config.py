@@ -67,6 +67,14 @@ class Config:
     # replication factor (reference cluster.replicas, server/config.go:63)
     cluster_peers: list = field(default_factory=list)
     cluster_replicas: int = 1
+    # Dynamic membership: URIs of existing members to join through at
+    # boot (reference: memberlist seed join, gossip/gossip.go:65; the
+    # join event drives a coordinator resize, cluster.go:1676-1715).
+    # Unlike cluster_peers this does NOT list the whole cluster — any
+    # one reachable seed suffices, and the node adopts the topology the
+    # seed returns. A restarted member re-announcing through its seeds
+    # is a no-op (idempotent rejoin).
+    cluster_seeds: list = field(default_factory=list)
     advertise: str = ""  # URI peers reach us at; default <scheme>://<bind>
     # TLS (reference server/config.go:120-166: TLS.CertificatePath,
     # TLS.CertificateKeyPath, TLS.SkipCertificateVerification; listener
