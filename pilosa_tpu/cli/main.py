@@ -214,17 +214,9 @@ def cmd_server(args) -> int:
     return 0
 
 
-def cmd_import(args) -> int:
-    """Bulk CSV import: rows of `row,col` (or `col,value` with --field-type
-    int), straight into a local holder (reference ctl/import.go; the
-    reference also supports posting to a remote host — use the HTTP API
-    for that)."""
-    from pilosa_tpu.core.holder import Holder
-    from pilosa_tpu.core.field import FieldOptions
-
-    holder = Holder(os.path.expanduser(args.data_dir))
-    holder.open()
-    idx = holder.create_index(args.index, error_if_exists=False)
+def _read_import_csv(args):
+    """(rows, cols, vals) from the CSV files: `row,col` lines, or
+    `col,value` with --field-type int."""
     rows, cols, vals = [], [], []
     for path in args.files:
         with open(path, newline="") as f:
@@ -237,6 +229,79 @@ def cmd_import(args) -> int:
                 else:
                     rows.append(int(rec[0]))
                     cols.append(int(rec[1]))
+    return rows, cols, vals
+
+
+# Pairs per POST on the remote import path: bounds request bodies to a
+# few MB while amortizing the round trip (reference ctl/import.go
+# buffers 10M bits per request by default).
+REMOTE_IMPORT_BATCH = 1_000_000
+
+
+def _import_remote(args) -> int:
+    """POST CSV-derived batches through a running host's import API
+    (reference ctl/import.go: the import subcommand posts ImportRequests
+    to --host; the receiving node translates/splits/forwards to shard
+    owners, api.go:814). Creates the index/field if missing, like the
+    local path."""
+    from pilosa_tpu.parallel.client import ClientError, InternalClient
+
+    ssl_ctx = None
+    if args.tls_skip_verify:
+        import ssl
+        ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ssl_ctx.check_hostname = False
+        ssl_ctx.verify_mode = ssl.CERT_NONE
+    client = InternalClient(timeout=300.0, ssl_context=ssl_ctx)
+    host = args.host.rstrip("/")
+
+    def ensure(path: str, options: dict) -> None:
+        try:
+            client._req("POST", f"{host}{path}", obj={"options": options})
+        except ClientError as e:
+            if not (e.status == 409 and "exists" in e.body):
+                raise
+
+    rows, cols, vals = _read_import_csv(args)
+    ensure(f"/index/{args.index}", {})
+    if args.field_type == "int":
+        lo, hi = (min(vals), max(vals)) if vals else (0, 0)
+        ensure(f"/index/{args.index}/field/{args.field}",
+               {"type": "int", "min": lo, "max": hi})
+    else:
+        ensure(f"/index/{args.index}/field/{args.field}", {})
+    url = f"{host}/index/{args.index}/field/{args.field}/import"
+    for i in range(0, len(cols), REMOTE_IMPORT_BATCH):
+        if args.field_type == "int":
+            body = {"columnIDs": cols[i:i + REMOTE_IMPORT_BATCH],
+                    "values": vals[i:i + REMOTE_IMPORT_BATCH]}
+        else:
+            body = {"rowIDs": rows[i:i + REMOTE_IMPORT_BATCH],
+                    "columnIDs": cols[i:i + REMOTE_IMPORT_BATCH]}
+        client._req("POST", url, obj=body)
+    print(f"imported {len(cols)} records into "
+          f"{args.index}/{args.field} via {host}")
+    return 0
+
+
+def cmd_import(args) -> int:
+    """Bulk CSV import: rows of `row,col` (or `col,value` with --field-type
+    int). Default: straight into a local holder. With --host: posted
+    through a running server's import API (reference ctl/import.go
+    supports both shapes)."""
+    if args.host:
+        return _import_remote(args)
+    if not args.data_dir:
+        print("import: either --host or --data-dir is required",
+              file=sys.stderr)
+        return 2
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+
+    holder = Holder(os.path.expanduser(args.data_dir))
+    holder.open()
+    idx = holder.create_index(args.index, error_if_exists=False)
+    rows, cols, vals = _read_import_csv(args)
     if args.field_type == "int":
         lo, hi = (min(vals), max(vals)) if vals else (0, 0)
         f = idx.field(args.field) or idx.create_field(
@@ -468,7 +533,14 @@ def main(argv=None) -> int:
     sp.set_defaults(fn=cmd_server)
 
     ip = sub.add_parser("import", help="bulk import CSV files")
-    ip.add_argument("-d", "--data-dir", required=True)
+    ip.add_argument("-d", "--data-dir", default=None,
+                    help="local holder to import into (omit with --host)")
+    ip.add_argument("--host", default=None,
+                    help="import through a running server instead of a "
+                         "local holder, e.g. http://localhost:10101")
+    ip.add_argument("--tls-skip-verify", action="store_true",
+                    help="with an https --host: skip certificate "
+                         "verification")
     ip.add_argument("-i", "--index", required=True)
     ip.add_argument("-f", "--field", required=True)
     ip.add_argument("--field-type", default="set", choices=["set", "int"])
