@@ -214,9 +214,12 @@ def cmd_server(args) -> int:
     return 0
 
 
-def _read_import_csv(args):
-    """(rows, cols, vals) from the CSV files: `row,col` lines, or
-    `col,value` with --field-type int."""
+def _iter_import_csv(args, batch: int = 0):
+    """Yield (rows, cols, vals) batches from the CSV files — `row,col`
+    lines, or `col,value` with --field-type int. batch=0 yields one
+    batch with everything (the local path); a positive batch streams in
+    O(batch) memory (the remote path must not materialize a 100M-line
+    CSV as Python lists)."""
     rows, cols, vals = [], [], []
     for path in args.files:
         with open(path, newline="") as f:
@@ -229,7 +232,16 @@ def _read_import_csv(args):
                 else:
                     rows.append(int(rec[0]))
                     cols.append(int(rec[1]))
-    return rows, cols, vals
+                if batch and len(cols) >= batch:
+                    yield rows, cols, vals
+                    rows, cols, vals = [], [], []
+    if cols or not batch:
+        yield rows, cols, vals
+
+
+def _read_import_csv(args):
+    """(rows, cols, vals) fully materialized (the local path)."""
+    return next(_iter_import_csv(args))
 
 
 # Pairs per POST on the remote import path: bounds request bodies to a
@@ -259,27 +271,34 @@ def _import_remote(args) -> int:
         try:
             client._req("POST", f"{host}{path}", obj={"options": options})
         except ClientError as e:
-            if not (e.status == 409 and "exists" in e.body):
+            # Shared predicate: 409 alone also means "wrong cluster
+            # state", which must NOT read as success (client.py:292).
+            if not InternalClient._is_already_exists(e):
                 raise
 
-    rows, cols, vals = _read_import_csv(args)
     ensure(f"/index/{args.index}", {})
     if args.field_type == "int":
-        lo, hi = (min(vals), max(vals)) if vals else (0, 0)
+        # Streaming min/max prescan so field creation fits the data
+        # without materializing the CSV (second pass posts batches).
+        lo = hi = None
+        for _, _, vals in _iter_import_csv(args, REMOTE_IMPORT_BATCH):
+            if vals:
+                lo = min(vals) if lo is None else min(lo, min(vals))
+                hi = max(vals) if hi is None else max(hi, max(vals))
         ensure(f"/index/{args.index}/field/{args.field}",
-               {"type": "int", "min": lo, "max": hi})
+               {"type": "int", "min": lo or 0, "max": hi or 0})
     else:
         ensure(f"/index/{args.index}/field/{args.field}", {})
     url = f"{host}/index/{args.index}/field/{args.field}/import"
-    for i in range(0, len(cols), REMOTE_IMPORT_BATCH):
+    total = 0
+    for rows, cols, vals in _iter_import_csv(args, REMOTE_IMPORT_BATCH):
         if args.field_type == "int":
-            body = {"columnIDs": cols[i:i + REMOTE_IMPORT_BATCH],
-                    "values": vals[i:i + REMOTE_IMPORT_BATCH]}
+            body = {"columnIDs": cols, "values": vals}
         else:
-            body = {"rowIDs": rows[i:i + REMOTE_IMPORT_BATCH],
-                    "columnIDs": cols[i:i + REMOTE_IMPORT_BATCH]}
+            body = {"rowIDs": rows, "columnIDs": cols}
         client._req("POST", url, obj=body)
-    print(f"imported {len(cols)} records into "
+        total += len(cols)
+    print(f"imported {total} records into "
           f"{args.index}/{args.field} via {host}")
     return 0
 

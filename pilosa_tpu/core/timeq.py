@@ -10,6 +10,7 @@ fit, then walk back down.
 
 from __future__ import annotations
 
+import functools
 from datetime import datetime, timedelta
 from typing import List
 
@@ -166,8 +167,12 @@ def time_of_view(v: str, adj: bool) -> datetime:
     return t
 
 
+@functools.lru_cache(maxsize=4096)
 def parse_timestamp(s: str) -> datetime:
-    """PQL timestamp formats (reference pql.peg timestampfmt)."""
+    """PQL timestamp formats (reference pql.peg timestampfmt). Cached:
+    strptime costs ~15 us and dashboards re-issue the same literal
+    range bounds on every query (datetime is immutable, so sharing the
+    parse is safe)."""
     for fmt in ("%Y-%m-%dT%H:%M", "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d %H:%M",
                 "%Y-%m-%d"):
         try:

@@ -46,7 +46,8 @@ from pilosa_tpu.executor.results import (
     FieldRow, GroupCount, PairsResult, RowIdentifiers, RowResult, ValCount,
 )
 from pilosa_tpu.ops.bitset import SHARD_WIDTH, WORDS_PER_SHARD
-from pilosa_tpu.pql import Call, Condition, Query, parse_string
+from pilosa_tpu.pql import (Call, Condition, Query, parse_string,
+                            parse_string_cached)
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
 
 _LOG = logging.getLogger("pilosa_tpu.executor")
@@ -309,7 +310,7 @@ class Executor:
     def _execute_query(self, index_name: str, query, shards
                        ) -> Tuple[List[Any], "ExecOptions"]:
         if isinstance(query, str):
-            query = parse_string(query)
+            query = parse_string_cached(query)
         if isinstance(query, Call):
             query = Query([query])
         if self.max_writes_per_request > 0 and \

@@ -24,7 +24,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from pilosa_tpu.executor.results import result_to_json
 from pilosa_tpu.parallel.client import ClientError, InternalClient
 from pilosa_tpu.parallel.cluster import Cluster
-from pilosa_tpu.pql import Call, parse_string
+from pilosa_tpu.pql import Call, parse_string_cached
 from pilosa_tpu.ops.bitset import SHARD_WIDTH
 
 _WRITE_SINGLE_COL = {"Set", "Clear"}
@@ -222,7 +222,7 @@ class ClusterExecutor:
         from pilosa_tpu.executor.executor import (
             ExecutionError, write_call_count,
         )
-        q = parse_string(query) if isinstance(query, str) else query
+        q = parse_string_cached(query) if isinstance(query, str) else query
         limit = self.local.max_writes_per_request
         if limit > 0 and write_call_count(q) > limit:
             # (reference ErrTooManyWrites, executor.go:106)

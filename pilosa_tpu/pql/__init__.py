@@ -6,4 +6,5 @@ Call/Condition AST shapes (/root/reference/pql/ast.go:27,247,466).
 """
 
 from pilosa_tpu.pql.ast import Call, Condition, Query  # noqa: F401
-from pilosa_tpu.pql.parser import parse_string, ParseError  # noqa: F401
+from pilosa_tpu.pql.parser import (parse_string,  # noqa: F401
+                                   parse_string_cached, ParseError)

@@ -45,6 +45,25 @@ class Call:
     def arg(self, key: str, default: Any = None) -> Any:
         return self.args.get(key, default)
 
+    def clone(self) -> "Call":
+        """Fresh Call tree with its OWN args dicts, children lists,
+        Call-valued args (GroupBy filter), list-valued args (previous,
+        ids), and Conditions — every structure the executor's key
+        translation can write resolved ids into (executor.py
+        _translate_call mutates args in place, including nested filter
+        trees and `previous` lists). Scalars are shared (immutable)."""
+        args: Dict[str, Any] = {}
+        for k, v in self.args.items():
+            if isinstance(v, Call):
+                v = v.clone()
+            elif isinstance(v, Condition):
+                v = Condition(v.op, list(v.value)
+                              if isinstance(v.value, list) else v.value)
+            elif isinstance(v, list):
+                v = list(v)
+            args[k] = v
+        return Call(self.name, args, [c.clone() for c in self.children])
+
     def uint_arg(self, key: str) -> Optional[int]:
         v = self.args.get(key)
         if v is None:
@@ -135,6 +154,9 @@ class Query:
 
     def write_calls(self) -> List[Call]:
         return [c for c in self.calls if c.writes()]
+
+    def clone(self) -> "Query":
+        return Query([c.clone() for c in self.calls])
 
     def __str__(self) -> str:
         return "\n".join(str(c) for c in self.calls)

@@ -11,6 +11,7 @@ grammar actions (startCall/addPosNum/addCond/endConditional in
 from __future__ import annotations
 
 import re
+import threading
 from typing import Any, List, Optional, Tuple
 
 from pilosa_tpu.pql.ast import (
@@ -417,3 +418,28 @@ def parse_string(src: str) -> Query:
     """Parse a PQL string into a Query (reference ParseString,
     pql/parser.go)."""
     return _Parser(src).parse_query()
+
+
+_PARSE_CACHE: "dict[str, Query]" = {}
+_PARSE_LOCK = threading.Lock()
+_PARSE_CACHE_MAX = 512
+
+
+def parse_string_cached(src: str) -> Query:
+    """parse_string through a small LRU keyed by the source text,
+    returning a CLONE of the cached tree (the executor's key
+    translation writes resolved ids into call.args, so the pristine
+    parse must never escape). Serving workloads re-issue identical
+    query strings; the ~0.2 ms parse is pure overhead on a warm
+    small-query path."""
+    with _PARSE_LOCK:
+        hit = _PARSE_CACHE.pop(src, None)
+        if hit is not None:
+            _PARSE_CACHE[src] = hit  # re-insert: LRU by dict order
+            return hit.clone()
+    parsed = parse_string(src)
+    with _PARSE_LOCK:
+        while len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+            _PARSE_CACHE.pop(next(iter(_PARSE_CACHE)))
+        _PARSE_CACHE[src] = parsed
+    return parsed.clone()

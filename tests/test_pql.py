@@ -135,3 +135,35 @@ def test_call_as_value():
     c = one("Count(Distinct(Row(f=1), field=other))")
     assert c.children[0].name == "Distinct"
     assert c.children[0].children[0].name == "Row"
+
+
+def test_parse_cache_clones_are_isolated():
+    """parse_string_cached clones must not share any mutable structure
+    with the cached tree: the executor's key translation writes
+    resolved ids into args in place — including nested filter Calls,
+    `previous` lists, and Condition list values (code-review r4: a
+    shallow clone leaked the first execution's ids into every later
+    one)."""
+    from pilosa_tpu.pql import parse_string_cached
+
+    src = ('GroupBy(Rows(f), filter=Row(color="red"), previous=["a"]) '
+           'Row(v == 3)')
+    a = parse_string_cached(src)
+    b = parse_string_cached(src)
+    ga, gb = a.calls[0], b.calls[0]
+    # Mutate everything translation mutates, through clone a only.
+    ga.args["filter"].args["color"] = 7
+    ga.args["previous"][0] = 42
+    ga.children[0].args["_field"] = "XX"
+    ra = a.calls[1]
+    cond = next(v for v in ra.args.values()
+                if hasattr(v, "op"))
+    cond.value = 99
+    # Clone b (and any future clone) still sees the pristine parse.
+    assert gb.args["filter"].args["color"] == "red"
+    assert gb.args["previous"] == ["a"]
+    c = parse_string_cached(src)
+    assert c.calls[0].args["filter"].args["color"] == "red"
+    assert c.calls[0].args["previous"] == ["a"]
+    condc = next(v for v in c.calls[1].args.values() if hasattr(v, "op"))
+    assert condc.value == 3
