@@ -619,7 +619,7 @@ class API:
                     if peer.id in (self.cluster.local.id, node.id):
                         continue
                     self.broadcaster.send_now_or_queue(
-                        peer.uri, {"type": "topology",
+                        peer.uri, {"type": "topology", "complete": True,
                                    "nodes": [n.to_json() for n in
                                              self.cluster.nodes()]})
             return self.cluster.status()
@@ -645,7 +645,7 @@ class API:
         # where the joiner never called /internal/join itself.)
         try:
             self._client.cluster_message(
-                node.uri, {"type": "topology",
+                node.uri, {"type": "topology", "complete": True,
                            "nodes": [n.to_json()
                                      for n in self.cluster.nodes()],
                            "prev": prev, "translatePrimary": tp})
@@ -690,7 +690,7 @@ class API:
                     last = e
                     continue
                 self.handle_cluster_message({
-                    "type": "topology",
+                    "type": "topology", "complete": True,
                     "nodes": status.get("nodes", []),
                     "prev": status.get("prevNodes"),
                     "translatePrimary": status.get("translatePrimary"),
@@ -820,8 +820,19 @@ class API:
             if msg.get("prev"):
                 self.cluster.begin_resize(
                     [Node.from_json(nd) for nd in msg["prev"]])
-            for nd in msg.get("nodes", []):
-                self.cluster.add_node(Node.from_json(nd))
+            incoming = [Node.from_json(nd) for nd in msg.get("nodes", [])]
+            for node in incoming:
+                self.cluster.add_node(node)
+            if msg.get("complete"):
+                # The sender's view is the FULL membership: drop local
+                # members absent from it (a node rejoining with a stale
+                # persisted .topology would otherwise resurrect ghosts
+                # removed while it was down). Never self-detach here —
+                # node-leave owns that transition.
+                keep = {n.id for n in incoming} | {self.cluster.local.id}
+                for n in list(self.cluster.nodes()):
+                    if n.id not in keep:
+                        self.cluster.remove_node(n.id)
         elif typ == "set-coordinator":
             for n in self.cluster.nodes():
                 n.is_coordinator = (n.id == msg.get("nodeID"))
