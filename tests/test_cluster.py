@@ -1299,6 +1299,73 @@ def test_cluster_soak_random_schedule(tmp_path):
             nd.stop()
 
 
+def test_seed_join_under_concurrent_imports(tmp_path):
+    """Writers keep importing while a 4th node seed-joins and the
+    cluster resizes: no write may fail and no bit may be lost — the
+    write fan-out targets current ∪ pre-resize owners during the move
+    (write_nodes), and the resize pulls cover the rest. The in-flight
+    membership change is exactly when a lesser design undercounts."""
+    import threading
+    import time
+
+    nodes = run_cluster(tmp_path, 3)
+    n4 = None
+    stop = threading.Event()
+    imported: list = []
+    errors: list = []
+
+    def writer(k, uris):
+        i = 0
+        while not stop.is_set() and not errors:
+            base = (i * 997 + k * 4_000_003) % (8 * SHARD_WIDTH)
+            cols = [(base + j * 61) % (8 * SHARD_WIDTH) for j in range(40)]
+            try:
+                req(uris[i % len(uris)], "POST",
+                    "/index/ji/field/f/import",
+                    {"rowIDs": [1] * len(cols), "columnIDs": cols})
+            except Exception as e:  # noqa: BLE001 — recorded, test fails
+                errors.append(e)
+                return
+            imported.extend(cols)
+            i += 1
+
+    try:
+        base = nodes[0].uri
+        req(base, "POST", "/index/ji", {"options": {}})
+        req(base, "POST", "/index/ji/field/f", {"options": {}})
+        uris = [nd.uri for nd in nodes]
+        threads = [threading.Thread(target=writer, args=(k, uris))
+                   for k in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)  # writes in flight before the join lands
+
+        n4 = ClusterNode(tmp_path, "n3")
+        n4.start(None, 1)
+        n4.attach_cluster([n4.uri], 1)
+        n4.api.join_via_seeds([base])
+        allnodes = nodes + [n4]
+        assert _wait(lambda: all(
+            len(nd.cluster.nodes()) == 4
+            and nd.cluster.state == STATE_NORMAL for nd in allnodes))
+        time.sleep(0.3)  # writes continue against the new placement
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    try:
+        assert not errors, errors
+        want = len(set(imported))
+        req(base, "POST", "/internal/sync")
+        for nd in allnodes:
+            res = req(nd.uri, "POST", "/index/ji/query",
+                      b"Count(Row(f=1))")
+            assert res["results"] == [want], (nd.uri, res, want)
+    finally:
+        for nd in nodes + ([n4] if n4 is not None else []):
+            nd.stop()
+
+
 def test_translate_primary_pinned_across_membership(tmp_path):
     """A joiner whose id sorts FIRST must not become the key allocator
     with an empty store (id collisions); removing the primary promotes
