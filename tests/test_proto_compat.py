@@ -328,3 +328,26 @@ def test_reference_client_protocol_end_to_end(live_server):
     err = M["QueryResponse"]()
     err.ParseFromString(ei.value.read())
     assert ei.value.code == 400 and err.Err
+
+
+def test_truncated_fields_raise_proto_error():
+    """Every wire type's truncation raises ProtoError instead of
+    silently dropping trailing fields (ADVICE r3: the fixed64/fixed32
+    paths lacked the bounds check the varint/length-delimited paths
+    had)."""
+    import pytest
+
+    from pilosa_tpu.server.proto_compat import ProtoError, _fields
+
+    # field 1, each wire type, with a short body.
+    for tag, body in [
+        (b"\x09", b"\x01\x02\x03"),        # I64 with 3 of 8 bytes
+        (b"\x0d", b"\x01\x02"),            # I32 with 2 of 4 bytes
+        (b"\x08", b"\x80"),                # varint cut mid-continuation
+        (b"\x0a", b"\x05ab"),              # LEN claiming 5, giving 2
+    ]:
+        with pytest.raises(ProtoError):
+            _fields(tag + body)
+    # Intact messages of each type still parse.
+    assert _fields(b"\x09" + bytes(8))[0][1] == 1
+    assert _fields(b"\x0d" + bytes(4))[0][1] == 5
