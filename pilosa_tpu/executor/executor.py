@@ -520,6 +520,57 @@ class Executor:
             out = self.mesh.pad_shards(out, floor=floor)
         return out
 
+    def _referenced_fields(self, idx: Index, call: Call,
+                           out: set) -> bool:
+        """Collect every field a row-call tree reads; False when the
+        tree contains a construct this walk doesn't model (caller then
+        keeps the full shard list)."""
+        name = call.name
+        if name in ("Row", "Range"):
+            try:
+                fname, _ = self._row_call_field(call)
+            except ExecutionError:
+                return False
+            f = idx.field(fname)
+            if f is None:
+                return False
+            out.add(f)
+            return True
+        if name == "Not":
+            ef = idx.existence_field()
+            if ef is None:
+                return False
+            out.add(ef)
+            return all(self._referenced_fields(idx, c, out)
+                       for c in call.children)
+        if name in ("Intersect", "Union", "Difference", "Xor", "Shift"):
+            return bool(call.children) and all(
+                self._referenced_fields(idx, c, out)
+                for c in call.children)
+        return False
+
+    def _restrict_shards(self, idx: Index, call: Call,
+                         shards: List[int]) -> List[int]:
+        """Drop shards where NO referenced field has data — a leaf over
+        an absent fragment is all-zeros, and zeros through any bitmap
+        expression stay zeros, so dropped shards cannot contribute
+        columns or counts. This is what keeps a narrow field (e.g. a
+        time field covering one shard) from sweeping every shard of a
+        wide index (the reference's executeRowShard likewise skips
+        absent fragments, executor.go:1265). Field granularity: one
+        availableShards union per field, no per-view walk."""
+        fields: set = set()
+        if not self._referenced_fields(idx, call, fields) or not fields:
+            return shards
+        covered: set = set()
+        for f in fields:
+            covered.update(f.available_shards())
+        out = [s for s in shards if s in covered]
+        # Keep one shard when nothing is covered: zero-size device
+        # shapes are not worth the special-casing for an all-empty
+        # result.
+        return out or shards[:1]
+
     # ----------------------------------------------------- bitmap call eval
 
     def _execute_options(self, idx: Index, call: Call, shards,
@@ -554,7 +605,8 @@ class Executor:
 
     def _execute_bitmap(self, idx: Index, call: Call, shards,
                         opts: Optional["ExecOptions"] = None) -> RowResult:
-        shards = self._shards(idx, shards)
+        shards = self._shards(idx, self._restrict_shards(
+            idx, call, self._shards(idx, shards, pad=False)))
         words = self._eval_tree(idx, call, shards, mode="row")
         res = RowResult(shards, words)
         if opts is not None and opts.exclude_row_attrs:
@@ -568,7 +620,8 @@ class Executor:
     def _execute_count(self, idx: Index, call: Call, shards) -> "_Pending":
         if len(call.children) != 1:
             raise ExecutionError("Count() takes exactly one row argument")
-        shards = self._shards(idx, shards)
+        shards = self._shards(idx, self._restrict_shards(
+            idx, call.children[0], self._shards(idx, shards, pad=False)))
         counts = self._eval_tree(idx, call.children[0], shards, mode="count")
         return _Pending(
             lambda: int(np.asarray(counts, dtype=np.int64).sum()))

@@ -878,3 +878,52 @@ def test_host_block_cache_hits_and_invalidates(ex, monkeypatch):
     view.close()
     assert all(e2[0] is not view for e2 in
                view_mod.HOST_BLOCK_BUDGET._entries.values())
+
+
+def test_narrow_field_restricts_shard_sweep(tmp_path):
+    """A field covering one shard of a wide index must not sweep every
+    index shard (r4: the 100M-ride taxi time-range leg scanned 96
+    mostly-empty shards of day views; reference executeRowShard skips
+    absent fragments, executor.go:1265). Correctness first: counts and
+    columns match the model; then the restriction is observable via the
+    shard list handed to _eval_tree."""
+    import numpy as np
+
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+    h = Holder(str(tmp_path / "h"))
+    h.open()
+    idx = h.create_index("ns")
+    wide = idx.create_field("wide")
+    n_shards = 6
+    wide.import_bits(np.ones(n_shards, np.uint64),
+                     np.arange(n_shards, dtype=np.uint64)
+                     * SHARD_WIDTH + 7)
+    narrow = idx.create_field("narrow")
+    narrow.import_bits(np.array([1, 1], np.uint64),
+                       np.array([5, 9], np.uint64))  # shard 0 only
+    ex = Executor(h)
+    seen = {}
+    orig = ex._eval_tree
+
+    def spy(idx_, call, shards, mode):
+        seen["shards"] = list(shards)
+        return orig(idx_, call, shards, mode)
+
+    ex._eval_tree = spy
+    (cnt,) = ex.execute("ns", "Count(Row(narrow=1))")
+    assert cnt == 2
+    assert seen["shards"] == [0]  # restricted to the covered shard
+    (row,) = ex.execute("ns", "Row(narrow=1)")
+    assert row.columns().tolist() == [5, 9]
+    # A wide leaf anywhere in the tree keeps the wide shard list.
+    (cnt2,) = ex.execute("ns", "Count(Union(Row(narrow=1), Row(wide=1)))")
+    assert cnt2 == 2 + n_shards
+    assert len(seen["shards"]) == n_shards
+    # Fully-uncovered field: empty result, no crash.
+    idx.create_field("empty")
+    (c0,) = ex.execute("ns", "Count(Row(empty=1))")
+    assert c0 == 0
+    h.close()
