@@ -1035,6 +1035,16 @@ class Executor:
         view = field.view(VIEW_STANDARD)
         if view is None:
             return PairsResult([])
+        # Sweep only shards this field's view covers (absent fragments
+        # contribute zero to every row count, filtered or not) — a
+        # narrow field on a wide index must not upload empty bank
+        # columns. Restriction happens BEFORE the filter tree runs so
+        # filter words stay shard-aligned with the bank.
+        covered = [s for s in shards if view.fragment(s) is not None]
+        if not covered:
+            return PairsResult([])
+        if len(covered) < len(shards):
+            shards = self._shards(idx, covered)
 
         filter_words = None
         if call.children:

@@ -927,3 +927,30 @@ def test_narrow_field_restricts_shard_sweep(tmp_path):
     (c0,) = ex.execute("ns", "Count(Row(empty=1))")
     assert c0 == 0
     h.close()
+
+
+def test_topn_narrow_field_restricts_and_matches(tmp_path):
+    """TopN on a field covering a subset of the index's shards sweeps
+    only the covered shards and still answers exactly — with and
+    without a filter child."""
+    import numpy as np
+
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+    h = Holder(str(tmp_path / "h"))
+    h.open()
+    idx = h.create_index("tn")
+    wide = idx.create_field("wide")
+    wide.import_bits(np.ones(5, np.uint64),
+                     np.arange(5, dtype=np.uint64) * SHARD_WIDTH + 3)
+    nar = idx.create_field("nar")
+    nar.import_bits(np.array([1, 1, 1, 2], np.uint64),
+                    np.array([3, 4, 5, 3], np.uint64))  # shard 0 only
+    ex = Executor(h)
+    (res,) = ex.execute("tn", "TopN(nar, n=5)")
+    assert res.pairs == [(1, 3), (2, 1)]
+    (res2,) = ex.execute("tn", "TopN(nar, Row(wide=1), n=5)")
+    assert res2.pairs == [(1, 1), (2, 1)]  # only col 3 passes the filter
+    h.close()
