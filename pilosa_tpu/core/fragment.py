@@ -314,6 +314,63 @@ class Fragment:
         return self.storage.count_range(row_id * SHARD_WIDTH,
                                         (row_id + 1) * SHARD_WIDTH)
 
+    @staticmethod
+    def _gather_row_arrays(containers, row_ids, total64, cwords64):
+        """Single-container-layout gather shared by rows_dense and
+        rows_positions: (u16_arrays, their_row_indexes, dense_items)
+        where dense_items are the (row_index, dense_container) pairs the
+        u16 path can't carry. Bulk probe: map(dict.get, ...) runs the
+        65k-per-chunk lookup loop in C — the pure-Python for/get/append
+        form was the dominant host cost of the whole chunked sweep."""
+        keys = (np.asarray(row_ids, dtype=np.uint64)
+                * np.uint64(CONTAINERS_PER_ROW)).tolist()
+        cs = list(map(containers.get, keys))
+        arrays, rows_at, dense_items = [], [], []
+        u16dt = np.dtype(np.uint16)
+        trim = total64 != cwords64
+        lim = np.uint16(total64 * 64 - 1) if trim else None
+        ap_a, ap_r = arrays.append, rows_at.append
+        for i, c in enumerate(cs):
+            if c is None:
+                continue
+            if c.dtype is not u16dt:
+                dense_items.append((i, c))
+                continue
+            if trim and c[-1] > lim:
+                # Sorted array: slice the in-range prefix rather
+                # than boolean-masking every element.
+                c = c[:np.searchsorted(c, lim, "right")]
+            ap_a(c)
+            ap_r(i)
+        return arrays, rows_at, dense_items
+
+    def rows_positions(self, row_ids, u32_words: int):
+        """Sparse chunk payload for the single-container narrow layout:
+        (pos16 concat, lens, rows_at) — the SET bit positions of each
+        row, ~2 bytes each, versus the 4*u32_words a dense row costs.
+        The chunked-TopN upload path expands these to the dense bank ON
+        DEVICE (view._expand_sparse_chunk), so a tunnel-attached chip
+        transfers only real data. None when the layout doesn't qualify
+        (row wider than one container, or any dense-encoded container —
+        the dense fallback handles those)."""
+        bits = u32_words * 32
+        if bits > CONTAINER_BITS or bits % 64:
+            return None
+        total64 = u32_words // 2
+        with self._lock:
+            arrays, rows_at, dense_items = self._gather_row_arrays(
+                self.storage.containers, row_ids, total64,
+                CONTAINER_BITS // 64)
+        if dense_items:
+            return None
+        if not arrays:
+            return (np.empty(0, np.uint16), np.empty(0, np.int64),
+                    np.empty(0, np.int64))
+        lens = np.fromiter(map(len, arrays), dtype=np.int64,
+                           count=len(arrays))
+        return (np.concatenate(arrays),
+                lens, np.asarray(rows_at, dtype=np.int64))
+
     def row_dense(self, row_id: int, u32_words: Optional[int] = None
                   ) -> np.ndarray:
         """Row as uint32 words (host). `u32_words` materializes only the
@@ -350,30 +407,11 @@ class Fragment:
             # block — no per-row Python work beyond the dict probe.
             if n_containers == 1:
                 flat = out.reshape(-1)
-                # Bulk probe: map(dict.get, ...) runs the 65k-per-chunk
-                # lookup loop in C — the pure-Python for/get/append form
-                # was the dominant host cost of the whole chunked sweep.
-                keys = (np.asarray(row_ids, dtype=np.uint64)
-                        * np.uint64(CONTAINERS_PER_ROW)).tolist()
-                cs = list(map(containers.get, keys))
-                arrays, rows_at = [], []
-                u16dt = np.dtype(np.uint16)
-                trim = total64 != cwords64
-                lim = np.uint16(total64 * 64 - 1) if trim else None
+                arrays, rows_at, dense_items = self._gather_row_arrays(
+                    containers, row_ids, total64, cwords64)
                 n_dense = min(cwords64, total64)
-                ap_a, ap_r = arrays.append, rows_at.append
-                for i, c in enumerate(cs):
-                    if c is None:
-                        continue
-                    if c.dtype is not u16dt:
-                        out[i, :n_dense] = c[:n_dense]
-                        continue
-                    if trim and c[-1] > lim:
-                        # Sorted array: slice the in-range prefix rather
-                        # than boolean-masking every element.
-                        c = c[:np.searchsorted(c, lim, "right")]
-                    ap_a(c)
-                    ap_r(i)
+                for i, c in dense_items:
+                    out[i, :n_dense] = c[:n_dense]
                 if arrays:
                     from pilosa_tpu import native
                     lens = np.fromiter(map(len, arrays),

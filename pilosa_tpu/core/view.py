@@ -14,11 +14,64 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.core.fragment import CONTAINER_BITS, Fragment
 from pilosa_tpu.core import cache as cache_mod
 
 VIEW_STANDARD = "standard"
 VIEW_BSI_PREFIX = "bsig_"
+
+# Sparse chunk upload (kill switch): single-shard narrow-layout chunk
+# banks ship u16 bit POSITIONS (~2 B/set bit) and expand to the dense
+# bank on device with one scatter — ~5x less host->device traffic for
+# fingerprint-shaped fields, where the transfer (not the sweep)
+# dominates on a tunnel-attached chip.
+SPARSE_UPLOAD = os.environ.get("PILOSA_TPU_SPARSE_UPLOAD", "1") != "0"
+
+_EXPAND_FN = None
+_EXPAND_SENTINEL = 0xFFFFFFFF
+
+
+def _expand_sparse_chunk(pos16: np.ndarray, lens: np.ndarray,
+                         rows_at: np.ndarray, cap: int, width: int):
+    """Device [cap, 1, width] u32 bank from concatenated per-row sorted
+    UNIQUE positions. Uniqueness matters: the expansion scatter uses
+    add, and two set bits only OR because distinct powers of two add
+    without carries — container arrays guarantee it. Position arrays
+    pad to power-of-two buckets so XLA compiles O(log P) variants, not
+    one per chunk cardinality; sentinel entries land on a scratch word
+    past the bank and add zero."""
+    global _EXPAND_FN
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    if _EXPAND_FN is None:
+        @functools.partial(jax.jit, static_argnums=(2, 3))
+        def expand(pos, row_of, cap, width):
+            total = cap * width
+            sent = pos == jnp.uint32(_EXPAND_SENTINEL)
+            word = jnp.where(
+                sent, total,
+                row_of * width + (pos >> 5)).astype(jnp.int32)
+            bit = jnp.where(
+                sent, jnp.uint32(0),
+                jnp.left_shift(jnp.uint32(1),
+                               (pos & 31).astype(jnp.uint32)))
+            flat = jnp.zeros((total + 1,), jnp.uint32)
+            flat = flat.at[word].add(bit, mode="drop",
+                                     unique_indices=False)
+            return flat[:total].reshape(cap, 1, width)
+
+        _EXPAND_FN = expand
+    n = len(pos16)
+    padded = 1 << max(10, (n - 1).bit_length() if n else 0)
+    pos = np.full(padded, _EXPAND_SENTINEL, np.uint32)
+    pos[:n] = pos16
+    row_of = np.zeros(padded, np.uint32)
+    if n:
+        row_of[:n] = np.repeat(rows_at.astype(np.uint32), lens)
+    return _EXPAND_FN(jnp.asarray(pos), jnp.asarray(row_of), cap, width)
 
 
 class BankBudget:
@@ -305,6 +358,23 @@ class View:
                     else:
                         self._host_blocks.pop(hb_key, None)
                         HOST_BLOCK_BUDGET.forget(self, hb_key)
+            if host is None and SPARSE_UPLOAD and rows is not None \
+                    and mesh is None and len(shards) == 1 \
+                    and trim and width * 32 <= CONTAINER_BITS:
+                # Sparse chunk upload: ship positions, expand on device.
+                f = frags[shards[0]]
+                sp = (f.rows_positions(row_set, width)
+                      if f is not None else
+                      (np.empty(0, np.uint16), np.empty(0, np.int64),
+                       np.empty(0, np.int64)))
+                if sp is not None:
+                    array = _expand_sparse_chunk(*sp, cap, width)
+                    slots = {r: i for i, r in enumerate(row_set)}
+                    bank = ViewBank(array, slots, cap - 1, versions)
+                    if cache_rows:
+                        self._bank_cache[cache_key] = bank
+                        BANK_BUDGET.admit(self, cache_key)
+                    return bank
             if host is None:
                 host = np.zeros((cap, len(shards), width), dtype=np.uint32)
                 for si, s in enumerate(shards):
