@@ -1359,6 +1359,19 @@ class Executor:
 
         if not call.children or any(c.name != "Rows" for c in call.children):
             raise ExecutionError("GroupBy requires Rows() arguments")
+        shards = self._shards(idx, shards, pad=False)
+        # GroupBy only ANDs, so a group's count is zero on any shard
+        # some child field doesn't cover — restrict to the INTERSECTION
+        # of the children's availableShards (narrow fields keep a wide
+        # index's empty shards out of the [P, R, S, W] expansions).
+        child_fields = [idx.field(c.arg("_field")) for c in call.children]
+        if all(f is not None for f in child_fields):
+            covered = set(child_fields[0].available_shards())
+            for f in child_fields[1:]:
+                covered &= set(f.available_shards())
+            shards = [s for s in shards if s in covered]
+            if not shards:
+                return []
         shards = self._shards(idx, shards)
         limit = call.uint_arg("limit") or 0
         previous = call.arg("previous")

@@ -1021,3 +1021,46 @@ def test_sparse_chunk_upload_matches_dense(tmp_path, monkeypatch):
             want = res.pairs
         assert res.pairs == want and len(want) == 8
     h.close()
+
+
+def test_groupby_narrow_field_intersection_restriction(tmp_path):
+    """GroupBy restricts to the INTERSECTION of its children's covered
+    shards (it only ANDs): a narrow field keeps a wide index's empty
+    shards out of the expansion, and answers stay exact."""
+    import numpy as np
+
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+    h = Holder(str(tmp_path / "h"))
+    h.open()
+    idx = h.create_index("gb")
+    wide = idx.create_field("wide")
+    # rows 0/1 across 4 shards
+    cols = np.arange(8, dtype=np.uint64) * (SHARD_WIDTH // 2)
+    wide.import_bits((np.arange(8) % 2).astype(np.uint64), cols)
+    nar = idx.create_field("nar")
+    nar.import_bits(np.array([5, 5, 6], np.uint64),
+                    np.array([0, SHARD_WIDTH // 2, 0], np.uint64))
+    ex = Executor(h)
+    (got,) = ex.execute("gb", "GroupBy(Rows(wide), Rows(nar))")
+    want = {}
+    for w in (0, 1):
+        for nr in (5, 6):
+            wcols = {int(c) for c, r in zip(cols, np.arange(8) % 2)
+                     if r == w}
+            ncols = {0, SHARD_WIDTH // 2} if nr == 5 else {0}
+            n = len(wcols & ncols)
+            if n:
+                want[(w, nr)] = n
+    got_map = {(gc.group[0].row_id, gc.group[1].row_id): gc.count
+               for gc in got}
+    assert got_map == want
+    # Disjoint coverage: early empty result.
+    far = idx.create_field("far")
+    far.import_bits(np.array([1], np.uint64),
+                    np.array([7 * SHARD_WIDTH + 1], np.uint64))
+    (got2,) = ex.execute("gb", "GroupBy(Rows(nar), Rows(far))")
+    assert got2 == []
+    h.close()
