@@ -193,3 +193,69 @@ def test_groupby_with_random_filter(world):
                 if n:
                     want[(r0, r1)] = n
         assert got == want, f"iter {i}: filter {pql}"
+
+
+def test_sparse_coverage_trees(tmp_path):
+    """Randomized bitmap trees over fields with RANDOM shard coverage
+    (r4 shard-coverage restriction): fields covering disjoint/partial
+    shard subsets of a wide index, random Union/Intersect/Difference/
+    Xor/Not trees, Count and Row answers vs a host set model. Exercises
+    the restriction walk against the planner for every tree shape."""
+    rng = np.random.default_rng(97 + SEED_OFFSET)
+    h = Holder(str(tmp_path / "w"))
+    h.open()
+    idx = h.create_index("sc")
+    n_shards = 5
+    fields = {}
+    model = {}  # field -> set(cols)  (row 1 everywhere)
+    for fi in range(4):
+        f = idx.create_field(f"f{fi}")
+        cover = rng.choice(n_shards, size=rng.integers(1, n_shards + 1),
+                           replace=False)
+        cols = []
+        for s in cover:
+            base = int(s) * SHARD_WIDTH
+            cols.extend(base + c for c in
+                        rng.integers(0, 3000, 40).tolist())
+        cols = sorted(set(cols))
+        f.import_bits(np.ones(len(cols), np.uint64),
+                      np.array(cols, np.uint64))
+        fields[f"f{fi}"] = f
+        model[f"f{fi}"] = set(cols)
+    idx.add_existence(np.array(sorted(set().union(*model.values())),
+                               np.uint64))
+    everything = set().union(*model.values())
+    ex = Executor(h)
+
+    def gen(depth):
+        if depth == 0 or rng.random() < 0.4:
+            name = f"f{rng.integers(0, 4)}"
+            return f"Row({name}=1)", model[name]
+        op = rng.choice(["Union", "Intersect", "Difference", "Xor",
+                         "Not"])
+        if op == "Not":
+            q, s = gen(depth - 1)
+            return f"Not({q})", everything - s
+        k = int(rng.integers(2, 4))
+        subs = [gen(depth - 1) for _ in range(k)]
+        qs = ", ".join(q for q, _ in subs)
+        sets = [s for _, s in subs]
+        if op == "Union":
+            want = set().union(*sets)
+        elif op == "Intersect":
+            want = set.intersection(*sets)
+        elif op == "Difference":
+            want = sets[0].difference(*sets[1:])
+        else:
+            want = set(sets[0])  # copy: ^= would mutate model[...]
+            for s in sets[1:]:
+                want ^= s
+        return f"{op}({qs})", want
+
+    for trial in range(40):
+        q, want = gen(int(rng.integers(1, 4)))
+        (cnt,) = ex.execute("sc", f"Count({q})")
+        assert cnt == len(want), (q, cnt, len(want))
+        (row,) = ex.execute("sc", q)
+        assert set(row.columns().tolist()) == want, q
+    h.close()
