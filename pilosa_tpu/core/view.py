@@ -358,10 +358,12 @@ class View:
                     else:
                         self._host_blocks.pop(hb_key, None)
                         HOST_BLOCK_BUDGET.forget(self, hb_key)
-            if host is None and SPARSE_UPLOAD and rows is not None \
+            array = None
+            if host is None and SPARSE_UPLOAD \
                     and mesh is None and len(shards) == 1 \
                     and trim and width * 32 <= CONTAINER_BITS:
-                # Sparse chunk upload: ship positions, expand on device.
+                # Sparse upload (chunk AND full-bank builds): ship
+                # positions, expand to the dense bank on device.
                 f = frags[shards[0]]
                 sp = (f.rows_positions(row_set, width)
                       if f is not None else
@@ -370,32 +372,30 @@ class View:
                 if sp is not None:
                     array = _expand_sparse_chunk(*sp, cap, width)
                     slots = {r: i for i, r in enumerate(row_set)}
-                    bank = ViewBank(array, slots, cap - 1, versions)
-                    if cache_rows:
-                        self._bank_cache[cache_key] = bank
-                        BANK_BUDGET.admit(self, cache_key)
-                    return bank
-            if host is None:
-                host = np.zeros((cap, len(shards), width), dtype=np.uint32)
-                for si, s in enumerate(shards):
-                    f = frags[s]
-                    if f is not None:
-                        host[:len(row_set), si] = f.rows_dense(row_set,
-                                                               width)
-                # Cached alongside so a hit is O(1) host-side — no
-                # 65k-entry dict rebuild per chunk per repeat query.
-                slots = {r: i for i, r in enumerate(row_set)}
-                # The slots dict is real host RAM too (~100 B/entry of
-                # dict overhead + int pair; several MB at 65k rows):
-                # account it, or a budget-full cache overshoots by the
-                # sum of its mappings (ADVICE r2).
-                entry_bytes = host.nbytes + 100 * len(row_set)
-                if hb_key is not None and \
-                        0 < entry_bytes <= HOST_BLOCK_BUDGET.budget:
-                    self._host_blocks[hb_key] = (host, versions, slots)
-                    HOST_BLOCK_BUDGET.admit(self, hb_key,
-                                            nbytes=entry_bytes)
-            array = mesh.put_bank(host) if mesh else jnp.asarray(host)
+            if array is None:
+                if host is None:
+                    host = np.zeros((cap, len(shards), width),
+                                    dtype=np.uint32)
+                    for si, s in enumerate(shards):
+                        f = frags[s]
+                        if f is not None:
+                            host[:len(row_set), si] = f.rows_dense(
+                                row_set, width)
+                    # Cached alongside so a hit is O(1) host-side — no
+                    # 65k-entry dict rebuild per chunk per repeat query.
+                    slots = {r: i for i, r in enumerate(row_set)}
+                    # The slots dict is real host RAM too (~100 B/entry
+                    # of dict overhead + int pair; several MB at 65k
+                    # rows): account it, or a budget-full cache
+                    # overshoots by the sum of its mappings (ADVICE r2).
+                    entry_bytes = host.nbytes + 100 * len(row_set)
+                    if hb_key is not None and \
+                            0 < entry_bytes <= HOST_BLOCK_BUDGET.budget:
+                        self._host_blocks[hb_key] = (host, versions,
+                                                     slots)
+                        HOST_BLOCK_BUDGET.admit(self, hb_key,
+                                                nbytes=entry_bytes)
+                array = mesh.put_bank(host) if mesh else jnp.asarray(host)
             bank = ViewBank(array, slots, cap - 1, versions)
             if rows is None or cache_rows:
                 self._bank_cache[cache_key] = bank

@@ -1064,3 +1064,43 @@ def test_groupby_narrow_field_intersection_restriction(tmp_path):
     (got2,) = ex.execute("gb", "GroupBy(Rows(nar), Rows(far))")
     assert got2 == []
     h.close()
+
+
+def test_sparse_full_bank_and_patching(tmp_path, monkeypatch):
+    """The FULL-bank TopN path also builds sparse (r4), and the
+    incremental patch path composes with a sparse-built base: write a
+    bit, re-query, counts refresh exactly."""
+    import numpy as np
+
+    from pilosa_tpu.core import view as view_mod
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.executor import Executor
+
+    h = Holder(str(tmp_path / "h"))
+    h.open()
+    idx = h.create_index("sf")
+    f = idx.create_field("fp", FieldOptions(max_columns=4096,
+                                            cache_type="none"))
+    rng = np.random.default_rng(9)
+    rows = np.repeat(np.arange(50, dtype=np.uint64), 20)
+    f.import_bits(rows, rng.integers(0, 4096, 1000).astype(np.uint64))
+    view = f.view()
+
+    def build(sparse):
+        monkeypatch.setattr(view_mod, "SPARSE_UPLOAD", sparse)
+        view._bank_cache.clear()
+        return view.device_bank((0,), trim=True)  # rows=None: full bank
+
+    a, b = build(False), build(True)
+    assert np.array_equal(np.asarray(a.array), np.asarray(b.array))
+
+    ex = Executor(h)
+    (r1,) = ex.execute("sf", "TopN(fp, n=3)")
+    f.set_bit(2, 4000)  # dirty one row; next bank build patches
+    (r2,) = ex.execute("sf", "TopN(fp, n=3)")
+    want = {r: int((rows == r).sum()) for r in range(50)}
+    want[2] = f.view().fragment(0).row_count(2)
+    top = sorted(want.items(), key=lambda kv: (-kv[1], kv[0]))[:3]
+    assert r2.pairs == top
+    h.close()
