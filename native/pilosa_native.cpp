@@ -18,6 +18,8 @@
 #include <cstdio>
 #include <cstring>
 #include <cstdlib>
+#include <exception>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -84,7 +86,11 @@ int native_threads() {
 // contiguous chunks of >= grain items, chunk t covering the t-th range
 // in order (deterministic stripe order — callers rely on it to keep
 // per-chunk outputs concatenable in ascending key order). Serial when
-// one chunk suffices.
+// one chunk suffices. An exception thrown inside a worker (bad_alloc
+// from a per-stripe vector) is captured and rethrown on the calling
+// thread AFTER all workers join — escaping a std::thread would call
+// std::terminate and abort the whole process, turning a recoverable
+// out-of-memory import into a crash.
 template <typename F>
 void parallel_ranges(uint64_t n, uint64_t grain, F&& fn) {
   const uint64_t nt = static_cast<uint64_t>(native_threads());
@@ -97,12 +103,22 @@ void parallel_ranges(uint64_t n, uint64_t grain, F&& fn) {
   const uint64_t per = (n + chunks - 1) / chunks;
   std::vector<std::thread> ts;
   ts.reserve(chunks);
+  std::exception_ptr err = nullptr;
+  std::mutex err_mu;
   for (uint64_t t = 0; t < chunks; t++) {
     const uint64_t lo = t * per, hi = std::min(n, lo + per);
     if (lo >= hi) break;
-    ts.emplace_back([&fn, lo, hi, t] { fn(lo, hi, t); });
+    ts.emplace_back([&fn, &err, &err_mu, lo, hi, t] {
+      try {
+        fn(lo, hi, t);
+      } catch (...) {
+        std::lock_guard<std::mutex> g(err_mu);
+        if (!err) err = std::current_exception();
+      }
+    });
   }
   for (auto& th : ts) th.join();
+  if (err) std::rethrow_exception(err);
 }
 
 // crc32 (IEEE reflected, poly 0xEDB88320), slice-by-8 — bit-identical to
@@ -1066,6 +1082,10 @@ void* pn_import_build(const uint64_t* rows, const uint64_t* cols,
     });
   } catch (const std::bad_alloc&) {
     return bail("out of memory");
+  } catch (...) {
+    // Anything rethrown from a parallel_ranges worker: same recovery
+    // (an exception crossing the C ABI would terminate the process).
+    return bail("import build failed");
   }
   return ib;
 }
@@ -1098,25 +1118,32 @@ uint64_t pn_serialize_groups(const uint64_t* keys, const uint16_t* lows,
     if (card == 0 || card > 65536) return 0;
     offs[i + 1] = offs[i] + (card < 4096 ? 2 * card : 8192);
   }
-  parallel_ranges(m, 2048, [&](uint64_t lo, uint64_t hi, uint64_t) {
-    for (uint64_t i = lo; i < hi; i++) {
-      uint64_t card = bounds[i + 1] - bounds[i];
-      uint16_t typ = card < 4096 ? kTypeArray : kTypeBitmap;
-      wu64(out + meta_pos + 12 * i, keys[i]);
-      wu16(out + meta_pos + 12 * i + 8, typ);
-      wu16(out + meta_pos + 12 * i + 10, static_cast<uint16_t>(card - 1));
-      wu32(out + off_pos + 4 * i, static_cast<uint32_t>(offs[i]));
-      if (typ == kTypeArray) {
-        std::memcpy(out + offs[i], lows + bounds[i], 2 * card);
-      } else {
-        uint64_t mask[kContainerWords];
-        std::memset(mask, 0, sizeof(mask));
-        for (uint64_t j = bounds[i]; j < bounds[i + 1]; j++)
-          mask[lows[j] >> 6] |= 1ull << (lows[j] & 63);
-        std::memcpy(out + offs[i], mask, 8192);
+  try {
+    parallel_ranges(m, 2048, [&](uint64_t lo, uint64_t hi, uint64_t) {
+      for (uint64_t i = lo; i < hi; i++) {
+        uint64_t card = bounds[i + 1] - bounds[i];
+        uint16_t typ = card < 4096 ? kTypeArray : kTypeBitmap;
+        wu64(out + meta_pos + 12 * i, keys[i]);
+        wu16(out + meta_pos + 12 * i + 8, typ);
+        wu16(out + meta_pos + 12 * i + 10,
+             static_cast<uint16_t>(card - 1));
+        wu32(out + off_pos + 4 * i, static_cast<uint32_t>(offs[i]));
+        if (typ == kTypeArray) {
+          std::memcpy(out + offs[i], lows + bounds[i], 2 * card);
+        } else {
+          uint64_t mask[kContainerWords];
+          std::memset(mask, 0, sizeof(mask));
+          for (uint64_t j = bounds[i]; j < bounds[i + 1]; j++)
+            mask[lows[j] >> 6] |= 1ull << (lows[j] & 63);
+          std::memcpy(out + offs[i], mask, 8192);
+        }
       }
-    }
-  });
+    });
+  } catch (...) {
+    // Exceptions must not cross the C ABI (ctypes caller): 0 is this
+    // function's error convention, the caller falls back to Python.
+    return 0;
+  }
   return offs[m];
 }
 

@@ -158,14 +158,20 @@ def cmd_server(args) -> int:
 
         seed_stop = threading.Event()
 
+        # Probe the BIND address (loopback only when binding wildcard/
+        # loopback): a server bound to a specific interface does not
+        # answer on 127.0.0.1, and advertise may be an external address
+        # this host cannot reach. A plain TCP connect avoids TLS (certs
+        # need not cover the probe name).
+        probe_host = cfg.host
+        if probe_host in ("", "0.0.0.0", "::", "localhost"):
+            probe_host = "127.0.0.1"
+
         def _seed_join():
             import socket as _socket
             while not seed_stop.is_set():
-                try:  # wait for our own LISTENER (a plain TCP connect:
-                    # advertise may be an external address this host
-                    # cannot reach, and TLS certs need not cover
-                    # localhost)
-                    _socket.create_connection(("127.0.0.1", cfg.port),
+                try:  # wait for our own LISTENER
+                    _socket.create_connection((probe_host, cfg.port),
                                               timeout=1.0).close()
                     break
                 except OSError:

@@ -1099,8 +1099,10 @@ class Executor:
                     order = order[:n]
                 warm = [(int(rows_arr[o]), int(counts_arr[o]))
                         for o in order]
+                # == 1 % EVERY, not == 1: at EVERY=1 (check every hit)
+                # the residue is 0 and a literal ==1 would never match.
                 if not (TOPN_SELFCHECK_EVERY and self.topn_cache_hits
-                        % TOPN_SELFCHECK_EVERY == 1):
+                        % TOPN_SELFCHECK_EVERY == 1 % TOPN_SELFCHECK_EVERY):
                     return PairsResult(warm)
                 # Sampled self-check: fall through to the exact sweep
                 # and compare in finalize (both orderings are the same

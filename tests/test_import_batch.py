@@ -377,6 +377,20 @@ def test_topn_selfcheck_catches_stale_cache(tmp_path):
         assert ex2.topn_selfchecks == 1
         assert ex2.topn_selfcheck_mismatches == 0
         assert res3.pairs == res.pairs
+
+        # EVERY=1 means EVERY warm hit is checked (the % EVERY == 1
+        # literal would silently disable it at its most aggressive
+        # setting — code-review r4).
+        from pilosa_tpu.executor import executor as ex_mod
+        old = ex_mod.TOPN_SELFCHECK_EVERY
+        ex_mod.TOPN_SELFCHECK_EVERY = 1
+        try:
+            ex3 = Executor(h)
+            ex3.execute("chk", "TopN(f, n=4)")
+            ex3.execute("chk", "TopN(f, n=4)")
+            assert ex3.topn_selfchecks == 2
+        finally:
+            ex_mod.TOPN_SELFCHECK_EVERY = old
         h.close()
 
 
