@@ -159,6 +159,35 @@ class ViewBank:
         return self.slots.get(row_id, self.zero_slot)
 
 
+class PositionsBank:
+    """Device-RESIDENT sparse view for single-shard narrow layouts: all
+    rows' sorted u16 bit positions concatenated, plus per-row start
+    offsets — ~2 bytes per SET bit instead of 64 per bit-slot, so a
+    100M-row fingerprint field (~10 GB) stays resident in one chip's
+    HBM where its dense banks (~51 GB) cannot. Filtered TopN then needs
+    NO per-query upload or chunk streaming: |row ∧ filter| is a gather
+    of filter bits at the row's positions plus a cumsum difference
+    (executor._topn_positions). Segmented on row boundaries so every
+    segment's position count fits i32 offsets."""
+
+    __slots__ = ("segments", "row_ids", "versions", "nbytes")
+
+    def __init__(self, segments, row_ids, versions, nbytes):
+        # segments: [(row_lo, n_rows, pos_dev u16 [Ppad], starts_dev
+        #            i32 [n_rows+1], p_real)]
+        self.segments = segments
+        self.row_ids = row_ids      # global sorted row ids
+        self.versions = versions
+        self.nbytes = nbytes
+
+
+# Positions per device segment (i32-offset bound with headroom) and the
+# host gather chunk for the one-time build.
+PBANK_SEGMENT_POSITIONS = int(os.environ.get(
+    "PILOSA_TPU_PBANK_SEGMENT", 1 << 30))
+PBANK_GATHER_ROWS = 1 << 20
+
+
 def view_bsi_name(field: str) -> str:
     return VIEW_BSI_PREFIX + field
 
@@ -401,6 +430,86 @@ class View:
                 self._bank_cache[cache_key] = bank
                 BANK_BUDGET.admit(self, cache_key)
             return bank
+
+    def positions_bank(self, shard: int, width: int
+                       ) -> Optional[PositionsBank]:
+        """Device-resident PositionsBank for one shard, or None when
+        the layout doesn't qualify (no fragment, any dense-encoded
+        container, or width spanning a full container — the 0xFFFF pad
+        sentinel must gather out of range). Cached per (shard, width)
+        under the HBM budget; any fragment write invalidates."""
+        import jax.numpy as jnp
+
+        if width * 32 >= CONTAINER_BITS:
+            return None
+        key = ("pbank", shard, width)
+        with self._lock:
+            frag = self.fragments.get(shard)
+            versions = {shard: (frag.version if frag else -1)}
+            cached = self._bank_cache.get(key)
+            if isinstance(cached, PositionsBank) \
+                    and cached.versions == versions:
+                BANK_BUDGET.touch(self, key)
+                return cached
+            if frag is None:
+                return None
+        row_ids = frag.row_ids()
+        row_ids.sort()
+        segments = []
+        nbytes = 0
+        pos_parts: list = []
+        lens_parts: list = []
+        cur_p = 0
+        row_lo = 0
+        rows_done = 0
+
+        def flush():
+            nonlocal pos_parts, lens_parts, cur_p, row_lo, nbytes
+            if not lens_parts:
+                return
+            pos16 = (np.concatenate(pos_parts) if pos_parts
+                     else np.empty(0, np.uint16))
+            lens = np.concatenate(lens_parts)
+            starts = np.zeros(len(lens) + 1, np.int64)
+            np.cumsum(lens, out=starts[1:])
+            p = len(pos16)
+            padded = 1 << max(10, (p - 1).bit_length() if p else 0)
+            buf = np.full(padded, 0xFFFF, np.uint16)  # OOB-gather pad
+            buf[:p] = pos16
+            seg = (row_lo, len(lens), jnp.asarray(buf),
+                   jnp.asarray(starts.astype(np.int32)), p)
+            segments.append(seg)
+            nbytes += padded * 2 + (len(lens) + 1) * 4
+            pos_parts, lens_parts = [], []
+            cur_p = 0
+            row_lo += len(lens)
+
+        for c0 in range(0, len(row_ids), PBANK_GATHER_ROWS):
+            chunk = row_ids[c0:c0 + PBANK_GATHER_ROWS]
+            rp = frag.rows_positions(chunk, width)
+            if rp is None:
+                return None  # dense container somewhere: dense paths
+            pos16, lens, rows_at = rp
+            # Align lens to EVERY chunk row (a present row always has
+            # real positions, but stay defensive about empties).
+            if len(rows_at) != len(chunk):
+                full = np.zeros(len(chunk), np.int64)
+                full[rows_at] = lens
+                lens = full
+                # positions already concatenated in rows_at order ==
+                # ascending row order; empties contribute nothing.
+            pos_parts.append(pos16)
+            lens_parts.append(lens)
+            cur_p += len(pos16)
+            rows_done += len(chunk)
+            if cur_p >= PBANK_SEGMENT_POSITIONS:
+                flush()
+        flush()
+        bank = PositionsBank(segments, row_ids, versions, nbytes)
+        with self._lock:
+            self._bank_cache[key] = bank
+        BANK_BUDGET.admit(self, key, nbytes=nbytes)
+        return bank
 
     def _patch_bank(self, cached: "ViewBank", frags, versions, row_set,
                     shards, width):

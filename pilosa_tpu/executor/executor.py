@@ -92,6 +92,13 @@ TOPN_MAX_BANK_BYTES = int(os.environ.get("PILOSA_TPU_TOPN_BANK_BYTES",
 # live at narrow widths.
 TOPN_CHUNK_ROWS = int(os.environ.get("PILOSA_TPU_TOPN_CHUNK_ROWS", 1024))
 
+# Device-resident positions bank for over-budget TopN (kill switch):
+# when a narrow single-shard view outgrows TOPN_MAX_BANK_BYTES, keep
+# its u16 positions resident (~2 B/set bit) and answer filtered TopN
+# with one gather+cumsum pass per query instead of streaming dense
+# chunk banks (view.PositionsBank).
+PBANK_ENABLED = os.environ.get("PILOSA_TPU_PBANK", "1") != "0"
+
 # Warm-cache TopN self-check sampling: 1 in this many warm hits ALSO
 # runs the exact device sweep and compares (VERDICT r3 weak #5: the
 # shortcut's correctness rests on every write path refreshing cached
@@ -1133,6 +1140,19 @@ class Executor:
                 (all_rows, bank, self._dispatch_counts(bank.array,
                                                        filter_words)))
         else:
+            if PBANK_ENABLED and self.mesh is None and len(shards) == 1 \
+                    and allowed_rows is None and not ids_arg and n \
+                    and selfcheck_pairs is None:
+                # Positions-resident fast path: the whole view's sorted
+                # positions live on device; no streaming, no expansion.
+                pb = view.positions_bank(shards[0], width)
+                if pb is not None:
+                    src_pb = None
+                    if tanimoto and filter_words is not None:
+                        src_pb = self._popcount_row(filter_words)
+                    return self._topn_positions(pb, filter_words, n,
+                                                tanimoto, min_threshold,
+                                                src_pb)
             # Huge row sets stream through transient chunk banks to bound
             # HBM (the 50k-row ranked-cache shape). Chunks are uploaded
             # lazily in finalize with one-chunk lookahead — dispatching
@@ -1222,6 +1242,97 @@ class Executor:
             # the full-bank path needs no such care because its device
             # arrays snapshot at dispatch.
             return finalize()
+        return _Pending(finalize)
+
+    _PBANK_KERNELS: Dict[tuple, Callable] = {}
+
+    @classmethod
+    def _pbank_kernel(cls, k: int, has_filter: bool):
+        """Jitted per-segment TopN over a PositionsBank: |row ∧ filter|
+        = Σ_{p ∈ row} filter_bit[p], computed as a gather of filter
+        bits at every stored position + a cumsum differenced at row
+        starts (u32 wrap subtraction is exact — per-row counts fit
+        u16). No dense expansion, no streaming: one pass over the
+        resident positions. Unfiltered TopN skips even that — counts
+        are the start diffs. Tanimoto/threshold ride as traced params;
+        lax.top_k breaks ties by lower index, which IS the (-count,
+        row) order because rows are stored ascending."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        key = (k, has_filter)
+        fn = cls._PBANK_KERNELS.get(key)
+        if fn is not None:
+            return fn
+
+        @jax.jit
+        def kernel(fw, pos, starts, params):
+            raw = starts[1:] - starts[:-1]
+            if has_filter:
+                posi = pos.astype(jnp.int32)
+                # Pad sentinel 0xFFFF gathers out of range -> fill 0.
+                bits = (jnp.take(fw, posi >> 5, mode="fill",
+                                 fill_value=0)
+                        >> (posi & 31).astype(jnp.uint32)) & jnp.uint32(1)
+                s = jnp.concatenate(
+                    [jnp.zeros(1, jnp.uint32),
+                     jnp.cumsum(bits, dtype=jnp.uint32)])
+                c = (s[starts[1:]] - s[starts[:-1]]).astype(jnp.int32)
+            else:
+                c = raw
+            thresh, tani, src = (params[0].astype(jnp.int32),
+                                 params[1].astype(jnp.int32),
+                                 params[2].astype(jnp.int32))
+            keep = c >= jnp.maximum(1, thresh)
+            denom = raw + src - c
+            keep &= jnp.where(tani > 0,
+                              (denom > 0) & (c * 100 >= tani * denom),
+                              True)
+            score = jnp.where(keep, c, -1)
+            return jax.lax.top_k(score, k)
+
+        cls._PBANK_KERNELS[key] = kernel
+        return kernel
+
+    def _topn_positions(self, pb, filter_words, n: int, tanimoto: int,
+                        min_threshold: int, src_dev) -> "_Pending":
+        """TopN over a device-resident PositionsBank (see
+        view.PositionsBank): per segment one kernel dispatch, host
+        merge of k-candidates across segments."""
+        import jax.numpy as jnp
+
+        fw = None
+        if filter_words is not None:
+            fw = filter_words[0]  # [W] u32, single shard
+        outs = []
+        for row_lo, n_rows, pos, starts, _p in pb.segments:
+            k = min(n, n_rows)
+            if k == 0:
+                continue
+            kern = self._pbank_kernel(k, fw is not None)
+            params = jnp.asarray(
+                np.asarray([min_threshold, tanimoto, 0], np.uint32))
+            if tanimoto and src_dev is not None:
+                params = params.at[2].set(
+                    jnp.asarray(src_dev).astype(jnp.uint32))
+            outs.append((row_lo, kern(
+                fw if fw is not None
+                else jnp.zeros((1,), jnp.uint32), pos, starts, params)))
+
+        def finalize() -> PairsResult:
+            pairs = []
+            for row_lo, (vals, idxs) in outs:
+                v = np.asarray(vals)
+                ix = np.asarray(idxs)
+                for val, i in zip(v.tolist(), ix.tolist()):
+                    if val > 0:
+                        pairs.append((int(pb.row_ids[row_lo + i]),
+                                      int(val)))
+            pairs.sort(key=lambda rc: (-rc[1], rc[0]))
+            return PairsResult(pairs[:n])
+
         return _Pending(finalize)
 
     def _repair_topn_caches(self, view, shards) -> None:

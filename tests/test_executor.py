@@ -1104,3 +1104,55 @@ def test_sparse_full_bank_and_patching(tmp_path, monkeypatch):
     top = sorted(want.items(), key=lambda kv: (-kv[1], kv[0]))[:3]
     assert r2.pairs == top
     h.close()
+
+
+def test_positions_bank_topn_matches_streaming(tmp_path, monkeypatch):
+    """The positions-resident TopN path answers identically to the
+    chunk-streaming path for every variant: plain, filtered, tanimoto,
+    threshold — and invalidates on write."""
+    import numpy as np
+
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import executor as ex_mod
+
+    h = Holder(str(tmp_path / "h"))
+    h.open()
+    idx = h.create_index("pb")
+    f = idx.create_field("fp", FieldOptions(max_columns=4096,
+                                            cache_type="none"))
+    rng = np.random.default_rng(13)
+    n_rows = 700
+    rows = np.repeat(np.arange(n_rows, dtype=np.uint64),
+                     rng.integers(5, 40, n_rows))
+    cols = rng.integers(0, 4096, len(rows)).astype(np.uint64)
+    f.import_bits(rows, cols)
+    monkeypatch.setattr(ex_mod, "TOPN_MAX_BANK_BYTES", 1)  # force regime
+    queries = [
+        "TopN(fp, n=7)",
+        "TopN(fp, Row(fp=3), n=7)",
+        "TopN(fp, Row(fp=3), n=9, tanimotoThreshold=20)",
+        "TopN(fp, n=5, threshold=25)",
+    ]
+    want = {}
+    monkeypatch.setattr(ex_mod, "PBANK_ENABLED", False)
+    ex = Executor(h)
+    for q in queries:
+        (res,) = ex.execute("pb", q)
+        want[q] = res.pairs
+    monkeypatch.setattr(ex_mod, "PBANK_ENABLED", True)
+    ex2 = Executor(h)
+    for q in queries:
+        (res,) = ex2.execute("pb", q)
+        assert res.pairs == want[q], q
+        assert len(res.pairs) > 0
+    # Repeat query hits the cached bank (no rebuild) and a write
+    # invalidates it.
+    view = f.view()
+    assert any(k[0] == "pbank" for k in view._bank_cache)
+    f.set_bit(3, 4095)
+    (res,) = ex2.execute("pb", "TopN(fp, Row(fp=3), n=7)")
+    (ref,) = ex.execute("pb", "TopN(fp, Row(fp=3), n=7)")
+    assert res.pairs == ref.pairs
+    h.close()
