@@ -126,8 +126,13 @@ class BankBudget:
                 self.total -= old[1]
 
 
+# Default sized for a v5e-class chip (16 GiB HBM): 12 GiB of resident
+# banks leaves ~4 GiB for transient chunk banks, filter rows, sparse
+# expansions, and XLA scratch. The 100M-fingerprint positions bank
+# (~9.6 GiB) must fit WITH its filter banks or the LRU thrashes it on
+# every query — the round-3 8 GiB default did exactly that.
 BANK_BUDGET = BankBudget(
-    int(os.environ.get("PILOSA_TPU_HBM_BUDGET_BYTES", 8 << 30)))
+    int(os.environ.get("PILOSA_TPU_HBM_BUDGET_BYTES", 12 << 30)))
 
 # Process-wide host-RAM budget for cached packed chunk blocks (the
 # chunked-TopN repeat-query shortcut). 0 disables caching.
