@@ -349,10 +349,16 @@ class Fragment:
         (pos16 concat, lens, rows_at) — the SET bit positions of each
         row, ~2 bytes each, versus the 4*u32_words a dense row costs.
         The chunked-TopN upload path expands these to the dense bank ON
-        DEVICE (view._expand_sparse_chunk), so a tunnel-attached chip
-        transfers only real data. None when the layout doesn't qualify
-        (row wider than one container, or any dense-encoded container —
-        the dense fallback handles those)."""
+        DEVICE (view._expand_sparse_chunk) and the positions bank keeps
+        them resident, so a tunnel-attached chip transfers only real
+        data. Dense-ENCODED containers still qualify (a point write
+        densifies its row's container for mutation — one Set must not
+        disqualify a 100M-row field): their positions are extracted,
+        bailing to None only when >25% of rows are dense (a genuinely
+        dense field belongs on the dense paths) or a row spans more
+        than one container."""
+        from pilosa_tpu.storage.roaring import _dense_to_array
+
         bits = u32_words * 32
         if bits > CONTAINER_BITS or bits % 64:
             return None
@@ -361,11 +367,25 @@ class Fragment:
             arrays, rows_at, dense_items = self._gather_row_arrays(
                 self.storage.containers, row_ids, total64,
                 CONTAINER_BITS // 64)
-        if dense_items:
-            return None
+            if dense_items:
+                if len(dense_items) * 4 > max(1, len(row_ids)):
+                    return None
+                lim = np.uint16(bits - 1) if bits < CONTAINER_BITS \
+                    else None
+                for i, c in dense_items:
+                    pos = _dense_to_array(c)
+                    if lim is not None and len(pos) and pos[-1] > lim:
+                        pos = pos[:np.searchsorted(pos, lim, "right")]
+                    arrays.append(pos)
+                    rows_at.append(i)
         if not arrays:
             return (np.empty(0, np.uint16), np.empty(0, np.int64),
                     np.empty(0, np.int64))
+        if dense_items:
+            # Re-establish ascending row order after the appends.
+            order = np.argsort(np.asarray(rows_at), kind="stable")
+            arrays = [arrays[j] for j in order]
+            rows_at = [rows_at[j] for j in order]
         lens = np.fromiter(map(len, arrays), dtype=np.int64,
                            count=len(arrays))
         return (np.concatenate(arrays),

@@ -186,10 +186,14 @@ class PositionsBank:
         self.nbytes = nbytes
 
 
-# Positions per device segment (i32-offset bound with headroom) and the
-# host gather chunk for the one-time build.
+# Positions per device segment. The TopN kernel's cumsum array has
+# padded+1 elements and is indexed with i32 (x64 stays off), so a
+# segment must stay well under 2^31 AFTER power-of-two padding: cap at
+# 2^29, pad to at most 2^30 (+ one gather chunk of headroom before the
+# flush check runs). The host gather chunk bounds the one-time build's
+# temporaries.
 PBANK_SEGMENT_POSITIONS = int(os.environ.get(
-    "PILOSA_TPU_PBANK_SEGMENT", 1 << 30))
+    "PILOSA_TPU_PBANK_SEGMENT", 1 << 29))
 PBANK_GATHER_ROWS = 1 << 20
 
 

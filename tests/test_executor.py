@@ -1155,4 +1155,18 @@ def test_positions_bank_topn_matches_streaming(tmp_path, monkeypatch):
     (res,) = ex2.execute("pb", "TopN(fp, Row(fp=3), n=7)")
     (ref,) = ex.execute("pb", "TopN(fp, Row(fp=3), n=7)")
     assert res.pairs == ref.pairs
+
+    # Multi-segment bank (billion-position shape scaled down): answers
+    # must merge across segments identically.
+    from pilosa_tpu.core import view as view_mod
+    monkeypatch.setattr(view_mod, "PBANK_SEGMENT_POSITIONS", 512)
+    monkeypatch.setattr(view_mod, "PBANK_GATHER_ROWS", 128)
+    view._bank_cache.clear()
+    ex3 = Executor(h)
+    for q in queries:
+        (res,) = ex3.execute("pb", q)
+        (ref,) = ex.execute("pb", q)
+        assert res.pairs == ref.pairs, q
+    pb = view.positions_bank(0, view.trimmed_words())
+    assert len(pb.segments) > 3  # the sweep above really merged
     h.close()
