@@ -482,7 +482,12 @@ class View:
             starts = np.zeros(len(lens) + 1, np.int64)
             np.cumsum(lens, out=starts[1:])
             p = len(pos16)
-            padded = 1 << max(10, (p - 1).bit_length() if p else 0)
+            # Pad to a 1M multiple, NOT a power of two: segments build
+            # once (per version), so compile reuse matters little, and
+            # pow2 padding nearly doubled a ~10 GiB bank — pushing it
+            # over the HBM budget and into rebuild-per-query thrash
+            # (caught by the 100M run).
+            padded = max(1 << 20, -(-p // (1 << 20)) * (1 << 20))
             buf = np.full(padded, 0xFFFF, np.uint16)  # OOB-gather pad
             buf[:p] = pos16
             seg = (row_lo, len(lens), jnp.asarray(buf),
