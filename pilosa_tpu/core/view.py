@@ -186,12 +186,13 @@ class PositionsBank:
         self.nbytes = nbytes
 
 
-# Positions per device segment. The TopN kernel's cumsum array has
-# padded+1 elements and is indexed with i32 (x64 stays off), so a
-# segment must stay well under 2^31 AFTER power-of-two padding: cap at
-# 2^29, pad to at most 2^30 (+ one gather chunk of headroom before the
-# flush check runs). The host gather chunk bounds the one-time build's
-# temporaries.
+# Positions per device segment. The TopN kernel's cumsum array is
+# i32-indexed (x64 stays off), so segment position counts must stay
+# well under 2^31; the build enforces the cap EXACTLY by splitting
+# gather chunks on row boundaries (a row contributes at most 2^16
+# positions, so no single row can break it). 2^29 leaves 4x headroom
+# under i32 while keeping segment count single-digit at 100M rows.
+# The host gather chunk bounds the one-time build's temporaries.
 PBANK_SEGMENT_POSITIONS = int(os.environ.get(
     "PILOSA_TPU_PBANK_SEGMENT", 1 << 29))
 PBANK_GATHER_ROWS = 1 << 20
@@ -443,10 +444,12 @@ class View:
     def positions_bank(self, shard: int, width: int
                        ) -> Optional[PositionsBank]:
         """Device-resident PositionsBank for one shard, or None when
-        the layout doesn't qualify (no fragment, any dense-encoded
-        container, or width spanning a full container — the 0xFFFF pad
-        sentinel must gather out of range). Cached per (shard, width)
-        under the HBM budget; any fragment write invalidates."""
+        the layout doesn't qualify: no fragment, width spanning a full
+        container (the 0xFFFF pad sentinel must gather out of range),
+        or a genuinely dense field (>25% dense-encoded containers in
+        some gather chunk — a FEW densified rows, e.g. from point
+        writes, are extracted and stay in-bank). Cached per
+        (shard, width) under the HBM budget; any write invalidates."""
         import jax.numpy as jnp
 
         if width * 32 >= CONTAINER_BITS:
@@ -502,7 +505,7 @@ class View:
             chunk = row_ids[c0:c0 + PBANK_GATHER_ROWS]
             rp = frag.rows_positions(chunk, width)
             if rp is None:
-                return None  # dense container somewhere: dense paths
+                return None  # too dense for the sparse layout
             pos16, lens, rows_at = rp
             # Align lens to EVERY chunk row (a present row always has
             # real positions, but stay defensive about empties).
@@ -512,12 +515,30 @@ class View:
                 lens = full
                 # positions already concatenated in rows_at order ==
                 # ascending row order; empties contribute nothing.
-            pos_parts.append(pos16)
-            lens_parts.append(lens)
-            cur_p += len(pos16)
+            # Enforce the segment cap EXACTLY, splitting this chunk on
+            # row boundaries if needed — checking only after a whole
+            # chunk appends would let dense-heavy rows blow a segment
+            # past the kernel's i32 index space (up to 2^16
+            # positions/row x 2^20 rows/chunk).
+            ends = np.cumsum(lens)
+            taken = 0
+            while taken < len(lens):
+                room = PBANK_SEGMENT_POSITIONS - cur_p
+                # Rows of this chunk (beyond `taken`) that fit in room.
+                hi = int(np.searchsorted(ends, ends[taken - 1] + room
+                                         if taken else room, "right"))
+                if hi <= taken:
+                    flush()
+                    continue
+                lo_p = int(ends[taken - 1]) if taken else 0
+                hi_p = int(ends[hi - 1])
+                pos_parts.append(pos16[lo_p:hi_p])
+                lens_parts.append(lens[taken:hi])
+                cur_p += hi_p - lo_p
+                taken = hi
+                if cur_p >= PBANK_SEGMENT_POSITIONS:
+                    flush()
             rows_done += len(chunk)
-            if cur_p >= PBANK_SEGMENT_POSITIONS:
-                flush()
         flush()
         bank = PositionsBank(segments, row_ids, versions, nbytes)
         with self._lock:

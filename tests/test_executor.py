@@ -1169,4 +1169,10 @@ def test_positions_bank_topn_matches_streaming(tmp_path, monkeypatch):
         assert res.pairs == ref.pairs, q
     pb = view.positions_bank(0, view.trimmed_words())
     assert len(pb.segments) > 3  # the sweep above really merged
+    # The cap is enforced EXACTLY even though gather chunks (128 rows
+    # here) carry far more positions than one segment holds — chunks
+    # split on row boundaries (code-review r4: checking only after a
+    # whole chunk appended could blow the kernel's i32 index space).
+    assert all(p_real <= 512 for *_x, p_real in pb.segments)
+    assert sum(nr for _lo, nr, *_r in pb.segments) == len(pb.row_ids)
     h.close()
