@@ -105,17 +105,31 @@ void parallel_ranges(uint64_t n, uint64_t grain, F&& fn) {
   ts.reserve(chunks);
   std::exception_ptr err = nullptr;
   std::mutex err_mu;
+  auto record = [&err, &err_mu]() {
+    std::lock_guard<std::mutex> g(err_mu);
+    if (!err) err = std::current_exception();
+  };
   for (uint64_t t = 0; t < chunks; t++) {
     const uint64_t lo = t * per, hi = std::min(n, lo + per);
     if (lo >= hi) break;
-    ts.emplace_back([&fn, &err, &err_mu, lo, hi, t] {
+    try {
+      ts.emplace_back([&fn, &record, lo, hi, t] {
+        try {
+          fn(lo, hi, t);
+        } catch (...) {
+          record();
+        }
+      });
+    } catch (...) {
+      // Thread spawn itself failed (EAGAIN under pid limits): letting
+      // it unwind would destroy joinable threads -> std::terminate.
+      // Run this chunk inline instead; the work still completes.
       try {
         fn(lo, hi, t);
       } catch (...) {
-        std::lock_guard<std::mutex> g(err_mu);
-        if (!err) err = std::current_exception();
+        record();
       }
-    });
+    }
   }
   for (auto& th : ts) th.join();
   if (err) std::rethrow_exception(err);
@@ -1140,9 +1154,11 @@ uint64_t pn_serialize_groups(const uint64_t* keys, const uint16_t* lows,
       }
     });
   } catch (...) {
-    // Exceptions must not cross the C ABI (ctypes caller): 0 is this
-    // function's error convention, the caller falls back to Python.
-    return 0;
+    // Exceptions must not cross the C ABI (ctypes caller). 0 means
+    // "bad bounds" (caller raises); an execution failure (OOM,
+    // thread-spawn) returns ~0 so the wrapper can fall back to the
+    // Python serializer instead of misdiagnosing corrupt data.
+    return ~0ull;
   }
   return offs[m];
 }

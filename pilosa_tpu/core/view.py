@@ -400,7 +400,11 @@ class View:
             array = None
             if host is None and SPARSE_UPLOAD \
                     and mesh is None and len(shards) == 1 \
-                    and trim and width * 32 <= CONTAINER_BITS:
+                    and trim and width * 32 <= CONTAINER_BITS \
+                    and cap * width < (1 << 31):
+                # (cap*width bound: the expansion scatter indexes with
+                # i32 — an operator-raised bank budget must fall back
+                # to the dense path, not wrap indices.)
                 # Sparse upload (chunk AND full-bank builds): ship
                 # positions, expand to the dense bank on device.
                 f = frags[shards[0]]

@@ -1150,9 +1150,14 @@ class Executor:
                     src_pb = None
                     if tanimoto and filter_words is not None:
                         src_pb = self._popcount_row(filter_words)
-                    return self._topn_positions(pb, filter_words, n,
-                                                tanimoto, min_threshold,
-                                                src_pb)
+                    # tanimoto applies only WITH a filter (the dense
+                    # finalize's `if tanimoto and filter_words` rule) —
+                    # passing it filterless would zero every denominator
+                    # and empty the result.
+                    return self._topn_positions(
+                        pb, filter_words, n,
+                        tanimoto if filter_words is not None else 0,
+                        min_threshold, src_pb)
             # Huge row sets stream through transient chunk banks to bound
             # HBM (the 50k-row ranked-cache shape). Chunks are uploaded
             # lazily in finalize with one-chunk lookahead — dispatching

@@ -329,6 +329,10 @@ def serialize_groups(keys: np.ndarray, lows: np.ndarray,
         lows.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
         _as_u64_ptr(bounds), m,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if size == (1 << 64) - 1:
+        # Native execution failure (OOM/thread spawn) — distinct from
+        # bad bounds; None routes callers to the Python serializer.
+        return None
     if size == 0 and m > 0:
         raise ValueError("pn_serialize_groups: bad group bounds")
     return out[:size].tobytes()

@@ -75,23 +75,27 @@ class AsyncBroadcaster:
         with self._lock:
             return bool(self._queues.get(uri))
 
-    def send_now_or_queue(self, uri: str, message: dict) -> bool:
+    def send_now_or_queue(self, uri: str, message: dict,
+                          coalesce: bool = False) -> bool:
         """Deliver synchronously when possible, queue otherwise —
         WITHOUT breaking per-peer ordering: if messages are already
         queued for this peer, this one lines up behind them (a sync
         send would overtake the queue and e.g. land resize-complete
         before the node-leave it completes). Topology-change callers
         use this so reachable peers learn the new membership BEFORE any
-        follow-up direct RPC (the resize job's pull) reaches them.
-        Returns True when delivered now."""
+        follow-up direct RPC (the resize job's pull) reaches them, and
+        cache-invalidation callers so an import ack means reachable
+        peers already dropped their caches. Returns True when
+        delivered now."""
         if not self.has_pending(uri):
             try:
                 self._client.cluster_message(uri, message)
-                self.sent += 1
+                with self._lock:
+                    self.sent += 1
                 return True
             except Exception:
                 pass  # fall through to the queued/retried path
-        self.send(uri, message)
+        self.send(uri, message, coalesce=coalesce)
         return False
 
     def flush(self, timeout: float = 10.0) -> bool:
@@ -170,5 +174,5 @@ class AsyncBroadcaster:
                 if q and q[0] == (deadline, msg):
                     q.popleft()
                 self._backoff.pop(uri, None)
-            self.sent += 1
+                self.sent += 1  # under the lock: callers also bump it
             backoff = 0.0

@@ -205,9 +205,12 @@ class ClusterExecutor:
                 continue
             msg = {"type": "shards-changed", "index": index}
             if self.broadcaster is not None:
-                # coalesce: N queued copies of a cache-invalidation do
-                # what one does — a down peer gets one, not a backlog.
-                self.broadcaster.send(node.uri, msg, coalesce=True)
+                # Sync-first: the import ack must mean reachable peers
+                # already dropped their shard caches (queue-only opened
+                # a read-your-writes-via-another-node window). Down
+                # peers get ONE queued copy (coalesce), not a backlog.
+                self.broadcaster.send_now_or_queue(node.uri, msg,
+                                                   coalesce=True)
                 continue
             try:
                 self.client.cluster_message(node.uri, msg)

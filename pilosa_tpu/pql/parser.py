@@ -436,7 +436,11 @@ def parse_string_cached(src: str) -> Query:
         hit = _PARSE_CACHE.pop(src, None)
         if hit is not None:
             _PARSE_CACHE[src] = hit  # re-insert: LRU by dict order
-            return hit.clone()
+    if hit is not None:
+        # Clone OUTSIDE the lock: the warm path runs on every request
+        # thread, and a big filter tree's clone under a global lock
+        # would serialize them.
+        return hit.clone()
     parsed = parse_string(src)
     with _PARSE_LOCK:
         while len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:

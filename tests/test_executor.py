@@ -1134,6 +1134,9 @@ def test_positions_bank_topn_matches_streaming(tmp_path, monkeypatch):
         "TopN(fp, Row(fp=3), n=7)",
         "TopN(fp, Row(fp=3), n=9, tanimotoThreshold=20)",
         "TopN(fp, n=5, threshold=25)",
+        # tanimoto WITHOUT a filter is ignored (the dense finalize's
+        # rule) — the pbank path must not zero the denominators.
+        "TopN(fp, n=6, tanimotoThreshold=50)",
     ]
     want = {}
     monkeypatch.setattr(ex_mod, "PBANK_ENABLED", False)
