@@ -445,39 +445,19 @@ class View:
                 BANK_BUDGET.admit(self, cache_key)
             return bank
 
-    def positions_bank(self, shard: int, width: int
-                       ) -> Optional[PositionsBank]:
-        """Device-resident PositionsBank for one shard, or None when
-        the layout doesn't qualify: no fragment, width spanning a full
-        container (the 0xFFFF pad sentinel must gather out of range),
-        or a genuinely dense field (>25% dense-encoded containers in
-        some gather chunk — a FEW densified rows, e.g. from point
-        writes, are extracted and stay in-bank). Cached per
-        (shard, width) under the HBM budget; any write invalidates."""
+    def _build_pbank_segments(self, frag, rows: list, width: int,
+                              row_lo0: int):
+        """Gather `rows` (sorted) into device segments starting at
+        global row index `row_lo0`: [(row_lo, n_rows, pos_dev,
+        starts_dev, p_real)], total nbytes — or None when too dense."""
         import jax.numpy as jnp
 
-        if width * 32 >= CONTAINER_BITS:
-            return None
-        key = ("pbank", shard, width)
-        with self._lock:
-            frag = self.fragments.get(shard)
-            versions = {shard: (frag.version if frag else -1)}
-            cached = self._bank_cache.get(key)
-            if isinstance(cached, PositionsBank) \
-                    and cached.versions == versions:
-                BANK_BUDGET.touch(self, key)
-                return cached
-            if frag is None:
-                return None
-        row_ids = frag.row_ids()
-        row_ids.sort()
-        segments = []
+        segments: list = []
         nbytes = 0
         pos_parts: list = []
         lens_parts: list = []
         cur_p = 0
-        row_lo = 0
-        rows_done = 0
+        row_lo = row_lo0
 
         def flush():
             nonlocal pos_parts, lens_parts, cur_p, row_lo, nbytes
@@ -505,8 +485,8 @@ class View:
             cur_p = 0
             row_lo += len(lens)
 
-        for c0 in range(0, len(row_ids), PBANK_GATHER_ROWS):
-            chunk = row_ids[c0:c0 + PBANK_GATHER_ROWS]
+        for c0 in range(0, len(rows), PBANK_GATHER_ROWS):
+            chunk = rows[c0:c0 + PBANK_GATHER_ROWS]
             rp = frag.rows_positions(chunk, width)
             if rp is None:
                 return None  # too dense for the sparse layout
@@ -542,13 +522,89 @@ class View:
                 taken = hi
                 if cur_p >= PBANK_SEGMENT_POSITIONS:
                     flush()
-            rows_done += len(chunk)
         flush()
+        return segments, nbytes
+
+    def positions_bank(self, shard: int, width: int
+                       ) -> Optional[PositionsBank]:
+        """Device-resident PositionsBank for one shard, or None when
+        the layout doesn't qualify: no fragment, width spanning a full
+        container (the 0xFFFF pad sentinel must gather out of range),
+        or a genuinely dense field (>25% dense-encoded containers in
+        some gather chunk — a FEW densified rows, e.g. from point
+        writes, are extracted and stay in-bank). Cached per
+        (shard, width) under the HBM budget. A write invalidates by
+        version; the rebuild is INCREMENTAL when the row set is
+        unchanged — only segments containing written rows regather,
+        the rest reuse their device arrays (at 100M rows a point write
+        costs ~1/segment-count of the full build, not minutes)."""
+        if width * 32 >= CONTAINER_BITS:
+            return None
+        key = ("pbank", shard, width)
+        with self._lock:
+            frag = self.fragments.get(shard)
+            versions = {shard: (frag.version if frag else -1)}
+            cached = self._bank_cache.get(key)
+            if isinstance(cached, PositionsBank) \
+                    and cached.versions == versions:
+                BANK_BUDGET.touch(self, key)
+                return cached
+            if frag is None:
+                return None
+        row_ids = frag.row_ids()
+        row_ids.sort()
+        built = None
+        if isinstance(cached, PositionsBank) \
+                and cached.row_ids == row_ids:
+            built = self._patch_pbank(cached, frag, width)
+        if built is None:
+            built = self._build_pbank_segments(frag, row_ids, width, 0)
+        if built is None:
+            return None
+        segments, nbytes = built
         bank = PositionsBank(segments, row_ids, versions, nbytes)
         with self._lock:
             self._bank_cache[key] = bank
         BANK_BUDGET.admit(self, key, nbytes=nbytes)
         return bank
+
+    def _patch_pbank(self, cached: PositionsBank, frag, width: int):
+        """Regather only the segments whose row ranges contain rows
+        written since the cached build; clean segments carry over with
+        their device arrays. Same-row-set only (the caller checked):
+        global row indexes then stay aligned except where segment
+        boundaries move, handled by rebuilding dirty ranges in place.
+        Returns (segments, nbytes) or None to force a full rebuild."""
+        changed = frag.rows_changed_since(
+            next(iter(cached.versions.values())))
+        if not changed or len(changed) > len(cached.row_ids) // 4:
+            return None  # nothing known, or patch ~= rebuild
+        dirty = set(changed)
+        segments: list = []
+        nbytes = 0
+        row_lo = 0
+        for seg in cached.segments:
+            s_lo, n_rows, pos_dev, starts_dev, p_real = seg
+            seg_rows = cached.row_ids[s_lo:s_lo + n_rows]
+            if dirty.isdisjoint(seg_rows):
+                # Clean: reuse the device arrays; only the global row
+                # offset may have shifted if an earlier dirty range
+                # re-split (row COUNT per range is unchanged, so it
+                # cannot — assert the invariant cheaply).
+                segments.append((row_lo, n_rows, pos_dev, starts_dev,
+                                 p_real))
+                nbytes += int(pos_dev.size) * 2 + (n_rows + 1) * 4
+                row_lo += n_rows
+                continue
+            rebuilt = self._build_pbank_segments(frag, seg_rows, width,
+                                                 row_lo)
+            if rebuilt is None:
+                return None
+            new_segs, nb = rebuilt
+            segments.extend(new_segs)
+            nbytes += nb
+            row_lo += n_rows
+        return segments, nbytes
 
     def _patch_bank(self, cached: "ViewBank", frags, versions, row_set,
                     shards, width):

@@ -1179,3 +1179,58 @@ def test_positions_bank_topn_matches_streaming(tmp_path, monkeypatch):
     assert all(p_real <= 512 for *_x, p_real in pb.segments)
     assert sum(nr for _lo, nr, *_r in pb.segments) == len(pb.row_ids)
     h.close()
+
+
+def test_positions_bank_incremental_patch(tmp_path, monkeypatch):
+    """A point write rebuilds only the segment containing the written
+    row; every other segment reuses its device arrays — and answers
+    stay exact vs the streaming path."""
+    import numpy as np
+
+    from pilosa_tpu.core import view as view_mod
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import executor as ex_mod
+
+    monkeypatch.setattr(view_mod, "PBANK_SEGMENT_POSITIONS", 2048)
+    monkeypatch.setattr(view_mod, "PBANK_GATHER_ROWS", 256)
+    h = Holder(str(tmp_path / "h"))
+    h.open()
+    idx = h.create_index("ip")
+    f = idx.create_field("fp", FieldOptions(max_columns=4096,
+                                            cache_type="none"))
+    rng = np.random.default_rng(17)
+    n_rows = 1200
+    rows = np.repeat(np.arange(n_rows, dtype=np.uint64), 15)
+    f.import_bits(rows, rng.integers(0, 4096, len(rows)).astype(np.uint64))
+    view = f.view()
+    w = view.trimmed_words()
+    pb1 = view.positions_bank(0, w)
+    assert pb1 is not None and len(pb1.segments) >= 4
+
+    f.set_bit(2, 4000)  # row 2 lives in the FIRST segment
+    pb2 = view.positions_bank(0, w)
+    assert pb2 is not pb1
+    # Later segments reuse the very same device arrays.
+    reused = sum(1 for a, b in zip(pb1.segments[1:], pb2.segments[1:])
+                 if b[2] is a[2])
+    assert reused >= len(pb1.segments) - 2
+    assert pb2.segments[0][2] is not pb1.segments[0][2]
+    # Row count bookkeeping intact.
+    assert sum(nr for _lo, nr, *_x in pb2.segments) == len(pb2.row_ids)
+
+    # Exactness vs the streaming path after the patch.
+    monkeypatch.setattr(ex_mod, "TOPN_MAX_BANK_BYTES", 1)
+    monkeypatch.setattr(ex_mod, "TOPN_CHUNK_ROWS", 64)
+    (a,) = Executor(h).execute("ip", "TopN(fp, Row(fp=2), n=6)")
+    monkeypatch.setattr(ex_mod, "PBANK_ENABLED", False)
+    (b,) = Executor(h).execute("ip", "TopN(fp, Row(fp=2), n=6)")
+    assert a.pairs == b.pairs
+
+    # A row-set CHANGE (brand-new row) falls back to a full rebuild.
+    monkeypatch.setattr(ex_mod, "PBANK_ENABLED", True)
+    f.set_bit(5000, 1)
+    pb3 = view.positions_bank(0, w)
+    assert len(pb3.row_ids) == n_rows + 1
+    h.close()
