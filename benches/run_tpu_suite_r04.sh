@@ -57,4 +57,5 @@ run taxi_100m 7200 env PILOSA_TAXI_N=100000000 PILOSA_TAXI_ITERS=3 python benche
 run tanimoto_chunked_100m 14400 env PILOSA_TANIMOTO_N=100000000 PILOSA_TANIMOTO_ITERS=1 python benches/tanimoto_chunked.py
 run tanimoto 1800 python benches/tanimoto.py
 run taxi_10m 3600 env PILOSA_TAXI_N=10000000 PILOSA_TAXI_ITERS=3 python benches/taxi.py
+run topn_cache 1200 python benches/topn_cache.py
 echo "$(date -u +%H:%M:%S) suite done" >&2
