@@ -269,11 +269,16 @@ def main():
         install_partial_record_handler
     install_partial_record_handler(
         "micro_suite", "mixed")
+    # Device-time table FIRST: with an intermittently-up TPU tunnel the
+    # roofline evidence is the leg's most precious output — spend the
+    # chip window on it before the host-side (tunnel-independent)
+    # benches, so a mid-leg tunnel drop costs the cheap lines, not the
+    # validated sweep table.
+    bench_device_time_table()
+    bench_device_kernels()
+    bench_query_qps()
     bench_roaring_kernels()
     bench_fragment_paths()
-    bench_query_qps()
-    bench_device_kernels()
-    bench_device_time_table()
 
 
 if __name__ == "__main__":

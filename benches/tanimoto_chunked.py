@@ -118,6 +118,11 @@ def main():
         converted = N_MOLECULES
         load_s = time.perf_counter() - t0
 
+        # With an intermittent TPU tunnel, meet the chip at query time:
+        # the build above is host-only, so (when enabled) wait here.
+        from pilosa_tpu.utils.benchenv import hold_for_tpu
+        hold_for_tpu("tanimoto_chunked")
+
         ex = Executor(holder)
         q = (f"TopN(fingerprint, Row(fingerprint={QUERY_MOL}), "
              f"n=50, tanimotoThreshold={THRESHOLD})")

@@ -88,6 +88,11 @@ def main():
         load_s = time.perf_counter() - t0
         log(f"taxi: loaded in {load_s:.1f}s")
 
+        # With an intermittent TPU tunnel, meet the chip at query time:
+        # the load above is host-only, so (when enabled) wait here.
+        from pilosa_tpu.utils.benchenv import hold_for_tpu
+        hold_for_tpu("taxi")
+
         ex = Executor(holder)
 
         def p50(q):

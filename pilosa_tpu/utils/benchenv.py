@@ -156,6 +156,98 @@ def apply_bench_platform() -> None:
                           os.environ["PILOSA_BENCH_PLATFORM"])
 
 
+def probe_device_once(timeout_s: float = 75.0):
+    """One subprocess probe of the accelerator backend: (ok, detail).
+
+    Runs a tiny op in a FRESH python so the caller's process never
+    initializes jax against a dead tunnel (a dead axon tunnel makes
+    in-process backend init stall, not error). `detail` carries the
+    probe child's stderr tail on failure so a persistent non-tunnel
+    failure (misconfigured jax, cpu-pinned platform) is diagnosable
+    from the bench .err file."""
+    import subprocess
+    import sys
+
+    probe_src = ("import jax, jax.numpy as jnp;"
+                 "assert jax.devices()[0].platform != 'cpu', 'cpu backend';"
+                 "print(int(jnp.ones((8,), jnp.uint32).sum()))")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", probe_src], timeout=timeout_s,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s"
+    if r.returncode == 0:
+        return True, ""
+    tail = (r.stderr or b"").decode("utf-8", "replace").strip()
+    return False, tail[-500:] if tail else f"probe rc={r.returncode}"
+
+
+def hold_for_tpu(label: str = "bench"):
+    """Block until the device backend answers, probing in a subprocess
+    (probe_device_once) so the main process never initializes jax
+    against a dead tunnel.
+
+    Gated by PILOSA_BENCH_HOLD_FOR_TPU ("", "0", "false" = off); a
+    PILOSA_BENCH_PLATFORM smoke run never holds. Purpose: the long
+    benches spend many minutes (hours at 100M scale) building host-side
+    data before their first device op; with an intermittently-up TPU
+    tunnel, a leg that waited for the tunnel BEFORE building usually
+    finds it gone by query time. Calling this at the build->query
+    boundary inverts that: data builds while the tunnel is down, and
+    queries start the moment it answers. Bounded by
+    PILOSA_BENCH_HOLD_MAX_S (default 3 h); on deadline the process
+    EXITS non-zero — proceeding would stall on the first device op
+    (axon pins the tpu platform; a dead tunnel hangs rather than
+    falling back), burning the leg's remaining timeout, whereas a clean
+    failure leaves the leg unmarked so the suite's retry pass reclaims
+    it."""
+    import sys
+
+    if os.environ.get("PILOSA_BENCH_HOLD_FOR_TPU",
+                      "").lower() in ("", "0", "false"):
+        return
+    if os.environ.get("PILOSA_BENCH_PLATFORM"):
+        return
+    import signal
+
+    deadline = time.time() + float(
+        os.environ.get("PILOSA_BENCH_HOLD_MAX_S", str(3 * 3600)))
+    # Disarm any partial-record SIGTERM handler for the hold's duration:
+    # no real record can exist yet, and a zero-value partial printed
+    # from inside the hold would only mislead consumers about a leg
+    # that never reached its query phase.
+    prev_term = signal.getsignal(signal.SIGTERM)
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    try:
+        first_fail = True
+        while True:
+            ok, detail = probe_device_once()
+            if ok:
+                print(f"{label}: hold_for_tpu: device answered",
+                      file=sys.stderr, flush=True)
+                return
+            if first_fail and detail:
+                print(f"{label}: hold_for_tpu: probe failing: {detail}",
+                      file=sys.stderr, flush=True)
+                first_fail = False
+            if time.time() >= deadline:
+                print(f"{label}: hold_for_tpu: deadline passed with the "
+                      f"device still unreachable (last: {detail}); exiting "
+                      "so the suite retry pass can reclaim this leg",
+                      file=sys.stderr, flush=True)
+                sys.exit(75)  # EX_TEMPFAIL
+            print(f"{label}: hold_for_tpu: waiting for device...",
+                  file=sys.stderr, flush=True)
+            # Short sleep: a failed probe against a hung tunnel already
+            # costs its 75s timeout; the sleep only bounds probe-spawn
+            # churn, and every extra idle second here is taken out of
+            # a ~6-minute up-window.
+            time.sleep(20)
+    finally:
+        signal.signal(signal.SIGTERM, prev_term)
+
+
 def install_partial_record_handler(metric: str, unit: str):
     """SIGTERM -> print a partial JSON record and exit 0, so a
     suite-level `timeout` kill still leaves a parseable line (the axon
@@ -177,7 +269,10 @@ def install_partial_record_handler(metric: str, unit: str):
         # severed fragment line).
         sys.stdout.write("\n" + json.dumps(partial) + "\n")
         sys.stdout.flush()
-        os._exit(0)
+        # 143 (=128+SIGTERM), not 0: the line stays parseable, but the
+        # exit stays a failure so a suite run that marks legs done on
+        # rc==0 never counts a partial-only leg as completed.
+        os._exit(143)
 
     signal.signal(signal.SIGTERM, _on_term)
 
