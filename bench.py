@@ -67,8 +67,8 @@ TIMING_BUDGET_S = 90.0  # stop the timing loop early past this (>=2 samples)
 # Probe horizon: the tunnel can degrade for minutes at a time (it cost
 # round 2 its official TPU record after just 2 probes 20 s apart), so
 # probing now spans ~10 minutes before giving up on the backend.
-PROBE_TIMEOUT_S = 150
-PROBE_RETRIES = 8
+PROBE_TIMEOUT_S = int(os.environ.get("PILOSA_BENCH_PROBE_TIMEOUT_S", 150))
+PROBE_RETRIES = int(os.environ.get("PILOSA_BENCH_PROBE_RETRIES", 8))
 PROBE_BACKOFF_S = (0, 20, 40, 60, 90, 120, 120, 120)
 
 # Same-round carry-forward: every successful TPU child run persists its
