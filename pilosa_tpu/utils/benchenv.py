@@ -149,11 +149,44 @@ def apply_bench_platform() -> None:
     """Honor PILOSA_BENCH_PLATFORM (e.g. 'cpu' for smoke runs): the axon
     sitecustomize hook force-selects its platform through jax.config,
     overriding JAX_PLATFORMS, so benches must override it back the same
-    way tests/conftest.py does."""
+    way tests/conftest.py does. Also enables the shared persistent
+    compile cache (see enable_compile_cache)."""
     if os.environ.get("PILOSA_BENCH_PLATFORM"):
         import jax
         jax.config.update("jax_platforms",
                           os.environ["PILOSA_BENCH_PLATFORM"])
+    enable_compile_cache()
+
+
+def enable_compile_cache() -> None:
+    """Point jax's persistent compilation cache at a shared on-disk dir
+    (benches/.jax_cache; override or disable via
+    PILOSA_BENCH_COMPILE_CACHE, ''/'0' = off).
+
+    Why: TPU compiles cost 20-40 s each through the tunnel, and the
+    micro leg's device-time table compiles ~4 chain lengths x 8 kernel
+    families — more compile time than one observed ~6-minute tunnel
+    up-window contains. With the cache, a leg that dies mid-window
+    resumes its retry with every already-compiled program free, so two
+    short windows can finish what one cannot. Harmless if the backend
+    ignores the cache (worst case: unused dir)."""
+    d = os.environ.get("PILOSA_BENCH_COMPILE_CACHE")
+    if d in ("", "0"):
+        return
+    if d is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        d = os.path.join(repo_root, "benches", ".jax_cache")
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", d)
+        # Cache everything that took >=1 s to compile: trivial host-side
+        # jits stay out, every real device program gets reused.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception:  # pragma: no cover - older jax without the knobs
+        pass
 
 
 def probe_device_once(timeout_s: float = 75.0):
