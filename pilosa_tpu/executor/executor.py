@@ -1680,8 +1680,15 @@ class Executor:
         shards = self._shards(idx, shards)
         depth = bsig.bit_depth
         bank = self._get_bank_for(field, view_bsi_name(field_name), shards)
-        sel = jnp.asarray(np.asarray([bank.slot(r) for r in range(depth + 1)],
-                                     dtype=np.int32))
+        # Plane-slot vector memoized on the bank object: banks rebuild
+        # when fragment versions change, so the memo invalidates with
+        # them, and repeat Sum/Min/Max calls skip a host build + device
+        # upload (~1 ms/call, comparable to the whole device sweep).
+        sel = getattr(bank, "_bsi_sel", None)
+        if sel is None or int(sel.shape[0]) != depth + 1:
+            sel = jnp.asarray(np.asarray(
+                [bank.slot(r) for r in range(depth + 1)], dtype=np.int32))
+            bank._bsi_sel = sel
         filter_words = None
         if call.children:
             filter_words = _align_words(

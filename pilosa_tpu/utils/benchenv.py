@@ -161,7 +161,7 @@ def apply_bench_platform() -> None:
 def enable_compile_cache() -> None:
     """Point jax's persistent compilation cache at a shared on-disk dir
     (benches/.jax_cache; override or disable via
-    PILOSA_BENCH_COMPILE_CACHE, ''/'0' = off).
+    PILOSA_BENCH_COMPILE_CACHE, ''/'0'/'false' = off).
 
     Why: TPU compiles cost 20-40 s each through the tunnel, and the
     micro leg's device-time table compiles ~4 chain lengths x 8 kernel
@@ -171,13 +171,30 @@ def enable_compile_cache() -> None:
     short windows can finish what one cannot. Harmless if the backend
     ignores the cache (worst case: unused dir)."""
     d = os.environ.get("PILOSA_BENCH_COMPILE_CACHE")
-    if d in ("", "0"):
+    if d is not None and d.lower() in ("", "0", "false"):
         return
+    import jax
+
     if d is None:
+        # Default-dir arming is device-compiles only: XLA:CPU persists
+        # AOT machine code whose recorded machine features can mismatch
+        # the loading host (observed "+prefer-no-gather ... could lead
+        # to execution errors such as SIGILL" warnings on this very
+        # box), and sub-second CPU compiles gain nothing from a cache.
+        # The platform is read from config (set by apply_bench_platform
+        # for smoke runs, by the axon sitecustomize for device boxes) —
+        # NOT by initializing the backend, which stalls on a dead
+        # tunnel. cpu-first or unknown => stay off. An EXPLICIT
+        # PILOSA_BENCH_COMPILE_CACHE dir is an operator opt-in and is
+        # honored regardless.
+        plats = (jax.config.jax_platforms or
+                 os.environ.get("JAX_PLATFORMS") or "")
+        first = plats.split(",")[0].strip().lower()
+        if first in ("", "cpu"):
+            return
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         d = os.path.join(repo_root, "benches", ".jax_cache")
-    import jax
 
     try:
         jax.config.update("jax_compilation_cache_dir", d)

@@ -1,0 +1,156 @@
+"""Tests for the bench-environment helpers (pilosa_tpu/utils/benchenv.py):
+the hold-for-device gate, its deadline contract, the partial-record
+handler's exit status, and the persistent compile-cache knob. These are
+the pieces the round-4 TPU suite's retry correctness rests on
+(benches/run_tpu_suite_r04b.sh marks a leg done only on rc==0)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from pilosa_tpu.utils import benchenv
+
+
+@pytest.fixture
+def hold_env(monkeypatch):
+    """Clean slate for the hold gate's env knobs."""
+    for k in ("PILOSA_BENCH_HOLD_FOR_TPU", "PILOSA_BENCH_HOLD_MAX_S",
+              "PILOSA_BENCH_PLATFORM"):
+        monkeypatch.delenv(k, raising=False)
+    return monkeypatch
+
+
+def _forbid_probe(monkeypatch):
+    def boom(timeout_s=75.0):  # pragma: no cover - failure path
+        raise AssertionError("probe_device_once must not be called")
+    monkeypatch.setattr(benchenv, "probe_device_once", boom)
+
+
+def test_hold_gate_off_by_default(hold_env):
+    _forbid_probe(hold_env)
+    benchenv.hold_for_tpu("t")  # returns without probing
+
+
+@pytest.mark.parametrize("val", ["0", "false", "FALSE", ""])
+def test_hold_gate_off_values(hold_env, val):
+    _forbid_probe(hold_env)
+    hold_env.setenv("PILOSA_BENCH_HOLD_FOR_TPU", val)
+    benchenv.hold_for_tpu("t")
+
+
+def test_hold_noop_in_smoke_mode(hold_env):
+    """A PILOSA_BENCH_PLATFORM smoke run must never hold: the probe
+    asserts a non-cpu platform, so holding would always hit deadline."""
+    _forbid_probe(hold_env)
+    hold_env.setenv("PILOSA_BENCH_HOLD_FOR_TPU", "1")
+    hold_env.setenv("PILOSA_BENCH_PLATFORM", "cpu")
+    benchenv.hold_for_tpu("t")
+
+
+def test_hold_returns_when_device_answers(hold_env):
+    hold_env.setenv("PILOSA_BENCH_HOLD_FOR_TPU", "1")
+    hold_env.setattr(benchenv, "probe_device_once",
+                     lambda timeout_s=75.0: (True, ""))
+    before = signal.getsignal(signal.SIGTERM)
+    benchenv.hold_for_tpu("t")
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_hold_deadline_exits_tempfail(hold_env):
+    """Deadline with the device unreachable must EXIT (75), not proceed:
+    a dead axon tunnel makes the first in-process device op stall
+    forever, which would burn the leg's whole timeout."""
+    hold_env.setenv("PILOSA_BENCH_HOLD_FOR_TPU", "1")
+    hold_env.setenv("PILOSA_BENCH_HOLD_MAX_S", "0")
+    hold_env.setattr(benchenv, "probe_device_once",
+                     lambda timeout_s=75.0: (False, "down"))
+    before = signal.getsignal(signal.SIGTERM)
+    with pytest.raises(SystemExit) as exc:
+        benchenv.hold_for_tpu("t")
+    assert exc.value.code == 75
+    # The partial-record disarm is restored even on the exit path.
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_hold_disarms_sigterm_while_waiting(hold_env):
+    """During the hold, SIGTERM must be at SIG_DFL (no partial record
+    can be meaningful before the query phase)."""
+    hold_env.setenv("PILOSA_BENCH_HOLD_FOR_TPU", "1")
+    seen = {}
+
+    def probe(timeout_s=75.0):
+        seen["handler"] = signal.getsignal(signal.SIGTERM)
+        return True, ""
+
+    hold_env.setattr(benchenv, "probe_device_once", probe)
+    prev = signal.signal(signal.SIGTERM, lambda s, f: None)
+    try:
+        benchenv.hold_for_tpu("t")
+        assert seen["handler"] is signal.SIG_DFL
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_partial_record_handler_exits_143():
+    """SIGTERM during a bench leg: parseable partial line on stdout,
+    exit 143 — so an rc==0-based suite done-marker never counts a
+    partial-only leg as completed."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "import sys; sys.path.insert(0, %r);"
+         "from pilosa_tpu.utils.benchenv import"
+         " install_partial_record_handler;"
+         "install_partial_record_handler('m', 'u');"
+         "print('READY', flush=True);"
+         "import time; time.sleep(30)" % os.path.dirname(
+             os.path.dirname(os.path.abspath(__file__)))],
+        stdout=subprocess.PIPE, text=True)
+    assert proc.stdout.readline().strip() == "READY"
+    proc.terminate()
+    out, _ = proc.communicate(timeout=15)
+    assert proc.returncode == 143
+    rec = json.loads(out.strip().splitlines()[-1])
+    assert rec["partial"] is True and rec["metric"] == "m"
+
+
+@pytest.mark.parametrize("val", ["0", "false", "False", ""])
+def test_enable_compile_cache_disable(monkeypatch, val):
+    monkeypatch.setenv("PILOSA_BENCH_COMPILE_CACHE", val)
+    import jax
+
+    before = jax.config.jax_compilation_cache_dir
+    benchenv.enable_compile_cache()
+    assert jax.config.jax_compilation_cache_dir == before
+
+
+def test_enable_compile_cache_default_skipped_on_cpu(monkeypatch):
+    """XLA:CPU AOT cache entries can mismatch the loading host's machine
+    features (observed SIGILL-risk warnings); the DEFAULT cache dir must
+    only arm for device runs. Under the test conftest jax_platforms is
+    'cpu', which is exactly the cpu-first config that must stay off."""
+    monkeypatch.delenv("PILOSA_BENCH_COMPILE_CACHE", raising=False)
+    import jax
+
+    assert jax.config.jax_platforms.split(",")[0] == "cpu"
+    before = jax.config.jax_compilation_cache_dir
+    benchenv.enable_compile_cache()
+    assert jax.config.jax_compilation_cache_dir == before
+
+
+def test_enable_compile_cache_explicit_dir_honored(monkeypatch, tmp_path):
+    """An explicitly set PILOSA_BENCH_COMPILE_CACHE is an operator
+    opt-in: honored even on a cpu platform (e.g. validating cache
+    behavior in a smoke run)."""
+    monkeypatch.setenv("PILOSA_BENCH_COMPILE_CACHE", str(tmp_path))
+    import jax
+
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        benchenv.enable_compile_cache()
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
