@@ -101,7 +101,7 @@ def validated_chain_slope(timed, bytes_per_iter, device,
     return last
 
 
-def make_salted_chain(kern, jit_static_argnums=2):
+def make_salted_chain(kern, static_k=False):
     """Build the standard data-dependent chain for chain_slope_gbps.
 
     `kern(x, y, salt_x, salt_y)` computes one full sweep over its
@@ -115,14 +115,23 @@ def make_salted_chain(kern, jit_static_argnums=2):
     (x^y)^(sx^sy) lets LICM hoist the loop-invariant x^y and stream
     one bank instead of two — while addition does not distribute over
     any of the bitwise ops being measured. The two salts are distinct
-    functions of the carry as defense in depth."""
-    import functools
+    functions of the carry as defense in depth.
 
+    The chain length k is a TRACED argument by default, so each kernel
+    family compiles exactly ONE device program no matter how many chain
+    lengths the slope method times: with 20-40 s TPU compiles through
+    the tunnel, static-k chains (one compile per length, 4 per kernel)
+    cost more compile time than an observed ~6-minute tunnel up-window
+    contains. A traced bound lowers fori_loop to a while loop whose
+    per-iteration bookkeeping lands IN the slope — a bias that
+    UNDER-reports GB/s (µs of scalar work vs a ~ms full-bank sweep),
+    i.e. conservative for a roofline-bounded measurement. static_k=True
+    restores the unrolled-loop behavior for comparison."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
-    @functools.partial(jax.jit, static_argnums=jit_static_argnums)
-    def chain(x, y, k):
+    def chain_impl(x, y, k):
         def body(_, carry):
             acc, salt = carry
             sx = salt ^ jnp.uint32(0x9E3779B9)
@@ -133,7 +142,12 @@ def make_salted_chain(kern, jit_static_argnums=2):
             0, k, body, (jnp.uint32(0), jnp.uint32(0)))
         return acc
 
-    return chain
+    if static_k:
+        return jax.jit(chain_impl, static_argnums=2)
+    jitted = jax.jit(chain_impl)
+    # np.int32 keeps the scalar's dtype (and thus the trace signature)
+    # stable across every chain length: one compile total.
+    return lambda x, y, k: jitted(x, y, np.int32(k))
 
 
 def timed_fetch(fn):
