@@ -194,13 +194,15 @@ def bench_device_time_table():
     from pilosa_tpu.utils.benchenv import (make_salted_chain, timed_fetch,
                                            validated_chain_slope)
 
-    rng = np.random.default_rng(3)
     rows = int(os.environ.get("PILOSA_MICRO_ROWS", 255))
     shards = int(os.environ.get("PILOSA_MICRO_SHARDS", 8))
     shape = (rows, shards, WORDS_PER_SHARD)
-    a = jnp.asarray(rng.integers(0, 2**32, shape, dtype=np.uint32))
-    b = jnp.asarray(rng.integers(0, 2**32, shape, dtype=np.uint32))
-    jax.block_until_ready((a, b))
+    # Operands are generated ON DEVICE: this is a pure kernel bench
+    # (contents are random words either way), and uploading 2 x ~267 MB
+    # through the tunnel costs 1-2 minutes of a ~6-minute up-window.
+    ka, kb = jax.random.split(jax.random.key(3))
+    a = jax.block_until_ready(jax.random.bits(ka, shape, jnp.uint32))
+    b = jax.block_until_ready(jax.random.bits(kb, shape, jnp.uint32))
 
     kernels = {
         # bytes_read_factor: how many operand banks each sweep streams.

@@ -35,10 +35,19 @@ def resolve_roofline(device):
     return ROOFLINE_GBPS_DEFAULT, kind or "unknown"
 
 
-def chain_slope_gbps(timed, bytes_per_iter, ks=(4, 10, 16, 22), reps=3):
+def chain_slope_gbps(timed, bytes_per_iter, ks=(8, 32, 72, 128), reps=3,
+                     warm_all=False):
     """Per-iteration sweep rate from the chained-iteration slope method,
     measured across MULTIPLE chain-length pairs so one noisy sample
     cannot fabricate a slope.
+
+    Chain lengths are deliberately long (the traced-k chain makes extra
+    iterations compile-free): the per-iteration signal between the
+    shortest and longest chain is (128-8) x sweep-time, which must
+    stand clear of the tunnel's fetch-RTT jitter — at the old
+    (4,10,16,22) lengths the spread was ~23 ms against ±100 ms-class
+    RTT noise; at (8,32,72,128) it is ~7x larger for under a second of
+    added device time per rep.
 
     `timed(k)` must run a k-iteration chain whose every iteration has a
     true data dependency on the previous one (see make_salted_chain)
@@ -50,8 +59,12 @@ def chain_slope_gbps(timed, bytes_per_iter, ks=(4, 10, 16, 22), reps=3):
     (tunnel too noisy to measure)."""
     import numpy as np
 
-    for k in ks:
-        timed(k)  # compile each chain length
+    # One untimed warm call covers compile + first-touch: the traced-k
+    # chain (make_salted_chain's default) compiles a single program for
+    # every length. A static_k chain must pass warm_all=True so each
+    # length's compile stays out of the timed reps.
+    for k in (ks if warm_all else ks[:1]):
+        timed(k)
     med = {k: float(np.median([timed(k) for _ in range(reps)])) for k in ks}
     slopes = []
     for i, ka in enumerate(ks):
@@ -77,7 +90,7 @@ def chain_slope_gbps(timed, bytes_per_iter, ks=(4, 10, 16, 22), reps=3):
 
 
 def validated_chain_slope(timed, bytes_per_iter, device,
-                          ks=(4, 10, 16, 22), reps=3, retries=1):
+                          ks=(8, 32, 72, 128), reps=3, retries=1):
     """chain_slope_gbps + the physical-validity guard (VERDICT r2 weak
     #1): a median above roofline*ROOFLINE_SLACK is re-measured up to
     `retries` times; if it stays impossible the result is returned with
