@@ -1,0 +1,59 @@
+"""Standalone retry of the sweep_andnot_popcount device-time record.
+
+The micro leg's andnot sweep refused a record during the 03:15 UTC
+window (5/6 non-positive chain-slope pairs — tunnel too noisy), and
+micro's done-marker keeps the other 23 records from re-running. This
+re-measures ONLY the andnot family (reference ANDNOT container loops,
+roaring/roaring.go:3031) with the identical salted-chain machinery, so
+the roofline table in docs/perf.md has all four algebra kernels.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
+    import jax
+    import jax.numpy as jnp
+    from pilosa_tpu.ops.bitset import WORDS_PER_SHARD, popcount
+    from pilosa_tpu.utils.benchenv import (make_salted_chain, timed_fetch,
+                                           validated_chain_slope)
+
+    rows = int(os.environ.get("PILOSA_MICRO_ROWS", 255))
+    shards = int(os.environ.get("PILOSA_MICRO_SHARDS", 8))
+    shape = (rows, shards, WORDS_PER_SHARD)
+    ka, kb = jax.random.split(jax.random.key(3))
+    a = jax.block_until_ready(jax.random.bits(ka, shape, jnp.uint32))
+    b = jax.block_until_ready(jax.random.bits(kb, shape, jnp.uint32))
+
+    chain = make_salted_chain(
+        lambda x, y, sx, sy: popcount(
+            jnp.bitwise_and((x + sx), jnp.bitwise_not((y + sy))),
+            axis=(-2, -1)))
+    dev = jax.devices()[0]
+    try:
+        r = validated_chain_slope(
+            lambda k: timed_fetch(lambda: chain(a, b, k)),
+            a.nbytes * 2, dev)
+    except RuntimeError as e:
+        print(json.dumps({"metric": "sweep_andnot_popcount", "value": 0.0,
+                          "unit": "GB/sec", "error": str(e)}))
+        return
+    print(json.dumps({
+        "metric": "sweep_andnot_popcount", "value": r["gbps_median"],
+        "unit": "GB/sec", "backend": dev.platform,
+        "bank_mb": a.nbytes >> 20, "method": "salted-chain-slope",
+        **{k: r[k] for k in
+           ("gbps_min", "gbps_max", "slope_pairs", "roofline_frac",
+            "roofline_gbps_assumed", "device_kind")},
+        **({"invalid": True, "error": r["error"]}
+           if r.get("invalid") else {})}))
+
+
+if __name__ == "__main__":
+    main()

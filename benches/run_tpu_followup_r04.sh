@@ -1,0 +1,46 @@
+#!/bin/bash
+# Follow-up legs added after the r04b suite launched: waits for the
+# main suite (if running) so chain-slope measurements don't time-share
+# the chip with flagship legs, then captures the BSI device-time table
+# and the andnot retry with the same wait/retry mechanics as r04b.
+cd /root/repo
+while pgrep -f run_tpu_suite_r04b.sh > /dev/null; do
+  echo "$(date -u +%H:%M:%S) waiting for main suite to finish..." >&2
+  sleep 120
+done
+probe() {
+  timeout 100 python -c "
+from pilosa_tpu.utils.benchenv import probe_device_once
+import sys
+ok, detail = probe_device_once(80)
+if not ok:
+    print(detail, file=sys.stderr)
+sys.exit(0 if ok else 1)" 2>/dev/null
+}
+wait_tpu() {
+  until probe; do
+    echo "$(date -u +%H:%M:%S) waiting for TPU..." >&2
+    sleep 45
+  done
+  echo "$(date -u +%H:%M:%S) TPU answered" >&2
+}
+run() {
+  local name=$1 to=$2; shift 2
+  if [ -e "benches/.${name}_r04_done" ]; then
+    echo "$(date -u +%H:%M:%S) bench: $name already done, skipping" >&2
+    return
+  fi
+  wait_tpu
+  echo "$(date -u +%H:%M:%S) bench: $name" >&2
+  timeout "$to" "$@" > "benches/${name}_r04_tpu.jsonl" 2> "benches/${name}_r04_tpu.err"
+  local rc=$?
+  echo "$(date -u +%H:%M:%S) bench: $name rc=$rc" >&2
+  if [ "$rc" -eq 0 ] && [ -s "benches/${name}_r04_tpu.jsonl" ]; then
+    touch "benches/.${name}_r04_done"
+  fi
+}
+run bsi_device 1800 python benches/bsi_device.py
+run andnot_retry 1200 python benches/andnot_retry.py
+# One more pass in case a leg died mid-device.
+run bsi_device 1800 python benches/bsi_device.py
+run andnot_retry 1200 python benches/andnot_retry.py
