@@ -1,0 +1,113 @@
+"""Phase profiler for the headline TopN call (bench.py's workload).
+
+bench.py's r04 sidecar shows device sweep 1.28 ms but 82 ms per
+end-to-end call — ~80 ms of per-call overhead that a 22 us trivial-add
+round trip (benches/tunnel_rtt_r04.json) cannot explain. This breaks
+one TopN(f, n=10) call into phases and times each through the tunnel:
+
+  probe     - trivial 1-element add fetch (tunnel health; must be quiet)
+  dispatch  - _dispatch_counts only (async queue, no block)
+  fetch     - np.asarray on the dispatched counts output
+  execute   - the full production ex.execute per call (batched 8)
+  fetch_eq  - np.asarray on a pre-existing device array of counts shape
+  sweep_jit - raw jitted popcount sweep call+block on the same bank
+
+Run only when nothing else is using the chip — contention inflates
+every number (the suite's flagship legs upload GB-scale banks).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_SHARDS = int(os.environ.get("PILOSA_BENCH_SHARDS", 8))
+N_ROWS = int(os.environ.get("PILOSA_BENCH_ROWS", 1023))
+BATCH_CALLS = 8
+
+
+def med(fn, n=7):
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[n // 2], ts[0], ts[-1]
+
+
+def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, "/root/repo")
+    import bench as bench_mod
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.ops.bitset import popcount
+
+    out = {"platform": jax.devices()[0].platform, "phases": {}}
+
+    def phase(name, fn, n=7):
+        m, lo, hi = med(fn, n)
+        out["phases"][name] = {"median_s": m, "min_s": lo, "max_s": hi}
+        print(f"{name:<26} median {m*1e3:9.3f} ms  min {lo*1e3:9.3f}  "
+              f"max {hi*1e3:9.3f}", file=sys.stderr, flush=True)
+
+    one = jnp.zeros((1,), jnp.int32)
+    tadd = jax.jit(lambda x: x + 1)
+    np.asarray(tadd(one))
+    phase("probe_trivial_fetch", lambda: np.asarray(tadd(one)))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder = bench_mod.build_holder(tmp)
+        ex = Executor(holder)
+        (want,) = ex.execute("bench", "TopN(f, n=10)")  # warm upload+compile
+
+        view = holder.index("bench").field("f").view()
+        bank = view.device_bank(tuple(range(N_SHARDS)), trim=True)
+        arr = bank.array
+        print(f"bank {arr.shape} {arr.dtype} = {arr.nbytes >> 20} MiB",
+              file=sys.stderr)
+
+        # Raw sweep: same kernel family the counts dispatch runs.
+        sweep = jax.jit(lambda a: popcount(a, axis=(-2, -1)))
+        jax.block_until_ready(sweep(arr))
+        phase("sweep_jit_block", lambda: jax.block_until_ready(sweep(arr)))
+        phase("sweep_jit_fetch", lambda: np.asarray(sweep(arr)))
+
+        # Executor dispatch vs fetch split.
+        o = ex._dispatch_counts(arr, None)
+        ex._fetch_counts(o, None)
+        phase("dispatch_counts_only", lambda: ex._dispatch_counts(arr, None))
+        phase("dispatch_plus_fetch",
+              lambda: ex._fetch_counts(ex._dispatch_counts(arr, None), None))
+
+        # Pre-existing device array of the counts shape: pure fetch cost.
+        counts_dev = jax.block_until_ready(sweep(arr))
+        phase("fetch_existing_counts", lambda: np.asarray(counts_dev))
+
+        # Full production call, single and batched.
+        phase("execute_single", lambda: ex.execute("bench", "TopN(f, n=10)"),
+              n=5)
+        q = " ".join("TopN(f, n=10)" for _ in range(BATCH_CALLS))
+        ex.execute("bench", q)
+        t0 = time.perf_counter()
+        ex.execute("bench", q)
+        out["phases"]["execute_batched_per_call"] = {
+            "median_s": (time.perf_counter() - t0) / BATCH_CALLS}
+        print(f"execute_batched_per_call   "
+              f"{(time.perf_counter()-t0)/BATCH_CALLS*1e3:9.3f} ms",
+              file=sys.stderr)
+        holder.close()
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
