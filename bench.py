@@ -52,6 +52,10 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+# Child process start, for deadline-aware budgets inside bench_tpu.
+_child_t0 = time.monotonic()
+
+
 # Size overrides exist so the full machinery (probe, child, device-time
 # slope) can be smoke-tested quickly on CPU; the defaults are the real
 # benchmark shape. 1023 rows (not 1024): bank capacity pads to the next
@@ -142,7 +146,22 @@ def bench_tpu(holder, partial):
     partial["tpu_s_per_call"] = warm_s
     partial["pairs"] = [[int(r), int(c)] for r, c in want.pairs]
     partial["tpu_timing"] = "cold-warmup-only"
-    log(f"bench: warm done in {warm_s:.1f}s, timing")
+    # Contention stamp + quiet gate: on this 1-vCPU box a competing
+    # process turns every host<->device round trip into a ~70-100 ms
+    # scheduling stall (quiet floor: ~22 us), which caps the end-to-end
+    # number far below the device ceiling. Wait briefly for exclusive
+    # CPU — bounded by what's left of the child's soft deadline, so a
+    # slow build+warm never lets the gate starve the timed loop into a
+    # cold-warmup-only record — then record the evidence either way.
+    from pilosa_tpu.utils.benchenv import (measurement_context,
+                                           quiet_wait_budget_s)
+    left = CHILD_SOFT_DEADLINE_S - (time.monotonic() - _child_t0) \
+        - TIMING_BUDGET_S - 60
+    partial.update(measurement_context(
+        wait_quiet_s=max(0.0, min(quiet_wait_budget_s(), left))))
+    log(f"bench: warm done in {warm_s:.1f}s "
+        f"(trivial_fetch {partial['trivial_fetch_ms']:.2f} ms, "
+        f"load {partial['loadavg_1m']}), timing")
     # Measure a BATCH_CALLS-call query: the executor dispatches every
     # call's device program before fetching any result, so per-call cost
     # amortizes the host<->device round trip — the realistic serving shape
@@ -403,18 +422,32 @@ def main():
             child.get("platform") != "cpu":
         # Persist the measurement so a later run whose tunnel is down
         # can still carry a same-round TPU number with provenance. CPU
-        # smoke runs never overwrite a real device measurement.
+        # smoke runs never overwrite a real device measurement, and a
+        # smaller-shape run (env-shrunk smoke against the real chip)
+        # never replaces a full-shape record — "last good" must not be
+        # downgradeable by a verification drive.
+        persist = True
         try:
-            tmp_path = LAST_GOOD_TPU_PATH + ".tmp"
-            with open(tmp_path, "w") as fh:
-                json.dump({"measured_at_unix": time.time(),
-                           "measured_at": time.strftime(
-                               "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
-                           "bits": bits, "payload": child}, fh, indent=1)
-            os.replace(tmp_path, LAST_GOOD_TPU_PATH)
-            log(f"bench: wrote {LAST_GOOD_TPU_PATH}")
-        except OSError as e:
-            log(f"bench: could not persist last-good sidecar: {e!r}")
+            with open(LAST_GOOD_TPU_PATH) as fh:
+                if json.load(fh).get("bits", 0) > bits:
+                    persist = False
+                    log("bench: sidecar holds a larger-shape record; "
+                        "not overwriting it with this run")
+        except (OSError, ValueError):
+            pass
+        if persist:
+            try:
+                tmp_path = LAST_GOOD_TPU_PATH + ".tmp"
+                with open(tmp_path, "w") as fh:
+                    json.dump({"measured_at_unix": time.time(),
+                               "measured_at": time.strftime(
+                                   "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                               "bits": bits, "payload": child}, fh,
+                              indent=1)
+                os.replace(tmp_path, LAST_GOOD_TPU_PATH)
+                log(f"bench: wrote {LAST_GOOD_TPU_PATH}")
+            except OSError as e:
+                log(f"bench: could not persist last-good sidecar: {e!r}")
 
     if child is not None and "tpu_s_per_call" in child:
         if "pairs" in child:
@@ -436,7 +469,8 @@ def main():
                   "device_and_gbps_max", "device_and_roofline_frac",
                   "device_and_invalid",
                   "fetch_rtt_s", "device_time_error", "device_time_invalid",
-                  "partial", "tpu_timing"):
+                  "partial", "tpu_timing",
+                  "loadavg_1m", "trivial_fetch_ms", "waited_quiet_s"):
             if k in child:
                 result[k] = child[k]
         if child.get("platform") == "cpu":

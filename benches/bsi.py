@@ -63,6 +63,8 @@ def main():
                "loaded_cols": N_COLS, "load_seconds": round(load_s, 2)}
         batched = " ".join(q for q, _ in queries.values())
         ex.execute("bsi", batched)  # warm compile
+        from pilosa_tpu.utils.benchenv import measurement_context
+        out.update(measurement_context())
         # correctness
         results = ex.execute("bsi", batched)
         for (name, (_, ref)), got in zip(queries.items(), results):

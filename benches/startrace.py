@@ -62,6 +62,8 @@ def main():
             q = ("Count(Intersect(Row(stargazer=14), Row(stargazer=19))) "
                  "TopN(stargazer, n=5)")
             want = post("/index/repository/query", q)  # warm
+            from pilosa_tpu.utils.benchenv import measurement_context
+            ctx = measurement_context()
             times = []
             for _ in range(ITERS):
                 t0 = time.perf_counter()
@@ -90,6 +92,7 @@ def main():
                 "value": tpu_t,
                 "unit": "seconds",
                 "vs_baseline": cpu_t / tpu_t,
+                **ctx,
             }))
         finally:
             srv.shutdown()

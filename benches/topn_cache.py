@@ -21,6 +21,8 @@ with tempfile.TemporaryDirectory() as tmp:
     load_s = time.perf_counter() - t0
     ex = Executor(h)
     (want,) = ex.execute("c2", "TopN(f, n=10)")  # warm
+    from pilosa_tpu.utils.benchenv import measurement_context
+    ctx = measurement_context()
     times = []
     for _ in range(200):
         t0 = time.perf_counter()
@@ -42,4 +44,5 @@ with tempfile.TemporaryDirectory() as tmp:
 print(json.dumps({"metric": "topn_ranked_cache_p50_latency", "value": p50,
                   "unit": "seconds", "vs_baseline": base_s / p50,
                   "columns": 1 << 20, "distinct_rows": 5000,
-                  "cache_hits": True, "load_seconds": round(load_s, 2)}))
+                  "cache_hits": True, "load_seconds": round(load_s, 2),
+                  **ctx}))

@@ -325,6 +325,78 @@ def hold_for_tpu(label: str = "bench"):
         signal.signal(signal.SIGTERM, prev_term)
 
 
+_trivial_probe = None  # (jitted fn, operand) — compiled once per process
+
+
+def trivial_fetch_ms(samples: int = 9):
+    """Median wall ms of a 1-element jitted device add fetched to host.
+
+    The box's contention signature (round-4 finding): quiet, this is
+    ~0.02 ms through the tunnel; with ANY other process competing for
+    this 1-vCPU host it jumps to ~70-100 ms — scheduling delay, not
+    tunnel latency. Call only after the backend is initialized (it runs
+    a device op). The probe compiles once per process: a quiet-gate
+    loop polling this must not itself generate CPU load (an XLA compile
+    per poll would inflate the very signal being measured)."""
+    import numpy as np
+
+    global _trivial_probe
+    if _trivial_probe is None:
+        import jax
+        import jax.numpy as jnp
+        f = jax.jit(lambda x: x + 1)
+        x = jnp.zeros((1,), jnp.int32)
+        np.asarray(f(x))  # compile + first transfer
+        _trivial_probe = (f, x)
+    f, x = _trivial_probe
+    ts = []
+    for _ in range(samples):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2] * 1e3
+
+
+def quiet_wait_budget_s(default: float = 120.0) -> float:
+    """The quiet-gate budget: PILOSA_BENCH_WAIT_QUIET_S, else
+    `default`. Single definition so every leg reads the same knob (an
+    empty value means the default, not a ValueError)."""
+    raw = os.environ.get("PILOSA_BENCH_WAIT_QUIET_S", "")
+    try:
+        return float(raw) if raw.strip() else default
+    except ValueError:
+        return default
+
+
+def measurement_context(wait_quiet_s: float = None,
+                        quiet_threshold_ms: float = 2.0) -> dict:
+    """Contention evidence to stamp onto every end-to-end record:
+    {loadavg_1m, trivial_fetch_ms, waited_quiet_s}. First polls until
+    the trivial-fetch probe drops below quiet_threshold_ms (i.e. this
+    process has the box to itself) or the budget runs out — then
+    measures. wait_quiet_s defaults to quiet_wait_budget_s() (the
+    PILOSA_BENCH_WAIT_QUIET_S knob). Never blocks a leg forever: on
+    timeout the record simply carries the contended numbers, visibly."""
+    if wait_quiet_s is None:
+        wait_quiet_s = quiet_wait_budget_s()
+    waited = 0.0
+    ms = trivial_fetch_ms()
+    deadline = time.time() + wait_quiet_s
+    t_start = time.time()
+    while ms > quiet_threshold_ms and time.time() < deadline:
+        time.sleep(5)
+        ms = trivial_fetch_ms()
+        waited = time.time() - t_start
+    try:
+        load1 = os.getloadavg()[0]
+    except OSError:
+        load1 = -1.0
+    return {"loadavg_1m": round(load1, 2),
+            "trivial_fetch_ms": round(ms, 3),
+            "waited_quiet_s": round(waited, 1)}
+
+
 def install_partial_record_handler(metric: str, unit: str):
     """SIGTERM -> print a partial JSON record and exit 0, so a
     suite-level `timeout` kill still leaves a parseable line (the axon
