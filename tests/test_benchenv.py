@@ -154,3 +154,39 @@ def test_enable_compile_cache_explicit_dir_honored(monkeypatch, tmp_path):
         assert jax.config.jax_compilation_cache_dir == str(tmp_path)
     finally:
         jax.config.update("jax_compilation_cache_dir", before)
+
+
+# ---------------------------------------------------------------- round 4:
+# contention stamps / quiet gate (benchenv.measurement_context)
+
+def test_quiet_wait_budget_env(monkeypatch):
+    from pilosa_tpu.utils import benchenv
+    monkeypatch.delenv("PILOSA_BENCH_WAIT_QUIET_S", raising=False)
+    assert benchenv.quiet_wait_budget_s(30.0) == 30.0
+    monkeypatch.setenv("PILOSA_BENCH_WAIT_QUIET_S", "7.5")
+    assert benchenv.quiet_wait_budget_s() == 7.5
+    # Empty and garbage values mean the default, never a crash.
+    monkeypatch.setenv("PILOSA_BENCH_WAIT_QUIET_S", "")
+    assert benchenv.quiet_wait_budget_s(11.0) == 11.0
+    monkeypatch.setenv("PILOSA_BENCH_WAIT_QUIET_S", "nope")
+    assert benchenv.quiet_wait_budget_s(11.0) == 11.0
+
+
+def test_measurement_context_fields(monkeypatch):
+    from pilosa_tpu.utils import benchenv
+    ctx = benchenv.measurement_context(wait_quiet_s=0)
+    assert set(ctx) == {"loadavg_1m", "trivial_fetch_ms",
+                        "waited_quiet_s"}
+    assert ctx["trivial_fetch_ms"] >= 0
+    assert ctx["waited_quiet_s"] == 0.0
+
+
+def test_trivial_probe_compiles_once():
+    """The quiet-gate loop polls this; a compile per poll would inflate
+    the contention signal it measures, so the jitted probe is cached."""
+    from pilosa_tpu.utils import benchenv
+    benchenv.trivial_fetch_ms(samples=1)
+    probe = benchenv._trivial_probe
+    assert probe is not None
+    benchenv.trivial_fetch_ms(samples=1)
+    assert benchenv._trivial_probe is probe
