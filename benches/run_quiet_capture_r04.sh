@@ -2,9 +2,15 @@
 # One-shot: at the next tunnel up-window, capture the headline bench.py
 # measurement and the TopN phase profile with EXCLUSIVE use of the box
 # (the per-call floor is host scheduling — benches/README.md), by
-# SIGSTOPping the main suite's wait loop for the duration, then
-# resuming it so its retry legs run next. The sidecar guard in bench.py
-# means this can only upgrade the carried record, never downgrade it.
+# SIGSTOPping the main suite's WHOLE PROCESS GROUP (the nohup'd suite
+# shell is its own group leader, so -PGID covers running leg children
+# and probe subprocesses too) for the duration, then resuming it so its
+# retry legs run next. The sidecar guard in bench.py means this can
+# only upgrade the carried record, never downgrade it.
+#
+# probe() duplicates r04b's — those scripts are mid-execution and bash
+# reads scripts incrementally, so they cannot be edited to source a
+# shared file until they exit; dedup then.
 cd /root/repo
 probe() {
   timeout 100 python -c "
@@ -17,17 +23,22 @@ until probe; do
   echo "$(date -u +%H:%M:%S) quiet-capture: waiting for TPU..." >&2
   sleep 45
 done
-echo "$(date -u +%H:%M:%S) quiet-capture: TPU answered; pausing suite" >&2
-pkill -STOP -f run_tpu_suite_r04b.sh
-pkill -STOP -f "probe_device_once" 2>/dev/null
+SUITE_PID=$(pgrep -o -f run_tpu_suite_r04b.sh)
+SUITE_PGID=""
+if [ -n "$SUITE_PID" ]; then
+  SUITE_PGID=$(ps -o pgid= -p "$SUITE_PID" | tr -d ' ')
+fi
+echo "$(date -u +%H:%M:%S) quiet-capture: TPU answered; pausing suite pgid=${SUITE_PGID:-none}" >&2
+[ -n "$SUITE_PGID" ] && kill -STOP -- "-$SUITE_PGID" 2>/dev/null
 resume() {
   echo "$(date -u +%H:%M:%S) quiet-capture: resuming suite" >&2
-  pkill -CONT -f "probe_device_once" 2>/dev/null
-  pkill -CONT -f run_tpu_suite_r04b.sh
+  [ -n "$SUITE_PGID" ] && kill -CONT -- "-$SUITE_PGID" 2>/dev/null
 }
-trap resume EXIT
+# EXIT alone does not fire on untrapped signal death; cover the ways
+# this script can be killed so the suite is never left stopped.
+trap resume EXIT INT TERM HUP
 echo "$(date -u +%H:%M:%S) quiet-capture: bench.py (full shape)" >&2
-timeout 900 env PILOSA_BENCH_WAIT_QUIET_S=60 python bench.py \
+timeout 1800 env PILOSA_BENCH_WAIT_QUIET_S=60 python bench.py \
   > BENCH_quiet_r04.json 2> bench_quiet_r04.err
 echo "$(date -u +%H:%M:%S) quiet-capture: bench.py rc=$?" >&2
 echo "$(date -u +%H:%M:%S) quiet-capture: topn phase profile" >&2
