@@ -1,0 +1,26 @@
+# Shared tunnel probe for suite scripts: source this file, then use
+# probe / wait_tpu. Single definition so shell gates and the in-leg
+# hold (benchenv.probe_device_once — the same probe, called here) can
+# never drift in what "tunnel is up" means.
+#
+# The r04 scripts (run_tpu_suite_r04b.sh, run_tpu_followup_r04.sh,
+# run_quiet_capture_r04.sh) carry inline copies because they were
+# mid-execution when this file was extracted (bash reads scripts
+# incrementally — editing a running script corrupts it); round-5
+# scripts should `source benches/probe.sh` instead.
+probe() {
+  timeout 100 python -c "
+from pilosa_tpu.utils.benchenv import probe_device_once
+import sys
+ok, detail = probe_device_once(80)
+if not ok:
+    print(detail, file=sys.stderr)
+sys.exit(0 if ok else 1)" 2>/dev/null
+}
+wait_tpu() {
+  until probe; do
+    echo "$(date -u +%H:%M:%S) waiting for TPU..." >&2
+    sleep 45
+  done
+  echo "$(date -u +%H:%M:%S) TPU answered" >&2
+}
