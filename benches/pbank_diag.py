@@ -1,0 +1,97 @@
+"""Diagnostic: does the tanimoto TopN warm path reuse the device-resident
+PositionsBank, or rebuild/stream per query?
+
+The r04 TPU suite measured 10M/100M tanimoto p50s that scale linearly
+with N at ~tunnel bandwidth over the sparse (~2 B/set bit) size — the
+signature of a per-query re-upload, while the CPU records demonstrably
+ran the resident-bank path. This traces positions_bank cache hits,
+segment builds, and the executor branch actually taken, at a scale just
+above the forced 64 MB dense-bank threshold the bench uses.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(os.environ.get("PILOSA_DIAG_N", 8_000_000))
+
+
+def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
+    os.environ.setdefault("PILOSA_TPU_TOPN_CHUNK_ROWS", "65536")
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import executor as executor_mod
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+    import pilosa_tpu.core.view as V
+
+    executor_mod.TOPN_CHUNK_ROWS = 65536
+    executor_mod.TOPN_MAX_BANK_BYTES = 64 << 20  # same forcing as the bench
+
+    orig_pb = V.View.positions_bank
+    def traced_pb(self, shard, width):
+        t0 = time.perf_counter()
+        pb = orig_pb(self, shard, width)
+        print(f"[diag] positions_bank {1000 * (time.perf_counter() - t0):.0f} ms "
+              f"none={pb is None}", flush=True)
+        return pb
+    V.View.positions_bank = traced_pb
+
+    orig_build = V.View._build_pbank_segments
+    def traced_build(self, frag, rows, width, row_lo0):
+        t0 = time.perf_counter()
+        r = orig_build(self, frag, rows, width, row_lo0)
+        print(f"[diag] BUILD pbank segments {time.perf_counter() - t0:.1f} s "
+              f"none={r is None}", flush=True)
+        return r
+    V.View._build_pbank_segments = traced_build
+
+    orig_tp = executor_mod.Executor._topn_positions
+    def traced_tp(self, *a, **kw):
+        print("[diag] _topn_positions (resident-bank branch) taken", flush=True)
+        return orig_tp(self, *a, **kw)
+    executor_mod.Executor._topn_positions = traced_tp
+
+    rng = np.random.default_rng(7)
+    t0 = time.perf_counter()
+    pos = np.sort(rng.integers(0, 4096, (N, 48), dtype=np.uint16), axis=1)
+    print(f"[diag] gen {time.perf_counter() - t0:.1f} s", flush=True)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder = Holder(tmp)
+        holder.open()
+        idx = holder.create_index("mole")
+        f = idx.create_field("fingerprint", FieldOptions(max_columns=4096))
+        view = f.create_view_if_not_exists("standard")
+        frag = view.create_fragment_if_not_exists(0)
+        containers = frag.storage.containers
+        cpr = SHARD_WIDTH // 65536
+        keep = np.empty(pos.shape, dtype=bool)
+        keep[:, 0] = True
+        np.not_equal(pos[:, 1:], pos[:, :-1], out=keep[:, 1:])
+        t0 = time.perf_counter()
+        for i in range(N):
+            containers[i * cpr] = pos[i][keep[i]]
+        for i in range(N):
+            frag._touch_row(i)
+        print(f"[diag] load {time.perf_counter() - t0:.1f} s", flush=True)
+
+        ex = Executor(holder)
+        q = ("TopN(fingerprint, Row(fingerprint=12345), n=50, "
+             "tanimotoThreshold=60)")
+        for it in range(4):
+            t0 = time.perf_counter()
+            (res,) = ex.execute("mole", q)
+            print(f"[diag] query {it}: {time.perf_counter() - t0:.2f} s, "
+                  f"pairs={len(res.pairs)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Phase breakdown of the warm positions-bank TopN query: preamble
+(parse/translate/row-leaf) vs kernel dispatch vs device compute vs
+result fetch. Follow-up to pbank_diag.py, which showed the resident
+bank IS reused and a single-segment 8M warm query still costs ~5.6 s.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(os.environ.get("PILOSA_DIAG_N", 8_000_000))
+
+
+def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
+    os.environ.setdefault("PILOSA_TPU_TOPN_CHUNK_ROWS", "65536")
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import executor as executor_mod
+    from pilosa_tpu.executor.results import PairsResult
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+    executor_mod.TOPN_CHUNK_ROWS = 65536
+    executor_mod.TOPN_MAX_BANK_BYTES = 64 << 20
+
+    import jax
+    import jax.numpy as jnp
+
+    def traced_tp(self, pb, filter_words, n, tanimoto, min_threshold,
+                  src_dev):
+        print(f"[diag]   _topn_positions enter; segments={len(pb.segments)}",
+              flush=True)
+        fw = filter_words[0] if filter_words is not None else None
+        t0 = time.perf_counter()
+        outs = []
+        for row_lo, n_rows, pos, starts, _p in pb.segments:
+            k = min(n, n_rows)
+            if k == 0:
+                continue
+            kern = self._pbank_kernel(k, fw is not None)
+            params = jnp.asarray(
+                np.asarray([min_threshold, tanimoto, 0], np.uint32))
+            if tanimoto and src_dev is not None:
+                params = params.at[2].set(
+                    jnp.asarray(src_dev).astype(jnp.uint32))
+            outs.append((row_lo, kern(
+                fw if fw is not None else jnp.zeros((1,), jnp.uint32),
+                pos, starts, params)))
+        print(f"[diag]   dispatch {time.perf_counter() - t0:.3f} s",
+              flush=True)
+        t0 = time.perf_counter()
+        jax.block_until_ready([o for _, o in outs])
+        print(f"[diag]   device  {time.perf_counter() - t0:.3f} s",
+              flush=True)
+        t0 = time.perf_counter()
+        got = jax.device_get([(v, i) for _, (v, i) in outs])
+        print(f"[diag]   fetch   {time.perf_counter() - t0:.3f} s",
+              flush=True)
+
+        def finalize():
+            pairs = []
+            for (row_lo, _), (v, ix) in zip(outs, got):
+                for val, i in zip(v.tolist(), ix.tolist()):
+                    if val > 0:
+                        pairs.append((int(pb.row_ids[row_lo + i]),
+                                      int(val)))
+            pairs.sort(key=lambda rc: (-rc[1], rc[0]))
+            return PairsResult(pairs[:n])
+
+        return executor_mod._Pending(finalize)
+
+    executor_mod.Executor._topn_positions = traced_tp
+
+    rng = np.random.default_rng(7)
+    pos = np.sort(rng.integers(0, 4096, (N, 48), dtype=np.uint16), axis=1)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder = Holder(tmp)
+        holder.open()
+        idx = holder.create_index("mole")
+        f = idx.create_field("fingerprint", FieldOptions(max_columns=4096))
+        view = f.create_view_if_not_exists("standard")
+        frag = view.create_fragment_if_not_exists(0)
+        containers = frag.storage.containers
+        cpr = SHARD_WIDTH // 65536
+        keep = np.empty(pos.shape, dtype=bool)
+        keep[:, 0] = True
+        np.not_equal(pos[:, 1:], pos[:, :-1], out=keep[:, 1:])
+        for i in range(N):
+            containers[i * cpr] = pos[i][keep[i]]
+        for i in range(N):
+            frag._touch_row(i)
+        print("[diag] loaded", flush=True)
+
+        ex = Executor(holder)
+        q = ("TopN(fingerprint, Row(fingerprint=12345), n=50, "
+             "tanimotoThreshold=60)")
+        for it in range(4):
+            t0 = time.perf_counter()
+            (res,) = ex.execute("mole", q)
+            print(f"[diag] query {it}: {time.perf_counter() - t0:.2f} s "
+                  f"pairs={len(res.pairs)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

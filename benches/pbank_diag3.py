@@ -1,0 +1,166 @@
+"""A/B the PositionsBank TopN kernel through the REAL executor path at
+one-segment scale (8M molecules, bank resident between queries):
+
+  current — gather bits + cumsum rowdiff + flat lax.top_k
+  A       — same, but exact two-stage (blocked) top-k
+  B       — A + gather-free membership for sparse filters: the query
+            fingerprint's <=64 set positions are extracted on device
+            (nonzero over 4096 bits) and membership is a dense
+            [P]x[64] compare-reduce; lax.cond falls back to the gather
+            form when the filter is denser than 64 bits.
+
+Each variant replaces Executor._pbank_kernel, clears the kernel cache,
+and runs ITERS warm queries; results must match the current kernel's.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(os.environ.get("PILOSA_DIAG_N", 8_000_000))
+ITERS = int(os.environ.get("PILOSA_DIAG_ITERS", 3))
+BLOCK = 8192
+QCAP = 64
+
+
+def variant_kernel(variant: str):
+    import jax
+    import jax.numpy as jnp
+
+    def build(k: int, has_filter: bool):
+        def topk_flat(score):
+            return jax.lax.top_k(score, k)
+
+        def topk_two_stage(score):
+            r = score.shape[0]
+            pad = (-r) % BLOCK
+            sp = jnp.pad(score, (0, pad), constant_values=-1)
+            nb = sp.shape[0] // BLOCK
+            kb = min(k, BLOCK)
+            v, i = jax.lax.top_k(sp.reshape(nb, BLOCK), kb)
+            base = (jnp.arange(nb, dtype=jnp.int32) * BLOCK)[:, None]
+            cv = v.reshape(-1)
+            ci = (i.astype(jnp.int32) + base).reshape(-1)
+            gv, gi = jax.lax.top_k(cv, k)
+            return gv, jnp.take(ci, gi)
+
+        topk = topk_flat if variant == "current" else topk_two_stage
+
+        def bits_gather(fw, posi):
+            return (jnp.take(fw, posi >> 5, mode="fill", fill_value=0)
+                    >> (posi & 31).astype(jnp.uint32)) & jnp.uint32(1)
+
+        def bits_compare(fw, posi):
+            # fw: [W] u32 words; set positions -> [QCAP] i32 (pad 2^30)
+            w = jnp.arange(fw.shape[0], dtype=jnp.int32)
+            allpos = w[:, None] * 32 + jnp.arange(32, dtype=jnp.int32)
+            setmask = ((fw[:, None] >> jnp.arange(32, dtype=jnp.uint32))
+                       & jnp.uint32(1)).astype(bool)
+            qpos = jnp.where(
+                setmask, allpos, 1 << 30).reshape(-1)
+            qtop = -jax.lax.top_k(-qpos, QCAP)[0]  # QCAP smallest
+            m = (posi[:, None] == qtop[None, :]).any(axis=1)
+            return m.astype(jnp.uint32)
+
+        @jax.jit
+        def kernel(fw, pos, starts, params):
+            raw = starts[1:] - starts[:-1]
+            if has_filter:
+                posi = pos.astype(jnp.int32)
+                if variant == "B":
+                    fwpop = jnp.sum(
+                        jax.lax.population_count(fw)).astype(jnp.int32)
+                    bits = jax.lax.cond(
+                        fwpop <= QCAP,
+                        lambda: bits_compare(fw, posi),
+                        lambda: bits_gather(fw, posi))
+                else:
+                    bits = bits_gather(fw, posi)
+                s = jnp.concatenate(
+                    [jnp.zeros(1, jnp.uint32),
+                     jnp.cumsum(bits, dtype=jnp.uint32)])
+                c = (s[starts[1:]] - s[starts[:-1]]).astype(jnp.int32)
+            else:
+                c = raw
+            thresh, tani, src = (params[0].astype(jnp.int32),
+                                 params[1].astype(jnp.int32),
+                                 params[2].astype(jnp.int32))
+            keep = c >= jnp.maximum(1, thresh)
+            denom = raw + src - c
+            keep &= jnp.where(tani > 0,
+                              (denom > 0) & (c * 100 >= tani * denom),
+                              True)
+            score = jnp.where(keep, c, -1)
+            return topk(score)
+
+        return kernel
+
+    return build
+
+
+def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
+    os.environ.setdefault("PILOSA_TPU_TOPN_CHUNK_ROWS", "65536")
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import executor as executor_mod
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+    executor_mod.TOPN_CHUNK_ROWS = 65536
+    executor_mod.TOPN_MAX_BANK_BYTES = 64 << 20
+
+    rng = np.random.default_rng(7)
+    pos = np.sort(rng.integers(0, 4096, (N, 48), dtype=np.uint16), axis=1)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder = Holder(tmp)
+        holder.open()
+        idx = holder.create_index("mole")
+        f = idx.create_field("fingerprint", FieldOptions(max_columns=4096))
+        view = f.create_view_if_not_exists("standard")
+        frag = view.create_fragment_if_not_exists(0)
+        containers = frag.storage.containers
+        cpr = SHARD_WIDTH // 65536
+        keep = np.empty(pos.shape, dtype=bool)
+        keep[:, 0] = True
+        np.not_equal(pos[:, 1:], pos[:, :-1], out=keep[:, 1:])
+        for i in range(N):
+            containers[i * cpr] = pos[i][keep[i]]
+        for i in range(N):
+            frag._touch_row(i)
+        print("[diag] loaded", flush=True)
+
+        ex = Executor(holder)
+        q = ("TopN(fingerprint, Row(fingerprint=12345), n=50, "
+             "tanimotoThreshold=60)")
+        want = None
+        for variant in ["current", "A", "B"]:
+            executor_mod.Executor._PBANK_KERNELS.clear()
+            build = variant_kernel(variant)
+            executor_mod.Executor._pbank_kernel = classmethod(
+                lambda cls, k, hf, _b=build: cls._PBANK_KERNELS.setdefault(
+                    (k, hf), _b(k, hf)))
+            times = []
+            for it in range(ITERS + 1):
+                t0 = time.perf_counter()
+                (res,) = ex.execute("mole", q)
+                dt = time.perf_counter() - t0
+                if it > 0:  # it 0 pays the variant's compile
+                    times.append(dt)
+            if want is None:
+                want = res.pairs
+            assert res.pairs == want, f"{variant} results differ"
+            print(f"[diag] {variant}: warm p50 "
+                  f"{float(np.median(times)):.2f} s "
+                  f"(all {[f'{t:.2f}' for t in times]})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -99,6 +99,14 @@ TOPN_CHUNK_ROWS = int(os.environ.get("PILOSA_TPU_TOPN_CHUNK_ROWS", 1024))
 # chunk banks (view.PositionsBank).
 PBANK_ENABLED = os.environ.get("PILOSA_TPU_PBANK", "1") != "0"
 
+# Filters with at most this many set bits take the positions-bank
+# kernel's gather-free compare path (see _pbank_kernel.bits_compare);
+# denser filters use the table gather. 64 covers every fingerprint
+# query (48 draws) with headroom; raising it grows the [P, QCAP]
+# compare fan-out linearly.
+PBANK_SPARSE_FILTER_BITS = int(os.environ.get(
+    "PILOSA_TPU_PBANK_SPARSE_BITS", 64))
+
 # Warm-cache TopN self-check sampling: 1 in this many warm hits ALSO
 # runs the exact device sweep and compares (VERDICT r3 weak #5: the
 # shortcut's correctness rests on every write path refreshing cached
@@ -1262,8 +1270,6 @@ class Executor:
         are the start diffs. Tanimoto/threshold ride as traced params;
         lax.top_k breaks ties by lower index, which IS the (-count,
         row) order because rows are stored ascending."""
-        import functools
-
         import jax
         import jax.numpy as jnp
 
@@ -1272,15 +1278,52 @@ class Executor:
         if fn is not None:
             return fn
 
+        def bits_gather(fw, posi):
+            # Pad sentinel 0xFFFF gathers out of range -> fill 0.
+            return (jnp.take(fw, posi >> 5, mode="fill", fill_value=0)
+                    >> (posi & 31).astype(jnp.uint32)) & jnp.uint32(1)
+
+        def bits_compare(fw, posi):
+            # Sparse-filter membership WITHOUT the positions gather: a
+            # tanimoto query's filter is one fingerprint (~48 set bits),
+            # and an element-wise [P] x [QCAP] compare-reduce against
+            # its extracted set positions is VPU-shaped where the
+            # P-sized dynamic gather is not — measured 3.9x faster at
+            # 384M positions on a v5e (benches/pbank_diag3.py; the
+            # two-stage top-k variant measured no gain, so top_k stays
+            # flat). Extraction: enumerate the filter's 32*W bit
+            # positions, keep set ones, take the QCAP smallest (pad
+            # 2^30 sorts last; a real position is < 2^16).
+            w = jnp.arange(fw.shape[0], dtype=jnp.int32)
+            allpos = w[:, None] * 32 + jnp.arange(32, dtype=jnp.int32)
+            setmask = ((fw[:, None] >> jnp.arange(32, dtype=jnp.uint32))
+                       & jnp.uint32(1)).astype(bool)
+            qpos = jnp.where(setmask, allpos, 1 << 30).reshape(-1)
+            # Clamp to the filter's bit width: top_k(k > size) raises at
+            # TRACE time and lax.cond traces both branches, so a narrow
+            # filter row would crash every filtered query. The clamp is
+            # exact: popcount(fw) <= 32*W == the clamped k, so the gate
+            # below still guarantees every set position is captured.
+            qk = min(PBANK_SPARSE_FILTER_BITS, int(qpos.shape[0]))
+            qtop = -jax.lax.top_k(-qpos, qk)[0]
+            m = (posi[:, None] == qtop[None, :]).any(axis=1)
+            return m.astype(jnp.uint32)
+
         @jax.jit
         def kernel(fw, pos, starts, params):
             raw = starts[1:] - starts[:-1]
             if has_filter:
                 posi = pos.astype(jnp.int32)
-                # Pad sentinel 0xFFFF gathers out of range -> fill 0.
-                bits = (jnp.take(fw, posi >> 5, mode="fill",
-                                 fill_value=0)
-                        >> (posi & 31).astype(jnp.uint32)) & jnp.uint32(1)
+                # Exactness gate ON DEVICE (no extra host round trip):
+                # the compare form only sees the QCAP smallest filter
+                # positions, so any denser filter falls back to the
+                # gather form inside the same compiled program.
+                fwpop = jnp.sum(
+                    jax.lax.population_count(fw)).astype(jnp.int32)
+                bits = jax.lax.cond(
+                    fwpop <= PBANK_SPARSE_FILTER_BITS,
+                    lambda: bits_compare(fw, posi),
+                    lambda: bits_gather(fw, posi))
                 s = jnp.concatenate(
                     [jnp.zeros(1, jnp.uint32),
                      jnp.cumsum(bits, dtype=jnp.uint32)])

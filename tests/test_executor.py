@@ -1181,6 +1181,65 @@ def test_positions_bank_topn_matches_streaming(tmp_path, monkeypatch):
     h.close()
 
 
+def test_positions_bank_dense_filter_fallback(tmp_path, monkeypatch):
+    """The pbank kernel's sparse-filter compare path only sees the
+    PBANK_SPARSE_FILTER_BITS smallest filter positions; a filter denser
+    than that must take the gather branch of the lax.cond and still
+    match the streaming path exactly — on both sides of the gate."""
+    import numpy as np
+
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import executor as ex_mod
+
+    h = Holder(str(tmp_path / "h"))
+    h.open()
+    idx = h.create_index("pbd")
+    f = idx.create_field("fp", FieldOptions(max_columns=4096,
+                                            cache_type="none"))
+    rng = np.random.default_rng(29)
+    n_rows = 300
+    rows = np.repeat(np.arange(n_rows, dtype=np.uint64),
+                     rng.integers(5, 40, n_rows))
+    cols = rng.integers(0, 4096, len(rows)).astype(np.uint64)
+    # Row 0: 200 distinct columns — denser than the 64-bit sparse gate.
+    dense_cols = rng.choice(4096, 200, replace=False).astype(np.uint64)
+    rows = np.concatenate([rows, np.zeros(200, np.uint64)])
+    cols = np.concatenate([cols, dense_cols])
+    f.import_bits(rows, cols)
+    monkeypatch.setattr(ex_mod, "TOPN_MAX_BANK_BYTES", 1)  # force regime
+    queries = [
+        "TopN(fp, Row(fp=0), n=7)",                        # dense filter
+        "TopN(fp, Row(fp=0), n=9, tanimotoThreshold=10)",  # dense+tanimoto
+        "TopN(fp, Row(fp=5), n=7)",                        # sparse filter
+    ]
+    want = {}
+    monkeypatch.setattr(ex_mod, "PBANK_ENABLED", False)
+    ex = Executor(h)
+    for q in queries:
+        (res,) = ex.execute("pbd", q)
+        want[q] = res.pairs
+    monkeypatch.setattr(ex_mod, "PBANK_ENABLED", True)
+    ex2 = Executor(h)
+    for q in queries:
+        (res,) = ex2.execute("pbd", q)
+        assert res.pairs == want[q], q
+        assert len(res.pairs) > 0
+    # Sparse gate above the filter's bit width: top_k(k) must clamp to
+    # the qpos size or the kernel crashes at TRACE time (lax.cond
+    # traces both branches, so even dense filters would die).
+    monkeypatch.setattr(ex_mod, "PBANK_SPARSE_FILTER_BITS", 8192)
+    ex_mod.Executor._PBANK_KERNELS.clear()
+    try:
+        for q in queries:
+            (res,) = ex2.execute("pbd", q)
+            assert res.pairs == want[q], q
+    finally:
+        ex_mod.Executor._PBANK_KERNELS.clear()
+    h.close()
+
+
 def test_positions_bank_incremental_patch(tmp_path, monkeypatch):
     """A point write rebuilds only the segment containing the written
     row; every other segment reuses its device arrays — and answers
