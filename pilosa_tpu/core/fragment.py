@@ -293,10 +293,14 @@ class Fragment:
 
     # -- row reads ----------------------------------------------------------
 
-    def row_ids(self) -> List[int]:
-        """Sorted ids of rows that contain any bit. Cached per write
-        version — TopN/Rows walk this per query and fragments can hold
-        hundreds of thousands of containers."""
+    def row_ids(self) -> Tuple[int, ...]:
+        """Sorted ids of rows that contain any bit, as an IMMUTABLE
+        tuple: the same cached object is returned to every caller until
+        the write version bumps (TopN aliases it straight into its
+        query row set — a mutable list here would let any caller
+        silently corrupt every later query's view of the fragment).
+        Cached per write version — TopN/Rows walk this per query and
+        fragments can hold hundreds of thousands of containers."""
         with self._lock:
             cached = getattr(self, "_row_ids_cache", None)
             if cached is not None and cached[0] == self.version:
@@ -306,7 +310,7 @@ class Fragment:
             for key in self.storage.containers:
                 if self.storage.container_count(key):
                     rows.add(key // CONTAINERS_PER_ROW)
-            out = sorted(rows)
+            out = tuple(sorted(rows))
             self._row_ids_cache = (version, out)
             return out
 

@@ -551,8 +551,7 @@ class View:
                 return cached
             if frag is None:
                 return None
-        row_ids = frag.row_ids()
-        row_ids.sort()
+        row_ids = frag.row_ids()  # sorted immutable tuple (contract)
         built = None
         if isinstance(cached, PositionsBank) \
                 and cached.row_ids == row_ids:

@@ -1077,9 +1077,19 @@ class Executor:
         ids_arg = call.arg("ids")
         min_threshold = call.uint_arg("threshold") or 0
 
-        view_rows = sorted({r for s in shards
-                            for f_ in [view.fragment(s)] if f_
-                            for r in f_.row_ids()})
+        # Per-fragment row_ids() lists are already sorted and cached per
+        # write version; re-unioning them into a set and re-sorting cost
+        # O(N log N) Python PER QUERY — ~10 s of the warm 32M-molecule
+        # tanimoto p50 went here (benches/pbank_diag2.py). Single
+        # fragment: alias the cached list (never mutated downstream —
+        # every refinement rebinds). Multi-fragment: C-speed set union,
+        # one sort.
+        per_frag = [f_.row_ids() for s in shards
+                    for f_ in [view.fragment(s)] if f_]
+        if len(per_frag) == 1:
+            view_rows = per_frag[0]
+        else:
+            view_rows = sorted(set().union(*per_frag))
         all_rows = view_rows
         if allowed_rows is not None:
             all_rows = [r for r in all_rows if r in allowed_rows]
@@ -1351,29 +1361,35 @@ class Executor:
         merge of k-candidates across segments."""
         import jax.numpy as jnp
 
+        import jax
+
         fw = None
         if filter_words is not None:
             fw = filter_words[0]  # [W] u32, single shard
+        # Params are identical for every segment — build/upload ONCE.
+        # (Per-segment rebuilds were one host->device put per segment
+        # per query; on a tunneled chip each put costs an RTT.)
+        params = jnp.asarray(
+            np.asarray([min_threshold, tanimoto, 0], np.uint32))
+        if tanimoto and src_dev is not None:
+            params = params.at[2].set(
+                jnp.asarray(src_dev).astype(jnp.uint32))
+        fw_arg = fw if fw is not None else jnp.zeros((1,), jnp.uint32)
         outs = []
         for row_lo, n_rows, pos, starts, _p in pb.segments:
             k = min(n, n_rows)
             if k == 0:
                 continue
             kern = self._pbank_kernel(k, fw is not None)
-            params = jnp.asarray(
-                np.asarray([min_threshold, tanimoto, 0], np.uint32))
-            if tanimoto and src_dev is not None:
-                params = params.at[2].set(
-                    jnp.asarray(src_dev).astype(jnp.uint32))
-            outs.append((row_lo, kern(
-                fw if fw is not None
-                else jnp.zeros((1,), jnp.uint32), pos, starts, params)))
+            outs.append((row_lo, kern(fw_arg, pos, starts, params)))
 
         def finalize() -> PairsResult:
+            # ONE batched transfer for all segments' k-candidates
+            # (sequential per-segment np.asarray fetches each paid a
+            # blocking RTT; the results are ~k ints per segment).
+            got = jax.device_get([(v, i) for _, (v, i) in outs])
             pairs = []
-            for row_lo, (vals, idxs) in outs:
-                v = np.asarray(vals)
-                ix = np.asarray(idxs)
+            for (row_lo, _), (v, ix) in zip(outs, got):
                 for val, i in zip(v.tolist(), ix.tolist()):
                     if val > 0:
                         pairs.append((int(pb.row_ids[row_lo + i]),

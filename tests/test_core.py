@@ -60,7 +60,7 @@ def test_row_reads_and_bank(tmp_path):
     f.import_bits(np.full(len(cols), 3, dtype=np.uint64), cols)
     frag = f.view().fragment(0)
     np.testing.assert_array_equal(frag.row_columns(3), cols)
-    assert frag.row_ids() == [3]
+    assert frag.row_ids() == (3,)
     bank, slots = frag.bank()
     assert bank.shape[0] == 1 and 3 in slots
     # write -> dirty -> bank refresh
