@@ -1,0 +1,172 @@
+"""A/B the pbank kernel's row-count reduction at 32M molecules:
+current flat jnp.cumsum over [P] vs a two-level blocked scan
+([P/2^16, 2^16] inner cumsum + exclusive block offsets), both through
+the real executor with the bank resident. Positions segments pad to
+1M multiples, so the reshape is always valid; padding bits are zero
+(sentinel positions match nothing), so prefix lookups clamp safely.
+"""
+
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(os.environ.get("PILOSA_DIAG_N", 32_000_000))
+ITERS = int(os.environ.get("PILOSA_DIAG_ITERS", 3))
+INNER = 1 << 16
+
+
+def variant_kernel(variant: str):
+    import jax
+    import jax.numpy as jnp
+    from pilosa_tpu.executor import executor as ex_mod
+
+    def build(k: int, has_filter: bool):
+        QCAP = ex_mod.PBANK_SPARSE_FILTER_BITS
+
+        def bits_gather(fw, posi):
+            return (jnp.take(fw, posi >> 5, mode="fill", fill_value=0)
+                    >> (posi & 31).astype(jnp.uint32)) & jnp.uint32(1)
+
+        def bits_compare(fw, posi):
+            w = jnp.arange(fw.shape[0], dtype=jnp.int32)
+            allpos = w[:, None] * 32 + jnp.arange(32, dtype=jnp.int32)
+            setmask = ((fw[:, None] >> jnp.arange(32, dtype=jnp.uint32))
+                       & jnp.uint32(1)).astype(bool)
+            qpos = jnp.where(setmask, allpos, 1 << 30).reshape(-1)
+            qk = min(QCAP, int(qpos.shape[0]))
+            qtop = -jax.lax.top_k(-qpos, qk)[0]
+            m = (posi[:, None] == qtop[None, :]).any(axis=1)
+            return m.astype(jnp.uint32)
+
+        def rowsum_flat(bits, starts):
+            s = jnp.concatenate(
+                [jnp.zeros(1, jnp.uint32),
+                 jnp.cumsum(bits, dtype=jnp.uint32)])
+            return (s[starts[1:]] - s[starts[:-1]]).astype(jnp.int32)
+
+        def rowsum_two_level(bits, starts):
+            nb = bits.shape[0] // INNER
+            b2 = bits.reshape(nb, INNER)
+            inner = jnp.cumsum(b2, axis=1, dtype=jnp.uint32)  # inclusive
+            blk = jnp.concatenate(
+                [jnp.zeros(1, jnp.uint32),
+                 jnp.cumsum(inner[:, -1], dtype=jnp.uint32)])  # excl.
+
+            def prefix(j):
+                # sum of bits[:j]; padding bits are zero so clamping the
+                # final j==P edge inside the last block is exact.
+                jc = jnp.minimum(j, nb * INNER - 1)
+                b = jc // INNER
+                off = jc % INNER
+                base = blk[b]
+                innerv = jnp.where(off > 0, inner[b, off - 1],
+                                   jnp.uint32(0))
+                # j == nb*INNER: jc points at the last element, whose
+                # bit is zero-padding, so prefix(j) == total.
+                last = jnp.where(j == nb * INNER,
+                                 inner[jc // INNER, INNER - 1] - innerv,
+                                 jnp.uint32(0))
+                return base + innerv + last
+
+            hi = prefix(starts[1:])
+            lo = prefix(starts[:-1])
+            return (hi - lo).astype(jnp.int32)
+
+        rowsum = rowsum_flat if variant == "flat" else rowsum_two_level
+
+        @jax.jit
+        def kernel(fw, pos, starts, params):
+            raw = starts[1:] - starts[:-1]
+            if has_filter:
+                posi = pos.astype(jnp.int32)
+                fwpop = jnp.sum(
+                    jax.lax.population_count(fw)).astype(jnp.int32)
+                bits = jax.lax.cond(
+                    fwpop <= QCAP,
+                    lambda: bits_compare(fw, posi),
+                    lambda: bits_gather(fw, posi))
+                c = rowsum(bits, starts)
+            else:
+                c = raw
+            thresh, tani, src = (params[0].astype(jnp.int32),
+                                 params[1].astype(jnp.int32),
+                                 params[2].astype(jnp.int32))
+            keep = c >= jnp.maximum(1, thresh)
+            denom = raw + src - c
+            keep &= jnp.where(tani > 0,
+                              (denom > 0) & (c * 100 >= tani * denom),
+                              True)
+            score = jnp.where(keep, c, -1)
+            return jax.lax.top_k(score, k)
+
+        return kernel
+
+    return build
+
+
+def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
+    os.environ.setdefault("PILOSA_TPU_TOPN_CHUNK_ROWS", "65536")
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import executor as ex_mod
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+    ex_mod.TOPN_CHUNK_ROWS = 65536
+    ex_mod.TOPN_MAX_BANK_BYTES = 64 << 20
+
+    rng = np.random.default_rng(7)
+    pos = np.sort(rng.integers(0, 4096, (N, 48), dtype=np.uint16), axis=1)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        holder = Holder(tmp)
+        holder.open()
+        idx = holder.create_index("mole")
+        f = idx.create_field("fingerprint", FieldOptions(max_columns=4096))
+        view = f.create_view_if_not_exists("standard")
+        frag = view.create_fragment_if_not_exists(0)
+        containers = frag.storage.containers
+        cpr = SHARD_WIDTH // 65536
+        keep = np.empty(pos.shape, dtype=bool)
+        keep[:, 0] = True
+        np.not_equal(pos[:, 1:], pos[:, :-1], out=keep[:, 1:])
+        for i in range(N):
+            containers[i * cpr] = pos[i][keep[i]]
+        for i in range(N):
+            frag._touch_row(i)
+        print("[diag] loaded", flush=True)
+
+        ex = Executor(holder)
+        q = ("TopN(fingerprint, Row(fingerprint=12345), n=50, "
+             "tanimotoThreshold=60)")
+        want = None
+        for variant in ["flat", "two_level"]:
+            ex_mod.Executor._PBANK_KERNELS.clear()
+            build = variant_kernel(variant)
+            ex_mod.Executor._pbank_kernel = classmethod(
+                lambda cls, k, hf, _b=build: cls._PBANK_KERNELS.setdefault(
+                    (k, hf), _b(k, hf)))
+            times = []
+            for it in range(ITERS + 1):
+                t0 = time.perf_counter()
+                (res,) = ex.execute("mole", q)
+                dt = time.perf_counter() - t0
+                if it > 0:
+                    times.append(dt)
+            if want is None:
+                want = res.pairs
+            assert res.pairs == want, f"{variant} results differ"
+            print(f"[diag] {variant}: warm p50 "
+                  f"{float(np.median(times)):.2f} s "
+                  f"(all {[f'{t:.2f}' for t in times]})", flush=True)
+
+
+if __name__ == "__main__":
+    main()
