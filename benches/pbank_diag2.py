@@ -44,7 +44,8 @@ def main():
             k = min(n, n_rows)
             if k == 0:
                 continue
-            kern = self._pbank_kernel(k, fw is not None)
+            kern = self._pbank_kernel(k, fw is not None,
+                                      fixed=pos.ndim == 2)
             params = jnp.asarray(
                 np.asarray([min_threshold, tanimoto, 0], np.uint32))
             if tanimoto and src_dev is not None:

@@ -165,21 +165,28 @@ class ViewBank:
 
 
 class PositionsBank:
-    """Device-RESIDENT sparse view for single-shard narrow layouts: all
-    rows' sorted u16 bit positions concatenated, plus per-row start
-    offsets — ~2 bytes per SET bit instead of 64 per bit-slot, so a
-    100M-row fingerprint field (~10 GB) stays resident in one chip's
-    HBM where its dense banks (~51 GB) cannot. Filtered TopN then needs
-    NO per-query upload or chunk streaming: |row ∧ filter| is a gather
-    of filter bits at the row's positions plus a cumsum difference
-    (executor._topn_positions). Segmented on row boundaries so every
-    segment's position count fits i32 offsets."""
+    """Device-RESIDENT sparse view for single-shard narrow layouts:
+    rows' sorted u16 bit positions — ~2 bytes per SET bit instead of 64
+    per bit-slot, so a 100M-row fingerprint field (~10 GB) stays
+    resident in one chip's HBM where its dense banks (~51 GB) cannot.
+    Filtered TopN then needs NO per-query upload or chunk streaming
+    (executor._topn_positions). Two segment layouts, distinguished by
+    the position array's RANK (every consumer must dispatch on it):
+
+    - flat:  (row_lo, n_rows, pos u16 [Ppad], starts i32 [n_rows+1],
+      p_real) — |row ∧ filter| = membership bits + cumsum differenced
+      at starts; handles arbitrary per-row lengths.
+    - fixed: (row_lo, n_rows, pos u16 [n_rows, L], lens i32 [n_rows],
+      p_real) — rows padded to L slots with 0xFFFF; counts are one
+      axis-1 reduce, no cumsum. Chosen per segment when every row fits
+      PBANK_FIXED_ROW_SLOTS and density clears PBANK_FIXED_MIN_DENSITY.
+
+    Segmented on row boundaries so every segment's position count fits
+    i32 offsets."""
 
     __slots__ = ("segments", "row_ids", "versions", "nbytes")
 
     def __init__(self, segments, row_ids, versions, nbytes):
-        # segments: [(row_lo, n_rows, pos_dev u16 [Ppad], starts_dev
-        #            i32 [n_rows+1], p_real)]
         self.segments = segments
         self.row_ids = row_ids      # global sorted row ids
         self.versions = versions
@@ -196,6 +203,13 @@ class PositionsBank:
 PBANK_SEGMENT_POSITIONS = int(os.environ.get(
     "PILOSA_TPU_PBANK_SEGMENT", 1 << 29))
 PBANK_GATHER_ROWS = 1 << 20
+# Fixed-width segment eligibility: every row in the segment must fit
+# this many position slots, and real positions must fill at least this
+# fraction of the padded matrix (bounds the padding overhead to 2x the
+# flat bytes in the worst admitted case).
+PBANK_FIXED_ROW_SLOTS = int(os.environ.get(
+    "PILOSA_TPU_PBANK_FIXED_SLOTS", 128))
+PBANK_FIXED_MIN_DENSITY = 0.5
 
 
 def view_bsi_name(field: str) -> str:
@@ -466,24 +480,45 @@ class View:
             pos16 = (np.concatenate(pos_parts) if pos_parts
                      else np.empty(0, np.uint16))
             lens = np.concatenate(lens_parts)
-            starts = np.zeros(len(lens) + 1, np.int64)
-            np.cumsum(lens, out=starts[1:])
             p = len(pos16)
-            # Pad to a 1M multiple, NOT a power of two: segments build
-            # once (per version), so compile reuse matters little, and
-            # pow2 padding nearly doubled a ~10 GiB bank — pushing it
-            # over the HBM budget and into rebuild-per-query thrash
-            # (caught by the 100M run).
-            padded = max(1 << 20, -(-p // (1 << 20)) * (1 << 20))
-            buf = np.full(padded, 0xFFFF, np.uint16)  # OOB-gather pad
-            buf[:p] = pos16
-            seg = (row_lo, len(lens), jnp.asarray(buf),
-                   jnp.asarray(starts.astype(np.int32)), p)
-            segments.append(seg)
-            nbytes += padded * 2 + (len(lens) + 1) * 4
+            n = len(lens)
+            # FIXED-WIDTH layout when the segment's rows are uniform
+            # enough: positions as [n_rows, L] (0xFFFF pad) + per-row
+            # real lengths. The TopN kernel then row-sums with one
+            # axis-1 reduce — no O(P) cumsum, no starts gathers (the
+            # two ops left in the warm flagship profile once the
+            # membership gather fell, docs/perf.md §4b). Fingerprint
+            # banks are ~99% dense at L=48; the density guard keeps
+            # padding ≤ 2x the flat bytes. Kind is carried by array
+            # rank (pos 2D = fixed), so every 5-tuple consumer —
+            # patcher, tests, benches — is untouched.
+            L = int(lens.max()) if n else 0
+            if 0 < L <= PBANK_FIXED_ROW_SLOTS \
+                    and p >= PBANK_FIXED_MIN_DENSITY * n * L:
+                mat = np.full((n, L), 0xFFFF, np.uint16)
+                mat[np.arange(L)[None, :] < lens[:, None]] = pos16
+                seg = (row_lo, n, jnp.asarray(mat),
+                       jnp.asarray(lens.astype(np.int32)), p)
+                segments.append(seg)
+                nbytes += n * L * 2 + n * 4
+            else:
+                starts = np.zeros(n + 1, np.int64)
+                np.cumsum(lens, out=starts[1:])
+                # Pad to a 1M multiple, NOT a power of two: segments
+                # build once (per version), so compile reuse matters
+                # little, and pow2 padding nearly doubled a ~10 GiB
+                # bank — pushing it over the HBM budget and into
+                # rebuild-per-query thrash (caught by the 100M run).
+                padded = max(1 << 20, -(-p // (1 << 20)) * (1 << 20))
+                buf = np.full(padded, 0xFFFF, np.uint16)  # OOB pad
+                buf[:p] = pos16
+                seg = (row_lo, n, jnp.asarray(buf),
+                       jnp.asarray(starts.astype(np.int32)), p)
+                segments.append(seg)
+                nbytes += padded * 2 + (n + 1) * 4
             pos_parts, lens_parts = [], []
             cur_p = 0
-            row_lo += len(lens)
+            row_lo += n
 
         for c0 in range(0, len(rows), PBANK_GATHER_ROWS):
             chunk = rows[c0:c0 + PBANK_GATHER_ROWS]
@@ -592,7 +627,9 @@ class View:
                 # cannot — assert the invariant cheaply).
                 segments.append((row_lo, n_rows, pos_dev, starts_dev,
                                  p_real))
-                nbytes += int(pos_dev.size) * 2 + (n_rows + 1) * 4
+                nbytes += int(pos_dev.size) * 2 + (
+                    n_rows * 4 if pos_dev.ndim == 2  # fixed: lens i32
+                    else (n_rows + 1) * 4)           # flat: starts i32
                 row_lo += n_rows
                 continue
             rebuilt = self._build_pbank_segments(frag, seg_rows, width,

@@ -1172,8 +1172,22 @@ class Executor:
                     # finalize's `if tanimoto and filter_words` rule) —
                     # passing it filterless would zero every denominator
                     # and empty the result.
+                    # Slice the filter row to the BANK's width: a plan
+                    # can be wider than the bank (Not() rides the
+                    # existence view, Shift(), a wider sibling field),
+                    # and a set bit at word 2047 would otherwise match
+                    # the fixed layout's 0xFFFF row pads — the gather's
+                    # OOB-fill and the compare's qtop extraction both
+                    # become pad-safe once fw stops at the bank width
+                    # (real positions are < width*32 <= 65503, so no
+                    # real count changes; the tanimoto denominator
+                    # src_pb deliberately keeps the FULL row's popcount,
+                    # matching the dense path's semantics).
+                    fw_b = None
+                    if filter_words is not None:
+                        fw_b = [filter_words[0][:width]]
                     return self._topn_positions(
-                        pb, filter_words, n,
+                        pb, fw_b, n,
                         tanimoto if filter_words is not None else 0,
                         min_threshold, src_pb)
             # Huge row sets stream through transient chunk banks to bound
@@ -1270,20 +1284,27 @@ class Executor:
     _PBANK_KERNELS: Dict[tuple, Callable] = {}
 
     @classmethod
-    def _pbank_kernel(cls, k: int, has_filter: bool):
+    def _pbank_kernel(cls, k: int, has_filter: bool,
+                      fixed: bool = False):
         """Jitted per-segment TopN over a PositionsBank: |row ∧ filter|
-        = Σ_{p ∈ row} filter_bit[p], computed as a gather of filter
-        bits at every stored position + a cumsum differenced at row
-        starts (u32 wrap subtraction is exact — per-row counts fit
-        u16). No dense expansion, no streaming: one pass over the
-        resident positions. Unfiltered TopN skips even that — counts
-        are the start diffs. Tanimoto/threshold ride as traced params;
+        = Σ_{p ∈ row} filter_bit[p]. Two layouts (view.py flush):
+
+        - flat (pos [P], starts [R+1]): membership bits + a cumsum
+          differenced at row starts (u32 wrap subtraction is exact —
+          per-row counts fit u16);
+        - fixed (pos [R, L], lens [R]): membership summed with one
+          axis-1 reduce — no O(P) cumsum, no starts gathers. The
+          0xFFFF row pad matches nothing (compare) / gathers fill-0.
+
+        No dense expansion, no streaming: one pass over the resident
+        positions. Unfiltered TopN skips even that — counts are the
+        start diffs / lens. Tanimoto/threshold ride as traced params;
         lax.top_k breaks ties by lower index, which IS the (-count,
         row) order because rows are stored ascending."""
         import jax
         import jax.numpy as jnp
 
-        key = (k, has_filter)
+        key = (k, has_filter, fixed)
         fn = cls._PBANK_KERNELS.get(key)
         if fn is not None:
             return fn
@@ -1316,12 +1337,15 @@ class Executor:
             # below still guarantees every set position is captured.
             qk = min(PBANK_SPARSE_FILTER_BITS, int(qpos.shape[0]))
             qtop = -jax.lax.top_k(-qpos, qk)[0]
-            m = (posi[:, None] == qtop[None, :]).any(axis=1)
+            # posi is [P] (flat layout) or [R, L] (fixed layout); the
+            # trailing broadcast axis makes membership layout-agnostic.
+            m = (posi[..., None] == qtop).any(axis=-1)
             return m.astype(jnp.uint32)
 
         @jax.jit
-        def kernel(fw, pos, starts, params):
-            raw = starts[1:] - starts[:-1]
+        def kernel(fw, pos, aux, params):
+            # aux: starts [R+1] (flat) | lens [R] (fixed)
+            raw = aux if fixed else aux[1:] - aux[:-1]
             if has_filter:
                 posi = pos.astype(jnp.int32)
                 # Exactness gate ON DEVICE (no extra host round trip):
@@ -1334,10 +1358,13 @@ class Executor:
                     fwpop <= PBANK_SPARSE_FILTER_BITS,
                     lambda: bits_compare(fw, posi),
                     lambda: bits_gather(fw, posi))
-                s = jnp.concatenate(
-                    [jnp.zeros(1, jnp.uint32),
-                     jnp.cumsum(bits, dtype=jnp.uint32)])
-                c = (s[starts[1:]] - s[starts[:-1]]).astype(jnp.int32)
+                if fixed:
+                    c = bits.sum(axis=1).astype(jnp.int32)
+                else:
+                    s = jnp.concatenate(
+                        [jnp.zeros(1, jnp.uint32),
+                         jnp.cumsum(bits, dtype=jnp.uint32)])
+                    c = (s[aux[1:]] - s[aux[:-1]]).astype(jnp.int32)
             else:
                 c = raw
             thresh, tani, src = (params[0].astype(jnp.int32),
@@ -1376,12 +1403,13 @@ class Executor:
                 jnp.asarray(src_dev).astype(jnp.uint32))
         fw_arg = fw if fw is not None else jnp.zeros((1,), jnp.uint32)
         outs = []
-        for row_lo, n_rows, pos, starts, _p in pb.segments:
+        for row_lo, n_rows, pos, aux, _p in pb.segments:
             k = min(n, n_rows)
             if k == 0:
                 continue
-            kern = self._pbank_kernel(k, fw is not None)
-            outs.append((row_lo, kern(fw_arg, pos, starts, params)))
+            kern = self._pbank_kernel(k, fw is not None,
+                                      fixed=pos.ndim == 2)
+            outs.append((row_lo, kern(fw_arg, pos, aux, params)))
 
         def finalize() -> PairsResult:
             # ONE batched transfer for all segments' k-candidates

@@ -1237,6 +1237,71 @@ def test_positions_bank_dense_filter_fallback(tmp_path, monkeypatch):
             assert res.pairs == want[q], q
     finally:
         ex_mod.Executor._PBANK_KERNELS.clear()
+    # MIXED bank: a small segment cap splits the 200-position row into
+    # a flat segment while narrow-row segments go fixed-width; answers
+    # must merge identically across layouts. The sparse gate is
+    # restored to its real value FIRST so the 200-bit dense filter
+    # exercises the GATHER branch over fixed segments (with the 8192
+    # monkeypatch still active every query would take bits_compare and
+    # the fixed+gather path would only ever be traced, not checked).
+    monkeypatch.setattr(ex_mod, "PBANK_SPARSE_FILTER_BITS", 64)
+    ex_mod.Executor._PBANK_KERNELS.clear()
+    from pilosa_tpu.core import view as view_mod
+    monkeypatch.setattr(view_mod, "PBANK_SEGMENT_POSITIONS", 1024)
+    f.view()._bank_cache.clear()
+    ex4 = Executor(h)
+    pb = f.view().positions_bank(0, f.view().trimmed_words())
+    kinds = {("fixed" if s[2].ndim == 2 else "flat")
+             for s in pb.segments}
+    assert kinds == {"fixed", "flat"}, kinds
+    for q in queries:
+        (res,) = ex4.execute("pbd", q)
+        assert res.pairs == want[q], q
+    h.close()
+
+
+def test_positions_bank_filter_wider_than_bank(tmp_path, monkeypatch):
+    """A TopN filter row can be WIDER than the narrow bank (sibling
+    field with bigger columns; Not() via the existence view). The
+    fixed layout's 0xFFFF row pads must not match set filter bits at
+    word 2047 (code-review r4: the pad position gathers in-range once
+    the filter spans the full container) — the filter is sliced to the
+    bank width, so pads gather OOB-fill-0 / compare against nothing."""
+    import numpy as np
+
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import executor as ex_mod
+
+    h = Holder(str(tmp_path / "h"))
+    h.open()
+    idx = h.create_index("pbw")
+    f = idx.create_field("fp", FieldOptions(max_columns=4096,
+                                            cache_type="none"))
+    rng = np.random.default_rng(31)
+    n_rows = 120
+    rows = np.repeat(np.arange(n_rows, dtype=np.uint64),
+                     rng.integers(5, 40, n_rows))
+    cols = rng.integers(0, 4096, len(rows)).astype(np.uint64)
+    f.import_bits(rows, cols)
+    # Wide sibling field: its filter row sets bit 65535 (the fixed
+    # layout's pad sentinel position) plus a few low columns that
+    # really overlap fp.
+    wide = idx.create_field("wide", FieldOptions(cache_type="none"))
+    wcols = np.array([7, 11, 599, 65535], dtype=np.uint64)
+    wide.import_bits(np.zeros(len(wcols), np.uint64), wcols)
+    monkeypatch.setattr(ex_mod, "TOPN_MAX_BANK_BYTES", 1)
+    q = "TopN(fp, Row(wide=0), n=10)"
+    monkeypatch.setattr(ex_mod, "PBANK_ENABLED", False)
+    (ref,) = Executor(h).execute("pbw", q)
+    monkeypatch.setattr(ex_mod, "PBANK_ENABLED", True)
+    ex2 = Executor(h)
+    (res,) = ex2.execute("pbw", q)
+    assert res.pairs == ref.pairs
+    # and the bank really used the fixed layout for this shape
+    pb = f.view().positions_bank(0, f.view().trimmed_words())
+    assert all(s[2].ndim == 2 for s in pb.segments)
     h.close()
 
 
