@@ -197,11 +197,14 @@ class PositionsBank:
 # i32-indexed (x64 stays off), so segment position counts must stay
 # well under 2^31; the build enforces the cap EXACTLY by splitting
 # gather chunks on row boundaries (a row contributes at most 2^16
-# positions, so no single row can break it). 2^29 leaves 4x headroom
-# under i32 while keeping segment count single-digit at 100M rows.
-# The host gather chunk bounds the one-time build's temporaries.
+# positions, so no single row can break it). 2^27 keeps each segment
+# program's workspace a few hundred MB so several can queue beside a
+# ~10 GB resident bank without exhausting HBM (2^29 segments put
+# multi-GB transients next to the bank and OOMed the 100M run); the
+# extra dispatches are cheap — results fetch as one batched
+# device_get. The host gather chunk bounds the build's temporaries.
 PBANK_SEGMENT_POSITIONS = int(os.environ.get(
-    "PILOSA_TPU_PBANK_SEGMENT", 1 << 29))
+    "PILOSA_TPU_PBANK_SEGMENT", 1 << 27))
 PBANK_GATHER_ROWS = 1 << 20
 # Fixed-width segment eligibility: every row in the segment must fit
 # this many position slots, and real positions must fill at least this
@@ -210,6 +213,10 @@ PBANK_GATHER_ROWS = 1 << 20
 PBANK_FIXED_ROW_SLOTS = int(os.environ.get(
     "PILOSA_TPU_PBANK_FIXED_SLOTS", 128))
 PBANK_FIXED_MIN_DENSITY = 0.5
+# Segment row counts round up to this multiple so kernel shapes repeat
+# across segments (one compile per bank instead of one per segment).
+PBANK_FIXED_ROW_PAD = int(os.environ.get(
+    "PILOSA_TPU_PBANK_ROW_PAD", 1 << 16))
 
 
 def view_bsi_name(field: str) -> str:
@@ -492,18 +499,35 @@ class View:
             # padding ≤ 2x the flat bytes. Kind is carried by array
             # rank (pos 2D = fixed), so every 5-tuple consumer —
             # patcher, tests, benches — is untouched.
+            # Row-count pad (both layouts): kernels compile per array
+            # SHAPE, and every remote compile crosses the tunnel — a
+            # 36-segment bank with 36 distinct row counts cost 36 cold
+            # compiles (one tunnel-window died mid-query paying them).
+            # Padding rows to a 2^16 multiple collapses the shapes to
+            # one or two per bank (+<3% rows). Pad rows carry zero
+            # lengths, so their counts are 0 and can never rank.
+            # Small segments pad to a small multiple: a 65536-row floor
+            # on a 1000-row bank would cost ~7x its HBM for no compile
+            # reuse worth having (code-review r4); big segments keep
+            # the large multiple so interior shapes repeat.
+            row_pad = PBANK_FIXED_ROW_PAD if n >= PBANK_FIXED_ROW_PAD \
+                else 1024
+            n_pad = -n % row_pad
             L = int(lens.max()) if n else 0
             if 0 < L <= PBANK_FIXED_ROW_SLOTS \
                     and p >= PBANK_FIXED_MIN_DENSITY * n * L:
-                mat = np.full((n, L), 0xFFFF, np.uint16)
-                mat[np.arange(L)[None, :] < lens[:, None]] = pos16
+                mat = np.full((n + n_pad, L), 0xFFFF, np.uint16)
+                mat[:n][np.arange(L)[None, :] < lens[:, None]] = pos16
+                lens32 = np.zeros(n + n_pad, np.int32)
+                lens32[:n] = lens
                 seg = (row_lo, n, jnp.asarray(mat),
-                       jnp.asarray(lens.astype(np.int32)), p)
+                       jnp.asarray(lens32), p)
                 segments.append(seg)
-                nbytes += n * L * 2 + n * 4
+                nbytes += (n + n_pad) * L * 2 + (n + n_pad) * 4
             else:
-                starts = np.zeros(n + 1, np.int64)
-                np.cumsum(lens, out=starts[1:])
+                starts = np.zeros(n + n_pad + 1, np.int64)
+                np.cumsum(lens, out=starts[1:n + 1])
+                starts[n + 1:] = starts[n]  # pad rows: empty ranges
                 # Pad to a 1M multiple, NOT a power of two: segments
                 # build once (per version), so compile reuse matters
                 # little, and pow2 padding nearly doubled a ~10 GiB
@@ -515,7 +539,7 @@ class View:
                 seg = (row_lo, n, jnp.asarray(buf),
                        jnp.asarray(starts.astype(np.int32)), p)
                 segments.append(seg)
-                nbytes += padded * 2 + (n + 1) * 4
+                nbytes += padded * 2 + (n + n_pad + 1) * 4
             pos_parts, lens_parts = [], []
             cur_p = 0
             row_lo += n
@@ -627,9 +651,9 @@ class View:
                 # cannot — assert the invariant cheaply).
                 segments.append((row_lo, n_rows, pos_dev, starts_dev,
                                  p_real))
-                nbytes += int(pos_dev.size) * 2 + (
-                    n_rows * 4 if pos_dev.ndim == 2  # fixed: lens i32
-                    else (n_rows + 1) * 4)           # flat: starts i32
+                # aux is lens (fixed) or starts (flat), both i32 and
+                # possibly row-padded — its own size is the truth.
+                nbytes += int(pos_dev.size) * 2 + int(starts_dev.size) * 4
                 row_lo += n_rows
                 continue
             rebuilt = self._build_pbank_segments(frag, seg_rows, width,

@@ -107,6 +107,15 @@ PBANK_ENABLED = os.environ.get("PILOSA_TPU_PBANK", "1") != "0"
 PBANK_SPARSE_FILTER_BITS = int(os.environ.get(
     "PILOSA_TPU_PBANK_SPARSE_BITS", 64))
 
+# Max positions-bank segment programs enqueued before a sync (see
+# _topn_positions): bounds how many programs' workspaces (~2x segment
+# positions x 4 B at the 2^27 default segment size, i.e. ~1.1 GB each)
+# can coexist in HBM beside a resident bank that may itself be ~10 GB.
+# Each wave sync costs one tunnel RTT, so the cap trades fetch latency
+# against OOM headroom; 4 keeps 100M-row queries ~4.4 GB of transients.
+PBANK_INFLIGHT_SEGMENTS = int(os.environ.get(
+    "PILOSA_TPU_PBANK_INFLIGHT", 4))
+
 # Warm-cache TopN self-check sampling: 1 in this many warm hits ALSO
 # runs the exact device sweep and compares (VERDICT r3 weak #5: the
 # shortcut's correctness rests on every write path refreshing cached
@@ -1309,12 +1318,14 @@ class Executor:
         if fn is not None:
             return fn
 
-        def bits_gather(fw, posi):
-            # Pad sentinel 0xFFFF gathers out of range -> fill 0.
-            return (jnp.take(fw, posi >> 5, mode="fill", fill_value=0)
-                    >> (posi & 31).astype(jnp.uint32)) & jnp.uint32(1)
+        def bits_gather(fw, pos):
+            # Pad sentinel 0xFFFF gathers out of range -> fill 0. Casts
+            # stay inline (no materialized i32 copy of the whole bank).
+            return (jnp.take(fw, (pos >> 5).astype(jnp.int32),
+                             mode="fill", fill_value=0)
+                    >> (pos & 31).astype(jnp.uint32)) & jnp.uint32(1)
 
-        def bits_compare(fw, posi):
+        def bits_compare(fw, pos):
             # Sparse-filter membership WITHOUT the positions gather: a
             # tanimoto query's filter is one fingerprint (~48 set bits),
             # and an element-wise [P] x [QCAP] compare-reduce against
@@ -1337,34 +1348,39 @@ class Executor:
             # below still guarantees every set position is captured.
             qk = min(PBANK_SPARSE_FILTER_BITS, int(qpos.shape[0]))
             qtop = -jax.lax.top_k(-qpos, qk)[0]
-            # posi is [P] (flat layout) or [R, L] (fixed layout); the
+            # pos is [P] (flat layout) or [R, L] (fixed layout); the
             # trailing broadcast axis makes membership layout-agnostic.
-            m = (posi[..., None] == qtop).any(axis=-1)
-            return m.astype(jnp.uint32)
+            return (pos[..., None].astype(jnp.int32) == qtop).any(-1)
 
         @jax.jit
         def kernel(fw, pos, aux, params):
             # aux: starts [R+1] (flat) | lens [R] (fixed)
             raw = aux if fixed else aux[1:] - aux[:-1]
             if has_filter:
-                posi = pos.astype(jnp.int32)
+                def c_from(bits):
+                    # Reduce to per-row counts INSIDE the cond branch:
+                    # the branch output is then [R] i32 instead of a
+                    # bank-sized bits array — at 100M rows the cond's
+                    # branch buffers next to the resident bank were the
+                    # difference between fitting HBM and
+                    # RESOURCE_EXHAUSTED.
+                    if fixed:
+                        return bits.sum(axis=1, dtype=jnp.int32)
+                    s = jnp.concatenate(
+                        [jnp.zeros(1, jnp.uint32),
+                         jnp.cumsum(bits, dtype=jnp.uint32)])
+                    return (s[aux[1:]] - s[aux[:-1]]).astype(jnp.int32)
+
                 # Exactness gate ON DEVICE (no extra host round trip):
                 # the compare form only sees the QCAP smallest filter
                 # positions, so any denser filter falls back to the
                 # gather form inside the same compiled program.
                 fwpop = jnp.sum(
                     jax.lax.population_count(fw)).astype(jnp.int32)
-                bits = jax.lax.cond(
+                c = jax.lax.cond(
                     fwpop <= PBANK_SPARSE_FILTER_BITS,
-                    lambda: bits_compare(fw, posi),
-                    lambda: bits_gather(fw, posi))
-                if fixed:
-                    c = bits.sum(axis=1).astype(jnp.int32)
-                else:
-                    s = jnp.concatenate(
-                        [jnp.zeros(1, jnp.uint32),
-                         jnp.cumsum(bits, dtype=jnp.uint32)])
-                    c = (s[aux[1:]] - s[aux[:-1]]).astype(jnp.int32)
+                    lambda: c_from(bits_compare(fw, pos)),
+                    lambda: c_from(bits_gather(fw, pos)))
             else:
                 c = raw
             thresh, tani, src = (params[0].astype(jnp.int32),
@@ -1403,13 +1419,25 @@ class Executor:
                 jnp.asarray(src_dev).astype(jnp.uint32))
         fw_arg = fw if fw is not None else jnp.zeros((1,), jnp.uint32)
         outs = []
+        wave = []
         for row_lo, n_rows, pos, aux, _p in pb.segments:
             k = min(n, n_rows)
             if k == 0:
                 continue
             kern = self._pbank_kernel(k, fw is not None,
                                       fixed=pos.ndim == 2)
-            outs.append((row_lo, kern(fw_arg, pos, aux, params)))
+            out = kern(fw_arg, pos, aux, params)
+            outs.append((row_lo, out))
+            # Bound enqueued-program concurrency: each segment program
+            # needs GBs of workspace next to the resident bank, and
+            # letting all segments queue at once OOMed the chip at 100M
+            # rows (9 x ~4 GB transients + the 9.6 GB bank). A wave
+            # sync caps coexisting workspaces; outputs are k-sized so
+            # keeping them all is free.
+            wave.append(out)
+            if len(wave) >= PBANK_INFLIGHT_SEGMENTS:
+                jax.block_until_ready(wave)
+                wave = []
 
         def finalize() -> PairsResult:
             # ONE batched transfer for all segments' k-candidates
