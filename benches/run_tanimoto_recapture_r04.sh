@@ -1,0 +1,40 @@
+#!/bin/bash
+# Re-capture the tanimoto flagship legs with the final round-4 kernel
+# (fixed-width segments + HBM/compile bounds) at the next tunnel
+# window. Same wait/retry/done-marker mechanics as run_tpu_suite_r04b.
+cd /root/repo
+probe() {
+  timeout 100 python -c "
+from pilosa_tpu.utils.benchenv import probe_device_once
+import sys
+ok, _ = probe_device_once(80)
+sys.exit(0 if ok else 1)" 2>/dev/null
+}
+wait_tpu() {
+  until probe; do
+    echo "$(date -u +%H:%M:%S) waiting for TPU..." >&2
+    sleep 45
+  done
+  echo "$(date -u +%H:%M:%S) TPU answered" >&2
+}
+run() {
+  local name=$1 to=$2; shift 2
+  if [ -e "benches/.${name}_final_done" ]; then
+    echo "$(date -u +%H:%M:%S) $name already done, skipping" >&2
+    return
+  fi
+  wait_tpu
+  echo "$(date -u +%H:%M:%S) bench: $name" >&2
+  timeout "$to" "$@" > "benches/${name}_r04_tpu.jsonl" 2> "benches/${name}_r04_tpu.err"
+  local rc=$?
+  echo "$(date -u +%H:%M:%S) bench: $name rc=$rc" >&2
+  if [ "$rc" -eq 0 ] && [ -s "benches/${name}_r04_tpu.jsonl" ]; then
+    touch "benches/.${name}_final_done"
+  fi
+}
+# Two passes so a mid-device death gets one retry window.
+for pass in 1 2; do
+  run tanimoto_chunked_100m 14400 env PILOSA_BENCH_HOLD_FOR_TPU=1 PILOSA_BENCH_HOLD_MAX_S=9000 PILOSA_TANIMOTO_N=100000000 PILOSA_TANIMOTO_ITERS=3 python benches/tanimoto_chunked.py
+  run tanimoto_chunked_10m 3600 env PILOSA_BENCH_HOLD_FOR_TPU=1 PILOSA_BENCH_HOLD_MAX_S=2000 PILOSA_TANIMOTO_N=10000000 PILOSA_TANIMOTO_ITERS=5 python benches/tanimoto_chunked.py
+done
+echo "$(date -u +%H:%M:%S) recapture done" >&2
