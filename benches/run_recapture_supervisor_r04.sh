@@ -1,35 +1,30 @@
 #!/bin/bash
-# Re-capture the tanimoto flagship legs with the final round-4 kernel
-# (fixed-width segments + HBM/compile bounds) at the next tunnel
-# window. Same wait/retry/done-marker mechanics as run_tpu_suite_r04b.
+# Adopts the already-holding 100M tanimoto leg (pid passed as $1):
+# waits for it to finish; promotes or restores the record; then runs
+# the 10M leg with atomic promotion via the fixed recapture script's
+# conventions.
 cd /root/repo
-probe() {
-  timeout 100 python -c "
-from pilosa_tpu.utils.benchenv import probe_device_once
-import sys
-ok, _ = probe_device_once(80)
-sys.exit(0 if ok else 1)" 2>/dev/null
-}
-wait_tpu() {
-  until probe; do
-    echo "$(date -u +%H:%M:%S) waiting for TPU..." >&2
-    sleep 45
-  done
-  echo "$(date -u +%H:%M:%S) TPU answered" >&2
-}
+LEG_PID=$1
+GOOD_100M_COMMIT=08e305a
+if [ -n "$LEG_PID" ]; then
+  echo "$(date -u +%H:%M:%S) supervising 100M leg pid $LEG_PID" >&2
+  while kill -0 "$LEG_PID" 2>/dev/null; do sleep 60; done
+  if [ -s benches/tanimoto_chunked_100m_r04_tpu.jsonl ]; then
+    echo "$(date -u +%H:%M:%S) 100M record landed" >&2
+    touch benches/.tanimoto_chunked_100m_final_done
+  else
+    echo "$(date -u +%H:%M:%S) 100M attempt failed; restoring best" >&2
+    git show "$GOOD_100M_COMMIT":benches/tanimoto_chunked_100m_r04_tpu.jsonl \
+      > benches/tanimoto_chunked_100m_r04_tpu.jsonl
+  fi
+fi
 run() {
-  # No wait_tpu gate: the legs build host-side data during an outage
-  # and hold at the build->query boundary (PILOSA_BENCH_HOLD_FOR_TPU),
-  # so the next up-window is spent on compiles+queries, not builds.
   local name=$1 to=$2; shift 2
   if [ -e "benches/.${name}_final_done" ]; then
     echo "$(date -u +%H:%M:%S) $name already done, skipping" >&2
     return
   fi
   echo "$(date -u +%H:%M:%S) bench: $name" >&2
-  # Write to a sidecar and promote only on success: truncating the
-  # committed record at attempt start left an EMPTY record when one
-  # attempt died to a tunnel outage.
   timeout "$to" "$@" > "benches/${name}_r04_tpu.jsonl.tmp" \
                    2> "benches/${name}_r04_tpu.err"
   local rc=$?
@@ -41,9 +36,8 @@ run() {
     rm -f "benches/${name}_r04_tpu.jsonl.tmp"
   fi
 }
-# Two passes so a mid-device death gets one retry window.
 for pass in 1 2; do
   run tanimoto_chunked_100m 14400 env PILOSA_BENCH_HOLD_FOR_TPU=1 PILOSA_BENCH_HOLD_MAX_S=9000 PILOSA_TANIMOTO_N=100000000 PILOSA_TANIMOTO_ITERS=3 python benches/tanimoto_chunked.py
   run tanimoto_chunked_10m 3600 env PILOSA_BENCH_HOLD_FOR_TPU=1 PILOSA_BENCH_HOLD_MAX_S=2000 PILOSA_TANIMOTO_N=10000000 PILOSA_TANIMOTO_ITERS=5 python benches/tanimoto_chunked.py
 done
-echo "$(date -u +%H:%M:%S) recapture done" >&2
+echo "$(date -u +%H:%M:%S) supervisor done" >&2
