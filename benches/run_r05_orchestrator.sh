@@ -1,0 +1,123 @@
+#!/bin/bash
+# Round-5 sequential capture orchestrator. One process, strict order —
+# no concurrent legs contending for the 1-vCPU box or for tunnel
+# windows:
+#   1. adopt the already-running 100M tanimoto leg (timeout pid $1,
+#      writing benches/tanimoto_chunked_100m_r05_tpu.jsonl.tmp), or
+#      start one; retry up to 3 total attempts. The flagship capture
+#      owns the first tunnel window.
+#   2. live bench.py capture (hold-for-window probe inside bench.py),
+#      retried until a real device record lands in BENCH_early_r05.json.
+#   3. 10M tanimoto re-capture with the final kernel.
+# Promotion (advisor r4): a leg's success is judged from ITS OWN
+# artifact — the .tmp it wrote — parsed for a complete (non-partial)
+# record; the done marker is only ever touched at promotion.
+cd /root/repo
+REC=benches/tanimoto_chunked_100m_r05_tpu.jsonl
+
+check_and_promote() {  # $1=tmpfile $2=final $3=marker $4=expected_n
+  python - "$1" "$2" "$3" "$4" <<'EOF'
+import json, os, sys
+tmp, final, marker, want_n = sys.argv[1:5]
+rec = None
+try:
+    for ln in reversed(open(tmp).read().strip().splitlines()):
+        try:
+            rec = json.loads(ln)
+            break
+        except ValueError:
+            continue
+except OSError:
+    pass
+ok = (rec is not None and not rec.get("partial")
+      and rec.get("molecules") == int(want_n) and "p50_query_s" in rec)
+if ok:
+    with open(final, "w") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    with open(marker, "w") as fh:
+        pass
+    os.unlink(tmp)
+    print("promoted:", rec.get("p50_query_s"))
+sys.exit(0 if ok else 1)
+EOF
+}
+
+ADOPT_PID=$1
+if [ -n "$ADOPT_PID" ] && kill -0 "$ADOPT_PID" 2>/dev/null; then
+  echo "$(date -u +%H:%M:%S) orch: adopting 100M leg pid $ADOPT_PID" >&2
+  while kill -0 "$ADOPT_PID" 2>/dev/null; do sleep 30; done
+  echo "$(date -u +%H:%M:%S) orch: adopted leg exited" >&2
+  check_and_promote "$REC.tmp" "$REC" benches/.tanimoto_chunked_100m_r05_done \
+      100000000 >&2 && echo "$(date -u +%H:%M:%S) orch: 100M landed (adopted)" >&2
+  rm -f "$REC.tmp"
+fi
+
+run_leg() {  # $1=name $2=timeout $3=n $4=iters $5=hold_max
+  local name=$1 to=$2 n=$3 iters=$4 hold=$5
+  if [ -e "benches/.${name}_r05_done" ]; then return 0; fi
+  echo "$(date -u +%H:%M:%S) orch: leg $name" >&2
+  timeout "$to" env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+      "PILOSA_BENCH_HOLD_MAX_S=$hold" "PILOSA_TANIMOTO_N=$n" \
+      "PILOSA_TANIMOTO_ITERS=$iters" python benches/tanimoto_chunked.py \
+      > "benches/${name}_r05_tpu.jsonl.tmp" \
+      2> "benches/${name}_r05_tpu.err"
+  local rc=$?
+  echo "$(date -u +%H:%M:%S) orch: leg $name rc=$rc" >&2
+  check_and_promote "benches/${name}_r05_tpu.jsonl.tmp" \
+      "benches/${name}_r05_tpu.jsonl" "benches/.${name}_r05_done" "$n" >&2
+  local ok=$?
+  rm -f "benches/${name}_r05_tpu.jsonl.tmp"
+  return $ok
+}
+
+for pass in 1 2 3; do
+  [ -e benches/.tanimoto_chunked_100m_r05_done ] && break
+  run_leg tanimoto_chunked_100m 18000 100000000 3 10800 && break
+done
+
+probe() {
+  timeout 170 python -c "
+from pilosa_tpu.utils.benchenv import probe_device_once
+import sys
+ok, _ = probe_device_once(150)
+sys.exit(0 if ok else 1)" 2>/dev/null
+}
+while [ ! -e benches/.bench_live_r05_done ]; do
+  echo "$(date -u +%H:%M:%S) orch: bench.py live attempt" >&2
+  # bench.py holds for a window itself (3 h default probe hold).
+  timeout 14400 env PILOSA_BENCH_WAIT_QUIET_S=60 python bench.py \
+      > BENCH_early_r05.json.tmp 2> bench_early_r05.err
+  rc=$?
+  ok=$(python - <<'EOF'
+import json
+rec = None
+try:
+    for ln in reversed(open("BENCH_early_r05.json.tmp").read()
+                       .strip().splitlines()):
+        try:
+            rec = json.loads(ln)
+            break
+        except ValueError:
+            continue
+except OSError:
+    pass
+print(1 if rec and rec.get("backend") != "cpu-fallback"
+      and not rec.get("provisional") and "value" in rec else 0)
+EOF
+)
+  echo "$(date -u +%H:%M:%S) orch: bench.py rc=$rc ok=$ok" >&2
+  if [ "$rc" -eq 0 ] && [ "$ok" = "1" ]; then
+    mv BENCH_early_r05.json.tmp BENCH_early_r05.json
+    touch benches/.bench_live_r05_done
+    echo "$(date -u +%H:%M:%S) orch: live TPU bench record landed" >&2
+  else
+    rm -f BENCH_early_r05.json.tmp
+    sleep 60
+  fi
+done
+
+for pass in 1 2; do
+  [ -e benches/.tanimoto_chunked_10m_r05_done ] && break
+  run_leg tanimoto_chunked_10m 7200 10000000 5 5400 && break
+done
+echo "$(date -u +%H:%M:%S) orch: all done" >&2

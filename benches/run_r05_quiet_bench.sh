@@ -1,0 +1,66 @@
+#!/bin/bash
+# Round-5 one-shot live bench.py capture: at the next tunnel up-window,
+# pause the leg runner's whole process group (1-vCPU box — any
+# competing process turns every device fetch into a ~70-100 ms
+# scheduling stall) and run the official bench with exclusive use of
+# the box. Promotes to BENCH_early_r05.json ONLY when the final JSON
+# line is a real device record (no backend:cpu-fallback) — a failed
+# attempt leaves no marker, so the loop retries at the next window.
+cd /root/repo
+probe() {
+  timeout 170 python -c "
+from pilosa_tpu.utils.benchenv import probe_device_once
+import sys
+ok, _ = probe_device_once(150)
+sys.exit(0 if ok else 1)" 2>/dev/null
+}
+while [ ! -e benches/.bench_live_r05_done ]; do
+  until probe; do
+    echo "$(date -u +%H:%M:%S) quiet-bench: waiting for TPU..." >&2
+    sleep 45
+  done
+  LEGS_PID=$(pgrep -o -f run_r05_legs.sh)
+  LEGS_PGID=""
+  if [ -n "$LEGS_PID" ]; then
+    LEGS_PGID=$(ps -o pgid= -p "$LEGS_PID" | tr -d ' ')
+  fi
+  echo "$(date -u +%H:%M:%S) quiet-bench: TPU up; pausing legs pgid=${LEGS_PGID:-none}" >&2
+  [ -n "$LEGS_PGID" ] && kill -STOP -- "-$LEGS_PGID" 2>/dev/null
+  resume() {
+    [ -n "$LEGS_PGID" ] && kill -CONT -- "-$LEGS_PGID" 2>/dev/null
+  }
+  trap resume EXIT INT TERM HUP
+  # Tunnel known up: a short probe hold inside bench.py suffices.
+  timeout 2400 env PILOSA_BENCH_PROBE_HOLD_S=900 \
+      PILOSA_BENCH_WAIT_QUIET_S=60 python bench.py \
+      > BENCH_early_r05.json.tmp 2> bench_early_r05.err
+  rc=$?
+  resume
+  trap - EXIT INT TERM HUP
+  ok=$(python - <<'EOF'
+import json
+try:
+    lines = open("BENCH_early_r05.json.tmp").read().strip().splitlines()
+    rec = None
+    for ln in reversed(lines):
+        try:
+            rec = json.loads(ln); break
+        except ValueError:
+            continue
+    print(1 if rec and rec.get("backend") != "cpu-fallback"
+          and not rec.get("provisional") and "value" in rec else 0)
+except OSError:
+    print(0)
+EOF
+)
+  echo "$(date -u +%H:%M:%S) quiet-bench: rc=$rc ok=$ok" >&2
+  if [ "$rc" -eq 0 ] && [ "$ok" = "1" ]; then
+    mv BENCH_early_r05.json.tmp BENCH_early_r05.json
+    touch benches/.bench_live_r05_done
+    echo "$(date -u +%H:%M:%S) quiet-bench: live TPU record landed" >&2
+  else
+    rm -f BENCH_early_r05.json.tmp
+    echo "$(date -u +%H:%M:%S) quiet-bench: attempt failed; will retry" >&2
+    sleep 120
+  fi
+done

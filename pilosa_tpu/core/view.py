@@ -506,12 +506,24 @@ class View:
             # Padding rows to a 2^16 multiple collapses the shapes to
             # one or two per bank (+<3% rows). Pad rows carry zero
             # lengths, so their counts are 0 and can never rank.
-            # Small segments pad to a small multiple: a 65536-row floor
-            # on a 1000-row bank would cost ~7x its HBM for no compile
-            # reuse worth having (code-review r4); big segments keep
-            # the large multiple so interior shapes repeat.
-            row_pad = PBANK_FIXED_ROW_PAD if n >= PBANK_FIXED_ROW_PAD \
-                else 1024
+            # The multiple is the largest power of two (1024..2^16)
+            # whose padding stays <= n/8: interior segments at scale
+            # still land on the big multiple (shapes repeat, compile
+            # reuse holds), while row counts just above a multiple
+            # (e.g. n=65537) no longer pad toward 2x their HBM
+            # (advisor r4 — the old two-point 1024/65536 rule).
+            # Tension accepted: mixed-density banks whose segments'
+            # row counts straddle the 65536..8*65536 band can see a
+            # few more distinct padded shapes (=cold compiles) than
+            # the old always-65536 rule; real banks split segments at
+            # the POSITION cap, so same-density interior segments
+            # share one shape either way.
+            row_pad = min(1024, PBANK_FIXED_ROW_PAD)
+            cand = row_pad * 2
+            while cand <= PBANK_FIXED_ROW_PAD:
+                if -n % cand <= n // 8:
+                    row_pad = cand
+                cand *= 2
             n_pad = -n % row_pad
             L = int(lens.max()) if n else 0
             if 0 < L <= PBANK_FIXED_ROW_SLOTS \
@@ -661,6 +673,13 @@ class View:
             if rebuilt is None:
                 return None
             new_segs, nb = rebuilt
+            # The clean-segment reuse above depends on every dirty
+            # range rebuilding to the SAME real row count (row_lo
+            # offsets of later clean segments assume it). A mismatch
+            # falls back to the full rebuild — same path as
+            # rebuilt-is-None — rather than serving misaligned rows.
+            if sum(s[1] for s in new_segs) != n_rows:
+                return None
             segments.extend(new_segs)
             nbytes += nb
             row_lo += n_rows
