@@ -31,7 +31,9 @@ _TIMESTAMP_RE = re.compile(
 
 
 class ParseError(ValueError):
-    pass
+    def __init__(self, msg: str, pos: int = -1):
+        super().__init__(msg)
+        self.pos = pos
 
 
 class _Parser:
@@ -43,7 +45,7 @@ class _Parser:
 
     def error(self, msg: str):
         raise ParseError(f"{msg} at offset {self.pos}: "
-                         f"{self.src[self.pos:self.pos + 20]!r}")
+                         f"{self.src[self.pos:self.pos + 20]!r}", self.pos)
 
     def sp(self) -> None:
         while self.pos < len(self.src) and self.src[self.pos] in " \t\n\r":
@@ -109,7 +111,25 @@ class _Parser:
             self.error("expected '(' after call name")
         handler = getattr(self, f"_call_{name}", None)
         if handler is not None:
-            return handler()
+            after_name = self.pos
+            try:
+                return handler()
+            except ParseError as special_err:
+                # PEG ordered choice (pql.peg Call): a failed special
+                # form falls back to the generic IDENT alternative —
+                # this is how Rows()/TopN() with no posfield parse in
+                # the reference. When BOTH alternatives fail, report
+                # whichever error got furthest into the input: the
+                # generic attempt usually dies at the first positional
+                # token, which would mask the special form's precise
+                # diagnosis (e.g. an invalid escape deep in an arg).
+                self.pos = after_name
+                try:
+                    return self._call_generic(name)
+                except ParseError as generic_err:
+                    raise (special_err
+                           if special_err.pos > generic_err.pos
+                           else generic_err) from None
         return self._call_generic(name)
 
     # Special forms. Each mirrors one branch of pql.peg `Call`.
@@ -347,21 +367,79 @@ class _Parser:
             return self._quoted('"')
         self.error("expected timestamp")
 
+    # Go strconv.Unquote escapes for double-quoted strings (pql.peg:50
+    # runs Unquote on the captured token). \' is deliberately absent:
+    # Go rejects it inside double quotes.
+    _DQ_ESCAPES = {'"': '"', "\\": "\\", "n": "\n", "t": "\t",
+                   "r": "\r", "a": "\a", "b": "\b", "f": "\f", "v": "\v"}
+
     def _quoted(self, q: str) -> str:
+        """Quoted string body (cursor past the opening quote).
+
+        Double quotes interpret Go escape sequences, matching the
+        reference's strconv.Unquote (pql.peg:50) — except that an
+        INVALID escape raises a parse error here, where the reference
+        ignores the Unquote error and silently yields "" (documented
+        divergence: an error beats silently dropping user data).
+        Single quotes unescape only \\' and \\\\ — a divergence from
+        the reference, which captures the raw text backslashes and
+        all (pql.peg:51); the unescaped form round-trips through
+        Call.to_pql, the raw form cannot."""
         out = []
         while self.pos < len(self.src):
             ch = self.src[self.pos]
-            if ch == "\\" and self.pos + 1 < len(self.src) \
-                    and self.src[self.pos + 1] in (q, "\\"):
-                out.append(self.src[self.pos + 1])
-                self.pos += 2
-                continue
+            if ch == "\\" and self.pos + 1 < len(self.src):
+                nxt = self.src[self.pos + 1]
+                if q == "'":
+                    if nxt in ("'", "\\"):
+                        out.append(nxt)
+                        self.pos += 2
+                        continue
+                elif nxt in self._DQ_ESCAPES:
+                    out.append(self._DQ_ESCAPES[nxt])
+                    self.pos += 2
+                    continue
+                elif nxt in "xuU01234567":
+                    out.append(self._numeric_escape(nxt))
+                    continue
+                else:
+                    self.error(f"invalid escape \\{nxt}")
             if ch == q:
                 self.pos += 1
                 return "".join(out)
             out.append(ch)
             self.pos += 1
         self.error(f"unterminated {q} string")
+
+    _OCTAL = frozenset("01234567")
+    _HEX = frozenset("0123456789abcdefABCDEF")
+
+    def _numeric_escape(self, kind: str) -> str:
+        """\\xNN, \\uNNNN, \\UNNNNNNNN, \\NNN (octal) — cursor on the
+        backslash; consumes the whole escape. Matches Go strconv
+        bounds: octal <= 255, no lone surrogates, <= U+10FFFF; digits
+        are validated per character (int() would accept '_')."""
+        start = self.pos
+        self.pos += 2  # backslash + kind char
+        if kind in self._OCTAL:
+            want, digits = 3, self.src[start + 1:start + 4]
+            base, allowed, self.pos = 8, self._OCTAL, start + 4
+        else:
+            want = {"x": 2, "u": 4, "U": 8}[kind]
+            digits = self.src[self.pos:self.pos + want]
+            base, allowed = 16, self._HEX
+            self.pos += want
+        if len(digits) != want or any(d not in allowed for d in digits):
+            self.pos = start
+            self.error("invalid numeric escape")
+        code = int(digits, base)
+        if base == 8 and code > 255:
+            self.pos = start
+            self.error("octal escape value > 255")
+        if code > 0x10FFFF or 0xD800 <= code <= 0xDFFF:
+            self.pos = start
+            self.error("invalid unicode code point in escape")
+        return chr(code)
 
     # -- values -------------------------------------------------------------
 

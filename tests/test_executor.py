@@ -513,14 +513,14 @@ def test_malformed_unary_calls(ex):
     e, h = ex
     setup_basic(h)
     from pilosa_tpu.executor.executor import ExecutionError
-    from pilosa_tpu.pql import ParseError
     # Not()/Shift() parse as generic zero-child calls -> executor error;
-    # Store(g=1) violates the grammar itself (Store requires a Call first).
-    for bad in ["Not()", "Shift()"]:
+    # Store(g=1) fails the Store special form (which requires a Call
+    # first) but falls back to the generic IDENT alternative per PEG
+    # ordered choice (pql.peg Call), so it too reaches the executor and
+    # fails there — matching the reference grammar.
+    for bad in ["Not()", "Shift()", "Store(g=1)"]:
         with pytest.raises(ExecutionError):
             e.execute("i", bad)
-    with pytest.raises(ParseError):
-        e.execute("i", "Store(g=1)")
 
 
 def test_list_attr_values_dont_crash(ex):

@@ -1,0 +1,321 @@
+"""PQL conformance corpus, ported from the reference parser tests.
+
+Table-driven differential suite pinning grammar conformance against the
+reference's PEG corpus (/root/reference/pql/pqlpeg_test.go:1-674) and
+the older parser suite (/root/reference/pql/parser_test.go:26-195).
+Every case asserts the same outcome the reference asserts for the same
+input: parses-with-N-calls, exact AST deep equality, or a parse error.
+Intentional divergences are documented inline next to their case.
+"""
+
+import pytest
+
+from pilosa_tpu.pql import parse_string
+from pilosa_tpu.pql.ast import (
+    BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ,
+)
+
+
+def C(name, args=None, children=None):
+    return Call(name, args or {}, children or [])
+
+
+# ---------------------------------------------------------------------------
+# pqlpeg_test.go TestPEGWorking (:57-283): input parses to N calls.
+
+WORKING = [
+    ("Empty", "", 0),
+    ("Set", "Set(2, f=10)", 1),
+    ("SetWithColKeySingleQuote", "Set('foo', f=10)", 1),
+    ("SetWithColKeyDoubleQuote", 'Set("foo", f=10)', 1),
+    ("SetTime", "Set(2, f=1, 1999-12-31T00:00)", 1),
+    ("DoubleSet", "Set(1, a=4)Set(2, a=4)", 2),
+    ("DoubleSetSpc", "Set(1, a=4) Set(2, a=4)", 2),
+    ("DoubleSetNewline", "Set(1, a=4) \n Set(2, a=4)", 2),
+    ("SetWithArbCall", "Set(1, a=4)Blerg(z=ha)", 2),
+    ("SetArbSet", "Set(1, a=4)Blerg(z=ha)Set(2, z=99)", 3),
+    ("ArbSetArb", "Arb(q=1, a=4)Set(1, z=9)Arb(z=99)", 3),
+    ("SetStringArg", "Set(1, a=zoom)", 1),
+    ("SetManyArgs", "Set(1, a=4, b=5)", 1),
+    ("SetManyMixedArgs", "Set(1, a=4, bsd=haha)", 1),
+    ("SetTimestamp", "Set(1, a=4, 2017-04-03T19:34)", 1),
+    ("Union()", "Union()", 1),
+    ("UnionOneRow", "Union(Row(a=1))", 1),
+    ("UnionTwoRows", "Union(Row(a=1), Row(z=44))", 1),
+    ("UnionNested", "Union(Intersect(Row(), Union(Row(), Row())), Row())",
+     1),
+    ("TopN no args", "TopN(boondoggle)", 1),
+    ("TopN with args", "TopN(boon, doggle=9)", 1),
+    ("double quoted args", """B(a="zm''e")""", 1),
+    ("single quoted args", '''B(a='zm""e')''', 1),
+    ("SetRowAttrs", "SetRowAttrs(blah, 9, a=47)", 1),
+    ("SetRowAttrs2args", "SetRowAttrs(blah, 9, a=47, b=bval)", 1),
+    ("SetRowAttrsWithRowKeySingleQuote",
+     "SetRowAttrs(blah, 'rowKey', a=47)", 1),
+    ("SetRowAttrsWithRowKeyDoubleQuote",
+     'SetRowAttrs(blah, "rowKey", a=47)', 1),
+    ("SetColumnAttrs", "SetColumnAttrs(9, a=47)", 1),
+    ("SetColumnAttrs2args", "SetColumnAttrs(9, a=47, b=bval)", 1),
+    ("SetColumnAttrsWithColKeySingleQuote",
+     "SetColumnAttrs('colKey', a=47)", 1),
+    ("SetColumnAttrsWithColKeyDoubleQuote",
+     'SetColumnAttrs("colKey", a=47)', 1),
+    ("Clear", "Clear(1, a=53)", 1),
+    ("Clear2args", "Clear(1, a=53, b=33)", 1),
+    ("TopN", "TopN(myfield, n=44)", 1),
+    ("TopNBitmap", "TopN(myfield, Row(a=47), n=10)", 1),
+    ("RangeLT", "Row(a < 4)", 1),
+    ("RangeGT", "Row(a > 4)", 1),
+    ("RangeLTE", "Row(a <= 4)", 1),
+    ("RangeGTE", "Row(a >= 4)", 1),
+    ("RangeEQ", "Row(a == 4)", 1),
+    ("RangeNEQ", "Row(a != null)", 1),
+    ("RangeLTLT", "Row(4 < a < 9)", 1),
+    ("RangeLTLTE", "Row(4 < a <= 9)", 1),
+    ("RangeLTELT", "Row(4 <= a < 9)", 1),
+    ("RangeLTELTE", "Row(4 <= a <= 9)", 1),
+    ("RangeTime",
+     "Row(a=4, from=2010-07-04T00:00, to=2010-08-04T00:00)", 1),
+    ("RangeTimeQuotes",
+     "Row(a=4, from='2010-07-04T00:00', to=\"2010-08-04T00:00\")", 1),
+    ("RangeTimeFromQuotes", "Row(a=4, from='2010-07-04T00:00')", 1),
+    ("RangeTimeToQuotes", 'Row(a=4, to="2010-08-04T00:00")', 1),
+    ("Dashed Frame", "Set(1, my-frame=9)", 1),
+    ("newlines", "Set(\n1,\nmy-frame\n=9)", 1),
+]
+
+
+@pytest.mark.parametrize("name,src,ncalls", WORKING,
+                         ids=[w[0] for w in WORKING])
+def test_peg_working(name, src, ncalls):
+    q = parse_string(src)
+    assert len(q.calls) == ncalls, q.calls
+
+
+# ---------------------------------------------------------------------------
+# pqlpeg_test.go TestPEGErrors (:285-327): input must NOT parse.
+
+ERRORS = [
+    ("SetNoParens", "Set"),
+    ("SetBadTimestamp", "Set(1, a=4, 2017-94-03T19:34)"),
+    ("SetTimestampNoArg", "Set(1, 2017-04-03T19:34)"),
+    ("SetStartingComma", "Set(, 1, a=4)"),
+    ("StartinCommaArb", "Zeeb(, a=4)"),
+    ("SetRowAttrs0args", "SetRowAttrs(blah, 9)"),
+    ("Clear0args", "Clear(9)"),
+    ("RangeTimeGT", "Row(a>4, 2010-07-04T00:00, 2010-08-04T00:00)"),
+    ("RangeTimeOneStamp", "Row(a=4, 2010-07-04T00:00)"),
+    # pqlpeg_test.go:19-24 — interior unescaped double quote.
+    ("InteriorUnescapedQuote",
+     'SetRowAttrs(attr="http://zoo9.com=\\\\\'hello\' "and \\"hello\\"")'),
+]
+
+
+@pytest.mark.parametrize("name,src", ERRORS, ids=[e[0] for e in ERRORS])
+def test_peg_errors(name, src):
+    with pytest.raises(ValueError):
+        parse_string(src)
+
+
+# ---------------------------------------------------------------------------
+# pqlpeg_test.go TestPQLDeepEquality (:329-674): exact AST.
+
+DEEP = [
+    ("Set", "Set(1, a=7, 2010-07-08T14:44)",
+     C("Set", {"a": 7, "_col": 1, "_timestamp": "2010-07-08T14:44"})),
+    ("SetRowAttrs", "SetRowAttrs(myfield, 9, z=4)",
+     C("SetRowAttrs", {"z": 4, "_field": "myfield", "_row": 9})),
+    ("SetRowAttrsWithRowKeySingleQuote",
+     "SetRowAttrs(myfield, 'rowKey', z=4)",
+     C("SetRowAttrs", {"z": 4, "_field": "myfield", "_row": "rowKey"})),
+    ("SetRowAttrsWithRowKeyDoubleQuote",
+     'SetRowAttrs(myfield, "rowKey", z=4)',
+     C("SetRowAttrs", {"z": 4, "_field": "myfield", "_row": "rowKey"})),
+    ("SetColumnAttrs", "SetColumnAttrs(9, z=4)",
+     C("SetColumnAttrs", {"z": 4, "_col": 9})),
+    ("SetColumnAttrsWithColKeySingleQuote",
+     "SetColumnAttrs('colKey', z=4)",
+     C("SetColumnAttrs", {"z": 4, "_col": "colKey"})),
+    ("SetColumnAttrsWithColKeyDoubleQuote",
+     'SetColumnAttrs("colKey", z=4)',
+     C("SetColumnAttrs", {"z": 4, "_col": "colKey"})),
+    ("Clear", "Clear(1, a=7)", C("Clear", {"a": 7, "_col": 1})),
+    ("TopN", "TopN(myfield, Row(), a=7)",
+     C("TopN", {"a": 7, "_field": "myfield"}, [C("Row")])),
+    ("RangeEQ", "Row(a==7)", C("Row", {"a": Condition(EQ, 7)})),
+    ("RangeLT", "Row(a<7)", C("Row", {"a": Condition(LT, 7)})),
+    ("RangeLTE", "Row(a<=7)", C("Row", {"a": Condition(LTE, 7)})),
+    ("RangeGTE", "Row(a>=7)", C("Row", {"a": Condition(GTE, 7)})),
+    ("RangeGT", "Row(a>7)", C("Row", {"a": Condition(GT, 7)})),
+    ("RangeNEQ", "Row(a!=null)", C("Row", {"a": Condition(NEQ, None)})),
+    # Open bounds normalize to inclusive BETWEEN, ast.go:514-529.
+    ("RangeLTELT", "Row(4 <= a < 9)",
+     C("Row", {"a": Condition(BETWEEN, [4, 8])})),
+    ("RangeLTLT", "Row(4 < a < 9)",
+     C("Row", {"a": Condition(BETWEEN, [5, 8])})),
+    ("RangeLTELTE", "Row(4 <= a <= 9)",
+     C("Row", {"a": Condition(BETWEEN, [4, 9])})),
+    ("RangeLTLTE", "Row(4 < a <= 9)",
+     C("Row", {"a": Condition(BETWEEN, [5, 9])})),
+    ("Sum", "Sum(field=f)", C("Sum", {"field": "f"})),
+    ("Weird dash", "Sum(field-=f)", C("Sum", {"field-": "f"})),
+    ("SumChild", "Sum(Row(), field=f)",
+     C("Sum", {"field": "f"}, [C("Row")])),
+    ("MinChild", "Min(Row(), field=f)",
+     C("Min", {"field": "f"}, [C("Row")])),
+    ("MaxChild", "Max(Row(), field=f)",
+     C("Max", {"field": "f"}, [C("Row")])),
+    ("OptionsWrapper", "Options(Row(f1=123), excludeRowAttrs=true)",
+     C("Options", {"excludeRowAttrs": True},
+       [C("Row", {"f1": 123})])),
+    ("GroupBy", "GroupBy(Rows(), filter=Row(a=1))",
+     C("GroupBy", {"filter": C("Row", {"a": 1})}, [C("Rows")])),
+    ("GroupByFilterRangeLTLT", "GroupBy(Rows(), filter=Row(4 < a < 9))",
+     C("GroupBy", {"filter": C("Row", {"a": Condition(BETWEEN, [5, 8])})},
+       [C("Rows")])),
+]
+
+
+@pytest.mark.parametrize("name,src,want", DEEP, ids=[d[0] for d in DEEP])
+def test_deep_equality(name, src, want):
+    q = parse_string(src)
+    assert len(q.calls) == 1
+    assert q.calls[0] == want
+
+
+# ---------------------------------------------------------------------------
+# parser_test.go TestParser_Parse (:26-195).
+
+PARSER = [
+    ("Empty", "Bitmap()", C("Bitmap")),
+    ("ChildrenOnly", "Union(  Bitmap()  , Count()  )",
+     C("Union", None, [C("Bitmap"), C("Count")])),
+    ("ChildWithArgument", "Count( Bitmap( id=100))",
+     C("Count", None, [C("Bitmap", {"id": 100})])),
+    ("ArgumentsOnly",
+     'MyCall( key= value, foo=\'bar\', age = 12 , bool0=true, '
+     'bool1=false, x=null, escape="\\" \\\\escape\\n\\\\\\\\"  )',
+     C("MyCall", {"key": "value", "foo": "bar", "age": 12,
+                  "bool0": True, "bool1": False, "x": None,
+                  "escape": '" \\escape\n\\\\'})),
+    ("WithFloatArgs", "MyCall( key=12.25, foo= 13.167, bar=2., baz=0.9)",
+     C("MyCall", {"key": 12.25, "foo": 13.167, "bar": 2.0, "baz": 0.9})),
+    ("WithNegativeArgs", "MyCall( key=-12.25, foo= -13)",
+     C("MyCall", {"key": -12.25, "foo": -13})),
+    ("ChildrenAndArguments", "TopN(f, Bitmap(id=100, field=other), n=3)",
+     C("TopN", {"n": 3, "_field": "f"},
+       [C("Bitmap", {"id": 100, "field": "other"})])),
+    ("ListArgument", "TopN(f, ids=[0,10,30])",
+     C("TopN", {"_field": "f", "ids": [0, 10, 30]})),
+    ("WithCondition",
+     "MyCall(key=foo, x == 12.25, y >= 100, z >< [4,8], m != null)",
+     C("MyCall", {"key": "foo",
+                  "x": Condition(EQ, 12.25),
+                  "y": Condition(GTE, 100),
+                  "z": Condition(BETWEEN, [4, 8]),
+                  "m": Condition(NEQ, None)})),
+]
+
+
+@pytest.mark.parametrize("name,src,want", PARSER,
+                         ids=[p[0] for p in PARSER])
+def test_parser_parse(name, src, want):
+    q = parse_string(src)
+    assert len(q.calls) == 1
+    assert q.calls[0] == want
+
+
+def test_float_args_are_floats():
+    """int64 vs float64 distinction survives (parser_test.go:100-135):
+    2. stays float even though it is integral."""
+    q = parse_string("MyCall(bar=2.)")
+    v = q.calls[0].args["bar"]
+    assert isinstance(v, float) and not isinstance(v, bool)
+    q = parse_string("MyCall(bar=2)")
+    v = q.calls[0].args["bar"]
+    assert isinstance(v, int) and not isinstance(v, bool)
+
+
+# ---------------------------------------------------------------------------
+# pqlpeg_test.go TestPEG (:9-48) — the gnarly smoke cases.
+
+def test_peg_smoke_multicall():
+    src = ('SetBit(Union(Zitmap(row==4), Intersect(Qitmap(blah>4), '
+           'Ritmap(field="http://zoo9.com=\\\\\'hello\' and \\"hello\\"")),'
+           ' Hitmap(row=ag-bee)), a="4z", b=5) '
+           'Count(Union(Witmap(row=5.73, frame=.10), Row(zztop><[2, 9]))) '
+           'TopN(blah, fields=["hello", "goodbye", "zero"])')
+    q = parse_string(src)
+    assert len(q.calls) == 3
+    setbit, count, topn = q.calls
+    assert setbit.name == "SetBit"
+    assert setbit.args["a"] == "4z" and setbit.args["b"] == 5
+    union = setbit.children[0]
+    assert union.name == "Union"
+    assert union.children[0] == C("Zitmap", {"row": Condition(EQ, 4)})
+    ritmap = union.children[1].children[1]
+    assert ritmap.args["field"] == 'http://zoo9.com=\\\'hello\' and "hello"'
+    assert union.children[2] == C("Hitmap", {"row": "ag-bee"})
+    witmap = count.children[0].children[0]
+    assert witmap.args == {"row": 5.73, "frame": 0.10}
+    zz = count.children[0].children[1]
+    assert zz.args["zztop"] == Condition(BETWEEN, [2, 9])
+    assert topn.args["_field"] == "blah"
+    assert topn.args["fields"] == ["hello", "goodbye", "zero"]
+
+
+def test_peg_topn_rewrite_ast():
+    """pqlpeg_test.go:26-32 asserts a String() round-trip; the rebuild
+    asserts the same parse as AST equality instead (Call.to_pql's
+    serialization is its own round-trip surface, covered in
+    test_pql.py) — intentional divergence, same conformance pinned."""
+    q = parse_string("TopN(blah, Bitmap(id==other), field=f, n=0)")
+    assert q.calls[0] == C(
+        "TopN", {"_field": "blah", "field": "f", "n": 0},
+        [C("Bitmap", {"id": Condition(EQ, "other")})])
+
+
+def test_peg_falsen0_is_string():
+    q = parse_string("C(a=falsen0)")
+    assert q.calls[0].args["a"] == "falsen0"
+
+
+def test_peg_bitmap_cond_and_arg():
+    q = parse_string("Bitmap(row=4, did==other)")
+    assert q.calls[0] == C("Bitmap", {"row": 4,
+                                      "did": Condition(EQ, "other")})
+
+
+def test_old_pql_setbit():
+    """pqlpeg_test.go:50-55 — legacy SetBit form still parses."""
+    q = parse_string("SetBit(f=11, col=1)")
+    assert len(q.calls) == 1 and q.calls[0].name == "SetBit"
+
+
+# ---------------------------------------------------------------------------
+# Double-quote escape edges (Go strconv.Unquote bounds, pql.peg:50).
+
+def test_dq_numeric_escapes():
+    q = parse_string('C(a="\\x41\\u00e9\\U0001F600\\101")')
+    assert q.calls[0].args["a"] == "Aé\U0001F600A"
+
+
+@pytest.mark.parametrize("bad", [
+    'C(a="\\ud800")',      # lone surrogate — Go rejects
+    'C(a="\\777")',        # octal > 255 — Go rejects
+    'C(a="\\0_1")',        # '_' is not an octal digit
+    'C(a="\\x4")',         # truncated hex
+    'C(a="\\q")',          # unknown escape
+], ids=["surrogate", "octal-overflow", "underscore", "short-hex",
+        "unknown"])
+def test_dq_invalid_escapes(bad):
+    with pytest.raises(ValueError):
+        parse_string(bad)
+
+
+def test_fallback_reports_furthest_error():
+    """When both the special form and the generic fallback fail, the
+    error that got furthest into the input wins — the invalid escape,
+    not the generic attempt's confusion at the positional col."""
+    with pytest.raises(ValueError, match="escape"):
+        parse_string('Set(1, f="\\q")')
