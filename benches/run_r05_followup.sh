@@ -1,0 +1,47 @@
+#!/bin/bash
+# Round-5 follow-up captures: startrace + BSI end-to-end legs with the
+# new batch mode (VERDICT r4 #3 wants batch>=16 measured through the
+# tunnel), run AFTER the main orchestrator finishes so the box and the
+# tunnel windows are never contended. Promotion mirrors the
+# orchestrator: judge a leg by its own .tmp artifact, marker only on
+# promotion.
+cd /root/repo
+while pgrep -f run_r05_orchestrator.sh > /dev/null; do sleep 60; done
+echo "$(date -u +%H:%M:%S) followup: orchestrator done, starting" >&2
+run() {
+  local name=$1 to=$2; shift 2
+  if [ -e "benches/.${name}_r05_done" ]; then
+    echo "$(date -u +%H:%M:%S) followup: $name already done" >&2
+    return
+  fi
+  echo "$(date -u +%H:%M:%S) followup: $name" >&2
+  timeout "$to" "$@" > "benches/${name}_r05_tpu.jsonl.tmp" \
+                   2> "benches/${name}_r05_tpu.err"
+  local rc=$?
+  echo "$(date -u +%H:%M:%S) followup: $name rc=$rc" >&2
+  if [ "$rc" -eq 0 ] && [ -s "benches/${name}_r05_tpu.jsonl.tmp" ] && \
+     python - "benches/${name}_r05_tpu.jsonl.tmp" <<'EOF'
+import json, sys
+rec = None
+for ln in reversed(open(sys.argv[1]).read().strip().splitlines()):
+    try:
+        rec = json.loads(ln); break
+    except ValueError:
+        continue
+ok = rec is not None and not rec.get("partial") and "value" in rec
+sys.exit(0 if ok else 1)
+EOF
+  then
+    mv "benches/${name}_r05_tpu.jsonl.tmp" "benches/${name}_r05_tpu.jsonl"
+    touch "benches/.${name}_r05_done"
+  else
+    rm -f "benches/${name}_r05_tpu.jsonl.tmp"
+  fi
+}
+for pass in 1 2; do
+  run startrace 3600 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+      PILOSA_BENCH_HOLD_MAX_S=3000 python benches/startrace.py
+  run bsi 3600 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+      PILOSA_BENCH_HOLD_MAX_S=3000 python benches/bsi.py
+done
+echo "$(date -u +%H:%M:%S) followup: done" >&2

@@ -130,12 +130,36 @@ class _Pending:
     """A dispatched-but-unfetched call result. The device program is
     already queued; finalize() blocks on the transfer and builds the
     host-side result. Lets _execute_query overlap every read call's
-    device work and device→host drain across a multi-call query."""
+    device work and device→host drain across a multi-call query.
 
-    __slots__ = ("finalize",)
+    `arrays` (optional) are the device arrays finalize will fetch.
+    Exposing them lets the executor start EVERY result's device→host
+    copy asynchronously before blocking on any (prefetch_pendings) —
+    N calls then share one overlapped drain instead of paying N
+    serial fetch RTTs, which is what makes 1 ms-class queries batch
+    usefully through a ~70 ms-RTT tunnel."""
 
-    def __init__(self, finalize):
+    __slots__ = ("finalize", "arrays")
+
+    def __init__(self, finalize, arrays=()):
         self.finalize = finalize
+        self.arrays = arrays
+
+
+def prefetch_pendings(staged) -> None:
+    """Kick off async device→host copies for every _Pending's declared
+    arrays. jax.Array.copy_to_host_async is a no-op on host-resident
+    (CPU backend) arrays and caches the fetched copy so the later
+    np.asarray/device_get inside finalize reuses it."""
+    for _, result in staged:
+        if isinstance(result, _Pending):
+            for a in result.arrays:
+                fn = getattr(a, "copy_to_host_async", None)
+                if fn is not None:
+                    try:
+                        fn()
+                    except Exception:
+                        pass  # transfer still happens in finalize
 
 
 class ExecutionError(ValueError):
@@ -333,6 +357,22 @@ class Executor:
 
     def _execute_query(self, index_name: str, query, shards
                        ) -> Tuple[List[Any], "ExecOptions"]:
+        # Two phases: dispatch every call's device program in call order
+        # (jax dispatch is async — programs queue on the device), then
+        # fetch/finalize. A multi-call query thus pays one pipelined
+        # device→host drain instead of a blocking round trip per call —
+        # the TPU analog of the reference streaming per-shard results
+        # into reduceFn as they arrive (executor.go:2277).
+        idx, staged, opts = self._dispatch_query(index_name, query, shards)
+        prefetch_pendings(staged)
+        return self._finalize_staged(idx, staged), opts
+
+    def _dispatch_query(self, index_name: str, query, shards,
+                        batch_tail_writes: bool = False):
+        """Parse/validate/translate and dispatch every call's device
+        program; returns (idx, staged, opts) with results still pending.
+        `batch_tail_writes`: a later query in the same batch writes, so
+        deferred reads must snapshot (see _tls.later_writes)."""
         if isinstance(query, str):
             query = parse_string_cached(query)
         if isinstance(query, Call):
@@ -344,31 +384,87 @@ class Executor:
         if idx is None:
             raise ExecutionError(f"index not found: {index_name}")
         opts = ExecOptions()
-        # Two phases: dispatch every call's device program in call order
-        # (jax dispatch is async — programs queue on the device), then
-        # fetch/finalize. A multi-call query thus pays one pipelined
-        # device→host drain instead of a blocking round trip per call —
-        # the TPU analog of the reference streaming per-shard results
-        # into reduceFn as they arrive (executor.go:2277).
         staged = []
         calls = list(query.calls)
-        for i, call in enumerate(calls):
-            self._translate_call(idx, call)
-            # Deferred reads (TopN chunking) consult this to know whether
-            # lazily re-reading fragment state in finalize is still safe.
-            self._tls.later_writes = any(
-                _peel_options(c).name in _WRITE_CALLS
-                for c in calls[i + 1:])
-            staged.append((call, self._execute_call(idx, call, shards,
-                                                    opts)))
-        self._tls.later_writes = False
+        try:
+            for i, call in enumerate(calls):
+                self._translate_call(idx, call)
+                # Deferred reads (TopN chunking) consult this to know
+                # whether lazily re-reading fragment state in finalize
+                # is still safe.
+                self._tls.later_writes = batch_tail_writes or any(
+                    _peel_options(c).name in _WRITE_CALLS
+                    for c in calls[i + 1:])
+                staged.append((call, self._execute_call(idx, call, shards,
+                                                        opts)))
+        finally:
+            self._tls.later_writes = False
+        return idx, staged, opts
+
+    def _finalize_staged(self, idx: Index, staged) -> List[Any]:
         results = []
         for call, result in staged:
             if isinstance(result, _Pending):
                 result = result.finalize()
             self._translate_result(idx, call, result)
             results.append(result)
-        return results, opts
+        return results
+
+    def execute_batch(self, requests: Sequence[Tuple[str, Any, Optional[
+            Sequence[int]]]]) -> List[Any]:
+        """Execute N independent queries with ONE pipelined device
+        drain: every query's calls are dispatched before any result is
+        fetched, and all pending transfers start asynchronously before
+        the first blocking finalize. The cross-request extension of
+        the multi-call pipeline (reference executor.go:84 evaluates a
+        query's calls together; clients batch calls per request) —
+        this is the API-layer amortization that makes 1 ms-class
+        queries serve efficiently through a high-RTT link.
+
+        Each element of `requests` is (index_name, query, shards).
+        Returns one entry per request: List[results] on success, or
+        the exception instance for that request (per-request errors
+        don't fail the batch). ExecOptions-driven response shaping
+        (columnAttrs) is per-request via the returned opts."""
+        staged_q: List[Any] = []
+        out: List[Any] = [None] * len(requests)
+        # Parse ONCE per request (the parsed tree is handed straight to
+        # _dispatch_query — no second parse/clone) and pre-scan for
+        # writes so earlier requests' deferred reads know to snapshot.
+        parsed: List[Any] = [None] * len(requests)
+        writes_after = [False] * len(requests)
+        any_writes = False
+        for j in range(len(requests) - 1, -1, -1):
+            writes_after[j] = any_writes
+            q = requests[j][1]
+            try:
+                if isinstance(q, str):
+                    q = parse_string_cached(q)
+                if isinstance(q, Call):
+                    q = Query([q])
+                parsed[j] = q
+                if write_call_count(q) > 0:
+                    any_writes = True
+            except Exception as e:
+                out[j] = e  # parse error: reported for this item only
+        for j, (index_name, _, shards) in enumerate(requests):
+            if parsed[j] is None:
+                continue
+            try:
+                staged_q.append(
+                    (j, self._dispatch_query(index_name, parsed[j], shards,
+                                             batch_tail_writes=
+                                             writes_after[j])))
+            except Exception as e:
+                out[j] = e
+        for _, (_, staged, _) in staged_q:
+            prefetch_pendings(staged)
+        for j, (idx, staged, opts) in staged_q:
+            try:
+                out[j] = (self._finalize_staged(idx, staged), opts)
+            except Exception as e:
+                out[j] = e
+        return out
 
     def execute_full(self, index_name: str, query,
                      shards: Optional[Sequence[int]] = None
@@ -376,8 +472,15 @@ class Executor:
         """Execute and return the full JSON-shaped response, including
         `columnAttrs` when an Options(columnAttrs=true) call requested them
         (reference executor.Execute, executor.go:134-165)."""
-        from pilosa_tpu.executor.results import result_to_json
         results, opts = self._execute_query(index_name, query, shards)
+        return self.shape_response(index_name, results, opts)
+
+    def shape_response(self, index_name: str, results, opts: "ExecOptions"
+                       ) -> Dict[str, Any]:
+        """JSON-shape executed results, attaching columnAttrs via the
+        LOCAL translator when requested (shared by execute_full and the
+        single-node batch path)."""
+        from pilosa_tpu.executor.results import result_to_json
         resp: Dict[str, Any] = {"results": [result_to_json(r)
                                             for r in results]}
         if opts.column_attrs:
@@ -648,7 +751,8 @@ class Executor:
             idx, call.children[0], self._shards(idx, shards, pad=False)))
         counts = self._eval_tree(idx, call.children[0], shards, mode="count")
         return _Pending(
-            lambda: int(np.asarray(counts, dtype=np.int64).sum()))
+            lambda: int(np.asarray(counts, dtype=np.int64).sum()),
+            arrays=(counts,))
 
     def _eval_tree(self, idx: Index, call: Call, shards: List[int],
                    mode: str):
@@ -1288,7 +1392,11 @@ class Executor:
             # the full-bank path needs no such care because its device
             # arrays snapshot at dispatch.
             return finalize()
-        return _Pending(finalize)
+        return _Pending(
+            finalize,
+            arrays=tuple(x for _, _, out in dispatched
+                         for x in (out if isinstance(out, tuple) else (out,))
+                         ) + ((src_dev,) if src_dev is not None else ()))
 
     _PBANK_KERNELS: Dict[tuple, Callable] = {}
 
@@ -1453,7 +1561,8 @@ class Executor:
             pairs.sort(key=lambda rc: (-rc[1], rc[0]))
             return PairsResult(pairs[:n])
 
-        return _Pending(finalize)
+        return _Pending(finalize,
+                        arrays=tuple(x for _, vi in outs for x in vi))
 
     def _repair_topn_caches(self, view, shards) -> None:
         """Rebuild every fragment's cached per-row counts from storage —
@@ -1842,7 +1951,7 @@ class Executor:
                        for i, v in enumerate(np.asarray(a).tolist()))
             return ValCount(base + bsig.min, count)
 
-        return _Pending(finalize)
+        return _Pending(finalize, arrays=(a, b))
 
     # --------------------------------------------------------------- writes
 

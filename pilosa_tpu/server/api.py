@@ -244,6 +244,56 @@ class API:
                 return resp
             return self.executor.execute_full(index, query, shards=shards)
 
+    def query_batch(self, items: Sequence[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+        """Execute N independent queries in one request with one
+        pipelined device drain (Executor.execute_batch). Each item is
+        {"index": str, "query": str, "shards"?: [int]}; the response
+        list carries {"results": [...]} or {"error": "..."} per item —
+        one bad query does not fail its batchmates.
+
+        This is the serving-layer amortization of the per-request
+        round trip: the reference's protocol already batches CALLS in
+        one query string (executor.go:84); this batches QUERIES, so a
+        client pays one HTTP round trip and the executor pays one
+        device->host drain for N small queries. On the single-node
+        path the dispatch/finalize pipeline spans the whole batch; on
+        the cluster path items execute sequentially (fan-out legs
+        already pipeline per node) — the HTTP round trip is still
+        amortized."""
+        with self.tracer.span("API.QueryBatch", n=len(items)):
+            if self.cluster_executor is not None:
+                # self.query() counts the "query" stat per item.
+                out = []
+                for it in items:
+                    try:
+                        out.append(self.query(it["index"], it["query"],
+                                              shards=it.get("shards")))
+                    except Exception as e:
+                        out.append({"error": str(e)})
+                return out
+            self.stats.count("query", len(items))
+            t0 = _time.perf_counter()
+            reqs = [(it["index"], it["query"], it.get("shards"))
+                    for it in items]
+            batched = self.executor.execute_batch(reqs)
+            out = []
+            for (index, _, _), res in zip(reqs, batched):
+                if isinstance(res, Exception):
+                    out.append({"error": str(res)})
+                    continue
+                results, opts = res
+                try:
+                    out.append(self.executor.shape_response(index, results,
+                                                            opts))
+                except Exception as e:
+                    out.append({"error": str(e)})
+            dur = _time.perf_counter() - t0
+            if self.long_query_time > 0 and dur > self.long_query_time:
+                self.logger.printf("%.3fs SLOW BATCH [%d queries]",
+                                   dur, len(items))
+            return out
+
     def _attach_column_attrs(self, index: str, q, resp: Dict[str, Any]
                              ) -> None:
         """Coordinator-side columnAttrs for the cluster path: if the query

@@ -329,6 +329,24 @@ class Handler(BaseHTTPRequestHandler):
                                          remote=self._qbool(q, "remote")))
                 except ValueError as e:
                     raise ApiError(str(e))
+            elif path == "/batch/query":
+                # Batch endpoint (rebuild extension; no reference route —
+                # the reference batches CALLS per query string,
+                # executor.go:84; this batches QUERIES per request so N
+                # small queries share one HTTP round trip and one
+                # pipelined device drain). Body:
+                #   {"queries": [{"index", "query", "shards"?}, ...]}
+                # Response: {"responses": [{"results": ...}|{"error"}]}.
+                body = self._body_json()
+                items = body.get("queries")
+                if not isinstance(items, list):
+                    raise ApiError("body must carry a 'queries' list")
+                for it in items:
+                    if not isinstance(it, dict) or "index" not in it \
+                            or "query" not in it:
+                        raise ApiError(
+                            "each batch item needs 'index' and 'query'")
+                self._json({"responses": api.query_batch(items)})
             elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)/import",
                                    path):
                 self._check_args(q, "clear", "remote", "ignoreKeyCheck")

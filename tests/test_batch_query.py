@@ -1,0 +1,161 @@
+"""Multi-query batching: Executor.execute_batch, API.query_batch, and
+the /batch/query HTTP route. The cross-request extension of the
+reference's multi-call pipelining (executor.go:84): N queries, one
+dispatch phase, one overlapped device->host drain."""
+
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits(np.array([1, 1, 1, 2, 2], np.uint64),
+                  np.array([1, 2, 3, 2, 3], np.uint64))
+    idx2 = h.create_index("j")
+    g = idx2.create_field("g")
+    g.import_bits(np.array([5, 5], np.uint64),
+                  np.array([9, SHARD_WIDTH + 4], np.uint64))
+    yield h
+    h.close()
+
+
+def unwrap(res):
+    assert not isinstance(res, Exception), res
+    return res[0]
+
+
+def test_batch_matches_serial(holder):
+    ex = Executor(holder)
+    reqs = [("i", "Count(Row(f=1))", None),
+            ("j", "Count(Row(g=5))", None),
+            ("i", "TopN(f, n=2)", None),
+            ("i", "Row(f=2)", None)]
+    serial = [ex.execute(i, q, shards=s) for i, q, s in reqs]
+    batched = ex.execute_batch(reqs)
+    for s, b in zip(serial, batched):
+        got = unwrap(b)
+        if hasattr(s[0], "pairs"):
+            assert got[0].pairs == s[0].pairs
+        elif hasattr(s[0], "columns"):
+            assert got[0].columns().tolist() == s[0].columns().tolist()
+        else:
+            assert got == s
+
+
+def test_batch_error_isolation(holder):
+    ex = Executor(holder)
+    out = ex.execute_batch([
+        ("i", "Count(Row(f=1))", None),
+        ("nosuch", "Count(Row(f=1))", None),
+        ("i", "Bogus((", None),
+        ("i", "Count(Row(f=2))", None)])
+    assert unwrap(out[0]) == [3]
+    assert isinstance(out[1], Exception)
+    assert isinstance(out[2], Exception)
+    assert unwrap(out[3]) == [2]
+
+
+def test_batch_write_then_read_ordering(holder):
+    """A write in request k is visible to request k+1 and NOT to
+    request k-1's already-dispatched read (sequential semantics across
+    the batch, like calls within one query)."""
+    ex = Executor(holder)
+    out = ex.execute_batch([
+        ("i", "Count(Row(f=1))", None),          # pre-write count: 3
+        ("i", "Set(77, f=1)", None),
+        ("i", "Count(Row(f=1))", None)])         # post-write: 4
+    assert unwrap(out[0]) == [3]
+    assert unwrap(out[1]) == [True]
+    assert unwrap(out[2]) == [4]
+
+
+def test_batch_write_isolation_under_chunked_topn(holder, monkeypatch):
+    """TopN's chunked path defers bank uploads to finalize; a write in
+    a LATER BATCH REQUEST must not leak into it (the same guard that
+    protects later calls within one query — _tls.later_writes)."""
+    from pilosa_tpu.executor import executor as executor_mod
+    monkeypatch.setattr(executor_mod, "TOPN_MAX_BANK_BYTES", 1)
+    monkeypatch.setattr(executor_mod, "TOPN_CHUNK_ROWS", 1)
+    ex = Executor(holder)
+    out = ex.execute_batch([
+        ("i", "TopN(f, n=4)", None),
+        ("i", "Set(100, f=1) Set(101, f=1) Set(102, f=1)", None)])
+    pairs = unwrap(out[0])[0].pairs
+    assert pairs == [(1, 3), (2, 2)]  # pre-write counts
+    (count,) = ex.execute("i", "Count(Row(f=1))")
+    assert count == 6  # writes landed after
+
+
+def test_batch_write_scan_sees_bare_call_writes(holder, monkeypatch):
+    """The write pre-scan must recognize a write passed as a bare Call
+    (not a string/Query) so earlier chunked reads still snapshot."""
+    from pilosa_tpu.executor import executor as executor_mod
+    from pilosa_tpu.pql.ast import Call
+    monkeypatch.setattr(executor_mod, "TOPN_MAX_BANK_BYTES", 1)
+    monkeypatch.setattr(executor_mod, "TOPN_CHUNK_ROWS", 1)
+    ex = Executor(holder)
+    out = ex.execute_batch([
+        ("i", "TopN(f, n=4)", None),
+        ("i", Call("Set", {"_col": 200, "f": 1}), None)])
+    assert unwrap(out[0])[0].pairs == [(1, 3), (2, 2)]
+    assert unwrap(out[1]) == [True]
+
+
+def test_query_batch_api(tmp_path):
+    from pilosa_tpu.server import API
+    h = Holder(str(tmp_path))
+    h.open()
+    api = API(h)
+    api.create_index("b1")
+    api.create_field("b1", "f")
+    api.query("b1", "Set(1, f=2) Set(3, f=2)")
+    out = api.query_batch([
+        {"index": "b1", "query": "Count(Row(f=2))"},
+        {"index": "b1", "query": "Row(f=2)"},
+        {"index": "zzz", "query": "Count(Row(f=2))"},
+    ])
+    assert out[0] == {"results": [2]}
+    assert out[1]["results"][0]["columns"] == [1, 3]
+    assert "error" in out[2]
+    h.close()
+
+
+def test_http_batch_route(live_server):
+    base, api, _h = live_server
+    api.create_index("hb")
+    api.create_field("hb", "f")
+
+    def post(path, body):
+        r = urllib.request.Request(
+            base + path, data=json.dumps(body).encode(), method="POST")
+        with urllib.request.urlopen(r) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+
+    api.query("hb", "Set(4, f=9)")
+    st, res = post("/batch/query", {"queries": [
+        {"index": "hb", "query": "Count(Row(f=9))"},
+        {"index": "hb", "query": "Row(f=9)"},
+        {"index": "hb", "query": "Nope(("},
+    ]})
+    assert st == 200
+    assert res["responses"][0] == {"results": [1]}
+    assert res["responses"][1]["results"][0]["columns"] == [4]
+    assert "error" in res["responses"][2]
+    # malformed body
+    r = urllib.request.Request(base + "/batch/query",
+                               data=b'{"queries": "nope"}', method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(r)
+    assert ei.value.code == 400
