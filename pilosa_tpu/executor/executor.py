@@ -422,10 +422,10 @@ class Executor:
         queries serve efficiently through a high-RTT link.
 
         Each element of `requests` is (index_name, query, shards).
-        Returns one entry per request: List[results] on success, or
-        the exception instance for that request (per-request errors
-        don't fail the batch). ExecOptions-driven response shaping
-        (columnAttrs) is per-request via the returned opts."""
+        Returns one entry per request: a (results, opts) tuple on
+        success — opts drives response shaping (columnAttrs), see
+        shape_response — or the exception instance for that request
+        (per-request errors don't fail the batch)."""
         staged_q: List[Any] = []
         out: List[Any] = [None] * len(requests)
         # Parse ONCE per request (the parsed tree is handed straight to

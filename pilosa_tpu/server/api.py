@@ -274,17 +274,31 @@ class API:
                 return out
             self.stats.count("query", len(items))
             t0 = _time.perf_counter()
-            reqs = [(it["index"], it["query"], it.get("shards"))
-                    for it in items]
-            batched = self.executor.execute_batch(reqs)
+            # Malformed items degrade per-item, same as execution errors.
+            reqs = []
+            shaped_err = {}
+            for pos, it in enumerate(items):
+                try:
+                    reqs.append((it["index"], it["query"],
+                                 it.get("shards")))
+                except (KeyError, TypeError) as e:
+                    shaped_err[pos] = {"error": f"bad batch item: {e!r}"}
+                    reqs.append(None)
+            batched = self.executor.execute_batch(
+                [r for r in reqs if r is not None])
             out = []
-            for (index, _, _), res in zip(reqs, batched):
+            bi = iter(batched)
+            for pos, r in enumerate(reqs):
+                if r is None:
+                    out.append(shaped_err[pos])
+                    continue
+                res = next(bi)
                 if isinstance(res, Exception):
                     out.append({"error": str(res)})
                     continue
                 results, opts = res
                 try:
-                    out.append(self.executor.shape_response(index, results,
+                    out.append(self.executor.shape_response(r[0], results,
                                                             opts))
                 except Exception as e:
                     out.append({"error": str(e)})

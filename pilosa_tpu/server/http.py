@@ -341,6 +341,11 @@ class Handler(BaseHTTPRequestHandler):
                 items = body.get("queries")
                 if not isinstance(items, list):
                     raise ApiError("body must carry a 'queries' list")
+                if len(items) > 1024:
+                    # Every item's device programs dispatch before any
+                    # result finalizes; an unbounded batch would queue
+                    # arbitrarily many pending outputs.
+                    raise ApiError("batch too large (max 1024 queries)")
                 for it in items:
                     if not isinstance(it, dict) or "index" not in it \
                             or "query" not in it:

@@ -53,6 +53,10 @@ def pytest_configure(config):
         "markers",
         "timeout(seconds): fail (not hang) a test that overruns; "
         "SIGALRM-based, vendored in conftest.py")
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute harness tests (process-level cluster "
+        "faults); deselect with -m 'not slow'")
 
 
 @pytest.hookimpl(wrapper=True)

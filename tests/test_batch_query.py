@@ -125,10 +125,12 @@ def test_query_batch_api(tmp_path):
         {"index": "b1", "query": "Count(Row(f=2))"},
         {"index": "b1", "query": "Row(f=2)"},
         {"index": "zzz", "query": "Count(Row(f=2))"},
+        {"index": "b1"},  # malformed: degrades per-item, not the batch
     ])
     assert out[0] == {"results": [2]}
     assert out[1]["results"][0]["columns"] == [1, 3]
     assert "error" in out[2]
+    assert "error" in out[3]
     h.close()
 
 
