@@ -12,6 +12,7 @@ import argparse
 import csv
 import json
 import os
+import signal
 import sys
 
 import numpy as np
@@ -193,6 +194,16 @@ def cmd_server(args) -> int:
                   "on" if cfg.tls_enabled else "off",
                   mesh.mesh.shape if mesh else "single-device",
                   f"{len(cluster.nodes())} nodes" if cluster else "no")
+    # SIGTERM unwinds like Ctrl-C so the finally below runs the full
+    # graceful close (flush caches, close holder) — the reference
+    # server likewise traps SIGTERM for shutdown (cmd/pilosa/main.go).
+    # Python's default TERM action would kill the process mid-buffer.
+    def _graceful(signum, frame):
+        raise KeyboardInterrupt
+    try:
+        signal.signal(signal.SIGTERM, _graceful)
+    except ValueError:
+        pass  # not the main thread (in-process test harness)
     try:
         serve(api, cfg.host, cfg.port,
               ssl_context=cfg.server_ssl_context())

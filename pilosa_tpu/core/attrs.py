@@ -5,9 +5,17 @@ implementation (boltdb/attrstore.go:82) and 100-id block checksums for
 diff-sync (attr.go:80-119). Host-side by design — attributes never touch
 the device (the reference likewise keeps them out of fragments).
 
-Implementation: in-memory dict + JSON file persisted atomically on every
-mutation batch; block checksums over sorted (id, sorted-attr) tuples give
-the same diff-sync capability the reference gets from BoltDB blocks.
+Implementation: in-memory dict + snapshot file + append-only delta log.
+A mutation appends ONE log line (the delta batch) — O(batch), flat in
+store size, the analog of the reference's per-key BoltDB upserts
+(boltdb/attrstore.go:218-280); the earlier whole-store rewrite per set()
+fell over on attr-heavy imports. The log compacts back into the
+snapshot when it grows past bounds; open() loads the snapshot, replays
+complete log lines, and truncates a torn tail (a crash mid-append loses
+at most the in-flight batch, never the store — same discipline as the
+fragment oplog). Block checksums over sorted (id, sorted-attr) tuples
+give the same diff-sync capability the reference gets from BoltDB
+blocks.
 """
 
 from __future__ import annotations
@@ -19,6 +27,11 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 ATTR_BLOCK_SIZE = 100
+# Compaction bounds: replay work stays O(entries), disk stays O(bytes).
+LOG_COMPACT_ENTRIES = int(os.environ.get("PILOSA_TPU_ATTR_LOG_ENTRIES",
+                                         4096))
+LOG_COMPACT_BYTES = int(os.environ.get("PILOSA_TPU_ATTR_LOG_BYTES",
+                                       8 << 20))
 
 
 class AttrStore:
@@ -26,24 +39,92 @@ class AttrStore:
         self.path = path
         self.attrs: Dict[int, Dict[str, Any]] = {}
         self._lock = threading.RLock()
+        self._log_fh = None
+        self._log_entries = 0
+        self._log_bytes = 0
+
+    @property
+    def _log_path(self) -> str:
+        return self.path + ".log"
 
     def open(self) -> None:
-        if self.path and os.path.exists(self.path):
+        if not self.path:
+            return
+        if os.path.exists(self.path):
             with open(self.path) as f:
                 raw = json.load(f)
             self.attrs = {int(k): v for k, v in raw.items()}
+        if os.path.exists(self._log_path):
+            keep = 0
+            with open(self._log_path, "rb") as f:
+                for line in f:
+                    try:
+                        delta = json.loads(line)
+                    except ValueError:
+                        break  # torn tail: stop at the first bad line
+                    self._apply({int(k): v for k, v in delta.items()})
+                    keep += len(line)
+                    self._log_entries += 1
+            if keep < os.path.getsize(self._log_path):
+                with open(self._log_path, "ab") as f:
+                    f.truncate(keep)
+            self._log_bytes = keep
 
     def close(self) -> None:
-        pass
+        with self._lock:
+            if self._log_fh is not None:
+                self._log_fh.close()
+                self._log_fh = None
 
-    def _save(self) -> None:
+    def _apply(self, items: Dict[int, Dict[str, Any]]) -> None:
+        """Merge a delta batch into memory (null values delete keys)."""
+        for id_, attrs in items.items():
+            cur = self.attrs.setdefault(id_, {})
+            for k, v in attrs.items():
+                if v is None:
+                    cur.pop(k, None)
+                else:
+                    cur[k] = v
+            if not cur:
+                self.attrs.pop(id_, None)
+
+    def _append(self, items: Dict[int, Dict[str, Any]]) -> None:
+        """One log line per mutation batch — the O(batch) write path."""
+        if not self.path:
+            return
+        line = json.dumps({str(k): v for k, v in items.items()},
+                          separators=(",", ":")) + "\n"
+        if self._log_fh is None:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            self._log_fh = open(self._log_path, "a")
+        self._log_fh.write(line)
+        self._log_fh.flush()
+        self._log_entries += 1
+        self._log_bytes += len(line)
+        if self._log_entries >= LOG_COMPACT_ENTRIES or \
+                self._log_bytes >= LOG_COMPACT_BYTES:
+            self._compact()
+
+    def _compact(self) -> None:
+        """Fold the log into the snapshot (atomic replace, then reset
+        the log). Crash between the replace and the reset replays the
+        already-folded deltas on next open — merges are idempotent."""
         if not self.path:
             return
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({str(k): v for k, v in self.attrs.items()}, f)
+            f.flush()
+            os.fsync(f.fileno())  # the log truncates right after: the
+            # snapshot must be durable first or a crash loses BOTH
+            # (same discipline as Fragment._snapshot).
         os.replace(tmp, self.path)
+        if self._log_fh is not None:
+            self._log_fh.close()
+        self._log_fh = open(self._log_path, "w")
+        self._log_entries = 0
+        self._log_bytes = 0
 
     def get(self, id_: int) -> Dict[str, Any]:
         with self._lock:
@@ -53,28 +134,13 @@ class AttrStore:
         """Merge attrs for id; null values delete keys (reference
         boltdb/attrstore.go upsert semantics)."""
         with self._lock:
-            cur = self.attrs.setdefault(id_, {})
-            for k, v in attrs.items():
-                if v is None:
-                    cur.pop(k, None)
-                else:
-                    cur[k] = v
-            if not cur:
-                self.attrs.pop(id_, None)
-            self._save()
+            self._apply({id_: attrs})
+            self._append({id_: attrs})
 
     def set_bulk(self, items: Dict[int, Dict[str, Any]]) -> None:
         with self._lock:
-            for id_, attrs in items.items():
-                cur = self.attrs.setdefault(id_, {})
-                for k, v in attrs.items():
-                    if v is None:
-                        cur.pop(k, None)
-                    else:
-                        cur[k] = v
-                if not cur:
-                    self.attrs.pop(id_, None)
-            self._save()
+            self._apply(items)
+            self._append(items)
 
     def ids_matching(self, key: str, values: List[Any]) -> List[int]:
         """Row ids whose attr `key` is in `values` (TopN attrName/attrValues

@@ -187,6 +187,7 @@ class Field:
                 v.close()
             if self._row_translator is not None:
                 self._row_translator.close()
+            self.row_attr_store.close()
 
     def _new_view(self, name: str) -> View:
         v = View(os.path.join(self.path, "views", name), self.index,

@@ -154,7 +154,12 @@ class Fragment:
                     f.write(data)
                 self.storage.snapshot_bytes = len(data)
             self._last_snapshot_bytes = self.storage.snapshot_bytes
-            self._file = open(self.path, "ab")
+            # Unbuffered append: every op record is one write syscall
+            # straight to the OS page cache (Go file-write
+            # semantics) — a killed PROCESS loses nothing; only
+            # a machine crash can tear the tail, which open()
+            # recovery already handles.
+            self._file = open(self.path, "ab", buffering=0)
             self.storage.op_writer = self._file
             cache_mod.load_cache(self.cache, self.cache_path(),
                                  stamp=self._storage_stamp())
@@ -240,7 +245,12 @@ class Fragment:
             # still in place and later op appends must keep working on a
             # fragment whose snapshot failed (batch records are already
             # in the log, so no data is at risk — only future appends).
-            self._file = open(self.path, "ab")
+            # Unbuffered append: every op record is one write syscall
+            # straight to the OS page cache (Go file-write
+            # semantics) — a killed PROCESS loses nothing; only
+            # a machine crash can tear the tail, which open()
+            # recovery already handles.
+            self._file = open(self.path, "ab", buffering=0)
             self.storage.op_writer = self._file
 
     def _oplog_over_limit(self) -> bool:

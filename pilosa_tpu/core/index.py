@@ -86,6 +86,7 @@ class Index:
                 f.close()
             if self._column_translator is not None:
                 self._column_translator.close()
+            self.column_attr_store.close()
 
     def _notify_shard(self, field: str, shard: int) -> None:
         if self.on_new_shard is not None:

@@ -346,11 +346,9 @@ class Handler(BaseHTTPRequestHandler):
                     # result finalizes; an unbounded batch would queue
                     # arbitrarily many pending outputs.
                     raise ApiError("batch too large (max 1024 queries)")
-                for it in items:
-                    if not isinstance(it, dict) or "index" not in it \
-                            or "query" not in it:
-                        raise ApiError(
-                            "each batch item needs 'index' and 'query'")
+                # Item shape is validated per item by query_batch — a
+                # malformed item degrades to {"error"} without failing
+                # its batchmates (one contract for HTTP and in-process).
                 self._json({"responses": api.query_batch(items)})
             elif m := re.fullmatch(r"/index/([^/]+)/field/([^/]+)/import",
                                    path):

@@ -97,6 +97,21 @@ else:  # pragma: no cover
         return int(_POP_TABLE[words.view(np.uint8)].sum())
 
 
+def _write_all(w, data: bytes) -> None:
+    """Write the whole record or raise. The op log is an UNBUFFERED
+    raw file (one syscall per op, Go file-write durability), and raw
+    writes may be short (e.g. ENOSPC writes what fits): an
+    acknowledged op must never be a truncated record, so loop and
+    fail loudly on no progress."""
+    view = memoryview(data)
+    while view:
+        n = w.write(view)
+        if not n:
+            raise OSError("op-log write made no progress "
+                          f"({len(view)} bytes unwritten)")
+        view = view[n:]
+
+
 def _new_container() -> np.ndarray:
     return np.zeros(CONTAINER_WORDS, dtype=np.uint64)
 
@@ -539,7 +554,7 @@ class Bitmap:
         self.op_n += n_bits
         self.oplog_bytes += len(rec)
         if self.op_writer is not None:
-            self.op_writer.write(rec)
+            _write_all(self.op_writer, rec)
 
     # -- queries ------------------------------------------------------------
 
@@ -798,7 +813,7 @@ class Bitmap:
         self.oplog_bytes += 13 if values is None else 13 + 8 * len(values)
         if self.op_writer is None:
             return
-        self.op_writer.write(encode_op(typ, value, values))
+        _write_all(self.op_writer, encode_op(typ, value, values))
 
     # -- serialization ------------------------------------------------------
 

@@ -1,0 +1,146 @@
+"""AttrStore: append-log write path, torn-tail recovery, compaction,
+block checksums (reference attr.go:80-119, boltdb/attrstore.go)."""
+
+import json
+import os
+
+import pytest
+
+from pilosa_tpu.core import attrs as attrs_mod
+from pilosa_tpu.core.attrs import ATTR_BLOCK_SIZE, AttrStore
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = AttrStore(str(tmp_path / "d" / ".attrs"))
+    s.open()
+    yield s
+    s.close()
+
+
+def reopened(s):
+    s.close()
+    s2 = AttrStore(s.path)
+    s2.open()
+    return s2
+
+
+def test_set_get_merge_delete(store):
+    store.set(1, {"a": 1, "b": "x"})
+    store.set(1, {"b": None, "c": [1, 2]})
+    assert store.get(1) == {"a": 1, "c": [1, 2]}
+    store.set(1, {"a": None, "c": None})
+    assert store.get(1) == {}
+    assert 1 not in store.attrs  # fully-emptied ids drop
+
+
+def test_log_append_and_replay(store):
+    store.set(5, {"k": "v"})
+    store.set_bulk({6: {"x": 1}, 7: {"y": 2}})
+    store.set(6, {"x": None, "z": 3})
+    # The write path appended (no snapshot rewrite yet).
+    assert os.path.getsize(store.path + ".log") > 0
+    assert not os.path.exists(store.path)
+    s2 = reopened(store)
+    assert s2.get(5) == {"k": "v"}
+    assert s2.get(6) == {"z": 3}
+    assert s2.get(7) == {"y": 2}
+    s2.close()
+
+
+def test_torn_tail_truncated(store):
+    store.set(1, {"a": 1})
+    store.set(2, {"b": 2})
+    store.close()
+    with open(store.path + ".log", "ab") as f:
+        f.write(b'{"3": {"c":')  # crash mid-append
+    s2 = AttrStore(store.path)
+    s2.open()
+    assert s2.get(1) == {"a": 1} and s2.get(2) == {"b": 2}
+    assert s2.get(3) == {}
+    # The torn bytes are gone; further writes replay cleanly.
+    s2.set(4, {"d": 4})
+    s3 = reopened(s2)
+    assert s3.get(4) == {"d": 4}
+    s3.close()
+
+
+def test_compaction_folds_log(store, monkeypatch):
+    monkeypatch.setattr(attrs_mod, "LOG_COMPACT_ENTRIES", 10)
+    for i in range(25):
+        store.set(i, {"n": i})
+    # Two compactions happened; log is small, snapshot holds the rest.
+    assert os.path.exists(store.path)
+    with open(store.path + ".log") as f:
+        assert len(f.read().strip().splitlines()) < 10
+    s2 = reopened(store)
+    assert all(s2.get(i) == {"n": i} for i in range(25))
+    s2.close()
+
+
+def test_legacy_snapshot_only_store_opens(tmp_path):
+    path = str(tmp_path / ".attrs")
+    with open(path, "w") as f:
+        json.dump({"9": {"old": True}}, f)
+    s = AttrStore(path)
+    s.open()
+    assert s.get(9) == {"old": True}
+    s.set(10, {"new": 1})
+    s2 = reopened(s)
+    assert s2.get(9) == {"old": True} and s2.get(10) == {"new": 1}
+    s2.close()
+
+
+def test_blocks_diff_after_log_writes(store):
+    store.set(3, {"a": 1})
+    store.set(ATTR_BLOCK_SIZE + 3, {"a": 1})
+    b1 = dict(store.blocks())
+    store.set(3, {"a": 2})
+    b2 = dict(store.blocks())
+    assert b1[0] != b2[0]          # changed block's checksum moved
+    assert b1[1] == b2[1]          # untouched block unchanged
+    assert store.block_data(1) == {ATTR_BLOCK_SIZE + 3: {"a": 1}}
+
+
+def test_oplog_survives_process_kill(tmp_path):
+    """Op appends are unbuffered (one write syscall each, Go file-write
+    semantics): bits written through the executor are durable on disk
+    even if the process dies WITHOUT close() — modeled by opening a
+    second holder on the same dir while the first is still open."""
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    h.create_index("k").create_field("f")
+    Executor(h).execute("k", "Set(1, f=3) Set(9, f=3)")
+    # No h.close() — the "killed" process's buffers never flush.
+    h2 = Holder(str(tmp_path / "d"))
+    h2.open()
+    (row,) = Executor(h2).execute("k", "Row(f=3)")
+    assert row.columns().tolist() == [1, 9]
+    h2.close()
+
+
+def test_write_cost_flat_in_store_size(tmp_path):
+    """The VERDICT r4 #6 criterion: per-write cost must not grow with
+    store size (the old path re-serialized the whole store per set).
+    Compare per-write time at 100 ids vs 10k ids — allow generous
+    noise, fail only on the old O(store) blow-up."""
+    import time
+    s = AttrStore(str(tmp_path / ".attrs"))
+    s.open()
+
+    def time_writes(base, n=50):
+        t0 = time.perf_counter()
+        for i in range(n):
+            s.set(base + i, {"v": i})
+        return (time.perf_counter() - t0) / n
+
+    for i in range(100):
+        s.set(i, {"v": i, "pad": "x" * 50})
+    small = time_writes(10_000)
+    for i in range(10_000):
+        s.attrs.setdefault(20_000 + i, {"v": i, "pad": "x" * 50})
+    big = time_writes(50_000)
+    s.close()
+    assert big < small * 20 + 1e-3, (small, big)

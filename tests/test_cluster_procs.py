@@ -210,7 +210,12 @@ def wait_converged(c, up_ports, want_counts, deadline_s=90):
                             port, "GET",
                             f"/internal/fragment/blocks?index=ci&field=cf"
                             f"&view=standard&shard={shard}")["blocks"]
-                        assert blocks, (shard, port)  # data landed here
+                        if not blocks:
+                            # e.g. a restarted node pre-resync: retry,
+                            # don't abort — this is the state the loop
+                            # exists to wait out.
+                            sums.add(f"empty:{port}")
+                            continue
                         sums.add(json.dumps(blocks, sort_keys=True))
                     checked += len(owner_ports)
                     if len(sums) > 1:
