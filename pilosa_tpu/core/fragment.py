@@ -18,6 +18,7 @@ row dirty; dirty slots are re-uploaded lazily before the next device read
 from __future__ import annotations
 
 import hashlib
+import itertools
 import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -73,6 +74,9 @@ HASH_BLOCK_SIZE = 100
 
 
 class Fragment:
+    # Process-wide fragment epoch allocator — see `self.version` below.
+    _VERSION_EPOCH = itertools.count(1)
+
     def __init__(self, path: str, index: str, field: str, view: str,
                  shard: int, cache_type: str = cache_mod.CACHE_TYPE_RANKED,
                  cache_size: int = cache_mod.DEFAULT_CACHE_SIZE,
@@ -101,7 +105,14 @@ class Fragment:
         self._bank_all_rows = False  # bank covers every present row
         # Monotonic write version; executors key leaf caches on it. The
         # per-row last-touch versions let view banks patch incrementally.
-        self.version = 0
+        # Based at a process-unique epoch (not 0): fragments are popped
+        # and recreated across resizes (syncer clean_unowned), and a
+        # recreated fragment restarting at version 0 would satisfy any
+        # version-keyed cache entry (view banks, merged row lists)
+        # built against its predecessor — serving pre-resize data. The
+        # 2^48 stride keeps per-fragment write counts from ever
+        # reaching the next epoch.
+        self.version = next(Fragment._VERSION_EPOCH) << 48
         self._row_versions: Dict[int, int] = {}
         # Block-checksum cache (anti-entropy): block id -> digest, plus
         # the blocks dirtied since it was built. None = cold (full pass

@@ -254,6 +254,11 @@ class View:
         # fragment skip the whole container gather and go straight to
         # device_put. LRU-bounded process-wide by HOST_BLOCK_BUDGET.
         self._host_blocks: Dict[tuple, tuple] = {}  # key -> (arr, vers)
+        # Merged row-id tuples per shard set, keyed on fragment
+        # versions: multi-shard TopN was re-unioning + re-sorting every
+        # per-fragment row list PER QUERY — O(N log N) Python at
+        # millions of rows (code-review r4 / VERDICT #7).
+        self._merged_rows: Dict[tuple, tuple] = {}  # shards -> (vers, rows)
 
     def open(self) -> None:
         frag_dir = os.path.join(self.path, "fragments")
@@ -595,6 +600,42 @@ class View:
                     flush()
         flush()
         return segments, nbytes
+
+    def merged_row_ids(self, shards) -> tuple:
+        """Sorted union of row_ids() across `shards`, cached per shard
+        set and invalidated by any member fragment's version bump —
+        repeat queries over unchanged fragments alias the same tuple
+        (no per-query union/sort; reference fragment.top reads its
+        rankCache per fragment, fragment.go:1067). The merge itself is
+        one C-speed np.unique over the concatenated sorted lists."""
+        key = tuple(shards)
+        frags = [f for s in key for f in [self.fragment(s)]
+                 if f is not None]
+        versions = tuple(f.version for f in frags)
+        with self._lock:
+            ent = self._merged_rows.get(key)
+            if ent is not None and ent[0] == versions:
+                # Refresh LRU order on hit (dict preserves insertion
+                # order; re-inserting moves this key to the back, so
+                # eviction below pops the genuinely coldest entry).
+                self._merged_rows.pop(key)
+                self._merged_rows[key] = ent
+                return ent[1]
+        per = [f.row_ids() for f in frags]
+        per = [p for p in per if p]
+        if not per:
+            merged: tuple = ()
+        elif len(per) == 1:
+            merged = per[0]  # already a sorted immutable tuple
+        else:
+            merged = tuple(np.unique(np.concatenate(
+                [np.asarray(p, dtype=np.uint64) for p in per])).tolist())
+        with self._lock:
+            self._merged_rows.pop(key, None)  # re-insert at the back
+            self._merged_rows[key] = (versions, merged)
+            while len(self._merged_rows) > 8:  # a few live shard sets
+                self._merged_rows.pop(next(iter(self._merged_rows)))
+        return merged
 
     def positions_bank(self, shard: int, width: int
                        ) -> Optional[PositionsBank]:

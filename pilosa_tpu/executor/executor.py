@@ -1190,19 +1190,13 @@ class Executor:
         ids_arg = call.arg("ids")
         min_threshold = call.uint_arg("threshold") or 0
 
-        # Per-fragment row_ids() lists are already sorted and cached per
-        # write version; re-unioning them into a set and re-sorting cost
-        # O(N log N) Python PER QUERY — ~10 s of the warm 32M-molecule
-        # tanimoto p50 went here (benches/pbank_diag2.py). Single
-        # fragment: alias the cached list (never mutated downstream —
-        # every refinement rebinds). Multi-fragment: C-speed set union,
-        # one sort.
-        per_frag = [f_.row_ids() for s in shards
-                    for f_ in [view.fragment(s)] if f_]
-        if len(per_frag) == 1:
-            view_rows = per_frag[0]
-        else:
-            view_rows = sorted(set().union(*per_frag))
+        # Merged row list is cached on the view per shard set, keyed on
+        # fragment versions — repeat queries alias the same tuple (the
+        # per-query union/sort cost ~10 s of the warm 32M-molecule
+        # tanimoto p50, benches/pbank_diag2.py; the multi-shard case
+        # re-paid it every query until r5). Never mutated downstream —
+        # every refinement rebinds.
+        view_rows = view.merged_row_ids(shards)
         all_rows = view_rows
         if allowed_rows is not None:
             all_rows = [r for r in all_rows if r in allowed_rows]
