@@ -105,7 +105,9 @@ def cmd_server(args) -> int:
         from pilosa_tpu.utils.tracing import ExportingTracer
         tracer = ExportingTracer(cfg.tracing_endpoint,
                                  service_name=cfg.tracing_service_name,
-                                 logger=logger)
+                                 logger=logger,
+                                 sampler_type=cfg.tracing_sampler_type,
+                                 sampler_param=cfg.tracing_sampler_param)
         tracer.start()
     else:
         tracer = RecordingTracer()

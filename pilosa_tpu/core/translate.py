@@ -38,6 +38,16 @@ class TranslateStore:
         # Byte cursor into the replication PRIMARY's log (see apply_log);
         # in-memory only — a restart re-replays from 0, idempotently.
         self.replica_offset = 0
+        # How many bytes of our id-ordered log are safe to SERVE to a
+        # chained successor (read_log_from): None = all (we allocate,
+        # so our id-ordered log IS the stream). On a replica it equals
+        # replica_offset: the primary allocates ids monotonically and
+        # streams id-ordered, so ids <= the last streamed id are
+        # exactly the streamed prefix, and any out-of-band adopted
+        # entry (apply_entries) has a HIGHER id — serving past the
+        # streamed prefix would splice those holes into a successor's
+        # stream at wrong byte positions.
+        self.served_limit: Optional[int] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -94,6 +104,12 @@ class TranslateStore:
             if id_ is None and create:
                 id_ = self._next_id
                 self._insert(key, id_)
+                # Allocating locally means we ARE the (possibly just
+                # promoted) primary: our id-ordered log is the stream,
+                # serve all of it. A promoted node's pre-promotion
+                # catch-up made its prefix complete; its new
+                # allocations extend the id order at the end.
+                self.served_limit = None
             return id_
 
     def translate_keys(self, keys: Iterable[str], create: bool = True
@@ -116,6 +132,10 @@ class TranslateStore:
                 cur = self._ids.get(key)
                 if cur is None:
                     self._insert(key, int(id_))
+                    # Out-of-band adoption marks us a replica: successors
+                    # may only be served the streamed prefix.
+                    if self.served_limit is None:
+                        self.served_limit = self.replica_offset
                 elif cur != id_:
                     raise ValueError(
                         f"translate conflict for {key!r}: {cur} != {id_}")
@@ -142,7 +162,15 @@ class TranslateStore:
             return bytes(out)
 
     def read_log_from(self, offset: int) -> bytes:
-        return self.log_bytes()[offset:]
+        """Serve the replication stream from a byte offset. All nodes
+        serve the SAME byte stream (the primary's id-ordered log), so
+        one cursor is valid against any source in the chain; replicas
+        serve only their streamed prefix (served_limit)."""
+        with self._lock:
+            data = self.log_bytes()
+            if self.served_limit is not None:
+                data = data[:self.served_limit]
+            return data[offset:]
 
     def apply_log(self, data: bytes, _persist: bool = True,
                   resume: bool = False) -> int:
@@ -171,4 +199,7 @@ class TranslateStore:
                 pos += 4 + n + 8
             if resume:
                 self.replica_offset += pos
+                # Streaming marks us a replica (until/unless promoted);
+                # the safe-to-serve prefix grows with the cursor.
+                self.served_limit = self.replica_offset
         return applied

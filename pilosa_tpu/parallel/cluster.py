@@ -95,6 +95,21 @@ class Cluster:
                     return n
             return self._nodes[sorted(self._nodes)[0]]
 
+    def previous_node(self) -> Optional[Node]:
+        """The node listed before the local node in id order, wrapping
+        (reference unprotectedPreviousNode, cluster.go:1919-1935); None
+        in a single-node cluster. This is each replica's translate-log
+        streaming source: chaining from ring predecessors bounds the
+        primary's replication egress to ONE stream however large the
+        cluster (reference setPrimaryTranslateStore at
+        cluster.go:1908-1910)."""
+        with self._lock:
+            ids = sorted(self._nodes)
+            if len(ids) <= 1 or self.local.id not in self._nodes:
+                return None
+            pos = ids.index(self.local.id)
+            return self._nodes[ids[pos - 1]]  # -1 wraps to the last
+
     def pin_translate_primary(self, node_id: Optional[str] = None) -> str:
         """Pin (or re-pin) the translation primary; defaults to the
         current effective primary. Returns the pinned id."""

@@ -626,6 +626,14 @@ class API:
         idx = self._index(index)
         store = idx.column_translator if field is None \
             else self._field(idx, field).row_translator
+        if self.cluster is not None and store.served_limit is None \
+                and self._translate_primary().id != self.cluster.local.id:
+            # Restarted replica that hasn't re-streamed this boot: its
+            # disk log may hold out-of-band adopted entries (holes in
+            # the id order), which must not be spliced into a chained
+            # successor's stream. Serve nothing until our own pull
+            # re-establishes the streamed prefix.
+            store.served_limit = 0
         return store.read_log_from(offset)
 
     def recalculate_caches(self) -> None:
@@ -1021,34 +1029,55 @@ class API:
         self._sync_translate_stores()
         return self.syncer.sync_holder()
 
+    def _translate_source(self):
+        """Where this replica streams translate logs FROM: its ring
+        predecessor (chained replication — each node replicates from
+        the node before it in id order, so the primary serves ONE
+        stream however large the cluster; reference
+        setPrimaryTranslateStore(previousNode), cluster.go:1908-1935).
+        Falls back to the pinned primary when the predecessor is DOWN
+        (the chain re-forms around failures; allocation always routes
+        to the primary regardless)."""
+        primary = self._translate_primary()
+        prev = self.cluster.previous_node()
+        if prev is None or prev.id == primary.id:
+            return primary
+        if prev.id in getattr(self.cluster, "down_ids", set()):
+            return primary
+        return prev
+
     def _sync_translate_stores(self) -> None:
         from pilosa_tpu.parallel.client import ClientError
         primary = self._translate_primary()
         if primary.id == self.cluster.local.id:
             return
-        for idx in self.holder.indexes.values():
-            try:
-                # Incremental: resume from our replica log's byte offset
-                # (reference streams the log tail from an offset,
-                # /internal/translate/data, translate.go:400).
-                if idx.keys:
-                    st = idx.column_translator
+        source = self._translate_source()
+
+        sources = [source] + ([primary] if primary.id != source.id else [])
+
+        def pull(st, idx_name, field_name=None):
+            fld = f"&field={field_name}" if field_name else ""
+            for node in sources:  # chain first, then the primary
+                try:
+                    # Incremental: resume from our replica log's byte
+                    # offset (reference streams the log tail from an
+                    # offset, /internal/translate/data, translate.go:400).
                     st.apply_log(self._client._req(
                         "GET",
-                        f"{primary.uri}/internal/translate/data"
-                        f"?index={idx.name}&offset={st.replica_offset}",
-                        raw=True), resume=True)
-                for f in idx.fields.values():
-                    if f.options.keys:
-                        st = f.row_translator
-                        st.apply_log(self._client._req(
-                            "GET",
-                            f"{primary.uri}/internal/translate/data"
-                            f"?index={idx.name}&field={f.name}"
-                            f"&offset={st.replica_offset}", raw=True),
-                            resume=True)
-            except ClientError:
-                continue
+                        f"{node.uri}/internal/translate/data"
+                        f"?index={idx_name}{fld}"
+                        f"&offset={st.replica_offset}", raw=True),
+                        resume=True)
+                    return
+                except ClientError:
+                    continue
+
+        for idx in self.holder.indexes.values():
+            if idx.keys:
+                pull(idx.column_translator, idx.name)
+            for f in idx.fields.values():
+                if f.options.keys:
+                    pull(f.row_translator, idx.name, f.name)
 
     def resize_now(self) -> dict:
         """Pull newly-owned fragments + drop unowned (tests + admin; the

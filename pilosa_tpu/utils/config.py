@@ -63,6 +63,11 @@ class Config:
     # spans in memory only.
     tracing_endpoint: str = ""
     tracing_service_name: str = "pilosa-tpu"
+    # Head sampling (reference Tracing.SamplerType/SamplerParam,
+    # server/config.go:110-118): const (param 0/1), probabilistic
+    # (param = fraction of traces), ratelimiting (param = traces/sec).
+    tracing_sampler_type: str = "const"
+    tracing_sampler_param: float = 1.0
     # Cluster: static peer URI list (must include this node's own URI) +
     # replication factor (reference cluster.replicas, server/config.go:63)
     cluster_peers: list = field(default_factory=list)

@@ -155,18 +155,55 @@ class ExportingTracer(RecordingTracer):
 
     def __init__(self, endpoint: str, service_name: str = "pilosa-tpu",
                  keep: int = 128, batch_size: int = 64,
-                 flush_interval: float = 5.0, logger=None):
+                 flush_interval: float = 5.0, logger=None,
+                 sampler_type: str = "const", sampler_param: float = 1.0):
         super().__init__(keep=keep)
         self.endpoint = endpoint
         self.service_name = service_name
         self.batch_size = batch_size
         self.flush_interval = flush_interval
         self.logger = logger
+        # Head sampling (reference SamplerType/SamplerParam,
+        # server/config.go:110-118, jaeger sampler semantics): decides
+        # per ROOT span whether its tree exports. Exporting every span
+        # is untenable at production query rates; local recording
+        # (/debug introspection) keeps working for unsampled traces.
+        if sampler_type not in ("const", "probabilistic", "ratelimiting"):
+            raise ValueError(f"unknown sampler type {sampler_type!r}")
+        self.sampler_type = sampler_type
+        self.sampler_param = float(sampler_param)
+        self._rl_tokens = self.sampler_param  # ratelimiting bucket
+        self._rl_stamp = time.monotonic()
         self._pending: List[Span] = []
         self._pending_lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _sampled(self, span: Span) -> bool:
+        if self.sampler_type == "const":
+            return self.sampler_param != 0
+        if self.sampler_type == "probabilistic":
+            # Deterministic on trace id: every node in the cluster makes
+            # the SAME decision for one propagated trace, so sampled
+            # traces export complete (jaeger's probabilistic sampler
+            # hashes the same way for the same reason).
+            import hashlib
+            h = int.from_bytes(hashlib.md5(
+                span.trace_id.encode()).digest()[:8], "big")
+            return h / 2**64 < self.sampler_param
+        # ratelimiting: token bucket of sampler_param traces/second.
+        with self._pending_lock:
+            now = time.monotonic()
+            self._rl_tokens = min(
+                max(self.sampler_param, 1.0),
+                self._rl_tokens + (now - self._rl_stamp)
+                * self.sampler_param)
+            self._rl_stamp = now
+            if self._rl_tokens >= 1.0:
+                self._rl_tokens -= 1.0
+                return True
+            return False
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs):
@@ -178,7 +215,8 @@ class ExportingTracer(RecordingTracer):
         finally:
             # Queue on the error path too: traces of FAILED requests are
             # the ones operators need most.
-            if not stack and s is not None:  # a root span just finished
+            if not stack and s is not None and self._sampled(s):
+                # a root span just finished and was head-sampled in
                 with self._pending_lock:
                     self._pending.append(s)
                     full = len(self._pending) >= self.batch_size

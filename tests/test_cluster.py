@@ -1512,3 +1512,133 @@ def test_cluster_connection_burst(tmp_path):
     finally:
         for nd in nodes:
             nd.stop()
+
+
+def test_translate_replication_chains_from_predecessor(tmp_path):
+    """Chained translate replication (reference
+    setPrimaryTranslateStore(previousNode), cluster.go:1908-1935): each
+    replica streams from its ring predecessor, so data flows
+    primary -> middle -> last one hop per pass, and the primary serves
+    ONE stream regardless of cluster size."""
+    nodes = run_cluster(tmp_path, 3)
+    try:
+        order = sorted(nodes, key=lambda n: n.uri)
+        primary, middle, last = order
+        # Sanity: the ring predecessor of each is the node before it.
+        assert middle.api._translate_source().id == primary.uri
+        assert last.api._translate_source().id == middle.uri
+        req(primary.uri, "POST", "/index/ch", {"options": {"keys": True}})
+        req(primary.uri, "POST", "/index/ch/field/f", {"options": {}})
+        req(primary.uri, "POST", "/index/ch/query", b"Set('kx', f=1)")
+
+        def has_key(n):
+            st = n.holder.index("ch").column_translator
+            return st.translate_key("kx", create=False) is not None
+
+        # last pulls from middle, which is still empty -> no key yet.
+        last.api._sync_translate_stores()
+        assert not has_key(last)
+        # middle pulls from the primary -> adopts the key.
+        middle.api._sync_translate_stores()
+        assert has_key(middle)
+        # now last's predecessor has it -> one more pass converges.
+        last.api._sync_translate_stores()
+        assert has_key(last)
+
+        # Predecessor DOWN: the chain re-forms around it via the
+        # primary fallback.
+        req(primary.uri, "POST", "/index/ch/query", b"Set('ky', f=1)")
+        last.cluster.down_ids.add(middle.uri)
+        assert last.api._translate_source().id == primary.uri
+        last.api._sync_translate_stores()
+        st = last.holder.index("ch").column_translator
+        assert st.translate_key("ky", create=False) is not None
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_chained_replica_serves_only_streamed_prefix(tmp_path):
+    """A replica's served stream must be byte-stable for its successor:
+    out-of-band adopted entries (primary-fallback lookups) have ids
+    beyond the streamed prefix and must NOT be spliced into the served
+    stream until the stream itself delivers them."""
+    from pilosa_tpu.core.translate import TranslateStore
+    primary = TranslateStore()
+    for k in ("a", "b", "c", "d"):
+        primary.translate_key(k)
+    replica = TranslateStore()
+    full = primary.read_log_from(0)
+    # Stream only the first two records into the replica.
+    two = 2 * (4 + 1 + 8)
+    replica.apply_log(full[:two], resume=True)
+    # Out-of-band adoption of a later allocation ('d', id 4).
+    replica.apply_entries([("d", 4)])
+    # The replica SERVES exactly the primary's first `two` bytes: a
+    # successor at any offset <= two reads the true stream.
+    assert replica.read_log_from(0) == full[:two]
+    assert replica.read_log_from(replica.replica_offset) == b""
+    # Streaming the rest closes the hole and extends the served prefix.
+    replica.apply_log(full[two:], resume=True)
+    assert replica.read_log_from(0) == full
+    # A store that allocates locally (the primary, incl. a promoted
+    # one) serves its whole id-ordered log.
+    replica.translate_key("e")
+    assert len(replica.read_log_from(0)) > len(full)
+
+
+def test_restarted_replica_does_not_serve_stale_log(tmp_path):
+    """After a restart a replica's served_limit is unknown; the serving
+    endpoint must gate it to 0 (serve nothing) until the replica has
+    re-streamed — not splice its possibly-hole-y disk log into a
+    successor's stream."""
+    nodes = run_cluster(tmp_path, 3)
+    try:
+        order = sorted(nodes, key=lambda n: n.uri)
+        primary, middle, last = order
+        req(primary.uri, "POST", "/index/rg", {"options": {"keys": True}})
+        req(primary.uri, "POST", "/index/rg/field/f", {"options": {}})
+        req(primary.uri, "POST", "/index/rg/query", b"Set('k1', f=1)")
+        middle.api._sync_translate_stores()
+        st = middle.holder.index("rg").column_translator
+        assert st.served_limit == st.replica_offset > 0
+        # Simulate restart: fresh store state, role unknown.
+        st.served_limit = None
+        # The HTTP-serving surface refuses to serve until re-streamed.
+        assert middle.api.translate_data("rg") == b""
+        assert st.served_limit == 0
+        # Primary restart keeps serving (role known by pin).
+        assert len(primary.api.translate_data("rg")) > 0
+        # After re-streaming, the replica serves again.
+        middle.api._sync_translate_stores()
+        assert len(middle.api.translate_data("rg")) > 0
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_fragment_version_epoch_unique_across_recreate(tmp_path):
+    """Version-keyed caches (view banks, merged row lists) must never
+    be satisfied by a RECREATED fragment that restarted its version
+    counter (fragments are popped/recreated across resizes)."""
+    from pilosa_tpu.core.holder import Holder
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    f = h.create_index("fe").create_field("ff")
+    view = f.create_view_if_not_exists("standard")
+    frag = view.create_fragment_if_not_exists(0)
+    frag.set_bit(1, 1)
+    v1 = frag.version
+    merged = view.merged_row_ids((0,))
+    assert merged == (1,)
+    # Drop and recreate the fragment with different data (a resize
+    # clean_unowned removes the files too).
+    import os
+    dropped = view.fragments.pop(0)
+    dropped.close()
+    os.unlink(dropped.path)
+    frag2 = view.create_fragment_if_not_exists(0)
+    frag2.set_bit(2, 2)
+    assert frag2.version != v1
+    assert view.merged_row_ids((0,)) == (2,)  # not the stale (1,)
+    h.close()

@@ -167,3 +167,72 @@ def test_live_query_spans_reach_exporter(tmp_path, capture_server):
              for a in by_name["API.Query"]["attributes"]}
     assert attrs.get("index") == "ti"
     holder.close()
+
+
+# ---------------------------------------------------------------------------
+# Head sampling (reference SamplerType/SamplerParam, server/config.go:110-118)
+
+def test_sampler_const_zero_exports_nothing(capture_server):
+    endpoint, captured = capture_server
+    tr = ExportingTracer(endpoint, sampler_type="const", sampler_param=0,
+                         batch_size=1, flush_interval=3600)
+    for _ in range(5):
+        with tr.span("q"):
+            pass
+    tr.flush()
+    assert not captured
+    # Local recording still works for /debug introspection.
+    assert len(tr.finished) == 5
+
+
+def test_sampler_probabilistic_is_deterministic_on_trace_id():
+    tr = ExportingTracer("http://unused", sampler_type="probabilistic",
+                         sampler_param=0.5)
+    from pilosa_tpu.utils.tracing import Span
+    decisions = {}
+    for i in range(64):
+        s = Span("q", trace_id=f"{i:032x}", attrs={})
+        d = tr._sampled(s)
+        # Same trace id -> same decision, on every node.
+        assert tr._sampled(Span("other", trace_id=s.trace_id,
+                                attrs={})) == d
+        decisions[s.trace_id] = d
+    kept = sum(decisions.values())
+    assert 10 < kept < 54  # ~50%, generous bounds
+
+
+def test_sampler_probabilistic_fraction(capture_server):
+    endpoint, captured = capture_server
+    tr = ExportingTracer(endpoint, sampler_type="probabilistic",
+                         sampler_param=0.25, batch_size=10**6,
+                         flush_interval=3600)
+    n = 400
+    for _ in range(n):
+        with tr.span("q"):
+            pass
+    with tr._pending_lock:
+        kept = len(tr._pending)
+    assert 0.1 * n < kept < 0.45 * n  # ~25%, generous bounds
+
+
+def test_sampler_ratelimiting_caps_rate():
+    tr = ExportingTracer("http://unused", sampler_type="ratelimiting",
+                         sampler_param=2.0)
+    from pilosa_tpu.utils.tracing import Span
+    burst = sum(tr._sampled(Span("q", "t" * 32, {})) for _ in range(50))
+    assert burst <= 2  # bucket starts with param tokens, refills slowly
+
+
+def test_sampler_unknown_type_rejected():
+    with pytest.raises(ValueError):
+        ExportingTracer("http://unused", sampler_type="bogus")
+
+
+def test_sampler_config_keys(tmp_path):
+    from pilosa_tpu.utils.config import load_config
+    p = tmp_path / "c.toml"
+    p.write_text('tracing-sampler-type = "probabilistic"\n'
+                 "tracing-sampler-param = 0.01\n")
+    cfg = load_config(str(p))
+    assert cfg.tracing_sampler_type == "probabilistic"
+    assert cfg.tracing_sampler_param == 0.01
