@@ -1,0 +1,140 @@
+"""Membership-kernel probe (VERDICT r5 #2): measure ns/position on the
+real device for each membership form over a fixed-layout positions bank
+shape (R x L u16, ~48-bit sparse filter):
+
+- compare: the [P] x [QCAP] equality fan-out (r4 default, ~1 ns/pos)
+- search:  binary search in the sorted query positions (log2 QCAP)
+- gather:  the filter-bit-table dynamic gather (r4's dense fallback)
+- pallas:  fused compare+rowsum, VMEM-resident query positions
+           (ops/pallas_kernels.pbank_membership_counts)
+
+Timing: salted chains (identical-repeat timing is invalid on this
+backend — docs/perf.md §4b); each iteration XORs a salt derived from
+the previous result into the query positions so no sweep can be CSE'd.
+Prints one JSON line per variant."""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+R = int(os.environ.get("PILOSA_PROBE_ROWS", 4_194_304))  # 4M rows
+L = 48
+QK = 48
+ITERS = [4, 12]  # chain lengths for the slope
+
+
+def main():
+    from pilosa_tpu.utils.benchenv import apply_bench_platform
+    apply_bench_platform()
+    from pilosa_tpu.utils.benchenv import hold_for_tpu
+    hold_for_tpu("membership_probe")
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(3)
+    pos = np.sort(rng.integers(0, 4096, (R, L), dtype=np.uint16), axis=1)
+    q = np.unique(rng.integers(0, 4096, QK * 2, dtype=np.uint16))[:QK]
+    q32 = q.astype(np.int32)
+    positions = R * L
+
+    pos_dev = jnp.asarray(pos)
+    qtop_dev = jnp.asarray(q32)
+    grouped = jnp.asarray(pos.view(np.uint32).reshape(R // 16,
+                                                      16 * (L // 2)))
+    qpad = np.full((8, 128), -1, np.int32)
+    qpad.reshape(-1)[:QK] = q32
+    qpad_dev = jnp.asarray(qpad)
+    # Filter bit table for the gather form: 4096 bits = 128 u32 words.
+    fw = np.zeros(128, np.uint32)
+    for p in q:
+        fw[p >> 5] |= np.uint32(1) << (p & 31)
+    fw_dev = jnp.asarray(fw)
+
+    def counts_compare(p, qt):
+        return (p[..., None].astype(jnp.int32) == qt).any(-1) \
+            .sum(axis=1, dtype=jnp.int32)
+
+    def counts_search(p, qt):
+        idx = jnp.clip(jnp.searchsorted(qt, p.astype(jnp.int32)),
+                       0, QK - 1)
+        return (jnp.take(qt, idx) == p.astype(jnp.int32)) \
+            .sum(axis=1, dtype=jnp.int32)
+
+    def counts_gather(p, _qt):
+        bits = (jnp.take(fw_dev, (p >> 5).astype(jnp.int32),
+                         mode="fill", fill_value=0)
+                >> (p & 31).astype(jnp.uint32)) & jnp.uint32(1)
+        return bits.sum(axis=1, dtype=jnp.int32)
+
+    def run_variant(name, fn, qarg):
+        """Chain K sweeps, salt threaded through the query positions
+        (XOR of a tiny salt keeps them valid i32s; counts feed the next
+        salt so iterations serialize)."""
+        @jax.jit
+        def chain(qt, k):
+            def body(_, carry):
+                qt_c, acc = carry
+                c = fn(pos_dev if name != "pallas" else grouped, qt_c)
+                s = (c[0] & 1).astype(qt_c.dtype)
+                return (qt_c ^ s, acc + c[-1])
+            (_, acc) = jax.lax.fori_loop(
+                0, k, body, (qt, jnp.int32(0)))
+            return acc
+
+        for k in ITERS:  # warm both shapes
+            np.asarray(chain(qarg, k))
+        times = {}
+        for k in ITERS:
+            reps = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(chain(qarg, k))
+                reps.append(time.perf_counter() - t0)
+            times[k] = min(reps)
+        per_iter = (times[ITERS[1]] - times[ITERS[0]]) \
+            / (ITERS[1] - ITERS[0])
+        print(json.dumps({
+            "metric": "pbank_membership_ns_per_position",
+            "variant": name,
+            "value": per_iter / positions * 1e9,
+            "unit": "ns/position",
+            "rows": R, "slots": L, "qk": QK,
+            "per_sweep_s": per_iter,
+        }), flush=True)
+        return per_iter
+
+    results = {}
+    results["compare"] = run_variant("compare", counts_compare, qtop_dev)
+    results["search"] = run_variant("search", counts_search, qtop_dev)
+    results["gather"] = run_variant("gather", counts_gather, qtop_dev)
+
+    from pilosa_tpu.ops import pallas_kernels as pk
+    if pk.available():
+        def counts_pallas(g, qt_pad):
+            return pk.pbank_membership_counts(g, qt_pad, qk=QK)
+        try:
+            results["pallas"] = run_variant("pallas", counts_pallas,
+                                            qpad_dev)
+        except Exception as e:
+            print(json.dumps({"variant": "pallas",
+                              "error": repr(e)[:400]}), flush=True)
+    else:
+        print(json.dumps({"variant": "pallas",
+                          "skipped": "no TPU backend"}), flush=True)
+
+    best = min(results, key=results.get)
+    print(json.dumps({"metric": "pbank_membership_best",
+                      "best": best,
+                      "value": results[best] / positions * 1e9,
+                      "unit": "ns/position",
+                      "speedup_vs_compare":
+                      results["compare"] / results[best]}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Third-stage round-5 watcher: after the main followup (startrace/bsi
+# batch legs) finishes, run the pbank membership-kernel probe
+# (VERDICT r5 #2) at the next tunnel window.
+cd /root/repo
+while pgrep -f "run_r05_followup.sh" > /dev/null; do sleep 60; done
+echo "$(date -u +%H:%M:%S) probe-followup: starting" >&2
+for pass in 1 2; do
+  [ -e benches/.membership_probe_r05_done ] && break
+  timeout 5400 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+      PILOSA_BENCH_HOLD_MAX_S=4500 \
+      python benches/pbank_membership_probe.py \
+      > benches/membership_probe_r05_tpu.jsonl.tmp \
+      2> benches/membership_probe_r05_tpu.err
+  rc=$?
+  echo "$(date -u +%H:%M:%S) probe-followup: rc=$rc" >&2
+  if [ "$rc" -eq 0 ] && grep -q pbank_membership_best \
+      benches/membership_probe_r05_tpu.jsonl.tmp; then
+    mv benches/membership_probe_r05_tpu.jsonl.tmp \
+       benches/membership_probe_r05_tpu.jsonl
+    touch benches/.membership_probe_r05_done
+  else
+    rm -f benches/membership_probe_r05_tpu.jsonl.tmp
+  fi
+done
+echo "$(date -u +%H:%M:%S) probe-followup: done" >&2

@@ -107,6 +107,18 @@ PBANK_ENABLED = os.environ.get("PILOSA_TPU_PBANK", "1") != "0"
 PBANK_SPARSE_FILTER_BITS = int(os.environ.get(
     "PILOSA_TPU_PBANK_SPARSE_BITS", 64))
 
+# Membership form for the sparse-filter pbank kernel: "compare" (the
+# [P] x [QCAP] equality fan-out, the r4 default and measured floor) or
+# "search" (binary search of each position in the sorted filter
+# positions, log2(QCAP) compare-select rounds). Selection is a compile
+# key; benches/pbank_membership_probe.py measures both on hardware.
+PBANK_MEMBERSHIP = os.environ.get("PILOSA_TPU_PBANK_MEMBERSHIP",
+                                  "compare")
+if PBANK_MEMBERSHIP not in ("compare", "search"):
+    raise ValueError(
+        f"PILOSA_TPU_PBANK_MEMBERSHIP={PBANK_MEMBERSHIP!r}: "
+        "must be 'compare' or 'search'")
+
 # Max positions-bank segment programs enqueued before a sync (see
 # _topn_positions): bounds how many programs' workspaces (~2x segment
 # positions x 4 B at the 2^27 default segment size, i.e. ~1.1 GB each)
@@ -1415,7 +1427,7 @@ class Executor:
         import jax
         import jax.numpy as jnp
 
-        key = (k, has_filter, fixed)
+        key = (k, has_filter, fixed, PBANK_MEMBERSHIP)
         fn = cls._PBANK_KERNELS.get(key)
         if fn is not None:
             return fn
@@ -1450,6 +1462,17 @@ class Executor:
             # below still guarantees every set position is captured.
             qk = min(PBANK_SPARSE_FILTER_BITS, int(qpos.shape[0]))
             qtop = -jax.lax.top_k(-qpos, qk)[0]
+            if PBANK_MEMBERSHIP == "search":
+                # qtop is sorted ascending: binary-search each position
+                # in log2(qk) compare-select rounds instead of a qk-wide
+                # compare fan-out (the r4-measured ~1 ns/position floor
+                # is this fan-out; VERDICT r5 #2). Positions are < 2^16
+                # and the 2^30 pad sorts last, so equality at the found
+                # slot is exact membership.
+                idx = jnp.clip(jnp.searchsorted(qtop,
+                                                pos.astype(jnp.int32)),
+                               0, qk - 1)
+                return jnp.take(qtop, idx) == pos.astype(jnp.int32)
             # pos is [P] (flat layout) or [R, L] (fixed layout); the
             # trailing broadcast axis makes membership layout-agnostic.
             return (pos[..., None].astype(jnp.int32) == qtop).any(-1)

@@ -189,6 +189,82 @@ def bank_row_counts_masked(bank, filt, *, interpret: bool = False):
             jnp.sum(raw, axis=(1, 2), dtype=jnp.int32).astype(jnp.uint32))
 
 
+# ---------------------------------------------------------------------------
+# Positions-bank membership (probe stage — VERDICT r5 #2)
+#
+# The tanimoto flagship's warm floor is the sparse-filter membership in
+# the fixed-layout pbank kernel: |row ∧ filter| over [R, L] u16 position
+# rows vs ~48 query positions, measured ~1 ns/position as an XLA
+# [P]x[QCAP] compare fan-out. This kernel fuses compare+rowsum with the
+# query positions VMEM-resident, accumulating through a fori loop so no
+# [P, QCAP] intermediate ever materializes. Layout: u16 positions
+# bitcast to u32 pairs and GROUPED 16 rows per block-row so every Mosaic
+# tile is lane-aligned: in [GB, 16*L2] u32 (L2 = L/2), out (8, 128) i32
+# = 1024 row counts per grid step.
+#
+# Status: correctness-tested in interpret mode; measured on hardware by
+# benches/pbank_membership_probe.py before any production wiring (the
+# r4 bank-sweep Pallas kernels measured SLOWER than XLA fusion, so this
+# ships opt-in until the probe says otherwise).
+
+_MEM_ROWS_BLOCK = 1024  # rows per grid step (= 8*128 out tile)
+_MEM_GROUP = 16         # bank rows packed per block-row
+
+
+def _membership_kernel(qk):
+    def kernel(pos_ref, qtop_ref, out_ref):
+        blk = pos_ref[...]                    # [GB, 16*L2] u32
+        qvals = qtop_ref[...]                 # (8, 128) i32, qk real
+        gb, gl2 = blk.shape
+        l2 = gl2 // _MEM_GROUP
+        pairs = blk.reshape(gb * _MEM_GROUP, l2)
+        lo = (pairs & jnp.uint32(0xFFFF)).astype(jnp.int32)
+        hi = (pairs >> jnp.uint32(16)).astype(jnp.int32)
+        # Static unroll over the query positions: each step is one
+        # VPU-wide compare+or against a scalar held in VMEM — no
+        # [P, QCAP] intermediate, no dynamic indexing.
+        mlo = jnp.zeros(lo.shape, dtype=jnp.bool_)
+        mhi = jnp.zeros(hi.shape, dtype=jnp.bool_)
+        for j in range(qk):
+            q = qvals[j // 128, j % 128]
+            mlo |= lo == q
+            mhi |= hi == q
+        counts = (mlo.astype(jnp.int32) + mhi.astype(jnp.int32)
+                  ).sum(axis=1, dtype=jnp.int32)
+        out_ref[0] = counts.reshape(8, 128)
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("qk", "interpret"))
+def pbank_membership_counts(pos_grouped, qtop_pad, *, qk: int,
+                            interpret: bool = False):
+    """([R/16, 16*L2] u32 grouped position pairs, (8,128) i32 padded
+    query positions, qk = real query count) -> |row ∧ query| i32[R].
+
+    R must be a multiple of 1024 (the fixed layout pads rows anyway);
+    0xFFFF pads match nothing as long as no real position is 0xFFFF
+    (fingerprint positions are < 4096)."""
+    from jax.experimental import pallas as pl
+
+    rg, gl2 = pos_grouped.shape
+    R = rg * _MEM_GROUP
+    assert R % _MEM_ROWS_BLOCK == 0, R
+    gb = _MEM_ROWS_BLOCK // _MEM_GROUP
+    out = pl.pallas_call(
+        _membership_kernel(qk),
+        grid=(R // _MEM_ROWS_BLOCK,),
+        in_specs=[
+            pl.BlockSpec((gb, gl2), lambda r: (r, 0)),
+            pl.BlockSpec((8, 128), lambda r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 8, 128), lambda r: (r, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((R // _MEM_ROWS_BLOCK, 8, 128),
+                                       jnp.int32),
+        interpret=interpret,
+    )(pos_grouped, qtop_pad)
+    return out.reshape(R)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def bsi_plane_counts(planes, mask, *, interpret: bool = False):
     """([D, S, W] bit-planes, [S, W] column mask) -> uint32[D] masked

@@ -626,14 +626,18 @@ class API:
         idx = self._index(index)
         store = idx.column_translator if field is None \
             else self._field(idx, field).row_translator
-        if self.cluster is not None and store.served_limit is None \
+        if self.cluster is not None \
                 and self._translate_primary().id != self.cluster.local.id:
             # Restarted replica that hasn't re-streamed this boot: its
             # disk log may hold out-of-band adopted entries (holes in
             # the id order), which must not be spliced into a chained
             # successor's stream. Serve nothing until our own pull
-            # re-establishes the streamed prefix.
-            store.served_limit = 0
+            # re-establishes the streamed prefix. Check-and-set under
+            # the store lock: a concurrent apply_log(resume) may have
+            # just re-established the prefix and must not be clobbered.
+            with store._lock:
+                if store.served_limit is None:
+                    store.served_limit = 0
         return store.read_log_from(offset)
 
     def recalculate_caches(self) -> None:
@@ -859,6 +863,8 @@ class API:
         typ = msg.get("type")
         if msg.get("translatePrimary"):
             self.cluster.pin_translate_primary(msg["translatePrimary"])
+            if msg["translatePrimary"] == self.cluster.local.id:
+                self._lift_translate_serving()
         if typ == "node-join":
             prev = [Node.from_json(nd) for nd in msg["prev"]] \
                 if msg.get("prev") else None
@@ -948,7 +954,7 @@ class API:
             # intervention, exactly like the reference's unreplicated
             # TranslateFile (translate.go:56).
             try:
-                self._sync_translate_stores()
+                self._sync_translate_stores(direct_primary=True)
             except Exception as e:
                 self.logger.printf(
                     "remove-node: translate catch-up from departing "
@@ -960,6 +966,12 @@ class API:
             # allocation between removal and pin would route to the
             # lexically-first fallback, which may lag.
             tp = self.cluster.pin_translate_primary(self.cluster.local.id)
+            # We now SERVE the stream: lift every local store's
+            # replica limit — a promoted primary that kept it would
+            # withhold its out-of-band adopted entries from successors
+            # until the next local allocation (possibly never, on a
+            # read-only cluster).
+            self._lift_translate_serving()
         self.cluster.remove_node(node_id)
         for peer in self.cluster.nodes():
             if peer.id == self.cluster.local.id:
@@ -1046,12 +1058,27 @@ class API:
             return primary
         return prev
 
-    def _sync_translate_stores(self) -> None:
+    def _lift_translate_serving(self) -> None:
+        """This node just became the translate primary: serve the whole
+        id-ordered log (see TranslateStore.served_limit)."""
+        for idx in self.holder.indexes.values():
+            if idx.keys:
+                idx.column_translator.served_limit = None
+            for f in idx.fields.values():
+                if f.options.keys:
+                    f.row_translator.served_limit = None
+
+    def _sync_translate_stores(self, direct_primary: bool = False) -> None:
+        """`direct_primary=True` bypasses the chain and pulls straight
+        from the primary — the pre-promotion catch-up must be complete
+        NOW, not one-chain-hop-per-interval eventually (a successful
+        pull from a lagging predecessor would otherwise satisfy it and
+        the promoted store could mint colliding ids)."""
         from pilosa_tpu.parallel.client import ClientError
         primary = self._translate_primary()
         if primary.id == self.cluster.local.id:
             return
-        source = self._translate_source()
+        source = primary if direct_primary else self._translate_source()
 
         sources = [source] + ([primary] if primary.id != source.id else [])
 

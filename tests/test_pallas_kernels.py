@@ -89,3 +89,64 @@ def test_executor_pallas_path_topn(tmp_path, monkeypatch):
         (got,) = Executor(holder).execute("i", "TopN(f, n=3)")
         assert got.pairs == want.pairs
     holder.close()
+
+
+def test_pbank_membership_counts_matches_numpy():
+    """Fused membership+rowsum (probe-stage, VERDICT r5 #2): grouped
+    u16-pair layout vs a numpy reference, pads excluded."""
+    rng = np.random.default_rng(5)
+    R, L, qk = 2048, 48, 48
+    pos = np.sort(rng.integers(0, 4096, (R, L), dtype=np.uint16), axis=1)
+    # Pad some rows (0xFFFF matches nothing).
+    lens = rng.integers(10, L + 1, R)
+    mask = np.arange(L)[None, :] >= lens[:, None]
+    pos[mask] = 0xFFFF
+    q = np.unique(rng.integers(0, 4096, qk * 2, dtype=np.uint16))[:qk]
+    qtop_pad = np.full((8, 128), -1, np.int32)
+    qtop_pad.reshape(-1)[:len(q)] = q.astype(np.int32)
+    grouped = (pos.view(np.uint32)
+               .reshape(R // 16, 16 * (L // 2)))
+    got = np.asarray(pk.pbank_membership_counts(
+        jnp.asarray(grouped), jnp.asarray(qtop_pad), qk=len(q),
+        interpret=True))
+    qset = set(int(x) for x in q)
+    want = np.array([sum(1 for p in row if int(p) in qset and p != 0xFFFF)
+                     for row in pos], np.int32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_pbank_search_membership_matches_compare(tmp_path, monkeypatch):
+    """The searchsorted membership form answers identically to the
+    compare form through the full executor tanimoto path."""
+    import os
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import executor as executor_mod
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+    def build(d):
+        h = Holder(d)
+        h.open()
+        idx = h.create_index("m")
+        f = idx.create_field("fp", FieldOptions(max_columns=512))
+        view = f.create_view_if_not_exists("standard")
+        frag = view.create_fragment_if_not_exists(0)
+        rng = np.random.default_rng(9)
+        cpr = SHARD_WIDTH // 65536
+        for i in range(3000):
+            frag.storage.containers[i * cpr] = np.unique(
+                rng.integers(0, 512, 24, dtype=np.uint16))
+            frag._touch_row(i)
+        return h
+
+    monkeypatch.setattr(executor_mod, "TOPN_MAX_BANK_BYTES", 1)
+    q = ("TopN(fp, Row(fp=7), n=20, tanimotoThreshold=30)")
+    h1 = build(str(tmp_path / "a"))
+    (want,) = Executor(h1).execute("m", q)
+    h1.close()
+    monkeypatch.setattr(executor_mod, "PBANK_MEMBERSHIP", "search")
+    h2 = build(str(tmp_path / "b"))
+    (got,) = Executor(h2).execute("m", q)
+    h2.close()
+    assert got.pairs == want.pairs and want.pairs
