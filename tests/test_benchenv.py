@@ -190,3 +190,37 @@ def test_trivial_probe_compiles_once():
     assert probe is not None
     benchenv.trivial_fetch_ms(samples=1)
     assert benchenv._trivial_probe is probe
+
+
+def test_bench_sidecar_carry_tolerates_corrupt_payload(tmp_path,
+                                                       monkeypatch):
+    """A malformed sidecar (zero/absent tpu_s_per_call, wrong JSON
+    shape) must yield carry=None, never an exception — sidecar_carry
+    runs BEFORE the provisional record prints (code-review r5)."""
+    import importlib.util
+    import json as _json
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    side = tmp_path / "side.json"
+    monkeypatch.setattr(bench, "LAST_GOOD_TPU_PATH", str(side))
+    import time as _time
+    for payload in (
+        {"measured_at_unix": _time.time(),
+         "payload": {"tpu_s_per_call": 0}},           # zero divisor
+        {"measured_at_unix": _time.time(), "payload": {}},  # absent
+        {"payload": None},                             # wrong shape
+        "not a dict",
+    ):
+        side.write_text(_json.dumps(payload))
+        assert bench.sidecar_carry(1e9, 1 << 30) is None
+    side.write_text("{garbage")
+    assert bench.sidecar_carry(1e9, 1 << 30) is None
+    # A healthy sidecar still carries.
+    side.write_text(_json.dumps({
+        "measured_at_unix": _time.time(), "bits": 1 << 30,
+        "payload": {"tpu_s_per_call": 0.5}}))
+    got = bench.sidecar_carry(1e9, 1 << 30)
+    assert got is not None and got["value"] == (1 << 30) / 0.5
