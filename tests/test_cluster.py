@@ -1642,3 +1642,31 @@ def test_fragment_version_epoch_unique_across_recreate(tmp_path):
     assert frag2.version != v1
     assert view.merged_row_ids((0,)) == (2,)  # not the stale (1,)
     h.close()
+
+
+def test_batch_query_cluster_path(tmp_path):
+    """/batch/query on a clustered node: items execute via the fan-out
+    executor, per-item errors isolate, HTTP round trip amortized."""
+    nodes = run_cluster(tmp_path, 2)
+    try:
+        req(nodes[0].uri, "POST", "/index/bq", {"options": {}})
+        req(nodes[0].uri, "POST", "/index/bq/field/f", {"options": {}})
+        req(nodes[0].uri, "POST", "/index/bq/query",
+            b"Set(1, f=6) Set(" + str(SHARD_WIDTH + 2).encode() + b", f=6)")
+        res = req(nodes[0].uri, "POST", "/batch/query", {"queries": [
+            {"index": "bq", "query": "Count(Row(f=6))"},
+            {"index": "bq", "query": "Row(f=6)"},
+            {"index": "nope", "query": "Count(Row(f=6))"},
+            {"index": "bq"},
+        ]})
+        out = res["responses"]
+        assert out[0] == {"results": [2]}
+        assert out[1]["results"][0]["columns"] == [1, SHARD_WIDTH + 2]
+        assert "error" in out[2] and "error" in out[3]
+        # Identical answers through the other node (its own fan-out).
+        res2 = req(nodes[1].uri, "POST", "/batch/query", {"queries": [
+            {"index": "bq", "query": "Count(Row(f=6))"}]})
+        assert res2["responses"][0] == {"results": [2]}
+    finally:
+        for nd in nodes:
+            nd.stop()
