@@ -319,3 +319,26 @@ def test_fallback_reports_furthest_error():
     not the generic attempt's confusion at the positional col."""
     with pytest.raises(ValueError, match="escape"):
         parse_string('Set(1, f="\\q")')
+
+
+# ---------------------------------------------------------------------------
+# ast_test.go (:25-69) — serialization + condition values. The exact
+# String() format intentionally differs (docs/parity.md); the pinned
+# property is the ROUND TRIP: to_pql output re-parses to the same AST.
+
+def test_call_to_pql_round_trips():
+    for src in ("Bitmap()",
+                "Range(field0 >= 10, other=f)",
+                "Row(4 < a <= 9)",
+                "TopN(f, Row(x=1), n=3, fields=[\"a\", \"b\"])",
+                "GroupBy(Rows(f), filter=Row(a=1))"):
+        q = parse_string(src)
+        again = parse_string(q.calls[0].to_pql())
+        assert again.calls[0] == q.calls[0], src
+
+
+def test_condition_int_slice():
+    assert Condition(BETWEEN, [4, 8]).int_slice() == [4, 8]
+    assert Condition(BETWEEN, [1, 2, 3]).int_slice() == [1, 2, 3]
+    with pytest.raises(ValueError):
+        Condition(BETWEEN, 7).int_slice()
