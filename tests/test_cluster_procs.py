@@ -80,6 +80,7 @@ class ProcCluster:
                 f"cluster_replicas = {REPLICAS}\n"
                 "anti_entropy_interval = 2.0\n"
                 "heartbeat_interval = 1.0\n"
+                "translate_replication_interval = 1.0\n"
                 'metric_service = "none"\n'
                 "metric_poll_interval = 0\n")
 
@@ -306,3 +307,35 @@ def test_pause_and_kill_mid_import(cluster):
                 raise RuntimeError("node2 failed to restart:\n" + log)
             time.sleep(0.5)
     wait_converged(c, c.ports, want, deadline_s=120)
+
+    # --- Keyed translation across real processes: writes through
+    # DIFFERENT nodes (non-primaries adopt allocations out-of-band),
+    # then the chained replication loops converge every node's served
+    # log to a byte-prefix of the primary's (the chain invariant,
+    # cluster.go:1908-1935).
+    _req(c.ports[0], "POST", "/index/tk", {"options": {"keys": True}})
+    _req(c.ports[0], "POST", "/index/tk/field/kf", {})
+    time.sleep(1)  # schema broadcast
+    for i, key in enumerate(("alpha", "beta", "gamma")):
+        _req(c.ports[i], "POST", "/index/tk/query",
+             f"Set('{key}', kf=1)".encode())
+    for port in c.ports:
+        res = _req(port, "POST", "/index/tk/query", b"Count(Row(kf=1))")
+        assert res["results"] == [3], (port, res)
+    import urllib.request as _ur
+    deadline = time.time() + 60
+    while True:
+        logs = []
+        for port in c.ports:
+            with _ur.urlopen(f"http://127.0.0.1:{port}/internal/"
+                             "translate/data?index=tk&offset=0",
+                             timeout=10) as r:
+                logs.append(r.read())
+        full = max(logs, key=len)
+        if all(len(lg) > 0 and full.startswith(lg) for lg in logs) \
+                and sum(len(lg) == len(full) for lg in logs) == len(logs):
+            break
+        if time.time() > deadline:
+            raise AssertionError(
+                f"translate logs did not converge: {[len(x) for x in logs]}")
+        time.sleep(1)
