@@ -1,0 +1,22 @@
+#!/bin/bash
+# Fourth-stage round-5 watcher: after ALL capture stages are done, run
+# the driver's own checks (graft entry + 8-device dryrun, full pytest)
+# so any breakage is known before the round closes. Never contends with
+# a capture leg for the 1-vCPU box.
+cd /root/repo
+while pgrep -f "run_r05_probe_followup.sh" > /dev/null; do sleep 60; done
+echo "$(date -u +%H:%M:%S) postcheck: starting" >&2
+timeout 900 env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python -c "
+import __graft_entry__ as g
+import jax
+fn, args = g.entry()
+jax.jit(fn)(*args)
+print('entry ok')
+g.dryrun_multichip(8)
+print('dryrun_multichip ok')
+" > benches/postcheck_r05.log 2>&1
+echo "graft rc=$?" >> benches/postcheck_r05.log
+timeout 2400 python -m pytest tests/ -x -q >> benches/postcheck_r05.log 2>&1
+echo "pytest rc=$?" >> benches/postcheck_r05.log
+echo "$(date -u +%H:%M:%S) postcheck: done" >&2
