@@ -1,0 +1,69 @@
+#!/bin/bash
+# Fifth-stage round-5 watcher: once the membership probe has landed,
+# turn its ns/position verdict into END-TO-END evidence. If a
+# production-selectable variant ("search") beat the default ("compare")
+# by >10% on-device, run the 10M tanimoto leg with that variant so the
+# default-flip decision rests on the full flagship path, not just the
+# kernel microbenchmark. Probe-only variants (pallas/gather) are
+# reported but cannot drive a leg.
+cd /root/repo
+for up in run_r05_orchestrator.sh run_r05_followup.sh \
+          run_r05_probe_followup.sh; do
+  while pgrep -f "$up" > /dev/null; do sleep 60; done
+done
+[ -e benches/.membership_e2e_r05_done ] && exit 0
+if [ ! -f benches/membership_probe_r05_tpu.jsonl ]; then
+  echo "membership probe never landed; nothing to act on" >&2
+  exit 0
+fi
+VARIANT=$(python - <<'EOF'
+import json
+best = None
+for ln in open("benches/membership_probe_r05_tpu.jsonl"):
+    try:
+        rec = json.loads(ln)
+    except ValueError:
+        continue
+    if rec.get("metric") == "pbank_membership_best":
+        best = rec
+if best and best.get("best") == "search" and \
+        best.get("speedup_vs_compare", 0) > 1.10:
+    print("search")
+EOF
+)
+if [ -z "$VARIANT" ]; then
+  echo "probe verdict: default (compare) stands; no e2e leg needed" >&2
+  touch benches/.membership_e2e_r05_done
+  exit 0
+fi
+echo "$(date -u +%H:%M:%S) membership-followup: e2e leg with $VARIANT" >&2
+for pass in 1 2; do
+  timeout 7200 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+      PILOSA_BENCH_HOLD_MAX_S=5400 PILOSA_TANIMOTO_N=10000000 \
+      PILOSA_TANIMOTO_ITERS=5 "PILOSA_TPU_PBANK_MEMBERSHIP=$VARIANT" \
+      python benches/tanimoto_chunked.py \
+      > "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl.tmp" \
+      2> "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.err"
+  rc=$?
+  echo "$(date -u +%H:%M:%S) membership-followup: rc=$rc" >&2
+  if python - "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl.tmp" <<'EOF'
+import json, sys
+rec = None
+for ln in reversed(open(sys.argv[1]).read().strip().splitlines()):
+    try:
+        rec = json.loads(ln); break
+    except ValueError:
+        continue
+ok = (rec is not None and not rec.get("partial")
+      and rec.get("molecules") == 10000000 and "p50_query_s" in rec)
+sys.exit(0 if ok else 1)
+EOF
+  then
+    mv "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl.tmp" \
+       "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl"
+    touch benches/.membership_e2e_r05_done
+    break
+  fi
+  rm -f "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl.tmp"
+done
+echo "$(date -u +%H:%M:%S) membership-followup: done" >&2

@@ -4,7 +4,13 @@
 # so any breakage is known before the round closes. Never contends with
 # a capture leg for the 1-vCPU box.
 cd /root/repo
-while pgrep -f "run_r05_probe_followup.sh" > /dev/null; do sleep 60; done
+# Wait on EVERY upstream stage, not just the last: a crashed
+# intermediate watcher must not release this stage into contention
+# with a still-running capture leg.
+for up in run_r05_orchestrator.sh run_r05_followup.sh \
+          run_r05_probe_followup.sh run_r05_membership_followup.sh; do
+  while pgrep -f "$up" > /dev/null; do sleep 60; done
+done
 echo "$(date -u +%H:%M:%S) postcheck: starting" >&2
 timeout 900 env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
     JAX_PLATFORMS=cpu python -c "
