@@ -108,16 +108,18 @@ PBANK_SPARSE_FILTER_BITS = int(os.environ.get(
     "PILOSA_TPU_PBANK_SPARSE_BITS", 64))
 
 # Membership form for the sparse-filter pbank kernel: "compare" (the
-# [P] x [QCAP] equality fan-out, the r4 default and measured floor) or
-# "search" (binary search of each position in the sorted filter
-# positions, log2(QCAP) compare-select rounds). Selection is a compile
-# key; benches/pbank_membership_probe.py measures both on hardware.
-PBANK_MEMBERSHIP = os.environ.get("PILOSA_TPU_PBANK_MEMBERSHIP",
-                                  "compare")
-if PBANK_MEMBERSHIP not in ("compare", "search"):
+# [P] x [QCAP] equality fan-out, the r4 default and measured floor on
+# the v5e VPU), "search" (binary search in the sorted filter positions,
+# log2(QCAP) compare-select rounds), or "auto" (default): search on the
+# XLA CPU backend — measured 1.33x warmer p50 and 7.7x faster cold
+# compile at 1M molecules (docs/round5-notes.md §3) — compare on
+# devices until benches/pbank_membership_probe.py proves otherwise.
+# Selection is a compile key, resolved per backend at kernel build.
+PBANK_MEMBERSHIP = os.environ.get("PILOSA_TPU_PBANK_MEMBERSHIP", "auto")
+if PBANK_MEMBERSHIP not in ("auto", "compare", "search"):
     raise ValueError(
         f"PILOSA_TPU_PBANK_MEMBERSHIP={PBANK_MEMBERSHIP!r}: "
-        "must be 'compare' or 'search'")
+        "must be 'auto', 'compare', or 'search'")
 
 # Max positions-bank segment programs enqueued before a sync (see
 # _topn_positions): bounds how many programs' workspaces (~2x segment
@@ -1427,7 +1429,12 @@ class Executor:
         import jax
         import jax.numpy as jnp
 
-        key = (k, has_filter, fixed, PBANK_MEMBERSHIP)
+        membership = PBANK_MEMBERSHIP
+        if membership == "auto":
+            membership = ("search"
+                          if jax.devices()[0].platform == "cpu"
+                          else "compare")
+        key = (k, has_filter, fixed, membership)
         fn = cls._PBANK_KERNELS.get(key)
         if fn is not None:
             return fn
@@ -1462,7 +1469,7 @@ class Executor:
             # below still guarantees every set position is captured.
             qk = min(PBANK_SPARSE_FILTER_BITS, int(qpos.shape[0]))
             qtop = -jax.lax.top_k(-qpos, qk)[0]
-            if PBANK_MEMBERSHIP == "search":
+            if membership == "search":
                 # qtop is sorted ascending: binary-search each position
                 # in log2(qk) compare-select rounds instead of a qk-wide
                 # compare fan-out (the r4-measured ~1 ns/position floor

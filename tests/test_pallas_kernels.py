@@ -142,6 +142,10 @@ def test_pbank_search_membership_matches_compare(tmp_path, monkeypatch):
 
     monkeypatch.setattr(executor_mod, "TOPN_MAX_BANK_BYTES", 1)
     q = ("TopN(fp, Row(fp=7), n=20, tanimotoThreshold=30)")
+    # Pin the baseline to "compare": the module default is "auto",
+    # which resolves to "search" on the CPU test mesh — without the
+    # pin this test would compare search against itself.
+    monkeypatch.setattr(executor_mod, "PBANK_MEMBERSHIP", "compare")
     h1 = build(str(tmp_path / "a"))
     (want,) = Executor(h1).execute("m", q)
     h1.close()
@@ -150,3 +154,40 @@ def test_pbank_search_membership_matches_compare(tmp_path, monkeypatch):
     (got,) = Executor(h2).execute("m", q)
     h2.close()
     assert got.pairs == want.pairs and want.pairs
+
+
+def test_pbank_membership_auto_resolves_per_backend(tmp_path,
+                                                    monkeypatch):
+    """'auto' (the default) must resolve to 'search' on the XLA CPU
+    backend (measured 1.33x warm / 7.7x faster cold at 1M molecules,
+    docs/round5-notes.md §3) and be cached under the RESOLVED name, so
+    an explicit-'search' run shares the same compiled kernel."""
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.core.field import FieldOptions
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import executor as executor_mod
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+    assert jax.devices()[0].platform == "cpu"  # test mesh is CPU-forced
+    monkeypatch.setattr(executor_mod, "PBANK_MEMBERSHIP", "auto")
+    monkeypatch.setattr(executor_mod, "TOPN_MAX_BANK_BYTES", 1)
+    monkeypatch.setattr(executor_mod.Executor, "_PBANK_KERNELS", {})
+    h = Holder(str(tmp_path / "auto"))
+    h.open()
+    idx = h.create_index("m")
+    f = idx.create_field("fp", FieldOptions(max_columns=512))
+    view = f.create_view_if_not_exists("standard")
+    frag = view.create_fragment_if_not_exists(0)
+    rng = np.random.default_rng(11)
+    cpr = SHARD_WIDTH // 65536
+    for i in range(512):
+        frag.storage.containers[i * cpr] = np.unique(
+            rng.integers(0, 512, 24, dtype=np.uint16))
+        frag._touch_row(i)
+    (res,) = Executor(h).execute(
+        "m", "TopN(fp, Row(fp=3), n=5, tanimotoThreshold=20)")
+    h.close()
+    assert res.pairs
+    forms = {key[3] for key in executor_mod.Executor._PBANK_KERNELS}
+    assert "search" in forms
+    assert "auto" not in forms
