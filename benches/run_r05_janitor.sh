@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-5 janitor: the driver runs the OFFICIAL bench.py at round end
+# (~12h after round start). Any still-holding capture watcher would
+# contend with it for the tunnel window and the 1-vCPU box — worse
+# than losing the remaining legs. Wind the whole chain down at the
+# deadline (default: 11:50 UTC, ~75 min before the expected driver
+# bench) unless it finished on its own.
+cd /root/repo
+DEADLINE_UTC=${1:-"11:50"}
+while :; do
+  now=$(date -u +%H:%M)
+  [ "$now" \> "$DEADLINE_UTC" ] && break
+  pgrep -f "run_r05_orchestrator.sh|run_r05_followup.sh|run_r05_probe_followup.sh|run_r05_membership_followup.sh" \
+      > /dev/null || exit 0   # chain finished by itself
+  sleep 120
+done
+echo "$(date -u +%H:%M:%S) janitor: deadline passed, winding down" >&2
+pkill -f run_r05_orchestrator.sh
+pkill -f run_r05_followup.sh
+pkill -f run_r05_probe_followup.sh
+pkill -f run_r05_membership_followup.sh
+sleep 2
+# Kill leg payloads (python benches) still holding for a window; their
+# partial-record handlers write what they have. The postcheck stage is
+# left alone — it only runs when everything above is gone.
+pkill -f "benches/tanimoto_chunked.py"
+pkill -f "benches/startrace.py"
+pkill -f "benches/bsi.py"
+pkill -f "benches/pbank_membership_probe.py"
+pkill -f "python bench.py"
+echo "$(date -u +%H:%M:%S) janitor: done" >&2
