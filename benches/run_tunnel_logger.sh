@@ -1,0 +1,27 @@
+#!/bin/bash
+# Tunnel-state logger: one timestamped line per state TRANSITION (and a
+# heartbeat every ~30 min) in benches/tunnel_state_r05.log, probing via
+# benchenv.probe_device_once (subprocess-isolated, bounded). Cheap
+# enough to run for the whole round; the log is the round's tunnel
+# uptime evidence.
+cd /root/repo
+LOG=benches/tunnel_state_r05.log
+last=""
+beats=0
+while :; do
+  if timeout 100 python -c "
+from pilosa_tpu.utils.benchenv import probe_device_once
+import sys
+ok, _ = probe_device_once(80)
+sys.exit(0 if ok else 1)" 2>/dev/null; then
+    state=up
+  else
+    state=down
+  fi
+  beats=$((beats + 1))
+  if [ "$state" != "$last" ] || [ $((beats % 10)) -eq 0 ]; then
+    echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) $state" >> "$LOG"
+    last=$state
+  fi
+  sleep 180
+done
