@@ -23,5 +23,12 @@ sys.exit(0 if ok else 1)" 2>/dev/null; then
     echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) $state" >> "$LOG"
     last=$state
   fi
-  sleep 180
+  # Each probe costs ~5 s of host CPU (a jax import). While a capture
+  # leg is alive, back off hard so a probe can't land inside a timed
+  # query on this 1-vCPU box; the leg's own hold logs the down state.
+  if pgrep -f "benches/tanimoto_chunked.py|benches/startrace.py|benches/bsi.py|benches/pbank_membership_probe.py|python bench.py" >/dev/null; then
+    sleep 900
+  else
+    sleep 180
+  fi
 done
