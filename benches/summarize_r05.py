@@ -1,0 +1,73 @@
+"""Round-5 capture integrator: print a markdown-ready summary of every
+landed r05 record (benches/*_r05_tpu.jsonl, BENCH_early_r05.json) with
+the context fields that matter (p50, vs_baseline, batch amortization,
+measurement context). Read-only; safe to run any time."""
+
+import glob
+import json
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def last_record(path):
+    rec = None
+    try:
+        for ln in open(path).read().strip().splitlines():
+            try:
+                c = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(c, dict) and ("value" in c or "metric" in c):
+                rec = c
+    except OSError:
+        pass
+    return rec
+
+
+def fmt(v, nd=3):
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}g}"
+    return str(v)
+
+
+def main():
+    rows = []
+    for path in sorted(glob.glob(os.path.join(HERE, "*_r05_tpu.jsonl"))):
+        rec = last_record(path)
+        if rec is None:
+            continue
+        name = os.path.basename(path).replace("_r05_tpu.jsonl", "")
+        rows.append((name, rec))
+    for extra in ("membership_probe_r05_tpu.jsonl",):
+        pass  # covered by the glob
+    bench = last_record(os.path.join(HERE, os.pardir,
+                                     "BENCH_early_r05.json"))
+    if bench is not None:
+        rows.append(("bench.py (live)", bench))
+
+    if not rows:
+        print("no r05 device records landed yet")
+        return
+    print("| leg | metric | value | unit | vs_baseline | p50 | batch/ctx |")
+    print("|---|---|---|---|---|---|---|")
+    for name, r in rows:
+        ctx = []
+        if "batch_vs_baseline" in r:
+            ctx.append(f"batch {r.get('batch_requests') or r.get('batch_calls')}: "
+                       f"{fmt(r['batch_vs_baseline'])}x")
+        if "trivial_fetch_ms" in r:
+            ctx.append(f"fetch {fmt(r['trivial_fetch_ms'])}ms")
+        if "backend" in r:
+            ctx.append(r["backend"])
+        if r.get("partial"):
+            ctx.append("PARTIAL")
+        print(f"| {name} | {r.get('metric', '-')} | {fmt(r.get('value'))} "
+              f"| {r.get('unit', '-')} | {fmt(r.get('vs_baseline'))} "
+              f"| {fmt(r.get('p50_query_s'))} | {'; '.join(ctx) or '-'} |")
+
+
+if __name__ == "__main__":
+    main()
