@@ -161,3 +161,16 @@ def test_http_batch_route(live_server):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(r)
     assert ei.value.code == 400
+    # over the documented cap (1024): rejected whole, nothing executes
+    big = {"queries": [{"index": "hb", "query": "Count(Row(f=9))"}] * 1025}
+    r = urllib.request.Request(base + "/batch/query",
+                               data=json.dumps(big).encode(),
+                               method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(r)
+    assert ei.value.code == 400
+    # exactly at the cap passes validation
+    st, res = post("/batch/query", {"queries": [
+        {"index": "hb", "query": "Count(Row(f=9))"}] * 1024})
+    assert st == 200 and len(res["responses"]) == 1024
+    assert all(r == {"results": [1]} for r in res["responses"])
