@@ -1,0 +1,206 @@
+#!/bin/bash
+# Round-5 LIVE capture chain (container-restart recovery). The original
+# five-watcher chain died with the container restart at 08:28 UTC on
+# 2026-08-01; this single sequential chain replaces it, re-ordered
+# QUICK-FIRST because the tunnel was observed UP at 08:29 and windows
+# have historically been short (~20 min to ~1 h):
+#   1. live bench.py          (official headline; 3 rounds of cpu-fallback)
+#   2. membership probe       (ns/position verdict for the default flip)
+#   3. 10M tanimoto           (final-kernel flagship record)
+#   4. startrace batch leg    (VERDICT #3: batch>=16 through the tunnel)
+#   5. bsi batch leg          (same)
+#   6. 10M with 'search' variant iff the probe says search wins >10%
+#   7. 100M tanimoto          (long build; holds at the query boundary)
+# Quick legs hold only ~25 min for a window so a mid-chain outage cannot
+# starve later legs; the 100M leg holds 3 h as before. Promotion judges
+# each leg by ITS OWN .tmp artifact (advisor r4 #1); markers only on
+# promotion. Re-runnable: done markers skip landed legs.
+cd /root/repo
+log() { echo "$(date -u +%H:%M:%S) live-chain: $*" >&2; }
+
+promote_tanimoto() {  # $1=tmp $2=final $3=marker $4=want_n
+  python - "$1" "$2" "$3" "$4" <<'EOF'
+import json, os, sys
+tmp, final, marker, want_n = sys.argv[1:5]
+rec = None
+try:
+    for ln in reversed(open(tmp).read().strip().splitlines()):
+        try:
+            rec = json.loads(ln)
+            break
+        except ValueError:
+            continue
+except OSError:
+    pass
+ok = (rec is not None and not rec.get("partial")
+      and rec.get("molecules") == int(want_n) and "p50_query_s" in rec)
+if ok:
+    with open(final, "w") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    open(marker, "w").close()
+    os.unlink(tmp)
+    print("promoted:", rec.get("p50_query_s"))
+sys.exit(0 if ok else 1)
+EOF
+}
+
+promote_value() {  # $1=tmp $2=final $3=marker  (generic "value" record)
+  python - "$1" "$2" "$3" <<'EOF'
+import json, os, sys
+tmp, final, marker = sys.argv[1:4]
+rec = None
+try:
+    for ln in reversed(open(tmp).read().strip().splitlines()):
+        try:
+            rec = json.loads(ln)
+            break
+        except ValueError:
+            continue
+except OSError:
+    pass
+ok = rec is not None and not rec.get("partial") and "value" in rec
+if ok:
+    os.replace(tmp, final)
+    open(marker, "w").close()
+sys.exit(0 if ok else 1)
+EOF
+}
+
+# ---- 1. live bench.py -------------------------------------------------
+if [ ! -e benches/.bench_live_r05_done ]; then
+  log "bench.py live"
+  timeout 3600 env PILOSA_BENCH_WAIT_QUIET_S=30 \
+      PILOSA_BENCH_PROBE_HOLD_S=1500 python bench.py \
+      > BENCH_early_r05.json.tmp 2> bench_early_r05.err
+  rc=$?
+  ok=$(python - <<'EOF'
+import json
+rec = None
+try:
+    for ln in reversed(open("BENCH_early_r05.json.tmp").read()
+                       .strip().splitlines()):
+        try:
+            rec = json.loads(ln)
+            break
+        except ValueError:
+            continue
+except OSError:
+    pass
+print(1 if rec and rec.get("backend") != "cpu-fallback"
+      and not rec.get("provisional") and "value" in rec else 0)
+EOF
+)
+  log "bench.py rc=$rc ok=$ok"
+  if [ "$rc" -eq 0 ] && [ "$ok" = "1" ]; then
+    mv BENCH_early_r05.json.tmp BENCH_early_r05.json
+    touch benches/.bench_live_r05_done
+    log "live TPU bench record landed"
+  else
+    rm -f BENCH_early_r05.json.tmp
+  fi
+fi
+
+# ---- 2. membership probe ---------------------------------------------
+if [ ! -e benches/.membership_probe_r05_done ]; then
+  log "membership probe"
+  timeout 2400 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+      PILOSA_BENCH_HOLD_MAX_S=1500 \
+      python benches/pbank_membership_probe.py \
+      > benches/membership_probe_r05_tpu.jsonl.tmp \
+      2> benches/membership_probe_r05_tpu.err
+  rc=$?
+  log "membership probe rc=$rc"
+  if [ "$rc" -eq 0 ] && grep -q pbank_membership_best \
+      benches/membership_probe_r05_tpu.jsonl.tmp; then
+    mv benches/membership_probe_r05_tpu.jsonl.tmp \
+       benches/membership_probe_r05_tpu.jsonl
+    touch benches/.membership_probe_r05_done
+  else
+    rm -f benches/membership_probe_r05_tpu.jsonl.tmp
+  fi
+fi
+
+# ---- 3. 10M tanimoto (final kernel, auto membership) ------------------
+if [ ! -e benches/.tanimoto_chunked_10m_r05_done ]; then
+  log "10M tanimoto"
+  timeout 4500 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+      PILOSA_BENCH_HOLD_MAX_S=1500 PILOSA_TANIMOTO_N=10000000 \
+      PILOSA_TANIMOTO_ITERS=5 python benches/tanimoto_chunked.py \
+      > benches/tanimoto_chunked_10m_r05_tpu.jsonl.tmp \
+      2> benches/tanimoto_chunked_10m_r05_tpu.err
+  log "10M rc=$?"
+  promote_tanimoto benches/tanimoto_chunked_10m_r05_tpu.jsonl.tmp \
+      benches/tanimoto_chunked_10m_r05_tpu.jsonl \
+      benches/.tanimoto_chunked_10m_r05_done 10000000 >&2
+  rm -f benches/tanimoto_chunked_10m_r05_tpu.jsonl.tmp
+fi
+
+# ---- 4+5. startrace / bsi batch legs ---------------------------------
+for leg in startrace bsi; do
+  if [ ! -e "benches/.${leg}_r05_done" ]; then
+    log "$leg batch leg"
+    timeout 2700 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+        PILOSA_BENCH_HOLD_MAX_S=1500 python "benches/${leg}.py" \
+        > "benches/${leg}_r05_tpu.jsonl.tmp" \
+        2> "benches/${leg}_r05_tpu.err"
+    log "$leg rc=$?"
+    promote_value "benches/${leg}_r05_tpu.jsonl.tmp" \
+        "benches/${leg}_r05_tpu.jsonl" "benches/.${leg}_r05_done" >&2 \
+      || rm -f "benches/${leg}_r05_tpu.jsonl.tmp"
+  fi
+done
+
+# ---- 6. membership e2e leg (only if probe picked search) --------------
+if [ -f benches/membership_probe_r05_tpu.jsonl ] && \
+   [ ! -e benches/.membership_e2e_r05_done ]; then
+  VARIANT=$(python - <<'EOF'
+import json
+best = None
+for ln in open("benches/membership_probe_r05_tpu.jsonl"):
+    try:
+        rec = json.loads(ln)
+    except ValueError:
+        continue
+    if rec.get("metric") == "pbank_membership_best":
+        best = rec
+if best and best.get("best") == "search" and \
+        best.get("speedup_vs_compare", 0) > 1.10:
+    print("search")
+EOF
+)
+  if [ -n "$VARIANT" ]; then
+    log "membership e2e leg with $VARIANT"
+    timeout 4500 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+        PILOSA_BENCH_HOLD_MAX_S=1500 PILOSA_TANIMOTO_N=10000000 \
+        PILOSA_TANIMOTO_ITERS=5 "PILOSA_TPU_PBANK_MEMBERSHIP=$VARIANT" \
+        python benches/tanimoto_chunked.py \
+        > "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl.tmp" \
+        2> "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.err"
+    log "membership e2e rc=$?"
+    promote_tanimoto \
+        "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl.tmp" \
+        "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl" \
+        benches/.membership_e2e_r05_done 10000000 >&2
+    rm -f "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl.tmp"
+  else
+    log "probe verdict: compare stands; no e2e leg"
+    touch benches/.membership_e2e_r05_done
+  fi
+fi
+
+# ---- 7. 100M tanimoto (long build, holds at query boundary) -----------
+for pass in 1 2 3; do
+  [ -e benches/.tanimoto_chunked_100m_r05_done ] && break
+  log "100M tanimoto pass $pass"
+  timeout 18000 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+      PILOSA_BENCH_HOLD_MAX_S=10800 PILOSA_TANIMOTO_N=100000000 \
+      PILOSA_TANIMOTO_ITERS=3 python benches/tanimoto_chunked.py \
+      > benches/tanimoto_chunked_100m_r05_tpu.jsonl.tmp \
+      2> benches/tanimoto_chunked_100m_r05_tpu.err
+  log "100M rc=$?"
+  promote_tanimoto benches/tanimoto_chunked_100m_r05_tpu.jsonl.tmp \
+      benches/tanimoto_chunked_100m_r05_tpu.jsonl \
+      benches/.tanimoto_chunked_100m_r05_done 100000000 >&2 && break
+  rm -f benches/tanimoto_chunked_100m_r05_tpu.jsonl.tmp
+done
+log "chain done"
