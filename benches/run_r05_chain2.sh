@@ -1,0 +1,212 @@
+#!/bin/bash
+# Round-5 capture chain, phase 2. Context: the 08:31-08:47 UTC window
+# landed the live bench.py record (the round's #1 item); the tunnel
+# then dropped mid-probe. This chain uses the outage productively:
+#   1. 100M tanimoto NOW — its long host-side build is tunnel-
+#      independent; the leg then holds at the query boundary (3 h) so
+#      WHENEVER the next window opens, the highest-value remaining
+#      capture is already sitting at the device call.
+#   2. Quick legs (membership probe, 10M, startrace, bsi, membership
+#      e2e) in a probe-gated retry loop until the janitor deadline:
+#      a cheap device probe gates each pass so legs only build+run
+#      when the tunnel actually answers; short holds keep a flapping
+#      tunnel from pinning one leg for hours.
+#   3. Postcheck: graft entry + 8-device dryrun + full pytest.
+# Promotion judges each leg by its own artifact; markers only on
+# promotion; re-runnable (markers skip landed legs).
+cd /root/repo
+log() { echo "$(date -u +%H:%M:%S) chain2: $*" >&2; }
+DEADLINE="11:38"
+
+promote_tanimoto() {  # $1=tmp $2=final $3=marker $4=want_n
+  python - "$1" "$2" "$3" "$4" <<'EOF'
+import json, os, sys
+tmp, final, marker, want_n = sys.argv[1:5]
+rec = None
+try:
+    for ln in reversed(open(tmp).read().strip().splitlines()):
+        try:
+            rec = json.loads(ln)
+            break
+        except ValueError:
+            continue
+except OSError:
+    pass
+ok = (rec is not None and not rec.get("partial")
+      and rec.get("molecules") == int(want_n) and "p50_query_s" in rec)
+if ok:
+    with open(final, "w") as fh:
+        fh.write(json.dumps(rec) + "\n")
+    open(marker, "w").close()
+    os.unlink(tmp)
+    print("promoted:", rec.get("p50_query_s"))
+sys.exit(0 if ok else 1)
+EOF
+}
+
+promote_value() {  # $1=tmp $2=final $3=marker
+  python - "$1" "$2" "$3" <<'EOF'
+import json, os, sys
+tmp, final, marker = sys.argv[1:4]
+rec = None
+try:
+    for ln in reversed(open(tmp).read().strip().splitlines()):
+        try:
+            rec = json.loads(ln)
+            break
+        except ValueError:
+            continue
+except OSError:
+    pass
+ok = rec is not None and not rec.get("partial") and "value" in rec
+if ok:
+    os.replace(tmp, final)
+    open(marker, "w").close()
+sys.exit(0 if ok else 1)
+EOF
+}
+
+# ---- 1. 100M tanimoto: build now, hold at the query boundary ----------
+for pass in 1 2; do
+  [ -e benches/.tanimoto_chunked_100m_r05_done ] && break
+  log "100M tanimoto pass $pass (build rides the outage)"
+  timeout 18000 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+      PILOSA_BENCH_HOLD_MAX_S=10800 PILOSA_TANIMOTO_N=100000000 \
+      PILOSA_TANIMOTO_ITERS=3 python benches/tanimoto_chunked.py \
+      > benches/tanimoto_chunked_100m_r05_tpu.jsonl.tmp \
+      2> benches/tanimoto_chunked_100m_r05_tpu.err
+  log "100M rc=$?"
+  promote_tanimoto benches/tanimoto_chunked_100m_r05_tpu.jsonl.tmp \
+      benches/tanimoto_chunked_100m_r05_tpu.jsonl \
+      benches/.tanimoto_chunked_100m_r05_done 100000000 >&2 && break
+  rm -f benches/tanimoto_chunked_100m_r05_tpu.jsonl.tmp
+  now=$(date -u +%H:%M); [ "$now" \> "10:30" ] && break  # no room for pass 2
+done
+
+# ---- 2. probe-gated quick-leg loop -----------------------------------
+tunnel_up() {
+  timeout 100 python -c "
+from pilosa_tpu.utils.benchenv import probe_device_once
+import sys
+ok, _ = probe_device_once(90)
+sys.exit(0 if ok else 1)" 2>/dev/null
+}
+
+all_done() {
+  [ -e benches/.membership_probe_r05_done ] && \
+  [ -e benches/.tanimoto_chunked_10m_r05_done ] && \
+  [ -e benches/.startrace_r05_done ] && \
+  [ -e benches/.bsi_r05_done ] && \
+  [ -e benches/.membership_e2e_r05_done ]
+}
+
+while :; do
+  all_done && { log "all quick legs landed"; break; }
+  now=$(date -u +%H:%M)
+  [ "$now" \> "$DEADLINE" ] && { log "deadline, stopping quick loop"; break; }
+  if ! tunnel_up; then
+    sleep 90
+    continue
+  fi
+  log "tunnel answered; running missing quick legs"
+
+  if [ ! -e benches/.membership_probe_r05_done ]; then
+    log "membership probe"
+    timeout 1800 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+        PILOSA_BENCH_HOLD_MAX_S=300 \
+        python benches/pbank_membership_probe.py \
+        > benches/membership_probe_r05_tpu.jsonl.tmp \
+        2> benches/membership_probe_r05_tpu.err
+    log "probe rc=$?"
+    if grep -q pbank_membership_best \
+        benches/membership_probe_r05_tpu.jsonl.tmp 2>/dev/null; then
+      mv benches/membership_probe_r05_tpu.jsonl.tmp \
+         benches/membership_probe_r05_tpu.jsonl
+      touch benches/.membership_probe_r05_done
+    else
+      rm -f benches/membership_probe_r05_tpu.jsonl.tmp
+    fi
+  fi
+
+  if [ ! -e benches/.tanimoto_chunked_10m_r05_done ]; then
+    log "10M tanimoto"
+    timeout 3600 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+        PILOSA_BENCH_HOLD_MAX_S=600 PILOSA_TANIMOTO_N=10000000 \
+        PILOSA_TANIMOTO_ITERS=5 python benches/tanimoto_chunked.py \
+        > benches/tanimoto_chunked_10m_r05_tpu.jsonl.tmp \
+        2> benches/tanimoto_chunked_10m_r05_tpu.err
+    log "10M rc=$?"
+    promote_tanimoto benches/tanimoto_chunked_10m_r05_tpu.jsonl.tmp \
+        benches/tanimoto_chunked_10m_r05_tpu.jsonl \
+        benches/.tanimoto_chunked_10m_r05_done 10000000 >&2
+    rm -f benches/tanimoto_chunked_10m_r05_tpu.jsonl.tmp
+  fi
+
+  for leg in startrace bsi; do
+    if [ ! -e "benches/.${leg}_r05_done" ]; then
+      log "$leg batch leg"
+      timeout 2400 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+          PILOSA_BENCH_HOLD_MAX_S=600 python "benches/${leg}.py" \
+          > "benches/${leg}_r05_tpu.jsonl.tmp" \
+          2> "benches/${leg}_r05_tpu.err"
+      log "$leg rc=$?"
+      promote_value "benches/${leg}_r05_tpu.jsonl.tmp" \
+          "benches/${leg}_r05_tpu.jsonl" "benches/.${leg}_r05_done" >&2 \
+        || rm -f "benches/${leg}_r05_tpu.jsonl.tmp"
+    fi
+  done
+
+  if [ -f benches/membership_probe_r05_tpu.jsonl ] && \
+     [ ! -e benches/.membership_e2e_r05_done ]; then
+    VARIANT=$(python - <<'EOF'
+import json
+best = None
+for ln in open("benches/membership_probe_r05_tpu.jsonl"):
+    try:
+        rec = json.loads(ln)
+    except ValueError:
+        continue
+    if rec.get("metric") == "pbank_membership_best":
+        best = rec
+if best and best.get("best") == "search" and \
+        best.get("speedup_vs_compare", 0) > 1.10:
+    print("search")
+EOF
+)
+    if [ -n "$VARIANT" ]; then
+      log "membership e2e leg with $VARIANT"
+      timeout 3600 env PILOSA_BENCH_HOLD_FOR_TPU=1 \
+          PILOSA_BENCH_HOLD_MAX_S=600 PILOSA_TANIMOTO_N=10000000 \
+          PILOSA_TANIMOTO_ITERS=5 "PILOSA_TPU_PBANK_MEMBERSHIP=$VARIANT" \
+          python benches/tanimoto_chunked.py \
+          > "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl.tmp" \
+          2> "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.err"
+      log "membership e2e rc=$?"
+      promote_tanimoto \
+          "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl.tmp" \
+          "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl" \
+          benches/.membership_e2e_r05_done 10000000 >&2
+      rm -f "benches/tanimoto_chunked_10m_${VARIANT}_r05_tpu.jsonl.tmp"
+    else
+      log "probe verdict: compare stands; no e2e leg"
+      touch benches/.membership_e2e_r05_done
+    fi
+  fi
+done
+
+# ---- 3. postcheck -----------------------------------------------------
+log "postcheck: graft entry + dryrun + pytest"
+timeout 900 env XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python -c "
+import __graft_entry__ as g
+import jax
+fn, args = g.entry()
+jax.jit(fn)(*args)
+print('entry ok')
+g.dryrun_multichip(8)
+print('dryrun_multichip ok')
+" > benches/postcheck_r05.log 2>&1
+echo "graft rc=$?" >> benches/postcheck_r05.log
+timeout 2400 python -m pytest tests/ -x -q >> benches/postcheck_r05.log 2>&1
+echo "pytest rc=$?" >> benches/postcheck_r05.log
+log "chain2 done"

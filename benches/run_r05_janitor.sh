@@ -10,7 +10,7 @@ DEADLINE_UTC=${1:-"11:50"}
 while :; do
   now=$(date -u +%H:%M)
   [ "$now" \> "$DEADLINE_UTC" ] && break
-  pgrep -f "run_r05_orchestrator.sh|run_r05_followup.sh|run_r05_probe_followup.sh|run_r05_membership_followup.sh|run_r05_live_chain.sh" \
+  pgrep -f "run_r05_orchestrator.sh|run_r05_followup.sh|run_r05_probe_followup.sh|run_r05_membership_followup.sh|run_r05_live_chain.sh|run_r05_chain2.sh" \
       > /dev/null || exit 0   # chain finished by itself
   sleep 120
 done
@@ -20,6 +20,7 @@ pkill -f run_r05_followup.sh
 pkill -f run_r05_probe_followup.sh
 pkill -f run_r05_membership_followup.sh
 pkill -f run_r05_live_chain.sh
+pkill -f run_r05_chain2.sh
 sleep 2
 # Kill leg payloads (python benches) still holding for a window; their
 # partial-record handlers write what they have. The postcheck stage is
