@@ -16,7 +16,8 @@
 # promotion; re-runnable (markers skip landed legs).
 cd /root/repo
 log() { echo "$(date -u +%H:%M:%S) chain2: $*" >&2; }
-DEADLINE="11:38"
+DEADLINE="${1:-11:38}"       # quick-leg loop stops after this (UTC HH:MM)
+PASS2_CUTOFF="${2:-10:30}"   # no 100M pass 2 after this
 
 promote_tanimoto() {  # $1=tmp $2=final $3=marker $4=want_n
   python - "$1" "$2" "$3" "$4" <<'EOF'
@@ -80,7 +81,7 @@ for pass in 1 2; do
       benches/tanimoto_chunked_100m_r05_tpu.jsonl \
       benches/.tanimoto_chunked_100m_r05_done 100000000 >&2 && break
   rm -f benches/tanimoto_chunked_100m_r05_tpu.jsonl.tmp
-  now=$(date -u +%H:%M); [ "$now" \> "10:30" ] && break  # no room for pass 2
+  now=$(date -u +%H:%M); [ "$now" \> "$PASS2_CUTOFF" ] && break  # no room for pass 2
 done
 
 # ---- 2. probe-gated quick-leg loop -----------------------------------
