@@ -39,8 +39,10 @@ Two timings are reported:
 Metric: bits scanned per second = rows x shards x 2^20 / median latency.
 """
 
+import atexit
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -50,6 +52,55 @@ import numpy as np
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+# Best-known record discipline: stdout carries ONLY JSON records (all
+# probe/progress chatter goes to stderr via log()), the FIRST stdout
+# line is already a complete provisional record, and an atexit/SIGTERM
+# handler re-emits the best-known record — so a driver that kills this
+# process at any point (rc 124 included) still parses a record instead
+# of `parsed:null` (round-5 verdict item 1).
+_BEST_RECORD = None
+_FINAL_EMITTED = False
+
+
+def _write_record_line(rec, terminate_partial=False):
+    """One os.write syscall per record so a signal cannot interleave
+    with a half-buffered print; `terminate_partial` prefixes a newline
+    so a re-emit lands on its own line even if a previous write was cut
+    mid-line (blank lines are skipped by last-JSON-line readers)."""
+    data = (json.dumps(rec) + "\n").encode()
+    if terminate_partial:
+        data = b"\n" + data
+    try:
+        sys.stdout.flush()
+    except Exception:
+        pass
+    os.write(1, data)
+
+
+def emit_record(rec, final=False):
+    global _BEST_RECORD, _FINAL_EMITTED
+    _BEST_RECORD = rec
+    _write_record_line(rec)
+    if final:
+        # Only AFTER the write completes: a SIGTERM mid-write must
+        # still find the safety net armed and re-emit on exit.
+        _FINAL_EMITTED = True
+
+
+def _emit_best_on_exit():
+    if _BEST_RECORD is not None and not _FINAL_EMITTED:
+        try:
+            _write_record_line(_BEST_RECORD, terminate_partial=True)
+        except Exception:
+            pass
+
+
+def _on_sigterm(signum, frame):
+    log("bench: SIGTERM; re-emitting best-known record and exiting")
+    _emit_best_on_exit()
+    os._exit(1)
 
 
 # Child process start, for deadline-aware budgets inside bench_tpu.
@@ -72,13 +123,14 @@ TIMING_BUDGET_S = 90.0  # stop the timing loop early past this (>=2 samples)
 # punctuated by up-windows of ~6 minutes to ~1 hour, so a fixed retry
 # count (rounds 2-4: ~10-25 minutes of probing) systematically missed
 # windows and the official record said "cpu-fallback" three rounds
-# running. The probe now HOLDS for a window: it keeps probing until a
-# deadline (default 3 h, same horizon as benchenv.hold_for_tpu). This
-# is safe even under an impatient driver because a provisional JSON
-# line — carrying any same-round sidecar TPU evidence — is printed
-# BEFORE the hold begins.
+# running. The probe HOLDS for a window, but the default hold is capped
+# at 20 min: the round-5 3 h hold overran the driver's timeout and
+# produced rc:124 records (verdict item 1) — long holds belong to the
+# capture chains (benchenv.hold_for_tpu), which raise it via env. A
+# provisional JSON line — carrying any same-round sidecar TPU
+# evidence — is printed BEFORE the hold begins either way.
 PROBE_TIMEOUT_S = int(os.environ.get("PILOSA_BENCH_PROBE_TIMEOUT_S", 150))
-PROBE_HOLD_S = float(os.environ.get("PILOSA_BENCH_PROBE_HOLD_S", 3 * 3600))
+PROBE_HOLD_S = float(os.environ.get("PILOSA_BENCH_PROBE_HOLD_S", 1200))
 PROBE_SLEEP_S = float(os.environ.get("PILOSA_BENCH_PROBE_SLEEP_S", 45))
 
 # Same-round carry-forward: every successful TPU child run persists its
@@ -388,22 +440,23 @@ def probe_backend():
 
 def sidecar_carry(baseline, bits):
     """The `last_measured_tpu` payload from the same-round sidecar, or
-    None if absent/stale. Used by both the provisional record (printed
-    before the probe hold, in case the driver kills the hold) and the
-    final cpu-fallback record."""
+    None if absent/stale. Used by the startup provisional record
+    (baseline=None: no CPU measurement yet, vs_cpu_now omitted), the
+    pre-hold provisional, and the final cpu-fallback record."""
     try:
         with open(LAST_GOOD_TPU_PATH) as fh:
             side = json.load(fh)
         payload = side.get("payload", {})
         age_s = time.time() - side.get("measured_at_unix", 0)
         if payload.get("tpu_s_per_call", 0) > 0 and age_s < 24 * 3600:
+            carried_value = (side.get("bits", bits)
+                             / payload["tpu_s_per_call"])
             return {
                 "measured_at": side.get("measured_at"),
                 "age_s": round(age_s),
-                "value": side.get("bits", bits) /
-                payload["tpu_s_per_call"],
-                "vs_cpu_now": (side.get("bits", bits) /
-                               payload["tpu_s_per_call"]) / baseline,
+                "value": carried_value,
+                **({"vs_cpu_now": carried_value / baseline}
+                   if baseline else {}),
                 **{k: payload[k] for k in
                    ("device_gbps", "device_gbps_min", "device_gbps_max",
                     "roofline_frac", "device_kind", "tpu_timing",
@@ -430,18 +483,39 @@ def main():
         return
     import tempfile
 
+    # Complete provisional record as the FIRST stdout line, before the
+    # holder build, the CPU baseline, and any probing: a driver that
+    # kills this process at ANY later point already holds a parseable
+    # record (value 0.0 marks "no measurement yet"; any same-round
+    # sidecar TPU evidence rides along).
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread
+    atexit.register(_emit_best_on_exit)
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+    bits = N_ROWS * N_SHARDS * SHARD_WIDTH
+    startup = {
+        "metric": "exact_topn_bits_scanned_per_sec", "value": 0.0,
+        "unit": "bits/sec", "vs_baseline": 1.0, "cpu_value": 0.0,
+        "backend": "cpu-fallback", "provisional": True,
+        "error": "provisional record emitted at startup, before any "
+                 "measurement",
+    }
+    carried = sidecar_carry(None, bits)
+    if carried is not None:
+        startup["last_measured_tpu"] = carried
+    emit_record(startup)
+
     with tempfile.TemporaryDirectory() as tmp:
         holder = build_holder(tmp)
         cpu_t, cpu_pairs = bench_cpu(holder)
         holder.close()
-    from pilosa_tpu.ops.bitset import SHARD_WIDTH
-    bits = N_ROWS * N_SHARDS * SHARD_WIDTH
     baseline = bits / cpu_t
 
-    # Provisional line FIRST: if the harness kills this process mid-hold
-    # or mid-TPU run, the output still ends (or begins) with a parseable
-    # record — including any same-round sidecar TPU evidence. The final
-    # line below supersedes it for any last-JSON-line reader.
+    # Upgrade the provisional with the live CPU measurement before the
+    # probe hold / TPU phase; the final line below supersedes it for
+    # any last-JSON-line reader.
     provisional = {
         "metric": "exact_topn_bits_scanned_per_sec", "value": baseline,
         "unit": "bits/sec", "vs_baseline": 1.0, "cpu_value": baseline,
@@ -451,7 +525,7 @@ def main():
     carried = sidecar_carry(baseline, bits)
     if carried is not None:
         provisional["last_measured_tpu"] = carried
-    print(json.dumps(provisional), flush=True)
+    emit_record(provisional)
 
     error = None
     child = None
@@ -572,7 +646,7 @@ def main():
         carried = sidecar_carry(baseline, bits)
         if carried is not None:
             result["last_measured_tpu"] = carried
-    print(json.dumps(result))
+    emit_record(result, final=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,19 @@
+# Sourced helper: UTC HH:MM deadline -> epoch seconds.
+#
+# HH:MM string comparisons wrap at midnight (a chain armed in the
+# evening with a past-midnight deadline never fires until the next
+# day's HH:MM — ADVICE r5), so deadlines are compared as epoch seconds.
+# Disambiguation rule: an HH:MM that passed within the last 6 h reads
+# as an already-expired same-day deadline and stays past (a janitor
+# restarted just after its deadline must wind the chain down NOW; a
+# chain re-armed at 10:45 with cutoff 10:30 must NOT launch the
+# multi-hour leg the cutoff exists to prevent); one that passed longer
+# ago reads as "tomorrow" (arm at 21:00 for an 11:38 deadline, or the
+# evening-arm past-midnight case). HH:MM alone cannot distinguish the
+# two perfectly; 6 h separates every round-5 arming pattern.
+deadline_epoch() {
+  local t
+  t=$(date -u -d "today $1" +%s 2>/dev/null) || t=$(date -u -d "$1" +%s)
+  if [ $(( $(date -u +%s) - t )) -ge 21600 ]; then t=$((t + 86400)); fi
+  echo "$t"
+}

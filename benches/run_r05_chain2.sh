@@ -19,6 +19,12 @@ log() { echo "$(date -u +%H:%M:%S) chain2: $*" >&2; }
 DEADLINE="${1:-11:38}"       # quick-leg loop stops after this (UTC HH:MM)
 PASS2_CUTOFF="${2:-10:30}"   # no 100M pass 2 after this
 
+# Epoch-second deadlines with the shared midnight-wrap rule (ADVICE
+# r5; see benches/deadline_epoch.sh for the 6 h disambiguation).
+. benches/deadline_epoch.sh
+DEADLINE_EPOCH=$(deadline_epoch "$DEADLINE")
+PASS2_CUTOFF_EPOCH=$(deadline_epoch "$PASS2_CUTOFF")
+
 promote_tanimoto() {  # $1=tmp $2=final $3=marker $4=want_n
   python - "$1" "$2" "$3" "$4" <<'EOF'
 import json, os, sys
@@ -81,7 +87,7 @@ for pass in 1 2; do
       benches/tanimoto_chunked_100m_r05_tpu.jsonl \
       benches/.tanimoto_chunked_100m_r05_done 100000000 >&2 && break
   rm -f benches/tanimoto_chunked_100m_r05_tpu.jsonl.tmp
-  now=$(date -u +%H:%M); [ "$now" \> "$PASS2_CUTOFF" ] && break  # no room for pass 2
+  [ "$(date -u +%s)" -ge "$PASS2_CUTOFF_EPOCH" ] && break  # no room for pass 2
 done
 
 # ---- 2. probe-gated quick-leg loop -----------------------------------
@@ -103,8 +109,8 @@ all_done() {
 
 while :; do
   all_done && { log "all quick legs landed"; break; }
-  now=$(date -u +%H:%M)
-  [ "$now" \> "$DEADLINE" ] && { log "deadline, stopping quick loop"; break; }
+  [ "$(date -u +%s)" -ge "$DEADLINE_EPOCH" ] && \
+    { log "deadline, stopping quick loop"; break; }
   if ! tunnel_up; then
     sleep 90
     continue
@@ -118,8 +124,11 @@ while :; do
         python benches/pbank_membership_probe.py \
         > benches/membership_probe_r05_tpu.jsonl.tmp \
         2> benches/membership_probe_r05_tpu.err
-    log "probe rc=$?"
-    if grep -q pbank_membership_best \
+    rc=$?
+    log "probe rc=$rc"
+    # rc gate matches run_r05_live_chain.sh: a timed-out/killed probe
+    # that already emitted the line must not be promoted (ADVICE r5).
+    if [ "$rc" -eq 0 ] && grep -q pbank_membership_best \
         benches/membership_probe_r05_tpu.jsonl.tmp 2>/dev/null; then
       mv benches/membership_probe_r05_tpu.jsonl.tmp \
          benches/membership_probe_r05_tpu.jsonl

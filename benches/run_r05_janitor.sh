@@ -7,9 +7,14 @@
 # bench) unless it finished on its own.
 cd /root/repo
 DEADLINE_UTC=${1:-"11:50"}
+# Epoch-second deadline with the shared midnight-wrap rule (ADVICE
+# r5; see benches/deadline_epoch.sh for the 6 h disambiguation — a
+# janitor restarted just after its deadline winds the chain down
+# immediately, not a day later).
+. benches/deadline_epoch.sh
+DEADLINE_EPOCH=$(deadline_epoch "$DEADLINE_UTC")
 while :; do
-  now=$(date -u +%H:%M)
-  [ "$now" \> "$DEADLINE_UTC" ] && break
+  [ "$(date -u +%s)" -ge "$DEADLINE_EPOCH" ] && break
   pgrep -f "run_r05_orchestrator.sh|run_r05_followup.sh|run_r05_probe_followup.sh|run_r05_membership_followup.sh|run_r05_live_chain.sh|run_r05_chain2.sh" \
       > /dev/null || exit 0   # chain finished by itself
   sleep 120

@@ -30,6 +30,10 @@ def cmd_server(args) -> int:
         "data_dir": args.data_dir, "bind": args.bind,
         "verbose": args.verbose or None,
         "platform": getattr(args, "platform", None),
+        "coalescer_enabled": (False if getattr(args, "no_coalescer",
+                                               False) else None),
+        "coalescer_window_ms": getattr(args, "coalescer_window_ms",
+                                       None),
     })
     if cfg.platform:
         # Must land before the first jax device touch. jax.config (not
@@ -116,6 +120,22 @@ def cmd_server(args) -> int:
     api.logger = logger
     api.long_query_time = cfg.long_query_time
     api.executor.max_writes_per_request = cfg.max_writes_per_request
+    coalescer = None
+    if cfg.coalescer_enabled:
+        # Cross-request query coalescer: concurrent single-query POSTs
+        # share one executor batch (server/coalescer.py). On cluster
+        # deployments the API routes around it, so attaching is safe
+        # either way.
+        from pilosa_tpu.server.coalescer import QueryCoalescer
+        coalescer = QueryCoalescer(
+            api.executor,
+            window_s=cfg.coalescer_window_ms / 1e3,
+            max_batch=cfg.coalescer_max_batch,
+            max_queue=cfg.coalescer_max_queue,
+            deadline_s=cfg.coalescer_deadline_ms / 1e3,
+            stats=stats, tracer=tracer, logger=logger)
+        coalescer.start()
+        api.coalescer = coalescer
     from pilosa_tpu.utils.diagnostics import (
         DiagnosticsCollector, RuntimeMonitor,
     )
@@ -192,10 +212,14 @@ def cmd_server(args) -> int:
         threading.Thread(target=_seed_join, daemon=True,
                          name="seed-join").start()
     logger.printf("pilosa-tpu server: data=%s bind=%s tls=%s mesh=%s "
-                  "cluster=%s", data_dir, cfg.bind,
+                  "cluster=%s coalescer=%s", data_dir, cfg.bind,
                   "on" if cfg.tls_enabled else "off",
                   mesh.mesh.shape if mesh else "single-device",
-                  f"{len(cluster.nodes())} nodes" if cluster else "no")
+                  f"{len(cluster.nodes())} nodes" if cluster else "no",
+                  (f"window={cfg.coalescer_window_ms:g}ms "
+                   f"batch<={cfg.coalescer_max_batch} "
+                   f"queue<={cfg.coalescer_max_queue}")
+                  if coalescer is not None else "off")
     # SIGTERM unwinds like Ctrl-C so the finally below runs the full
     # graceful close (flush caches, close holder) — the reference
     # server likewise traps SIGTERM for shutdown (cmd/pilosa/main.go).
@@ -210,6 +234,11 @@ def cmd_server(args) -> int:
         serve(api, cfg.host, cfg.port,
               ssl_context=cfg.server_ssl_context())
     finally:
+        if coalescer is not None:
+            # Graceful drain first (SIGTERM lands here via the handler
+            # above): admitted requests still execute; new arrivals
+            # degrade to the direct path while the listener unwinds.
+            coalescer.stop()
         if seed_stop is not None:
             seed_stop.set()
         if api.broadcaster is not None:
@@ -568,6 +597,11 @@ def main(argv=None) -> int:
     sp.add_argument("--verbose", action="store_true")
     sp.add_argument("--platform", default=None,
                     help="JAX platform override (e.g. cpu)")
+    sp.add_argument("--no-coalescer", action="store_true",
+                    help="serve every query on the direct path "
+                         "(disable cross-request coalescing)")
+    sp.add_argument("--coalescer-window-ms", type=float, default=None,
+                    help="coalescer batching window in milliseconds")
     sp.set_defaults(fn=cmd_server)
 
     ip = sub.add_parser("import", help="bulk import CSV files")

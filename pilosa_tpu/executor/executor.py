@@ -70,6 +70,21 @@ def write_call_count(query) -> int:
                if _peel_options(c).name in ALL_WRITE_CALLS)
 
 
+def query_is_write(query) -> bool:
+    """True when `query` (PQL string, Call, or Query) contains any write
+    call. Used by the serving-path coalescer to flush its window on
+    write arrival and to disable read-dedup for the flush. A parse error
+    reads as False — the dispatch path reports it per-request."""
+    try:
+        if isinstance(query, str):
+            query = parse_string_cached(query)
+        if isinstance(query, Call):
+            query = Query([query])
+        return write_call_count(query) > 0
+    except Exception:
+        return False
+
+
 def _peel_options(call: "Call") -> "Call":
     while call.name == "Options" and call.children:
         call = call.children[0]
@@ -478,6 +493,26 @@ class Executor:
                 out[j] = (self._finalize_staged(idx, staged), opts)
             except Exception as e:
                 out[j] = e
+        return out
+
+    def execute_batch_shaped(self, requests: Sequence[Tuple[
+            str, Any, Optional[Sequence[int]]]]) -> List[Any]:
+        """execute_batch + per-request JSON shaping: one entry per
+        request, either the shaped {"results": ...} dict or the
+        exception instance for that request. Shared by API.query_batch
+        (the /batch/query route) and the serving-path coalescer — one
+        place owns the shape-or-error contract."""
+        out: List[Any] = []
+        for (index_name, _, _), res in zip(requests,
+                                           self.execute_batch(requests)):
+            if isinstance(res, Exception):
+                out.append(res)
+                continue
+            results, opts = res
+            try:
+                out.append(self.shape_response(index_name, results, opts))
+            except Exception as e:
+                out.append(e)
         return out
 
     def execute_full(self, index_name: str, query,

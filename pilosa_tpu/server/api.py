@@ -82,6 +82,10 @@ class API:
         self.stats = stats or NopStatsClient()
         self.tracer = tracer or NopTracer()
         self.long_query_time = 0.0  # seconds; 0 disables slow-query logs
+        # Serving-path query coalescer (server/coalescer.py), attached
+        # by the server wiring (cli/main.py) or a test harness; None
+        # means every request takes the direct path.
+        self.coalescer = None
         self.cluster_executor = None
         self.syncer = None
         self.resize_puller = None
@@ -221,6 +225,51 @@ class API:
             # Slow-query logging (reference api.LongQueryTime api.go:1048,
             # enforced per request in http/handler.go:300-306).
             dur = _time.perf_counter() - t0
+            # Direct-path latency histogram: the baseline the coalesced
+            # path's coalescer.request timing is compared against.
+            self.stats.timing("query.direct", dur)
+            if self.long_query_time > 0 and dur > self.long_query_time:
+                self.logger.printf("%.3fs SLOW QUERY [%s] %r",
+                                   dur, index, query)
+
+    def query_coalesced(self, index: str, query,
+                        shards: Optional[Sequence[int]] = None,
+                        remote: bool = False) -> Dict[str, Any]:
+        """query() that rides the serving-path coalescer when one is
+        attached and the request is eligible: concurrent single-query
+        HTTP requests share one stacked executor batch (see
+        server/coalescer.py). Degrades to the direct path when the
+        coalescer is absent/stopped, on cluster deployments (the
+        fan-out legs already pipeline per node), and for remote
+        node-to-node legs (different response shaping)."""
+        coal = self.coalescer
+        if (coal is None or not coal.running or remote
+                or self.cluster_executor is not None):
+            return self.query(index, query, shards=shards, remote=remote)
+        from pilosa_tpu.server.coalescer import CoalescerStopped
+        t0 = _time.perf_counter()
+        try:
+            with self.tracer.span("API.QueryCoalesced", index=index):
+                self.stats.count("query", 1)
+                try:
+                    return coal.submit(index, query, shards=shards)
+                except CoalescerStopped:
+                    # Lost the race with coalescer.stop(): serve the
+                    # request directly rather than failing it. (Only
+                    # this sentinel retries — a genuine executor
+                    # RuntimeError must surface, not re-run.) Inline
+                    # direct path, not self._query: "query" was already
+                    # counted above and must not double-count.
+                    t1 = _time.perf_counter()
+                    try:
+                        return self.executor.execute_full(
+                            index, query, shards=shards)
+                    finally:
+                        self.stats.timing(
+                            "query.direct",
+                            _time.perf_counter() - t1)
+        finally:
+            dur = _time.perf_counter() - t0
             if self.long_query_time > 0 and dur > self.long_query_time:
                 self.logger.printf("%.3fs SLOW QUERY [%s] %r",
                                    dur, index, query)
@@ -284,24 +333,17 @@ class API:
                 except (KeyError, TypeError) as e:
                     shaped_err[pos] = {"error": f"bad batch item: {e!r}"}
                     reqs.append(None)
-            batched = self.executor.execute_batch(
+            shaped = self.executor.execute_batch_shaped(
                 [r for r in reqs if r is not None])
             out = []
-            bi = iter(batched)
+            bi = iter(shaped)
             for pos, r in enumerate(reqs):
                 if r is None:
                     out.append(shaped_err[pos])
                     continue
                 res = next(bi)
-                if isinstance(res, Exception):
-                    out.append({"error": str(res)})
-                    continue
-                results, opts = res
-                try:
-                    out.append(self.executor.shape_response(r[0], results,
-                                                            opts))
-                except Exception as e:
-                    out.append({"error": str(e)})
+                out.append({"error": str(res)}
+                           if isinstance(res, Exception) else res)
             dur = _time.perf_counter() - t0
             if self.long_query_time > 0 and dur > self.long_query_time:
                 self.logger.printf("%.3fs SLOW BATCH [%d queries]",

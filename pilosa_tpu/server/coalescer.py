@@ -1,0 +1,401 @@
+"""Cross-request query coalescing: continuous batching for the serving
+path.
+
+The executor already amortizes device dispatch *within* a batch
+(`Executor.execute_batch`'s overlapped drain), but only clients that
+explicitly POST to /batch/query benefit. The north-star workload is
+thousands of *independent* single-query requests, each paying its own
+host->device dispatch and result-fetch round trip. This module sits
+between the HTTP layer and the executor and transparently collects
+concurrent `POST /index/{i}/query` requests into one stacked device
+sweep — the serving-layer analogue of continuous batching in inference
+stacks.
+
+Mechanics:
+- A request thread enqueues its query and blocks on a per-item event.
+- A single dispatcher thread collects items arriving within a short
+  batching window (default ~1.5 ms), flushing early when the batch hits
+  the size cap, a write-containing query arrives, or the device is idle
+  (nothing was in flight when the previous flush finished — waiting
+  would only add latency).
+- The batch runs through `Executor.execute_batch` (one pipelined
+  dispatch-then-drain) with per-request error isolation: one bad query
+  resolves to ITS exception without failing its batchmates, the same
+  contract as /batch/query.
+- Identical read-only queries in one write-free flush execute ONCE and
+  fan the shaped response out to every requester (results are
+  byte-identical by construction).
+
+Robustness pieces a production front door needs:
+- Admission control: a bounded pending queue; past capacity, submit
+  raises CoalescerOverload -> HTTP 429 + Retry-After.
+- Per-request deadlines: an expired request is ejected from the window
+  (its dispatch skipped) and fails with 408 instead of occupying a
+  batch slot.
+- Observability: queue depth, batch occupancy, flush-reason counters,
+  and latency histograms via utils/stats.py; flushes are span-annotated
+  via utils/tracing.py.
+
+Coalescing is semantically invisible: single-item flushes run the exact
+direct path (`Executor.execute_full`), write-containing queries flush
+the window immediately (preserving the existing `batch_tail_writes`
+ordering inside `execute_batch`), and the API layer degrades to the
+direct path whenever the coalescer is absent, stopped, or ineligible
+(cluster fan-out, remote legs, protobuf surface).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from pilosa_tpu.server.api import ApiError
+
+# Item lifecycle: PENDING (queued, still ejectable) -> CLAIMED (taken by
+# the dispatcher; result imminent) or EJECTED (deadline passed while
+# queued; the dispatcher must skip it).
+_PENDING, _CLAIMED, _EJECTED = 0, 1, 2
+
+
+class CoalescerStopped(RuntimeError):
+    """Raised by submit() when the coalescer is stopped (or its
+    dispatcher died) — the ONLY condition the API layer may answer by
+    re-running the query on the direct path. A dedicated type so
+    genuine executor RuntimeErrors (device OOM, transfer failures)
+    surface to the client instead of being silently retried."""
+
+
+class CoalescerOverload(ApiError):
+    """Pending queue at capacity — HTTP 429 with a Retry-After hint."""
+
+    def __init__(self, msg: str, retry_after: float = 1.0):
+        super().__init__(msg, 429)
+        self.retry_after = retry_after
+        self.headers = {"Retry-After": str(max(1, int(retry_after)))}
+
+
+class DeadlineExceeded(ApiError):
+    """Request expired while queued; its dispatch was skipped."""
+
+    def __init__(self, msg: str):
+        super().__init__(msg, 408)
+
+
+class _Item:
+    __slots__ = ("index", "query", "shards", "is_write", "deadline",
+                 "state", "event", "result", "enqueued_at")
+
+    def __init__(self, index: str, query: Any,
+                 shards: Optional[Sequence[int]], is_write: bool,
+                 deadline: Optional[float]):
+        self.index = index
+        self.query = query
+        self.shards = shards
+        self.is_write = is_write
+        self.deadline = deadline
+        self.state = _PENDING
+        self.event = threading.Event()
+        self.result: Any = None
+        self.enqueued_at = time.perf_counter()
+
+
+class QueryCoalescer:
+    """Collects concurrent single-query requests into executor batches.
+
+    `submit()` is the only entry point for request threads; `start()`/
+    `stop()` bracket the dispatcher thread's lifetime. `stop()` drains:
+    everything already queued still executes before the thread exits, so
+    a SIGTERM'd server answers its admitted requests (in-flight HTTP
+    handlers block in submit until their batch completes)."""
+
+    def __init__(self, executor, window_s: float = 0.0015,
+                 max_batch: int = 64, max_queue: int = 256,
+                 deadline_s: float = 0.0, stats=None, tracer=None,
+                 logger=None):
+        from pilosa_tpu.utils.stats import NopStatsClient
+        from pilosa_tpu.utils.tracing import NopTracer
+        self.executor = executor
+        self.window_s = max(0.0, float(window_s))
+        self.max_batch = max(1, int(max_batch))
+        self.max_queue = max(1, int(max_queue))
+        self.deadline_s = max(0.0, float(deadline_s))
+        self.stats = stats or NopStatsClient()
+        self.tracer = tracer or NopTracer()
+        self.logger = logger
+        self._queue: List[_Item] = []
+        # Items claimed out of _queue for the batch being built or
+        # executed — tracked on self so the dispatcher-death handler
+        # can resolve them too (they are no longer in _queue).
+        self._inflight: List[_Item] = []
+        self._cond = threading.Condition()
+        self._flush_now: Optional[str] = None  # early-flush reason
+        self._stop = False
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        # True while the dispatcher executes a batch: arrivals during
+        # that span have already "waited" (continuous batching), so the
+        # next flush takes them without re-running the window timer.
+        self._busy = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def running(self) -> bool:
+        return self._running
+
+    def start(self) -> None:
+        if self._running or (self._thread is not None
+                             and self._thread.is_alive()):
+            # Second guard: a stop() whose drain timed out leaves the
+            # old dispatcher running — never spawn a second one over
+            # the same queue.
+            return
+        self._stop = False
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="query-coalescer")
+        self._thread.start()
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Graceful drain: stop admitting, execute everything queued,
+        join the dispatcher. Safe to call twice. If the dispatcher is
+        wedged in a batch past `timeout`, says so and keeps the thread
+        handle — callers proceed with teardown knowing the drain did
+        not complete, and start() refuses to double-dispatch."""
+        with self._cond:
+            if not self._running and self._thread is None:
+                return
+            self._running = False  # submit() now degrades to direct
+            self._stop = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                if self.logger is not None:
+                    self.logger.printf(
+                        "coalescer drain timed out after %.0fs; "
+                        "dispatcher still executing a batch", timeout)
+                return
+            self._thread = None
+
+    # --------------------------------------------------------------- submit
+
+    def submit(self, index: str, query: Any,
+               shards: Optional[Sequence[int]] = None) -> Dict[str, Any]:
+        """Queue one query and block until its batch resolves. Returns
+        the shaped response dict; raises the per-request exception
+        (executor errors, CoalescerOverload, DeadlineExceeded).
+
+        The caller (API.query_coalesced) checks `running` first and
+        falls back to the direct path, but the check races with stop():
+        RuntimeError from a just-stopped coalescer is re-routed by the
+        caller, never surfaced to the client."""
+        from pilosa_tpu.executor.executor import query_is_write
+        deadline = (time.monotonic() + self.deadline_s
+                    if self.deadline_s > 0 else None)
+        is_write = query_is_write(query)
+        item = _Item(index, query, shards, is_write, deadline)
+        with self._cond:
+            if not self._running:
+                raise CoalescerStopped("coalescer stopped")
+            if len(self._queue) >= self.max_queue:
+                self.stats.count("coalescer.rejected", 1)
+                raise CoalescerOverload(
+                    f"query queue at capacity ({self.max_queue} pending)",
+                    retry_after=max(1.0, self.window_s * 2))
+            self._queue.append(item)
+            self.stats.count("coalescer.admitted", 1)
+            self.stats.gauge("coalescer.queue_depth", len(self._queue))
+            if is_write and self._flush_now is None:
+                # Writes must not sit in a window: flush immediately so
+                # the batch (with its batch_tail_writes snapshotting)
+                # starts now.
+                self._flush_now = "write"
+            elif len(self._queue) >= self.max_batch and \
+                    self._flush_now is None:
+                self._flush_now = "size"
+            self._cond.notify_all()
+        return self._await(item)
+
+    def _await(self, item: _Item) -> Dict[str, Any]:
+        if item.deadline is not None:
+            if not item.event.wait(max(0.0, item.deadline
+                                       - time.monotonic())):
+                with self._cond:
+                    if item.state == _PENDING:
+                        # Still in the window: eject so the dispatcher
+                        # skips its dispatch entirely.
+                        item.state = _EJECTED
+                        try:
+                            self._queue.remove(item)
+                        except ValueError:
+                            pass
+                        self.stats.gauge("coalescer.queue_depth",
+                                         len(self._queue))
+                        self.stats.count("coalescer.deadline_ejected", 1)
+                        raise DeadlineExceeded(
+                            f"deadline exceeded after "
+                            f"{self.deadline_s * 1e3:.0f} ms in queue")
+                # Claimed by the dispatcher in the race: the result is
+                # being computed — deliver it (the deadline bounds QUEUE
+                # time, not execution).
+                item.event.wait()
+        else:
+            item.event.wait()
+        self.stats.timing("coalescer.request",
+                          time.perf_counter() - item.enqueued_at)
+        if isinstance(item.result, Exception):
+            raise item.result
+        return item.result
+
+    # ----------------------------------------------------------- dispatcher
+
+    def _run(self) -> None:
+        try:
+            while True:
+                with self._cond:
+                    while not self._queue and not self._stop:
+                        self._busy = False
+                        self._cond.wait()
+                    if not self._queue and self._stop:
+                        return
+                    reason = self._collect_window()
+                    batch = self._claim_batch()
+                    busy_next = bool(self._queue)
+                if batch:
+                    self._execute(batch, reason)
+                self._inflight = []
+                with self._cond:
+                    # Items that arrived while executing have waited
+                    # their window already: take them on the next loop
+                    # pass without re-arming the timer.
+                    self._busy = busy_next or bool(self._queue)
+        except BaseException as e:  # dispatcher died: strand nobody
+            if self.logger is not None:
+                self.logger.printf("coalescer dispatcher died: %r", e)
+            with self._cond:
+                self._running = False  # submits degrade to direct
+                pending, self._queue = self._queue, []
+            # _inflight covers items already claimed out of the queue
+            # (batch being built/executed when the exception hit).
+            for item in pending + self._inflight:
+                if not item.event.is_set():
+                    item.result = CoalescerStopped(
+                        f"coalescer dispatcher died: {e!r}")
+                    item.event.set()
+            raise
+
+    def _collect_window(self) -> str:
+        """Hold the window open for more arrivals (lock held). Returns
+        the flush reason."""
+        if self._stop:
+            return "shutdown"
+        if self._busy:
+            # The device just finished a batch and these items queued
+            # behind it — flush without further delay.
+            return "drain"
+        if self.window_s <= 0:
+            return "idle"
+        deadline = time.monotonic() + self.window_s
+        while (self._flush_now is None and not self._stop
+               and len(self._queue) < self.max_batch):
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return "window"
+            self._cond.wait(left)
+        if self._stop:
+            return "shutdown"
+        return self._flush_now or "window"
+
+    def _claim_batch(self) -> List[_Item]:
+        """Move up to max_batch pending items into CLAIMED (lock held),
+        dropping expired ones with a DeadlineExceeded result."""
+        self._flush_now = None
+        now = time.monotonic()
+        batch = self._inflight = []
+        while self._queue and len(batch) < self.max_batch:
+            item = self._queue.pop(0)
+            if item.state != _PENDING:  # ejected by its requester
+                continue
+            if item.deadline is not None and now >= item.deadline:
+                item.state = _EJECTED
+                item.result = DeadlineExceeded(
+                    f"deadline exceeded after "
+                    f"{self.deadline_s * 1e3:.0f} ms in queue")
+                self.stats.count("coalescer.deadline_ejected", 1)
+                item.event.set()
+                continue
+            item.state = _CLAIMED
+            batch.append(item)
+        self.stats.gauge("coalescer.queue_depth", len(self._queue))
+        return batch
+
+    def _execute(self, batch: List[_Item], reason: str) -> None:
+        self.stats.count(f"coalescer.flush.{reason}", 1)
+        self.stats.histogram("coalescer.batch_size", len(batch))
+        try:
+            with self.tracer.span("Coalescer.flush", n=len(batch),
+                                  reason=reason) as span:
+                if len(batch) == 1:
+                    self._execute_direct(batch[0])
+                else:
+                    self._execute_batched(batch, span)
+        except Exception as e:  # dispatcher must never die
+            if self.logger is not None:
+                self.logger.printf("coalescer flush failed: %r", e)
+            for item in batch:
+                if not item.event.is_set():
+                    item.result = e
+                    item.event.set()
+
+    def _execute_direct(self, item: _Item) -> None:
+        """Batch of one: run the EXACT direct path (execute_full), so a
+        lone request degrades to uncoalesced behavior."""
+        try:
+            item.result = self.executor.execute_full(
+                item.index, item.query, shards=item.shards)
+        except Exception as e:
+            item.result = e
+        item.event.set()
+
+    def _execute_batched(self, batch: List[_Item], span) -> None:
+        """One executor batch for N requests, deduplicating identical
+        read-only queries when the flush carries no writes (a write in
+        the batch orders against its batchmates, so reads that would
+        straddle it must each run in position)."""
+        dedup_ok = not any(it.is_write for it in batch)
+        groups: Dict[Tuple[str, str, Optional[Tuple[int, ...]]],
+                     List[int]] = {}
+        reqs: List[Tuple[str, Any, Optional[Sequence[int]]]] = []
+        owner: List[List[_Item]] = []
+        for item in batch:
+            key = None
+            if dedup_ok and isinstance(item.query, str):
+                key = (item.index, item.query,
+                       tuple(item.shards) if item.shards is not None
+                       else None)
+            if key is not None and key in groups:
+                owner[groups[key][0]].append(item)
+                continue
+            if key is not None:
+                groups[key] = [len(reqs)]
+            reqs.append((item.index, item.query, item.shards))
+            owner.append([item])
+        if len(reqs) < len(batch):
+            self.stats.count("coalescer.deduped", len(batch) - len(reqs))
+        if span is not None:
+            span.set("unique", len(reqs))
+        # Queue wait ends when execution STARTS — stamped before the
+        # batch runs, so the histogram separates window/queue time from
+        # device time (coalescer.request covers the end-to-end sum).
+        exec_start = time.perf_counter()
+        for item in batch:
+            self.stats.timing("coalescer.queue_wait",
+                              exec_start - item.enqueued_at)
+        shaped = self.executor.execute_batch_shaped(reqs)
+        for res, items in zip(shaped, owner):
+            for item in items:
+                item.result = res
+                item.event.set()

@@ -56,7 +56,8 @@ class Handler(BaseHTTPRequestHandler):
             logger.debugf(fmt % args)
 
     def _json(self, obj: Any, status: int = 200,
-              force_json: bool = False) -> None:
+              force_json: bool = False,
+              extra_headers: Optional[dict] = None) -> None:
         # Content negotiation (reference http/handler.go:447-489 protobuf
         # vs JSON): internal clients ask for the binary wire codec via
         # Accept; JSON is the public surface and the default.
@@ -74,6 +75,8 @@ class Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
@@ -85,8 +88,10 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _error(self, msg: str, status: int = 400) -> None:
-        self._json({"error": msg}, status, force_json=True)
+    def _error(self, msg: str, status: int = 400,
+               extra_headers: Optional[dict] = None) -> None:
+        self._json({"error": msg}, status, force_json=True,
+                   extra_headers=extra_headers)
 
     def _body(self) -> bytes:
         n = int(self.headers.get("Content-Length") or 0)
@@ -218,7 +223,10 @@ class Handler(BaseHTTPRequestHandler):
             if not handled:
                 self._error(f"no route for {method} {path}", 404)
         except ApiError as e:
-            self._error(str(e), e.status)
+            # e.headers carries response headers (e.g. Retry-After on
+            # the coalescer's 429 overload rejection).
+            self._error(str(e), e.status,
+                        extra_headers=getattr(e, "headers", None))
         except Exception as e:  # mirror the reference's panic recovery
             self._error(f"internal error: {type(e).__name__}: {e}", 500)
 
@@ -325,8 +333,16 @@ class Handler(BaseHTTPRequestHandler):
                 # (http/handler.go:186 PostQuery optional args).
                 try:
                     pql = self._wrap_options(pql, self._exec_optargs(q))
-                    self._json(api.query(m.group(1), pql, shards=shards,
-                                         remote=self._qbool(q, "remote")))
+                    # Rides the cross-request coalescer when one is
+                    # attached (server/coalescer.py); degrades to the
+                    # direct api.query path otherwise.
+                    self._json(api.query_coalesced(
+                        m.group(1), pql, shards=shards,
+                        remote=self._qbool(q, "remote")))
+                except ApiError:
+                    # Already carries its status (429 overload, 408
+                    # deadline): must not collapse to a generic 400.
+                    raise
                 except ValueError as e:
                     raise ApiError(str(e))
             elif path == "/batch/query":
@@ -496,6 +512,10 @@ class PilosaHTTPServer(ThreadingHTTPServer):
     connections through its still-alive handler threads."""
 
     daemon_threads = True
+    # The socketserver default listen backlog (5) resets connections
+    # under a coalescer-sized concurrent burst; a serving front door
+    # needs the accept queue deeper than any one batching window.
+    request_queue_size = 128
 
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)

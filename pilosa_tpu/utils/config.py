@@ -8,8 +8,12 @@ Same precedence here: CLI flags > PILOSA_TPU_* env > TOML file > defaults.
 from __future__ import annotations
 
 import os
-import tomllib
-from dataclasses import dataclass, field, asdict
+
+try:
+    import tomllib  # Python >= 3.11
+except ModuleNotFoundError:  # 3.10 images carry the identical backport
+    import tomli as tomllib
+from dataclasses import dataclass, field, fields, asdict
 from typing import Any, Dict, Optional
 
 ENV_PREFIX = "PILOSA_TPU_"
@@ -23,6 +27,16 @@ class Config:
     # Query
     max_writes_per_request: int = 5000
     long_query_time: float = 0.0  # seconds; 0 disables slow-query logging
+    # Serving-path query coalescer (server/coalescer.py): concurrent
+    # single-query POSTs arriving within the batching window share one
+    # executor batch. TOML accepts a [coalescer] table (keys without the
+    # prefix) or the flat coalescer_* spelling; env/flags use the flat
+    # names (PILOSA_TPU_COALESCER_WINDOW_MS, ...).
+    coalescer_enabled: bool = True
+    coalescer_window_ms: float = 1.5   # max wait for batchmates
+    coalescer_max_batch: int = 64      # size cap -> early flush
+    coalescer_max_queue: int = 256     # admission bound -> 429 past it
+    coalescer_deadline_ms: float = 0.0  # per-request queue deadline; 0 off
     # TPU
     mesh_devices: int = 0         # 0 = all visible devices
     mesh_replicas: int = 1
@@ -117,6 +131,10 @@ class Config:
         if bool(self.tls_certificate) != bool(self.tls_key):
             raise ValueError(
                 "tls_certificate and tls_key must be set together")
+        if self.coalescer_window_ms < 0 or self.coalescer_deadline_ms < 0:
+            raise ValueError("coalescer window/deadline must be >= 0")
+        if self.coalescer_max_batch < 1 or self.coalescer_max_queue < 1:
+            raise ValueError("coalescer max_batch/max_queue must be >= 1")
 
     def server_ssl_context(self):
         """ssl.SSLContext for the listener, or None when TLS is off
@@ -170,9 +188,24 @@ def load_config(path: Optional[str] = None,
     if path:
         with open(path, "rb") as f:
             data = tomllib.load(f)
+        # Validate against the dataclass FIELDS, not hasattr: hasattr
+        # also matches read-only properties (tls_enabled, port) and
+        # methods (server_ssl_context), which would either crash with
+        # a raw AttributeError or silently shadow a method.
+        settable = {f.name for f in fields(cfg)}
         for k, v in data.items():
             k = k.replace("-", "_")
-            if hasattr(cfg, k):
+            if isinstance(v, dict):
+                # TOML table, e.g. [coalescer] window_ms = 2.0 -> the
+                # flat coalescer_window_ms field (reference nests its
+                # TOML the same way, server/config.go:43).
+                for sk, sv in v.items():
+                    flat = f"{k}_{sk.replace('-', '_')}"
+                    if flat not in settable:
+                        raise ValueError(
+                            f"unknown config key {k}.{sk!r}")
+                    setattr(cfg, flat, sv)
+            elif k in settable:
                 setattr(cfg, k, v)
             else:
                 raise ValueError(f"unknown config key {k!r}")

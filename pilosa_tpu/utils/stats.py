@@ -98,6 +98,7 @@ class MemStatsClient(StatsClient):
                     out["timings"][k] = {
                         "count": len(s),
                         "p50": s[len(s) // 2],
+                        "p95": s[min(len(s) - 1, int(len(s) * 0.95))],
                         "p99": s[min(len(s) - 1, int(len(s) * 0.99))],
                     }
             return out
@@ -322,11 +323,17 @@ def prometheus_text(stats) -> str:
         emit(n, "gauge", [f"{n}{lab} {v}"])
     for k, t in sorted(snap.get("timings", {}).items()):
         name, lab = split_key(k)
-        n = f"pilosa_{name}_seconds"
+        # The timings store holds any distribution, not only durations
+        # (MemStatsClient.histogram aliases to timing): a name ending
+        # in _size (e.g. coalescer.batch_size) is a unitless count and
+        # must not export with the _seconds suffix, which would assert
+        # a time unit to every dashboard reading it.
+        suffix = "" if name.endswith("_size") else "_seconds"
+        n = f"pilosa_{name}{suffix}"
         inner = lab[1:-1] + "," if lab else ""
-        emit(n, "summary", [
-            f'{n}{{{inner}quantile="0.5"}} {t["p50"]}',
-            f'{n}{{{inner}quantile="0.99"}} {t["p99"]}',
-            f"{n}_count{lab} {t['count']}",
-        ])
+        quantiles = [f'{n}{{{inner}quantile="0.5"}} {t["p50"]}']
+        if "p95" in t:
+            quantiles.append(f'{n}{{{inner}quantile="0.95"}} {t["p95"]}')
+        quantiles.append(f'{n}{{{inner}quantile="0.99"}} {t["p99"]}')
+        emit(n, "summary", quantiles + [f"{n}_count{lab} {t['count']}"])
     return "\n".join(lines) + ("\n" if lines else "")

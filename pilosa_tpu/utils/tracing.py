@@ -38,6 +38,13 @@ class Span:
     def duration(self) -> float:
         return (self.end or time.time()) - self.start
 
+    def set(self, key: str, value) -> None:
+        """Annotate an open span with a value only known mid-span (e.g.
+        the coalescer flush's post-dedup unique-query count) — the
+        opentracing Span.SetTag analog the reference uses on its query
+        spans."""
+        self.attrs[key] = value
+
 
 class NopTracer:
     @contextlib.contextmanager

@@ -99,11 +99,21 @@ def live_server(tmp_path):
     from pilosa_tpu.server import API, serve
     from pilosa_tpu.utils.stats import MemStatsClient
 
+    from pilosa_tpu.server.coalescer import QueryCoalescer
+
     h = Holder(str(tmp_path / "srv"))
     h.open()
     api = API(h, stats=MemStatsClient())
+    # The coalescer must be semantically invisible, so the shared
+    # fixture runs WITH it attached: every HTTP-surface test doubles as
+    # an equivalence check of the coalesced path (test_coalescer.py
+    # additionally diffs coalesced vs direct byte-for-byte).
+    api.coalescer = QueryCoalescer(api.executor, window_s=0.0005,
+                                   stats=api.stats, tracer=api.tracer)
+    api.coalescer.start()
     srv = serve(api, "localhost", 0, background=True)
     yield f"http://localhost:{srv.server_address[1]}", api, h
     srv.shutdown()
     srv.server_close()
+    api.coalescer.stop()
     h.close()

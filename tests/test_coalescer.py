@@ -1,0 +1,388 @@
+"""Serving-path query coalescer (server/coalescer.py): threaded stress
+against a live PilosaHTTPServer asserting result-equivalence vs the
+direct path, per-request error isolation, deadline ejection, 429 at
+queue capacity, and the new observability surface. Rides alongside
+test_concurrency.py (in-process races) — here the races cross the HTTP
+boundary, which is the layer the coalescer lives at."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.server import API, serve
+from pilosa_tpu.server.coalescer import QueryCoalescer
+from pilosa_tpu.utils.stats import MemStatsClient
+
+N_THREADS = 8
+N_QUERIES = 6
+
+
+def post(base, path, body, timeout=30):
+    """(status, raw_bytes, headers) for a POST; 4xx captured, not raised."""
+    data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    r = urllib.request.Request(base + path, data=data, method="POST")
+    try:
+        with urllib.request.urlopen(r, timeout=timeout) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def seed_data(holder):
+    idx = holder.create_index("c")
+    f = idx.create_field("f")
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, 8, 4000).astype(np.uint64)
+    cols = rng.integers(0, 3 * 2**20, 4000).astype(np.uint64)
+    f.import_bits(rows, cols)
+    idx.add_existence(cols)
+
+
+@pytest.fixture
+def pair(tmp_path):
+    """Two identically-seeded live servers: one coalesced, one direct.
+    Yields (coalesced_base, direct_base, coalesced_api)."""
+    servers, holders, coalescers = [], [], []
+    bases = []
+    for name, with_coal in (("coal", True), ("direct", False)):
+        h = Holder(str(tmp_path / name))
+        h.open()
+        seed_data(h)
+        api = API(h, stats=MemStatsClient())
+        if with_coal:
+            api.coalescer = QueryCoalescer(
+                api.executor, window_s=0.002, max_batch=32,
+                stats=api.stats, tracer=api.tracer)
+            api.coalescer.start()
+            coalescers.append(api.coalescer)
+            capi = api
+        srv = serve(api, "localhost", 0, background=True)
+        servers.append(srv)
+        holders.append(h)
+        bases.append(f"http://localhost:{srv.server_address[1]}")
+    yield bases[0], bases[1], capi
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+    for c in coalescers:
+        c.stop()
+    for h in holders:
+        h.close()
+
+
+QUERIES = ([f"Count(Row(f={r}))" for r in range(8)]
+           + [f"Row(f={r})" for r in range(4)]
+           + ["TopN(f, n=3)", "Count(Union(Row(f=0), Row(f=1)))",
+              "Count(Intersect(Row(f=2), Row(f=3)))"])
+
+
+def test_coalesced_byte_identical_to_direct_threaded(pair):
+    """N client threads x M queries against the coalesced server; every
+    response body must be byte-identical to the direct server's answer
+    for the same query."""
+    coal, direct, _api = pair
+    want = {q: post(direct, "/index/c/query", q.encode()) for q in QUERIES}
+    for q, (st, _, _) in want.items():
+        assert st == 200, q
+    errors = []
+    barrier = threading.Barrier(N_THREADS)
+
+    def worker(tid):
+        try:
+            barrier.wait()
+            for i in range(N_QUERIES):
+                q = QUERIES[(tid * N_QUERIES + i) % len(QUERIES)]
+                st, body, _ = post(coal, "/index/c/query", q.encode())
+                assert st == 200, (q, body)
+                assert body == want[q][1], (q, body, want[q][1])
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_error_isolation_across_batchmates(pair):
+    """Bad queries (unknown field) racing good ones: each bad request
+    gets ITS 400; good batchmates still answer 200 with exact results."""
+    coal, direct, _api = pair
+    good = "Count(Row(f=1))"
+    want = post(direct, "/index/c/query", good.encode())[1]
+    errors = []
+    barrier = threading.Barrier(N_THREADS)
+
+    def worker(tid):
+        try:
+            barrier.wait()
+            for i in range(N_QUERIES):
+                if (tid + i) % 2:
+                    st, body, _ = post(coal, "/index/c/query",
+                                       b"Count(Row(nope=1))")
+                    assert st == 400, (st, body)
+                    assert b"error" in body
+                else:
+                    st, body, _ = post(coal, "/index/c/query",
+                                       good.encode())
+                    assert st == 200 and body == want, (st, body)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+
+def test_writes_flush_and_stay_exact(pair):
+    """Write-containing queries ride the coalescer (immediate flush, no
+    dedup) while readers hammer the same field; no lost writes."""
+    coal, _direct, _api = pair
+    errors = []
+    barrier = threading.Barrier(4)
+
+    def writer(tid):
+        try:
+            barrier.wait()
+            for i in range(20):
+                st, body, _ = post(
+                    coal, "/index/c/query",
+                    f"Set({4 * 2**20 + tid * 1000 + i}, f={20 + tid})"
+                    .encode())
+                assert st == 200, body
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def reader():
+        try:
+            barrier.wait()
+            for _ in range(20):
+                st, body, _ = post(coal, "/index/c/query",
+                                   b"Count(Row(f=20))")
+                assert st == 200, body
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(3)] + [threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for tid in range(3):
+        st, body, _ = post(coal, "/index/c/query",
+                           f"Count(Row(f={20 + tid}))".encode())
+        assert json.loads(body)["results"] == [20], (tid, body)
+
+
+def test_dedup_identical_queries_one_execution(pair):
+    """Identical read-only queries landing in one window execute once
+    and fan out; a long window + barrier makes the batch deterministic."""
+    coal, direct, api = pair
+    api.coalescer.window_s = 0.25  # hold the window open for the burst
+    try:
+        want = post(direct, "/index/c/query", b"Count(Row(f=5))")[1]
+        results, errors = [], []
+        barrier = threading.Barrier(12)
+
+        def worker():
+            try:
+                barrier.wait()
+                results.append(post(coal, "/index/c/query",
+                                    b"Count(Row(f=5))"))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+        assert all(st == 200 and body == want
+                   for st, body, _ in results), results
+        snap = api.stats.snapshot()
+        assert snap["counters"].get("coalescer.deduped", 0) > 0
+        assert snap["timings"]["coalescer.batch_size"]["count"] >= 1
+    finally:
+        api.coalescer.window_s = 0.002
+
+
+class _GatedExecutor:
+    """Delegating executor whose execute paths block on a release event
+    — pins the dispatcher mid-batch so queue-capacity and deadline
+    behavior become deterministic."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.started = threading.Event()
+        self.release = threading.Event()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _gate(self):
+        self.started.set()
+        assert self.release.wait(30), "gate never released"
+
+    def execute_full(self, *a, **kw):
+        self._gate()
+        return self._inner.execute_full(*a, **kw)
+
+    def execute_batch_shaped(self, *a, **kw):
+        self._gate()
+        return self._inner.execute_batch_shaped(*a, **kw)
+
+
+@pytest.fixture
+def gated(tmp_path):
+    """Live server whose coalescer has a tiny queue + deadline and a
+    gated executor. Yields (base, gate, api)."""
+    h = Holder(str(tmp_path / "g"))
+    h.open()
+    seed_data(h)
+    api = API(h, stats=MemStatsClient())
+    gate = _GatedExecutor(api.executor)
+    api.coalescer = QueryCoalescer(
+        gate, window_s=0.0005, max_batch=8, max_queue=2,
+        deadline_s=0.2, stats=api.stats, tracer=api.tracer)
+    api.coalescer.start()
+    srv = serve(api, "localhost", 0, background=True)
+    yield f"http://localhost:{srv.server_address[1]}", gate, api
+    gate.release.set()
+    srv.shutdown()
+    srv.server_close()
+    api.coalescer.stop()
+    h.close()
+
+
+def test_overload_429_and_deadline_ejection(gated):
+    base, gate, api = gated
+    results = {}
+
+    def bg(name):
+        def run():
+            results[name] = post(base, "/index/c/query",
+                                 b"Count(Row(f=1))")
+        t = threading.Thread(target=run)
+        t.start()
+        return t
+
+    # First request: dispatcher claims it and blocks inside the gate.
+    t1 = bg("inflight")
+    assert gate.started.wait(10), "dispatcher never started the batch"
+    # Two more fill the bounded pending queue (max_queue=2)...
+    t2, t3 = bg("q1"), bg("q2")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        depth = api.stats.snapshot()["gauges"].get(
+            "coalescer.queue_depth", 0)
+        if depth >= 2:
+            break
+        time.sleep(0.01)
+    # ...so the next submit is rejected up front: 429 + Retry-After.
+    st, body, headers = post(base, "/index/c/query", b"Count(Row(f=1))")
+    assert st == 429, (st, body)
+    assert "Retry-After" in headers, headers
+    assert b"capacity" in body
+    # The two queued requests outlive their 200 ms queue deadline while
+    # the dispatcher stays pinned: ejected with 408, never dispatched.
+    t2.join(timeout=10)
+    t3.join(timeout=10)
+    assert results["q1"][0] == 408, results["q1"]
+    assert results["q2"][0] == 408, results["q2"]
+    snap = api.stats.snapshot()
+    assert snap["counters"].get("coalescer.deadline_ejected", 0) >= 2
+    assert snap["counters"].get("coalescer.rejected", 0) >= 1
+    # Release the gate: the in-flight request completes normally.
+    gate.release.set()
+    t1.join(timeout=10)
+    assert results["inflight"][0] == 200, results["inflight"]
+
+
+def test_stats_and_metrics_surface(pair):
+    """The acceptance-named stats reach both /debug/vars (expvar) and
+    /metrics (Prometheus text)."""
+    coal, _direct, api = pair
+    barrier = threading.Barrier(6)
+
+    def worker():
+        barrier.wait()
+        for _ in range(4):
+            post(coal, "/index/c/query", b"Count(Row(f=1))")
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with urllib.request.urlopen(coal + "/debug/vars") as resp:
+        snap = json.loads(resp.read())
+    assert "coalescer.queue_depth" in snap["gauges"]
+    assert "coalescer.batch_size" in snap["timings"]
+    assert snap["counters"].get("coalescer.admitted", 0) >= 24
+    assert any(k.startswith("coalescer.flush.")
+               for k in snap["counters"]), snap["counters"]
+    with urllib.request.urlopen(coal + "/metrics") as resp:
+        text = resp.read().decode()
+    assert "pilosa_coalescer_queue_depth" in text
+    # occupancy is unitless: no _seconds suffix on the summary
+    assert "pilosa_coalescer_batch_size{" in text
+    assert "pilosa_coalescer_batch_size_seconds" not in text
+    assert "pilosa_coalescer_flush_" in text
+
+
+def test_graceful_stop_drains_and_degrades(pair):
+    """stop() executes everything already admitted, and later requests
+    fall back to the direct path (same answers, no errors)."""
+    coal, direct, api = pair
+    want = post(direct, "/index/c/query", b"Count(Row(f=2))")[1]
+    st, body, _ = post(coal, "/index/c/query", b"Count(Row(f=2))")
+    assert st == 200 and body == want
+    api.coalescer.stop()
+    st, body, _ = post(coal, "/index/c/query", b"Count(Row(f=2))")
+    assert st == 200 and body == want
+
+
+def test_single_request_degrades_to_direct_path(pair):
+    """A lone request (batch of one) takes the execute_full path and
+    matches the direct server exactly."""
+    coal, direct, _api = pair
+    for q in ("Count(Row(f=3))", "TopN(f, n=2)"):
+        assert (post(coal, "/index/c/query", q.encode())[1]
+                == post(direct, "/index/c/query", q.encode())[1]), q
+
+
+def test_config_coalescer_section(tmp_path):
+    """[coalescer] TOML table flattens onto the coalescer_* fields; env
+    spelling stays flat."""
+    from pilosa_tpu.utils.config import load_config
+
+    p = tmp_path / "c.toml"
+    p.write_text('bind = "localhost:1"\n'
+                 "[coalescer]\n"
+                 "enabled = false\n"
+                 "window-ms = 3.5\n"
+                 "max_batch = 16\n")
+    cfg = load_config(str(p))
+    assert cfg.coalescer_enabled is False
+    assert cfg.coalescer_window_ms == 3.5
+    assert cfg.coalescer_max_batch == 16
+    assert cfg.coalescer_max_queue == 256  # untouched default
+    with pytest.raises(ValueError, match="unknown config key"):
+        p.write_text("[coalescer]\nnot_a_key = 1\n")
+        load_config(str(p))
