@@ -23,7 +23,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import threading
+from pilosa_tpu.utils.locks import make_rlock
 from typing import Any, Dict, List, Optional, Tuple
 
 ATTR_BLOCK_SIZE = 100
@@ -38,7 +38,7 @@ class AttrStore:
     def __init__(self, path: Optional[str] = None):
         self.path = path
         self.attrs: Dict[int, Dict[str, Any]] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("AttrStore._lock")
         self._log_fh = None
         self._log_entries = 0
         self._log_bytes = 0

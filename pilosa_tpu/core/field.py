@@ -17,8 +17,8 @@ from __future__ import annotations
 
 import json
 import os
-import threading
-from dataclasses import dataclass, field as dc_field, asdict
+from pilosa_tpu.utils.locks import make_rlock
+from dataclasses import asdict, dataclass
 from datetime import datetime
 from typing import Dict, List, Optional, Tuple
 
@@ -132,7 +132,7 @@ class Field:
         self.options.validate()
         self.views: Dict[str, View] = {}
         self.bsi_groups: Dict[str, BSIGroup] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("Field._lock")
         self.on_new_shard = None
         from pilosa_tpu.core.attrs import AttrStore
         self.row_attr_store = AttrStore(os.path.join(self.path, ".row_attrs"))

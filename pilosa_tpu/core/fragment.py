@@ -20,7 +20,7 @@ from __future__ import annotations
 import hashlib
 import itertools
 import os
-import threading
+from pilosa_tpu.utils.locks import make_rlock
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -97,7 +97,7 @@ class Fragment:
         self.cache = cache_mod.new_cache(cache_type, cache_size)
         self.cache_type = cache_type
         self._file = None
-        self._lock = threading.RLock()
+        self._lock = make_rlock("Fragment._lock")
         # Device bank state.
         self._bank = None          # jnp uint32 [slots, WORDS_PER_SHARD]
         self._slots: Dict[int, int] = {}   # row id -> bank slot

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import os
 import shutil
-import threading
+from pilosa_tpu.utils.locks import make_rlock
 import uuid
 from typing import Dict, List, Optional
 
@@ -22,7 +22,7 @@ class Holder:
     def __init__(self, path: str):
         self.path = path
         self.indexes: Dict[str, Index] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("Holder._lock")
         self.node_id: Optional[str] = None
         self.on_new_shard = None  # callback(index, field, shard)
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
-import threading
+from pilosa_tpu.utils.locks import make_rlock
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -30,7 +30,7 @@ class Index:
         self.keys = keys
         self.track_existence = track_existence
         self.fields: Dict[str, Field] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("Index._lock")
         self.on_new_shard = None  # callback(field, shard)
         from pilosa_tpu.core.attrs import AttrStore
         self.column_attr_store = AttrStore(os.path.join(path, ".col_attrs"))

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
+from pilosa_tpu.utils.locks import make_rlock
 from typing import Dict, Iterable, List, Optional
 
 import numpy as np
@@ -34,7 +34,7 @@ class TranslateStore:
         self._keys: Dict[int, str] = {}
         self._next_id = 1
         self._file = None
-        self._lock = threading.RLock()
+        self._lock = make_rlock("TranslateStore._lock")
         # Byte cursor into the replication PRIMARY's log (see apply_log);
         # in-memory only — a restart re-replays from 0, idempotently.
         self.replica_offset = 0

@@ -9,7 +9,7 @@ view.go:207).
 from __future__ import annotations
 
 import os
-import threading
+from pilosa_tpu.utils.locks import make_lock, make_rlock
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -85,7 +85,7 @@ class BankBudget:
     def __init__(self, budget_bytes: int, cache_attr: str = "_bank_cache"):
         self.budget = budget_bytes
         self.cache_attr = cache_attr
-        self._lock = threading.Lock()
+        self._lock = make_lock("BankBudget._lock")
         # (id(view), key) -> (view, nbytes), in LRU order (oldest first).
         from collections import OrderedDict
         self._entries: "OrderedDict" = OrderedDict()
@@ -246,7 +246,7 @@ class View:
         self.cache_size = cache_size
         self.max_columns = max_columns  # declared column bound (0 = full)
         self.fragments: Dict[int, Fragment] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("View._lock")
         self.on_new_shard = None  # callback(shard) for shard broadcasts
         self._bank_cache: Dict[tuple, ViewBank] = {}
         # Host-side packed blocks for transient row-subset banks (the

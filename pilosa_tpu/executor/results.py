@@ -28,6 +28,9 @@ class RowResult:
         self.keys: Optional[List[str]] = None
         self._columns: Optional[np.ndarray] = None
 
+    # graftlint: materialize — columns() IS the device->host boundary:
+    # callers ask for host column ids exactly once, and the fetch is
+    # cached on the result.
     def columns(self) -> np.ndarray:
         if self._columns is not None:
             return self._columns
@@ -50,6 +53,8 @@ class RowResult:
                               dtype=np.uint32)
         self._columns = np.empty(0, dtype=np.uint64)
 
+    # graftlint: materialize — scalar count for response shaping; the
+    # executor's fused Count path never routes through here.
     def count(self) -> int:
         from pilosa_tpu.ops.bitset import popcount
         import jax.numpy as jnp

@@ -17,7 +17,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
+from pilosa_tpu.utils.locks import make_lock
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -26,7 +26,7 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
 _SO_PATH = os.path.join(_NATIVE_DIR, "libpilosa_native.so")
 
-_lock = threading.Lock()
+_lock = make_lock("native._lock")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

@@ -29,9 +29,12 @@ equivalent of the reference's fused `intersectionCountBitmapBitmap`
 
 from __future__ import annotations
 
+from typing import Sequence, Tuple, Union
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.typing import ArrayLike
 
 # Shard geometry. ShardWidth mirrors /root/reference/fragment.go:50-51
 # (2^20 columns per shard); it must stay a power of two and a multiple of
@@ -49,33 +52,33 @@ NP_WORD_DTYPE = np.uint32
 # ---------------------------------------------------------------------------
 
 
-def b_and(a, b):
+def b_and(a: ArrayLike, b: ArrayLike) -> jax.Array:
     """Intersect (reference: roaring.go:497 Intersect / :2630 bitmap∧bitmap)."""
     return jnp.bitwise_and(a, b)
 
 
-def b_or(a, b):
+def b_or(a: ArrayLike, b: ArrayLike) -> jax.Array:
     """Union (reference: roaring.go:522)."""
     return jnp.bitwise_or(a, b)
 
 
-def b_xor(a, b):
+def b_xor(a: ArrayLike, b: ArrayLike) -> jax.Array:
     """Xor (reference: roaring.go:837)."""
     return jnp.bitwise_xor(a, b)
 
 
-def b_andnot(a, b):
+def b_andnot(a: ArrayLike, b: ArrayLike) -> jax.Array:
     """Difference a \\ b (reference: roaring.go:810)."""
     return jnp.bitwise_and(a, jnp.bitwise_not(b))
 
 
-def b_not(a, existence):
+def b_not(a: ArrayLike, existence: ArrayLike) -> jax.Array:
     """Not(a) relative to an existence row (reference executor computes Not as
     existence-difference, /root/reference/executor.go:1556-1587)."""
     return jnp.bitwise_and(existence, jnp.bitwise_not(a))
 
 
-def union_many(stack, axis=0):
+def union_many(stack: jax.Array, axis: int = 0) -> jax.Array:
     """N-way union over a stacked axis (reference UnionInPlace,
     roaring.go:536 — the bulk union used by time-range row reads)."""
     return jax.lax.reduce(
@@ -86,7 +89,7 @@ def union_many(stack, axis=0):
     )
 
 
-def intersect_many(stack, axis=0):
+def intersect_many(stack: jax.Array, axis: int = 0) -> jax.Array:
     """N-way intersection over a stacked axis."""
     return jax.lax.reduce(
         stack,
@@ -102,7 +105,8 @@ def intersect_many(stack, axis=0):
 # ---------------------------------------------------------------------------
 
 
-def popcount(a, axis=-1):
+def popcount(a: ArrayLike,
+             axis: Union[int, Tuple[int, ...]] = -1) -> jax.Array:
     """Total set bits, reduced over `axis` (reference Count, roaring.go:319).
 
     Returns uint32: one reduced axis covers at most one shard row
@@ -112,20 +116,20 @@ def popcount(a, axis=-1):
                    dtype=jnp.uint32)
 
 
-def count_and(a, b):
+def count_and(a: ArrayLike, b: ArrayLike) -> jax.Array:
     """|a ∧ b| fused (reference IntersectionCount, roaring.go:472/2438)."""
     return popcount(jnp.bitwise_and(a, b))
 
 
-def count_or(a, b):
+def count_or(a: ArrayLike, b: ArrayLike) -> jax.Array:
     return popcount(jnp.bitwise_or(a, b))
 
 
-def count_xor(a, b):
+def count_xor(a: ArrayLike, b: ArrayLike) -> jax.Array:
     return popcount(jnp.bitwise_xor(a, b))
 
 
-def count_andnot(a, b):
+def count_andnot(a: ArrayLike, b: ArrayLike) -> jax.Array:
     return popcount(jnp.bitwise_and(a, jnp.bitwise_not(b)))
 
 
@@ -134,7 +138,7 @@ def count_andnot(a, b):
 # ---------------------------------------------------------------------------
 
 
-def shift_bits(a, n=1):
+def shift_bits(a: jax.Array, n: int = 1) -> jax.Array:
     """Shift every bit position up by n within the shard (reference
     roaring.Shift, roaring.go:865, used by executeShiftShard,
     executor.go:1591). Bits shifted past the top of the shard are dropped —
@@ -184,12 +188,15 @@ def range_mask_np(start: int, end: int, words: int = WORDS_PER_SHARD) -> np.ndar
 # ---------------------------------------------------------------------------
 
 
-def pack_positions(positions, width: int = SHARD_WIDTH) -> np.ndarray:
+def pack_positions(positions: Union[Sequence[int], np.ndarray],
+                   width: int = SHARD_WIDTH) -> np.ndarray:
     """Pack sorted/unsorted bit positions (< width) into a uint32 word array."""
     words = np.zeros(width // WORD_BITS, dtype=np.uint32)
     if len(positions) == 0:
         return words
     pos = np.asarray(positions, dtype=np.uint64)
+    # graftlint: disable=GL005 — w is a word-INDEX vector for
+    # np.bitwise_or.at (numpy requires signed indices), not word data.
     w = (pos >> np.uint64(5)).astype(np.int64)
     b = (pos & np.uint64(31)).astype(np.uint32)
     np.bitwise_or.at(words, w, np.left_shift(np.uint32(1), b))

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import functools
 import os
+from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -72,7 +73,7 @@ def enabled() -> bool:
     return flag in ("1", "true", "yes", "on") and available()
 
 
-def _popcount32(x):
+def _popcount32(x: jax.Array) -> jax.Array:
     """SWAR popcount over uint32 lanes (kept to VPU-native shift/and/add/mul
     so it lowers on every Mosaic version; equivalent to
     jax.lax.population_count)."""
@@ -83,7 +84,7 @@ def _popcount32(x):
     return (x * jnp.uint32(0x01010101)) >> jnp.uint32(24)
 
 
-def _block_partial(blk):
+def _block_partial(blk: jax.Array) -> jax.Array:
     """[SUBLANES, LANES] uint32 words -> (ACC_SUB, LANES) popcount partial.
 
     Accumulates in int32 (Mosaic has no unsigned reductions); per-lane
@@ -94,7 +95,7 @@ def _block_partial(blk):
         axis=0, dtype=jnp.int32)
 
 
-def _counts_kernel(bank_ref, out_ref):
+def _counts_kernel(bank_ref: Any, out_ref: Any) -> None:
     """Grid step (r, s): accumulate one block's popcount into out[r]."""
     from jax.experimental import pallas as pl
 
@@ -102,15 +103,16 @@ def _counts_kernel(bank_ref, out_ref):
     first = pl.program_id(1) == 0
 
     @pl.when(first)
-    def _init():
+    def _init() -> None:
         out_ref[0] = partial
 
     @pl.when(jnp.logical_not(first))
-    def _acc():
+    def _acc() -> None:
         out_ref[0] += partial
 
 
-def _masked_counts_kernel(bank_ref, filt_ref, inter_ref, raw_ref):
+def _masked_counts_kernel(bank_ref: Any, filt_ref: Any,
+                          inter_ref: Any, raw_ref: Any) -> None:
     """Grid step (r, s): one data pass accumulates BOTH |row ∧ filt| and
     |row| partials."""
     from jax.experimental import pallas as pl
@@ -121,18 +123,19 @@ def _masked_counts_kernel(bank_ref, filt_ref, inter_ref, raw_ref):
     first = pl.program_id(1) == 0
 
     @pl.when(first)
-    def _init():
+    def _init() -> None:
         inter_ref[0] = p_inter
         raw_ref[0] = p_raw
 
     @pl.when(jnp.logical_not(first))
-    def _acc():
+    def _acc() -> None:
         inter_ref[0] += p_inter
         raw_ref[0] += p_raw
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def bank_row_counts(bank, *, interpret: bool = False):
+def bank_row_counts(bank: jax.Array, *,
+                    interpret: bool = False) -> jax.Array:
     """Per-row popcounts of a [R, S, W] uint32 bank -> uint32[R].
 
     The TopN sweep (reference fragment.top, fragment.go:1067 — there a
@@ -156,7 +159,9 @@ def bank_row_counts(bank, *, interpret: bool = False):
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def bank_row_counts_masked(bank, filt, *, interpret: bool = False):
+def bank_row_counts_masked(
+        bank: jax.Array, filt: jax.Array, *,
+        interpret: bool = False) -> Tuple[jax.Array, jax.Array]:
     """([R,S,W] bank, [S,W] filter) -> (|row ∧ filt| uint32[R], |row|
     uint32[R]) in ONE pass over the bank (tanimoto needs both,
     fragment.go:1087-1093)."""
@@ -211,8 +216,8 @@ _MEM_ROWS_BLOCK = 1024  # rows per grid step (= 8*128 out tile)
 _MEM_GROUP = 16         # bank rows packed per block-row
 
 
-def _membership_kernel(qk):
-    def kernel(pos_ref, qtop_ref, out_ref):
+def _membership_kernel(qk: int) -> Callable[..., None]:
+    def kernel(pos_ref: Any, qtop_ref: Any, out_ref: Any) -> None:
         blk = pos_ref[...]                    # [GB, 16*L2] u32
         qvals = qtop_ref[...]                 # (8, 128) i32, qk real
         gb, gl2 = blk.shape
@@ -236,8 +241,9 @@ def _membership_kernel(qk):
 
 
 @functools.partial(jax.jit, static_argnames=("qk", "interpret"))
-def pbank_membership_counts(pos_grouped, qtop_pad, *, qk: int,
-                            interpret: bool = False):
+def pbank_membership_counts(pos_grouped: jax.Array, qtop_pad: jax.Array,
+                            *, qk: int,
+                            interpret: bool = False) -> jax.Array:
     """([R/16, 16*L2] u32 grouped position pairs, (8,128) i32 padded
     query positions, qk = real query count) -> |row ∧ query| i32[R].
 
@@ -266,7 +272,8 @@ def pbank_membership_counts(pos_grouped, qtop_pad, *, qk: int,
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def bsi_plane_counts(planes, mask, *, interpret: bool = False):
+def bsi_plane_counts(planes: jax.Array, mask: jax.Array, *,
+                     interpret: bool = False) -> jax.Array:
     """([D, S, W] bit-planes, [S, W] column mask) -> uint32[D] masked
     popcounts per plane — the O(bitDepth) loop of BSI Sum/Range
     (reference fragment.sum, fragment.go:767: per-bit IntersectionCount).

@@ -20,6 +20,7 @@ membership/cache messages where best-effort-with-retry is the point
 from __future__ import annotations
 
 import threading
+from pilosa_tpu.utils.locks import make_lock
 import time
 from collections import deque
 from typing import Dict, Optional
@@ -40,7 +41,7 @@ class AsyncBroadcaster:
         self._queues: Dict[str, deque] = {}
         # peer uri -> (next_attempt_unix, current_backoff_s)
         self._backoff: Dict[str, tuple] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("AsyncBroadcaster._lock")
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._idle = threading.Event()  # set while every queue is empty

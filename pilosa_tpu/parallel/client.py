@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import http.client
 import json
-import threading
+from pilosa_tpu.utils.locks import make_lock
 from typing import Any, Dict, List, Optional
 from urllib.parse import urlsplit
 
@@ -42,7 +42,7 @@ class _ConnPool:
         self.timeout = timeout
         self.ssl_context = ssl_context
         self._idle: Dict[tuple, list] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("_ConnPool._lock")
 
     def _new_conn(self, scheme: str, host: str, port: int,
                   timeout: float) -> http.client.HTTPConnection:

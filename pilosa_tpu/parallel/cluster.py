@@ -20,8 +20,8 @@ from __future__ import annotations
 
 import json
 import os
-import threading
-from dataclasses import dataclass, field
+from pilosa_tpu.utils.locks import make_rlock
+from dataclasses import dataclass
 from typing import Dict, List, Optional
 
 from pilosa_tpu.parallel.hashing import (
@@ -85,7 +85,7 @@ class Cluster:
         # (the reference pins the translate source by ring position,
         # cluster.go:1908-1935).
         self.translate_primary_id: Optional[str] = None
-        self._lock = threading.RLock()
+        self._lock = make_rlock("Cluster._lock")
 
     def translate_primary(self) -> Node:
         with self._lock:

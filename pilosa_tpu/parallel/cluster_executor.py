@@ -19,6 +19,7 @@ chip mesh.
 from __future__ import annotations
 
 import threading
+from pilosa_tpu.utils.locks import make_lock
 from typing import Any, Dict, List, Optional, Sequence
 
 from pilosa_tpu.executor.results import result_to_json
@@ -272,7 +273,7 @@ class ClusterExecutor:
                 raise last_err or e
             parts: List[Any] = []
             failed = False
-            results_lock = threading.Lock()
+            results_lock = make_lock("ClusterExecutor.results_lock")
             threads = []
 
             def run_remote(node, node_shards):

@@ -26,7 +26,7 @@ single controller owns schema and placement (survey §7.6).
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import numpy as np
 

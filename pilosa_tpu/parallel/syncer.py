@@ -13,8 +13,8 @@ streams newly-owned fragments from source nodes — here pull-based
 from __future__ import annotations
 
 import threading
-import time
-from typing import Dict, List, Optional
+from pilosa_tpu.utils.locks import make_lock
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -183,7 +183,7 @@ class ResizePuller:
         # Overlapping resize jobs may both ask this node to pull; the
         # passes are idempotent but their schema-discovery writes race
         # (create_field "already exists"), so serialize them.
-        self._pull_lock = threading.Lock()
+        self._pull_lock = make_lock("ResizePuller._pull_lock")
 
     def _log(self, fmt, *args):
         if self.logger is not None:
@@ -328,7 +328,6 @@ class ResizePuller:
         holderCleaner likewise runs only after the cluster returns to
         NORMAL, holder.go:859)."""
         import os
-        import shutil
         from pilosa_tpu.parallel.cluster import STATE_RESIZING
         if self.cluster.state == STATE_RESIZING:
             return 0

@@ -11,8 +11,8 @@ grammar actions (startCall/addPosNum/addCond/endConditional in
 from __future__ import annotations
 
 import re
-import threading
-from typing import Any, List, Optional, Tuple
+from pilosa_tpu.utils.locks import make_lock
+from typing import Any, List, Optional
 
 from pilosa_tpu.pql.ast import (
     BETWEEN, Call, Condition, EQ, GT, GTE, LT, LTE, NEQ, Query,
@@ -499,7 +499,7 @@ def parse_string(src: str) -> Query:
 
 
 _PARSE_CACHE: "dict[str, Query]" = {}
-_PARSE_LOCK = threading.Lock()
+_PARSE_LOCK = make_lock("pql._PARSE_LOCK")
 _PARSE_CACHE_MAX = 512
 
 

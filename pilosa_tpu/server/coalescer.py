@@ -47,6 +47,7 @@ direct path whenever the coalescer is absent, stopped, or ineligible
 from __future__ import annotations
 
 import threading
+from pilosa_tpu.utils.locks import make_condition
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -128,7 +129,7 @@ class QueryCoalescer:
         # executed — tracked on self so the dispatcher-death handler
         # can resolve them too (they are no longer in _queue).
         self._inflight: List[_Item] = []
-        self._cond = threading.Condition()
+        self._cond = make_condition("QueryCoalescer._cond")
         self._flush_now: Optional[str] = None  # early-flush reason
         self._stop = False
         self._running = False

@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+from pilosa_tpu.utils.locks import make_lock
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -520,7 +521,7 @@ class PilosaHTTPServer(ThreadingHTTPServer):
     def __init__(self, *a, **kw):
         super().__init__(*a, **kw)
         self._open_conns = set()
-        self._conns_lock = threading.Lock()
+        self._conns_lock = make_lock("PilosaHTTPServer._conns_lock")
 
     def process_request(self, request, client_address):
         with self._conns_lock:

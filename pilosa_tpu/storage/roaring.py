@@ -39,7 +39,8 @@ from __future__ import annotations
 
 import io
 import struct
-from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+from typing import (Callable, Dict, Iterable, Iterator, List, Optional,
+                    Tuple)
 
 import numpy as np
 
@@ -97,7 +98,7 @@ else:  # pragma: no cover
         return int(_POP_TABLE[words.view(np.uint8)].sum())
 
 
-def _write_all(w, data: bytes) -> None:
+def _write_all(w: io.RawIOBase, data: bytes) -> None:
     """Write the whole record or raise. The op log is an UNBUFFERED
     raw file (one syscall per op, Go file-write durability), and raw
     writes may be short (e.g. ENOSPC writes what fits): an
@@ -192,7 +193,7 @@ class Bitmap:
                  "op_n_small", "oplog_bytes", "snapshot_bytes",
                  "tail_dropped")
 
-    def __init__(self, positions: Optional[Iterable[int]] = None):
+    def __init__(self, positions: Optional[Iterable[int]] = None) -> None:
         self.containers: Dict[int, np.ndarray] = {}
         self._counts: Dict[int, int] = {}
         self.op_writer: Optional[io.RawIOBase] = None
@@ -695,7 +696,7 @@ class Bitmap:
                 del self.containers[key]
                 self._invalidate(key)
 
-    def for_each_range(self, start: int, end: int):
+    def for_each_range(self, start: int, end: int) -> np.ndarray:
         # Touch only containers intersecting [start, end): block-scoped
         # callers (checksum_blocks walks 100-row blocks) must not pay a
         # whole-bitmap extraction per block.
@@ -710,7 +711,8 @@ class Bitmap:
 
     # -- set algebra (host path / CPU baseline) -----------------------------
 
-    def _binary(self, other: "Bitmap", op, keys) -> "Bitmap":
+    def _binary(self, other: "Bitmap", op: Callable[..., np.ndarray],
+                keys: Iterable[int]) -> "Bitmap":
         out = Bitmap()
         zero = None
         for key in keys:
@@ -804,7 +806,8 @@ class Bitmap:
 
     # -- ops log ------------------------------------------------------------
 
-    def _write_op(self, typ: int, value: int = 0, values: Optional[np.ndarray] = None):
+    def _write_op(self, typ: int, value: int = 0,
+                  values: Optional[np.ndarray] = None) -> None:
         self.op_n += 1 if values is None else len(values)
         if values is None:
             self.op_n_small += 1
@@ -1040,7 +1043,8 @@ class Bitmap:
             buf = buf[size:]
 
 
-def _serialize_container_seq(items, n: int) -> bytes:
+def _serialize_container_seq(items: Iterable[Tuple[int, np.ndarray, int]],
+                             n: int) -> bytes:
     """Serialize (key, container, count) triples — sorted, non-empty —
     to the file format, one dense temp at a time (the Python writer
     shared by write_bytes and the import-batch fallback). Encoding
@@ -1097,7 +1101,7 @@ class OpTruncatedError(ValueError):
     """An op record extends past EOF — a torn tail append."""
 
 
-def decode_op(buf) -> Tuple[int, int, Optional[np.ndarray], int]:
+def decode_op(buf: bytes) -> Tuple[int, int, Optional[np.ndarray], int]:
     """Decode one op record; returns (type, value, values, encoded_size).
     For OP_ADD_ROARING, `values` is the raw payload bytes."""
     if len(buf) < 13:

@@ -19,7 +19,7 @@ import json
 import os
 import platform
 import threading
-import time
+from pilosa_tpu.utils.locks import make_lock
 import urllib.request
 from typing import Any, Dict, Optional
 
@@ -38,7 +38,7 @@ class DiagnosticsCollector:
         self.holder = holder
         self.logger = logger
         self._fields: Dict[str, Any] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("DiagnosticsCollector._lock")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.server_version: Optional[str] = None  # from version check

@@ -9,6 +9,7 @@ statsd/statsd.go:41, multi-client :164). Implementations here: in-memory
 from __future__ import annotations
 
 import threading
+from pilosa_tpu.utils.locks import make_lock
 import time
 from collections import defaultdict
 from typing import Dict, List, Optional, Sequence
@@ -50,7 +51,7 @@ class MemStatsClient(StatsClient):
             self.gauges: Dict[str, float] = {}
             self.timings: Dict[str, List[float]] = defaultdict(list)
             self.sets: Dict[str, set] = defaultdict(set)
-            self._lock = threading.Lock()
+            self._lock = make_lock("MemStatsClient._lock")
 
     def _key(self, name: str) -> str:
         return f"{name}{{{','.join(self.tags)}}}" if self.tags else name
@@ -182,7 +183,7 @@ class StatsdStatsClient(StatsClient):
                      int(addr[1]) if len(addr) == 2 else 8125),
             "sock": socket.socket(socket.AF_INET, socket.SOCK_DGRAM),
             "buf": [],
-            "lock": threading.Lock(),
+            "lock": make_lock("StatsdStatsClient._shared.lock"),
             "logger": logger,
             "warned": False,
             "last_flush": time.monotonic(),

@@ -15,6 +15,7 @@ from __future__ import annotations
 import contextlib
 import json
 import threading
+from pilosa_tpu.utils.locks import make_lock
 import time
 import uuid
 from typing import Dict, List, Optional
@@ -66,7 +67,7 @@ class RecordingTracer:
         self.keep = keep
         self.finished: List[Span] = []
         self._local = threading.local()
-        self._lock = threading.Lock()
+        self._lock = make_lock("RecordingTracer._lock")
 
     def _stack(self) -> List[Span]:
         if not hasattr(self._local, "stack"):
@@ -182,7 +183,7 @@ class ExportingTracer(RecordingTracer):
         self._rl_tokens = self.sampler_param  # ratelimiting bucket
         self._rl_stamp = time.monotonic()
         self._pending: List[Span] = []
-        self._pending_lock = threading.Lock()
+        self._pending_lock = make_lock("ExportingTracer._pending_lock")
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

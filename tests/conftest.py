@@ -59,6 +59,25 @@ def pytest_configure(config):
         "faults); deselect with -m 'not slow'")
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """Under PILOSA_TPU_LOCK_CHECK=1 every lock is a Debug* wrapper that
+    raises at a cycle-closing acquire — but application code may swallow
+    that raise (the coalescer's dispatcher-died handler, for one), so
+    the session additionally fails loudly if ANY violation was recorded.
+    tools/check.sh runs the concurrency suites in this mode."""
+    if os.environ.get("PILOSA_TPU_LOCK_CHECK") != "1":
+        return
+    from pilosa_tpu.utils.locks import lock_order_violations
+
+    violations = lock_order_violations()
+    if violations:
+        session.exitstatus = 3
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        for v in violations:
+            (tr.write_line if tr else print)(
+                f"LOCK-ORDER VIOLATION: {v}")
+
+
 @pytest.hookimpl(wrapper=True)
 def pytest_runtest_call(item):
     marker = item.get_closest_marker("timeout")

@@ -1,0 +1,17 @@
+"""GL001 fail: acquire() without a structural try/finally release."""
+import threading
+
+_LOCK = threading.Lock()  # also a GL001 factory finding when scoped
+STATE = 0
+
+
+def bad_bare():
+    global STATE
+    _LOCK.acquire()
+    STATE += 1          # an exception here leaks the lock forever
+    _LOCK.release()
+
+
+def bad_conditional(timeout):
+    if _LOCK.acquire(timeout=timeout):
+        _LOCK.release()

@@ -1,0 +1,25 @@
+"""GL001 pass: with-statement and both accepted try/finally shapes."""
+from pilosa_tpu.utils.locks import make_lock
+
+_LOCK = make_lock("fixture._LOCK")
+
+
+def good_with():
+    with _LOCK:
+        return 1
+
+
+def good_acquire_then_try():
+    _LOCK.acquire()
+    try:
+        return 2
+    finally:
+        _LOCK.release()
+
+
+def good_acquire_inside_try():
+    try:
+        _LOCK.acquire()
+        return 3
+    finally:
+        _LOCK.release()

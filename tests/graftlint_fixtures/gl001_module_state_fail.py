@@ -1,0 +1,18 @@
+"""GL001 fail: module-level mutable dict mutated without any lock."""
+from pilosa_tpu.utils.locks import make_lock
+
+_CACHE = {}
+_LOCK = make_lock("fixture._LOCK")
+
+
+def put(key, value):
+    _CACHE[key] = value     # racy: no lock held
+
+
+def get(key):
+    return _CACHE.get(key)  # racy read of mutated state
+
+
+def put_in_file_cm(key, path):
+    with open(path) as f:      # a context manager is NOT a lock
+        _CACHE[key] = f.read()

@@ -1,0 +1,21 @@
+"""GL001 pass: every access to the mutated module dict holds the lock;
+read-only module constants need no lock."""
+from pilosa_tpu.utils.locks import make_lock
+
+_CACHE = {}
+_LOCK = make_lock("fixture._LOCK")
+_CONSTANT_TABLE = {"a": 1, "b": 2}  # never mutated: no findings
+
+
+def put(key, value):
+    with _LOCK:
+        _CACHE[key] = value
+
+
+def get(key):
+    with _LOCK:
+        return _CACHE.get(key)
+
+
+def lookup(key):
+    return _CONSTANT_TABLE.get(key)

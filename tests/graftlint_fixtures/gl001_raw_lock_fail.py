@@ -1,0 +1,9 @@
+"""GL001 fail (factory sub-rule): raw threading primitives invisible to
+PILOSA_TPU_LOCK_CHECK."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition()

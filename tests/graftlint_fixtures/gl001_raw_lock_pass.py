@@ -1,0 +1,8 @@
+"""GL001 pass: locks built through the factory."""
+from pilosa_tpu.utils.locks import make_condition, make_rlock
+
+
+class Worker:
+    def __init__(self):
+        self._lock = make_rlock("Worker._lock")
+        self._cond = make_condition("Worker._cond")

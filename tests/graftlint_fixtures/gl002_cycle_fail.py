@@ -1,0 +1,32 @@
+"""GL002 fail: ABBA — Alpha.step holds Alpha._lock_a then calls
+Beta.poke (takes Beta._lock_b); Beta.drain holds Beta._lock_b and calls
+Alpha.kick (takes Alpha._lock_a)."""
+from pilosa_tpu.utils.locks import make_lock
+
+
+class Alpha:
+    def __init__(self, beta):
+        self._lock_a = make_lock("Alpha._lock_a")
+        self.beta = beta
+
+    def step(self):
+        with self._lock_a:
+            self.beta.poke()
+
+    def kick(self):
+        with self._lock_a:
+            return 1
+
+
+class Beta:
+    def __init__(self, alpha):
+        self._lock_b = make_lock("Beta._lock_b")
+        self.alpha = alpha
+
+    def poke(self):
+        with self._lock_b:
+            return 2
+
+    def drain(self):
+        with self._lock_b:
+            self.alpha.kick()

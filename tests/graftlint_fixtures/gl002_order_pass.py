@@ -1,0 +1,30 @@
+"""GL002 pass: strict one-way order Alpha._lock_a -> Beta._lock_b (and a
+reentrant self-hold, which is fine for an RLock)."""
+from pilosa_tpu.utils.locks import make_lock, make_rlock
+
+
+class Alpha:
+    def __init__(self, beta):
+        self._lock_a = make_rlock("Alpha._lock_a")
+        self.beta = beta
+
+    def step(self):
+        with self._lock_a:
+            self.beta.poke()
+
+    def snapshot(self):
+        with self._lock_a:
+            return self.inner()
+
+    def inner(self):
+        with self._lock_a:  # reentrant: no self-deadlock finding
+            return 1
+
+
+class Beta:
+    def __init__(self):
+        self._lock_b = make_lock("Beta._lock_b")
+
+    def poke(self):
+        with self._lock_b:
+            return 2

@@ -1,0 +1,16 @@
+"""GL002 fail: non-reentrant Lock re-acquired through a helper call."""
+from pilosa_tpu.utils.locks import make_lock
+
+
+class Counter:
+    def __init__(self):
+        self._lock = make_lock("Counter._lock")
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            return self.read()  # read() re-takes the plain Lock
+
+    def read(self):
+        with self._lock:
+            return self.n

@@ -1,0 +1,17 @@
+"""GL003 fail: host syncs on device values in a hot-path function."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaky_count(words):
+    acc = jnp.bitwise_and(words, words)
+    host = np.asarray(acc)          # device fetch mid-pipeline
+    total = int(jnp.sum(acc))       # blocking scalar transfer
+    jax.block_until_ready(acc)      # explicit sync
+    return host, total
+
+
+def leaky_item(words):
+    s = jnp.sum(words)
+    return s.item()                 # device->host scalar

@@ -1,0 +1,19 @@
+"""GL003 pass: syncs only at annotated materialization boundaries (or
+on host-only data)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+# graftlint: materialize — fixture materialization point.
+def finalize_count(words):
+    acc = jnp.bitwise_and(words, words)
+    return int(np.asarray(jnp.sum(acc)))
+
+
+def host_only(positions):
+    arr = np.asarray(positions, dtype=np.uint64)  # host list marshalling
+    return arr.shape[0]
+
+
+def stays_on_device(words, other):
+    return jnp.bitwise_or(words, other)

@@ -1,0 +1,18 @@
+"""GL004 fail: traced scalar/tuple call sites + import-time jnp."""
+import jax
+import jax.numpy as jnp
+
+_IMPORT_TIME = jnp.zeros(8, dtype=jnp.uint32)  # device alloc at import
+
+
+@jax.jit
+def shifted(words, n):
+    return words << n
+
+
+def caller(words):
+    return shifted(words, 3)        # literal scalar traced per call
+
+
+def caller_tuple(words):
+    return shifted(words, (1, 2))   # fresh tuple positional

@@ -1,0 +1,28 @@
+"""GL004 pass: statics declared, arrays built lazily."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def shifted(words, n):
+    return words << n
+
+
+def caller(words):
+    return shifted(words, 3)        # position 1 is static: fine
+
+
+def lazy_table():
+    return jnp.zeros(8, dtype=jnp.uint32)  # inside a function: fine
+
+
+class Kernels:
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def shifted_m(self, n, words):
+        return words << n
+
+    def caller(self, words):
+        # argnum 1 (= call-site position 0 after self) IS static.
+        return self.shifted_m(3, words)

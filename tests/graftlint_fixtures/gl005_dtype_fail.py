@@ -1,0 +1,14 @@
+"""GL005 fail: word-corrupting dtypes in a word-kernel file."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def promote(words):
+    w = words.astype(jnp.int64)           # x64-off silently truncates
+    f = words.astype(np.float32)          # float destroys bit patterns
+    z = jnp.zeros(words.shape)            # dtype-less: defaults float
+    return w, f, z
+
+
+def full_no_dtype(shape):
+    return np.full(shape, 0xFFFF)    # full's dtype is positional arg 2

@@ -1,0 +1,19 @@
+"""GL005 pass: the word dtype lattice (uint words, i32 accumulators,
+bool masks)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def word_ops(words):
+    w = words.astype(jnp.uint32)
+    acc = jax.lax.population_count(w).astype(jnp.int32)
+    mask = jnp.zeros(words.shape, dtype=jnp.bool_)
+    host = np.zeros(16, dtype=np.uint64)
+    return w, acc, mask, host
+
+
+def positional_dtype(shape, dt):
+    a = np.zeros(shape, np.uint32)   # recognizable positional dtype
+    b = np.zeros(shape, dt)          # unresolvable expression: left alone
+    return a, b

@@ -1,9 +1,7 @@
 """CLI tests (reference cmd/*_test.go / ctl tests)."""
 
-import json
 import os
 
-import numpy as np
 import pytest
 
 from pilosa_tpu.cli.main import main

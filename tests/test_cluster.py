@@ -1429,7 +1429,6 @@ def test_cluster_queries_after_restart(tmp_path):
     server/server_test.go)."""
     from pilosa_tpu.core.holder import Holder
     from pilosa_tpu.server import API, serve
-    from pilosa_tpu.server.http import PilosaHTTPServer
     from pilosa_tpu.utils.stats import MemStatsClient
 
     nodes = run_cluster(tmp_path, 2, replica_n=1)

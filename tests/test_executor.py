@@ -828,7 +828,7 @@ def test_hbm_budget_subset_banks(ex, monkeypatch):
 def test_bank_budget_lru_eviction(tmp_path):
     """Total cached-bank HBM is bounded: admitting past the budget evicts
     the least recently used bank from its owning view."""
-    from pilosa_tpu.core.view import BankBudget, BANK_BUDGET
+    from pilosa_tpu.core.view import BankBudget
     h = Holder(str(tmp_path))
     h.open()
     try:

@@ -8,10 +8,7 @@ import urllib.request
 import numpy as np
 import pytest
 
-from pilosa_tpu.core.holder import Holder
-from pilosa_tpu.server import API, serve
 from pilosa_tpu.storage import Bitmap
-from pilosa_tpu.utils.stats import MemStatsClient
 
 
 @pytest.fixture

@@ -6,11 +6,8 @@ import json
 import urllib.error
 import urllib.request
 
-import numpy as np
 import pytest
 
-from pilosa_tpu.core.holder import Holder
-from pilosa_tpu.server import API, serve
 
 
 @pytest.fixture

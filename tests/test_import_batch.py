@@ -20,7 +20,6 @@ from pilosa_tpu.core.fragment import Fragment
 from pilosa_tpu.storage import roaring as roaring_mod
 from pilosa_tpu.storage.roaring import (
     Bitmap,
-    OP_ADD_ROARING,
     encode_op_roaring,
 )
 

@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# tools/check.sh — the single CI gate.
+#
+#   ruff  ->  mypy  ->  graftlint  ->  native -Werror build
+#         ->  lock-order-checked concurrency tests  ->  tier-1 pytest
+#
+# ruff/mypy are OPTIONAL tools: the jax_graft image does not bake them
+# in, so a missing binary is reported and skipped (configs live in
+# pyproject.toml and apply wherever the tools exist, e.g. dev laptops).
+# Everything else is mandatory and fails the gate.
+#
+# Usage: tools/check.sh [--fast]
+#   --fast  skip the full tier-1 pytest sweep (graftlint + native +
+#           lock-check + graftlint's own tests still run).
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+FAST=0
+[ "${1:-}" = "--fast" ] && FAST=1
+
+fail=0
+step() { printf '\n== %s\n' "$*"; }
+
+step "ruff (optional)"
+if command -v ruff >/dev/null 2>&1; then
+    ruff check pilosa_tpu tools tests || fail=1
+elif python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check pilosa_tpu tools tests || fail=1
+else
+    echo "ruff not installed — skipped (config: pyproject.toml [tool.ruff])"
+fi
+
+step "mypy (optional)"
+if python -c "import mypy" >/dev/null 2>&1; then
+    python -m mypy pilosa_tpu || fail=1
+elif command -v mypy >/dev/null 2>&1; then
+    mypy pilosa_tpu || fail=1
+else
+    echo "mypy not installed — skipped (config: pyproject.toml [tool.mypy])"
+fi
+
+step "graftlint"
+python -m tools.graftlint pilosa_tpu tests || fail=1
+
+step "native build (-Wall -Wextra -Werror)"
+make -C native clean all || fail=1
+
+step "lock-order runtime check (PILOSA_TPU_LOCK_CHECK=1)"
+PILOSA_TPU_LOCK_CHECK=1 JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_coalescer.py tests/test_concurrency.py \
+    -q -m 'not slow' -p no:cacheprovider || fail=1
+
+if [ "$FAST" = 1 ]; then
+    step "graftlint self-tests (fast mode)"
+    JAX_PLATFORMS=cpu python -m pytest tests/test_graftlint.py -q \
+        -p no:cacheprovider || fail=1
+else
+    step "tier-1 pytest"
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider || fail=1
+fi
+
+step "result"
+if [ "$fail" = 0 ]; then
+    echo "check.sh: ALL CLEAN"
+else
+    echo "check.sh: FAILURES (see above)"
+fi
+exit $fail

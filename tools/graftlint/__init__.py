@@ -1,0 +1,25 @@
+"""graftlint: project-specific static analysis for pilosa_tpu.
+
+Rules (each suppressible with ``# graftlint: disable=RULE``):
+
+- GL001 lock-discipline: bare acquire() without try/finally, unguarded
+  module-level mutable state, raw threading primitives bypassing the
+  ``pilosa_tpu.utils.locks`` factory.
+- GL002 lock-order: cycles in the static lock-acquisition graph (plus
+  the PILOSA_TPU_LOCK_CHECK=1 runtime companion in utils/locks.py).
+- GL003 host-sync-in-hot-path: .item()/np.asarray/block_until_ready on
+  device values outside materialization points in ops/, executor/,
+  storage/roaring.py.
+- GL004 retrace-hazard: traced Python scalars / fresh tuples at jitted
+  call sites; import-time jnp array construction.
+- GL005 dtype-invariant: non-word dtypes in the bitset kernels.
+
+Run: ``python -m tools.graftlint pilosa_tpu tests``
+Docs: docs/development.md
+"""
+
+from tools.graftlint.engine import Config, Finding, Project, SourceFile
+from tools.graftlint.runner import lint_files, lint_paths
+
+__all__ = ["Config", "Finding", "Project", "SourceFile", "lint_files",
+           "lint_paths"]

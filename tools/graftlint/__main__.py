@@ -1,0 +1,58 @@
+"""CLI: ``python -m tools.graftlint [paths...]``.
+
+Exit status: 0 clean, 1 findings, 2 usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.graftlint.engine import Config
+from tools.graftlint.runner import lint_paths
+from tools.graftlint.rules import ALL_RULES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="pilosa_tpu project lints: concurrency discipline "
+                    "and TPU hot-path invariants (GL001-GL005)")
+    ap.add_argument("paths", nargs="*", default=["pilosa_tpu", "tests"],
+                    help="files or directories (default: pilosa_tpu "
+                         "tests)")
+    ap.add_argument("--select", help="comma-separated rule codes to run")
+    ap.add_argument("--ignore", help="comma-separated rule codes to skip")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            doc = (type(r).__module__ and
+                   (sys.modules[type(r).__module__].__doc__ or ""))
+            first = doc.strip().splitlines()[0] if doc else ""
+            print(f"{r.code}  {r.name:24s} {first}")
+        return 0
+
+    cfg = Config()
+    if args.select:
+        cfg.select = {c.strip() for c in args.select.split(",")}
+    if args.ignore:
+        cfg.ignore = {c.strip() for c in args.ignore.split(",")}
+    try:
+        findings = lint_paths(args.paths or ["pilosa_tpu", "tests"], cfg)
+    except SyntaxError as e:
+        print(f"graftlint: parse error: {e}", file=sys.stderr)
+        return 2
+    for f in findings:
+        print(f.format())
+    n = len(findings)
+    if n:
+        print(f"graftlint: {n} finding{'s' if n != 1 else ''}")
+        return 1
+    print("graftlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

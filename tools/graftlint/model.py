@@ -1,0 +1,179 @@
+"""Project-wide semantic model shared by the cross-file rules.
+
+Collects, in one pass over every file:
+
+- lock *nodes*: attributes assigned a lock in a class
+  (``self._lock = make_rlock(...)`` / ``threading.Lock()``) become
+  ``Class.attr``; module-level locks become ``module.NAME``; locals
+  assigned a lock become ``module.func.NAME``.
+- every function/method, addressable as ``module.Class.method`` or
+  ``module.func``, with its AST.
+- a method-name index used for conservative call resolution: a call
+  ``x.m(...)`` resolves to class ``C`` only when exactly ONE project
+  class defines ``m`` (ambiguous names are skipped — under-approximate,
+  never false-cycle).
+
+The model deliberately has no type inference; GL002's guarantee is
+"no cycle among the edges we can prove", which in this codebase (self
+calls + unique method names) covers the real lock nesting.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import Project, SourceFile, dotted_name
+
+LOCK_FACTORIES = {"make_lock", "make_rlock", "make_condition"}
+
+# Methods of builtin containers/files/primitives: an `x.clear()` where x
+# is a dict must never resolve to a same-named project method, so
+# unique-name resolution skips these outright (self.m() still resolves
+# exactly).
+BUILTIN_METHODS = {
+    "clear", "get", "pop", "popitem", "update", "add", "append",
+    "extend", "remove", "discard", "insert", "index", "count", "sort",
+    "reverse", "copy", "setdefault", "items", "keys", "values", "join",
+    "split", "strip", "close", "read", "write", "flush", "send", "recv",
+    "connect", "start", "run", "wait", "notify", "notify_all",
+    "acquire", "release", "set", "isSet", "is_set", "format", "encode",
+    "decode", "tolist", "item", "astype", "view", "sum", "max", "min",
+}
+
+
+def lock_ctor_kind(call: ast.AST) -> Optional[str]:
+    """'lock' / 'rlock' / 'condition' when `call` constructs a lock via
+    the threading module or the pilosa_tpu.utils.locks factory; else
+    None. A Condition is ordered like a lock (its underlying lock is
+    what's held)."""
+    if not isinstance(call, ast.Call):
+        return None
+    fn = dotted_name(call.func)
+    if fn in ("threading.Lock", "make_lock"):
+        return "lock"
+    if fn in ("threading.RLock", "make_rlock"):
+        return "rlock"
+    if fn in ("threading.Condition", "make_condition"):
+        return "condition"
+    return None
+
+
+@dataclass
+class FuncInfo:
+    qualname: str            # module.Class.method or module.func
+    module: str
+    cls: Optional[str]
+    name: str
+    node: ast.AST            # FunctionDef
+    sf: SourceFile
+
+
+@dataclass
+class LockInfo:
+    node_name: str           # "Class.attr" or "module.NAME"
+    reentrant: bool
+    sf: SourceFile
+    lineno: int
+
+
+@dataclass
+class Model:
+    funcs: Dict[str, FuncInfo] = field(default_factory=dict)
+    # method name -> [FuncInfo]; used for unique-name call resolution.
+    by_method: Dict[str, List[FuncInfo]] = field(default_factory=dict)
+    # lock node name -> LockInfo
+    locks: Dict[str, LockInfo] = field(default_factory=dict)
+    # (class name, attr) -> lock node name
+    class_lock_attrs: Dict[Tuple[str, str], str] = field(
+        default_factory=dict)
+    # attr name -> {lock node names}; for resolving `other._lock`-style
+    # references when the attr name is unique project-wide.
+    lock_attr_names: Dict[str, Set[str]] = field(default_factory=dict)
+    # module name -> {module-level lock var name -> node name}
+    module_locks: Dict[str, Dict[str, str]] = field(default_factory=dict)
+
+    def resolve_method(self, name: str,
+                       cls: Optional[str] = None) -> Optional[FuncInfo]:
+        """Resolve a method call by name: exact (cls, name) when the
+        class is known, else unique-name across the project — except
+        builtin container/file method names, which stay unresolved (an
+        `x.clear()` on a dict must not alias a project `clear`)."""
+        if cls is not None:
+            fi = self.funcs.get(f_qual(cls, name))
+            if fi is not None:
+                return fi
+        if name in BUILTIN_METHODS:
+            return None
+        cands = self.by_method.get(name, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+
+def f_qual(cls: Optional[str], name: str) -> str:
+    return f"{cls}.{name}" if cls else name
+
+
+def module_name(sf: SourceFile) -> str:
+    p = sf.path
+    if p.endswith(".py"):
+        p = p[:-3]
+    return p.replace("/", ".")
+
+
+def build_model(project: Project) -> Model:
+    m = Model()
+    for sf in project.files:
+        mod = module_name(sf)
+        # module-level locks + functions
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                kind = lock_ctor_kind(node.value)
+                if kind:
+                    var = node.targets[0].id
+                    nn = f"{mod}.{var}"
+                    m.locks[nn] = LockInfo(nn, kind == "rlock", sf,
+                                           node.lineno)
+                    m.module_locks.setdefault(mod, {})[var] = nn
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _add_func(m, sf, mod, None, node)
+            if isinstance(node, ast.ClassDef):
+                cls = node.name
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        _add_func(m, sf, mod, cls, sub)
+                        _scan_lock_attrs(m, sf, cls, sub)
+    return m
+
+
+def _add_func(m: Model, sf: SourceFile, mod: str, cls: Optional[str],
+              node: ast.AST) -> None:
+    name = node.name
+    key = f_qual(cls, name)
+    fi = FuncInfo(f"{mod}.{key}", mod, cls, name, node, sf)
+    # Key by Class.method / bare name: call resolution never knows the
+    # defining module, only (maybe) the class.
+    m.funcs.setdefault(key, fi)
+    if cls is not None:
+        m.by_method.setdefault(name, []).append(fi)
+
+
+def _scan_lock_attrs(m: Model, sf: SourceFile, cls: str,
+                     method: ast.AST) -> None:
+    for node in ast.walk(method):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                kind = lock_ctor_kind(node.value)
+                if kind:
+                    nn = f"{cls}.{t.attr}"
+                    m.locks[nn] = LockInfo(nn, kind == "rlock", sf,
+                                           node.lineno)
+                    m.class_lock_attrs[(cls, t.attr)] = nn
+                    m.lock_attr_names.setdefault(t.attr, set()).add(nn)

@@ -1,0 +1,15 @@
+"""graftlint rule registry."""
+
+from tools.graftlint.rules.gl001_locks import GL001LockDiscipline
+from tools.graftlint.rules.gl002_lockorder import GL002LockOrder
+from tools.graftlint.rules.gl003_hostsync import GL003HostSync
+from tools.graftlint.rules.gl004_retrace import GL004Retrace
+from tools.graftlint.rules.gl005_dtype import GL005DtypeInvariant
+
+ALL_RULES = (
+    GL001LockDiscipline(),
+    GL002LockOrder(),
+    GL003HostSync(),
+    GL004Retrace(),
+    GL005DtypeInvariant(),
+)

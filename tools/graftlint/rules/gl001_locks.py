@@ -1,0 +1,248 @@
+"""GL001 — lock discipline.
+
+Three sub-checks, all per-file:
+
+(a) *bare acquire*: any ``X.acquire(...)`` call whose release is not
+    structurally guaranteed. Accepted shapes::
+
+        X.acquire()          # statement immediately followed by
+        try:                 # a try whose finally releases X
+            ...
+        finally:
+            X.release()
+
+    and acquire as the first statement *inside* such a try. Everything
+    else — conditional acquires, acquire in an expression, acquire with
+    the release on the normal path only — is flagged; use ``with X:``.
+
+(b) *unguarded module state*: module-level mutable containers (dict /
+    list / set / deque / defaultdict displays or constructors) in the
+    configured packages that some function MUTATES. Once a name is
+    mutated anywhere, every function-level read or write of it must sit
+    inside a ``with <lock>:`` region (any project lock). Containers
+    only ever populated at import time are constants and never flagged.
+
+(c) *factory bypass*: ``threading.Lock()/RLock()/Condition()``
+    constructed directly inside the package instead of through
+    ``pilosa_tpu.utils.locks.make_*`` — a raw primitive is invisible to
+    the PILOSA_TPU_LOCK_CHECK=1 runtime order checker.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set
+
+from tools.graftlint.engine import (
+    Finding, Project, Rule, SourceFile, dotted_name, walk_shallow,
+)
+
+_MUTATING_METHODS = {
+    "append", "add", "pop", "popitem", "update", "setdefault", "extend",
+    "remove", "discard", "clear", "insert", "appendleft", "popleft",
+}
+_MUTABLE_CTORS = {"dict", "list", "set", "defaultdict", "deque",
+                  "OrderedDict", "Counter"}
+
+
+class GL001LockDiscipline(Rule):
+    code = "GL001"
+    name = "lock-discipline"
+
+    def check_file(self, sf: SourceFile,
+                   project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        self._check_bare_acquire(sf, out)
+        cfg = project.config
+        if sf.in_path(cfg.state_paths):
+            self._check_module_state(sf, out)
+        if sf.in_path(cfg.factory_paths) \
+                and not sf.in_path(cfg.factory_exempt):
+            self._check_factory(sf, out)
+        return out
+
+    # ------------------------------------------------------ (a) bare acquire
+
+    def _check_bare_acquire(self, sf: SourceFile, out: List[Finding]
+                            ) -> None:
+        safe: Set[int] = set()  # id() of acquire Call nodes proven safe
+        for node in ast.walk(sf.tree):
+            body = getattr(node, "body", None)
+            if not isinstance(body, list):
+                continue
+            for attr in ("body", "orelse", "finalbody"):
+                stmts = getattr(node, attr, None)
+                if isinstance(stmts, list):
+                    self._mark_safe_pairs(stmts, safe)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire" \
+                    and id(node) not in safe:
+                obj = dotted_name(node.func.value) or "<lock>"
+                out.append(Finding(
+                    sf.path, node.lineno, node.col_offset, self.code,
+                    f"bare {obj}.acquire() without a structural "
+                    f"try/finally release — use `with {obj}:` (or "
+                    f"acquire();try:...finally:release())"))
+
+    def _mark_safe_pairs(self, stmts: List[ast.stmt],
+                         safe: Set[int]) -> None:
+        for i, st in enumerate(stmts):
+            call = self._stmt_acquire_call(st)
+            if call is None:
+                continue
+            obj = dotted_name(call.func.value)
+            # acquire();  try: ... finally: release()
+            if i + 1 < len(stmts) and self._try_releases(stmts[i + 1], obj):
+                safe.add(id(call))
+            # try: acquire(); ... finally: release()  (release always
+            # runs; over-release on a failed acquire is the caller's
+            # accepted trade in this shape)
+        for st in stmts:
+            if isinstance(st, ast.Try) and st.finalbody and st.body:
+                call = self._stmt_acquire_call(st.body[0])
+                if call is not None and self._releases(
+                        st.finalbody, dotted_name(call.func.value)):
+                    safe.add(id(call))
+
+    @staticmethod
+    def _stmt_acquire_call(st: ast.stmt) -> Optional[ast.Call]:
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            c = st.value
+            if isinstance(c.func, ast.Attribute) \
+                    and c.func.attr == "acquire":
+                return c
+        return None
+
+    def _try_releases(self, st: ast.stmt, obj: Optional[str]) -> bool:
+        return isinstance(st, ast.Try) and st.finalbody \
+            and self._releases(st.finalbody, obj)
+
+    @staticmethod
+    def _releases(stmts: List[ast.stmt], obj: Optional[str]) -> bool:
+        for node in stmts:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "release" \
+                        and dotted_name(sub.func.value) == obj:
+                    return True
+        return False
+
+    # --------------------------------------------------- (b) module state
+
+    def _check_module_state(self, sf: SourceFile,
+                            out: List[Finding]) -> None:
+        mutable: Set[str] = set()
+        for node in sf.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+                value = node.value
+            else:
+                continue
+            if self._is_mutable_ctor(value):
+                mutable.update(t.id for t in targets
+                               if isinstance(t, ast.Name))
+        if not mutable:
+            return
+        funcs = [n for n in ast.walk(sf.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        # Pass 1: which names does any function mutate?
+        mutated: Set[str] = set()
+        for fn in funcs:
+            for name, _node, is_write in self._state_accesses(fn, mutable):
+                if is_write:
+                    mutated.add(name)
+        if not mutated:
+            return  # import-time constants
+        # Pass 2: every access to a mutated name must be under a lock.
+        for fn in funcs:
+            for name, node, _w in self._state_accesses(fn, mutated):
+                if not self._under_lock(fn, node):
+                    out.append(Finding(
+                        sf.path, node.lineno, node.col_offset, self.code,
+                        f"module-level mutable `{name}` accessed without "
+                        f"holding a lock (it is mutated elsewhere in this "
+                        f"module; guard every access)"))
+
+    @staticmethod
+    def _is_mutable_ctor(value: ast.AST) -> bool:
+        if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)):
+            return True
+        return isinstance(value, ast.Call) \
+            and isinstance(value.func, ast.Name) \
+            and value.func.id in _MUTABLE_CTORS
+
+    def _state_accesses(self, fn: ast.AST, names: Set[str]):
+        """Yield (name, node, is_write) for accesses to module-level
+        `names` inside `fn` (not descending into nested defs — they get
+        their own pass)."""
+        for node in walk_shallow(fn):
+            if isinstance(node, ast.Name) and node.id in names:
+                yield node.id, node, isinstance(node.ctx,
+                                                (ast.Store, ast.Del))
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id in names \
+                    and isinstance(node.ctx, (ast.Store, ast.Del)):
+                yield node.value.id, node, True
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in names \
+                    and node.func.attr in _MUTATING_METHODS:
+                yield node.func.value.id, node, True
+
+    _LOCKISH = re.compile(r"lock|mutex|cond|sem|guard", re.IGNORECASE)
+
+    def _under_lock(self, fn: ast.AST, target: ast.AST) -> bool:
+        """True when `target` sits lexically inside a with-statement
+        over something lock-SHAPED: the context expression's terminal
+        name matches lock/mutex/cond/sem/guard (precision about WHICH
+        lock belongs to GL002). `with open(path):` does not count."""
+        path: List[ast.AST] = []
+
+        def visit(node):
+            if node is target:
+                return True
+            for child in ast.iter_child_nodes(node):
+                path.append(node)
+                if visit(child):
+                    return True
+                path.pop()
+            return False
+
+        if not visit(fn):
+            return False
+        for p in path:
+            if isinstance(p, ast.With):
+                for item in p.items:
+                    name = dotted_name(item.context_expr)
+                    if name and self._LOCKISH.search(
+                            name.rsplit(".", 1)[-1]):
+                        return True
+        return False
+
+    # ------------------------------------------------------- (c) factory
+
+    def _check_factory(self, sf: SourceFile, out: List[Finding]) -> None:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                fn = dotted_name(node.func)
+                if fn in ("threading.Lock", "threading.RLock",
+                          "threading.Condition"):
+                    kind = fn.rsplit(".", 1)[1]
+                    factory = {"Lock": "make_lock", "RLock": "make_rlock",
+                               "Condition": "make_condition"}[kind]
+                    out.append(Finding(
+                        sf.path, node.lineno, node.col_offset, self.code,
+                        f"raw threading.{kind}() — construct via "
+                        f"pilosa_tpu.utils.locks.{factory}(name) so "
+                        f"PILOSA_TPU_LOCK_CHECK=1 can order-check it"))

@@ -1,0 +1,261 @@
+"""GL002 — static lock-acquisition order.
+
+Builds the project-wide lock graph: an edge A -> B means some code
+path acquires lock B while holding lock A. Sources of edges:
+
+- a ``with <lockB>:`` lexically nested inside a ``with <lockA>:``;
+- a call made while holding A to a function whose *transitive*
+  may-acquire set contains B (fixpoint over the resolvable call graph).
+
+Call resolution is deliberately conservative (see model.py): ``self.m``
+resolves within the class, ``x.m`` only when ``m`` is defined by
+exactly one project class, bare ``f()`` within the defining module.
+Unresolvable calls contribute no edges — GL002 under-approximates and
+never invents a cycle.
+
+Findings:
+- any cycle among distinct locks (the classic ABBA deadlock), reported
+  once per strongly-connected component with an example path;
+- re-acquisition of a NON-reentrant lock while already held (guaranteed
+  self-deadlock on the same instance).
+
+The runtime companion (``pilosa_tpu.utils.locks``, enabled by
+``PILOSA_TPU_LOCK_CHECK=1``) checks the same property on the orders a
+real run actually exhibits, catching what static resolution can't see.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding, Project, Rule, walk_shallow,
+)
+from tools.graftlint.model import FuncInfo, Model
+
+
+class GL002LockOrder(Rule):
+    code = "GL002"
+    name = "lock-order"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        model = project.model
+        if not model.locks:
+            return []
+        infos = list({id(fi): fi for fi in model.funcs.values()}.values())
+        direct: Dict[str, Set[str]] = {}
+        for fi in infos:
+            direct[fi.qualname] = {
+                lock for lock, _node in self._direct_locks(fi, model)}
+        may = self._fixpoint(infos, direct, model)
+        edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        findings: List[Finding] = []
+        for fi in infos:
+            self._collect_edges(fi, model, may, edges, findings)
+        findings.extend(self._report_cycles(edges, model))
+        return findings
+
+    # ---------------------------------------------------- lock resolution
+
+    def _resolve_lock(self, expr: ast.AST, fi: FuncInfo,
+                      model: Model) -> Optional[str]:
+        """Lock node name for a with-context / acquire target expr."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base == "self" and fi.cls is not None:
+                hit = model.class_lock_attrs.get((fi.cls, attr))
+                if hit:
+                    return hit
+            hits = model.lock_attr_names.get(attr, set())
+            if len(hits) == 1:
+                return next(iter(hits))
+            return None
+        if isinstance(expr, ast.Name):
+            mod_locks = model.module_locks.get(fi.module, {})
+            return mod_locks.get(expr.id)
+        return None
+
+    def _direct_locks(self, fi: FuncInfo, model: Model):
+        """(lock node, With/Call ast node) directly acquired in fi."""
+        for node in walk_shallow(fi.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lock = self._resolve_lock(item.context_expr, fi, model)
+                    if lock:
+                        yield lock, node
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                lock = self._resolve_lock(node.func.value, fi, model)
+                if lock:
+                    yield lock, node
+
+    # -------------------------------------------------------- call graph
+
+    def _resolve_call(self, call: ast.Call, fi: FuncInfo,
+                      model: Model) -> Optional[FuncInfo]:
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                return model.resolve_method(f.attr, cls=fi.cls)
+            return model.resolve_method(f.attr)
+        if isinstance(f, ast.Name):
+            cand = model.funcs.get(f.id)
+            if cand is not None and cand.cls is None \
+                    and cand.module == fi.module:
+                return cand
+        return None
+
+    def _fixpoint(self, infos: List[FuncInfo],
+                  direct: Dict[str, Set[str]],
+                  model: Model) -> Dict[str, Set[str]]:
+        callees: Dict[str, Set[str]] = {}
+        for fi in infos:
+            outs: Set[str] = set()
+            for node in walk_shallow(fi.node):
+                if isinstance(node, ast.Call):
+                    callee = self._resolve_call(node, fi, model)
+                    if callee is not None:
+                        outs.add(callee.qualname)
+            callees[fi.qualname] = outs
+        may = {q: set(s) for q, s in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for q, outs in callees.items():
+                cur = may[q]
+                before = len(cur)
+                for callee in outs:
+                    cur |= may.get(callee, set())
+                changed = changed or len(cur) != before
+        return may
+
+    # ------------------------------------------------------------- edges
+
+    def _collect_edges(self, fi: FuncInfo, model: Model,
+                       may: Dict[str, Set[str]],
+                       edges: Dict[Tuple[str, str], Tuple[str, int, str]],
+                       findings: List[Finding]) -> None:
+        for node in walk_shallow(fi.node):
+            if not isinstance(node, ast.With):
+                continue
+            held = [self._resolve_lock(i.context_expr, fi, model)
+                    for i in node.items]
+            held = [h for h in held if h]
+            if not held:
+                continue
+            for inner in walk_shallow(node):
+                acquired: List[Tuple[str, int, str]] = []
+                if isinstance(inner, ast.With):
+                    for item in inner.items:
+                        lk = self._resolve_lock(item.context_expr, fi,
+                                                model)
+                        if lk:
+                            acquired.append(
+                                (lk, inner.lineno,
+                                 f"nested with in {fi.qualname}"))
+                elif isinstance(inner, ast.Call):
+                    callee = self._resolve_call(inner, fi, model)
+                    if callee is not None:
+                        for lk in may.get(callee.qualname, ()):
+                            acquired.append(
+                                (lk, inner.lineno,
+                                 f"{fi.qualname} calls "
+                                 f"{callee.qualname} under lock"))
+                for lk, lineno, why in acquired:
+                    for h in held:
+                        if h == lk:
+                            info = model.locks.get(h)
+                            if info is not None and not info.reentrant \
+                                    and not fi.sf.suppressed(self.code,
+                                                             lineno):
+                                findings.append(Finding(
+                                    fi.sf.path, lineno, 0, self.code,
+                                    f"non-reentrant lock {h} re-acquired "
+                                    f"while held ({why}) — self-deadlock "
+                                    f"on the same instance"))
+                            continue
+                        edges.setdefault(
+                            (h, lk), (fi.sf.path, lineno, why))
+
+    # ------------------------------------------------------------ cycles
+
+    def _report_cycles(self, edges, model: Model) -> List[Finding]:
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, set()).add(b)
+            adj.setdefault(b, set())
+        sccs = _tarjan(adj)
+        out: List[Finding] = []
+        for scc in sccs:
+            if len(scc) < 2:
+                continue
+            cyc = sorted(scc)
+            parts = []
+            for i, a in enumerate(cyc):
+                b = cyc[(i + 1) % len(cyc)]
+                prov = edges.get((a, b))
+                if prov:
+                    parts.append(f"{a} -> {b} ({prov[0]}:{prov[1]})")
+            first = min((edges[(a, b)] for a in scc for b in scc
+                         if (a, b) in edges),
+                        key=lambda p: (p[0], p[1]))
+            out.append(Finding(
+                first[0], first[1], 0, self.code,
+                f"lock-order cycle among {{{', '.join(cyc)}}}: "
+                + "; ".join(parts)))
+        return out
+
+
+def _tarjan(adj: Dict[str, Set[str]]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # Iterative DFS (the lock graph is tiny, but recursion limits
+        # are not a failure mode a linter should have).
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    for v in list(adj):
+        if v not in index:
+            strongconnect(v)
+    return sccs

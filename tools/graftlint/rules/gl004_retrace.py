@@ -1,0 +1,183 @@
+"""GL004 — recompilation hazards.
+
+Two checks:
+
+(a) *unstable jit call sites*: a function wrapped by ``jax.jit`` /
+    ``jax.pmap`` (decorator, ``functools.partial(jax.jit, ...)`` or
+    ``f = jax.jit(g)`` alias) that is then called with a Python
+    number/bool literal or a fresh tuple/list display at a positional
+    slot not covered by ``static_argnums``. Scalars meant as
+    compile-time configuration (axis counts, flags, shapes) must be
+    static or the program either fails to trace (shape-dependent) or
+    quietly burns compile cache entries per call pattern.
+
+(b) *import-time device work*: ``jnp.zeros/ones/array/...`` at module
+    scope — array construction at import initializes the backend and
+    allocates device memory before the process has configured
+    platforms/meshes (and breaks JAX_PLATFORMS-switching tests).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding, Project, Rule, SourceFile, dotted_name,
+)
+
+_JIT_NAMES = ("jax.jit", "jit", "jax.pmap", "pmap")
+_JNP_CONSTRUCTORS = {
+    "zeros", "ones", "full", "empty", "array", "asarray", "arange",
+    "linspace", "eye", "stack", "concatenate",
+}
+
+
+def _jit_wrap_info(call: ast.Call) -> Optional[Tuple[bool, Set[int]]]:
+    """(is_jit, static_argnums) when `call` is jax.jit(...)-ish or
+    functools.partial(jax.jit, ...); None otherwise. static_argnames
+    presence is modeled as 'has statics' with unknown positions — such
+    functions are skipped (kwargs-passed statics are fine by
+    construction)."""
+    fn = dotted_name(call.func)
+    inner = call
+    if fn in ("functools.partial", "partial") and call.args:
+        first = call.args[0]
+        if dotted_name(first) in _JIT_NAMES:
+            inner = call
+            fn = dotted_name(first)
+        elif isinstance(first, ast.Call) \
+                and dotted_name(first.func) in _JIT_NAMES:
+            inner = first
+            fn = dotted_name(first.func)
+        else:
+            return None
+    if fn not in _JIT_NAMES:
+        return None
+    statics: Set[int] = set()
+    for kw in inner.keywords:
+        if kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value,
+                                                              int):
+                    statics.add(n.value)
+        elif kw.arg == "static_argnames":
+            return True, {-1}  # sentinel: named statics, skip call check
+    return True, statics
+
+
+class GL004Retrace(Rule):
+    code = "GL004"
+    name = "retrace-hazard"
+
+    def check_file(self, sf: SourceFile,
+                   project: Project) -> Iterable[Finding]:
+        out: List[Finding] = []
+        jitted = self._collect_jitted(sf)
+        self._check_call_sites(sf, jitted, out)
+        self._check_import_time(sf, out)
+        return out
+
+    # --------------------------------------------------- jitted functions
+
+    def _collect_jitted(self, sf: SourceFile) -> Dict[str, Tuple[
+            Set[int], int]]:
+        """name -> (static_argnums, self_offset). For a jitted METHOD
+        the wrapped function's argnum 0 is `self`, so a call-site
+        positional index i corresponds to argnum i+1: self_offset=1."""
+        jitted: Dict[str, Tuple[Set[int], int]] = {}
+        method_names = {
+            sub.name
+            for node in ast.walk(sf.tree) if isinstance(node, ast.ClassDef)
+            for sub in node.body
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and sub.args.args and sub.args.args[0].arg == "self"}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for deco in node.decorator_list:
+                    if isinstance(deco, ast.Call):
+                        info = _jit_wrap_info(deco)
+                    elif dotted_name(deco) in _JIT_NAMES:
+                        info = (True, set())
+                    else:
+                        info = None
+                    if info:
+                        offset = 1 if node.name in method_names else 0
+                        jitted[node.name] = (info[1], offset)
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                info = _jit_wrap_info(node.value)
+                if info:
+                    jitted[node.targets[0].id] = (info[1], 0)
+        return jitted
+
+    def _check_call_sites(self, sf: SourceFile,
+                          jitted: Dict[str, Tuple[Set[int], int]],
+                          out: List[Finding]) -> None:
+        if not jitted:
+            return
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = None
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in jitted:
+                name = f.id
+            elif isinstance(f, ast.Attribute) and f.attr in jitted \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "self":
+                name = f.attr
+            if name is None:
+                continue
+            statics, offset = jitted[name]
+            if -1 in statics:
+                continue  # static_argnames: keyword statics, fine
+            for pos, arg in enumerate(node.args):
+                if pos + offset in statics:
+                    continue
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, (int, float, bool)):
+                    out.append(Finding(
+                        sf.path, arg.lineno, arg.col_offset, self.code,
+                        f"Python scalar {arg.value!r} passed positionally "
+                        f"to jitted `{name}` (argnum {pos + offset}) without "
+                        f"static_argnums — traced scalars defeat "
+                        f"compile-time specialization"))
+                elif isinstance(arg, (ast.Tuple, ast.List)):
+                    out.append(Finding(
+                        sf.path, arg.lineno, arg.col_offset, self.code,
+                        f"fresh {type(arg).__name__.lower()} display "
+                        f"passed positionally to jitted `{name}` (argnum "
+                        f"{pos + offset}) without static_argnums — "
+                        f"shape-bearing args must be static"))
+
+    # ----------------------------------------------------- import-time jnp
+
+    def _check_import_time(self, sf: SourceFile,
+                           out: List[Finding]) -> None:
+        for node in self._module_scope_nodes(sf.tree):
+            if isinstance(node, ast.Call):
+                fn = dotted_name(node.func)
+                if fn and fn.startswith("jnp.") \
+                        and fn.split(".")[-1] in _JNP_CONSTRUCTORS:
+                    out.append(Finding(
+                        sf.path, node.lineno, node.col_offset, self.code,
+                        f"`{fn}` at module import time allocates on the "
+                        f"device before backend configuration — build "
+                        f"lazily inside a function"))
+
+    @staticmethod
+    def _module_scope_nodes(tree: ast.Module):
+        """Module-level expressions only: no descent into function or
+        class-method bodies (class *bodies* do run at import, so their
+        direct statements are included)."""
+        stack = list(tree.body)
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))

@@ -1,0 +1,52 @@
+"""File discovery and the lint entry points used by CLI and tests."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence
+
+from tools.graftlint.engine import (
+    Config, Finding, Project, Rule, SourceFile, run_rules,
+)
+
+# Directories never walked into. graftlint_fixtures holds deliberately
+# failing snippets for tests/test_graftlint.py — they lint clean only
+# when a test points a rule at them explicitly.
+_SKIP_DIRS = {"__pycache__", ".git", "graftlint_fixtures",
+              ".pytest_cache", "node_modules"}
+
+
+def discover(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)  # explicit file: always linted, even fixtures
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    return out
+
+
+def load_files(paths: Sequence[str]) -> List[SourceFile]:
+    files: List[SourceFile] = []
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        files.append(SourceFile(path, text))
+    return files
+
+
+def lint_files(paths: Sequence[str], config: Optional[Config] = None,
+               rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Lint exactly these files (no discovery); the unit-test entry."""
+    from tools.graftlint.rules import ALL_RULES
+    project = Project(load_files(paths), config or Config())
+    return run_rules(project, rules if rules is not None else ALL_RULES)
+
+
+def lint_paths(paths: Sequence[str], config: Optional[Config] = None,
+               rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    return lint_files(discover(paths), config, rules)
