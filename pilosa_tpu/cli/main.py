@@ -120,6 +120,11 @@ def cmd_server(args) -> int:
     api.logger = logger
     api.long_query_time = cfg.long_query_time
     api.executor.max_writes_per_request = cfg.max_writes_per_request
+    # Query profiler policy: device-fence 1-in-N unforced queries and
+    # bound the /debug/queries slow-query ring (utils/profile.py;
+    # ?profile=true always fences regardless of sample_every).
+    api.profiler.configure(sample_every=cfg.profile_sample_every,
+                           ring_size=cfg.profile_slow_ring)
     coalescer = None
     if cfg.coalescer_enabled:
         # Cross-request query coalescer: concurrent single-query POSTs

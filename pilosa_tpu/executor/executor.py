@@ -22,11 +22,13 @@ dispatch), :2277 (mapReduce). Structural translation to TPU:
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import itertools
 import logging
 import os
 import threading
+import time
 from pilosa_tpu.utils.locks import make_lock
 from dataclasses import dataclass, field as dc_field
 from datetime import datetime
@@ -46,7 +48,8 @@ from pilosa_tpu.executor import bsi
 from pilosa_tpu.executor.results import (
     FieldRow, GroupCount, PairsResult, RowIdentifiers, RowResult, ValCount,
 )
-from pilosa_tpu.ops.bitset import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.ops.bitset import SHARD_WIDTH, WORDS_PER_SHARD, \
+    transfer_nbytes
 from pilosa_tpu.pql import Call, Condition, Query, parse_string_cached
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
 
@@ -191,6 +194,18 @@ def prefetch_pendings(staged) -> None:
                         pass  # transfer still happens in finalize
 
 
+# graftlint: materialize — sampled device-time fence: reached ONLY when
+# the active QueryProfile requests device sampling (?profile=true or the
+# configured 1-in-N sample). The unprofiled hot path never calls it, so
+# the dispatch queue stays async (tests/test_profile.py asserts zero
+# calls without a sampling profile).
+def _fence_device(out) -> float:
+    import jax
+    t0 = time.perf_counter()
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
 class ExecutionError(ValueError):
     pass
 
@@ -328,6 +343,14 @@ class Executor:
         # Per-thread dispatch context (one executor serves all request
         # threads): whether calls after the one being dispatched write.
         self._tls = threading.local()
+        # Process-wide retrace counter: every shape-keyed jit-cache miss
+        # (a fresh XLA trace+compile) across the instance's jit sites.
+        # An unexpected climb under steady traffic means some query
+        # attribute leaked into a compile key (utils/profile.py surfaces
+        # it per query; /metrics exports the running total). Incremented
+        # via _note_jit_compile — request threads race here.
+        self.jit_compiles = 0
+        self._jit_stats_lock = make_lock("Executor._jit_stats_lock")
         # Observability: TopN answers served from warm ranked caches
         # without any device work (reference fragment.top, fragment.go:1067).
         self.topn_cache_hits = 0
@@ -375,16 +398,44 @@ class Executor:
     def _resolve_row_key(self, idx: Index, field: Field, key: str) -> int:
         return self._resolve_row_keys(idx, field, [key])[0]
 
+    # ------------------------------------------------------- profiling hooks
+
+    def _note_jit_compile(self) -> None:
+        """Count one fresh XLA trace+compile (jit-cache miss). '+= 1'
+        is not atomic and every request thread can land here."""
+        with self._jit_stats_lock:
+            self.jit_compiles += 1
+
+    def _profile(self):
+        """The QueryProfile attached to the current thread's in-flight
+        query, or None (the common, zero-overhead case)."""
+        return getattr(self._tls, "profile", None)
+
+    @contextlib.contextmanager
+    def _profiled(self, profile):
+        """Attach `profile` (may be None) to this thread for the
+        duration — the executor's instrumentation points read it via
+        _profile(). Thread-local because one executor serves every
+        request thread."""
+        prev = getattr(self._tls, "profile", None)
+        self._tls.profile = profile
+        try:
+            yield
+        finally:
+            self._tls.profile = prev
+
     # ------------------------------------------------------------------ API
 
     def execute(self, index_name: str, query, shards: Optional[Sequence[int]]
-                = None) -> List[Any]:
+                = None, profile=None) -> List[Any]:
         """Execute every call in `query` (reference executor.Execute,
-        executor.go:84)."""
-        results, _ = self._execute_query(index_name, query, shards)
+        executor.go:84). `profile` is an optional utils/profile
+        QueryProfile the run fills in."""
+        results, _ = self._execute_query(index_name, query, shards,
+                                         profile=profile)
         return results
 
-    def _execute_query(self, index_name: str, query, shards
+    def _execute_query(self, index_name: str, query, shards, profile=None
                        ) -> Tuple[List[Any], "ExecOptions"]:
         # Two phases: dispatch every call's device program in call order
         # (jax dispatch is async — programs queue on the device), then
@@ -392,9 +443,11 @@ class Executor:
         # device→host drain instead of a blocking round trip per call —
         # the TPU analog of the reference streaming per-shard results
         # into reduceFn as they arrive (executor.go:2277).
-        idx, staged, opts = self._dispatch_query(index_name, query, shards)
-        prefetch_pendings(staged)
-        return self._finalize_staged(idx, staged), opts
+        with self._profiled(profile):
+            idx, staged, opts = self._dispatch_query(index_name, query,
+                                                     shards)
+            prefetch_pendings(staged)
+            return self._finalize_staged(idx, staged), opts
 
     def _dispatch_query(self, index_name: str, query, shards,
                         batch_tail_writes: bool = False):
@@ -415,32 +468,52 @@ class Executor:
         opts = ExecOptions()
         staged = []
         calls = list(query.calls)
+        prof = self._profile()
+        if prof is not None:
+            # Rebase finish_op indices: a profile may span several
+            # dispatch/finalize rounds (the cluster path runs one
+            # execute() per PQL call against the same profile).
+            prof.mark_dispatch()
         try:
             for i, call in enumerate(calls):
-                self._translate_call(idx, call)
-                # Deferred reads (TopN chunking) consult this to know
-                # whether lazily re-reading fragment state in finalize
-                # is still safe.
-                self._tls.later_writes = batch_tail_writes or any(
-                    _peel_options(c).name in _WRITE_CALLS
-                    for c in calls[i + 1:])
-                staged.append((call, self._execute_call(idx, call, shards,
-                                                        opts)))
+                op = prof.begin_op(call.name) if prof is not None else None
+                t0 = time.perf_counter() if prof is not None else 0.0
+                try:
+                    self._translate_call(idx, call)
+                    # Deferred reads (TopN chunking) consult this to know
+                    # whether lazily re-reading fragment state in finalize
+                    # is still safe.
+                    self._tls.later_writes = batch_tail_writes or any(
+                        _peel_options(c).name in _WRITE_CALLS
+                        for c in calls[i + 1:])
+                    staged.append((call, self._execute_call(idx, call,
+                                                            shards, opts)))
+                finally:
+                    if op is not None:
+                        prof.end_op(op, time.perf_counter() - t0)
         finally:
             self._tls.later_writes = False
         return idx, staged, opts
 
     def _finalize_staged(self, idx: Index, staged) -> List[Any]:
+        prof = self._profile()
         results = []
-        for call, result in staged:
+        for i, (call, result) in enumerate(staged):
+            t0 = time.perf_counter() if prof is not None else 0.0
+            d2h = 0
             if isinstance(result, _Pending):
+                if prof is not None:
+                    d2h = transfer_nbytes(result.arrays)
                 result = result.finalize()
             self._translate_result(idx, call, result)
+            if prof is not None:
+                prof.finish_op(i, time.perf_counter() - t0, d2h)
             results.append(result)
         return results
 
     def execute_batch(self, requests: Sequence[Tuple[str, Any, Optional[
-            Sequence[int]]]]) -> List[Any]:
+            Sequence[int]]]], profiles: Optional[Sequence[Any]] = None
+            ) -> List[Any]:
         """Execute N independent queries with ONE pipelined device
         drain: every query's calls are dispatched before any result is
         fetched, and all pending transfers start asynchronously before
@@ -451,10 +524,16 @@ class Executor:
         queries serve efficiently through a high-RTT link.
 
         Each element of `requests` is (index_name, query, shards).
+        `profiles` (optional, aligned with `requests`) carries a
+        QueryProfile per request; each request's dispatch and finalize
+        phases run with its profile attached (the coalesced serving
+        path feeds these).
         Returns one entry per request: a (results, opts) tuple on
         success — opts drives response shaping (columnAttrs), see
         shape_response — or the exception instance for that request
         (per-request errors don't fail the batch)."""
+        profs = list(profiles) if profiles is not None \
+            else [None] * len(requests)
         staged_q: List[Any] = []
         out: List[Any] = [None] * len(requests)
         # Parse ONCE per request (the parsed tree is handed straight to
@@ -480,23 +559,27 @@ class Executor:
             if parsed[j] is None:
                 continue
             try:
-                staged_q.append(
-                    (j, self._dispatch_query(index_name, parsed[j], shards,
-                                             batch_tail_writes=
-                                             writes_after[j])))
+                with self._profiled(profs[j]):
+                    staged_q.append(
+                        (j, self._dispatch_query(index_name, parsed[j],
+                                                 shards,
+                                                 batch_tail_writes=
+                                                 writes_after[j])))
             except Exception as e:
                 out[j] = e
         for _, (_, staged, _) in staged_q:
             prefetch_pendings(staged)
         for j, (idx, staged, opts) in staged_q:
             try:
-                out[j] = (self._finalize_staged(idx, staged), opts)
+                with self._profiled(profs[j]):
+                    out[j] = (self._finalize_staged(idx, staged), opts)
             except Exception as e:
                 out[j] = e
         return out
 
     def execute_batch_shaped(self, requests: Sequence[Tuple[
-            str, Any, Optional[Sequence[int]]]]) -> List[Any]:
+            str, Any, Optional[Sequence[int]]]],
+            profiles: Optional[Sequence[Any]] = None) -> List[Any]:
         """execute_batch + per-request JSON shaping: one entry per
         request, either the shaped {"results": ...} dict or the
         exception instance for that request. Shared by API.query_batch
@@ -504,7 +587,9 @@ class Executor:
         place owns the shape-or-error contract."""
         out: List[Any] = []
         for (index_name, _, _), res in zip(requests,
-                                           self.execute_batch(requests)):
+                                           self.execute_batch(
+                                               requests,
+                                               profiles=profiles)):
             if isinstance(res, Exception):
                 out.append(res)
                 continue
@@ -516,12 +601,13 @@ class Executor:
         return out
 
     def execute_full(self, index_name: str, query,
-                     shards: Optional[Sequence[int]] = None
+                     shards: Optional[Sequence[int]] = None, profile=None
                      ) -> Dict[str, Any]:
         """Execute and return the full JSON-shaped response, including
         `columnAttrs` when an Options(columnAttrs=true) call requested them
         (reference executor.Execute, executor.go:134-165)."""
-        results, opts = self._execute_query(index_name, query, shards)
+        results, opts = self._execute_query(index_name, query, shards,
+                                            profile=profile)
         return self.shape_response(index_name, results, opts)
 
     def shape_response(self, index_name: str, results, opts: "ExecOptions"
@@ -809,6 +895,8 @@ class Executor:
         import jax
         import jax.numpy as jnp
 
+        prof = self._profile()
+        t_plan0 = time.perf_counter() if prof is not None else 0.0
         plan = _Plan()
         expr = self._plan_call(idx, call, shards, plan)
         banks = [self._get_bank(idx, key, shards,
@@ -833,7 +921,10 @@ class Executor:
                f"|B{[a.shape for a in bank_arrays]}"
                f"|L{None if lits is None else lits.shape}|S{len(shards)}")
         fn = self._jit_cache.get(sig)
+        jit_hit = fn is not None
         if fn is None:
+            self._note_jit_compile()
+
             def run(bank_arrays, idxs, params, lits):
                 out = expr(bank_arrays, idxs, params, lits)
                 if mode == "count":
@@ -845,6 +936,7 @@ class Executor:
         akey = (sig, tuple(plan.idxs), tuple(plan.params))
         with self._arg_cache_lock:
             cached = self._arg_cache.pop(akey, None)
+        arg_upload = cached is None
         if cached is None:
             # Device puts happen OUTSIDE the lock (they can block on the
             # transfer); two threads racing the same new key just put
@@ -864,7 +956,23 @@ class Executor:
                 # pop-and-reinsert on hit makes this an LRU).
                 self._arg_cache.pop(next(iter(self._arg_cache)))
             self._arg_cache[akey] = cached
-        return fn(bank_arrays, idxs, params, lits)
+        if prof is None:
+            return fn(bank_arrays, idxs, params, lits)
+        # Profiled run: planS covers planning + bank/operand staging up
+        # to the program call; dispatchS is the fn() call itself (async
+        # enqueue on a cache hit, trace+compile on a miss); deviceS is
+        # the fenced XLA execution time — sampled queries only, so the
+        # unprofiled path keeps its fully-async dispatch queue.
+        h2d = (transfer_nbytes((idxs, params)) if arg_upload else 0) \
+            + (lits.nbytes if lits is not None else 0)
+        node = prof.tree(mode, sig, jit_hit,
+                         time.perf_counter() - t_plan0, h2d, len(shards))
+        t_disp = time.perf_counter()
+        out = fn(bank_arrays, idxs, params, lits)
+        prof.tree_dispatch(node, time.perf_counter() - t_disp)
+        if prof.sample_device:
+            prof.tree_device(node, _fence_device(out))
+        return out
 
     # -- planning: one host walk resolving banks/slots/params ---------------
 
@@ -1149,6 +1257,7 @@ class Executor:
         key = f"topn:{with_filter}:{shape}:{use_pallas}"
         fn = self._jit_cache.get(key)
         if fn is None:
+            self._note_jit_compile()
             if with_filter:
                 if use_pallas:
                     def run(chunk, filt):
@@ -1196,6 +1305,7 @@ class Executor:
         from pilosa_tpu.ops.bitset import popcount
         fn = self._jit_cache.get("popcount_row")
         if fn is None:
+            self._note_jit_compile()
             fn = jax.jit(lambda w: popcount(w, axis=(-2, -1)))
             self._jit_cache["popcount_row"] = fn
         return fn(words)
@@ -1826,6 +1936,7 @@ class Executor:
         def _jit(key, builder):
             fn = self._jit_cache.get(key)
             if fn is None:
+                self._note_jit_compile()
                 fn = jax.jit(builder)
                 self._jit_cache[key] = fn
             return fn
@@ -1997,6 +2108,7 @@ class Executor:
               f"{filter_words is not None}"
         fn = self._jit_cache.get(key)
         if fn is None:
+            self._note_jit_compile()
             from pilosa_tpu.ops.bitset import popcount
             if op == "Sum":
                 def run(bank_arr, sel, filt):

@@ -218,3 +218,16 @@ def u64_to_words(buf: np.ndarray) -> np.ndarray:
 
 def words_to_u64(words: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(words).view("<u8")
+
+
+def transfer_nbytes(arrays) -> int:
+    """Sum of .nbytes over an iterable of (device or host) arrays,
+    skipping entries without the attribute. Shape metadata only — never
+    touches array contents, so it is safe on unfetched device arrays
+    (the profiler's H2D/D2H transfer-byte accounting)."""
+    total = 0
+    for a in arrays or ():
+        n = getattr(a, "nbytes", None)
+        if n is not None:
+            total += int(n)
+    return total

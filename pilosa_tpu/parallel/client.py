@@ -195,11 +195,21 @@ class InternalClient:
 
     def query_node(self, uri: str, index: str, pql: str,
                    shards: List[int]) -> List[Any]:
+        return self.query_node_full(uri, index, pql, shards)["results"]
+
+    def query_node_full(self, uri: str, index: str, pql: str,
+                        shards: List[int],
+                        profile: bool = False) -> Dict[str, Any]:
+        """query_node returning the FULL response dict. With
+        profile=True the ?profile=true flag propagates to the remote
+        node, whose response carries its own execution-profile fragment
+        under "profile" — the coordinator merges these into one tree
+        (cluster_executor._map_reduce -> QueryProfile.add_node_fragment)."""
         q = ",".join(str(s) for s in shards)
-        res = self._req("POST", f"{uri}/index/{index}/query"
-                                f"?shards={q}&remote=true",
-                        pql.encode("utf-8"))
-        return res["results"]
+        p = "&profile=true" if profile else ""
+        return self._req("POST", f"{uri}/index/{index}/query"
+                                 f"?shards={q}&remote=true{p}",
+                         pql.encode("utf-8"))
 
     # -- imports (reference importNode, http/client.go:439) ------------------
 

@@ -221,8 +221,14 @@ class ClusterExecutor:
     # -- query --------------------------------------------------------------
 
     def execute(self, index: str, query: str,
-                shards: Optional[Sequence[int]] = None) -> List[Any]:
-        """Returns JSON-shaped results (one per call)."""
+                shards: Optional[Sequence[int]] = None,
+                profile=None) -> List[Any]:
+        """Returns JSON-shaped results (one per call). `profile` (a
+        utils/profile QueryProfile) records the coordinator's local leg
+        in its own tree; when it is a forced profile (?profile=true)
+        the flag also propagates to every remote leg and the per-node
+        fragments merge under profile.nodes — a cross-node query then
+        shows where its time went, node by node."""
         from pilosa_tpu.executor.executor import (
             ExecutionError, write_call_count,
         )
@@ -231,9 +237,11 @@ class ClusterExecutor:
         if limit > 0 and write_call_count(q) > limit:
             # (reference ErrTooManyWrites, executor.go:106)
             raise ExecutionError("too many write commands")
-        return [self._execute_call(index, call, shards) for call in q.calls]
+        return [self._execute_call(index, call, shards, profile=profile)
+                for call in q.calls]
 
-    def _execute_call(self, index: str, call: Call, shards) -> Any:
+    def _execute_call(self, index: str, call: Call, shards,
+                      profile=None) -> Any:
         inner = call
         while inner.name == "Options" and inner.children:
             # Options(shards=[...]) overrides the scatter set at the
@@ -252,9 +260,10 @@ class ClusterExecutor:
             return self._execute_write_broadcast(index, inner)
         all_shards = list(shards) if shards is not None \
             else self.global_shards(index)
-        return self._map_reduce(index, call, all_shards)
+        return self._map_reduce(index, call, all_shards, profile=profile)
 
-    def _map_reduce(self, index: str, call: Call, shards: List[int]) -> Any:
+    def _map_reduce(self, index: str, call: Call, shards: List[int],
+                    profile=None) -> Any:
         from pilosa_tpu.parallel.cluster import STATE_RESIZING
         # While RESIZING, route reads against the pre-change placement:
         # those nodes are guaranteed to still hold the data (pulls never
@@ -262,6 +271,11 @@ class ClusterExecutor:
         # owner that has not pulled yet and would silently undercount
         # (reference instead rejects queries in RESIZING, api.go:76-99).
         previous = self.cluster.state == STATE_RESIZING
+        # Remote profile propagation only for forced profiles
+        # (?profile=true): passive sampling must not make every fan-out
+        # leg pay device fencing on its node.
+        want_profile = profile is not None and getattr(profile, "forced",
+                                                       False)
         excluded: set = set()
         last_err: Optional[Exception] = None
         for _ in range(max(1, self.cluster.replica_n)):
@@ -279,10 +293,14 @@ class ClusterExecutor:
             def run_remote(node, node_shards):
                 nonlocal failed, last_err
                 try:
-                    res = self.client.query_node(node.uri, index,
-                                                 call.to_pql(), node_shards)
+                    res = self.client.query_node_full(
+                        node.uri, index, call.to_pql(), node_shards,
+                        profile=want_profile)
+                    if want_profile and res.get("profile") is not None:
+                        profile.add_node_fragment(node.id,
+                                                  res["profile"])
                     with results_lock:
-                        parts.append(res[0])
+                        parts.append(res["results"][0])
                 except ClientError as e:
                     with results_lock:
                         excluded.add(node.id)
@@ -305,8 +323,11 @@ class ClusterExecutor:
                     t.start()
                     threads.append(t)
             if local_shards is not None:
+                # The coordinator's own leg records into the root
+                # profile directly — its ops ARE the tree's trunk.
                 local = self.local.execute(index, call.to_pql(),
-                                           shards=local_shards)
+                                           shards=local_shards,
+                                           profile=profile)
                 parts.append(result_to_json(local[0]))
             for t in threads:
                 t.join()

@@ -72,6 +72,7 @@ class API:
     def __init__(self, holder: Holder, mesh=None, cluster=None,
                  stats=None, tracer=None, client_ssl_context=None):
         from pilosa_tpu.utils.logger import Logger
+        from pilosa_tpu.utils.profile import Profiler
         from pilosa_tpu.utils.stats import NopStatsClient
         from pilosa_tpu.utils.tracing import NopTracer
         self.logger = Logger()
@@ -82,6 +83,11 @@ class API:
         self.stats = stats or NopStatsClient()
         self.tracer = tracer or NopTracer()
         self.long_query_time = 0.0  # seconds; 0 disables slow-query logs
+        # Per-query execution profiler (utils/profile.py): every query
+        # path reports through it (executor.* stats, the slow-query ring
+        # at GET /debug/queries); ?profile=true additionally embeds the
+        # profile tree in the response with device fencing on.
+        self.profiler = Profiler(stats=self.stats, tracer=self.tracer)
         # Serving-path query coalescer (server/coalescer.py), attached
         # by the server wiring (cli/main.py) or a test harness; None
         # means every request takes the direct path.
@@ -211,30 +217,53 @@ class API:
 
     # ----------------------------------------------------------------- query
 
+    def _observe_query(self, index: str, query, dur: float,
+                       profile=None, error=None,
+                       kind: str = "query") -> None:
+        """The single slow-query/stats sink for every query path —
+        slow-query logging (reference api.LongQueryTime api.go:1048) +
+        the structured ring at GET /debug/queries + the executor.*
+        stats feed, in one place instead of per-path printf copies."""
+        self.profiler.observe(index, query, dur, profile=profile,
+                              error=error,
+                              long_query_time=self.long_query_time,
+                              logger=self.logger, kind=kind)
+
     def query(self, index: str, query: str,
               shards: Optional[Sequence[int]] = None,
-              remote: bool = False) -> Dict[str, Any]:
+              remote: bool = False, profile: bool = False
+              ) -> Dict[str, Any]:
         """(reference API.Query, api.go:103). Returns the JSON-shaped
         response {"results": [...]}. `remote=True` marks a node-to-node
         sub-query: execute locally only, no re-fan-out (the reference's
-        opt.Remote, executor.go:2236)."""
+        opt.Remote, executor.go:2236). `profile=True` (the
+        ?profile=true surface) embeds the execution profile tree in the
+        response with device-time fencing on."""
+        prof = self.profiler.begin(index, query, shards,
+                                   force=bool(profile))
         t0 = _time.perf_counter()
+        err = None
         try:
-            return self._query(index, query, shards, remote)
+            resp = self._query(index, query, shards, remote, prof)
+            if profile:
+                prof.close(_time.perf_counter() - t0)
+                resp = dict(resp)
+                resp["profile"] = prof.to_json()
+            return resp
+        except Exception as e:
+            err = e
+            raise
         finally:
-            # Slow-query logging (reference api.LongQueryTime api.go:1048,
-            # enforced per request in http/handler.go:300-306).
             dur = _time.perf_counter() - t0
             # Direct-path latency histogram: the baseline the coalesced
             # path's coalescer.request timing is compared against.
             self.stats.timing("query.direct", dur)
-            if self.long_query_time > 0 and dur > self.long_query_time:
-                self.logger.printf("%.3fs SLOW QUERY [%s] %r",
-                                   dur, index, query)
+            self._observe_query(index, query, dur, prof, err)
 
     def query_coalesced(self, index: str, query,
                         shards: Optional[Sequence[int]] = None,
-                        remote: bool = False) -> Dict[str, Any]:
+                        remote: bool = False, profile: bool = False
+                        ) -> Dict[str, Any]:
         """query() that rides the serving-path coalescer when one is
         attached and the request is eligible: concurrent single-query
         HTTP requests share one stacked executor batch (see
@@ -245,14 +274,20 @@ class API:
         coal = self.coalescer
         if (coal is None or not coal.running or remote
                 or self.cluster_executor is not None):
-            return self.query(index, query, shards=shards, remote=remote)
+            return self.query(index, query, shards=shards, remote=remote,
+                              profile=profile)
         from pilosa_tpu.server.coalescer import CoalescerStopped
+        prof = self.profiler.begin(index, query, shards,
+                                   force=bool(profile))
         t0 = _time.perf_counter()
+        err = None
         try:
-            with self.tracer.span("API.QueryCoalesced", index=index):
+            with self.tracer.span("API.QueryCoalesced",
+                                  index=index) as sp:
                 self.stats.count("query", 1)
                 try:
-                    return coal.submit(index, query, shards=shards)
+                    resp = coal.submit(index, query, shards=shards,
+                                       profile=prof)
                 except CoalescerStopped:
                     # Lost the race with coalescer.stop(): serve the
                     # request directly rather than failing it. (Only
@@ -262,36 +297,56 @@ class API:
                     # counted above and must not double-count.
                     t1 = _time.perf_counter()
                     try:
-                        return self.executor.execute_full(
-                            index, query, shards=shards)
+                        resp = self.executor.execute_full(
+                            index, query, shards=shards, profile=prof)
                     finally:
                         self.stats.timing(
                             "query.direct",
                             _time.perf_counter() - t1)
+                prof.annotate_span(sp)
+                if profile:
+                    # Forced profiles are excluded from coalescer dedup,
+                    # so resp is this request's own dict — still copy
+                    # before mutating (defense against future sharing).
+                    prof.close(_time.perf_counter() - t0)
+                    resp = dict(resp)
+                    resp["profile"] = prof.to_json()
+                return resp
+        except Exception as e:
+            err = e
+            raise
         finally:
             dur = _time.perf_counter() - t0
-            if self.long_query_time > 0 and dur > self.long_query_time:
-                self.logger.printf("%.3fs SLOW QUERY [%s] %r",
-                                   dur, index, query)
+            self._observe_query(index, query, dur, prof, err)
 
     def _query(self, index: str, query: str,
                shards: Optional[Sequence[int]] = None,
-               remote: bool = False) -> Dict[str, Any]:
-        with self.tracer.span("API.Query", index=index):
+               remote: bool = False, prof=None) -> Dict[str, Any]:
+        with self.tracer.span("API.Query", index=index) as sp:
             self.stats.count("query", 1)
-            if remote:
-                # Node-to-node leg: results only; the coordinator owns
-                # response shaping (columnAttrs etc).
-                results = self.executor.execute(index, query, shards=shards)
-                return {"results": [result_to_json(r) for r in results]}
-            if self.cluster_executor is not None:
-                from pilosa_tpu.pql import parse_string
-                q = parse_string(query) if isinstance(query, str) else query
-                resp = {"results": self.cluster_executor.execute(
-                    index, q, shards=shards)}
-                self._attach_column_attrs(index, q, resp)
-                return resp
-            return self.executor.execute_full(index, query, shards=shards)
+            try:
+                if remote:
+                    # Node-to-node leg: results only; the coordinator owns
+                    # response shaping (columnAttrs etc).
+                    results = self.executor.execute(index, query,
+                                                    shards=shards,
+                                                    profile=prof)
+                    return {"results": [result_to_json(r)
+                                        for r in results]}
+                if self.cluster_executor is not None:
+                    from pilosa_tpu.pql import parse_string
+                    q = parse_string(query) if isinstance(query, str) \
+                        else query
+                    resp = {"results": self.cluster_executor.execute(
+                        index, q, shards=shards, profile=prof)}
+                    self._attach_column_attrs(index, q, resp)
+                    return resp
+                return self.executor.execute_full(index, query,
+                                                  shards=shards,
+                                                  profile=prof)
+            finally:
+                if prof is not None:
+                    prof.annotate_span(sp)
 
     def query_batch(self, items: Sequence[Dict[str, Any]]
                     ) -> List[Dict[str, Any]]:
@@ -345,9 +400,8 @@ class API:
                 out.append({"error": str(res)}
                            if isinstance(res, Exception) else res)
             dur = _time.perf_counter() - t0
-            if self.long_query_time > 0 and dur > self.long_query_time:
-                self.logger.printf("%.3fs SLOW BATCH [%d queries]",
-                                   dur, len(items))
+            self._observe_query("*", f"{len(items)} queries", dur,
+                                kind="batch")
             return out
 
     def _attach_column_attrs(self, index: str, q, resp: Dict[str, Any]

@@ -85,11 +85,11 @@ class DeadlineExceeded(ApiError):
 
 class _Item:
     __slots__ = ("index", "query", "shards", "is_write", "deadline",
-                 "state", "event", "result", "enqueued_at")
+                 "state", "event", "result", "enqueued_at", "profile")
 
     def __init__(self, index: str, query: Any,
                  shards: Optional[Sequence[int]], is_write: bool,
-                 deadline: Optional[float]):
+                 deadline: Optional[float], profile: Any = None):
         self.index = index
         self.query = query
         self.shards = shards
@@ -99,6 +99,9 @@ class _Item:
         self.event = threading.Event()
         self.result: Any = None
         self.enqueued_at = time.perf_counter()
+        # utils/profile QueryProfile the executor fills in while this
+        # item's request executes (None on non-profiled paths).
+        self.profile = profile
 
 
 class QueryCoalescer:
@@ -184,10 +187,15 @@ class QueryCoalescer:
     # --------------------------------------------------------------- submit
 
     def submit(self, index: str, query: Any,
-               shards: Optional[Sequence[int]] = None) -> Dict[str, Any]:
+               shards: Optional[Sequence[int]] = None,
+               profile: Any = None) -> Dict[str, Any]:
         """Queue one query and block until its batch resolves. Returns
         the shaped response dict; raises the per-request exception
         (executor errors, CoalescerOverload, DeadlineExceeded).
+        `profile` (a utils/profile QueryProfile) rides along and is
+        filled in by the executor when this item's request runs; forced
+        profiles are excluded from read-dedup so their tree describes
+        exactly this request's execution.
 
         The caller (API.query_coalesced) checks `running` first and
         falls back to the direct path, but the check races with stop():
@@ -197,7 +205,8 @@ class QueryCoalescer:
         deadline = (time.monotonic() + self.deadline_s
                     if self.deadline_s > 0 else None)
         is_write = query_is_write(query)
-        item = _Item(index, query, shards, is_write, deadline)
+        item = _Item(index, query, shards, is_write, deadline,
+                     profile=profile)
         with self._cond:
             if not self._running:
                 raise CoalescerStopped("coalescer stopped")
@@ -354,9 +363,13 @@ class QueryCoalescer:
     def _execute_direct(self, item: _Item) -> None:
         """Batch of one: run the EXACT direct path (execute_full), so a
         lone request degrades to uncoalesced behavior."""
+        if item.profile is not None:
+            item.profile.set_coalesced(
+                1, time.perf_counter() - item.enqueued_at)
         try:
             item.result = self.executor.execute_full(
-                item.index, item.query, shards=item.shards)
+                item.index, item.query, shards=item.shards,
+                profile=item.profile)
         except Exception as e:
             item.result = e
         item.event.set()
@@ -365,15 +378,19 @@ class QueryCoalescer:
         """One executor batch for N requests, deduplicating identical
         read-only queries when the flush carries no writes (a write in
         the batch orders against its batchmates, so reads that would
-        straddle it must each run in position)."""
+        straddle it must each run in position). Forced profiles
+        (?profile=true) never dedup: their tree must describe this
+        request's own execution, not a batchmate's."""
         dedup_ok = not any(it.is_write for it in batch)
         groups: Dict[Tuple[str, str, Optional[Tuple[int, ...]]],
                      List[int]] = {}
         reqs: List[Tuple[str, Any, Optional[Sequence[int]]]] = []
+        profiles: List[Any] = []
         owner: List[List[_Item]] = []
         for item in batch:
             key = None
-            if dedup_ok and isinstance(item.query, str):
+            forced = item.profile is not None and item.profile.forced
+            if dedup_ok and not forced and isinstance(item.query, str):
                 key = (item.index, item.query,
                        tuple(item.shards) if item.shards is not None
                        else None)
@@ -383,6 +400,7 @@ class QueryCoalescer:
             if key is not None:
                 groups[key] = [len(reqs)]
             reqs.append((item.index, item.query, item.shards))
+            profiles.append(item.profile)
             owner.append([item])
         if len(reqs) < len(batch):
             self.stats.count("coalescer.deduped", len(batch) - len(reqs))
@@ -395,7 +413,11 @@ class QueryCoalescer:
         for item in batch:
             self.stats.timing("coalescer.queue_wait",
                               exec_start - item.enqueued_at)
-        shaped = self.executor.execute_batch_shaped(reqs)
+            if item.profile is not None:
+                item.profile.set_coalesced(
+                    len(batch), exec_start - item.enqueued_at)
+        shaped = self.executor.execute_batch_shaped(reqs,
+                                                    profiles=profiles)
         for res, items in zip(shaped, owner):
             for item in items:
                 item.result = res

@@ -8,7 +8,8 @@ are raw roaring bytes, exactly like the reference.
 Routes implemented (public):
   GET  /                      home/info
   POST /index/{i}/query       PQL (body: raw PQL or {"query": ...})
-  GET  /schema  /status  /info  /version  /debug/vars
+  GET  /schema  /status  /info  /version
+  GET  /debug/vars  /debug/queries  /metrics
   GET  /index   /index/{i}
   POST /index/{i}             {"options": {"keys": bool, ...}}
   DEL  /index/{i}
@@ -248,6 +249,14 @@ class Handler(BaseHTTPRequestHandler):
             elif path == "/debug/vars":
                 stats = getattr(api.stats, "snapshot", lambda: {})()
                 self._json(stats)
+            elif path == "/debug/queries":
+                # Structured slow-query ring (utils/profile.py): every
+                # query over long_query_time, most recent first, with
+                # its profile tree when one was recorded — the
+                # structured replacement for grepping SLOW QUERY log
+                # lines (reference LongQueryTime, api.go:1048).
+                self._json({"queries": api.profiler.slow_queries(),
+                            "retraces": api.executor.jit_compiles})
             elif path == "/metrics":
                 from pilosa_tpu.utils.stats import prometheus_text
                 self._bytes(prometheus_text(api.stats).encode(),
@@ -313,7 +322,8 @@ class Handler(BaseHTTPRequestHandler):
         if method == "POST":
             if m := re.fullmatch(r"/index/([^/]+)/query", path):
                 self._check_args(q, "shards", "remote", "columnAttrs",
-                                 "excludeRowAttrs", "excludeColumns")
+                                 "excludeRowAttrs", "excludeColumns",
+                                 "profile")
                 raw = self._body()
                 # Reference-client protobuf surface
                 # (http/handler.go:916-995, internal/public.proto).
@@ -336,10 +346,14 @@ class Handler(BaseHTTPRequestHandler):
                     pql = self._wrap_options(pql, self._exec_optargs(q))
                     # Rides the cross-request coalescer when one is
                     # attached (server/coalescer.py); degrades to the
-                    # direct api.query path otherwise.
+                    # direct api.query path otherwise. ?profile=true
+                    # embeds the EXPLAIN ANALYZE-style execution
+                    # profile tree in the response (docs/observability
+                    # .md); the protobuf surface stays profile-free.
                     self._json(api.query_coalesced(
                         m.group(1), pql, shards=shards,
-                        remote=self._qbool(q, "remote")))
+                        remote=self._qbool(q, "remote"),
+                        profile=self._qbool(q, "profile")))
                 except ApiError:
                     # Already carries its status (429 overload, 408
                     # deadline): must not collapse to a generic 400.

@@ -26,7 +26,20 @@ class Config:
     verbose: bool = False
     # Query
     max_writes_per_request: int = 5000
-    long_query_time: float = 0.0  # seconds; 0 disables slow-query logging
+    # Queries slower than this (seconds) are logged AND recorded in the
+    # structured slow-query ring served at GET /debug/queries; 0
+    # disables both.
+    long_query_time: float = 0.0
+    # Per-query execution profiler (utils/profile.py). ?profile=true on
+    # POST /index/{i}/query always profiles with device-time fencing;
+    # sample_every additionally fences 1 in N unforced queries so
+    # /metrics carries real device timings under production traffic
+    # (0 = no sampling: the hot path pays zero block_until_ready
+    # fences). slow_ring bounds the /debug/queries ring. TOML accepts a
+    # [profile] table (sample_every / slow_ring) or the flat profile_*
+    # spelling; env uses PILOSA_TPU_PROFILE_SAMPLE_EVERY etc.
+    profile_sample_every: int = 0
+    profile_slow_ring: int = 128
     # Serving-path query coalescer (server/coalescer.py): concurrent
     # single-query POSTs arriving within the batching window share one
     # executor batch. TOML accepts a [coalescer] table (keys without the
@@ -135,6 +148,10 @@ class Config:
             raise ValueError("coalescer window/deadline must be >= 0")
         if self.coalescer_max_batch < 1 or self.coalescer_max_queue < 1:
             raise ValueError("coalescer max_batch/max_queue must be >= 1")
+        if self.profile_sample_every < 0:
+            raise ValueError("profile sample_every must be >= 0")
+        if self.profile_slow_ring < 1:
+            raise ValueError("profile slow_ring must be >= 1")
 
     def server_ssl_context(self):
         """ssl.SSLContext for the listener, or None when TLS is off

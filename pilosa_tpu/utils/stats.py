@@ -190,8 +190,10 @@ class StatsdStatsClient(StatsClient):
             "stop": threading.Event(),
         }
         # Periodic drain: without it, tail datagrams after a burst would
-        # sit in the buffer until the next _emit (or forever).
+        # sit in the buffer until the next _emit (or forever). The
+        # thread handle is kept so close() can join it.
         t = threading.Thread(target=self._flush_loop, daemon=True)
+        self._shared["thread"] = t
         t.start()
 
     def _flush_loop(self) -> None:
@@ -200,7 +202,16 @@ class StatsdStatsClient(StatsClient):
             self.flush()
 
     def close(self) -> None:
-        self._shared["stop"].set()
+        """Stop the periodic drain and flush what's left. Joins the
+        flush thread (it wakes from stop.wait within FLUSH_INTERVAL) so
+        a concurrent loop-driven flush() cannot race the final one —
+        previously the daemon thread was never joined and could still
+        be sending while the caller tore the socket down."""
+        s = self._shared
+        s["stop"].set()
+        t = s.get("thread")
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=self.FLUSH_INTERVAL * 2)
         self.flush()
 
     def with_tags(self, *tags: str) -> "StatsdStatsClient":

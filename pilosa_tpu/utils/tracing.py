@@ -104,6 +104,16 @@ class RecordingTracer:
         if tid:
             self._local.trace_id = _sanitize_trace_id(tid)
 
+    def current_trace_id(self) -> Optional[str]:
+        """Trace id of the thread's open root span (or the id extracted
+        from the incoming request, before any span opened) — lets the
+        query profiler stamp its slow-query records with the same id
+        the exported spans carry."""
+        stack = self._stack()
+        if stack:
+            return stack[0].trace_id
+        return getattr(self._local, "trace_id", None)
+
 
 def _sanitize_trace_id(tid: str) -> str:
     """Trace ids must be 32 hex chars on the OTLP wire. Our own nodes
@@ -180,8 +190,13 @@ class ExportingTracer(RecordingTracer):
             raise ValueError(f"unknown sampler type {sampler_type!r}")
         self.sampler_type = sampler_type
         self.sampler_param = float(sampler_param)
+        # The ratelimiting token bucket has its own lock: sampling
+        # decisions happen on every request thread at root-span close
+        # and must not contend with the exporter thread holding
+        # _pending_lock through a drain.
         self._rl_tokens = self.sampler_param  # ratelimiting bucket
         self._rl_stamp = time.monotonic()
+        self._rl_lock = make_lock("ExportingTracer._rl_lock")
         self._pending: List[Span] = []
         self._pending_lock = make_lock("ExportingTracer._pending_lock")
         self._wake = threading.Event()
@@ -201,7 +216,7 @@ class ExportingTracer(RecordingTracer):
                 span.trace_id.encode()).digest()[:8], "big")
             return h / 2**64 < self.sampler_param
         # ratelimiting: token bucket of sampler_param traces/second.
-        with self._pending_lock:
+        with self._rl_lock:
             now = time.monotonic()
             self._rl_tokens = min(
                 max(self.sampler_param, 1.0),

@@ -141,6 +141,44 @@ def test_cluster_query_write_fanout(tmp_path):
             nd.stop()
 
 
+def test_cluster_profile_merges_node_fragments(tmp_path):
+    """?profile=true on a cross-node query: the flag propagates to
+    remote legs and the coordinator merges per-node profile fragments
+    into one tree (profile.nodes keyed by node id)."""
+    nodes = run_cluster(tmp_path, 3)
+    try:
+        base = nodes[0].uri
+        req(base, "POST", "/index/cp", {"options": {}})
+        req(base, "POST", "/index/cp/field/f", {"options": {}})
+        cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+        req(base, "POST", "/index/cp/field/f/import",
+            {"rowIDs": [1] * 6, "columnIDs": cols})
+        res = req(base, "POST", "/index/cp/query?profile=true",
+                  b"Count(Row(f=1))")
+        assert res["results"] == [6]
+        prof = res["profile"]
+        assert prof["deviceSampled"] is True
+        # The coordinator's own leg fills the root ops; every remote
+        # node that served shards hangs its fragment off nodes[id].
+        frags = prof.get("nodes", {})
+        remote_ids = {nd.uri for nd in nodes[1:]}
+        served_remotely = {nid for nid in frags if nid in remote_ids}
+        assert prof["ops"] or served_remotely, prof
+        for frag in frags.values():
+            assert frag["deviceSampled"] is True
+            assert frag["ops"], frag
+            evals = [c for op in frag["ops"]
+                     for c in op.get("children", [])
+                     if c["name"].startswith("eval:")]
+            assert any("deviceS" in e for e in evals), frag
+        # An unprofiled cluster query carries no profile.
+        res = req(base, "POST", "/index/cp/query", b"Count(Row(f=1))")
+        assert "profile" not in res
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
 def _self_signed_cert(tmp_path):
     """PEM (cert_path, key_path) for CN/SAN localhost — EC P-256 (RSA
     keygen is seconds on this 1-vCPU box)."""

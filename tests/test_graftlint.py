@@ -265,10 +265,10 @@ def test_coalescer_under_lock_check(clean_graph, monkeypatch):
     from pilosa_tpu.utils.stats import MemStatsClient
 
     class StubExecutor:
-        def execute_full(self, index, query, shards=None):
+        def execute_full(self, index, query, shards=None, profile=None):
             return {"results": [True]}
 
-        def execute_batch_shaped(self, reqs):
+        def execute_batch_shaped(self, reqs, profiles=None):
             return [{"results": [True]} for _ in reqs]
 
     co = QueryCoalescer(StubExecutor(), window_s=0.002, max_batch=8,

@@ -1,0 +1,93 @@
+"""prometheus_text exposition invariants (utils/stats.py) — label
+escaping, the `_size` no-`_seconds`-suffix rule, and the one-TYPE-line-
+per-metric invariant — plus StatsdStatsClient.close() thread join."""
+
+import threading
+
+from pilosa_tpu.utils.stats import (
+    MemStatsClient, StatsdStatsClient, prometheus_text,
+)
+
+
+def test_prom_label_escaping():
+    """Tag values with backslashes and double quotes must escape per
+    the text exposition format, never break the label syntax."""
+    stats = MemStatsClient()
+    stats.with_tags('path:C:\\tmp', 'msg:say "hi"').count("esc", 2)
+    out = prometheus_text(stats)
+    line = next(l for l in out.splitlines()
+                if l.startswith("pilosa_esc_total{"))
+    assert 'path="C:\\\\tmp"' in line
+    assert 'msg="say \\"hi\\""' in line
+    assert line.endswith(" 2")
+
+
+def test_prom_size_metrics_have_no_seconds_suffix():
+    """The timings store holds any distribution (histogram aliases to
+    timing): a `*_size` name is unitless and must not claim seconds."""
+    stats = MemStatsClient()
+    stats.histogram("coalescer.batch_size", 4)
+    stats.timing("coalescer.request", 0.25)
+    out = prometheus_text(stats)
+    assert "pilosa_coalescer_batch_size{" in out
+    assert "pilosa_coalescer_batch_size_seconds" not in out
+    assert "pilosa_coalescer_request_seconds{" in out
+
+
+def test_prom_one_type_line_per_metric():
+    stats = MemStatsClient()
+    stats.count("q", 1)
+    stats.with_tags("index:a").count("q", 1)
+    stats.with_tags("index:b").count("q", 1)
+    stats.gauge("depth", 3)
+    stats.with_tags("index:a").gauge("depth", 5)
+    stats.timing("lat", 0.1)
+    stats.with_tags("index:a").timing("lat", 0.2)
+    out = prometheus_text(stats)
+    type_lines = [l for l in out.splitlines() if l.startswith("# TYPE ")]
+    names = [l.split()[2] for l in type_lines]
+    assert len(names) == len(set(names)), names
+    # Every series name that appears has exactly one TYPE declaration.
+    assert names.count("pilosa_q_total") == 1
+    assert names.count("pilosa_depth") == 1
+    assert names.count("pilosa_lat_seconds") == 1
+    # Samples with different label sets still share the one TYPE line.
+    q_samples = [l for l in out.splitlines()
+                 if l.startswith("pilosa_q_total")]
+    assert len(q_samples) == 3
+
+
+def test_prom_tagged_names_stay_bounded():
+    """Tags become labels, never part of the metric name (cardinality
+    control)."""
+    stats = MemStatsClient()
+    stats.with_tags("index:i1").count("query", 1)
+    out = prometheus_text(stats)
+    assert 'pilosa_query_total{index="i1"} 1' in out
+    assert "i1_total" not in out
+
+
+def test_statsd_close_joins_flush_thread():
+    """close() must stop AND join the periodic flush thread (it was
+    previously a fire-and-forget daemon that could race the final
+    flush)."""
+    before = threading.active_count()
+    c = StatsdStatsClient("localhost:1")  # UDP, nothing listening
+    t = c._shared["thread"]
+    assert t.is_alive()
+    c.count("x", 1)
+    c.close()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert threading.active_count() <= before + 1
+
+
+def test_statsd_close_via_tagged_clone():
+    """with_tags clones share the flush thread; close() through a clone
+    stops it too."""
+    c = StatsdStatsClient("localhost:1")
+    clone = c.with_tags("a:b")
+    clone.close()
+    assert not c._shared["thread"].is_alive() or \
+        c._shared["thread"].join(timeout=5) is None
+    assert c._shared["stop"].is_set()

@@ -46,6 +46,45 @@ python -m tools.graftlint pilosa_tpu tests || fail=1
 step "native build (-Wall -Wextra -Werror)"
 make -C native clean all || fail=1
 
+step "profiler smoke (one profiled query, JAX_PLATFORMS=cpu)"
+JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+import tempfile
+import numpy as np
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+from pilosa_tpu.server.api import API
+from pilosa_tpu.utils.stats import MemStatsClient, prometheus_text
+
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("smoke")
+    cols = np.array([1, 2, SHARD_WIDTH + 3], np.uint64)
+    for name in ("f", "g"):
+        idx.create_field(name).import_bits(np.full(3, 1, np.uint64), cols)
+    idx.add_existence(cols)
+    api = API(h, stats=MemStatsClient())
+    resp = api.query("smoke", "Count(Intersect(Row(f=1), Row(g=1)))",
+                     profile=True)
+    assert resp["results"] == [3], resp
+    p = resp["profile"]
+    # Well-formed tree: sampled, one op per call, an eval child with
+    # jit + device-time + transfer-byte fields, closed totals.
+    assert p["deviceSampled"] is True and p["durS"] > 0, p
+    assert p["ops"] and p["ops"][0]["name"] == "Count", p
+    def walk(n):
+        yield n
+        for c in n.get("children", []):
+            yield from walk(c)
+    evals = [n for op in p["ops"] for n in walk(op)
+             if n["name"].startswith("eval:")]
+    assert evals and evals[0]["jit"] in ("hit", "miss"), p
+    assert "deviceS" in evals[0] and evals[0]["shards"] == 2, p
+    assert p["ops"][0]["d2hBytes"] > 0, p
+    assert "pilosa_executor_" in prometheus_text(api.stats)
+    h.close()
+print("profiler smoke OK")
+EOF
+
 step "lock-order runtime check (PILOSA_TPU_LOCK_CHECK=1)"
 PILOSA_TPU_LOCK_CHECK=1 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_coalescer.py tests/test_concurrency.py \
