@@ -47,6 +47,9 @@ def _expand_sparse_chunk(pos16: np.ndarray, lens: np.ndarray,
     import jax.numpy as jnp
 
     if _EXPAND_FN is None:
+        # graftlint: disable=GL006 — process-global build memoized in
+        # _EXPAND_FN; static (cap, width) + pow2-padded positions keep
+        # the variant count O(log P), not per-query churn.
         @functools.partial(jax.jit, static_argnums=(2, 3))
         def expand(pos, row_of, cap, width):
             total = cap * width

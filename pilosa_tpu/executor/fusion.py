@@ -1,0 +1,277 @@
+"""Same-signature query fusion: one XLA dispatch for N batched queries.
+
+The dominant serving shape is a flood of structurally identical
+1-ms-class queries — ``Count(Row(user=X))`` for a million different X.
+The coalescer (server/coalescer.py) already lands them in one
+``Executor.execute_batch``, and read-dedup collapses *equal* queries,
+but each remaining *similar* query still paid its own host dispatch:
+plan + ``fn(...)`` enqueue, which the PR 3 profiler shows dwarfing the
+fenced device time for small trees. The roaring line of work (Chambi
+et al., arXiv:1402.6407) wins by amortizing per-op overhead across
+batched bitmap operations; this module is the dispatch-level analog.
+
+A compiled tree program is fully parameterized by its traced operand
+vectors (``idxs``, ``params``, ``lits``) under a shape signature
+``sig`` (Executor._stage_tree). So N staged evals with the same
+``(sig, bank identity)`` — same tree shape over the same device banks,
+different row ids / BSI predicates / literals — can stack their
+operand vectors along a new leading batch axis and run through ONE
+jitted ``vmap`` of the representative's program, returning ``[B, S]``
+counts or ``[B, S, W]`` row words that finalize slices per query.
+Bitwise ops and popcounts are deterministic elementwise/reduce
+kernels, so per-query results are bit-identical to the unfused path.
+
+Batch sizes pad up to a power of two (repeating the first entry's
+operands) so the compile cache holds O(log B) fused variants per
+signature instead of one per batch size; the pad lanes are sliced off
+before any result is read.
+
+Write fencing is the collector's caller's job: ``execute_batch``
+flushes the collector before dispatching any write-containing request
+and dispatches that request uncollected, so no read fuses across a
+write that orders between them (tests/test_fusion.py pins this).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class FusedEval:
+    """One query's slice of a fusion group's output. Stands in for the
+    device array ``_eval_tree`` would have returned: ``np.asarray``
+    resolves it (sharing ONE device->host fetch across the whole
+    group), ``copy_to_host_async``/``nbytes`` make it a valid
+    ``_Pending.arrays`` entry, and ``device_words()`` hands consumers
+    that want to stay on device the sliced jax array."""
+
+    __slots__ = ("group", "b", "shape", "slice_nbytes")
+
+    def __init__(self, group: "_FuseGroup", b: int,
+                 shape: Tuple[int, ...]):
+        self.group = group
+        self.b = b
+        self.shape = shape  # per-query output shape ([S] or [S, W])
+        self.slice_nbytes = int(np.prod(shape)) * 4
+
+    @property
+    def nbytes(self) -> int:
+        return self.slice_nbytes
+
+    def _out(self):
+        g = self.group
+        if g.error is not None:
+            raise g.error
+        if g.out is None:
+            # Resolution before the batch's flush point means a staged
+            # eval leaked outside execute_batch's dispatch/flush
+            # bracket — run the group now rather than deadlock.
+            g.run()
+            if g.error is not None:
+                raise g.error
+        return g.out
+
+    def device_words(self):
+        """This query's output as a device array (one slice op)."""
+        out = self._out()
+        return out[self.b] if self.group.batched else out
+
+    # graftlint: materialize — FusedEval.host IS the device->host
+    # boundary for fused results: the group's [B, ...] output fetches
+    # once and every member slices the cached host copy.
+    def host(self) -> np.ndarray:
+        g = self.group
+        out = self._out()
+        if g.host is None:
+            g.host = np.asarray(out)
+        return g.host[self.b] if g.batched else g.host
+
+    def __array__(self, dtype=None, copy=None):
+        a = self.host()
+        return np.asarray(a, dtype=dtype) if dtype is not None else a
+
+    def copy_to_host_async(self) -> None:
+        """Start the group's (single, shared) async device->host copy
+        (prefetch_pendings calls this per _Pending array)."""
+        fn = getattr(self._out(), "copy_to_host_async", None)
+        if fn is not None:
+            fn()
+
+
+class _FuseGroup:
+    """All staged evals sharing one (sig, bank identity) key, plus the
+    profiling contexts captured when each was staged."""
+
+    __slots__ = ("executor", "entries", "profs", "nodes", "out", "host",
+                 "batched", "error")
+
+    def __init__(self, executor):
+        self.executor = executor
+        self.entries: List[Any] = []      # _StagedEval, batch order
+        self.profs: List[Any] = []        # QueryProfile or None
+        self.nodes: List[Any] = []        # ProfileNode or None
+        self.out = None                   # [B, ...] (or [...] solo)
+        self.host: Optional[np.ndarray] = None
+        self.batched = False
+        self.error: Optional[Exception] = None
+
+    def add(self, staged, prof, t_plan0: float) -> FusedEval:
+        node = None
+        if prof is not None:
+            # jit hit/miss is unknown until the group compiles at
+            # flush; tree_jit fills it in then. The stacked operand
+            # upload is likewise charged at flush via tree_h2d.
+            node = prof.tree(staged.mode, staged.sig, None,
+                             time.perf_counter() - t_plan0, 0,
+                             staged.n_shards)
+        b = len(self.entries)
+        self.entries.append(staged)
+        self.profs.append(prof)
+        self.nodes.append(node)
+        shape = ((staged.n_shards,) if staged.mode == "count"
+                 else (staged.n_shards, staged.width))
+        return FusedEval(self, b, shape)
+
+    def run(self) -> None:
+        """Compile (cached) + dispatch the group's single program and
+        attribute it back to every member's profile. Never raises: a
+        failure lands on `error` and surfaces per member when its
+        request finalizes — batchmates in other groups are unharmed."""
+        if self.out is not None or self.error is not None:
+            return
+        try:
+            self._run()
+        except Exception as e:
+            self.error = e
+        finally:
+            # Resolution needs only out/host/batched/error, but every
+            # result holds FusedEval -> group until its response is
+            # shaped — drop the staged closure graph (exprs capture
+            # plan objects and bank arrays) as soon as the program is
+            # in flight.
+            self.entries = []
+            self.profs = []
+            self.nodes = []
+
+    def _run(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        ex = self.executor
+        B = len(self.entries)
+        rep = self.entries[0]
+        if B == 1:
+            # Solo group: the exact unfused path (same program, same
+            # arg cache) so a lone query costs nothing extra.
+            fn, jit_hit = ex._tree_fn(rep)
+            idxs, params, uploaded = ex._staged_args(rep)
+            h2d = ((idxs.nbytes + params.nbytes) if uploaded else 0) \
+                + (rep.lits.nbytes if rep.lits is not None else 0)
+            t0 = time.perf_counter()
+            self.out = ex._call_program(fn, rep.bank_arrays, idxs,
+                                        params, rep.lits)
+            self._attribute(jit_hit, time.perf_counter() - t0, h2d,
+                            fused=False)
+            return
+        # Pad to the next power of two with the first entry's operands
+        # so distinct batch sizes share O(log B) compiled variants.
+        bp = 1 << (B - 1).bit_length()
+        rows = self.entries + [rep] * (bp - B)
+        key = f"fused{bp}|{rep.sig}"
+
+        def build():
+            # graftlint: disable=GL003 — host-list marshalling for the
+            # stacked operand upload (the device transfer is
+            # jnp.asarray).
+            i = jnp.asarray(np.asarray([e.idxs for e in rows],
+                                       np.int32))
+            # graftlint: disable=GL003 — host-list upload, as above.
+            p = jnp.asarray(np.asarray([e.params for e in rows],
+                                       np.uint32))
+            return i, p
+
+        # Repeated batch compositions (dashboards, hot row sets) hit
+        # the same LRU arg cache the solo path uses and skip both
+        # stacked uploads.
+        akey = (key, tuple(tuple(e.idxs) for e in rows),
+                tuple(tuple(e.params) for e in rows))
+        (idxs, params), uploaded = ex._cached_args(akey, build)
+        lits = None
+        if rep.lits is not None:
+            lits = jnp.stack([e.lits for e in rows])
+        fn = ex._jit_get(key)
+        jit_hit = fn is not None
+        if fn is None:
+            ex._note_jit_compile()
+            in_axes = (None, 0, 0, 0 if rep.lits is not None else None)
+            fn = jax.jit(jax.vmap(rep.runner(), in_axes=in_axes))
+            ex._jit_put(key, fn)
+        t0 = time.perf_counter()
+        out = ex._call_program(fn, rep.bank_arrays, idxs, params, lits)
+        dispatch_s = time.perf_counter() - t0
+        if bp != B:
+            out = out[:B]  # drop pad lanes before anything reads them
+        self.out = out
+        self.batched = True
+        ex._note_fused(B)
+        # Whole stacked upload (pad lanes included) spread over the B
+        # real members, so the per-query sum equals the real traffic.
+        h2d = ((idxs.nbytes + params.nbytes) // B if uploaded else 0) \
+            + (rep.lits.nbytes if rep.lits is not None else 0)
+        self._attribute(jit_hit, dispatch_s, h2d, fused=True)
+
+    def _attribute(self, jit_hit: bool, dispatch_s: float, h2d: int,
+                   fused: bool) -> None:
+        B = len(self.entries)
+        fence_profs = []
+        for b, (prof, node) in enumerate(zip(self.profs, self.nodes)):
+            if prof is None or node is None:
+                continue
+            prof.tree_jit(node, jit_hit)
+            prof.tree_h2d(node, h2d)
+            # The program ran once for the whole group: every member
+            # sees the group's dispatch time, labeled with its batch
+            # coordinates so readers know the cost is shared.
+            prof.tree_dispatch(node, dispatch_s)
+            if fused:
+                node.attrs["fusedBatch"] = B
+                node.attrs["batchIndex"] = b
+                prof.set_fused(B)
+            if prof.sample_device:
+                fence_profs.append((prof, node))
+        if fence_profs:
+            from pilosa_tpu.executor.executor import _fence_device
+            device_s = _fence_device(self.out)
+            for prof, node in fence_profs:
+                prof.tree_device(node, device_s)
+
+
+class FusionCollector:
+    """Per-batch registry of staged terminal evals, grouped by fusion
+    key. Installed thread-locally by execute_batch (Executor._fusing);
+    `flush()` runs every open group — called before a write-containing
+    request dispatches (the fence) and once after the dispatch loop."""
+
+    def __init__(self, executor):
+        self.executor = executor
+        self.groups: Dict[tuple, _FuseGroup] = {}
+
+    def add(self, staged, prof, t_plan0: float) -> FusedEval:
+        """Stage one eval; returns its FusedEval handle. Grouping is
+        by (sig, bank-array identity): the signature equates tree
+        shape, widths and shard count, and identity equates the actual
+        device operands — a write between two stages rebuilds the bank
+        and so splits them even without an explicit fence."""
+        key = (staged.sig, tuple(id(a) for a in staged.bank_arrays))
+        group = self.groups.get(key)
+        if group is None:
+            group = self.groups[key] = _FuseGroup(self.executor)
+        return group.add(staged, prof, t_plan0)
+
+    def flush(self) -> None:
+        groups, self.groups = self.groups, {}
+        for group in groups.values():
+            group.run()

@@ -34,6 +34,9 @@ class RowResult:
     def columns(self) -> np.ndarray:
         if self._columns is not None:
             return self._columns
+        # `words` may be a fusion handle (executor/fusion.FusedEval):
+        # np.asarray resolves it against the fused batch output, one
+        # shared transfer per fusion group.
         host = np.asarray(self.words)
         out = []
         for i, shard in enumerate(self.shards):
@@ -58,7 +61,11 @@ class RowResult:
     def count(self) -> int:
         from pilosa_tpu.ops.bitset import popcount
         import jax.numpy as jnp
-        return int(np.asarray(popcount(jnp.asarray(self.words),
+        words = self.words
+        dw = getattr(words, "device_words", None)
+        if dw is not None:  # fusion handle: slice on device, no bounce
+            words = dw()
+        return int(np.asarray(popcount(jnp.asarray(words),
                                        axis=(-2, -1))))
 
     def to_json(self) -> dict:

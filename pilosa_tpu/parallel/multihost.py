@@ -65,6 +65,8 @@ def child(process_id: int, coordinator: str) -> None:
     gb = jax.make_array_from_callback(b.shape, sharding,
                                       lambda idx: b[idx])
 
+    # graftlint: disable=GL006 — multihost dry-run probe kernel,
+    # compiled once per child process; no serving executor exists here.
     @jax.jit
     def count_intersect(x, y):
         # The executor's fused hot kernel: AND + popcount reduced over

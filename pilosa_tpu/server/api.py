@@ -81,6 +81,9 @@ class API:
         self.executor = Executor(holder, mesh=mesh)
         self.cluster = cluster
         self.stats = stats or NopStatsClient()
+        # Batch-scoped executor signals (fusion counters/group sizes)
+        # have no per-query profile to ride — feed them straight in.
+        self.executor.stats = self.stats
         self.tracer = tracer or NopTracer()
         self.long_query_time = 0.0  # seconds; 0 disables slow-query logs
         # Per-query execution profiler (utils/profile.py): every query
@@ -228,6 +231,10 @@ class API:
                               error=error,
                               long_query_time=self.long_query_time,
                               logger=self.logger, kind=kind)
+        # Cheap (one len() under a lock) and refreshed on the query
+        # path, so /metrics tracks compile-cache pressure live.
+        self.stats.gauge("executor.jit_cache_size",
+                         self.executor.jit_cache_size())
 
     def query(self, index: str, query: str,
               shards: Optional[Sequence[int]] = None,

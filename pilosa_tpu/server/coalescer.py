@@ -25,6 +25,12 @@ Mechanics:
 - Identical read-only queries in one write-free flush execute ONCE and
   fan the shaped response out to every requester (results are
   byte-identical by construction).
+- The distinct remainder passes through to `execute_batch` UNCHANGED:
+  the executor's fusion pass (executor/fusion.py) then collapses
+  *similar* queries — same tree shape, different row ids / predicates —
+  into one vmapped XLA dispatch per signature group, where read-dedup
+  only collapses *equal* ones. The flush span records how many of the
+  batch's queries fused (`fusedQueries`).
 
 Robustness pieces a production front door needs:
 - Admission control: a bounded pending queue; past capacity, submit
@@ -418,6 +424,15 @@ class QueryCoalescer:
                     len(batch), exec_start - item.enqueued_at)
         shaped = self.executor.execute_batch_shaped(reqs,
                                                     profiles=profiles)
+        if span is not None:
+            # Fusion attribution from this flush's OWN profiles (the
+            # process-wide executor counters also move under
+            # concurrent /batch/query traffic, so a before/after delta
+            # would claim work this flush never did).
+            span.set("fusedQueries",
+                     sum(1 for p in profiles
+                         if p is not None
+                         and getattr(p, "fused_batch", None)))
         for res, items in zip(shaped, owner):
             for item in items:
                 item.result = res

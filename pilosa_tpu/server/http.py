@@ -256,7 +256,12 @@ class Handler(BaseHTTPRequestHandler):
                 # structured replacement for grepping SLOW QUERY log
                 # lines (reference LongQueryTime, api.go:1048).
                 self._json({"queries": api.profiler.slow_queries(),
-                            "retraces": api.executor.jit_compiles})
+                            "retraces": api.executor.jit_compiles,
+                            "fusedDispatches":
+                                api.executor.fused_dispatches,
+                            "fusedQueries": api.executor.fused_queries,
+                            "jitCacheSize":
+                                api.executor.jit_cache_size()})
             elif path == "/metrics":
                 from pilosa_tpu.utils.stats import prometheus_text
                 self._bytes(prometheus_text(api.stats).encode(),

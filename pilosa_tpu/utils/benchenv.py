@@ -156,7 +156,10 @@ def make_salted_chain(kern, static_k=False):
         return acc
 
     if static_k:
+        # graftlint: disable=GL006 — bench-harness probe: compiles are
+        # the measurement, not serving traffic; no executor exists here.
         return jax.jit(chain_impl, static_argnums=2)
+    # graftlint: disable=GL006 — bench-harness probe, as above.
     jitted = jax.jit(chain_impl)
     # np.int32 keeps the scalar's dtype (and thus the trace signature)
     # stable across every chain length: one compile total.
@@ -344,6 +347,8 @@ def trivial_fetch_ms(samples: int = 9):
     if _trivial_probe is None:
         import jax
         import jax.numpy as jnp
+        # graftlint: disable=GL006 — trivial RTT probe, compiled once
+        # per process (memoized in _trivial_probe).
         f = jax.jit(lambda x: x + 1)
         x = jnp.zeros((1,), jnp.int32)
         np.asarray(f(x))  # compile + first transfer

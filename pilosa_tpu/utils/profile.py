@@ -121,6 +121,9 @@ class QueryProfile:
         self.totals = {"plan": 0.0, "dispatch": 0.0, "device": 0.0,
                        "materialize": 0.0}
         self.coalesced: Optional[Dict[str, Any]] = None
+        # Largest same-signature fusion group this query's evals ran
+        # in (None = nothing fused; see Executor.execute_batch).
+        self.fused_batch: Optional[int] = None
         self._frag_lock = make_lock("QueryProfile._frag_lock")
         self.node_fragments: Dict[str, Any] = {}
 
@@ -160,28 +163,51 @@ class QueryProfile:
         self.totals["materialize"] += materialize_s
         self.d2h_bytes += int(d2h_bytes)
 
-    def tree(self, mode: str, sig: str, jit_hit: bool, plan_s: float,
-             h2d_bytes: int, n_shards: int) -> ProfileNode:
+    def tree(self, mode: str, sig: str, jit_hit: Optional[bool],
+             plan_s: float, h2d_bytes: int, n_shards: int) -> ProfileNode:
         """One compiled tree program (Executor._eval_tree). Child of the
-        current op when one is open (it always is on the query path)."""
+        current op when one is open (it always is on the query path).
+        ``jit_hit=None`` means not-yet-known: batch-fused evals stage
+        before their group compiles; tree_jit() closes the field when
+        the fused program runs."""
         parent = self._cur
         node = (parent.child(f"eval:{mode}") if parent is not None
                 else ProfileNode(f"eval:{mode}"))
         if parent is None:
             self.ops.append(node)
         node.attrs["sig"] = sig[:200]
-        node.attrs["jit"] = "hit" if jit_hit else "miss"
         node.attrs["planS"] = plan_s
         node.attrs["shards"] = n_shards
         if h2d_bytes:
             node.attrs["h2dBytes"] = h2d_bytes
+        if jit_hit is not None:
+            self.tree_jit(node, jit_hit)
+        self.totals["plan"] += plan_s
+        self.h2d_bytes += int(h2d_bytes)
+        return node
+
+    def tree_jit(self, node: ProfileNode, jit_hit: bool) -> None:
+        node.attrs["jit"] = "hit" if jit_hit else "miss"
         if jit_hit:
             self.jit_hits += 1
         else:
             self.jit_misses += 1
-        self.totals["plan"] += plan_s
-        self.h2d_bytes += int(h2d_bytes)
-        return node
+
+    def tree_h2d(self, node: ProfileNode, h2d_bytes: int) -> None:
+        """Late H2D attribution for fused evals (the stacked operand
+        upload happens at group flush, after tree() recorded 0)."""
+        if h2d_bytes:
+            node.attrs["h2dBytes"] = \
+                node.attrs.get("h2dBytes", 0) + h2d_bytes
+            self.h2d_bytes += int(h2d_bytes)
+
+    def set_fused(self, batch: int) -> None:
+        """This query's terminal eval ran inside a fused batch of
+        `batch` same-signature queries (largest group wins when a
+        multi-call query fused several evals). Surfaces at top level
+        in to_json so the slow-query ring records group size without
+        walking the tree."""
+        self.fused_batch = max(self.fused_batch or 0, int(batch))
 
     def tree_dispatch(self, node: ProfileNode, dispatch_s: float) -> None:
         node.attrs["dispatchS"] = dispatch_s
@@ -221,6 +247,8 @@ class QueryProfile:
         span.set("profile.jitMisses", self.jit_misses)
         span.set("profile.h2dBytes", self.h2d_bytes)
         span.set("profile.d2hBytes", self.d2h_bytes)
+        if self.fused_batch:
+            span.set("profile.fusedBatch", self.fused_batch)
 
     def to_json(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -245,6 +273,8 @@ class QueryProfile:
             out["traceId"] = self.trace_id
         if self.coalesced:
             out["coalesced"] = self.coalesced
+        if self.fused_batch:
+            out["fusedBatch"] = self.fused_batch
         if self.error:
             out["error"] = self.error
         with self._frag_lock:

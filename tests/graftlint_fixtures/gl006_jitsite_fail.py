@@ -1,0 +1,28 @@
+"""GL006 fail fixture: jit build sites invisible to the retrace
+counter — no _note_jit_compile anywhere in the enclosing scope."""
+import functools
+
+import jax
+
+
+@jax.jit  # module-scope decorator build: flagged
+def _module_kernel(x):
+    return x + 1
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))  # flagged
+def _module_kernel2(x, *, flag=False):
+    return x if flag else -x
+
+
+class Runner:
+    _cache = {}
+
+    def kernel_for(self, shape):
+        # Cached, but the compile is never noted: the retrace counter
+        # stays flat while signature churn burns compiles — flagged.
+        fn = self._cache.get(shape)
+        if fn is None:
+            fn = jax.jit(lambda x: x * 2)
+            self._cache[shape] = fn
+        return fn

@@ -1,0 +1,32 @@
+"""GL006 pass fixture: every jit build site notes its compile (the
+tracked-cache idiom), or carries a justified suppression."""
+import jax
+
+
+class Runner:
+    def __init__(self):
+        self._jit_cache = {}
+        self.jit_compiles = 0
+
+    def _note_jit_compile(self):
+        self.jit_compiles += 1
+
+    def kernel_for(self, shape):
+        fn = self._jit_cache.get(shape)
+        if fn is None:
+            self._note_jit_compile()
+            fn = jax.jit(lambda x: x * 2)
+            self._jit_cache[shape] = fn
+        return fn
+
+    def nested_build(self, shape):
+        # The note may sit in the enclosing function while the build
+        # hides in a helper closure.
+        def build():
+            return jax.jit(lambda x: x + 1)
+        self._note_jit_compile()
+        return build()
+
+
+# graftlint: disable=GL006 — process-global compile-once probe kernel
+_PROBE = jax.jit(lambda x: x)

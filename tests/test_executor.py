@@ -967,9 +967,9 @@ def test_narrow_field_restricts_shard_sweep(tmp_path):
     seen = {}
     orig = ex._eval_tree
 
-    def spy(idx_, call, shards, mode):
+    def spy(idx_, call, shards, mode, fusible=False):
         seen["shards"] = list(shards)
-        return orig(idx_, call, shards, mode)
+        return orig(idx_, call, shards, mode, fusible=fusible)
 
     ex._eval_tree = spy
     (cnt,) = ex.execute("ns", "Count(Row(narrow=1))")

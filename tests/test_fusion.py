@@ -1,0 +1,243 @@
+"""Same-signature query fusion (executor/fusion.py + the Executor
+plan/compile/run split): a batch of N structurally identical queries —
+different row ids / BSI predicates over the same banks — must issue
+exactly ONE XLA program dispatch, with per-query results bit-identical
+to the unfused path; a write in the batch fences fusion groups across
+it. Dispatch counts are asserted deterministically through a stub on
+``Executor._call_program`` (the single funnel every compiled
+tree-program invocation passes through) plus the new
+``fused_dispatches``/``fused_queries`` counters and
+``Executor.jit_compiles``.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor.fusion import FusedEval
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+N_ROWS = 16
+
+
+@pytest.fixture
+def ex(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    rng = np.random.default_rng(11)
+    rows = rng.integers(0, N_ROWS, 6000).astype(np.uint64)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, 6000).astype(np.uint64)
+    f.import_bits(rows, cols)
+    g.import_bits(rows[::2], cols[::2])
+    idx.create_field("v", FieldOptions(type="int", min=0, max=10000))
+    vcols = rng.integers(0, 2 * SHARD_WIDTH, 800).astype(np.uint64)
+    idx.field("v").import_values(vcols,
+                                 rng.integers(0, 10000, 800)
+                                 .astype(np.int64))
+    idx.add_existence(cols)
+    executor = Executor(h)
+    yield executor
+    h.close()
+
+
+def count_dispatches(monkeypatch):
+    """Stub Executor._call_program to count real program dispatches."""
+    calls = []
+    orig = Executor._call_program
+
+    def stub(self, fn, *args):
+        calls.append(fn)
+        return orig(self, fn, *args)
+
+    monkeypatch.setattr(Executor, "_call_program", stub)
+    return calls
+
+
+def test_same_signature_counts_fuse_to_one_dispatch(ex, monkeypatch):
+    queries = [f"Count(Row(f={r}))" for r in range(8)]
+    direct = [ex.execute("i", q)[0] for q in queries]
+    calls = count_dispatches(monkeypatch)
+    jc0 = ex.jit_compiles
+    out = ex.execute_batch([("i", q, None) for q in queries])
+    assert [r[0][0] for r in out] == direct
+    assert len(calls) == 1, "8 same-signature counts must be 1 dispatch"
+    assert ex.fused_dispatches == 1
+    assert ex.fused_queries == 8
+    # Exactly one fresh compile: the fused (vmapped) program. The
+    # single-query program was compiled by the direct runs above.
+    assert ex.jit_compiles == jc0 + 1
+    # Same-shape repeat: still one dispatch, zero new compiles.
+    out2 = ex.execute_batch([("i", q, None) for q in queries])
+    assert [r[0][0] for r in out2] == direct
+    assert len(calls) == 2
+    assert ex.jit_compiles == jc0 + 1
+    assert ex.fused_dispatches == 2
+
+
+def test_write_fences_fusion_and_tail_read_observes_it(ex, monkeypatch):
+    (c0,) = ex.execute("i", "Count(Row(f=5))")
+    calls = count_dispatches(monkeypatch)
+    free_col = 2 * SHARD_WIDTH - 3
+    out = ex.execute_batch([
+        ("i", "Count(Row(f=5))", None),
+        ("i", f"Set({free_col}, f=5)", None),
+        ("i", "Count(Row(f=5))", None),
+    ])
+    assert out[0][0][0] == c0, "head read must see pre-write state"
+    assert out[1][0][0] is True
+    assert out[2][0][0] == c0 + 1, "tail read must observe the write"
+    # The two same-signature reads must NOT share a program across the
+    # write: one solo dispatch each (Set itself is a host-side write).
+    assert len(calls) == 2
+    assert ex.fused_dispatches == 0
+    assert ex.fused_queries == 0
+
+
+def test_mixed_signatures_form_independent_groups(ex, monkeypatch):
+    reqs = ([("i", f"Count(Row(f={r}))", None) for r in (1, 2, 3)]
+            + [("i", f"Row(f={r})", None) for r in (4, 5)]
+            + [("i", "Count(Intersect(Row(f=6), Row(g=7)))", None)])
+    direct = [ex.execute_full(i, q, shards=s) for i, q, s in reqs]
+    calls = count_dispatches(monkeypatch)
+    shaped = ex.execute_batch_shaped(reqs)
+    assert shaped == direct
+    # 3 groups: counts (fused x3), rows (fused x2), intersect (solo).
+    assert len(calls) == 3
+    assert ex.fused_dispatches == 2
+    assert ex.fused_queries == 5
+
+
+def test_row_results_bit_identical_and_non_pow2_padding(ex):
+    # B=5 pads the vmapped program to 8 lanes; the pad lanes must never
+    # leak into results.
+    reqs = [("i", f"Row(f={r})", None) for r in (0, 3, 7, 11, 15)]
+    direct = [ex.execute_full(i, q, shards=s) for i, q, s in reqs]
+    shaped = ex.execute_batch_shaped(reqs)
+    assert shaped == direct
+    assert ex.fused_queries == 5
+    jc = ex.jit_compiles
+    assert ex.execute_batch_shaped(reqs) == direct
+    assert ex.jit_compiles == jc, "same padded size must not recompile"
+
+
+def test_bsi_predicate_fusion(ex, monkeypatch):
+    # Same comparison shape, different traced predicate values -> one
+    # signature group, one dispatch.
+    queries = [f"Count(Row(v > {t}))" for t in (100, 2500, 7000, 9000)]
+    direct = [ex.execute("i", q)[0] for q in queries]
+    calls = count_dispatches(monkeypatch)
+    out = ex.execute_batch([("i", q, None) for q in queries])
+    assert [r[0][0] for r in out] == direct
+    assert len(calls) == 1
+    assert ex.fused_queries == 4
+    assert sorted(direct, reverse=True) != direct or len(set(direct)) > 1
+
+
+def test_error_isolation_batchmates_still_fuse(ex, monkeypatch):
+    calls = count_dispatches(monkeypatch)
+    out = ex.execute_batch([
+        ("i", "Count(Row(f=1))", None),
+        ("i", "Count(Row(nosuch=1))", None),  # plan-time error
+        ("i", "Count(Row(f=2))", None),
+    ])
+    assert isinstance(out[1], Exception)
+    assert out[0][0][0] == ex.execute("i", "Count(Row(f=1))")[0]
+    assert out[2][0][0] == ex.execute("i", "Count(Row(f=2))")[0]
+    assert calls, "good batchmates executed"
+    assert ex.fused_queries == 2
+
+
+def test_profile_attribution_fused_batch_fields(ex):
+    from pilosa_tpu.utils.profile import QueryProfile
+    queries = [f"Count(Row(f={r}))" for r in range(4)]
+    profs = [QueryProfile("i", q) for q in queries]
+    ex.execute_batch([("i", q, None) for q in queries], profiles=profs)
+    for b, p in enumerate(profs):
+        assert p.fused_batch == 4
+        evals = [n for op in p.ops for n in op.children
+                 if n.name.startswith("eval:")]
+        assert evals, p.ops
+        node = evals[0]
+        assert node.attrs["fusedBatch"] == 4
+        assert node.attrs["batchIndex"] == b
+        assert node.attrs["jit"] in ("hit", "miss")
+        assert p.to_json()["fusedBatch"] == 4
+
+
+def test_fused_eval_handle_surface(ex):
+    """The FusedEval stand-in must behave like the device array the
+    unfused path returns everywhere results code touches it."""
+    reqs = [("i", f"Row(f={r})", None) for r in (0, 1)]
+    out = ex.execute_batch(reqs)
+    (res0, _), (res1, _) = out
+    row0, row1 = res0[0], res1[0]
+    assert isinstance(row0.words, FusedEval)
+    assert row0.words.shape == np.asarray(row0.words).shape
+    assert row0.count() == len(row0.columns())
+    direct = ex.execute("i", "Row(f=0)")[0]
+    assert row0.columns().tolist() == direct.columns().tolist()
+    assert row1.count() == ex.execute("i", "Row(f=1)")[0].count()
+
+
+def test_jit_cache_is_lru_bounded_and_banks_survive(ex, monkeypatch):
+    # Placeholder banks live in their own cache now: an absent view
+    # resolves to an emptybank entry that compile-cache pressure must
+    # never evict.
+    ex.holder.index("i").create_field("empty")
+    ex.execute("i", "Count(Row(empty=1))")
+    assert any(k.startswith("emptybank:") for k in ex._bank_cache)
+    assert not any(k.startswith("emptybank:") for k in ex._jit_cache)
+    monkeypatch.setattr(ex, "JIT_CACHE_MAX", 2)
+    for r in range(4):
+        ex.execute("i", f"Count(Row(f={r}))")          # 1 sig
+        ex.execute("i", f"Count(Union(Row(f={r}), Row(g={r})))")
+        ex.execute("i", f"Row(f={r})")
+    assert ex.jit_cache_size() <= 2
+    assert any(k.startswith("emptybank:") for k in ex._bank_cache)
+    # Evicted programs recompile on demand and still answer correctly.
+    (c,) = ex.execute("i", "Count(Row(f=1))")
+    assert c == ex.execute("i", "Count(Row(f=1))")[0]
+
+
+def test_fusion_through_coalescer_end_to_end(ex):
+    """Concurrent same-shape single-query submits ride the coalescer
+    into one executor batch and fuse; responses match the direct path
+    exactly."""
+    from pilosa_tpu.server.coalescer import QueryCoalescer
+    from pilosa_tpu.utils.stats import MemStatsClient
+    queries = [f"Count(Row(f={r}))" for r in range(6)]
+    direct = {q: ex.execute_full("i", q) for q in queries}
+    co = QueryCoalescer(ex, window_s=0.2, max_batch=len(queries),
+                        stats=MemStatsClient())
+    co.start()
+    try:
+        results = {}
+        errors = []
+        barrier = threading.Barrier(len(queries))
+
+        def worker(q):
+            try:
+                barrier.wait()
+                results[q] = co.submit("i", q)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker, args=(q,))
+                   for q in queries]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert results == direct
+        assert ex.fused_queries >= len(queries)
+        assert ex.fused_dispatches >= 1
+    finally:
+        co.stop()

@@ -26,6 +26,7 @@ def fixture_config() -> Config:
         word_dtype_paths=("graftlint_fixtures/gl005",),
         state_paths=("graftlint_fixtures/",),
         factory_paths=("graftlint_fixtures/",),
+        jit_tracked_paths=("graftlint_fixtures/gl006",),
     )
 
 
@@ -47,6 +48,7 @@ def codes_for(filename, config=None):
     ("gl003_hostsync_fail.py", "gl003_hostsync_pass.py", "GL003"),
     ("gl004_retrace_fail.py", "gl004_retrace_pass.py", "GL004"),
     ("gl005_dtype_fail.py", "gl005_dtype_pass.py", "GL005"),
+    ("gl006_jitsite_fail.py", "gl006_jitsite_pass.py", "GL006"),
 ])
 def test_rule_fixtures(fail_fixture, pass_fixture, code):
     fail_codes = codes_for(fail_fixture)
@@ -79,13 +81,19 @@ def test_gl004_flags_both_call_and_import_time():
     assert codes_for("gl004_retrace_fail.py").count("GL004") >= 3
 
 
+def test_gl006_flags_decorator_partial_and_cached_call():
+    # module-scope @jax.jit, functools.partial(jax.jit, ...), and an
+    # un-noted cached build inside a method: three distinct site forms.
+    assert codes_for("gl006_jitsite_fail.py").count("GL006") >= 3
+
+
 def test_pass_fixtures_fully_clean():
     """Pass fixtures produce NO findings of any rule (not just 'not
     their own rule')."""
     for name in ("gl001_bare_acquire_pass.py", "gl001_module_state_pass.py",
                  "gl001_raw_lock_pass.py", "gl002_order_pass.py",
                  "gl003_hostsync_pass.py", "gl004_retrace_pass.py",
-                 "gl005_dtype_pass.py"):
+                 "gl005_dtype_pass.py", "gl006_jitsite_pass.py"):
         assert codes_for(name) == [], name
 
 

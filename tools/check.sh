@@ -85,6 +85,35 @@ with tempfile.TemporaryDirectory() as d:
 print("profiler smoke OK")
 EOF
 
+step "fusion smoke (16 same-signature counts -> 1 fused dispatch)"
+JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+import tempfile
+import numpy as np
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("fuse")
+    f = idx.create_field("f")
+    rng = np.random.default_rng(5)
+    rows = rng.integers(0, 16, 4000).astype(np.uint64)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, 4000).astype(np.uint64)
+    f.import_bits(rows, cols)
+    idx.add_existence(cols)
+    ex = Executor(h)
+    queries = [f"Count(Row(f={r}))" for r in range(16)]
+    direct = [ex.execute("fuse", q)[0] for q in queries]
+    out = ex.execute_batch([("fuse", q, None) for q in queries])
+    assert [r[0][0] for r in out] == direct, "fused != unfused results"
+    assert ex.fused_dispatches == 1, ex.fused_dispatches
+    assert ex.fused_queries == 16, ex.fused_queries
+    assert ex.jit_cache_size() > 0
+    h.close()
+print("fusion smoke OK")
+EOF
+
 step "lock-order runtime check (PILOSA_TPU_LOCK_CHECK=1)"
 PILOSA_TPU_LOCK_CHECK=1 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_coalescer.py tests/test_concurrency.py \

@@ -70,6 +70,11 @@ class Config:
     # instruments them); the factory module itself is exempt.
     factory_paths: Tuple[str, ...] = ("pilosa_tpu/",)
     factory_exempt: Tuple[str, ...] = ("pilosa_tpu/utils/locks.py",)
+    # GL006: packages where every jax.jit/pmap build site must be
+    # visible to the retrace counter (a _note_jit_compile call in an
+    # enclosing function) — an untracked site is a blind spot for the
+    # pilosa_executor_retrace series and /debug/queries.
+    jit_tracked_paths: Tuple[str, ...] = ("pilosa_tpu/",)
     select: Optional[Set[str]] = None
     ignore: Set[str] = field(default_factory=set)
 

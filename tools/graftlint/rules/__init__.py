@@ -5,6 +5,7 @@ from tools.graftlint.rules.gl002_lockorder import GL002LockOrder
 from tools.graftlint.rules.gl003_hostsync import GL003HostSync
 from tools.graftlint.rules.gl004_retrace import GL004Retrace
 from tools.graftlint.rules.gl005_dtype import GL005DtypeInvariant
+from tools.graftlint.rules.gl006_jitsite import GL006JitSite
 
 ALL_RULES = (
     GL001LockDiscipline(),
@@ -12,4 +13,5 @@ ALL_RULES = (
     GL003HostSync(),
     GL004Retrace(),
     GL005DtypeInvariant(),
+    GL006JitSite(),
 )

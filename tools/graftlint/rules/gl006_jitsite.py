@@ -1,0 +1,113 @@
+"""GL006 — untracked jit build sites.
+
+Every ``jax.jit`` / ``jax.pmap`` build site in the serving tree must be
+visible to the process-wide retrace counter: ``Executor.jit_compiles``
+increments via ``_note_jit_compile()`` at every cache-miss compile, and
+``/metrics`` exports the running total (``pilosa_executor_retrace``).
+A jit call that bypasses the ``_jit_cache``/``_note_jit_compile``
+helpers still burns real trace+compile time on signature churn — but
+invisibly: the retrace counter stays flat while latency climbs, which
+is exactly the diagnosis the PR 3 profiler exists to make.
+
+The check: a jit-building expression (``jax.jit(...)`` call,
+``@jax.jit`` decorator, or ``functools.partial(jax.jit, ...)``) inside
+a ``jit_tracked_paths`` package must have a ``_note_jit_compile(...)``
+call somewhere in an enclosing function — the idiom every tracked site
+uses (miss branch: note, build, cache). Module-scope jit builds can
+never note a compile on an instance and are flagged unconditionally;
+genuinely compile-once sites (process-global kernels, bench harness
+probes) carry a justified ``# graftlint: disable=GL006``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Tuple
+
+from tools.graftlint.engine import (
+    Finding, Project, Rule, SourceFile, dotted_name,
+)
+from tools.graftlint.rules.gl004_retrace import _JIT_NAMES, _jit_wrap_info
+
+_NOTE_NAME = "_note_jit_compile"
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_jit_build(node: ast.AST) -> bool:
+    """True for an expression that BUILDS a jitted callable: a
+    jax.jit/pmap(-partial) call, or a bare `jax.jit` decorator
+    reference."""
+    if isinstance(node, ast.Call):
+        return _jit_wrap_info(node) is not None
+    return dotted_name(node) in _JIT_NAMES
+
+
+def _notes_compile(fn: ast.AST) -> bool:
+    """Does this function (including nested scopes — the miss branch
+    often sits inside a helper closure) call _note_jit_compile?"""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name == _NOTE_NAME:
+                return True
+    return False
+
+
+class GL006JitSite(Rule):
+    code = "GL006"
+    name = "untracked-jit-site"
+
+    def check_file(self, sf: SourceFile,
+                   project: Project) -> Iterable[Finding]:
+        if not sf.in_path(project.config.jit_tracked_paths):
+            return ()
+        out: List[Finding] = []
+        # note_ok caches per enclosing function whether it (or a scope
+        # nested in it) notes compiles.
+        note_cache = {}
+
+        def tracked(stack: Tuple[ast.AST, ...]) -> bool:
+            for fn in stack:
+                ok = note_cache.get(id(fn))
+                if ok is None:
+                    ok = note_cache[id(fn)] = _notes_compile(fn)
+                if ok:
+                    return True
+            return False
+
+        def flag(node: ast.AST, stack: Tuple[ast.AST, ...],
+                 what: str) -> None:
+            if tracked(stack):
+                return
+            where = (f"function `{stack[-1].name}`" if stack
+                     else "module scope")
+            out.append(Finding(
+                sf.path, node.lineno, node.col_offset, self.code,
+                f"{what} in {where} bypasses the _jit_cache/"
+                f"_note_jit_compile helpers — this compile site is "
+                f"invisible to the retrace counter "
+                f"(pilosa_executor_retrace, /debug/queries)"))
+
+        def visit(node: ast.AST, stack: Tuple[ast.AST, ...]) -> None:
+            if isinstance(node, _FUNC_NODES):
+                # Decorators evaluate in the ENCLOSING scope.
+                for deco in node.decorator_list:
+                    if _is_jit_build(deco):
+                        flag(deco, stack, "jit-wrapping decorator")
+                    else:
+                        visit(deco, stack)
+                inner = stack + (node,)
+                for child in node.body + node.args.defaults:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call) and _is_jit_build(node):
+                flag(node, stack, f"`{dotted_name(node.func)}(` build")
+                # still descend: nested builds inside the args
+            for child in ast.iter_child_nodes(node):
+                visit(child, stack)
+
+        visit(sf.tree, ())
+        return out
