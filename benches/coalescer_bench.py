@@ -192,11 +192,14 @@ def main():
                 f"({coal_qps / direct_qps:.2f}x)")
 
         snap = api.stats.snapshot()
-        bs = snap["timings"].get("coalescer.batch_size", {})
+        # batch_size is a real cumulative histogram now (pow2 buckets);
+        # report the mean + the bucket distribution.
+        bs = snap["histograms"].get("coalescer.batch_size", {})
         out.update(results)
         out["value"] = results["identical"]["speedup"]
-        out["batch_size_p50"] = bs.get("p50")
-        out["batch_size_p99"] = bs.get("p99")
+        out["batch_size_mean"] = (round(bs["sum"] / bs["count"], 2)
+                                  if bs.get("count") else None)
+        out["batch_size_buckets"] = bs.get("buckets")
         out["deduped"] = snap["counters"].get("coalescer.deduped", 0)
         out["flush_reasons"] = {
             k.split(".", 2)[2]: v for k, v in snap["counters"].items()

@@ -18,6 +18,28 @@ import sys
 import numpy as np
 
 
+def drain_telemetry(api, watchdog=None, logger=None) -> None:
+    """The telemetry leg of the SIGTERM drain: stop the memory
+    watchdog and dump its flight-recorder ring, dump the profiler's
+    slow-query ring, and stop the tracer (ExportingTracer.stop joins
+    the exporter thread and performs the final flush) — so a graceful
+    shutdown never discards buffered telemetry. Factored out of
+    cmd_server's finally block so tests can drive it directly with a
+    simulated drain."""
+    if watchdog is not None:
+        watchdog.stop()
+        watchdog.dump(logger)
+    profiler = getattr(api, "profiler", None)
+    if profiler is not None:
+        profiler.dump(logger)
+    tracer = getattr(api, "tracer", None)
+    if tracer is not None:
+        if hasattr(tracer, "stop"):
+            tracer.stop()  # final flush of pending spans
+        elif hasattr(tracer, "flush"):
+            tracer.flush()
+
+
 def cmd_server(args) -> int:
     from pilosa_tpu.core.holder import Holder
     from pilosa_tpu.server import API, serve
@@ -141,6 +163,32 @@ def cmd_server(args) -> int:
             stats=stats, tracer=tracer, logger=logger)
         coalescer.start()
         api.coalescer = coalescer
+    watchdog = None
+    if cfg.telemetry_sample_every_s > 0:
+        # Always-on memory/health watchdog (utils/memledger.py): ledger
+        # + queue gauges sampled into a flight-recorder ring; pressure
+        # warnings when device bytes cross the HBM watermark. Host-side
+        # only — zero device fences, so it rides under any load.
+        from pilosa_tpu.core.view import BANK_BUDGET
+        from pilosa_tpu.utils.memledger import LEDGER, MemoryWatchdog
+
+        def _telemetry_gauges():
+            coal = api.coalescer
+            return {
+                "queueDepth": (coal.queue_depth()
+                               if coal is not None else 0),
+                "jitCacheSize": api.executor.jit_cache_size(),
+            }
+
+        watchdog = MemoryWatchdog(
+            LEDGER, stats=stats, logger=logger,
+            sample_every_s=cfg.telemetry_sample_every_s,
+            ring=cfg.telemetry_ring,
+            watermark_bytes=int(BANK_BUDGET.budget
+                                * cfg.telemetry_hbm_watermark),
+            extra_gauges=_telemetry_gauges)
+        watchdog.start()
+        api.watchdog = watchdog
     from pilosa_tpu.utils.diagnostics import (
         DiagnosticsCollector, RuntimeMonitor,
     )
@@ -257,8 +305,9 @@ def cmd_server(args) -> int:
         diagnostics.stop()
         if runtime_monitor is not None:
             runtime_monitor.stop()
-        if hasattr(tracer, "stop"):
-            tracer.stop()  # final flush of pending spans
+        # Telemetry drain: watchdog ring + slow-query ring dump to the
+        # log, tracer stop/flush — buffered telemetry survives SIGTERM.
+        drain_telemetry(api, watchdog=watchdog, logger=logger)
         holder.close()
         if hasattr(stats, "flush"):
             # Drain buffered statsd datagrams last, after every

@@ -34,6 +34,7 @@ from pilosa_tpu.ops.bitset import (
 from pilosa_tpu.storage.roaring import Bitmap, CONTAINER_BITS
 from pilosa_tpu.core import cache as cache_mod
 from pilosa_tpu.utils.logger import default_logger
+from pilosa_tpu.utils.memledger import LEDGER
 
 # Snapshot after this many logged single-bit ops (reference MaxOpN,
 # fragment.go:79).
@@ -570,6 +571,10 @@ class Fragment:
             self._slots = {}
             self._dirty = set()
             self._bank_all_rows = False
+            # Under the lock: a straggling unregister after release
+            # could delete the entry a concurrent bank() rebuild just
+            # re-registered (same invariant as Executor._jit_put).
+            LEDGER.unregister("fragment_bank", "bank", owner=self)
 
     def bank(self, row_ids: Optional[Sequence[int]] = None):
         """Return (device bank [slots, W] uint32, row->slot map) guaranteed
@@ -603,9 +608,21 @@ class Fragment:
                     base[self._slots[r]] = self.row_dense(r)
                 self._dirty -= set(refresh) | set(missing)
                 self._bank = jnp.asarray(base)
+                self._ledger_bank()
             elif self._bank is None:
                 self._bank = jnp.asarray(base)
+                self._ledger_bank()
             return self._bank, dict(self._slots)
+
+    def _ledger_bank(self) -> None:
+        """(Re-)register the per-fragment append-only bank with the HBM
+        ledger — rebuilds replace the entry in place (same key), and a
+        collected fragment purges it via the ledger's owner tracking."""
+        LEDGER.register(
+            "fragment_bank", "bank",
+            len(self._slots) * WORDS_PER_SHARD * 4, owner=self,
+            index=self.index, field=self.field, view=self.view,
+            shard=self.shard, rows=len(self._slots))
 
     def row_device(self, row_id: int):
         """One row as a device array (gather from the bank)."""

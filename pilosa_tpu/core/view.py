@@ -16,6 +16,7 @@ import numpy as np
 
 from pilosa_tpu.core.fragment import CONTAINER_BITS, Fragment
 from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.utils.memledger import LEDGER
 
 VIEW_STANDARD = "standard"
 VIEW_BSI_PREFIX = "bsig_"
@@ -85,6 +86,12 @@ class BankBudget:
     what stays resident. Evicted banks drop out of their view's cache (the
     device array frees once the last query referencing it drains)."""
 
+    # Ledger categories a view registers its cached entries under; an
+    # eviction must clear whichever one the key belongs to (keys are
+    # disjoint across categories, and unregister is idempotent, so
+    # clearing all three is one cheap dict miss per non-owner).
+    LEDGER_CATEGORIES = ("bank", "pbank", "host_block")
+
     def __init__(self, budget_bytes: int, cache_attr: str = "_bank_cache"):
         self.budget = budget_bytes
         self.cache_attr = cache_attr
@@ -113,6 +120,8 @@ class BankBudget:
                 self.total -= nb
                 self.evictions += 1
                 getattr(v, self.cache_attr).pop(vkey, None)
+                for cat in self.LEDGER_CATEGORIES:
+                    LEDGER.unregister(cat, (vid, vkey))
             self._entries[ek] = (view, nbytes)
             self.total += nbytes
 
@@ -127,6 +136,8 @@ class BankBudget:
             old = self._entries.pop((id(view), key), None)
             if old is not None:
                 self.total -= old[1]
+        for cat in self.LEDGER_CATEGORIES:
+            LEDGER.unregister(cat, (id(view), key))
 
 
 # Default sized for a v5e-class chip (16 GiB HBM): 12 GiB of resident
@@ -314,6 +325,21 @@ class View:
 
     # -- device bank --------------------------------------------------------
 
+    def _ledger_bank(self, cache_key, bank: "ViewBank",
+                     n_rows: int) -> None:
+        """Register a cached dense bank with the HBM ledger: total vs
+        pow2-pad bytes (capacity rows beyond n_rows + the zero slot),
+        tagged so /debug/memory's top-K names the occupant. Keyed
+        identically to the BankBudget entry, which unregisters it on
+        eviction."""
+        cap, s, w = (int(x) for x in bank.array.shape)
+        row_bytes = s * w * 4
+        LEDGER.register(
+            "bank", cache_key, cap * row_bytes,
+            padded_bytes=max(0, cap - n_rows - 1) * row_bytes,
+            owner=self, index=self.index, field=self.field,
+            view=self.name, nShards=s, rows=n_rows)
+
     # Word granularity of declared-bound trims: 128 u32 words = 4096
     # bits = one full VPU lane row, and exactly a Morgan fingerprint.
     TRIM_GRANULE = 128
@@ -394,6 +420,8 @@ class View:
                     if patched is not None:
                         self._bank_cache[cache_key] = patched
                         BANK_BUDGET.touch(self, cache_key)
+                        self._ledger_bank(cache_key, patched,
+                                          len(row_set))
                         return patched
             else:
                 row_set = sorted(set(rows))
@@ -467,11 +495,19 @@ class View:
                                                      slots)
                         HOST_BLOCK_BUDGET.admit(self, hb_key,
                                                 nbytes=entry_bytes)
+                        LEDGER.register(
+                            "host_block", hb_key, entry_bytes,
+                            padded_bytes=max(0, cap - len(row_set) - 1)
+                            * len(shards) * width * 4,
+                            owner=self, index=self.index,
+                            field=self.field, view=self.name,
+                            nShards=len(shards), rows=len(row_set))
                 array = mesh.put_bank(host) if mesh else jnp.asarray(host)
             bank = ViewBank(array, slots, cap - 1, versions)
             if rows is None or cache_rows:
                 self._bank_cache[cache_key] = bank
                 BANK_BUDGET.admit(self, cache_key)
+                self._ledger_bank(cache_key, bank, len(row_set))
             return bank
 
     def _build_pbank_segments(self, frag, rows: list, width: int,
@@ -680,6 +716,15 @@ class View:
         with self._lock:
             self._bank_cache[key] = bank
         BANK_BUDGET.admit(self, key, nbytes=nbytes)
+        # Ideal (pad-free) footprint: 2 B per real position + one i32
+        # aux word per row (+1); the rest is pow2 / fixed-width / row
+        # padding — the number the padding gauge exists to surface.
+        ideal = sum(p * 2 + (n + 1) * 4 for _, n, _, _, p in segments)
+        LEDGER.register(
+            "pbank", key, nbytes,
+            padded_bytes=max(0, nbytes - ideal), owner=self,
+            index=self.index, field=self.field, view=self.name,
+            shard=shard, rows=len(row_ids))
         return bank
 
     def _patch_pbank(self, cached: PositionsBank, frag, width: int):

@@ -52,6 +52,7 @@ from pilosa_tpu.ops.bitset import SHARD_WIDTH, WORDS_PER_SHARD, \
     transfer_nbytes
 from pilosa_tpu.pql import Call, Condition, Query, parse_string_cached
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
+from pilosa_tpu.utils.memledger import LEDGER
 
 _LOG = logging.getLogger("pilosa_tpu.executor")
 
@@ -178,11 +179,19 @@ class _Pending:
     serial fetch RTTs, which is what makes 1 ms-class queries batch
     usefully through a ~70 ms-RTT tunnel."""
 
-    __slots__ = ("finalize", "arrays")
+    __slots__ = ("finalize", "arrays", "__weakref__")
 
     def __init__(self, finalize, arrays=()):
         self.finalize = finalize
         self.arrays = arrays
+        if arrays:
+            # Ledger the not-yet-fetched device outputs (category
+            # "pending"): keyed on this object, auto-unregistered when
+            # finalize drops the last reference — so /debug/memory
+            # counts result arrays queued behind a slow drain.
+            LEDGER.track(self, "pending",
+                         sum(int(getattr(a, "nbytes", 0) or 0)
+                             for a in arrays))
 
 
 def prefetch_pendings(staged) -> None:
@@ -476,10 +485,21 @@ class Executor:
             return fn
 
     def _jit_put(self, key: str, fn: Callable) -> None:
+        # Compiled XLA executables occupy HBM too; their sizes are not
+        # introspectable from here, so the ledger carries the entry
+        # COUNT (bytes 0) — eviction decrements the gauge (pinned by
+        # tests/test_memledger.py). Ledger updates happen UNDER the
+        # cache lock (the ledger lock is a leaf, so the nesting is
+        # safe): deferring them would let an evict/recompile interleave
+        # unregister another thread's freshly re-registered entry.
         with self._jit_cache_lock:
             while len(self._jit_cache) >= max(1, self.JIT_CACHE_MAX):
-                self._jit_cache.pop(next(iter(self._jit_cache)))
+                old = next(iter(self._jit_cache))
+                self._jit_cache.pop(old)
+                LEDGER.unregister("jit_cache", old, owner=self)
             self._jit_cache[key] = fn
+            LEDGER.register("jit_cache", key, 0, owner=self,
+                            sig=str(key)[:120])
 
     def jit_cache_size(self) -> int:
         """Live compiled-program count (the pilosa_executor_jit_cache_size
@@ -1420,6 +1440,9 @@ class Executor:
                 else jnp.asarray(host)
             bank = ViewBank(arr, {}, 0, {})
             self._bank_cache[key] = bank
+            LEDGER.register("bank", key, host.nbytes, owner=self,
+                            view="(placeholder)", nShards=n_shards,
+                            rows=0)
         return bank
 
     def _row_call_field(self, call: Call) -> Tuple[str, Any]:

@@ -39,6 +39,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pilosa_tpu.utils.memledger import LEDGER
+
 
 class FusedEval:
     """One query's slice of a fusion group's output. Stands in for the
@@ -106,7 +108,7 @@ class _FuseGroup:
     profiling contexts captured when each was staged."""
 
     __slots__ = ("executor", "entries", "profs", "nodes", "out", "host",
-                 "batched", "error")
+                 "batched", "error", "__weakref__")
 
     def __init__(self, executor):
         self.executor = executor
@@ -216,6 +218,16 @@ class _FuseGroup:
             out = out[:B]  # drop pad lanes before anything reads them
         self.out = out
         self.batched = True
+        # Ledger the group's device output: B live lanes plus the
+        # pow2 pad lanes (output + stacked operands) as padding bytes.
+        # Keyed on the group object, so the entry unregisters when the
+        # last member's response is shaped and the group is collected.
+        lane = (int(np.prod((rep.n_shards,) if rep.mode == "count"
+                            else (rep.n_shards, rep.width))) * 4)
+        pad = (bp - B) * lane \
+            + (idxs.nbytes + params.nbytes) * (bp - B) // bp
+        LEDGER.track(self, "fusion_pad", B * lane, padded_bytes=pad,
+                     batch=B, padTo=bp, sig=str(rep.sig)[:120])
         ex._note_fused(B)
         # Whole stacked upload (pad lanes included) spread over the B
         # real members, so the per-query sum equals the real traffic.

@@ -276,6 +276,14 @@ class InternalClient:
     def status(self, uri: str) -> dict:
         return self._req("GET", f"{uri}/status")
 
+    def node_health(self, uri: str, timeout: float = 5.0) -> dict:
+        """One node's health self-report (GET /internal/health) for the
+        coordinator's /cluster/health merge. Short dedicated-connection
+        timeout: the health plane must report a wedged node as
+        unhealthy, not hang the whole fleet document behind it."""
+        return self._req("GET", f"{uri}/internal/health",
+                         timeout=timeout)
+
     def local_shards(self, uri: str) -> Dict[str, List[int]]:
         return self._req("GET", f"{uri}/internal/local-shards")
 

@@ -77,6 +77,7 @@ class API:
         from pilosa_tpu.utils.tracing import NopTracer
         self.logger = Logger()
         self._translate_negative: Dict[Any, set] = {}
+        self._started_at = _time.time()
         self.holder = holder
         self.executor = Executor(holder, mesh=mesh)
         self.cluster = cluster
@@ -95,6 +96,9 @@ class API:
         # by the server wiring (cli/main.py) or a test harness; None
         # means every request takes the direct path.
         self.coalescer = None
+        # Always-on memory watchdog (utils/memledger.MemoryWatchdog),
+        # attached by cli/main.py; the health plane reports its state.
+        self.watchdog = None
         self.cluster_executor = None
         self.syncer = None
         self.resize_puller = None
@@ -751,6 +755,174 @@ class API:
                         frag.cache.invalidate()
                         for r in frag.row_ids():
                             frag.cache.add(r, frag.row_count(r))
+
+    # ------------------------------------------------- memory / health plane
+
+    def refresh_memory_gauges(self) -> None:
+        """Publish the memory-ledger gauges (pilosa_memory_bytes{category},
+        pilosa_memory_padding_bytes{category}) plus the jit-cache size
+        into the stats client. Called by the watchdog every sample and
+        by the /metrics handler so a scrape is never staler than one
+        request. Pure host-side dict reads — no device interaction."""
+        from pilosa_tpu.utils.memledger import LEDGER
+        LEDGER.publish(self.stats)
+        self.stats.gauge("executor.jit_cache_size",
+                         self.executor.jit_cache_size())
+
+    def debug_memory(self, top_k: int = 10) -> Dict[str, Any]:
+        """The GET /debug/memory document: per-category live/padded
+        bytes + the top-K largest resident banks (utils/memledger.py).
+        `totalBytes` equals the sum of the per-category byte totals by
+        construction (pinned by test)."""
+        from pilosa_tpu.utils.memledger import LEDGER
+        self.refresh_memory_gauges()
+        return LEDGER.snapshot(top_k=top_k)
+
+    def node_health(self) -> Dict[str, Any]:
+        """This node's health document (GET /internal/health): memory
+        ledger totals, coalescer queue depth, jit-cache/retrace/fusion
+        counters, slow-query count, watchdog state. The coordinator's
+        cluster_health() merges one of these per node."""
+        from pilosa_tpu.utils.memledger import LEDGER
+        now = _time.time()
+        if self.cluster is not None:
+            node_id, uri = self.cluster.local.id, self.cluster.local.uri
+            state = self.cluster.state
+        else:
+            node_id, uri, state = self.holder.node_id, "", "NORMAL"
+        mem = LEDGER.snapshot(top_k=3)
+        coal = self.coalescer
+        wd = self.watchdog
+        return {
+            "id": node_id,
+            "uri": uri,
+            "state": state,
+            "healthy": True,
+            "time": now,
+            "uptimeS": now - self._started_at,
+            "memory": {
+                "totalBytes": mem["totalBytes"],
+                "deviceBytes": mem["deviceBytes"],
+                "paddingBytes": mem["paddingBytes"],
+                "categories": {c: t["bytes"]
+                               for c, t in mem["categories"].items()},
+            },
+            "coalescer": {
+                "attached": coal is not None,
+                "running": bool(coal is not None and coal.running),
+                "queueDepth": coal.queue_depth() if coal is not None
+                else 0,
+            },
+            "executor": {
+                "jitCacheSize": self.executor.jit_cache_size(),
+                "retraces": self.executor.jit_compiles,
+                "fusedDispatches": self.executor.fused_dispatches,
+                "fusedQueries": self.executor.fused_queries,
+            },
+            # Cumulative, not ring occupancy (which saturates at the
+            # ring bound) — fleet totals must reflect the actual rate.
+            "slowQueries": self.profiler.slow_total,
+            "slowRing": self.profiler.ring_count(),
+            "watchdog": {
+                "running": bool(wd is not None and wd.running),
+                "samples": wd.samples_taken if wd is not None else 0,
+                "lastSampleAt": (wd.last_sample_at if wd is not None
+                                 else None),
+            },
+        }
+
+    @staticmethod
+    def _merge_health_totals(nodes: List[Dict[str, Any]]
+                             ) -> Dict[str, Any]:
+        tot = {"memoryBytes": 0, "paddingBytes": 0, "queueDepth": 0,
+               "jitCacheSize": 0, "retraces": 0, "slowQueries": 0}
+        for d in nodes:
+            mem = d.get("memory") or {}
+            tot["memoryBytes"] += int(mem.get("totalBytes", 0))
+            tot["paddingBytes"] += int(mem.get("paddingBytes", 0))
+            tot["queueDepth"] += int(
+                (d.get("coalescer") or {}).get("queueDepth", 0))
+            ex = d.get("executor") or {}
+            tot["jitCacheSize"] += int(ex.get("jitCacheSize", 0))
+            tot["retraces"] += int(ex.get("retraces", 0))
+            tot["slowQueries"] += int(d.get("slowQueries", 0))
+        return tot
+
+    def cluster_health(self) -> Dict[str, Any]:
+        """The GET /cluster/health document: one node_health() doc per
+        member — the local one inline, remote ones fanned out over the
+        internal client in parallel — merged with liveness (an
+        unreachable node reports healthy=false with the error; a node
+        the failure detector marks down reports down=true) and
+        staleness (ageS: how old each node's self-report is). Totals
+        aggregate memory/queue/jit/slow-query counters fleet-wide, so
+        capacity pressure is one document away instead of N scrapes."""
+        import threading as _threading
+        now = _time.time()
+        local = self.node_health()
+        if self.cluster is None:
+            local["down"] = False
+            local["ageS"] = 0.0  # same doc shape as the clustered path
+            nodes = [local]
+            return {"state": "NORMAL", "totalNodes": 1,
+                    "healthyNodes": 1, "nodes": nodes,
+                    "totals": self._merge_health_totals(nodes)}
+        docs: Dict[str, Dict[str, Any]] = {}
+        down = set(getattr(self.cluster, "down_ids", set()))
+
+        def fetch(node):
+            if node.id == self.cluster.local.id:
+                docs[node.id] = local
+                return
+            try:
+                doc = self._client.node_health(node.uri)
+                if not isinstance(doc, dict):
+                    raise ValueError(f"bad health body: {doc!r}")
+            except Exception as e:
+                doc = {"id": node.id, "uri": node.uri, "healthy": False,
+                       "error": f"{type(e).__name__}: {e}"}
+            # Coordinator-clock receipt stamp: ageS must measure how
+            # old the self-report is ON OUR CLOCK, not the cross-host
+            # skew a doc["time"] comparison would report.
+            doc["_received"] = _time.time()
+            docs[node.id] = doc
+
+        members = list(self.cluster.nodes())
+        threads = [_threading.Thread(target=fetch, args=(n,))
+                   for n in members]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        nodes = []
+        end = _time.time()
+        for node in members:
+            doc = docs.get(node.id,
+                           {"id": node.id, "uri": node.uri,
+                            "healthy": False, "error": "no response"})
+            doc.setdefault("id", node.id)
+            doc.setdefault("uri", node.uri)
+            doc["down"] = node.id in down
+            if doc["down"]:
+                doc["healthy"] = False
+            received = doc.pop("_received", now)
+            doc["ageS"] = max(0.0, end - received)
+            nodes.append(doc)
+        healthy = [d for d in nodes if d.get("healthy")]
+        # Totals aggregate every node that RESPONDED — a down-marked
+        # but still-answering node's banks are real fleet HBM and must
+        # not vanish from the capacity number just because the failure
+        # detector distrusts the node.
+        responded = [d for d in nodes if "memory" in d]
+        return {
+            "state": self.cluster.state,
+            "coordinator": next((n.id for n in members
+                                 if n.is_coordinator), None),
+            "totalNodes": len(nodes),
+            "healthyNodes": len(healthy),
+            "nodes": nodes,
+            "totals": self._merge_health_totals(responded),
+        }
 
     # ---------------------------------------------------------------- status
 

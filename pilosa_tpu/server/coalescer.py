@@ -154,6 +154,12 @@ class QueryCoalescer:
     def running(self) -> bool:
         return self._running
 
+    def queue_depth(self) -> int:
+        """Live pending-queue depth (the health plane reads this; the
+        coalescer.queue_depth gauge only updates on queue churn)."""
+        with self._cond:
+            return len(self._queue)
+
     def start(self) -> None:
         if self._running or (self._thread is not None
                              and self._thread.is_alive()):

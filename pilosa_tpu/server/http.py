@@ -9,7 +9,8 @@ Routes implemented (public):
   GET  /                      home/info
   POST /index/{i}/query       PQL (body: raw PQL or {"query": ...})
   GET  /schema  /status  /info  /version
-  GET  /debug/vars  /debug/queries  /metrics
+  GET  /debug/vars  /debug/queries  /debug/memory  /metrics
+  GET  /cluster/health
   GET  /index   /index/{i}
   POST /index/{i}             {"options": {"keys": bool, ...}}
   DEL  /index/{i}
@@ -25,7 +26,7 @@ Internal (node-to-node / sync):
   GET  /internal/fragment/data?...
   GET  /internal/shards/max
   GET  /internal/translate/data?index[&field][&offset]
-  GET  /internal/nodes
+  GET  /internal/nodes  /internal/health
 """
 
 from __future__ import annotations
@@ -262,8 +263,26 @@ class Handler(BaseHTTPRequestHandler):
                             "fusedQueries": api.executor.fused_queries,
                             "jitCacheSize":
                                 api.executor.jit_cache_size()})
+            elif path == "/debug/memory":
+                # HBM memory ledger (utils/memledger.py): per-category
+                # live vs padded bytes + the top-K largest resident
+                # banks — "what is occupying HBM right now".
+                self._json(api.debug_memory())
+            elif path == "/cluster/health":
+                # Coordinator-merged fleet health: per-node memory,
+                # queue depth, jit/retrace/slow-query counters,
+                # liveness and staleness in one document.
+                self._json(api.cluster_health())
+            elif path == "/internal/health":
+                # One node's self-report (the cluster_health fan-out
+                # leg).
+                self._json(api.node_health())
             elif path == "/metrics":
                 from pilosa_tpu.utils.stats import prometheus_text
+                # Memory gauges refresh at scrape time too, so
+                # pilosa_memory_bytes is live even between watchdog
+                # samples (and on watchdog-less embedded servers).
+                api.refresh_memory_gauges()
                 self._bytes(prometheus_text(api.stats).encode(),
                             ctype="text/plain; version=0.0.4")
             elif path == "/index":

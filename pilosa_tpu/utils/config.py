@@ -75,6 +75,20 @@ class Config:
     # Standing translate-log replication from the primary (reference
     # monitorReplication, translate.go:359); 0 disables
     translate_replication_interval: float = 10.0
+    # Telemetry watchdog (utils/memledger.MemoryWatchdog): always-on
+    # sampling of the HBM memory ledger + queue gauges into a bounded
+    # flight-recorder ring, dumped to the log on SIGTERM. Near-zero
+    # overhead (host-side dict reads; never fences the device). TOML
+    # accepts a [telemetry] table (sample_every_s / ring /
+    # hbm_watermark) or the flat telemetry_* spelling; env uses
+    # PILOSA_TPU_TELEMETRY_SAMPLE_EVERY_S etc. sample_every_s = 0
+    # disables the watchdog (the ledger itself is always on).
+    telemetry_sample_every_s: float = 10.0
+    telemetry_ring: int = 360  # flight-recorder snapshots kept
+    # HBM pressure watermark as a fraction of the resident-bank budget
+    # (PILOSA_TPU_HBM_BUDGET_BYTES): crossing it logs one warning with
+    # the top-K largest banks. 0 disables the warning.
+    telemetry_hbm_watermark: float = 0.9
     # Metrics (reference server/config.go Metric.Service/Host: expvar |
     # statsd | none — "mem" is the expvar equivalent)
     metric_service: str = "mem"   # mem | statsd | none
@@ -152,6 +166,13 @@ class Config:
             raise ValueError("profile sample_every must be >= 0")
         if self.profile_slow_ring < 1:
             raise ValueError("profile slow_ring must be >= 1")
+        if self.telemetry_sample_every_s < 0:
+            raise ValueError("telemetry sample_every_s must be >= 0")
+        if self.telemetry_ring < 1:
+            raise ValueError("telemetry ring must be >= 1")
+        if not 0 <= self.telemetry_hbm_watermark <= 1:
+            raise ValueError(
+                "telemetry hbm_watermark must be in [0, 1]")
 
     def server_ssl_context(self):
         """ssl.SSLContext for the listener, or None when TLS is off

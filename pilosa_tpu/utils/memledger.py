@@ -1,0 +1,400 @@
+"""HBM memory ledger + always-on telemetry watchdog.
+
+The whole design keeps the index resident as packed device banks in
+HBM, which makes device memory the resource that decides how many
+shards a node can hold — yet before this module nothing could answer
+"what is occupying HBM right now, and how much of it is padding?".
+PR 3/PR 4 gave *per-query* visibility (profiler, fusion attribution);
+this is the *per-resource* counterpart:
+
+- ``MemoryLedger``: a process-wide registry every long-lived device
+  (and host-cache) allocation registers with — view banks (tagged
+  index/field/view/shard), positions banks, the executor's LRU jit
+  cache, fusion pad lanes, pending result arrays, host block caches.
+  Each entry carries live bytes AND padded bytes, so pow2 padding
+  waste is a first-class number instead of folklore. Served at
+  ``GET /debug/memory`` and exported as ``pilosa_memory_bytes{category}``
+  / ``pilosa_memory_padding_bytes{category}`` gauges.
+- ``MemoryWatchdog``: an always-on sampling thread (Monarch-style
+  continuous low-overhead collection; cf. PAPERS.md) that snapshots
+  the ledger + a few queue gauges into a bounded flight-recorder ring,
+  logs a pressure warning with the top-K banks when a configurable HBM
+  watermark is crossed, and dumps the ring to the log on SIGTERM so
+  post-mortems always have the last N snapshots.
+
+Pure host-side module: NO jax imports, no device fencing — sampling a
+dict of integers can never stall the dispatch queue (graftlint GL003
+stays clean by construction).
+
+Registration contract: keys are scoped to an ``owner`` object (a View,
+Fragment, Executor, ...) whenever one exists; the ledger drops every
+entry of a garbage-collected owner via ``weakref.finalize``, so objects
+without an explicit close() cannot leak ledger rows after they — and
+their device arrays — are gone.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from pilosa_tpu.utils.locks import make_rlock
+
+# Categories whose bytes live in host RAM, not device HBM: excluded
+# from the watchdog's HBM watermark (but still ledgered + exported).
+HOST_CATEGORIES = frozenset({"host_block"})
+
+
+class _Entry:
+    __slots__ = ("category", "key", "nbytes", "padded", "meta", "oid")
+
+    def __init__(self, category: str, key: Any, nbytes: int,
+                 padded: int, meta: Dict[str, Any],
+                 oid: Optional[int] = None):
+        self.category = category
+        self.key = key
+        self.nbytes = int(nbytes)
+        self.padded = int(padded)
+        self.meta = meta
+        # id() of the owner the entry was registered under (None for
+        # unowned entries): unregistration must clean the owner's
+        # key-set HOWEVER it was reached — eviction paths unregister
+        # by bare scoped key, without the owner in hand.
+        self.oid = oid
+
+
+class MemoryLedger:
+    """Thread-safe registry of live allocations, grouped by category.
+
+    ``register`` replaces an existing (category, key) entry in place
+    (the bank-replace path re-registers under the same key; totals
+    never double-count). ``unregister`` is idempotent — eviction paths
+    race with close() and both may fire for the same key.
+
+    GC discipline: ``weakref.finalize`` callbacks run at arbitrary
+    allocation points — potentially while the current thread holds ANY
+    lock (including, under PILOSA_TPU_LOCK_CHECK, the order checker's
+    own non-reentrant mutex). A finalizer that takes the ledger lock
+    can therefore deadlock the process. So finalizers here are
+    lock-free: they append to ``_dead`` (deque.append is atomic) and
+    every public ledger operation drains that queue before doing its
+    own work."""
+
+    TOP_K = 10
+
+    def __init__(self):
+        self._lock = make_rlock("MemoryLedger._lock")
+        self._entries: Dict[Tuple[str, Any], _Entry] = {}
+        # category -> [bytes, padded, count]; categories persist at
+        # zero once seen so exported gauges drop to 0 instead of
+        # disappearing from /metrics.
+        self._totals: Dict[str, List[int]] = {}
+        # id(owner) -> set of (category, key) to purge when the owner
+        # is collected.
+        self._owned: Dict[int, set] = {}
+        # Deaths reported by GC finalizers, pending processing:
+        # ("entry", (category, key)) | ("owner", oid).
+        self._dead: deque = deque()
+
+    # ------------------------------------------------------------ mutation
+
+    def _scoped(self, key: Any, owner: Optional[Any]) -> Any:
+        return (id(owner), key) if owner is not None else key
+
+    def _note_dead(self, kind: str, payload: Any) -> None:
+        """weakref.finalize target — MUST stay lock-free (see class
+        docstring); the next ledger operation applies it."""
+        self._dead.append((kind, payload))
+
+    def _drain_dead(self) -> None:
+        while True:
+            try:
+                kind, payload = self._dead.popleft()
+            except IndexError:
+                return
+            if kind == "owner":
+                self._purge_owner(payload)
+            else:
+                category, key = payload
+                self._unregister_now(category, key, None)
+
+    def register(self, category: str, key: Any, nbytes: int,
+                 padded_bytes: int = 0, owner: Optional[Any] = None,
+                 **meta: Any) -> None:
+        """Track (or replace) one allocation. `owner` scopes the key to
+        a live object and auto-purges on its collection."""
+        self._drain_dead()
+        k = self._scoped(key, owner)
+        entry = _Entry(category, k, max(0, int(nbytes)),
+                       max(0, int(padded_bytes)), meta,
+                       oid=id(owner) if owner is not None else None)
+        with self._lock:
+            if owner is not None:
+                oid = id(owner)
+                owned = self._owned.get(oid)
+                if owned is None:
+                    owned = self._owned[oid] = set()
+                    weakref.finalize(owner, self._note_dead, "owner",
+                                     oid)
+                owned.add((category, k))
+            old = self._entries.get((category, k))
+            tot = self._totals.setdefault(category, [0, 0, 0])
+            if old is not None:
+                tot[0] -= old.nbytes
+                tot[1] -= old.padded
+                tot[2] -= 1
+            self._entries[(category, k)] = entry
+            tot[0] += entry.nbytes
+            tot[1] += entry.padded
+            tot[2] += 1
+
+    def unregister(self, category: str, key: Any,
+                   owner: Optional[Any] = None) -> None:
+        self._drain_dead()
+        self._unregister_now(category, key, owner)
+
+    def _unregister_now(self, category: str, key: Any,
+                        owner: Optional[Any]) -> None:
+        k = self._scoped(key, owner)
+        with self._lock:
+            old = self._entries.pop((category, k), None)
+            if old is None:
+                return
+            tot = self._totals.get(category)
+            if tot is not None:
+                tot[0] -= old.nbytes
+                tot[1] -= old.padded
+                tot[2] -= 1
+            # Clean the owner's key-set via the id recorded at
+            # registration: eviction paths unregister by bare scoped
+            # key (no owner in hand), and cache_rows keys embed whole
+            # row-id tuples — leaving them in the set would grow a
+            # long-lived view's bookkeeping without bound.
+            if old.oid is not None:
+                owned = self._owned.get(old.oid)
+                if owned is not None:
+                    owned.discard((category, k))
+
+    def _purge_owner(self, oid: int) -> None:
+        with self._lock:
+            for category, k in self._owned.pop(oid, ()):
+                old = self._entries.pop((category, k), None)
+                if old is None:
+                    continue
+                tot = self._totals.get(category)
+                if tot is not None:
+                    tot[0] -= old.nbytes
+                    tot[1] -= old.padded
+                    tot[2] -= 1
+
+    def track(self, obj: Any, category: str, nbytes: int,
+              padded_bytes: int = 0, **meta: Any) -> None:
+        """Register an allocation that lives exactly as long as `obj`
+        (fusion groups, pending result sets): keyed on the object,
+        unregistered automatically when it is collected. Deliberately
+        skips the per-owner key-set bookkeeping of `owner=` — this
+        runs per query result on the serving hot path, and a tracked
+        object has exactly one entry, so a direct finalize suffices.
+        (The finalize fires at collection, before the id can be
+        recycled, so the key cannot alias a successor object.)"""
+        key = ("obj", id(obj))
+        self.register(category, key, nbytes, padded_bytes, **meta)
+        weakref.finalize(obj, self._note_dead, "entry", (category, key))
+
+    # ------------------------------------------------------------- reading
+
+    def totals(self) -> Dict[str, Dict[str, int]]:
+        self._drain_dead()
+        with self._lock:
+            return {c: {"bytes": t[0], "paddedBytes": t[1], "count": t[2]}
+                    for c, t in sorted(self._totals.items())}
+
+    def total_bytes(self, device_only: bool = False) -> int:
+        self._drain_dead()
+        with self._lock:
+            return sum(t[0] for c, t in self._totals.items()
+                       if not (device_only and c in HOST_CATEGORIES))
+
+    def top(self, k: int = TOP_K,
+            device_only: bool = False) -> List[Dict[str, Any]]:
+        """The k largest live entries (the "what is actually occupying
+        HBM" list for /debug/memory and pressure warnings).
+        `device_only` drops host-RAM categories — the pressure warning
+        must name what contributes to the DEVICE number it fired on."""
+        self._drain_dead()
+        with self._lock:
+            entries = sorted(
+                (e for e in self._entries.values() if e.nbytes > 0
+                 and not (device_only
+                          and e.category in HOST_CATEGORIES)),
+                key=lambda e: e.nbytes, reverse=True)[:k]
+            return [{"category": e.category, "bytes": e.nbytes,
+                     "paddedBytes": e.padded, **e.meta}
+                    for e in entries]
+
+    def snapshot(self, top_k: int = TOP_K) -> Dict[str, Any]:
+        """The /debug/memory document. `totalBytes` is the exact sum of
+        the per-category byte totals (asserted by test); `deviceBytes`
+        derives from the SAME totals snapshot, so the two can never
+        disagree within one document."""
+        cats = self.totals()
+        return {
+            "totalBytes": sum(c["bytes"] for c in cats.values()),
+            "deviceBytes": sum(c["bytes"] for name, c in cats.items()
+                               if name not in HOST_CATEGORIES),
+            "paddingBytes": sum(c["paddedBytes"] for c in cats.values()),
+            "categories": cats,
+            "top": self.top(top_k),
+        }
+
+    def publish(self, stats) -> None:
+        """Export per-category gauges: pilosa_memory_bytes{category},
+        pilosa_memory_padding_bytes{category}, pilosa_memory_objects.
+        Totals are snapshotted under the lock; the stats client (its
+        own lock) is called outside it."""
+        if stats is None:
+            return
+        for cat, t in self.totals().items():
+            tagged = stats.with_tags(f"category:{cat}")
+            tagged.gauge("memory.bytes", t["bytes"])
+            tagged.gauge("memory.padding_bytes", t["paddedBytes"])
+            tagged.gauge("memory.objects", t["count"])
+
+
+# The process-wide ledger every allocation site registers with (the
+# memory analog of core.view.BANK_BUDGET — one process, one HBM).
+LEDGER = MemoryLedger()
+
+
+class MemoryWatchdog:
+    """Always-on, near-zero-overhead sampler: every `sample_every_s`
+    it snapshots the ledger (+ caller-supplied gauges: coalescer queue
+    depth, jit-cache size, ...) into a bounded flight-recorder ring,
+    publishes the memory gauges, and warns — with the top-K largest
+    banks — when device bytes cross `watermark_bytes`. The warning
+    re-arms only after pressure falls below 90% of the watermark, so a
+    hovering workload logs one line, not one per sample.
+
+    `dump()` writes the ring to the log; the server's SIGTERM drain
+    calls it so post-mortems always have the last N snapshots."""
+
+    def __init__(self, ledger: MemoryLedger = LEDGER, stats=None,
+                 logger=None, sample_every_s: float = 10.0,
+                 ring: int = 360, watermark_bytes: int = 0,
+                 top_k: int = 5,
+                 extra_gauges: Optional[Callable[[], Dict[str, Any]]]
+                 = None):
+        self.ledger = ledger
+        self.stats = stats
+        self.logger = logger
+        self.sample_every_s = max(0.05, float(sample_every_s))
+        self.watermark_bytes = int(watermark_bytes)
+        self.top_k = top_k
+        self.extra_gauges = extra_gauges
+        self._ring: deque = deque(maxlen=max(1, int(ring)))
+        self._ring_lock = make_rlock("MemoryWatchdog._ring_lock")
+        self._over_watermark = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.samples_taken = 0
+        self.last_sample_at: Optional[float] = None
+
+    # ------------------------------------------------------------ sampling
+
+    def sample_once(self) -> Dict[str, Any]:
+        """One flight-recorder snapshot: ledger totals + extra gauges.
+        Host-side dict arithmetic only — never touches the device."""
+        snap: Dict[str, Any] = {
+            "t": time.time(),
+            "totalBytes": 0,
+            "deviceBytes": 0,
+            "paddingBytes": 0,
+            "categories": {},
+        }
+        # One totals() read: every derived number in the snapshot is
+        # internally consistent.
+        for cat, t in self.ledger.totals().items():
+            snap["categories"][cat] = t["bytes"]
+            snap["totalBytes"] += t["bytes"]
+            snap["paddingBytes"] += t["paddedBytes"]
+            if cat not in HOST_CATEGORIES:
+                snap["deviceBytes"] += t["bytes"]
+        if self.extra_gauges is not None:
+            try:
+                snap.update(self.extra_gauges() or {})
+            except Exception:
+                pass  # gauges must never kill the watchdog
+        with self._ring_lock:
+            self._ring.append(snap)
+            self.samples_taken += 1
+            self.last_sample_at = snap["t"]
+        self.ledger.publish(self.stats)
+        self._check_watermark(snap)
+        return snap
+
+    def _check_watermark(self, snap: Dict[str, Any]) -> None:
+        if self.watermark_bytes <= 0:
+            return
+        device = snap["deviceBytes"]
+        if device >= self.watermark_bytes:
+            if not self._over_watermark:
+                self._over_watermark = True
+                if self.logger is not None:
+                    top = self.ledger.top(self.top_k,
+                                          device_only=True)
+                    self.logger.printf(
+                        "HBM pressure: %d bytes ledgered on device "
+                        "(watermark %d); top banks: %s",
+                        device, self.watermark_bytes, top)
+        elif device < int(self.watermark_bytes * 0.9):
+            self._over_watermark = False
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()  # restartable after stop()
+
+        def loop():
+            while not self._stop.wait(self.sample_every_s):
+                try:
+                    self.sample_once()
+                except Exception:
+                    pass  # a bad sample must not end always-on telemetry
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="mem-watchdog")
+        self._thread.start()
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.sample_every_s + 5)
+            self._thread = None
+
+    def snapshots(self) -> List[Dict[str, Any]]:
+        """Oldest-first copy of the flight-recorder ring."""
+        with self._ring_lock:
+            return list(self._ring)
+
+    def dump(self, logger=None, last: int = 10) -> int:
+        """Write the last `last` ring snapshots to the log (the SIGTERM
+        post-mortem path). Returns how many were written."""
+        logger = logger or self.logger
+        snaps = self.snapshots()[-max(0, int(last)):]
+        if logger is not None and snaps:
+            logger.printf("memory watchdog: dumping last %d of %d "
+                          "snapshots", len(snaps), self.samples_taken)
+            for s in snaps:
+                logger.printf("memory watchdog: %s", s)
+        return len(snaps)

@@ -303,6 +303,10 @@ class Profiler:
         self._lock = make_lock("Profiler._lock")
         self._seq = 0
         self._ring: deque = deque(maxlen=128)
+        # Cumulative slow-query count: the ring is bounded (its length
+        # saturates at capacity), so rate consumers — /internal/health,
+        # the fleet totals — need the running total.
+        self.slow_total = 0
 
     def configure(self, sample_every: Optional[int] = None,
                   ring_size: Optional[int] = None) -> None:
@@ -389,6 +393,7 @@ class Profiler:
             rec["error"] = f"{type(error).__name__}: {error}"
         with self._lock:
             self._ring.append(rec)
+            self.slow_total += 1
         self.stats.count("executor.slow_query", 1)
 
     def slow_queries(self) -> List[Dict[str, Any]]:
@@ -396,3 +401,23 @@ class Profiler:
         GET /debug/queries)."""
         with self._lock:
             return list(reversed(self._ring))
+
+    def ring_count(self) -> int:
+        """Slow-query records currently held (the health plane reads
+        this without copying the ring)."""
+        with self._lock:
+            return len(self._ring)
+
+    def dump(self, logger, last: int = 10) -> int:
+        """Write the most recent `last` slow-query records to the log —
+        the SIGTERM drain calls this so a shutdown never discards the
+        buffered evidence of what was slow. Returns records written."""
+        recs = self.slow_queries()[:max(0, int(last))]
+        if logger is not None and recs:
+            logger.printf("profiler: dumping %d slow-query record(s) "
+                          "on shutdown", len(recs))
+            for r in recs:
+                logger.printf(
+                    "profiler: %.3fs [%s] %s", r.get("durS", 0.0),
+                    r.get("index", "?"), r.get("query", ""))
+        return len(recs)

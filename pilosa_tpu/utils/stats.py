@@ -39,6 +39,12 @@ class NopStatsClient(StatsClient):
     pass
 
 
+# Bucket upper bounds for MemStatsClient histograms (+Inf implied).
+# Powers of two because every histogrammed quantity here is a batch /
+# fusion group size, and those pad to powers of two by construction.
+HISTOGRAM_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
 class MemStatsClient(StatsClient):
     """In-memory stats served at /debug/vars (the reference's expvar
     backend, stats/stats.go:84)."""
@@ -50,6 +56,13 @@ class MemStatsClient(StatsClient):
             self.counters: Dict[str, int] = defaultdict(int)
             self.gauges: Dict[str, float] = {}
             self.timings: Dict[str, List[float]] = defaultdict(list)
+            # Real cumulative histograms (fusion_group_size,
+            # batch_size): per-bucket increment counts + running sum —
+            # NOT an alias of the timing summary store, which cannot
+            # express Prometheus _bucket/_sum/_count semantics.
+            self.histos: Dict[str, dict] = defaultdict(
+                lambda: {"counts": [0] * (len(HISTOGRAM_BUCKETS) + 1),
+                         "sum": 0.0})
             self.sets: Dict[str, set] = defaultdict(set)
             self._lock = make_lock("MemStatsClient._lock")
 
@@ -71,7 +84,17 @@ class MemStatsClient(StatsClient):
             root.gauges[self._key(name)] = value
 
     def histogram(self, name, value, rate=1.0):
-        self.timing(name, value, rate)
+        """One observation into the bucketed histogram for `name`
+        (buckets HISTOGRAM_BUCKETS + +Inf; exported with cumulative
+        _bucket/_sum/_count lines by prometheus_text)."""
+        root = self._parent
+        i = 0
+        while i < len(HISTOGRAM_BUCKETS) and value > HISTOGRAM_BUCKETS[i]:
+            i += 1
+        with root._lock:
+            h = root.histos[self._key(name)]
+            h["counts"][i] += 1
+            h["sum"] += value
 
     def set(self, name, value, rate=1.0):
         root = self._parent
@@ -92,6 +115,16 @@ class MemStatsClient(StatsClient):
             out = {"counters": dict(root.counters),
                    "gauges": dict(root.gauges),
                    "sets": {k: sorted(v) for k, v in root.sets.items()}}
+            out["histograms"] = {}
+            for k, h in root.histos.items():
+                cum, buckets = 0, {}
+                for le, c in zip(HISTOGRAM_BUCKETS, h["counts"]):
+                    cum += c
+                    buckets[str(le)] = cum
+                buckets["+Inf"] = cum + h["counts"][-1]
+                out["histograms"][k] = {"buckets": buckets,
+                                        "sum": h["sum"],
+                                        "count": buckets["+Inf"]}
             out["timings"] = {}
             for k, vals in root.timings.items():
                 if vals:
@@ -333,11 +366,25 @@ def prometheus_text(stats) -> str:
         name, lab = split_key(k)
         n = f"pilosa_{name}"
         emit(n, "gauge", [f"{n}{lab} {v}"])
+    for k, h in sorted(snap.get("histograms", {}).items()):
+        # Real cumulative histogram exposition: _bucket counts are
+        # monotone non-decreasing in le, le="+Inf" equals _count, and
+        # _sum carries the running total (tests/test_stats.py pins the
+        # invariants).
+        name, lab = split_key(k)
+        n = f"pilosa_{name}"
+        inner = lab[1:-1] + "," if lab else ""
+        sample_lines = [f'{n}_bucket{{{inner}le="{le}"}} {c}'
+                        for le, c in h["buckets"].items()]
+        sample_lines.append(f"{n}_sum{lab} {h['sum']}")
+        sample_lines.append(f"{n}_count{lab} {h['count']}")
+        emit(n, "histogram", sample_lines)
     for k, t in sorted(snap.get("timings", {}).items()):
         name, lab = split_key(k)
         # The timings store holds any distribution, not only durations
-        # (MemStatsClient.histogram aliases to timing): a name ending
-        # in _size (e.g. coalescer.batch_size) is a unitless count and
+        # (bucketed histograms live in their own store above, but
+        # timing() is still called with unitless values): a name ending
+        # in _size (e.g. queue.wait_size) is a unitless count and
         # must not export with the _seconds suffix, which would assert
         # a time unit to every dashboard reading it.
         suffix = "" if name.endswith("_size") else "_seconds"

@@ -20,7 +20,51 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
+# W3C Trace Context (https://www.w3.org/TR/trace-context/): the
+# header every OTel-aware proxy/collector understands, so traces stay
+# joined across non-pilosa hops too. Format:
+#   traceparent: 00-<32 hex trace-id>-<16 hex parent-span-id>-<flags>
+TRACEPARENT_HEADER = "traceparent"
+# Pre-traceparent header, still EMITTED and ACCEPTED for one release
+# so a mixed-version cluster keeps correlating in both directions
+# during a rolling upgrade; both sides drop with the window.
 TRACE_HEADER = "X-Trace-Id"
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """00-<trace>-<span>-01 (flags 01 = sampled: we always record
+    locally; export sampling is decided at root-span close)."""
+    return (f"00-{trace_id[:32].ljust(32, '0')}"
+            f"-{span_id[:16].ljust(16, '0')}-01")
+
+
+def parse_traceparent(value: str) -> Optional[str]:
+    """Trace id from a traceparent header, or None when malformed
+    (wrong field count/width, non-hex, all-zero trace id, or the
+    reserved version ff). Malformed headers fall back to a fresh local
+    trace rather than poisoning the export pipeline."""
+    parts = value.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id, flags = parts[:4]
+    hexdigits = set("0123456789abcdef")
+    if len(version) != 2 or not set(version) <= hexdigits \
+            or version == "ff":
+        return None
+    # Version 00 defines exactly 4 fields; trailing fields make the
+    # header invalid (future versions may legitimately append them).
+    if version == "00" and len(parts) != 4:
+        return None
+    if len(trace_id) != 32 or not set(trace_id) <= hexdigits \
+            or trace_id == "0" * 32:
+        return None
+    # Parent span id must be 16 hex and not all-zero; flags 2 hex.
+    if len(span_id) != 16 or not set(span_id) <= hexdigits \
+            or span_id == "0" * 16:
+        return None
+    if len(flags) != 2 or not set(flags) <= hexdigits:
+        return None
+    return trace_id
 
 
 class Span:
@@ -95,11 +139,28 @@ class RecordingTracer:
                         del self.finished[: -self.keep]
 
     def inject(self, headers: Dict[str, str]) -> None:
+        """Stamp outgoing node-to-node requests with W3C traceparent:
+        the root span's trace id + the innermost open span as parent.
+        The legacy header rides along for the same one-release window
+        extract keeps accepting it — a not-yet-upgraded peer only
+        reads X-Trace-Id, and a mixed-version cluster must keep
+        correlating in BOTH directions during a rolling upgrade."""
         stack = self._stack()
         if stack:
+            headers[TRACEPARENT_HEADER] = format_traceparent(
+                stack[0].trace_id, stack[-1].span_id)
             headers[TRACE_HEADER] = stack[0].trace_id
 
     def extract(self, headers) -> None:
+        """Adopt an incoming trace context: W3C traceparent first, the
+        legacy X-Trace-Id spelling as a fallback (accepted for one
+        release so mixed-version clusters keep correlating)."""
+        tp = headers.get(TRACEPARENT_HEADER)
+        if tp:
+            tid = parse_traceparent(tp)
+            if tid is not None:
+                self._local.trace_id = tid
+                return
         tid = headers.get(TRACE_HEADER)
         if tid:
             self._local.trace_id = _sanitize_trace_id(tid)

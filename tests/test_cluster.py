@@ -1710,3 +1710,98 @@ def test_batch_query_cluster_path(tmp_path):
     finally:
         for nd in nodes:
             nd.stop()
+
+
+def test_traceparent_round_trip_coordinator_to_remote(tmp_path):
+    """W3C traceparent propagates across a coordinator→remote query
+    leg: the trace id a client sends to the coordinator stamps the
+    remote node's spans too (inject emits traceparent; extract adopts
+    it), so one distributed query is one trace end to end."""
+    from pilosa_tpu.utils.tracing import RecordingTracer
+
+    nodes = run_cluster(tmp_path, 2)
+    try:
+        tracers = []
+        for nd in nodes:
+            rt = RecordingTracer()
+            nd.api.tracer = rt
+            # The internal client captured the tracer at API build
+            # time; repoint it so outgoing legs inject the new one.
+            nd.api._client.tracer = rt
+            tracers.append(rt)
+        base = nodes[0].uri
+        req(base, "POST", "/index/tp", {"options": {}})
+        req(base, "POST", "/index/tp/field/f", {"options": {}})
+        cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+        req(base, "POST", "/index/tp/field/f/import",
+            {"rowIDs": [1] * 6, "columnIDs": cols})
+        trace_id = "f0" * 16
+        r = urllib.request.Request(
+            base + "/index/tp/query", data=b"Count(Row(f=1))",
+            method="POST",
+            headers={"traceparent": f"00-{trace_id}-{'ab' * 8}-01"})
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            assert json.loads(resp.read())["results"] == [6]
+        # Coordinator adopted the client's trace id...
+        coord_roots = [s for s in tracers[0].finished
+                       if s.name.startswith("API.Query")]
+        assert coord_roots and all(s.trace_id == trace_id
+                                   for s in coord_roots)
+        # ...and the remote leg carried it over the node-to-node hop.
+        remote_roots = [s for s in tracers[1].finished
+                        if s.trace_id == trace_id]
+        assert remote_roots, [s.trace_id for s in tracers[1].finished]
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_cluster_health_merges_nodes(tmp_path):
+    """/cluster/health on any member fans out over the internal client
+    and merges every node's self-report — memory, queue depth, jit and
+    slow-query counters — plus liveness: a severed node shows up as
+    healthy=false instead of vanishing from the document."""
+    nodes = run_cluster(tmp_path, 3)
+    try:
+        base = nodes[0].uri
+        req(base, "POST", "/index/ch", {"options": {}})
+        req(base, "POST", "/index/ch/field/f", {"options": {}})
+        cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+        req(base, "POST", "/index/ch/field/f/import",
+            {"rowIDs": [1] * 6, "columnIDs": cols})
+        res = req(base, "POST", "/index/ch/query", b"Count(Row(f=1))")
+        assert res["results"] == [6]
+
+        doc = req(base, "GET", "/cluster/health")
+        assert doc["totalNodes"] == 3
+        assert doc["healthyNodes"] == 3
+        assert len(doc["nodes"]) == 3
+        ids = {n["id"] for n in doc["nodes"]}
+        assert ids == {nd.uri for nd in nodes}
+        for n in doc["nodes"]:
+            assert n["healthy"] is True and n["down"] is False
+            assert n["memory"]["totalBytes"] >= 0
+            assert "queueDepth" in n["coalescer"]
+            assert "jitCacheSize" in n["executor"]
+            # Remote self-reports carry a staleness age; it is fresh.
+            assert n["ageS"] < 30
+        # The query above built at least one resident bank somewhere;
+        # the fleet totals see it.
+        assert doc["totals"]["memoryBytes"] > 0
+        assert doc["totals"]["memoryBytes"] == sum(
+            n["memory"]["totalBytes"] for n in doc["nodes"])
+
+        # Sever node 2: the merge reports it unhealthy with the error,
+        # and keeps merging the survivors.
+        nodes[2].stop_server_only()
+        nodes[0].api._client.drop_idle()
+        doc = req(base, "GET", "/cluster/health")
+        assert doc["totalNodes"] == 3
+        assert doc["healthyNodes"] == 2
+        dead = [n for n in doc["nodes"] if not n["healthy"]]
+        assert len(dead) == 1 and dead[0]["id"] == nodes[2].uri
+        assert "error" in dead[0]
+    finally:
+        nodes[2].holder.close()
+        for nd in nodes[:2]:
+            nd.stop()

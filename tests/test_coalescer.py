@@ -217,7 +217,7 @@ def test_dedup_identical_queries_one_execution(pair):
                    for st, body, _ in results), results
         snap = api.stats.snapshot()
         assert snap["counters"].get("coalescer.deduped", 0) > 0
-        assert snap["timings"]["coalescer.batch_size"]["count"] >= 1
+        assert snap["histograms"]["coalescer.batch_size"]["count"] >= 1
     finally:
         api.coalescer.window_s = 0.002
 
@@ -333,7 +333,7 @@ def test_stats_and_metrics_surface(pair):
     with urllib.request.urlopen(coal + "/debug/vars") as resp:
         snap = json.loads(resp.read())
     assert "coalescer.queue_depth" in snap["gauges"]
-    assert "coalescer.batch_size" in snap["timings"]
+    assert "coalescer.batch_size" in snap["histograms"]
     assert snap["counters"].get("coalescer.admitted", 0) >= 24
     assert any(k.startswith("coalescer.flush.")
                for k in snap["counters"]), snap["counters"]
@@ -341,7 +341,7 @@ def test_stats_and_metrics_surface(pair):
         text = resp.read().decode()
     assert "pilosa_coalescer_queue_depth" in text
     # occupancy is unitless: no _seconds suffix on the summary
-    assert "pilosa_coalescer_batch_size{" in text
+    assert "pilosa_coalescer_batch_size_bucket{" in text
     assert "pilosa_coalescer_batch_size_seconds" not in text
     assert "pilosa_coalescer_flush_" in text
 

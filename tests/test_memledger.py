@@ -1,0 +1,386 @@
+"""HBM memory ledger + watchdog invariants (utils/memledger.py).
+
+The invariants that make /debug/memory trustworthy: registered bytes
+return to baseline after bank evict/replace/close, jit-cache eviction
+decrements the gauge, the /debug/memory totals equal the sum of the
+per-category totals, and the watchdog samples without ever touching
+the device.
+"""
+
+import gc
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+from pilosa_tpu.utils.memledger import (
+    LEDGER, MemoryLedger, MemoryWatchdog,
+)
+
+
+class _LogStub:
+    def __init__(self):
+        self.lines = []
+
+    def printf(self, fmt, *args):
+        self.lines.append(fmt % args if args else fmt)
+
+    debugf = printf
+
+
+def _cat(ledger, name):
+    return ledger.totals().get(name,
+                               {"bytes": 0, "paddedBytes": 0, "count": 0})
+
+
+# ------------------------------------------------------------- pure ledger
+
+
+def test_register_replace_unregister_totals():
+    led = MemoryLedger()
+    led.register("bank", "k1", 100, padded_bytes=20, index="i")
+    led.register("bank", "k2", 50)
+    assert _cat(led, "bank") == {"bytes": 150, "paddedBytes": 20,
+                                 "count": 2}
+    # Same-key registration REPLACES (the bank-rebuild path): totals
+    # must not double-count.
+    led.register("bank", "k1", 200, padded_bytes=10)
+    assert _cat(led, "bank") == {"bytes": 250, "paddedBytes": 10,
+                                 "count": 2}
+    led.unregister("bank", "k1")
+    led.unregister("bank", "k1")  # idempotent (evict races close)
+    led.unregister("bank", "k2")
+    assert _cat(led, "bank") == {"bytes": 0, "paddedBytes": 0,
+                                 "count": 0}
+    # Categories persist at zero so exported gauges drop to 0 instead
+    # of disappearing between scrapes.
+    assert "bank" in led.totals()
+
+
+def test_snapshot_total_equals_category_sum_and_top():
+    led = MemoryLedger()
+    led.register("bank", "a", 300, padded_bytes=50, field="big")
+    led.register("pbank", "b", 100, shard=0)
+    led.register("jit_cache", "c", 0)
+    snap = led.snapshot(top_k=5)
+    assert snap["totalBytes"] == sum(
+        c["bytes"] for c in snap["categories"].values()) == 400
+    assert snap["paddingBytes"] == 50
+    # top is byte-ordered and excludes zero-byte entries (jit slots).
+    assert [e["bytes"] for e in snap["top"]] == [300, 100]
+    assert snap["top"][0]["field"] == "big"
+
+
+def test_owner_scoped_entries_purge_on_gc():
+    led = MemoryLedger()
+
+    class Owner:
+        pass
+
+    o = Owner()
+    led.register("bank", "k", 64, owner=o)
+    led.track(o, "pending", 32)
+    assert led.total_bytes() == 96
+    del o
+    gc.collect()
+    assert led.total_bytes() == 0
+
+
+def test_bare_key_unregister_cleans_owner_set():
+    """Eviction paths unregister by bare scoped key (no owner in
+    hand); the owner's key-set must shrink anyway or a long-lived
+    view's bookkeeping grows without bound."""
+    led = MemoryLedger()
+
+    class Owner:
+        pass
+
+    o = Owner()
+    led.register("bank", "k", 64, owner=o)
+    assert led._owned[id(o)]
+    led.unregister("bank", (id(o), "k"))  # how BankBudget evicts
+    assert not led._owned[id(o)]
+    assert led.total_bytes() == 0
+
+
+def test_host_categories_excluded_from_device_bytes():
+    led = MemoryLedger()
+    led.register("bank", "d", 100)
+    led.register("host_block", "h", 1000)
+    assert led.total_bytes() == 1100
+    assert led.total_bytes(device_only=True) == 100
+
+
+# ----------------------------------------------------- bank lifecycle wiring
+
+
+def test_bank_bytes_return_to_baseline_after_close(tmp_holder):
+    gc.collect()  # settle prior tests' dropped owners first
+    before = _cat(LEDGER, "bank")["bytes"]
+    idx = tmp_holder.create_index("ml")
+    f = idx.create_field("f")
+    f.import_bits(np.array([1, 1, 2], np.uint64),
+                  np.array([1, 2, SHARD_WIDTH + 3], np.uint64))
+    from pilosa_tpu.executor import Executor
+    ex = Executor(tmp_holder)
+    assert ex.execute("ml", "Count(Row(f=1))") == [2]
+    assert _cat(LEDGER, "bank")["bytes"] > before
+    tmp_holder.delete_index("ml")
+    assert _cat(LEDGER, "bank")["bytes"] == before
+
+
+def test_bank_replace_reregisters_not_double_counts(tmp_holder):
+    idx = tmp_holder.create_index("mr")
+    f = idx.create_field("f")
+    f.import_bits(np.array([1, 2], np.uint64),
+                  np.array([5, 6], np.uint64))
+    from pilosa_tpu.executor import Executor
+    ex = Executor(tmp_holder)
+    ex.execute("mr", "Count(Row(f=1))")
+    c1 = _cat(LEDGER, "bank")
+    # A write bumps the fragment version; the next query rebuilds or
+    # patches the cached bank under the SAME ledger key.
+    ex.execute("mr", "Set(7, f=1)")
+    ex.execute("mr", "Count(Row(f=1))")
+    c2 = _cat(LEDGER, "bank")
+    assert c2["count"] == c1["count"]
+    assert c2["bytes"] == c1["bytes"]  # same capacity -> same footprint
+    tmp_holder.delete_index("mr")
+
+
+def test_bank_eviction_unregisters(tmp_holder):
+    """When a bank budget evicts a cached bank, its ledger entry goes
+    with it — the ledger mirrors residency, not history. Exercised on
+    a dedicated BankBudget (same eviction code path as the process
+    BANK_BUDGET) so the test cannot storm-evict other tests' banks."""
+    from pilosa_tpu.core.view import BankBudget
+    from pilosa_tpu.executor import Executor
+    idx = tmp_holder.create_index("me")
+    idx.create_field("f").import_bits(
+        np.array([1], np.uint64), np.array([1], np.uint64))
+    ex = Executor(tmp_holder)
+    ex.execute("me", "Count(Row(f=1))")
+    view = idx.field("f").view()
+    key = next(iter(view._bank_cache))
+    gc.collect()  # settle other tests' dropped owners first
+    b1 = _cat(LEDGER, "bank")
+    small = BankBudget(1)
+    small.admit(view, key)       # over budget alone: stays (LRU floor)
+    small.admit(view, "other", nbytes=8)  # second entry evicts `key`
+    assert small.evictions == 1
+    assert key not in view._bank_cache
+    b2 = _cat(LEDGER, "bank")
+    assert b2["count"] == b1["count"] - 1
+    assert b2["bytes"] < b1["bytes"]
+    small.forget(view, "other")
+    tmp_holder.delete_index("me")
+
+
+def test_jit_cache_eviction_decrements_gauge(tmp_holder):
+    from pilosa_tpu.executor import Executor
+    gc.collect()  # settle prior tests' dropped executors first
+    before = _cat(LEDGER, "jit_cache")["count"]
+    ex = Executor(tmp_holder)
+    ex.JIT_CACHE_MAX = 2
+    for i in range(5):
+        ex._jit_put(f"sig{i}", lambda: None)
+    assert ex.jit_cache_size() == 2
+    # Evicted programs left the ledger with the cache.
+    assert _cat(LEDGER, "jit_cache")["count"] == before + 2
+    del ex
+    gc.collect()
+    assert _cat(LEDGER, "jit_cache")["count"] == before
+
+
+def test_fusion_pad_lanes_ledgered_and_released(tmp_holder):
+    """A non-pow2 fused batch registers its pad lanes as padding bytes
+    for the group's lifetime, and releases them when results shape."""
+    from pilosa_tpu.executor import Executor
+    idx = tmp_holder.create_index("mf")
+    f = idx.create_field("f")
+    rng = np.random.default_rng(3)
+    f.import_bits(rng.integers(0, 8, 500).astype(np.uint64),
+                  rng.integers(0, SHARD_WIDTH, 500).astype(np.uint64))
+    ex = Executor(tmp_holder)
+    out = ex.execute_batch(
+        [("mf", f"Count(Row(f={r}))", None) for r in range(3)])
+    assert len(out) == 3 and ex.fused_queries == 3
+    gc.collect()
+    fp = _cat(LEDGER, "fusion_pad")
+    assert fp["count"] == 0 and fp["bytes"] == 0  # group released
+    assert "fusion_pad" in LEDGER.totals()        # but it was ledgered
+    tmp_holder.delete_index("mf")
+
+
+# ------------------------------------------------------------ HTTP surfaces
+
+
+def test_debug_memory_totals_equal_category_sum(live_server):
+    base, api, h = live_server
+    idx = h.create_index("dm")
+    idx.create_field("f").import_bits(
+        np.array([1, 1], np.uint64),
+        np.array([1, SHARD_WIDTH + 2], np.uint64))
+    body = json.dumps({"query": "Count(Row(f=1))"}).encode()
+    urllib.request.urlopen(base + "/index/dm/query", data=body).read()
+    doc = json.loads(urllib.request.urlopen(
+        base + "/debug/memory").read())
+    assert doc["totalBytes"] > 0
+    assert doc["totalBytes"] == sum(
+        c["bytes"] for c in doc["categories"].values())
+    assert doc["paddingBytes"] == sum(
+        c["paddedBytes"] for c in doc["categories"].values())
+    assert doc["top"] and doc["top"][0]["bytes"] > 0
+    # top is byte-ordered and tagged (the ledger is process-global, so
+    # banks from other live holders may legitimately outrank ours).
+    tops = [e["bytes"] for e in doc["top"]]
+    assert tops == sorted(tops, reverse=True)
+    assert all("category" in e for e in doc["top"])
+    # /metrics carries the matching gauges.
+    met = urllib.request.urlopen(base + "/metrics").read().decode()
+    assert 'pilosa_memory_bytes{category="bank"}' in met
+    assert "pilosa_memory_padding_bytes" in met
+
+
+def test_single_node_cluster_health(live_server):
+    base, api, h = live_server
+    doc = json.loads(urllib.request.urlopen(
+        base + "/cluster/health").read())
+    assert doc["totalNodes"] == doc["healthyNodes"] == 1
+    node = doc["nodes"][0]
+    assert node["healthy"] is True
+    assert node["coalescer"]["attached"] is True
+    assert "jitCacheSize" in node["executor"]
+    assert doc["totals"]["memoryBytes"] >= 0
+
+
+# ------------------------------------------------------------------ watchdog
+
+
+def test_watchdog_never_touches_the_device():
+    """The always-on sampler must be fence-free by construction: no jax
+    import, no block_until_ready anywhere in the module (graftlint
+    GL003 enforces the same in CI)."""
+    import inspect
+    import pilosa_tpu.utils.memledger as m
+    src = inspect.getsource(m)
+    assert "import jax" not in src
+    assert "block_until_ready" not in src
+
+
+def test_watchdog_ring_and_extra_gauges():
+    from pilosa_tpu.utils.stats import MemStatsClient, prometheus_text
+    led = MemoryLedger()
+    led.register("bank", "k", 4096, padded_bytes=1024)
+    stats = MemStatsClient()
+    wd = MemoryWatchdog(led, stats=stats, ring=3,
+                        extra_gauges=lambda: {"queueDepth": 7})
+    for _ in range(5):
+        wd.sample_once()
+    snaps = wd.snapshots()
+    assert len(snaps) == 3  # bounded flight recorder
+    assert snaps[-1]["totalBytes"] == 4096
+    assert snaps[-1]["paddingBytes"] == 1024
+    assert snaps[-1]["queueDepth"] == 7
+    assert wd.samples_taken == 5
+    out = prometheus_text(stats)
+    assert 'pilosa_memory_bytes{category="bank"} 4096' in out
+    assert 'pilosa_memory_padding_bytes{category="bank"} 1024' in out
+
+
+def test_watchdog_watermark_warns_once_with_top_banks():
+    led = MemoryLedger()
+    led.register("bank", "hog", 1 << 20, index="i", field="big")
+    log = _LogStub()
+    wd = MemoryWatchdog(led, logger=log, watermark_bytes=1 << 10)
+    wd.sample_once()
+    wd.sample_once()  # still over: must not re-log every sample
+    warns = [l for l in log.lines if "HBM pressure" in l]
+    assert len(warns) == 1
+    assert "big" in warns[0]  # names the top occupant
+    # Dropping below 90% of the watermark re-arms the warning.
+    led.unregister("bank", "hog")
+    wd.sample_once()
+    led.register("bank", "hog2", 1 << 20)
+    wd.sample_once()
+    assert len([l for l in log.lines if "HBM pressure" in l]) == 2
+
+
+def test_watchdog_thread_lifecycle():
+    led = MemoryLedger()
+    wd = MemoryWatchdog(led, sample_every_s=0.05)
+    wd.start()
+    deadline = time.time() + 5
+    while wd.samples_taken == 0 and time.time() < deadline:
+        time.sleep(0.02)
+    assert wd.samples_taken >= 1
+    assert wd.running
+    wd.stop()
+    assert not wd.running
+    # Restartable: start() after stop() must sample again, not spawn
+    # a thread that sees the stale stop event and exits immediately.
+    n = wd.samples_taken
+    wd.start()
+    deadline = time.time() + 5
+    while wd.samples_taken == n and time.time() < deadline:
+        time.sleep(0.02)
+    assert wd.samples_taken > n
+    wd.stop()
+
+
+def test_watchdog_dump_writes_ring_to_log():
+    led = MemoryLedger()
+    led.register("bank", "k", 123)
+    log = _LogStub()
+    wd = MemoryWatchdog(led, logger=log, ring=4)
+    wd.sample_once()
+    wd.sample_once()
+    n = wd.dump(log, last=10)
+    assert n == 2
+    assert any("dumping last 2" in l for l in log.lines)
+    assert any("'totalBytes': 123" in l for l in log.lines)
+
+
+# -------------------------------------------------------------- SIGTERM drain
+
+
+def test_drain_telemetry_simulated(tmp_holder):
+    """The SIGTERM drain path: watchdog stops and dumps its ring, the
+    profiler dumps its slow-query ring, and the tracer's stop() (the
+    final exporter flush) runs — no buffered telemetry is dropped."""
+    from pilosa_tpu.cli.main import drain_telemetry
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.utils.stats import MemStatsClient
+
+    class _TracerStub:
+        stopped = False
+
+        def stop(self):
+            self.stopped = True
+
+    api = API(tmp_holder, stats=MemStatsClient())
+    api.tracer = _TracerStub()
+    api.profiler.record_slow("i", "Count(Row(f=1))", 2.5)
+    log = _LogStub()
+    wd = MemoryWatchdog(MemoryLedger(), logger=log,
+                        sample_every_s=0.05)
+    wd.start()
+    wd.sample_once()
+    drain_telemetry(api, watchdog=wd, logger=log)
+    assert not wd.running
+    assert any("memory watchdog: dumping" in l for l in log.lines)
+    assert any("slow-query record" in l for l in log.lines)
+    assert any("Count(Row(f=1))" in l for l in log.lines)
+    assert api.tracer.stopped
+
+
+def test_drain_telemetry_without_watchdog(tmp_holder):
+    """Embedded servers may run ledger-only: drain degrades cleanly."""
+    from pilosa_tpu.cli.main import drain_telemetry
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.utils.stats import MemStatsClient
+    api = API(tmp_holder, stats=MemStatsClient())
+    drain_telemetry(api, watchdog=None, logger=_LogStub())

@@ -23,15 +23,56 @@ def test_prom_label_escaping():
 
 
 def test_prom_size_metrics_have_no_seconds_suffix():
-    """The timings store holds any distribution (histogram aliases to
-    timing): a `*_size` name is unitless and must not claim seconds."""
+    """Unitless distributions must not claim seconds: histograms
+    (batch/group sizes) export bare _bucket/_sum/_count names, and a
+    `*_size` timing stays suffix-free too."""
     stats = MemStatsClient()
     stats.histogram("coalescer.batch_size", 4)
+    stats.timing("queue.wait_size", 3)
     stats.timing("coalescer.request", 0.25)
     out = prometheus_text(stats)
-    assert "pilosa_coalescer_batch_size{" in out
+    assert 'pilosa_coalescer_batch_size_bucket{le="4"} 1' in out
     assert "pilosa_coalescer_batch_size_seconds" not in out
+    assert "pilosa_queue_wait_size{" in out
+    assert "pilosa_queue_wait_size_seconds" not in out
     assert "pilosa_coalescer_request_seconds{" in out
+
+
+def test_prom_histogram_bucket_invariants():
+    """fusion_group_size is a REAL cumulative histogram: fixed pow2
+    buckets 1,2,4,...,64,+Inf; _bucket counts monotone non-decreasing;
+    le="+Inf" == _count; _sum is the observation total."""
+    stats = MemStatsClient()
+    for v in (1, 1, 2, 3, 5, 64, 200):
+        stats.histogram("executor.fusion_group_size", v)
+    snap = stats.snapshot()["histograms"]["executor.fusion_group_size"]
+    assert list(snap["buckets"]) == ["1", "2", "4", "8", "16", "32",
+                                     "64", "+Inf"]
+    # Cumulative counts: 2 at le=1, +1 at le=2, +1 at le=4 (v=3),
+    # +1 at le=8 (v=5), +1 at le=64, +1 only past every bound (v=200).
+    assert snap["buckets"] == {"1": 2, "2": 3, "4": 4, "8": 5,
+                               "16": 5, "32": 5, "64": 6, "+Inf": 7}
+    cum = list(snap["buckets"].values())
+    assert cum == sorted(cum)  # monotone non-decreasing
+    assert snap["count"] == snap["buckets"]["+Inf"] == 7
+    assert snap["sum"] == 1 + 1 + 2 + 3 + 5 + 64 + 200
+
+    out = prometheus_text(stats)
+    assert "# TYPE pilosa_executor_fusion_group_size histogram" in out
+    assert 'pilosa_executor_fusion_group_size_bucket{le="+Inf"} 7' in out
+    assert "pilosa_executor_fusion_group_size_count 7" in out
+    assert "pilosa_executor_fusion_group_size_sum 276" in out
+
+
+def test_prom_histogram_labels_ride_buckets():
+    """A tagged histogram keeps its labels beside le= on every bucket
+    line (tags must not fold into the metric name)."""
+    stats = MemStatsClient()
+    stats.with_tags("index:i1").histogram("executor.fusion_group_size", 2)
+    out = prometheus_text(stats)
+    assert ('pilosa_executor_fusion_group_size_bucket'
+            '{index="i1",le="2"} 1') in out
+    assert 'pilosa_executor_fusion_group_size_count{index="i1"} 1' in out
 
 
 def test_prom_one_type_line_per_metric():

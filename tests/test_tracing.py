@@ -112,6 +112,77 @@ def test_failed_spans_still_export(capture_server):
     assert names == ["boom"]
 
 
+def test_inject_emits_w3c_traceparent():
+    """inject speaks traceparent (00-<trace>-<span>-01): the root
+    span's trace id, the innermost open span as parent."""
+    from pilosa_tpu.utils.tracing import parse_traceparent
+    tr = RecordingTracer()
+    headers = {}
+    with tr.span("root") as root:
+        with tr.span("child") as child:
+            tr.inject(headers)
+    tp = headers["traceparent"]
+    ver, tid, sid, flags = tp.split("-")
+    assert (ver, flags) == ("00", "01")
+    assert tid == root.trace_id and len(tid) == 32
+    assert sid == child.span_id and len(sid) == 16
+    assert parse_traceparent(tp) == root.trace_id
+    # The legacy header rides along (same id) for the one-release
+    # window, so a not-yet-upgraded receiver still correlates.
+    assert headers["X-Trace-Id"] == root.trace_id
+
+
+def test_extract_traceparent_round_trip():
+    """A trace id injected by one tracer is adopted by another through
+    the traceparent header — the same id stamps both sides' spans."""
+    a, b = RecordingTracer(), RecordingTracer()
+    headers = {}
+    with a.span("client"):
+        a.inject(headers)
+    b.extract(headers)
+    with b.span("server"):
+        pass
+    assert b.finished[0].trace_id == a.finished[0].trace_id
+
+
+def test_extract_accepts_legacy_header():
+    """X-Trace-Id still extracts (one-release compatibility window for
+    mixed-version clusters)."""
+    tr = RecordingTracer()
+    tid = "ab" * 16
+    tr.extract({"X-Trace-Id": tid})
+    with tr.span("s"):
+        pass
+    assert tr.finished[0].trace_id == tid
+
+
+def test_extract_prefers_traceparent_and_rejects_malformed():
+    from pilosa_tpu.utils.tracing import parse_traceparent
+    # traceparent wins over the legacy header when both are present.
+    tr = RecordingTracer()
+    tp_tid = "cd" * 16
+    tr.extract({"traceparent": f"00-{tp_tid}-{'12' * 8}-01",
+                "X-Trace-Id": "ab" * 16})
+    with tr.span("s"):
+        pass
+    assert tr.finished[0].trace_id == tp_tid
+    # Malformed traceparents parse to None instead of poisoning.
+    for bad in ("junk", "00-short-1212121212121212-01",
+                f"00-{'0' * 32}-{'12' * 8}-01",       # all-zero trace
+                f"ff-{'cd' * 16}-{'12' * 8}-01",      # reserved version
+                f"00-{'zz' * 16}-{'12' * 8}-01",      # non-hex
+                f"00-{'cd' * 16}-{'0' * 16}-01",      # all-zero span
+                f"00-{'cd' * 16}-{'12' * 8}-zz",      # non-hex flags
+                f"00-{'cd' * 16}-{'12' * 8}-01-x"):   # v00 extra field
+        assert parse_traceparent(bad) is None, bad
+    # ... and a malformed traceparent falls back to the legacy header.
+    tr2 = RecordingTracer()
+    tr2.extract({"traceparent": "junk", "X-Trace-Id": "ab" * 16})
+    with tr2.span("s"):
+        pass
+    assert tr2.finished[0].trace_id == "ab" * 16
+
+
 def test_non_hex_trace_header_is_sanitized():
     """Client-settable X-Trace-Id must not poison the OTLP batch: a
     non-hex value re-hashes deterministically to 32 hex chars."""

@@ -114,6 +114,51 @@ with tempfile.TemporaryDirectory() as d:
 print("fusion smoke OK")
 EOF
 
+step "telemetry smoke (live /debug/memory + /cluster/health)"
+JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+import json
+import tempfile
+import urllib.request
+import numpy as np
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+from pilosa_tpu.server import API, serve
+from pilosa_tpu.utils.memledger import LEDGER, MemoryWatchdog
+from pilosa_tpu.utils.stats import MemStatsClient
+
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("tel")
+    cols = np.array([1, 2, SHARD_WIDTH + 3], np.uint64)
+    idx.create_field("f").import_bits(np.full(3, 1, np.uint64), cols)
+    idx.add_existence(cols)
+    api = API(h, stats=MemStatsClient())
+    wd = MemoryWatchdog(LEDGER, stats=api.stats, sample_every_s=60)
+    api.watchdog = wd
+    srv = serve(api, "localhost", 0, background=True)
+    base = f"http://localhost:{srv.server_address[1]}"
+    r = urllib.request.urlopen(base + "/index/tel/query",
+                               data=b"Count(Row(f=1))").read()
+    assert json.loads(r)["results"] == [3], r
+    mem = json.loads(urllib.request.urlopen(base + "/debug/memory").read())
+    assert mem["totalBytes"] > 0, mem
+    assert mem["totalBytes"] == sum(
+        c["bytes"] for c in mem["categories"].values()), mem
+    assert mem["top"] and mem["top"][0]["bytes"] > 0, mem
+    health = json.loads(
+        urllib.request.urlopen(base + "/cluster/health").read())
+    assert health["healthyNodes"] == health["totalNodes"] == 1, health
+    node = health["nodes"][0]
+    assert node["healthy"] is True, health
+    assert node["memory"]["totalBytes"] == mem["totalBytes"], health
+    wd.sample_once()  # the watchdog populates the /metrics gauges
+    met = urllib.request.urlopen(base + "/metrics").read().decode()
+    assert 'pilosa_memory_bytes{category="bank"}' in met
+    assert "pilosa_memory_padding_bytes" in met
+    srv.shutdown(); srv.server_close(); h.close()
+print("telemetry smoke OK")
+EOF
+
 step "lock-order runtime check (PILOSA_TPU_LOCK_CHECK=1)"
 PILOSA_TPU_LOCK_CHECK=1 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_coalescer.py tests/test_concurrency.py \
