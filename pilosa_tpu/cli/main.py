@@ -32,6 +32,12 @@ def drain_telemetry(api, watchdog=None, logger=None) -> None:
     profiler = getattr(api, "profiler", None)
     if profiler is not None:
         profiler.dump(logger)
+    # Workload recorder: log what was hot (fragments, cacheable
+    # signatures, repeat ratio) so post-mortems see the access shape
+    # the process served, not just its cost counters.
+    from pilosa_tpu.utils.hotspots import WORKLOAD
+    if WORKLOAD.enabled:
+        WORKLOAD.dump(logger)
     tracer = getattr(api, "tracer", None)
     if tracer is not None:
         if hasattr(tracer, "stop"):
@@ -147,6 +153,17 @@ def cmd_server(args) -> int:
     # ?profile=true always fences regardless of sample_every).
     api.profiler.configure(sample_every=cfg.profile_sample_every,
                            ring_size=cfg.profile_slow_ring)
+    # Workload analytics plane (utils/hotspots.py): the process-wide
+    # recorder picks up the [workload] config — decay half-life,
+    # rolling repeat window, top-K, LRU bounds, kill switch.
+    from pilosa_tpu.utils.hotspots import WORKLOAD
+    WORKLOAD.configure(enabled=cfg.workload_enabled,
+                       half_life_s=cfg.workload_half_life_s,
+                       window_s=cfg.workload_window_s,
+                       top_k=cfg.workload_top_k,
+                       max_fragments=cfg.workload_max_fragments,
+                       max_rows=cfg.workload_max_rows,
+                       max_signatures=cfg.workload_max_signatures)
     coalescer = None
     if cfg.coalescer_enabled:
         # Cross-request query coalescer: concurrent single-query POSTs

@@ -33,6 +33,7 @@ from pilosa_tpu.ops.bitset import (
 )
 from pilosa_tpu.storage.roaring import Bitmap, CONTAINER_BITS
 from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.utils.hotspots import WORKLOAD
 from pilosa_tpu.utils.logger import default_logger
 from pilosa_tpu.utils.memledger import LEDGER
 
@@ -561,6 +562,11 @@ class Fragment:
         # through here, so the block-checksum cache re-hashes only
         # blocks written since the last pass.
         self._dirty_blocks.add(row_id // HASH_BLOCK_SIZE)
+        # Workload plane: every mutation path funnels through here too,
+        # so this one call records write churn AND the generation bump
+        # caches key on (utils/hotspots.py; host dict work only).
+        WORKLOAD.record_write(self.index, self.field, self.view,
+                              self.shard, generation=self.version)
 
     def rows_changed_since(self, version: int) -> List[int]:
         return [r for r, v in self._row_versions.items() if v > version]

@@ -16,6 +16,7 @@ import numpy as np
 
 from pilosa_tpu.core.fragment import CONTAINER_BITS, Fragment
 from pilosa_tpu.core import cache as cache_mod
+from pilosa_tpu.utils.hotspots import WORKLOAD
 from pilosa_tpu.utils.memledger import LEDGER
 
 VIEW_STANDARD = "standard"
@@ -412,6 +413,16 @@ class View:
                     # ~150 ms/query at 500k rows).
                     BANK_BUDGET.touch(self, cache_key)
                     return cached
+                if cached is not None:
+                    # Write churn just cost a device-bank patch/rebuild
+                    # — record WHICH fragments moved (the shards whose
+                    # version diverged) for the workload plane's churn
+                    # ranking (utils/hotspots.py).
+                    moved = [s for s, v in versions.items()
+                             if cached.versions.get(s) != v]
+                    WORKLOAD.record_invalidation(
+                        self.index, self.field, self.name,
+                        moved or list(shards))
                 row_set = sorted({r for f in frags.values() if f
                                   for r in f.row_ids()})
                 if cached is not None and cached.array.shape[-1] == width:

@@ -52,6 +52,7 @@ from pilosa_tpu.ops.bitset import SHARD_WIDTH, WORDS_PER_SHARD, \
     transfer_nbytes
 from pilosa_tpu.pql import Call, Condition, Query, parse_string_cached
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
+from pilosa_tpu.utils.hotspots import WORKLOAD
 from pilosa_tpu.utils.memledger import LEDGER
 
 _LOG = logging.getLogger("pilosa_tpu.executor")
@@ -353,6 +354,13 @@ class _StagedEval:
     idxs: List[int]        # traced gather slots (host values)
     params: List[int]      # traced u32 scalars (host values)
     lits: Any              # stacked [L, S, W] device literals or None
+    # Workload-recorder identity: the semantic fingerprint a result
+    # cache would key on (sig + row ids + params — row IDS, not bank
+    # slots, so it is stable across bank rebuilds), and the operand
+    # banks' generation (fragment write versions) it was staged
+    # against. None when recording is disabled.
+    fp: Any = None
+    gen: Any = None
 
     def runner(self) -> Callable:
         """The traceable program body: expr + the mode's reduction."""
@@ -1101,11 +1109,32 @@ class Executor:
         sig = (f"{mode}|{''.join(plan.sig_parts)}|W{plan.width}"
                f"|B{[a.shape for a in bank_arrays]}"
                f"|L{None if lits is None else lits.shape}|S{len(shards)}")
+        fp = gen = None
+        if WORKLOAD.enabled:
+            # Workload recording at the staging seam: host dict work
+            # only, no device interaction (GL003-clean like memledger).
+            # The fingerprint uses ROW IDS from slot_refs (bank slots
+            # are append-order-dependent across rebuilds); the
+            # generation is the operand banks' fragment-version map —
+            # together the exact key a generation-keyed result cache
+            # would use (ROADMAP item 3).
+            fp = (sig, tuple((key, row) for _, key, row in
+                             plan.slot_refs), tuple(plan.params))
+            gen = tuple(tuple(sorted(b.versions.items())) for b in banks)
+            WORKLOAD.record_query(fp, gen, index=idx.name, mode=mode,
+                                  n_shards=len(shards), sig=sig)
+            prof = self._profile()
+            for key in plan.bank_keys:
+                WORKLOAD.record_read(idx.name, key[0], key[1], shards,
+                                     rows=plan.rows_for.get(key))
+                if prof is not None:
+                    prof.touch_fragments(idx.name, key[0], key[1],
+                                         shards)
         return _StagedEval(mode=mode, sig=sig, expr=expr,
                            width=plan.width, n_shards=len(shards),
                            bank_arrays=bank_arrays,
                            idxs=list(plan.idxs), params=list(plan.params),
-                           lits=lits)
+                           lits=lits, fp=fp, gen=gen)
 
     def _tree_fn(self, staged: "_StagedEval") -> Tuple[Callable, bool]:
         """Compile phase: the jitted program for a staged eval, from
@@ -1186,9 +1215,18 @@ class Executor:
         t_disp = time.perf_counter()
         out = self._call_program(fn, staged.bank_arrays, idxs, params,
                                  staged.lits)
-        prof.tree_dispatch(node, time.perf_counter() - t_disp)
+        dispatch_s = time.perf_counter() - t_disp
+        prof.tree_dispatch(node, dispatch_s)
+        device_s = 0.0
         if prof.sample_device:
-            prof.tree_device(node, _fence_device(out))
+            device_s = _fence_device(out)
+            prof.tree_device(node, device_s)
+        if staged.fp is not None:
+            # Feed the cache-opportunity estimator: what one eval of
+            # this signature actually cost (dispatch enqueue + fenced
+            # device time when sampled) — the seconds a result-cache
+            # hit would have saved.
+            WORKLOAD.note_eval_seconds(staged.fp, dispatch_s + device_s)
         return out
 
     # -- planning: one host walk resolving banks/slots/params ---------------
@@ -1588,6 +1626,18 @@ class Executor:
             all_rows = [r for r in all_rows if r in wanted]
         if not all_rows:
             return PairsResult([])
+        if WORKLOAD.enabled:
+            # Heatmap the sweep BEFORE the warm-cache shortcut: a
+            # cache-served TopN is still workload (host dict work only).
+            # Small candidate sets (ids=... leaderboard refreshes)
+            # record row identities; full-view sweeps record the
+            # aggregate scan size.
+            WORKLOAD.record_read(idx.name, field_name, VIEW_STANDARD,
+                                 shards, rows=all_rows)
+            prof = self._profile()
+            if prof is not None:
+                prof.touch_fragments(idx.name, field_name,
+                                     VIEW_STANDARD, shards)
 
         # Warm-cache shortcut (reference fragment.top over rankCache,
         # fragment.go:1067, cache.go:136): when every fragment's cache
@@ -2075,6 +2125,12 @@ class Executor:
             out = [r for r in out if r > previous]
         if limit is not None:
             out = out[:limit]
+        if WORKLOAD.enabled:
+            for vname in view_names:
+                if field.view(vname) is not None:
+                    WORKLOAD.record_read(idx.name, field_name, vname,
+                                         shards,
+                                         rows_scanned=len(out))
         return RowIdentifiers(out)
 
     # -------------------------------------------------------------- GroupBy
@@ -2141,6 +2197,11 @@ class Executor:
             child_rows.append((child.arg("_field"), ids))
             if not ids:
                 return []
+        if WORKLOAD.enabled:
+            # Each child's rows feed the [P, R, S, W] expansion sweep.
+            for fname, ids_ in child_rows:
+                WORKLOAD.record_read(idx.name, fname, VIEW_STANDARD,
+                                     shards, rows=ids_)
 
         # Keyed by child INDEX, not field name: GroupBy(Rows(f), Rows(f))
         # is legal, and with subset banks the two children may need

@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from pilosa_tpu.utils.hotspots import WORKLOAD
 from pilosa_tpu.utils.memledger import LEDGER
 
 
@@ -254,11 +255,20 @@ class _FuseGroup:
                 prof.set_fused(B)
             if prof.sample_device:
                 fence_profs.append((prof, node))
+        device_s = 0.0
         if fence_profs:
             from pilosa_tpu.executor.executor import _fence_device
             device_s = _fence_device(self.out)
             for prof, node in fence_profs:
                 prof.tree_device(node, device_s)
+        # Cache-opportunity attribution AFTER the (sampled) fence so
+        # fused evals report the same dispatch + device cost basis as
+        # the unfused path (_run_staged) — one fused dispatch covered
+        # B queries, so each member's eval cost its share.
+        per_eval = (dispatch_s + device_s) / max(1, B)
+        for e in self.entries:
+            if e.fp is not None:
+                WORKLOAD.note_eval_seconds(e.fp, per_eval)
 
 
 class FusionCollector:

@@ -284,6 +284,17 @@ class InternalClient:
         return self._req("GET", f"{uri}/internal/health",
                          timeout=timeout)
 
+    def node_hotspots(self, uri: str, timeout: float = 5.0,
+                      top_k: Optional[int] = None) -> dict:
+        """One node's workload snapshot (GET /debug/hotspots) for the
+        /cluster/hotspots merge — same short-timeout rule as
+        node_health: a wedged node is reported, not waited on. `top_k`
+        forwards the coordinator's ?topk so every member's lists share
+        one bound."""
+        q = f"?topk={int(top_k)}" if top_k is not None else ""
+        return self._req("GET", f"{uri}/debug/hotspots{q}",
+                         timeout=timeout)
+
     def local_shards(self, uri: str) -> Dict[str, List[int]]:
         return self._req("GET", f"{uri}/internal/local-shards")
 

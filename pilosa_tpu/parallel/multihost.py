@@ -35,10 +35,35 @@ SHARDS = N_PROCESSES * DEVICES_PER_PROCESS
 WORDS = 512  # small: the point is the cross-process lowering
 
 
+def cpu_multiprocess_supported() -> bool:
+    """Whether this jax/jaxlib can run cross-process computations on
+    the CPU backend: XLA:CPU only implements multi-process collectives
+    through a CpuCollectives plugin (gloo over TCP), so both the
+    jaxlib hooks and the jax config knob that selects them must exist.
+    The dryrun (and its tier-1 test) runs where this holds and skips
+    precisely where it cannot — older wheels raise
+    "Multiprocess computations aren't implemented on the CPU backend"
+    at dispatch time."""
+    try:
+        import jax
+        from jaxlib import xla_client
+    except Exception:
+        return False
+    return (hasattr(xla_client._xla, "make_gloo_tcp_collectives")
+            and "jax_cpu_collectives_implementation"
+            in getattr(jax.config, "values", {}))
+
+
 def child(process_id: int, coordinator: str) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    # XLA:CPU needs an explicit collectives plugin for cross-process
+    # computations (TPU/GPU backends bring their own); gloo-over-TCP is
+    # the portable one. Without this, dispatch fails with
+    # "Multiprocess computations aren't implemented on the CPU
+    # backend" on every jaxlib that doesn't default it.
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(coordinator_address=coordinator,
                                num_processes=N_PROCESSES,
                                process_id=process_id)

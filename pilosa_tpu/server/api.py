@@ -85,6 +85,13 @@ class API:
         # Batch-scoped executor signals (fusion counters/group sizes)
         # have no per-query profile to ride — feed them straight in.
         self.executor.stats = self.stats
+        # The process-wide workload recorder (utils/hotspots.py)
+        # increments its counters (pilosa_fragment_reads_total, ...)
+        # straight into the stats client at record time so the
+        # exported counters stay true monotone counters. Last-attached
+        # wins, same as the ledger's scrape-time publish target.
+        from pilosa_tpu.utils.hotspots import WORKLOAD
+        WORKLOAD.stats = self.stats
         self.tracer = tracer or NopTracer()
         self.long_query_time = 0.0  # seconds; 0 disables slow-query logs
         # Per-query execution profiler (utils/profile.py): every query
@@ -764,8 +771,10 @@ class API:
         into the stats client. Called by the watchdog every sample and
         by the /metrics handler so a scrape is never staler than one
         request. Pure host-side dict reads — no device interaction."""
+        from pilosa_tpu.utils.hotspots import WORKLOAD
         from pilosa_tpu.utils.memledger import LEDGER
         LEDGER.publish(self.stats)
+        WORKLOAD.publish(self.stats)
         self.stats.gauge("executor.jit_cache_size",
                          self.executor.jit_cache_size())
 
@@ -778,11 +787,28 @@ class API:
         self.refresh_memory_gauges()
         return LEDGER.snapshot(top_k=top_k)
 
+    def debug_hotspots(self, top_k: Optional[int] = None
+                       ) -> Dict[str, Any]:
+        """The GET /debug/hotspots document (utils/hotspots.py):
+        fragment/row/signature heatmaps, write churn, rolling-window
+        repeat ratios, and the cache-opportunity report — signature
+        saved-seconds estimates joined against profiler timings, bank
+        density-vs-access quadrants joined against the memory ledger.
+        Totals are provable from the document: totals.X == tracked.X +
+        evicted.X (pinned by test)."""
+        from pilosa_tpu.utils.hotspots import WORKLOAD
+        from pilosa_tpu.utils.memledger import LEDGER
+        self.refresh_memory_gauges()
+        return WORKLOAD.snapshot(
+            top_k=top_k,
+            bank_entries=LEDGER.entries("bank", "fragment_bank"))
+
     def node_health(self) -> Dict[str, Any]:
         """This node's health document (GET /internal/health): memory
         ledger totals, coalescer queue depth, jit-cache/retrace/fusion
         counters, slow-query count, watchdog state. The coordinator's
         cluster_health() merges one of these per node."""
+        from pilosa_tpu.utils.hotspots import WORKLOAD
         from pilosa_tpu.utils.memledger import LEDGER
         now = _time.time()
         if self.cluster is not None:
@@ -793,6 +819,7 @@ class API:
         mem = LEDGER.snapshot(top_k=3)
         coal = self.coalescer
         wd = self.watchdog
+        workload = WORKLOAD.summary()
         return {
             "id": node_id,
             "uri": uri,
@@ -823,6 +850,10 @@ class API:
             # ring bound) — fleet totals must reflect the actual rate.
             "slowQueries": self.profiler.slow_total,
             "slowRing": self.profiler.ring_count(),
+            # Workload-shape summary (utils/hotspots.py): cumulative
+            # read/write counters + live repeat ratios, so capacity
+            # AND access skew read from one health document.
+            "workload": workload,
             "watchdog": {
                 "running": bool(wd is not None and wd.running),
                 "samples": wd.samples_taken if wd is not None else 0,
@@ -835,7 +866,8 @@ class API:
     def _merge_health_totals(nodes: List[Dict[str, Any]]
                              ) -> Dict[str, Any]:
         tot = {"memoryBytes": 0, "paddingBytes": 0, "queueDepth": 0,
-               "jitCacheSize": 0, "retraces": 0, "slowQueries": 0}
+               "jitCacheSize": 0, "retraces": 0, "slowQueries": 0,
+               "fragmentReads": 0, "fragmentWrites": 0}
         for d in nodes:
             mem = d.get("memory") or {}
             tot["memoryBytes"] += int(mem.get("totalBytes", 0))
@@ -846,6 +878,9 @@ class API:
             tot["jitCacheSize"] += int(ex.get("jitCacheSize", 0))
             tot["retraces"] += int(ex.get("retraces", 0))
             tot["slowQueries"] += int(d.get("slowQueries", 0))
+            wl = d.get("workload") or {}
+            tot["fragmentReads"] += int(wl.get("fragmentReads", 0))
+            tot["fragmentWrites"] += int(wl.get("fragmentWrites", 0))
         return tot
 
     def cluster_health(self) -> Dict[str, Any]:
@@ -923,6 +958,87 @@ class API:
             "nodes": nodes,
             "totals": self._merge_health_totals(responded),
         }
+
+    def cluster_hotspots(self, top_k: Optional[int] = None
+                         ) -> Dict[str, Any]:
+        """The GET /cluster/hotspots document: one debug_hotspots()
+        snapshot per member — local inline, remote fanned out in
+        parallel over the internal client (mirroring cluster_health) —
+        with fleet totals. An unreachable node is REPORTED with its
+        error, never dropped: a missing node's hotspots are exactly
+        the blind spot an operator must see."""
+        import threading as _threading
+        local = self.debug_hotspots(top_k=top_k)
+        if self.cluster is None:
+            nodes = [{"id": self.holder.node_id, "uri": "",
+                      "healthy": True, "hotspots": local}]
+            return {"totalNodes": 1, "respondedNodes": 1,
+                    "nodes": nodes,
+                    "totals": self._merge_hotspot_totals(nodes)}
+        docs: Dict[str, Dict[str, Any]] = {}
+        down = set(getattr(self.cluster, "down_ids", set()))
+
+        def fetch(node):
+            if node.id == self.cluster.local.id:
+                docs[node.id] = {"id": node.id, "uri": node.uri,
+                                 "healthy": True, "hotspots": local}
+                return
+            try:
+                doc = self._client.node_hotspots(node.uri,
+                                                 top_k=top_k)
+                if not isinstance(doc, dict):
+                    raise ValueError(f"bad hotspots body: {doc!r}")
+                docs[node.id] = {"id": node.id, "uri": node.uri,
+                                 "healthy": True, "hotspots": doc}
+            except Exception as e:
+                docs[node.id] = {"id": node.id, "uri": node.uri,
+                                 "healthy": False,
+                                 "error": f"{type(e).__name__}: {e}"}
+
+        members = list(self.cluster.nodes())
+        threads = [_threading.Thread(target=fetch, args=(n,))
+                   for n in members]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        nodes = []
+        for node in members:
+            doc = docs.get(node.id,
+                           {"id": node.id, "uri": node.uri,
+                            "healthy": False, "error": "no response"})
+            doc["down"] = node.id in down
+            if doc["down"]:
+                doc["healthy"] = False
+            nodes.append(doc)
+        return {
+            "totalNodes": len(nodes),
+            "respondedNodes": sum(1 for d in nodes if "hotspots" in d),
+            "nodes": nodes,
+            "totals": self._merge_hotspot_totals(nodes),
+        }
+
+    @staticmethod
+    def _merge_hotspot_totals(nodes: List[Dict[str, Any]]
+                              ) -> Dict[str, Any]:
+        """Fleet-wide workload totals over every node that RESPONDED
+        (same rule as the health totals: a down-marked node that still
+        answers contributes — its reads are real traffic)."""
+        tot = {"fragmentReads": 0, "fragmentWrites": 0, "queries": 0,
+               "windowSeen": 0, "windowRepeats": 0}
+        for d in nodes:
+            hs = d.get("hotspots") or {}
+            t = hs.get("totals") or {}
+            tot["fragmentReads"] += int(t.get("fragmentReads", 0))
+            tot["fragmentWrites"] += int(t.get("fragmentWrites", 0))
+            tot["queries"] += int(t.get("queries", 0))
+            w = hs.get("queriesWindow") or {}
+            tot["windowSeen"] += int(w.get("seen", 0))
+            tot["windowRepeats"] += int(w.get("repeats", 0))
+        tot["queryRepeatRatio"] = (
+            tot["windowRepeats"] / tot["windowSeen"]
+            if tot["windowSeen"] else 0.0)
+        return tot
 
     # ---------------------------------------------------------------- status
 

@@ -58,6 +58,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from pilosa_tpu.server.api import ApiError
+from pilosa_tpu.utils.hotspots import WORKLOAD
 
 # Item lifecycle: PENDING (queued, still ejectable) -> CLAIMED (taken by
 # the dispatcher; result imminent) or EJECTED (deadline passed while
@@ -354,9 +355,33 @@ class QueryCoalescer:
         self.stats.gauge("coalescer.queue_depth", len(self._queue))
         return batch
 
+    def _note_workload(self, batch: List[_Item]) -> None:
+        """Record every read-only request's identity with the workload
+        recorder's rolling window: cross-REQUEST duplicate reads (the
+        ones in-batch dedup cannot see — identical queries arriving in
+        different flushes) feed the cache-opportunity report and the
+        coalescer.window_repeat counter."""
+        if not WORKLOAD.enabled:
+            return
+        from pilosa_tpu.utils.profile import pql_text
+        repeats = 0
+        for item in batch:
+            if item.is_write:
+                continue
+            q = item.query if isinstance(item.query, str) \
+                else pql_text(item.query)
+            key = (item.index, q,
+                   tuple(item.shards) if item.shards is not None
+                   else None)
+            if WORKLOAD.record_request(key):
+                repeats += 1
+        if repeats:
+            self.stats.count("coalescer.window_repeat", repeats)
+
     def _execute(self, batch: List[_Item], reason: str) -> None:
         self.stats.count(f"coalescer.flush.{reason}", 1)
         self.stats.histogram("coalescer.batch_size", len(batch))
+        self._note_workload(batch)
         try:
             with self.tracer.span("Coalescer.flush", n=len(batch),
                                   reason=reason) as span:

@@ -268,6 +268,19 @@ class Handler(BaseHTTPRequestHandler):
                 # live vs padded bytes + the top-K largest resident
                 # banks — "what is occupying HBM right now".
                 self._json(api.debug_memory())
+            elif path == "/debug/hotspots":
+                # Workload analytics plane (utils/hotspots.py): hot
+                # fragments/rows/signatures, write churn, repeat
+                # ratios, and the cache-opportunity report.
+                self._check_args(q, "topk")
+                self._json(api.debug_hotspots(
+                    top_k=int(q["topk"]) if q.get("topk") else None))
+            elif path == "/cluster/hotspots":
+                # Coordinator-merged fleet workload: one hotspots
+                # snapshot per node, unreachable nodes reported.
+                self._check_args(q, "topk")
+                self._json(api.cluster_hotspots(
+                    top_k=int(q["topk"]) if q.get("topk") else None))
             elif path == "/cluster/health":
                 # Coordinator-merged fleet health: per-node memory,
                 # queue depth, jit/retrace/slow-query counters,

@@ -89,6 +89,27 @@ class Config:
     # (PILOSA_TPU_HBM_BUDGET_BYTES): crossing it logs one warning with
     # the top-K largest banks. 0 disables the warning.
     telemetry_hbm_watermark: float = 0.9
+    # Workload analytics plane (utils/hotspots.WorkloadRecorder):
+    # access heatmaps, write churn, cache-opportunity estimation.
+    # Always host-side dict work on the staging path; `enabled = false`
+    # is the kill switch (record calls return before taking any lock).
+    # TOML accepts a [workload] table (enabled / half_life_s /
+    # window_s / top_k / max_fragments / max_rows / max_signatures) or
+    # the flat workload_* spelling; env uses PILOSA_TPU_WORKLOAD_*.
+    workload_enabled: bool = True
+    # EWMA half-life for "recently hot" rates: a fragment idle for one
+    # half-life scores half its previous rate.
+    workload_half_life_s: float = 600.0
+    # Rolling window for cross-request repeat ratios (queries and
+    # coalescer request identities).
+    workload_window_s: float = 300.0
+    # Entries in /debug/hotspots top-K lists.
+    workload_top_k: int = 10
+    # LRU bounds on tracked keys (evicted entries fold their counts
+    # into the snapshot's `evicted` bucket, keeping totals provable).
+    workload_max_fragments: int = 4096
+    workload_max_rows: int = 4096
+    workload_max_signatures: int = 1024
     # Metrics (reference server/config.go Metric.Service/Host: expvar |
     # statsd | none — "mem" is the expvar equivalent)
     metric_service: str = "mem"   # mem | statsd | none
@@ -168,6 +189,14 @@ class Config:
             raise ValueError("profile slow_ring must be >= 1")
         if self.telemetry_sample_every_s < 0:
             raise ValueError("telemetry sample_every_s must be >= 0")
+        if self.workload_half_life_s <= 0 or self.workload_window_s <= 0:
+            raise ValueError(
+                "workload half_life_s/window_s must be > 0")
+        if self.workload_top_k < 1 or self.workload_max_fragments < 1 \
+                or self.workload_max_rows < 1 \
+                or self.workload_max_signatures < 1:
+            raise ValueError(
+                "workload top_k/max_* bounds must be >= 1")
         if self.telemetry_ring < 1:
             raise ValueError("telemetry ring must be >= 1")
         if not 0 <= self.telemetry_hbm_watermark <= 1:

@@ -235,6 +235,17 @@ class MemoryLedger:
                      "paddedBytes": e.padded, **e.meta}
                     for e in entries]
 
+    def entries(self, *categories: str) -> List[Dict[str, Any]]:
+        """Every live entry of the given categories, with bytes/padding
+        and registration meta — the workload plane joins bank entries
+        against access rates for its density-vs-access quadrants."""
+        self._drain_dead()
+        with self._lock:
+            return [{"category": e.category, "bytes": e.nbytes,
+                     "paddedBytes": e.padded, **e.meta}
+                    for e in self._entries.values()
+                    if e.category in categories]
+
     def snapshot(self, top_k: int = TOP_K) -> Dict[str, Any]:
         """The /debug/memory document. `totalBytes` is the exact sum of
         the per-category byte totals (asserted by test); `deviceBytes`

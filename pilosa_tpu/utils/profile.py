@@ -124,6 +124,11 @@ class QueryProfile:
         # Largest same-signature fusion group this query's evals ran
         # in (None = nothing fused; see Executor.execute_batch).
         self.fused_batch: Optional[int] = None
+        # Fragments this query's staged programs read, as (index,
+        # field, view, shard) keys — bounded; the slow-query ring joins
+        # them against the workload recorder so a slow query and the
+        # hot data it touched correlate in one record.
+        self.touched: Dict[tuple, None] = {}
         self._frag_lock = make_lock("QueryProfile._frag_lock")
         self.node_fragments: Dict[str, Any] = {}
 
@@ -200,6 +205,21 @@ class QueryProfile:
             node.attrs["h2dBytes"] = \
                 node.attrs.get("h2dBytes", 0) + h2d_bytes
             self.h2d_bytes += int(h2d_bytes)
+
+    # Touched-fragment keys kept per profile: enough to name every
+    # operand of a realistic tree without letting a 1024-shard sweep
+    # bloat ring records.
+    TOUCHED_CAP = 64
+
+    def touch_fragments(self, index: str, field: str, view: str,
+                        shards) -> None:
+        """Note fragments a staged program read (Executor._stage_tree
+        and the TopN sweep call this; single-writer like the rest of
+        the executor-facing hooks)."""
+        for s in shards:
+            if len(self.touched) >= self.TOUCHED_CAP:
+                return
+            self.touched[(index, field, view, int(s))] = None
 
     def set_fused(self, batch: int) -> None:
         """This query's terminal eval ran inside a fused batch of
@@ -389,6 +409,15 @@ class Profiler:
             if profile.shards is not None:
                 rec["shards"] = profile.shards
             rec["profile"] = profile.to_json()
+            if profile.touched:
+                # Correlate the slow query with the hot data it read:
+                # current workload-recorder standings for the fragments
+                # this query touched (hottest first). Lazy import — the
+                # profiler stays usable standalone.
+                from pilosa_tpu.utils.hotspots import WORKLOAD
+                hot = WORKLOAD.fragment_ranks(list(profile.touched))
+                if hot:
+                    rec["hotFragments"] = hot
         if error is not None:
             rec["error"] = f"{type(error).__name__}: {error}"
         with self._lock:

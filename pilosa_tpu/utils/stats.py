@@ -349,14 +349,25 @@ def prometheus_text(stats) -> str:
             labels.append(f'{clean(lk)}="{lv}"')
         return clean(name), "{" + ",".join(labels) + "}" if labels else ""
 
-    lines = []
-    typed = set()
+    # Samples grouped BY FAMILY, not by raw store key: the exposition
+    # format requires every line of one metric family to form a single
+    # contiguous group under exactly one # TYPE line. Sorting raw keys
+    # alone breaks that whenever another family's name sorts between a
+    # family's untagged and tagged spellings ("fragment.reads" <
+    # "fragment.reads_dedup" < "fragment.reads{index=...}" — '_' <
+    # '{'), which split pilosa_fragment_reads_total into two groups
+    # with the second one TYPE-less. Families render in first-seen
+    # (sorted-key) order; the first-seen type wins, so exactly one
+    # TYPE line per family by construction.
+    families: Dict[str, List[str]] = {}
+    order: List[str] = []
 
     def emit(name: str, typ: str, sample_lines):
-        if name not in typed:  # one TYPE line per metric name
-            typed.add(name)
-            lines.append(f"# TYPE {name} {typ}")
-        lines.extend(sample_lines)
+        group = families.get(name)
+        if group is None:
+            group = families[name] = [f"# TYPE {name} {typ}"]
+            order.append(name)
+        group.extend(sample_lines)
 
     for k, v in sorted(snap.get("counters", {}).items()):
         name, lab = split_key(k)
@@ -395,4 +406,5 @@ def prometheus_text(stats) -> str:
             quantiles.append(f'{n}{{{inner}quantile="0.95"}} {t["p95"]}')
         quantiles.append(f'{n}{{{inner}quantile="0.99"}} {t["p99"]}')
         emit(n, "summary", quantiles + [f"{n}_count{lab} {t['count']}"])
+    lines = [line for name in order for line in families[name]]
     return "\n".join(lines) + ("\n" if lines else "")

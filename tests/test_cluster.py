@@ -1805,3 +1805,57 @@ def test_cluster_health_merges_nodes(tmp_path):
         nodes[2].holder.close()
         for nd in nodes[:2]:
             nd.stop()
+
+
+def test_cluster_hotspots_merge_with_unreachable_node(tmp_path):
+    """/cluster/hotspots mirrors the health plane's fan-out: one
+    workload snapshot per member with fleet totals, and a severed
+    node is REPORTED with its error instead of silently dropped."""
+    from pilosa_tpu.utils.hotspots import WORKLOAD
+
+    nodes = run_cluster(tmp_path, 3)
+    try:
+        WORKLOAD.reset()
+        base = nodes[0].uri
+        req(base, "POST", "/index/hs", {"options": {}})
+        req(base, "POST", "/index/hs/field/f", {"options": {}})
+        cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+        req(base, "POST", "/index/hs/field/f/import",
+            {"rowIDs": [1] * 6, "columnIDs": cols})
+        for _ in range(4):
+            res = req(base, "POST", "/index/hs/query",
+                      b"Count(Row(f=1))")
+            assert res["results"] == [6]
+
+        doc = req(base, "GET", "/cluster/hotspots")
+        assert doc["totalNodes"] == 3
+        assert doc["respondedNodes"] == 3
+        assert {n["id"] for n in doc["nodes"]} == \
+            {nd.uri for nd in nodes}
+        for n in doc["nodes"]:
+            assert n["healthy"] is True and n["down"] is False
+            assert "totals" in n["hotspots"]
+        # Fleet totals aggregate exactly what the nodes reported.
+        assert doc["totals"]["fragmentReads"] == sum(
+            n["hotspots"]["totals"]["fragmentReads"]
+            for n in doc["nodes"])
+        assert doc["totals"]["fragmentReads"] > 0
+
+        # Sever node 2: reported unhealthy with the error, survivors
+        # still merged — never dropped from the document.
+        nodes[2].stop_server_only()
+        nodes[0].api._client.drop_idle()
+        doc = req(base, "GET", "/cluster/hotspots")
+        assert doc["totalNodes"] == 3
+        assert doc["respondedNodes"] == 2
+        dead = [n for n in doc["nodes"] if not n["healthy"]]
+        assert len(dead) == 1 and dead[0]["id"] == nodes[2].uri
+        assert "error" in dead[0] and "hotspots" not in dead[0]
+        assert doc["totals"]["fragmentReads"] == sum(
+            n["hotspots"]["totals"]["fragmentReads"]
+            for n in doc["nodes"] if "hotspots" in n)
+    finally:
+        WORKLOAD.reset()
+        nodes[2].holder.close()
+        for nd in nodes[:2]:
+            nd.stop()

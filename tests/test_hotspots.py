@@ -1,0 +1,439 @@
+"""Workload analytics plane (utils/hotspots.py): EWMA decay math,
+LRU bounding with provable totals, zero-fence recording, the
+cache-opportunity report's synthetic repeat structure, cross-request
+repeat accounting through the coalescer, and the HTTP surfaces."""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+from pilosa_tpu.server.api import API
+from pilosa_tpu.utils.hotspots import (
+    ROW_CAP_PER_CALL, WORKLOAD, WorkloadRecorder, _Window,
+)
+from pilosa_tpu.utils.stats import MemStatsClient, prometheus_text
+
+
+@pytest.fixture(autouse=True)
+def _reset_workload():
+    """The recorder is process-wide (like memledger's LEDGER): every
+    test starts from a clean slate and leaves defaults behind."""
+    WORKLOAD.reset()
+    WORKLOAD.configure(enabled=True, half_life_s=600.0, window_s=300.0,
+                       top_k=10, max_fragments=4096, max_rows=4096,
+                       max_signatures=1024)
+    WORKLOAD.stats = None
+    yield
+    WORKLOAD.reset()
+    WORKLOAD.stats = None
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _seed(holder, fields=("f", "g")):
+    idx = holder.create_index("ws")
+    cols = np.array([1, 2, SHARD_WIDTH + 3], np.uint64)
+    for name in fields:
+        idx.create_field(name).import_bits(
+            np.full(3, 1, np.uint64), cols)
+    idx.add_existence(cols)
+    return idx
+
+
+# ------------------------------------------------------------- decay math
+
+
+def test_ewma_half_life_under_injected_clock():
+    """The decayed rate halves per half-life of inactivity while the
+    cumulative count never decays."""
+    clock = FakeClock()
+    rec = WorkloadRecorder(half_life_s=10.0, clock=clock)
+    for _ in range(100):
+        rec.record_read("i", "f", "standard", [0])
+    snap = rec.snapshot()
+    ent = snap["fragments"][0]
+    assert ent["reads"] == 100
+    assert ent["readRate"] == pytest.approx(100.0, rel=1e-6)
+    clock.advance(10.0)  # one half-life
+    ent = rec.snapshot()["fragments"][0]
+    assert ent["reads"] == 100  # cumulative: no decay
+    assert ent["readRate"] == pytest.approx(50.0, rel=1e-6)
+    clock.advance(20.0)  # two more half-lives
+    ent = rec.snapshot()["fragments"][0]
+    assert ent["readRate"] == pytest.approx(12.5, rel=1e-6)
+    # New activity adds on top of the decayed value, not the raw one.
+    rec.record_read("i", "f", "standard", [0])
+    ent = rec.snapshot()["fragments"][0]
+    assert ent["readRate"] == pytest.approx(13.5, rel=1e-6)
+    assert ent["reads"] == 101
+
+
+def test_window_prunes_by_age_and_caps_events():
+    clock = FakeClock()
+    w = _Window(window_s=30.0, max_events=4)
+    assert w.add("a", clock()) is False
+    assert w.add("a", clock()) is True  # live repeat
+    clock.advance(31.0)
+    assert w.add("a", clock()) is False  # pruned by age: fresh again
+    # Event cap: only the newest max_events stay live.
+    for k in ("b", "c", "d", "e"):
+        w.add(k, clock())
+    snap = w.snapshot(clock())
+    assert snap["seen"] == 4  # "a" fell off the cap
+    assert snap["seenTotal"] == 7
+    assert snap["repeatsTotal"] == 1
+
+
+# ---------------------------------------------------------- LRU + totals
+
+
+def test_fragment_lru_bound_and_provable_totals():
+    """Fragment keys are LRU-bounded; evicted entries fold their
+    counts into `evicted`, so totals.X == tracked.X + evicted.X holds
+    at every moment."""
+    rec = WorkloadRecorder(max_fragments=8, clock=FakeClock())
+    for s in range(32):
+        rec.record_read("i", "f", "standard", [s])
+        rec.record_write("i", "f", "standard", s, generation=s)
+    snap = rec.snapshot(top_k=100)
+    assert len(snap["fragments"]) == 8  # bounded
+    assert snap["totals"]["fragmentReads"] == 32
+    assert snap["totals"]["fragmentWrites"] == 32
+    assert snap["totals"]["fragmentReads"] == \
+        snap["tracked"]["fragmentReads"] + \
+        snap["evicted"]["fragmentReads"]
+    assert snap["totals"]["fragmentWrites"] == \
+        snap["tracked"]["fragmentWrites"] + \
+        snap["evicted"]["fragmentWrites"]
+    assert snap["evicted"]["fragmentReads"] == 24
+    # LRU, not FIFO: touching an old key keeps it resident.
+    rec2 = WorkloadRecorder(max_fragments=4, clock=FakeClock())
+    for s in range(4):
+        rec2.record_read("i", "f", "standard", [s])
+    rec2.record_read("i", "f", "standard", [0])  # touch shard 0
+    rec2.record_read("i", "f", "standard", [99])  # evicts shard 1
+    shards = {f["shard"] for f in rec2.snapshot(top_k=100)["fragments"]}
+    assert 0 in shards and 1 not in shards
+
+
+def test_row_and_signature_lru_bounds():
+    rec = WorkloadRecorder(max_rows=4, max_signatures=4,
+                           clock=FakeClock())
+    rec.record_read("i", "f", "standard", [0], rows=range(16))
+    snap = rec.snapshot(top_k=100)
+    assert len(snap["rows"]) == 4
+    assert snap["totals"]["rowTouches"] == 16
+    assert snap["totals"]["rowTouches"] == \
+        snap["tracked"]["rowTouches"] + snap["evicted"]["rowTouches"]
+    for i in range(9):
+        rec.record_query(("sig", i), ("g",), index="i", mode="count",
+                         n_shards=1)
+    snap = rec.snapshot(top_k=100)
+    assert len(snap["signatures"]) == 4
+    assert snap["totals"]["queries"] == 9
+    assert snap["totals"]["queries"] == \
+        snap["tracked"]["queries"] + snap["evicted"]["queries"]
+
+
+def test_row_cap_per_call_records_scan_aggregate():
+    """A sweep naming more rows than ROW_CAP_PER_CALL records the cap
+    as identities and the remainder as rowsScanned — full-bank TopN
+    scans must not flood the row map."""
+    rec = WorkloadRecorder(clock=FakeClock())
+    rec.record_read("i", "f", "standard", [0],
+                    rows=range(ROW_CAP_PER_CALL + 100))
+    snap = rec.snapshot(top_k=1000)
+    assert len(snap["rows"]) == ROW_CAP_PER_CALL
+    assert snap["totals"]["rowsScanned"] == 100
+
+
+def test_kill_switch_skips_all_recording():
+    rec = WorkloadRecorder(clock=FakeClock())
+    rec.enabled = False
+    rec.record_read("i", "f", "standard", [0], rows=[1])
+    rec.record_write("i", "f", "standard", 0)
+    rec.record_query("fp", "g", index="i", mode="count", n_shards=1)
+    assert rec.record_request("k") is False
+    snap = rec.snapshot()
+    assert snap["totals"]["fragmentReads"] == 0
+    assert snap["totals"]["fragmentWrites"] == 0
+    assert snap["totals"]["queries"] == 0
+    assert snap["queriesWindow"]["seen"] == 0
+
+
+# ------------------------------------------------- executor wiring (reads)
+
+
+def test_zero_fences_on_recording_path(tmp_holder, monkeypatch):
+    """Acceptance: workload recording adds NO block_until_ready fences
+    — the unprofiled hot path stays fully async with the recorder on
+    (the GL003-by-construction claim, pinned like PR 3's test)."""
+    import pilosa_tpu.executor.executor as ex
+
+    _seed(tmp_holder)
+    api = API(tmp_holder, stats=MemStatsClient())
+    fences = []
+    monkeypatch.setattr(ex, "_fence_device",
+                        lambda out: fences.append(1) or 0.0)
+    for i in range(8):
+        api.query("ws", f"Count(Row(f={i % 2}))")
+    assert fences == []
+    # ...and it actually recorded while staying fence-free.
+    assert WORKLOAD.summary()["fragmentReads"] > 0
+    assert WORKLOAD.summary()["queries"] == 8
+
+
+def test_reads_writes_and_generation_recorded(tmp_holder):
+    _seed(tmp_holder)
+    api = API(tmp_holder, stats=MemStatsClient())
+    WORKLOAD.reset()  # drop the import-time writes; isolate the query
+    api.query("ws", "Count(Row(f=1))")
+    snap = api.debug_hotspots()
+    frags = {(f["field"], f["shard"]): f for f in snap["fragments"]}
+    assert frags[("f", 0)]["reads"] == 1
+    assert frags[("f", 1)]["reads"] == 1
+    # Row 1 of field f was the named row.
+    assert snap["rows"][0]["row"] == 1
+    assert snap["rows"][0]["field"] == "f"
+    # A write records churn + the generation caches key on.
+    api.query("ws", "Set(5, f=1)")
+    snap = api.debug_hotspots()
+    f0 = next(f for f in snap["fragments"]
+              if f["field"] == "f" and f["shard"] == 0
+              and f["writes"] > 0)
+    frag = tmp_holder.index("ws").field("f").view().fragment(0)
+    assert f0["generation"] == frag.version
+    # The next read of f finds the cached bank stale: churn cost a
+    # device-bank patch, recorded as an invalidation.
+    api.query("ws", "Count(Row(f=1))")
+    snap = api.debug_hotspots()
+    f0 = next(f for f in snap["fragments"]
+              if f["field"] == "f" and f["shard"] == 0)
+    assert f0["bankInvalidations"] >= 1
+
+
+def test_topn_and_groupby_record_reads(tmp_holder):
+    _seed(tmp_holder)
+    api = API(tmp_holder, stats=MemStatsClient())
+    WORKLOAD.reset()
+    api.query("ws", "TopN(f, n=2)")
+    snap = api.debug_hotspots()
+    assert any(f["field"] == "f" and f["reads"] > 0
+               for f in snap["fragments"])
+    assert any(r["field"] == "f" and r["row"] == 1
+               for r in snap["rows"])
+    WORKLOAD.reset()
+    api.query("ws", "GroupBy(Rows(f), Rows(g))")
+    snap = api.debug_hotspots()
+    touched = {f["field"] for f in snap["fragments"] if f["reads"] > 0}
+    assert {"f", "g"} <= touched
+
+
+# --------------------------------------------- cache-opportunity report
+
+
+def test_synthetic_repeat_structure_and_saved_seconds(tmp_holder):
+    """Acceptance: 64 requests of 4 distinct signatures -> repeat
+    ratio == 15/16 and the 4 signatures ranked with profiler-derived
+    saved-seconds attached."""
+    _seed(tmp_holder)
+    api = API(tmp_holder, stats=MemStatsClient())
+    WORKLOAD.reset()
+    for i in range(64):
+        api.query("ws", f"Count(Row(f={i % 4}))")
+    snap = api.debug_hotspots()
+    win = snap["queriesWindow"]
+    assert win["seen"] == 64
+    assert win["repeats"] == 60
+    assert win["ratio"] == pytest.approx(15 / 16)
+    sigs = snap["signatures"]
+    assert len(sigs) == 4
+    for s in sigs:
+        assert s["hits"] == 16
+        assert s["genHits"] == 16  # no writes: generation never moved
+        assert s["avgEvalS"] is not None and s["avgEvalS"] > 0
+        # 15 cacheable repeats x the observed per-eval seconds.
+        assert s["estSavedS"] == pytest.approx(15 * s["avgEvalS"])
+    opp = snap["opportunity"]["signatures"]
+    assert len(opp) == 4
+    assert opp == sorted(opp, key=lambda s: -s["estSavedS"])
+    total = snap["opportunity"]["totalEstSavedS"]
+    assert total == pytest.approx(sum(s["estSavedS"] for s in opp))
+    # totalEstSavedS covers EVERY cacheable signature — the cache
+    # sizing number must not change with the requested list bound.
+    narrow = api.debug_hotspots(top_k=1)["opportunity"]
+    assert len(narrow["signatures"]) == 1
+    assert narrow["totalEstSavedS"] == pytest.approx(total)
+    # Fingerprints are stable digests (16 hex chars), identical
+    # across snapshots — NOT process-salted hash() values.
+    fps = sorted(s["fingerprint"] for s in sigs)
+    assert all(len(f) == 16 and int(f, 16) >= 0 for f in fps)
+    fps2 = sorted(s["fingerprint"]
+                  for s in api.debug_hotspots()["signatures"])
+    assert fps == fps2
+
+
+def test_generation_bump_resets_cacheable_run(tmp_holder):
+    """A write between repeats moves the operand generation: the
+    signature's cacheable run restarts (a result cache would have
+    been invalidated exactly there)."""
+    _seed(tmp_holder)
+    api = API(tmp_holder, stats=MemStatsClient())
+    WORKLOAD.reset()
+    for _ in range(4):
+        api.query("ws", "Count(Row(f=1))")
+    api.query("ws", "Set(7, f=1)")
+    api.query("ws", "Count(Row(f=1))")
+    snap = api.debug_hotspots()
+    sig = next(s for s in snap["signatures"] if s["mode"] == "count"
+               and s["hits"] >= 5)
+    assert sig["hits"] == 5
+    assert sig["genHits"] == 1  # run reset by the generation bump
+
+
+def test_bank_quadrants_join_ledger_and_access(tmp_holder):
+    _seed(tmp_holder)
+    api = API(tmp_holder, stats=MemStatsClient())
+    WORKLOAD.reset()
+    api.query("ws", "Count(Row(f=1))")
+    banks = api.debug_hotspots()["opportunity"]["banks"]
+    assert banks, "resident banks must appear in the quadrant report"
+    by_field = {b["field"]: b for b in banks if b["index"] == "ws"}
+    assert by_field["f"]["quadrant"].endswith("-hot")
+    assert by_field["f"]["readRate"] > 0
+    for b in banks:
+        assert 0.0 <= b["density"] <= 1.0
+        assert b["quadrant"] in ("dense-hot", "dense-cold",
+                                 "sparse-hot", "sparse-cold")
+        assert b["demotionScore"] >= 0.0
+    # Demotion ranking: sparse-cold outranks dense-hot.
+    scores = [b["demotionScore"] for b in banks]
+    assert scores == sorted(scores, reverse=True)
+
+
+# -------------------------------------------------- coalescer + surfaces
+
+
+def test_cross_request_repeats_through_coalescer(live_server):
+    """Identical queries arriving in DIFFERENT flushes are invisible
+    to in-batch dedup; the recorder's rolling window still counts
+    them as cross-request repeats."""
+    base, api, h = live_server
+    _seed(h)
+    WORKLOAD.reset()
+
+    def post(q):
+        return urllib.request.urlopen(
+            base + "/index/ws/query", data=q.encode()).read()
+
+    # Sequential requests: each lands in its own flush (no batchmates),
+    # so any repeat counted is cross-request by construction.
+    for _ in range(6):
+        post("Count(Row(f=1))")
+    win = WORKLOAD.requests_window.snapshot(WORKLOAD.clock())
+    assert win["seen"] == 6
+    assert win["repeats"] == 5
+    # Concurrent burst of two identities keeps accounting consistent.
+    threads = [threading.Thread(
+        target=post, args=(f"Count(Row(f={i % 2}))",))
+        for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    win = WORKLOAD.requests_window.snapshot(WORKLOAD.clock())
+    assert win["seen"] == 14
+    # f=1 was already live (4 burst arrivals all repeat); f=0 is a
+    # fresh identity (first arrival unique, 3 repeats): 5 + 4 + 3.
+    assert win["repeats"] == 12
+
+
+def test_debug_hotspots_http_surface_and_metrics(live_server):
+    base, api, h = live_server
+    _seed(h)
+    WORKLOAD.reset()
+    WORKLOAD.stats = api.stats
+    for i in range(8):
+        urllib.request.urlopen(base + "/index/ws/query",
+                               data=f"Count(Row(f={i % 2}))".encode()
+                               ).read()
+    doc = json.loads(urllib.request.urlopen(
+        base + "/debug/hotspots").read())
+    assert doc["enabled"] is True
+    assert doc["totals"]["fragmentReads"] > 0
+    assert doc["totals"]["fragmentReads"] == \
+        doc["tracked"]["fragmentReads"] + \
+        doc["evicted"]["fragmentReads"]
+    assert doc["fragments"] and doc["signatures"]
+    # ?topk bounds the lists.
+    doc1 = json.loads(urllib.request.urlopen(
+        base + "/debug/hotspots?topk=1").read())
+    assert len(doc1["fragments"]) == 1
+    # Counter families + the repeat-ratio gauge on /metrics.
+    met = urllib.request.urlopen(base + "/metrics").read().decode()
+    assert "# TYPE pilosa_fragment_reads_total counter" in met
+    assert "pilosa_fragment_reads_total" in met
+    assert "# TYPE pilosa_query_repeat_ratio gauge" in met
+    # Write churn counter appears once a write lands.
+    urllib.request.urlopen(base + "/index/ws/query",
+                           data=b"Set(9, f=1)").read()
+    met = urllib.request.urlopen(base + "/metrics").read().decode()
+    assert "pilosa_fragment_writes_total" in met
+    # Single-node /cluster/hotspots serves the same totals.
+    ch = json.loads(urllib.request.urlopen(
+        base + "/cluster/hotspots").read())
+    assert ch["totalNodes"] == ch["respondedNodes"] == 1
+    assert ch["totals"]["fragmentReads"] == \
+        json.loads(urllib.request.urlopen(
+            base + "/debug/hotspots").read())["totals"]["fragmentReads"]
+
+
+def test_slow_ring_hot_fragments_annotation(tmp_holder):
+    """Slow-query ring records carry hotFragments: the recorder's
+    current standings for exactly the fragments that query touched."""
+    _seed(tmp_holder)
+    api = API(tmp_holder, stats=MemStatsClient())
+    api.long_query_time = 1e-9  # everything is "slow"
+    WORKLOAD.reset()
+    for _ in range(3):
+        api.query("ws", "Count(Row(f=1))")
+    recs = api.profiler.slow_queries()
+    assert recs and "hotFragments" in recs[0]
+    hot = recs[0]["hotFragments"]
+    assert hot[0]["index"] == "ws" and hot[0]["field"] == "f"
+    assert hot[0]["reads"] >= 1
+    assert all(h["field"] == "f" for h in hot)  # only touched frags
+
+
+def test_health_stanza_and_publish(tmp_holder):
+    _seed(tmp_holder)
+    api = API(tmp_holder, stats=MemStatsClient())
+    WORKLOAD.reset()
+    api.query("ws", "Count(Row(f=1))")
+    doc = api.node_health()
+    wl = doc["workload"]
+    assert wl["enabled"] is True
+    assert wl["fragmentReads"] == 2  # two shards
+    assert wl["queries"] == 1
+    assert wl["trackedSignatures"] == 1
+    # Fleet totals pick the workload counters up.
+    ch = api.cluster_health()
+    assert ch["totals"]["fragmentReads"] == 2
+    # publish() exports the scrape-time gauges.
+    api.refresh_memory_gauges()
+    out = prometheus_text(api.stats)
+    assert "pilosa_query_repeat_ratio" in out
+    assert "pilosa_workload_tracked_fragments" in out

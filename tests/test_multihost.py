@@ -9,6 +9,8 @@ import sys
 
 import pytest
 
+from pilosa_tpu.parallel.multihost import cpu_multiprocess_supported
+
 
 def test_timeout_mark_is_enforced():
     """The vendored SIGALRM timeout (conftest.alarm_timeout) actually
@@ -31,6 +33,11 @@ def test_timeout_mark_is_enforced():
 
 
 @pytest.mark.timeout(360)
+@pytest.mark.skipif(
+    not cpu_multiprocess_supported(),
+    reason="XLA:CPU lacks a cross-process collectives plugin (no gloo "
+           "hooks in jaxlib / no jax_cpu_collectives_implementation "
+           "knob) — multiprocess CPU computations cannot run here")
 def test_two_process_jax_distributed_dryrun():
     env = dict(os.environ)
     # The parent re-spawns children with its own platform/device flags;

@@ -98,6 +98,57 @@ def test_prom_one_type_line_per_metric():
     assert len(q_samples) == 3
 
 
+def test_prom_families_stay_contiguous_under_name_interleave():
+    """The exposition format requires every family's samples to form
+    ONE contiguous group under exactly one # TYPE line. Raw-key
+    sorting breaks that whenever another family name sorts between a
+    family's untagged and tagged spellings ('fragment.reads' <
+    'fragment.reads_dedup' < 'fragment.reads{index=...}' since
+    '_' < '{') — the second group then rode TYPE-less behind a
+    different family. Families must group by name, not by raw key."""
+    stats = MemStatsClient()
+    stats.count("fragment.reads", 7)
+    stats.count("fragment.reads_dedup", 1)  # sorts BETWEEN the two
+    stats.with_tags("index:i1").count("fragment.reads", 3)
+    out = prometheus_text(stats)
+    lines = out.splitlines()
+    fam = [i for i, l in enumerate(lines)
+           if l.startswith("pilosa_fragment_reads_total")
+           or l == "# TYPE pilosa_fragment_reads_total counter"]
+    # TYPE + both samples, contiguous.
+    assert len(fam) == 3
+    assert fam == list(range(fam[0], fam[0] + 3))
+    assert lines[fam[0]] == "# TYPE pilosa_fragment_reads_total counter"
+    type_lines = [l.split()[2] for l in lines
+                  if l.startswith("# TYPE ")]
+    assert type_lines.count("pilosa_fragment_reads_total") == 1
+
+
+def test_prom_new_workload_counter_families():
+    """The workload-plane counter families export with one TYPE line
+    each and proper label escaping (the invariants of this module
+    extended to pilosa_fragment_{reads,writes}_total and
+    pilosa_query_repeat_ratio)."""
+    stats = MemStatsClient()
+    stats.count("fragment.reads", 5)
+    stats.with_tags('index:a"b').count("fragment.reads", 2)
+    stats.count("fragment.writes", 4)
+    stats.gauge("query.repeat_ratio", 0.9375)
+    out = prometheus_text(stats)
+    lines = out.splitlines()
+    for fam, typ in (("pilosa_fragment_reads_total", "counter"),
+                     ("pilosa_fragment_writes_total", "counter"),
+                     ("pilosa_query_repeat_ratio", "gauge")):
+        types = [l for l in lines if l == f"# TYPE {fam} {typ}"]
+        assert len(types) == 1, (fam, out)
+        # Samples directly follow their single TYPE line.
+        i = lines.index(types[0])
+        assert lines[i + 1].startswith(fam), (fam, lines[i:i + 2])
+    assert "pilosa_fragment_reads_total 5" in out
+    assert 'pilosa_fragment_reads_total{index="a\\"b"} 2' in out
+    assert "pilosa_query_repeat_ratio 0.9375" in out
+
+
 def test_prom_tagged_names_stay_bounded():
     """Tags become labels, never part of the metric name (cardinality
     control)."""

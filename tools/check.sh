@@ -159,6 +159,59 @@ with tempfile.TemporaryDirectory() as d:
 print("telemetry smoke OK")
 EOF
 
+step "hotspots smoke (repeated-query burst -> /debug/hotspots)"
+JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+import json
+import tempfile
+import urllib.request
+import numpy as np
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+from pilosa_tpu.server import API, serve
+from pilosa_tpu.server.coalescer import QueryCoalescer
+from pilosa_tpu.utils.hotspots import WORKLOAD
+from pilosa_tpu.utils.stats import MemStatsClient
+
+WORKLOAD.reset()
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("hot")
+    cols = np.array([1, 2, SHARD_WIDTH + 3], np.uint64)
+    idx.create_field("f").import_bits(np.full(3, 1, np.uint64), cols)
+    idx.add_existence(cols)
+    api = API(h, stats=MemStatsClient())
+    api.coalescer = QueryCoalescer(api.executor, window_s=0.0005,
+                                   stats=api.stats, tracer=api.tracer)
+    api.coalescer.start()
+    srv = serve(api, "localhost", 0, background=True)
+    base = f"http://localhost:{srv.server_address[1]}"
+    # Burst of repeated queries: 32 requests over 4 distinct reads.
+    for i in range(32):
+        r = urllib.request.urlopen(
+            base + "/index/hot/query",
+            data=f"Count(Row(f={i % 4}))".encode()).read()
+        assert json.loads(r)["results"] == [3 if i % 4 == 1 else 0], r
+    doc = json.loads(urllib.request.urlopen(
+        base + "/debug/hotspots").read())
+    # Nonzero cross-request repeat ratio: 32 arrivals, 4 identities.
+    assert doc["queriesWindow"]["ratio"] > 0.8, doc["queriesWindow"]
+    assert doc["requestsWindow"]["ratio"] > 0.8, doc["requestsWindow"]
+    # Provable totals: totals == tracked + evicted ...
+    assert doc["totals"]["fragmentReads"] == \
+        doc["tracked"]["fragmentReads"] + \
+        doc["evicted"]["fragmentReads"], doc["totals"]
+    # ... and consistent with the exported counter family.
+    met = urllib.request.urlopen(base + "/metrics").read().decode()
+    line = next(l for l in met.splitlines()
+                if l.startswith("pilosa_fragment_reads_total"))
+    assert int(line.rsplit(" ", 1)[1]) == \
+        doc["totals"]["fragmentReads"], (line, doc["totals"])
+    assert doc["opportunity"]["signatures"], "no cacheable signatures"
+    assert doc["opportunity"]["totalEstSavedS"] > 0
+    srv.shutdown(); srv.server_close(); api.coalescer.stop(); h.close()
+print("hotspots smoke OK")
+EOF
+
 step "lock-order runtime check (PILOSA_TPU_LOCK_CHECK=1)"
 PILOSA_TPU_LOCK_CHECK=1 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_coalescer.py tests/test_concurrency.py \
