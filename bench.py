@@ -241,7 +241,44 @@ def bench_tpu(holder, partial):
                 len(times) >= 2:
             log(f"bench: timing budget hit after {len(times)} iters")
             break
+    stage_timeline_breakdown(ex, q, partial)
     return float(np.median(times)), want.pairs
+
+
+def stage_timeline_breakdown(ex, q, partial, iters: int = 3):
+    """Where the per-call time goes, not just its total: a few
+    profiled (device-fenced) runs AFTER the timed loop record
+    queue/plan/dispatch/device/fetch medians, and the timeline plane's
+    dispatch-gap analyzer contributes `device_idle_ratio` — the
+    dispatch-floor baseline docs/perf.md §5 tracks and ROADMAP 5's
+    RTT-hiding pipeline must provably improve. Best-effort: a failure
+    costs the breakdown, never the headline number."""
+    try:
+        from pilosa_tpu.utils.profile import QueryProfile
+        from pilosa_tpu.utils.timeline import TIMELINE
+
+        stages = {"queueS": [], "planS": [], "dispatchS": [],
+                  "deviceS": [], "fetchS": []}
+        for _ in range(max(1, iters)):
+            prof = QueryProfile("bench", q, sample_device=True)
+            ex.execute("bench", q, profile=prof)
+            stages["queueS"].append(0.0)  # direct path: no queue wait
+            stages["planS"].append(prof.totals["plan"])
+            stages["dispatchS"].append(prof.totals["dispatch"])
+            stages["deviceS"].append(prof.totals["device"])
+            stages["fetchS"].append(prof.totals["materialize"])
+        partial["stage_breakdown"] = {
+            k: float(np.median(v)) for k, v in stages.items()}
+        # Idle ratio over the whole bench run's dispatches (the timed
+        # loop included): raise the gap window to cover it.
+        TIMELINE.configure(gap_window_s=3600.0)
+        gap = TIMELINE.gap_summary()
+        partial["device_idle_ratio"] = gap["idleRatio"]
+        partial["timeline_dispatches"] = gap["dispatchesTotal"]
+        log(f"bench: stage medians {partial['stage_breakdown']} "
+            f"idle_ratio={gap['idleRatio']:.3f}")
+    except Exception as e:
+        log(f"bench: stage breakdown failed: {e!r}")
 
 
 def bench_device_time(holder):
@@ -620,6 +657,8 @@ def main():
                   "device_and_invalid",
                   "fetch_rtt_s", "device_time_error", "device_time_invalid",
                   "partial", "tpu_timing",
+                  "stage_breakdown", "device_idle_ratio",
+                  "timeline_dispatches",
                   "loadavg_1m", "trivial_fetch_ms", "waited_quiet_s"):
             if k in child:
                 result[k] = child[k]

@@ -38,8 +38,17 @@ def drain_telemetry(api, watchdog=None, logger=None) -> None:
     from pilosa_tpu.utils.hotspots import WORKLOAD
     if WORKLOAD.enabled:
         WORKLOAD.dump(logger)
+    # Timeline plane: the last request timelines + the idle ratio the
+    # process died with (utils/timeline.py).
+    from pilosa_tpu.utils.timeline import TIMELINE
+    if TIMELINE.enabled:
+        TIMELINE.dump(logger)
     tracer = getattr(api, "tracer", None)
     if tracer is not None:
+        # The finished-span ring leaves evidence even when no exporter
+        # is configured (RecordingTracer.dump); exporters then flush.
+        if hasattr(tracer, "dump"):
+            tracer.dump(logger)
         if hasattr(tracer, "stop"):
             tracer.stop()  # final flush of pending spans
         elif hasattr(tracer, "flush"):
@@ -164,6 +173,14 @@ def cmd_server(args) -> int:
                        max_fragments=cfg.workload_max_fragments,
                        max_rows=cfg.workload_max_rows,
                        max_signatures=cfg.workload_max_signatures)
+    # Request-lifecycle timeline plane (utils/timeline.py): per-request
+    # stage timelines at GET /debug/timeline + the dispatch-gap idle
+    # ratio on /metrics. [timeline] enabled=false is the kill switch.
+    from pilosa_tpu.utils.timeline import TIMELINE
+    TIMELINE.configure(enabled=cfg.timeline_enabled,
+                       ring=cfg.timeline_ring,
+                       sample_every=cfg.timeline_sample_every,
+                       gap_window_s=cfg.timeline_gap_window_s)
     coalescer = None
     if cfg.coalescer_enabled:
         # Cross-request query coalescer: concurrent single-query POSTs

@@ -54,6 +54,9 @@ from pilosa_tpu.pql import Call, Condition, Query, parse_string_cached
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
 from pilosa_tpu.utils.hotspots import WORKLOAD
 from pilosa_tpu.utils.memledger import LEDGER
+from pilosa_tpu.utils.timeline import (
+    LANE_DEVICE, LANE_DISPATCH, LANE_FETCH, LANE_PLAN, TIMELINE,
+)
 
 _LOG = logging.getLogger("pilosa_tpu.executor")
 
@@ -638,6 +641,7 @@ class Executor:
 
     def _finalize_staged(self, idx: Index, staged) -> List[Any]:
         prof = self._profile()
+        tl = prof.timeline if prof is not None else None
         results = []
         for i, (call, result) in enumerate(staged):
             t0 = time.perf_counter() if prof is not None else 0.0
@@ -648,7 +652,11 @@ class Executor:
                 result = result.finalize()
             self._translate_result(idx, call, result)
             if prof is not None:
-                prof.finish_op(i, time.perf_counter() - t0, d2h)
+                mat_s = time.perf_counter() - t0
+                prof.finish_op(i, mat_s, d2h)
+                if tl is not None:
+                    TIMELINE.event(tl, "materialize", LANE_FETCH, t0,
+                                   mat_s, op=call.name, d2hBytes=d2h)
             results.append(result)
         return results
 
@@ -1190,8 +1198,14 @@ class Executor:
     def _call_program(self, fn, *args):
         """Run phase: the single funnel every compiled tree-program
         invocation goes through — fused and unfused alike. Tests stub
-        this to count real XLA dispatches."""
-        return fn(*args)
+        this to count real XLA dispatches. The timeline's dispatch-gap
+        analyzer taps the funnel (host wall timestamps of the async
+        enqueue — zero fences), so `pilosa_device_idle_ratio` sees
+        every dispatch however it was reached."""
+        t0 = time.perf_counter()
+        out = fn(*args)
+        TIMELINE.note_dispatch(t0, time.perf_counter() - t0)
+        return out
 
     def _run_staged(self, staged: "_StagedEval", prof, t_plan0: float):
         """Compile + run one staged eval on its own (the unfused
@@ -1209,18 +1223,32 @@ class Executor:
         # unprofiled path keeps its fully-async dispatch queue.
         h2d = (transfer_nbytes((idxs, params)) if uploaded else 0) \
             + (staged.lits.nbytes if staged.lits is not None else 0)
-        node = prof.tree(staged.mode, staged.sig, jit_hit,
-                         time.perf_counter() - t_plan0, h2d,
+        plan_s = time.perf_counter() - t_plan0
+        node = prof.tree(staged.mode, staged.sig, jit_hit, plan_s, h2d,
                          staged.n_shards)
+        tl = prof.timeline
+        if tl is not None:
+            TIMELINE.event(tl, "plan", LANE_PLAN, t_plan0, plan_s,
+                           jit="hit" if jit_hit else "miss")
         t_disp = time.perf_counter()
         out = self._call_program(fn, staged.bank_arrays, idxs, params,
                                  staged.lits)
         dispatch_s = time.perf_counter() - t_disp
         prof.tree_dispatch(node, dispatch_s)
+        if tl is not None:
+            TIMELINE.event(tl, "dispatch", LANE_DISPATCH, t_disp,
+                           dispatch_s, shards=staged.n_shards)
         device_s = 0.0
         if prof.sample_device:
+            # Device slices exist ONLY when the profiler already fenced
+            # this query (?profile=true / sampled 1-in-N) — the
+            # timeline adds zero fences of its own.
+            t_dev = time.perf_counter()
             device_s = _fence_device(out)
             prof.tree_device(node, device_s)
+            if tl is not None:
+                TIMELINE.event(tl, "device", LANE_DEVICE, t_dev,
+                               device_s)
         if staged.fp is not None:
             # Feed the cache-opportunity estimator: what one eval of
             # this signature actually cost (dispatch enqueue + fenced
@@ -1548,7 +1576,11 @@ class Executor:
         cannot intersect)."""
         filter_words = _align_words(filter_words, bank_array.shape[-1])
         fn = self._counts_fn(filter_words is not None, bank_array.shape)
-        return fn(bank_array, filter_words)
+        # Through the _call_program funnel: TopN sweeps are device
+        # dispatches too, and the timeline's dispatch-gap analyzer
+        # must see them or idle ratios under TopN traffic would read
+        # as pure idle.
+        return self._call_program(fn, bank_array, filter_words)
 
     def _fetch_counts(self, out, filter_words):
         """Block on a _dispatch_counts output: (counts_np, raw_np)."""
@@ -1566,7 +1598,7 @@ class Executor:
             self._note_jit_compile()
             fn = jax.jit(lambda w: popcount(w, axis=(-2, -1)))
             self._jit_put("popcount_row", fn)
-        return fn(words)
+        return self._call_program(fn, words)
 
     def _execute_topn(self, idx: Index, call: Call, shards) -> PairsResult:
         """Exact TopN (reference executeTopN 2-phase approximation,

@@ -41,6 +41,9 @@ import numpy as np
 
 from pilosa_tpu.utils.hotspots import WORKLOAD
 from pilosa_tpu.utils.memledger import LEDGER
+from pilosa_tpu.utils.timeline import (
+    LANE_DEVICE, LANE_DISPATCH, LANE_PLAN, TIMELINE,
+)
 
 
 class FusedEval:
@@ -127,9 +130,12 @@ class _FuseGroup:
             # jit hit/miss is unknown until the group compiles at
             # flush; tree_jit fills it in then. The stacked operand
             # upload is likewise charged at flush via tree_h2d.
-            node = prof.tree(staged.mode, staged.sig, None,
-                             time.perf_counter() - t_plan0, 0,
+            plan_s = time.perf_counter() - t_plan0
+            node = prof.tree(staged.mode, staged.sig, None, plan_s, 0,
                              staged.n_shards)
+            if prof.timeline is not None:
+                TIMELINE.event(prof.timeline, "plan", LANE_PLAN,
+                               t_plan0, plan_s, fused=True)
         b = len(self.entries)
         self.entries.append(staged)
         self.profs.append(prof)
@@ -176,7 +182,7 @@ class _FuseGroup:
             t0 = time.perf_counter()
             self.out = ex._call_program(fn, rep.bank_arrays, idxs,
                                         params, rep.lits)
-            self._attribute(jit_hit, time.perf_counter() - t0, h2d,
+            self._attribute(jit_hit, t0, time.perf_counter() - t0, h2d,
                             fused=False)
             return
         # Pad to the next power of two with the first entry's operands
@@ -234,10 +240,10 @@ class _FuseGroup:
         # real members, so the per-query sum equals the real traffic.
         h2d = ((idxs.nbytes + params.nbytes) // B if uploaded else 0) \
             + (rep.lits.nbytes if rep.lits is not None else 0)
-        self._attribute(jit_hit, dispatch_s, h2d, fused=True)
+        self._attribute(jit_hit, t0, dispatch_s, h2d, fused=True)
 
-    def _attribute(self, jit_hit: bool, dispatch_s: float, h2d: int,
-                   fused: bool) -> None:
+    def _attribute(self, jit_hit: bool, t_disp: float, dispatch_s: float,
+                   h2d: int, fused: bool) -> None:
         B = len(self.entries)
         fence_profs = []
         for b, (prof, node) in enumerate(zip(self.profs, self.nodes)):
@@ -253,14 +259,27 @@ class _FuseGroup:
                 node.attrs["fusedBatch"] = B
                 node.attrs["batchIndex"] = b
                 prof.set_fused(B)
+            if prof.timeline is not None:
+                # The shared group dispatch, stamped into every
+                # member's timeline with its batch coordinates (same
+                # convention as the profile tree).
+                TIMELINE.event(prof.timeline, "dispatch", LANE_DISPATCH,
+                               t_disp, dispatch_s,
+                               **({"fusedBatch": B, "batchIndex": b}
+                                  if fused else {}))
             if prof.sample_device:
                 fence_profs.append((prof, node))
         device_s = 0.0
         if fence_profs:
             from pilosa_tpu.executor.executor import _fence_device
+            t_dev = time.perf_counter()
             device_s = _fence_device(self.out)
             for prof, node in fence_profs:
                 prof.tree_device(node, device_s)
+                if prof.timeline is not None:
+                    TIMELINE.event(prof.timeline, "device", LANE_DEVICE,
+                                   t_dev, device_s,
+                                   **({"fusedBatch": B} if fused else {}))
         # Cache-opportunity attribution AFTER the (sampled) fence so
         # fused evals report the same dispatch + device cost basis as
         # the unfused path (_run_staged) — one fused dispatch covered

@@ -295,6 +295,17 @@ class InternalClient:
         return self._req("GET", f"{uri}/debug/hotspots{q}",
                          timeout=timeout)
 
+    def node_timeline(self, uri: str, trace_id: str,
+                      timeout: float = 5.0) -> dict:
+        """One node's timeline slices for a trace id (GET
+        /debug/timeline?trace=...) for the coordinator's
+        /cluster/timeline assembly — same short-timeout rule as
+        node_health: a wedged node is reported, not waited on."""
+        from urllib.parse import quote
+        return self._req("GET",
+                         f"{uri}/debug/timeline?trace={quote(trace_id)}",
+                         timeout=timeout)
+
     def local_shards(self, uri: str) -> Dict[str, List[int]]:
         return self._req("GET", f"{uri}/internal/local-shards")
 

@@ -19,7 +19,9 @@ chip mesh.
 from __future__ import annotations
 
 import threading
+import time
 from pilosa_tpu.utils.locks import make_lock
+from pilosa_tpu.utils.timeline import LANE_REMOTE, TIMELINE
 from typing import Any, Dict, List, Optional, Sequence
 
 from pilosa_tpu.executor.results import result_to_json
@@ -276,6 +278,17 @@ class ClusterExecutor:
         # leg pay device fencing on its node.
         want_profile = profile is not None and getattr(profile, "forced",
                                                        False)
+        # Trace context for the fan-out: captured HERE, on the calling
+        # thread (where the request's span/extracted id lives), because
+        # the scatter threads below have neither — without an explicit
+        # hand-off their query POSTs carry no traceparent and the
+        # remote legs record under fresh trace ids (the old stitching
+        # only appeared to work via a stale-thread-local side channel).
+        tracer = getattr(self.client, "tracer", None)
+        trace_id = getattr(profile, "trace_id", None) \
+            if profile is not None else None
+        if trace_id is None and hasattr(tracer, "current_trace_id"):
+            trace_id = tracer.current_trace_id()
         excluded: set = set()
         last_err: Optional[Exception] = None
         for _ in range(max(1, self.cluster.replica_n)):
@@ -292,16 +305,36 @@ class ClusterExecutor:
 
             def run_remote(node, node_shards):
                 nonlocal failed, last_err
+                # Scatter threads have no open span: adopt the
+                # request's trace id so the outgoing leg injects the
+                # SAME traceparent the coordinator received.
+                if trace_id and hasattr(tracer, "adopt"):
+                    tracer.adopt(trace_id)
+                # Remote-leg slice on the coordinator's request
+                # timeline: how long this node's scatter-gather round
+                # trip took (the remote's own stage slices record on
+                # ITS timeline under the same trace id and assemble
+                # via /cluster/timeline).
+                tl = getattr(profile, "timeline", None) \
+                    if profile is not None else None
+                t0 = time.perf_counter()
                 try:
                     res = self.client.query_node_full(
                         node.uri, index, call.to_pql(), node_shards,
                         profile=want_profile)
+                    TIMELINE.event(tl, f"remote:{node.id}", LANE_REMOTE,
+                                   t0, time.perf_counter() - t0,
+                                   remote=node.id,
+                                   shards=len(node_shards))
                     if want_profile and res.get("profile") is not None:
                         profile.add_node_fragment(node.id,
                                                   res["profile"])
                     with results_lock:
                         parts.append(res["results"][0])
                 except ClientError as e:
+                    TIMELINE.event(tl, f"remote:{node.id}", LANE_REMOTE,
+                                   t0, time.perf_counter() - t0,
+                                   remote=node.id, error=str(e)[:200])
                     with results_lock:
                         excluded.add(node.id)
                         failed = True

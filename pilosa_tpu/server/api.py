@@ -247,6 +247,27 @@ class API:
         self.stats.gauge("executor.jit_cache_size",
                          self.executor.jit_cache_size())
 
+    def _begin_timeline(self, index: str):
+        """Open a request timeline under the SAME trace id the tracer
+        will stamp on this request's spans (minting one when the
+        request arrived without a traceparent), so /debug/queries,
+        exported spans and /debug/timeline all cross-link by it."""
+        from pilosa_tpu.utils.timeline import TIMELINE
+        tid = getattr(self.tracer, "ensure_trace_id", lambda: None)()
+        return TIMELINE.begin(tid, index)
+
+    def _end_timeline(self, tl, err) -> None:
+        from pilosa_tpu.utils.timeline import TIMELINE
+        TIMELINE.finish(tl, error=err)
+        # The request is over: drop the thread-adopted trace id so an
+        # embedded (non-HTTP) caller's next query on this thread mints
+        # a fresh id instead of stitching every query into one trace.
+        # (The HTTP layer already resets per request via extract();
+        # library callers have no such reset.)
+        adopt = getattr(self.tracer, "adopt", None)
+        if adopt is not None:
+            adopt(None)
+
     def query(self, index: str, query: str,
               shards: Optional[Sequence[int]] = None,
               remote: bool = False, profile: bool = False
@@ -257,8 +278,10 @@ class API:
         opt.Remote, executor.go:2236). `profile=True` (the
         ?profile=true surface) embeds the execution profile tree in the
         response with device-time fencing on."""
+        tl = self._begin_timeline(index)
         prof = self.profiler.begin(index, query, shards,
                                    force=bool(profile))
+        prof.timeline = tl
         t0 = _time.perf_counter()
         err = None
         try:
@@ -276,6 +299,7 @@ class API:
             # Direct-path latency histogram: the baseline the coalesced
             # path's coalescer.request timing is compared against.
             self.stats.timing("query.direct", dur)
+            self._end_timeline(tl, err)
             self._observe_query(index, query, dur, prof, err)
 
     def query_coalesced(self, index: str, query,
@@ -295,8 +319,10 @@ class API:
             return self.query(index, query, shards=shards, remote=remote,
                               profile=profile)
         from pilosa_tpu.server.coalescer import CoalescerStopped
+        tl = self._begin_timeline(index)
         prof = self.profiler.begin(index, query, shards,
                                    force=bool(profile))
+        prof.timeline = tl
         t0 = _time.perf_counter()
         err = None
         try:
@@ -335,6 +361,7 @@ class API:
             raise
         finally:
             dur = _time.perf_counter() - t0
+            self._end_timeline(tl, err)
             self._observe_query(index, query, dur, prof, err)
 
     def _query(self, index: str, query: str,
@@ -773,8 +800,16 @@ class API:
         request. Pure host-side dict reads — no device interaction."""
         from pilosa_tpu.utils.hotspots import WORKLOAD
         from pilosa_tpu.utils.memledger import LEDGER
+        from pilosa_tpu.utils.timeline import TIMELINE
+        # Telemetry rings register their own bytes (category
+        # "telemetry") before the ledger publishes, so /debug/memory
+        # totals cover the observability plane itself.
+        TIMELINE.register_memory(LEDGER)
+        if hasattr(self.tracer, "register_memory"):
+            self.tracer.register_memory(LEDGER)
         LEDGER.publish(self.stats)
         WORKLOAD.publish(self.stats)
+        TIMELINE.publish(self.stats)
         self.stats.gauge("executor.jit_cache_size",
                          self.executor.jit_cache_size())
 
@@ -803,6 +838,114 @@ class API:
             top_k=top_k,
             bank_entries=LEDGER.entries("bank", "fragment_bank"))
 
+    def _node_ident(self):
+        if self.cluster is not None:
+            return self.cluster.local.id, self.cluster.local.uri
+        return self.holder.node_id, ""
+
+    def debug_timeline(self, last: Optional[int] = None,
+                       trace: Optional[str] = None) -> Dict[str, Any]:
+        """The GET /debug/timeline document (utils/timeline.py):
+        Chrome trace-event JSON for the last N recorded requests (or
+        one trace id), loadable directly in Perfetto/chrome://tracing,
+        plus the dispatch-gap summary (`deviceIdleRatio` — the baseline
+        ROADMAP 5's RTT-hiding pipeline must improve)."""
+        from pilosa_tpu.utils.timeline import TIMELINE
+        node_id, _ = self._node_ident()
+        self.refresh_memory_gauges()
+        return TIMELINE.snapshot(last=last, trace_id=trace,
+                                 node_id=node_id)
+
+    @staticmethod
+    def _merge_timeline_events(pid: int, node_id: str,
+                               doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+        """One node's trace events re-based under a merged pid, each
+        slice stamped with the node id it came from (down in `args` —
+        Perfetto's process track already shows it, but the JSON must be
+        self-describing too)."""
+        from pilosa_tpu.utils.timeline import TimelineRecorder
+        evs = TimelineRecorder.metadata_events(pid, node_id)
+        for ev in doc.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue  # re-emit our own metadata per pid instead
+            ev = dict(ev)
+            ev["pid"] = pid
+            args = dict(ev.get("args") or {})
+            args["node"] = node_id
+            ev["args"] = args
+            evs.append(ev)
+        return evs
+
+    def cluster_timeline(self, trace_id: str) -> Dict[str, Any]:
+        """The GET /cluster/timeline/{trace} document: every member's
+        timeline slices for one trace id assembled into a single
+        trace-event JSON — the coordinator is pid 0, each remote node
+        its own pid (legs joined by the W3C traceparent the cluster
+        already propagates, so a cross-node query reads as one
+        timeline). An unreachable node is REPORTED with its error,
+        never dropped — its missing leg is exactly the blind spot an
+        operator must see."""
+        import threading as _threading
+        node_id, uri = self._node_ident()
+        local = self.debug_timeline(trace=trace_id)
+        if self.cluster is None:
+            nodes = [{"id": node_id, "uri": uri, "healthy": True,
+                      "down": False,
+                      "events": local["summary"]["requests"]}]
+            return {"traceId": trace_id, "totalNodes": 1,
+                    "respondedNodes": 1, "nodes": nodes,
+                    "displayTimeUnit": "ms",
+                    "traceEvents": self._merge_timeline_events(
+                        0, node_id, local)}
+        docs: Dict[str, Dict[str, Any]] = {}
+        down = set(getattr(self.cluster, "down_ids", set()))
+
+        def fetch(node):
+            if node.id == self.cluster.local.id:
+                docs[node.id] = local
+                return
+            try:
+                doc = self._client.node_timeline(node.uri, trace_id)
+                if not isinstance(doc, dict):
+                    raise ValueError(f"bad timeline body: {doc!r}")
+                docs[node.id] = doc
+            except Exception as e:
+                docs[node.id] = {"error": f"{type(e).__name__}: {e}"}
+
+        # Coordinator first, then cluster order — pid 0 is always the
+        # node that assembled the document.
+        members = sorted(self.cluster.nodes(),
+                         key=lambda n: n.id != self.cluster.local.id)
+        threads = [_threading.Thread(target=fetch, args=(n,))
+                   for n in members]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        nodes = []
+        events: List[Dict[str, Any]] = []
+        for pid, node in enumerate(members):
+            doc = docs.get(node.id, {"error": "no response"})
+            entry: Dict[str, Any] = {"id": node.id, "uri": node.uri,
+                                     "pid": pid,
+                                     "healthy": "error" not in doc,
+                                     "down": node.id in down}
+            if entry["down"]:
+                entry["healthy"] = False
+            if "error" in doc:
+                entry["error"] = doc["error"]
+            else:
+                entry["events"] = doc.get("summary", {}).get(
+                    "requests", 0)
+                events.extend(self._merge_timeline_events(pid, node.id,
+                                                          doc))
+            nodes.append(entry)
+        return {"traceId": trace_id, "totalNodes": len(nodes),
+                "respondedNodes": sum(1 for n in nodes
+                                      if "error" not in n),
+                "nodes": nodes, "displayTimeUnit": "ms",
+                "traceEvents": events}
+
     def node_health(self) -> Dict[str, Any]:
         """This node's health document (GET /internal/health): memory
         ledger totals, coalescer queue depth, jit-cache/retrace/fusion
@@ -810,6 +953,7 @@ class API:
         cluster_health() merges one of these per node."""
         from pilosa_tpu.utils.hotspots import WORKLOAD
         from pilosa_tpu.utils.memledger import LEDGER
+        from pilosa_tpu.utils.timeline import TIMELINE as _TIMELINE
         now = _time.time()
         if self.cluster is not None:
             node_id, uri = self.cluster.local.id, self.cluster.local.uri
@@ -854,6 +998,13 @@ class API:
             # read/write counters + live repeat ratios, so capacity
             # AND access skew read from one health document.
             "workload": workload,
+            # Timeline plane (utils/timeline.py): recorded-request
+            # count + the rolling device idle ratio, so dispatch-floor
+            # pressure reads from the same health document.
+            "timeline": {
+                "requestsRecorded": _TIMELINE.requests_recorded,
+                "deviceIdleRatio": _TIMELINE.idle_ratio(),
+            },
             "watchdog": {
                 "running": bool(wd is not None and wd.running),
                 "samples": wd.samples_taken if wd is not None else 0,

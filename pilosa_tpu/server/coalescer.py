@@ -59,6 +59,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from pilosa_tpu.server.api import ApiError
 from pilosa_tpu.utils.hotspots import WORKLOAD
+from pilosa_tpu.utils.timeline import LANE_COALESCE, LANE_QUEUE, TIMELINE
 
 # Item lifecycle: PENDING (queued, still ejectable) -> CLAIMED (taken by
 # the dispatcher; result imminent) or EJECTED (deadline passed while
@@ -386,9 +387,9 @@ class QueryCoalescer:
             with self.tracer.span("Coalescer.flush", n=len(batch),
                                   reason=reason) as span:
                 if len(batch) == 1:
-                    self._execute_direct(batch[0])
+                    self._execute_direct(batch[0], reason)
                 else:
-                    self._execute_batched(batch, span)
+                    self._execute_batched(batch, span, reason)
         except Exception as e:  # dispatcher must never die
             if self.logger is not None:
                 self.logger.printf("coalescer flush failed: %r", e)
@@ -397,12 +398,15 @@ class QueryCoalescer:
                     item.result = e
                     item.event.set()
 
-    def _execute_direct(self, item: _Item) -> None:
+    def _execute_direct(self, item: _Item, reason: str = "idle") -> None:
         """Batch of one: run the EXACT direct path (execute_full), so a
         lone request degrades to uncoalesced behavior."""
         if item.profile is not None:
-            item.profile.set_coalesced(
-                1, time.perf_counter() - item.enqueued_at)
+            wait = time.perf_counter() - item.enqueued_at
+            item.profile.set_coalesced(1, wait)
+            TIMELINE.event(getattr(item.profile, "timeline", None),
+                           "queue", LANE_QUEUE, item.enqueued_at, wait,
+                           batch=1, reason=reason)
         try:
             item.result = self.executor.execute_full(
                 item.index, item.query, shards=item.shards,
@@ -411,7 +415,8 @@ class QueryCoalescer:
             item.result = e
         item.event.set()
 
-    def _execute_batched(self, batch: List[_Item], span) -> None:
+    def _execute_batched(self, batch: List[_Item], span,
+                         reason: str = "window") -> None:
         """One executor batch for N requests, deduplicating identical
         read-only queries when the flush carries no writes (a write in
         the batch orders against its batchmates, so reads that would
@@ -451,10 +456,25 @@ class QueryCoalescer:
             self.stats.timing("coalescer.queue_wait",
                               exec_start - item.enqueued_at)
             if item.profile is not None:
-                item.profile.set_coalesced(
-                    len(batch), exec_start - item.enqueued_at)
+                wait = exec_start - item.enqueued_at
+                item.profile.set_coalesced(len(batch), wait)
+                # Queue-wait slice on the member's own timeline: where
+                # this request sat before its flush started.
+                TIMELINE.event(getattr(item.profile, "timeline", None),
+                               "queue", LANE_QUEUE, item.enqueued_at,
+                               wait, batch=len(batch), reason=reason)
         shaped = self.executor.execute_batch_shaped(reqs,
                                                     profiles=profiles)
+        flush_s = time.perf_counter() - exec_start
+        for item in batch:
+            if item.profile is not None:
+                # The shared flush (coalesce -> fuse -> dispatch ->
+                # drain) as one slice per member, so a request's
+                # timeline shows the batch it rode and what it cost.
+                TIMELINE.event(getattr(item.profile, "timeline", None),
+                               "coalesce", LANE_COALESCE, exec_start,
+                               flush_s, batch=len(batch),
+                               unique=len(reqs), reason=reason)
         if span is not None:
             # Fusion attribution from this flush's OWN profiles (the
             # process-wide executor counters also move under

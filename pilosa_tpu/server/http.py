@@ -34,6 +34,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
 from pilosa_tpu.utils.locks import make_lock
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional, Tuple
@@ -41,6 +42,59 @@ from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu.server import proto_compat, wire
 from pilosa_tpu.server.api import API, ApiError
+from pilosa_tpu.utils.timeline import TIMELINE
+
+# Per-endpoint RED/SLO latency buckets (seconds): powers of two from
+# ~61 µs to 8 s — wide enough that a tunnel-bound 70 ms dispatch floor
+# and a sub-ms cache hit land in different buckets.
+SLO_BUCKETS = tuple(2.0 ** e for e in range(-14, 4))
+
+# Endpoint label normalization: path parameters collapse to
+# placeholders so `pilosa_http_request_seconds{endpoint=...}` stays a
+# bounded label set (index/field names must not explode cardinality).
+_EP_PATTERNS = [
+    (re.compile(r"/index/[^/]+/query"), "/index/{index}/query"),
+    (re.compile(r"/index/[^/]+/field/[^/]+/import-roaring/\d+"),
+     "/index/{index}/field/{field}/import-roaring/{shard}"),
+    (re.compile(r"/index/[^/]+/field/[^/]+/import"),
+     "/index/{index}/field/{field}/import"),
+    (re.compile(r"/index/[^/]+/field/[^/]+"),
+     "/index/{index}/field/{field}"),
+    (re.compile(r"/index/[^/]+/field"), "/index/{index}/field"),
+    (re.compile(r"/index/[^/]+"), "/index/{index}"),
+    (re.compile(r"/cluster/timeline/[^/]+"),
+     "/cluster/timeline/{trace}"),
+]
+_EP_STATIC = frozenset({
+    "/", "/schema", "/status", "/info", "/version", "/index",
+    "/metrics", "/batch/query", "/export", "/recalculate-caches",
+    "/debug/vars", "/debug/queries", "/debug/memory", "/debug/hotspots",
+    "/debug/timeline", "/cluster/health", "/cluster/hotspots",
+    # Internal/cluster routes are fixed strings: an explicit whitelist,
+    # NOT a prefix match — unknown paths under these prefixes must fold
+    # into "other" like everything else or a scanner mints series.
+    "/internal/health", "/internal/nodes", "/internal/local-shards",
+    "/internal/views", "/internal/join", "/internal/cluster/message",
+    "/internal/sync", "/internal/resize/pull", "/internal/shards/max",
+    "/internal/fragment/blocks", "/internal/fragment/block/data",
+    "/internal/fragment/data", "/internal/fragment/nodes",
+    "/internal/attr/blocks", "/internal/attr/block/data",
+    "/internal/attr/merge", "/internal/translate/data",
+    "/internal/translate/keys", "/internal/translate/ids",
+    "/cluster/resize/remove-node", "/cluster/resize/set-coordinator",
+    "/cluster/resize/abort", "/cluster/resize/run",
+})
+
+
+def endpoint_label(path: str) -> str:
+    """Bounded endpoint label for the SLO series. Unknown paths fold
+    into "other" — a scanner walking random URLs must not mint series."""
+    if path in _EP_STATIC:
+        return path
+    for rx, label in _EP_PATTERNS:
+        if rx.fullmatch(path):
+            return label
+    return "other"
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -217,10 +271,43 @@ class Handler(BaseHTTPRequestHandler):
     def do_DELETE(self):
         self._dispatch("DELETE")
 
+    def send_response(self, code, message=None):
+        # Remember the response status for the per-endpoint SLO
+        # histogram (each request sets it anew before _observe_slo
+        # reads it, so connection reuse cannot leak a stale code).
+        self._slo_status = code
+        super().send_response(code, message)
+
+    def _observe_slo(self, method: str, path: str, dur: float) -> None:
+        """One RED/SLO observation per request:
+        pilosa_http_request_seconds{endpoint,status} with pow2 buckets.
+        Slow non-query endpoints cross-link their trace id into the
+        slow-query ring (the query routes already record there with a
+        full profile) so /debug/queries -> traceId -> /debug/timeline
+        works for every surface."""
+        api = self.api
+        stats = getattr(api, "stats", None)
+        if stats is None:
+            return
+        ep = endpoint_label(path)
+        status = getattr(self, "_slo_status", 200)
+        stats.with_tags(f"endpoint:{ep}", f"status:{status}").histogram(
+            "http_request_seconds", dur, buckets=SLO_BUCKETS)
+        lqt = getattr(api, "long_query_time", 0.0)
+        if lqt > 0 and dur > lqt and ep not in (
+                "/index/{index}/query", "/batch/query"):
+            tracer = getattr(api, "tracer", None)
+            tid = getattr(tracer, "current_trace_id", lambda: None)()
+            profiler = getattr(api, "profiler", None)
+            if profiler is not None:
+                profiler.record_slow("-", f"{method} {ep}", dur,
+                                     kind="http", trace_id=tid)
+
     def _dispatch(self, method: str) -> None:
         path, q, _ = self._route()
         if hasattr(self.api, "tracer"):
             self.api.tracer.extract(self.headers)
+        t0 = time.perf_counter()
         try:
             handled = self._handle(method, path, q)
             if not handled:
@@ -232,6 +319,12 @@ class Handler(BaseHTTPRequestHandler):
                         extra_headers=getattr(e, "headers", None))
         except Exception as e:  # mirror the reference's panic recovery
             self._error(f"internal error: {type(e).__name__}: {e}", 500)
+        finally:
+            try:
+                self._observe_slo(method, path,
+                                  time.perf_counter() - t0)
+            except Exception:
+                pass  # metrics must never fail a served response
 
     def _handle(self, method: str, path: str, q: dict) -> bool:
         api = self.api
@@ -281,6 +374,18 @@ class Handler(BaseHTTPRequestHandler):
                 self._check_args(q, "topk")
                 self._json(api.cluster_hotspots(
                     top_k=int(q["topk"]) if q.get("topk") else None))
+            elif path == "/debug/timeline":
+                # Request-lifecycle timeline plane (utils/timeline.py):
+                # Chrome trace-event JSON for the last N requests —
+                # open it directly in Perfetto/chrome://tracing.
+                self._check_args(q, "last", "trace")
+                self._json(api.debug_timeline(
+                    last=int(q["last"]) if q.get("last") else None,
+                    trace=q.get("trace")))
+            elif m := re.fullmatch(r"/cluster/timeline/([^/]+)", path):
+                # Multi-node timeline for one trace id: legs assembled
+                # by the traceparent the cluster already propagates.
+                self._json(api.cluster_timeline(m.group(1)))
             elif path == "/cluster/health":
                 # Coordinator-merged fleet health: per-node memory,
                 # queue depth, jit/retrace/slow-query counters,
@@ -387,10 +492,18 @@ class Handler(BaseHTTPRequestHandler):
                     # embeds the EXPLAIN ANALYZE-style execution
                     # profile tree in the response (docs/observability
                     # .md); the protobuf surface stays profile-free.
-                    self._json(api.query_coalesced(
+                    resp = api.query_coalesced(
                         m.group(1), pql, shards=shards,
                         remote=self._qbool(q, "remote"),
-                        profile=self._qbool(q, "profile")))
+                        profile=self._qbool(q, "profile"))
+                    # Serialize stage on the request's timeline: the
+                    # handler thread writes the response after the API
+                    # layer closed the timeline, so the slice attaches
+                    # to the thread's last-finished request.
+                    ts0 = time.perf_counter()
+                    self._json(resp)
+                    TIMELINE.note_serialize(ts0,
+                                            time.perf_counter() - ts0)
                 except ApiError:
                     # Already carries its status (429 overload, 408
                     # deadline): must not collapse to a generic 400.

@@ -110,6 +110,20 @@ class Config:
     workload_max_fragments: int = 4096
     workload_max_rows: int = 4096
     workload_max_signatures: int = 1024
+    # Request-lifecycle timeline plane (utils/timeline.py): bounded
+    # per-process ring of per-request stage timelines (queue -> coalesce
+    # -> plan -> dispatch -> device -> materialize -> serialize) served
+    # as Chrome trace-event JSON at GET /debug/timeline, plus the
+    # dispatch-gap analyzer behind pilosa_device_idle_ratio. Host-side
+    # wall timestamps only — device slices appear only on queries the
+    # profiler already fences. `enabled = false` is the kill switch
+    # (recording and the gap analyzer both stop). TOML accepts a
+    # [timeline] table (enabled / ring / sample_every / gap_window_s)
+    # or the flat timeline_* spelling; env uses PILOSA_TPU_TIMELINE_*.
+    timeline_enabled: bool = True
+    timeline_ring: int = 256        # request timelines kept
+    timeline_sample_every: int = 1  # record 1 in N requests (1 = all)
+    timeline_gap_window_s: float = 60.0  # idle-ratio rolling window
     # Metrics (reference server/config.go Metric.Service/Host: expvar |
     # statsd | none — "mem" is the expvar equivalent)
     metric_service: str = "mem"   # mem | statsd | none
@@ -199,6 +213,11 @@ class Config:
                 "workload top_k/max_* bounds must be >= 1")
         if self.telemetry_ring < 1:
             raise ValueError("telemetry ring must be >= 1")
+        if self.timeline_ring < 1 or self.timeline_sample_every < 1:
+            raise ValueError(
+                "timeline ring/sample_every must be >= 1")
+        if self.timeline_gap_window_s <= 0:
+            raise ValueError("timeline gap_window_s must be > 0")
         if not 0 <= self.telemetry_hbm_watermark <= 1:
             raise ValueError(
                 "telemetry hbm_watermark must be in [0, 1]")

@@ -45,7 +45,9 @@ from pilosa_tpu.utils.locks import make_rlock
 
 # Categories whose bytes live in host RAM, not device HBM: excluded
 # from the watchdog's HBM watermark (but still ledgered + exported).
-HOST_CATEGORIES = frozenset({"host_block"})
+# "telemetry" covers the tracer span ring and the request-timeline
+# ring (utils/tracing.py / utils/timeline.py register themselves).
+HOST_CATEGORIES = frozenset({"host_block", "telemetry"})
 
 
 class _Entry:

@@ -121,6 +121,11 @@ class QueryProfile:
         self.totals = {"plan": 0.0, "dispatch": 0.0, "device": 0.0,
                        "materialize": 0.0}
         self.coalesced: Optional[Dict[str, Any]] = None
+        # Request-timeline handle (utils/timeline._TimelineRequest or
+        # None): the API layer attaches it so executor/coalescer/
+        # cluster seams — which already carry the profile — can record
+        # stage slices without any new plumbing of their own.
+        self.timeline: Any = None
         # Largest same-signature fusion group this query's evals ran
         # in (None = nothing fused; see Executor.execute_batch).
         self.fused_batch: Optional[int] = None
@@ -395,7 +400,12 @@ class Profiler:
     def record_slow(self, index: str, query: Any, duration: float,
                     profile: Optional[QueryProfile] = None,
                     error: Optional[BaseException] = None,
-                    kind: str = "query") -> None:
+                    kind: str = "query",
+                    trace_id: Optional[str] = None) -> None:
+        """`trace_id` cross-links profile-less records (the HTTP SLO
+        layer's slow non-query endpoints) into the timeline plane: the
+        ring record's traceId opens the request in
+        /debug/timeline?trace=... and /cluster/timeline/{trace}."""
         rec: Dict[str, Any] = {
             "time": time.time(),
             "durS": duration,
@@ -403,6 +413,8 @@ class Profiler:
             "query": pql_text(query, 500),
             "kind": kind,
         }
+        if trace_id:
+            rec["traceId"] = trace_id
         if profile is not None:
             if profile.trace_id:
                 rec["traceId"] = profile.trace_id

@@ -25,7 +25,8 @@ class StatsClient:
     def gauge(self, name: str, value: float, rate: float = 1.0) -> None:
         pass
 
-    def histogram(self, name: str, value: float, rate: float = 1.0) -> None:
+    def histogram(self, name: str, value: float, rate: float = 1.0,
+                  buckets: Optional[Sequence[float]] = None) -> None:
         pass
 
     def set(self, name: str, value: str, rate: float = 1.0) -> None:
@@ -39,10 +40,21 @@ class NopStatsClient(StatsClient):
     pass
 
 
-# Bucket upper bounds for MemStatsClient histograms (+Inf implied).
-# Powers of two because every histogrammed quantity here is a batch /
-# fusion group size, and those pad to powers of two by construction.
+# Default bucket upper bounds for MemStatsClient histograms (+Inf
+# implied). Powers of two because the original histogrammed quantities
+# are batch / fusion group sizes, which pad to powers of two by
+# construction. Callers with a different distribution (the HTTP SLO
+# latency histograms) pass their own `buckets=`; the bucket set is
+# fixed per metric family at first observation.
 HISTOGRAM_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def _le_label(le) -> str:
+    """Prometheus le= label text for one bucket bound: integral bounds
+    print as integers (the pow2 size buckets stay "1","2",...); float
+    bounds print exactly (repr round-trips)."""
+    f = float(le)
+    return str(int(f)) if f.is_integer() else repr(f)
 
 
 class MemStatsClient(StatsClient):
@@ -57,12 +69,12 @@ class MemStatsClient(StatsClient):
             self.gauges: Dict[str, float] = {}
             self.timings: Dict[str, List[float]] = defaultdict(list)
             # Real cumulative histograms (fusion_group_size,
-            # batch_size): per-bucket increment counts + running sum —
-            # NOT an alias of the timing summary store, which cannot
-            # express Prometheus _bucket/_sum/_count semantics.
-            self.histos: Dict[str, dict] = defaultdict(
-                lambda: {"counts": [0] * (len(HISTOGRAM_BUCKETS) + 1),
-                         "sum": 0.0})
+            # batch_size, http_request_seconds): per-bucket increment
+            # counts + running sum + the bucket bounds the entry was
+            # created with — NOT an alias of the timing summary store,
+            # which cannot express Prometheus _bucket/_sum/_count
+            # semantics.
+            self.histos: Dict[str, dict] = {}
             self.sets: Dict[str, set] = defaultdict(set)
             self._lock = make_lock("MemStatsClient._lock")
 
@@ -83,16 +95,25 @@ class MemStatsClient(StatsClient):
         with root._lock:
             root.gauges[self._key(name)] = value
 
-    def histogram(self, name, value, rate=1.0):
+    def histogram(self, name, value, rate=1.0, buckets=None):
         """One observation into the bucketed histogram for `name`
-        (buckets HISTOGRAM_BUCKETS + +Inf; exported with cumulative
-        _bucket/_sum/_count lines by prometheus_text)."""
+        (default buckets HISTOGRAM_BUCKETS + +Inf; exported with
+        cumulative _bucket/_sum/_count lines by prometheus_text).
+        `buckets` sets the bounds when the entry is first created —
+        first-seen wins, so one family never mixes bucket layouts."""
         root = self._parent
-        i = 0
-        while i < len(HISTOGRAM_BUCKETS) and value > HISTOGRAM_BUCKETS[i]:
-            i += 1
+        key = self._key(name)
         with root._lock:
-            h = root.histos[self._key(name)]
+            h = root.histos.get(key)
+            if h is None:
+                b = tuple(buckets) if buckets is not None \
+                    else HISTOGRAM_BUCKETS
+                h = root.histos[key] = {"counts": [0] * (len(b) + 1),
+                                        "sum": 0.0, "buckets": b}
+            b = h["buckets"]
+            i = 0
+            while i < len(b) and value > b[i]:
+                i += 1
             h["counts"][i] += 1
             h["sum"] += value
 
@@ -117,10 +138,11 @@ class MemStatsClient(StatsClient):
                    "sets": {k: sorted(v) for k, v in root.sets.items()}}
             out["histograms"] = {}
             for k, h in root.histos.items():
+                bounds = h.get("buckets", HISTOGRAM_BUCKETS)
                 cum, buckets = 0, {}
-                for le, c in zip(HISTOGRAM_BUCKETS, h["counts"]):
+                for le, c in zip(bounds, h["counts"]):
                     cum += c
-                    buckets[str(le)] = cum
+                    buckets[_le_label(le)] = cum
                 buckets["+Inf"] = cum + h["counts"][-1]
                 out["histograms"][k] = {"buckets": buckets,
                                         "sum": h["sum"],
@@ -164,9 +186,9 @@ class MultiStatsClient(StatsClient):
         for c in self.clients:
             c.gauge(name, value, rate)
 
-    def histogram(self, name, value, rate=1.0):
+    def histogram(self, name, value, rate=1.0, buckets=None):
         for c in self.clients:
-            c.histogram(name, value, rate)
+            c.histogram(name, value, rate, buckets=buckets)
 
     def set(self, name, value, rate=1.0):
         for c in self.clients:
@@ -306,7 +328,9 @@ class StatsdStatsClient(StatsClient):
     def gauge(self, name, value, rate=1.0):
         self._emit(name, f"{self._num(value)}|g", rate)
 
-    def histogram(self, name, value, rate=1.0):
+    def histogram(self, name, value, rate=1.0, buckets=None):
+        # statsd histograms are server-side bucketed; `buckets` is a
+        # MemStatsClient concern and is ignored on the wire.
         self._emit(name, f"{self._num(value)}|h", rate)
 
     def set(self, name, value, rate=1.0):

@@ -68,8 +68,14 @@ def parse_traceparent(value: str) -> Optional[str]:
 
 
 class Span:
-    __slots__ = ("name", "trace_id", "span_id", "start", "end", "attrs",
-                 "children")
+    """``start``/``end`` are wall-clock *export anchors*; durations are
+    pure ``time.perf_counter()`` deltas (``pc_start``/``pc_end``), so an
+    NTP step mid-span cannot corrupt them. ``end`` is derived at close
+    as ``start + duration()`` — one wall-clock read per span, never a
+    second one the clock could have stepped between."""
+
+    __slots__ = ("name", "trace_id", "span_id", "start", "end",
+                 "pc_start", "pc_end", "attrs", "children")
 
     def __init__(self, name: str, trace_id: str, attrs: dict):
         self.name = name
@@ -77,11 +83,31 @@ class Span:
         self.span_id = uuid.uuid4().hex[:16]
         self.start = time.time()
         self.end: Optional[float] = None
+        self.pc_start = time.perf_counter()
+        self.pc_end: Optional[float] = None
         self.attrs = attrs
         self.children: List["Span"] = []
 
     def duration(self) -> float:
-        return (self.end or time.time()) - self.start
+        return (self.pc_end if self.pc_end is not None
+                else time.perf_counter()) - self.pc_start
+
+    def close(self) -> None:
+        """Stamp the monotonic end and derive the wall-clock end from
+        the span's own anchor + duration (skew-proof)."""
+        if self.pc_end is None:
+            self.pc_end = time.perf_counter()
+        self.end = self.start + self.duration()
+
+    def nbytes(self) -> int:
+        """Rough retained-memory estimate for the whole subtree (the
+        tracer ring's memory-ledger registration)."""
+        n = 160 + len(self.name)
+        for k, v in self.attrs.items():
+            n += len(str(k)) + len(str(v)) + 32
+        for c in self.children:
+            n += c.nbytes()
+        return n
 
     def set(self, key: str, value) -> None:
         """Annotate an open span with a value only known mid-span (e.g.
@@ -112,6 +138,10 @@ class RecordingTracer:
         self.finished: List[Span] = []
         self._local = threading.local()
         self._lock = make_lock("RecordingTracer._lock")
+        # Bytes retained by `finished` (span trees), maintained
+        # incrementally under _lock — the memory ledger's `telemetry`
+        # registration reads it without walking the ring.
+        self._ring_bytes = 0
 
     def _stack(self) -> List[Span]:
         if not hasattr(self._local, "stack"):
@@ -130,31 +160,59 @@ class RecordingTracer:
         try:
             yield span
         finally:
-            span.end = time.time()
+            span.close()
             stack.pop()
             if not stack:
                 with self._lock:
                     self.finished.append(span)
+                    self._ring_bytes += span.nbytes()
                     if len(self.finished) > self.keep:
+                        for old in self.finished[: -self.keep]:
+                            self._ring_bytes -= old.nbytes()
                         del self.finished[: -self.keep]
 
     def inject(self, headers: Dict[str, str]) -> None:
         """Stamp outgoing node-to-node requests with W3C traceparent:
         the root span's trace id + the innermost open span as parent.
-        The legacy header rides along for the same one-release window
-        extract keeps accepting it — a not-yet-upgraded peer only
-        reads X-Trace-Id, and a mixed-version cluster must keep
-        correlating in BOTH directions during a rolling upgrade."""
+        With no span open, an adopted thread trace id (extract(), or
+        adopt() on a scatter-gather worker) still propagates — the
+        coordinator's fan-out legs run on threads that never opened a
+        span, and before this fallback their query POSTs carried no
+        trace context at all (the old cross-node stitching only worked
+        through a stale-thread-local side channel). The legacy header
+        rides along for the same one-release window extract keeps
+        accepting it — a not-yet-upgraded peer only reads X-Trace-Id,
+        and a mixed-version cluster must keep correlating in BOTH
+        directions during a rolling upgrade."""
         stack = self._stack()
         if stack:
             headers[TRACEPARENT_HEADER] = format_traceparent(
                 stack[0].trace_id, stack[-1].span_id)
             headers[TRACE_HEADER] = stack[0].trace_id
+            return
+        tid = getattr(self._local, "trace_id", None)
+        if tid:
+            # No open span to parent under: mint a synthetic parent id
+            # (the W3C field is mandatory; non-recording propagation-
+            # only contexts do the same in mainstream tracers).
+            headers[TRACEPARENT_HEADER] = format_traceparent(
+                tid, uuid.uuid4().hex[:16])
+            headers[TRACE_HEADER] = tid
+
+    def adopt(self, trace_id: Optional[str]) -> None:
+        """Adopt a trace id on THIS thread (scatter-gather workers call
+        it with the coordinator request's id so their outgoing legs
+        inject the same trace the request arrived under)."""
+        self._local.trace_id = trace_id
 
     def extract(self, headers) -> None:
         """Adopt an incoming trace context: W3C traceparent first, the
         legacy X-Trace-Id spelling as a fallback (accepted for one
-        release so mixed-version clusters keep correlating)."""
+        release so mixed-version clusters keep correlating). A request
+        carrying NEITHER header clears any previously adopted id —
+        handler threads are reused across keep-alive requests, and a
+        stale id would stitch unrelated requests into one trace."""
+        self._local.trace_id = None
         tp = headers.get(TRACEPARENT_HEADER)
         if tp:
             tid = parse_traceparent(tp)
@@ -174,6 +232,47 @@ class RecordingTracer:
         if stack:
             return stack[0].trace_id
         return getattr(self._local, "trace_id", None)
+
+    def ensure_trace_id(self) -> str:
+        """The thread's current trace id, minting (and adopting) one
+        when none was extracted — so the timeline recorder, the
+        profiler AND the spans a request subsequently opens all carry
+        the SAME id even for requests that arrived without a
+        traceparent header."""
+        tid = self.current_trace_id()
+        if tid is None:
+            tid = uuid.uuid4().hex
+            self._local.trace_id = tid
+        return tid
+
+    def ring_nbytes(self) -> int:
+        with self._lock:
+            return max(0, self._ring_bytes)
+
+    def register_memory(self, ledger=None) -> None:
+        """Register the finished-span ring with the memory ledger
+        (category ``telemetry``) so /debug/memory totals stay provable."""
+        if ledger is None:
+            from pilosa_tpu.utils.memledger import LEDGER as ledger
+        with self._lock:
+            nbytes = max(0, self._ring_bytes)
+            count = len(self.finished)
+        ledger.register("telemetry", "tracer_ring", nbytes, owner=self,
+                        kind="tracer", entries=count)
+
+    def dump(self, logger, last: int = 10) -> int:
+        """Write the most recent `last` finished root spans to the log
+        (the SIGTERM drain path — buffered spans that never exported
+        still leave evidence). Returns spans written."""
+        with self._lock:
+            spans = list(self.finished[-max(0, int(last)):])
+        if logger is not None and spans:
+            logger.printf("tracer: dumping %d finished span(s) on "
+                          "shutdown", len(spans))
+            for s in spans:
+                logger.printf("tracer: %.3fs %s trace=%s",
+                              s.duration(), s.name, s.trace_id)
+        return len(spans)
 
 
 def _sanitize_trace_id(tid: str) -> str:
@@ -196,14 +295,20 @@ def spans_to_otlp(spans: List[Span], service_name: str) -> dict:
     (server/config.go:110-118 wires jaeger-client-go)."""
     flat = []
 
-    def walk(span: Span, parent_id: str):
+    def walk(span: Span, parent_id: str, anchor_wall: float,
+             anchor_pc: float):
+        # One wall-clock anchor PER TRACE (the root span's): every
+        # descendant's export timestamps are monotonic offsets from it,
+        # so an NTP step mid-trace shifts nothing within the trace.
+        start = anchor_wall + (span.pc_start - anchor_pc)
+        end = start + span.duration()
         entry = {
             "traceId": span.trace_id[:32].ljust(32, "0"),
             "spanId": span.span_id,
             "name": span.name,
             "kind": 1,  # SPAN_KIND_INTERNAL
-            "startTimeUnixNano": str(int(span.start * 1e9)),
-            "endTimeUnixNano": str(int((span.end or span.start) * 1e9)),
+            "startTimeUnixNano": str(int(start * 1e9)),
+            "endTimeUnixNano": str(int(end * 1e9)),
             "attributes": [
                 {"key": str(k), "value": {"stringValue": str(v)}}
                 for k, v in span.attrs.items()],
@@ -212,10 +317,10 @@ def spans_to_otlp(spans: List[Span], service_name: str) -> dict:
             entry["parentSpanId"] = parent_id
         flat.append(entry)
         for child in span.children:
-            walk(child, span.span_id)
+            walk(child, span.span_id, anchor_wall, anchor_pc)
 
     for s in spans:
-        walk(s, "")
+        walk(s, "", s.start, s.pc_start)
     return {"resourceSpans": [{
         "resource": {"attributes": [
             {"key": "service.name",

@@ -1859,3 +1859,118 @@ def test_cluster_hotspots_merge_with_unreachable_node(tmp_path):
         nodes[2].holder.close()
         for nd in nodes[:2]:
             nd.stop()
+
+
+def test_cluster_timeline_stitches_nodes(tmp_path):
+    """A coordinator→remote query leg produces ONE assembled timeline:
+    /cluster/timeline/{trace} merges every member's slices for the
+    trace id the W3C traceparent propagated — remote slices carry the
+    remote node id and ride the coordinator's trace id, so a cross-
+    node query reads as one Perfetto-loadable document."""
+    from pilosa_tpu.utils.timeline import TIMELINE
+    from pilosa_tpu.utils.tracing import RecordingTracer
+
+    nodes = run_cluster(tmp_path, 2)
+    try:
+        TIMELINE.reset()
+        for nd in nodes:
+            rt = RecordingTracer()
+            nd.api.tracer = rt
+            nd.api._client.tracer = rt
+            nd.api.profiler.tracer = rt
+        base = nodes[0].uri
+        req(base, "POST", "/index/ct", {"options": {}})
+        req(base, "POST", "/index/ct/field/f", {"options": {}})
+        cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+        req(base, "POST", "/index/ct/field/f/import",
+            {"rowIDs": [1] * 6, "columnIDs": cols})
+        trace_id = "e1" * 16
+        r = urllib.request.Request(
+            base + "/index/ct/query", data=b"Count(Row(f=1))",
+            method="POST",
+            headers={"traceparent": f"00-{trace_id}-{'ab' * 8}-01"})
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            assert json.loads(resp.read())["results"] == [6]
+
+        doc = req(base, "GET", f"/cluster/timeline/{trace_id}")
+        assert doc["traceId"] == trace_id
+        assert doc["totalNodes"] == 2
+        assert doc["respondedNodes"] == 2
+        by_id = {n["id"]: n for n in doc["nodes"]}
+        assert set(by_id) == {nd.uri for nd in nodes}
+        # The coordinator that assembled the doc is pid 0.
+        assert by_id[nodes[0].uri]["pid"] == 0
+        for n in doc["nodes"]:
+            assert n["healthy"] is True and n["down"] is False
+
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert xs
+        # Every slice carries the shared trace id and its node id, and
+        # the two nodes' slices sit in distinct pid tracks.
+        assert all(e["args"]["trace"] == trace_id for e in xs)
+        per_node = {e["args"]["node"] for e in xs}
+        assert per_node == {nd.uri for nd in nodes}
+        assert {e["pid"] for e in xs} == {0, 1}
+        # The coordinator recorded the remote fan-out leg; the remote
+        # recorded its own dispatch under the SAME trace.
+        coord_names = {e["name"] for e in xs if e["pid"] == 0}
+        remote_names = {e["name"] for e in xs if e["pid"] == 1}
+        assert any(nm.startswith("remote:") for nm in coord_names), \
+            coord_names
+        assert "dispatch" in remote_names and "request" in remote_names
+        # Every event validates against the Chrome trace-event shape.
+        for ev in doc["traceEvents"]:
+            for k in ("ph", "ts", "dur", "pid", "tid"):
+                assert k in ev, ev
+    finally:
+        TIMELINE.reset()
+        for nd in nodes:
+            nd.stop()
+
+
+def test_cluster_timeline_reports_unreachable_node(tmp_path):
+    """A severed member is REPORTED in the assembled timeline with its
+    error — never silently dropped — while the survivors' slices still
+    merge (same contract as /cluster/health and /cluster/hotspots)."""
+    from pilosa_tpu.utils.timeline import TIMELINE
+    from pilosa_tpu.utils.tracing import RecordingTracer
+
+    nodes = run_cluster(tmp_path, 3)
+    try:
+        TIMELINE.reset()
+        for nd in nodes:
+            rt = RecordingTracer()
+            nd.api.tracer = rt
+            nd.api._client.tracer = rt
+        base = nodes[0].uri
+        req(base, "POST", "/index/cu", {"options": {}})
+        req(base, "POST", "/index/cu/field/f", {"options": {}})
+        cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+        req(base, "POST", "/index/cu/field/f/import",
+            {"rowIDs": [1] * 6, "columnIDs": cols})
+        trace_id = "e2" * 16
+        r = urllib.request.Request(
+            base + "/index/cu/query", data=b"Count(Row(f=1))",
+            method="POST",
+            headers={"traceparent": f"00-{trace_id}-{'ab' * 8}-01"})
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            assert json.loads(resp.read())["results"] == [6]
+
+        nodes[2].stop_server_only()
+        nodes[0].api._client.drop_idle()
+        doc = req(base, "GET", f"/cluster/timeline/{trace_id}")
+        assert doc["totalNodes"] == 3
+        assert doc["respondedNodes"] == 2
+        dead = [n for n in doc["nodes"] if not n["healthy"]]
+        assert len(dead) == 1 and dead[0]["id"] == nodes[2].uri
+        assert "error" in dead[0]
+        # Survivors' slices still assembled under the trace.
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert xs and all(e["args"]["trace"] == trace_id for e in xs)
+        live_ids = {n["id"] for n in doc["nodes"] if n["healthy"]}
+        assert {e["args"]["node"] for e in xs} <= live_ids
+    finally:
+        TIMELINE.reset()
+        nodes[2].holder.close()
+        for nd in nodes[:2]:
+            nd.stop()

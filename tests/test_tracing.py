@@ -240,6 +240,105 @@ def test_live_query_spans_reach_exporter(tmp_path, capture_server):
     holder.close()
 
 
+def test_span_duration_immune_to_clock_step(monkeypatch):
+    """Regression (PR 7 satellite): Span previously stamped start/end
+    with two time.time() reads, so an NTP step mid-span corrupted the
+    duration. Durations are now perf_counter deltas with ONE wall
+    anchor per trace for export timestamps."""
+    import time as _time
+
+    tr = RecordingTracer()
+    wall = [_time.time()]
+    monkeypatch.setattr(_time, "time", lambda: wall[0])
+    with tr.span("outer") as outer:
+        with tr.span("inner") as inner:
+            wall[0] -= 3600.0  # the clock steps BACK an hour mid-span
+    # Durations stay tiny and non-negative despite the step...
+    assert 0.0 <= inner.duration() < 5.0
+    assert 0.0 <= outer.duration() < 5.0
+    # ...and the derived wall end never precedes the start.
+    assert outer.end >= outer.start
+    # OTLP export anchors every span of the trace on the ROOT's wall
+    # clock: the child's offset from the root is monotonic, so end >=
+    # start holds and the child nests inside the parent window.
+    doc = spans_to_otlp(tr.finished, "svc")
+    spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    parent, child = spans
+    for s in spans:
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+    assert int(child["startTimeUnixNano"]) >= \
+        int(parent["startTimeUnixNano"])
+    assert int(child["endTimeUnixNano"]) <= int(parent["endTimeUnixNano"])
+
+
+def test_extract_without_headers_clears_stale_thread_id():
+    """Handler threads are reused across keep-alive requests: a request
+    with NO trace headers must clear the previous request's adopted id
+    instead of stitching unrelated requests into one trace."""
+    tr = RecordingTracer()
+    tr.extract({"X-Trace-Id": "ab" * 16})
+    assert tr.current_trace_id() == "ab" * 16
+    tr.extract({})  # next request on the same thread, no headers
+    assert tr.current_trace_id() is None
+
+
+def test_inject_falls_back_to_adopted_thread_id():
+    """Scatter-gather worker threads have no open span; after adopt()
+    their outgoing requests still inject the coordinator's trace id
+    (the fix that made cross-node stitching deterministic instead of
+    relying on a stale-thread-local side channel)."""
+    from pilosa_tpu.utils.tracing import parse_traceparent
+
+    tr = RecordingTracer()
+    headers = {}
+    tr.inject(headers)
+    assert "traceparent" not in headers  # nothing to propagate
+    tr.adopt("cd" * 16)
+    tr.inject(headers)
+    assert parse_traceparent(headers["traceparent"]) == "cd" * 16
+    assert headers["X-Trace-Id"] == "cd" * 16
+
+
+def test_tracer_ring_registers_with_memory_ledger():
+    """The finished-span ring registers its bytes under the ledger's
+    `telemetry` category (host RAM: excluded from deviceBytes), and
+    the registration tracks ring churn."""
+    from pilosa_tpu.utils.memledger import MemoryLedger
+
+    ledger = MemoryLedger()
+    tr = RecordingTracer(keep=4)
+    with tr.span("a", big="x" * 100):
+        pass
+    tr.register_memory(ledger)
+    tot = ledger.totals()["telemetry"]
+    assert tot["bytes"] > 100 and tot["count"] == 1
+    first = tot["bytes"]
+    for _ in range(20):  # churn past `keep`: bytes stay bounded
+        with tr.span("b"):
+            pass
+    tr.register_memory(ledger)
+    tot = ledger.totals()["telemetry"]
+    assert tot["count"] == 1  # re-registered in place, no growth
+    assert 0 < tot["bytes"] < first + 4 * 1000
+    snap = ledger.snapshot()
+    assert snap["deviceBytes"] == 0  # telemetry is host RAM
+    assert snap["totalBytes"] == tot["bytes"]
+
+
+def test_tracer_dump_writes_recent_spans():
+    tr = RecordingTracer()
+    with tr.span("API.Query", index="i"):
+        pass
+    lines = []
+
+    class _Log:
+        def printf(self, fmt, *args):
+            lines.append(fmt % args if args else fmt)
+
+    assert tr.dump(_Log()) == 1
+    assert any("API.Query" in ln for ln in lines)
+
+
 # ---------------------------------------------------------------------------
 # Head sampling (reference SamplerType/SamplerParam, server/config.go:110-118)
 

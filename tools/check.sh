@@ -212,6 +212,63 @@ with tempfile.TemporaryDirectory() as d:
 print("hotspots smoke OK")
 EOF
 
+step "timeline smoke (32-query burst -> /debug/timeline trace-event JSON)"
+JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+import json
+import tempfile
+import urllib.request
+import numpy as np
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+from pilosa_tpu.server import API, serve
+from pilosa_tpu.server.coalescer import QueryCoalescer
+from pilosa_tpu.utils.stats import MemStatsClient
+from pilosa_tpu.utils.timeline import TIMELINE
+from pilosa_tpu.utils.tracing import RecordingTracer
+
+TIMELINE.reset()
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("tls")
+    cols = np.array([1, 2, SHARD_WIDTH + 3], np.uint64)
+    idx.create_field("f").import_bits(np.full(3, 1, np.uint64), cols)
+    idx.add_existence(cols)
+    api = API(h, stats=MemStatsClient(), tracer=RecordingTracer())
+    api.coalescer = QueryCoalescer(api.executor, window_s=0.0005,
+                                   stats=api.stats, tracer=api.tracer)
+    api.coalescer.start()
+    srv = serve(api, "localhost", 0, background=True)
+    base = f"http://localhost:{srv.server_address[1]}"
+    # 32-query burst through the coalesced serving path.
+    for i in range(32):
+        r = urllib.request.urlopen(
+            base + "/index/tls/query",
+            data=f"Count(Row(f={i % 4}))".encode()).read()
+        assert "results" in json.loads(r), r
+    doc = json.loads(urllib.request.urlopen(
+        base + "/debug/timeline?last=16").read())
+    # Chrome trace-event shape: every event carries ph/ts/dur/pid/tid.
+    assert doc["traceEvents"], "no trace events recorded"
+    for ev in doc["traceEvents"]:
+        for k in ("ph", "ts", "dur", "pid", "tid"):
+            assert k in ev, (k, ev)
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    for want in ("queue", "plan", "dispatch", "materialize",
+                 "serialize", "request"):
+        assert want in names, (want, names)
+    s = doc["summary"]
+    assert s["requests"] == 16, s
+    assert 0.0 <= s["deviceIdleRatio"] <= 1.0, s
+    assert s["dispatchGap"]["dispatches"] > 0, s
+    # The idle-ratio gauge and the per-endpoint SLO histograms export.
+    met = urllib.request.urlopen(base + "/metrics").read().decode()
+    assert "pilosa_device_idle_ratio" in met
+    assert "# TYPE pilosa_http_request_seconds histogram" in met
+    assert 'endpoint="/index/{index}/query"' in met
+    srv.shutdown(); srv.server_close(); api.coalescer.stop(); h.close()
+print("timeline smoke OK")
+EOF
+
 step "lock-order runtime check (PILOSA_TPU_LOCK_CHECK=1)"
 PILOSA_TPU_LOCK_CHECK=1 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_coalescer.py tests/test_concurrency.py \
