@@ -139,6 +139,8 @@ class Field:
         self.row_attr_store.open()
         self._row_translator = None  # lazy: only keyed fields pay for one
         if self.options.type == FIELD_TYPE_INT:
+            # graftlint: disable=GL008 — one BSI group per int field
+            # name: schema-keyed, not request-driven.
             self.bsi_groups[name] = BSIGroup(name, self.options.min,
                                              self.options.max)
 
@@ -169,6 +171,10 @@ class Field:
             for name in os.listdir(views_dir):
                 v = self._new_view(name)
                 v.open()
+                # graftlint: disable=GL008 — the view map IS the
+                # field's on-disk contents (standard + time-quantum
+                # views): data-plane state whose lifetime is the
+                # field's, not an accumulator.
                 self.views[name] = v
 
     @property

@@ -89,8 +89,11 @@ class TranslateStore:
     # -- core ---------------------------------------------------------------
 
     def _insert(self, key: str, id_: int, persist: bool = True) -> None:
+        # graftlint: disable=GL008 — the translate store is append-only
+        # BY CONTRACT (ids, once handed out, stay resolvable for the
+        # life of the index; the reference never shrinks it either).
         self._ids[key] = id_
-        self._keys[id_] = key
+        self._keys[id_] = key  # graftlint: disable=GL008 — same contract
         self._next_id = max(self._next_id, id_ + 1)
         if persist and self._file is not None:
             raw = key.encode("utf-8")

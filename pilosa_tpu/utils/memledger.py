@@ -55,7 +55,7 @@ class _Entry:
 
     def __init__(self, category: str, key: Any, nbytes: int,
                  padded: int, meta: Dict[str, Any],
-                 oid: Optional[int] = None):
+                 oid: Optional[int] = None) -> None:
         self.category = category
         self.key = key
         self.nbytes = int(nbytes)
@@ -87,7 +87,7 @@ class MemoryLedger:
 
     TOP_K = 10
 
-    def __init__(self):
+    def __init__(self) -> None:
         self._lock = make_rlock("MemoryLedger._lock")
         self._entries: Dict[Tuple[str, Any], _Entry] = {}
         # category -> [bytes, padded, count]; categories persist at
@@ -143,6 +143,10 @@ class MemoryLedger:
                                      oid)
                 owned.add((category, k))
             old = self._entries.get((category, k))
+            # graftlint: disable=GL008 — closed key space: one entry
+            # per allocation category (bank, jit, telemetry, ...);
+            # totals persist at zero BY DESIGN so /debug/memory shows a
+            # category emptied rather than silently vanishing.
             tot = self._totals.setdefault(category, [0, 0, 0])
             if old is not None:
                 tot[0] -= old.nbytes
@@ -263,7 +267,7 @@ class MemoryLedger:
             "top": self.top(top_k),
         }
 
-    def publish(self, stats) -> None:
+    def publish(self, stats: Optional[Any]) -> None:
         """Export per-category gauges: pilosa_memory_bytes{category},
         pilosa_memory_padding_bytes{category}, pilosa_memory_objects.
         Totals are snapshotted under the lock; the stats client (its
@@ -294,12 +298,14 @@ class MemoryWatchdog:
     `dump()` writes the ring to the log; the server's SIGTERM drain
     calls it so post-mortems always have the last N snapshots."""
 
-    def __init__(self, ledger: MemoryLedger = LEDGER, stats=None,
-                 logger=None, sample_every_s: float = 10.0,
+    def __init__(self, ledger: MemoryLedger = LEDGER,
+                 stats: Optional[Any] = None,
+                 logger: Optional[Any] = None,
+                 sample_every_s: float = 10.0,
                  ring: int = 360, watermark_bytes: int = 0,
                  top_k: int = 5,
                  extra_gauges: Optional[Callable[[], Dict[str, Any]]]
-                 = None):
+                 = None) -> None:
         self.ledger = ledger
         self.stats = stats
         self.logger = logger
@@ -372,7 +378,7 @@ class MemoryWatchdog:
             return
         self._stop.clear()  # restartable after stop()
 
-        def loop():
+        def loop() -> None:
             while not self._stop.wait(self.sample_every_s):
                 try:
                     self.sample_once()
@@ -400,7 +406,7 @@ class MemoryWatchdog:
         with self._ring_lock:
             return list(self._ring)
 
-    def dump(self, logger=None, last: int = 10) -> int:
+    def dump(self, logger: Optional[Any] = None, last: int = 10) -> int:
         """Write the last `last` ring snapshots to the log (the SIGTERM
         post-mortem path). Returns how many were written."""
         logger = logger or self.logger

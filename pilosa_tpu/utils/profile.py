@@ -71,6 +71,10 @@ class ProfileNode:
 
     def child(self, name: str, **attrs: Any) -> "ProfileNode":
         node = ProfileNode(name, **attrs)
+        # graftlint: disable=GL008 — not long-lived state: the tree
+        # lives for ONE query (bounded by its plan size) and only
+        # sampled trees outlive the request, inside the slow-query
+        # ring, which is itself the bound.
         self.children.append(node)
         return node
 
@@ -250,6 +254,10 @@ class QueryProfile:
         """Adopt a remote node's profile fragment (cluster fan-out;
         called from per-node scatter threads)."""
         with self._frag_lock:
+            # graftlint: disable=GL008 — one entry per cluster node,
+            # on an object that lives for ONE query (see ProfileNode:
+            # only sampled profiles outlive the request, inside the
+            # bounded slow-query ring).
             self.node_fragments[node_id] = fragment
 
     def close(self, duration: float, error: Optional[BaseException] = None

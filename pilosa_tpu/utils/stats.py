@@ -12,7 +12,7 @@ import threading
 from pilosa_tpu.utils.locks import make_lock
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 
 class StatsClient:
@@ -49,7 +49,7 @@ class NopStatsClient(StatsClient):
 HISTOGRAM_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
 
-def _le_label(le) -> str:
+def _le_label(le: float) -> str:
     """Prometheus le= label text for one bucket bound: integral bounds
     print as integers (the pow2 size buckets stay "1","2",...); float
     bounds print exactly (repr round-trips)."""
@@ -61,7 +61,8 @@ class MemStatsClient(StatsClient):
     """In-memory stats served at /debug/vars (the reference's expvar
     backend, stats/stats.go:84)."""
 
-    def __init__(self, tags: Optional[Sequence[str]] = None, parent=None):
+    def __init__(self, tags: Optional[Sequence[str]] = None,
+                 parent: Optional["MemStatsClient"] = None) -> None:
         self._parent = parent or self
         self.tags = tuple(tags or ())
         if parent is None:
@@ -85,17 +86,18 @@ class MemStatsClient(StatsClient):
         child = MemStatsClient(tags=self.tags + tags, parent=self._parent)
         return child
 
-    def count(self, name, value=1, rate=1.0):
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
         root = self._parent
         with root._lock:
             root.counters[self._key(name)] += value
 
-    def gauge(self, name, value, rate=1.0):
+    def gauge(self, name: str, value: float, rate: float = 1.0) -> None:
         root = self._parent
         with root._lock:
             root.gauges[self._key(name)] = value
 
-    def histogram(self, name, value, rate=1.0, buckets=None):
+    def histogram(self, name: str, value: float, rate: float = 1.0,
+                  buckets: Optional[Sequence[float]] = None) -> None:
         """One observation into the bucketed histogram for `name`
         (default buckets HISTOGRAM_BUCKETS + +Inf; exported with
         cumulative _bucket/_sum/_count lines by prometheus_text).
@@ -117,12 +119,12 @@ class MemStatsClient(StatsClient):
             h["counts"][i] += 1
             h["sum"] += value
 
-    def set(self, name, value, rate=1.0):
+    def set(self, name: str, value: str, rate: float = 1.0) -> None:
         root = self._parent
         with root._lock:
             root.sets[self._key(name)].add(value)
 
-    def timing(self, name, value, rate=1.0):
+    def timing(self, name: str, value: float, rate: float = 1.0) -> None:
         root = self._parent
         with root._lock:
             vals = root.timings[self._key(name)]
@@ -161,10 +163,10 @@ class MemStatsClient(StatsClient):
 
 
 class MultiStatsClient(StatsClient):
-    def __init__(self, *clients: StatsClient):
+    def __init__(self, *clients: StatsClient) -> None:
         self.clients = clients
 
-    def with_tags(self, *tags):
+    def with_tags(self, *tags: str) -> "MultiStatsClient":
         return MultiStatsClient(*[c.with_tags(*tags) for c in self.clients])
 
     def snapshot(self) -> dict:
@@ -178,37 +180,38 @@ class MultiStatsClient(StatsClient):
             if hasattr(c, "flush"):
                 c.flush()
 
-    def count(self, name, value=1, rate=1.0):
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
         for c in self.clients:
             c.count(name, value, rate)
 
-    def gauge(self, name, value, rate=1.0):
+    def gauge(self, name: str, value: float, rate: float = 1.0) -> None:
         for c in self.clients:
             c.gauge(name, value, rate)
 
-    def histogram(self, name, value, rate=1.0, buckets=None):
+    def histogram(self, name: str, value: float, rate: float = 1.0,
+                  buckets: Optional[Sequence[float]] = None) -> None:
         for c in self.clients:
             c.histogram(name, value, rate, buckets=buckets)
 
-    def set(self, name, value, rate=1.0):
+    def set(self, name: str, value: str, rate: float = 1.0) -> None:
         for c in self.clients:
             c.set(name, value, rate)
 
-    def timing(self, name, value, rate=1.0):
+    def timing(self, name: str, value: float, rate: float = 1.0) -> None:
         for c in self.clients:
             c.timing(name, value, rate)
 
 
 class Timer:
-    def __init__(self, stats: StatsClient, name: str):
+    def __init__(self, stats: StatsClient, name: str) -> None:
         self.stats = stats
         self.name = name
 
-    def __enter__(self):
+    def __enter__(self) -> "Timer":
         self.t0 = time.perf_counter()
         return self
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: object) -> None:
         self.stats.timing(self.name, time.perf_counter() - self.t0)
 
 
@@ -225,7 +228,8 @@ class StatsdStatsClient(StatsClient):
     FLUSH_INTERVAL = 1.0
 
     def __init__(self, host: str, tags: Optional[Sequence[str]] = None,
-                 logger=None, _shared=None):
+                 logger: Optional[Any] = None,
+                 _shared: Optional[Dict[str, Any]] = None) -> None:
         import socket
 
         self.tags = tuple(tags or ())
@@ -315,33 +319,34 @@ class StatsdStatsClient(StatsClient):
                 pass
 
     @staticmethod
-    def _num(value) -> str:
+    def _num(value: float) -> str:
         """Exact decimal formatting: integral values print as integers
         (no %g 6-digit truncation, no exponent notation that non-DataDog
         statsd servers may reject)."""
         f = float(value)
         return str(int(f)) if f.is_integer() else repr(f)
 
-    def count(self, name, value=1, rate=1.0):
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
         self._emit(name, f"{int(value)}|c", rate)
 
-    def gauge(self, name, value, rate=1.0):
+    def gauge(self, name: str, value: float, rate: float = 1.0) -> None:
         self._emit(name, f"{self._num(value)}|g", rate)
 
-    def histogram(self, name, value, rate=1.0, buckets=None):
+    def histogram(self, name: str, value: float, rate: float = 1.0,
+                  buckets: Optional[Sequence[float]] = None) -> None:
         # statsd histograms are server-side bucketed; `buckets` is a
         # MemStatsClient concern and is ignored on the wire.
         self._emit(name, f"{self._num(value)}|h", rate)
 
-    def set(self, name, value, rate=1.0):
+    def set(self, name: str, value: str, rate: float = 1.0) -> None:
         self._emit(name, f"{value}|s", rate)
 
-    def timing(self, name, value, rate=1.0):
+    def timing(self, name: str, value: float, rate: float = 1.0) -> None:
         # seconds -> ms, the statsd timing unit.
         self._emit(name, f"{self._num(value * 1000.0)}|ms", rate)
 
 
-def prometheus_text(stats) -> str:
+def prometheus_text(stats: object) -> str:
     """Prometheus text exposition (v0.0.4) of a snapshot()-capable stats
     client — the modern pull-based complement to /debug/vars and the
     statsd push backend (reference metric backends, stats/stats.go:84,
@@ -353,7 +358,7 @@ def prometheus_text(stats) -> str:
     def clean(name: str) -> str:
         return _re.sub(r"[^a-zA-Z0-9_:]", "_", name)
 
-    def split_key(k: str):
+    def split_key(k: str) -> "tuple[str, str]":
         """'name{tag1,k:v}' (MemStatsClient._key) -> (name, labelstr):
         tags become proper Prometheus labels, never part of the metric
         name (tag values must not explode name cardinality)."""
@@ -386,7 +391,7 @@ def prometheus_text(stats) -> str:
     families: Dict[str, List[str]] = {}
     order: List[str] = []
 
-    def emit(name: str, typ: str, sample_lines):
+    def emit(name: str, typ: str, sample_lines: List[str]) -> None:
         group = families.get(name)
         if group is None:
             group = families[name] = [f"# TYPE {name} {typ}"]

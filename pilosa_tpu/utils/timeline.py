@@ -88,7 +88,7 @@ class _TimelineRequest:
     __slots__ = ("trace_id", "index", "seq", "t0_wall", "t0_pc",
                  "events", "dropped", "error")
 
-    def __init__(self, trace_id: str, index: str, seq: int):
+    def __init__(self, trace_id: str, index: str, seq: int) -> None:
         self.trace_id = trace_id
         self.index = index
         self.seq = seq
@@ -123,7 +123,8 @@ class TimelineRecorder:
     EVENT_NBYTES = 120
 
     def __init__(self, ring: int = 256, sample_every: int = 1,
-                 gap_window_s: float = 60.0, max_dispatches: int = 4096):
+                 gap_window_s: float = 60.0,
+                 max_dispatches: int = 4096) -> None:
         self.enabled = True
         self.sample_every = max(1, int(sample_every))
         self.gap_window_s = max(0.001, float(gap_window_s))
@@ -389,7 +390,7 @@ class TimelineRecorder:
             n_reqs = len(self._ring)
         return n_events * self.EVENT_NBYTES + n_reqs * 160
 
-    def register_memory(self, ledger=None) -> None:
+    def register_memory(self, ledger: Optional[Any] = None) -> None:
         """Register the ring's bytes with the memory ledger (category
         ``telemetry``) so /debug/memory totals stay provable."""
         if ledger is None:
@@ -398,7 +399,7 @@ class TimelineRecorder:
                         owner=self, kind="timeline",
                         entries=self.ring_count())
 
-    def publish(self, stats) -> None:
+    def publish(self, stats: Optional[Any]) -> None:
         """Export the dispatch-gap gauges: ``pilosa_device_idle_ratio``
         plus the dispatch counter the ratio derives from."""
         if stats is None:
@@ -407,7 +408,7 @@ class TimelineRecorder:
         stats.gauge("device_idle_ratio", gap["idleRatio"])
         stats.gauge("timeline_window_dispatches", gap["dispatches"])
 
-    def dump(self, logger, last: int = 5) -> int:
+    def dump(self, logger: Optional[Any], last: int = 5) -> int:
         """Write the most recent `last` request timelines to the log —
         the SIGTERM drain calls this so buffered timelines survive a
         graceful shutdown. Returns records written."""

@@ -18,7 +18,7 @@ import threading
 from pilosa_tpu.utils.locks import make_lock
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 # W3C Trace Context (https://www.w3.org/TR/trace-context/): the
 # header every OTel-aware proxy/collector understands, so traces stay
@@ -77,7 +77,7 @@ class Span:
     __slots__ = ("name", "trace_id", "span_id", "start", "end",
                  "pc_start", "pc_end", "attrs", "children")
 
-    def __init__(self, name: str, trace_id: str, attrs: dict):
+    def __init__(self, name: str, trace_id: str, attrs: dict) -> None:
         self.name = name
         self.trace_id = trace_id
         self.span_id = uuid.uuid4().hex[:16]
@@ -109,7 +109,7 @@ class Span:
             n += c.nbytes()
         return n
 
-    def set(self, key: str, value) -> None:
+    def set(self, key: str, value: Any) -> None:
         """Annotate an open span with a value only known mid-span (e.g.
         the coalescer flush's post-dedup unique-query count) — the
         opentracing Span.SetTag analog the reference uses on its query
@@ -119,13 +119,13 @@ class Span:
 
 class NopTracer:
     @contextlib.contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, **attrs: Any) -> Iterator[Optional[Span]]:
         yield None
 
     def inject(self, headers: Dict[str, str]) -> None:
         pass
 
-    def extract(self, headers) -> None:
+    def extract(self, headers: Dict[str, str]) -> None:
         pass
 
 
@@ -133,7 +133,7 @@ class RecordingTracer:
     """Keeps the last `keep` finished root spans for inspection (the
     in-process analog of the reference's Jaeger wiring)."""
 
-    def __init__(self, keep: int = 128):
+    def __init__(self, keep: int = 128) -> None:
         self.keep = keep
         self.finished: List[Span] = []
         self._local = threading.local()
@@ -149,7 +149,7 @@ class RecordingTracer:
         return self._local.stack
 
     @contextlib.contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, **attrs: Any) -> Iterator[Span]:
         stack = self._stack()
         trace_id = stack[0].trace_id if stack \
             else getattr(self._local, "trace_id", None) or uuid.uuid4().hex
@@ -205,7 +205,7 @@ class RecordingTracer:
         inject the same trace the request arrived under)."""
         self._local.trace_id = trace_id
 
-    def extract(self, headers) -> None:
+    def extract(self, headers: Dict[str, str]) -> None:
         """Adopt an incoming trace context: W3C traceparent first, the
         legacy X-Trace-Id spelling as a fallback (accepted for one
         release so mixed-version clusters keep correlating). A request
@@ -249,7 +249,7 @@ class RecordingTracer:
         with self._lock:
             return max(0, self._ring_bytes)
 
-    def register_memory(self, ledger=None) -> None:
+    def register_memory(self, ledger: Optional[Any] = None) -> None:
         """Register the finished-span ring with the memory ledger
         (category ``telemetry``) so /debug/memory totals stay provable."""
         if ledger is None:
@@ -260,7 +260,7 @@ class RecordingTracer:
         ledger.register("telemetry", "tracer_ring", nbytes, owner=self,
                         kind="tracer", entries=count)
 
-    def dump(self, logger, last: int = 10) -> int:
+    def dump(self, logger: Optional[Any], last: int = 10) -> int:
         """Write the most recent `last` finished root spans to the log
         (the SIGTERM drain path — buffered spans that never exported
         still leave evidence). Returns spans written."""
@@ -296,7 +296,7 @@ def spans_to_otlp(spans: List[Span], service_name: str) -> dict:
     flat = []
 
     def walk(span: Span, parent_id: str, anchor_wall: float,
-             anchor_pc: float):
+             anchor_pc: float) -> None:
         # One wall-clock anchor PER TRACE (the root span's): every
         # descendant's export timestamps are monotonic offsets from it,
         # so an NTP step mid-trace shifts nothing within the trace.
@@ -339,8 +339,10 @@ class ExportingTracer(RecordingTracer):
 
     def __init__(self, endpoint: str, service_name: str = "pilosa-tpu",
                  keep: int = 128, batch_size: int = 64,
-                 flush_interval: float = 5.0, logger=None,
-                 sampler_type: str = "const", sampler_param: float = 1.0):
+                 flush_interval: float = 5.0,
+                 logger: Optional[Any] = None,
+                 sampler_type: str = "const",
+                 sampler_param: float = 1.0) -> None:
         super().__init__(keep=keep)
         self.endpoint = endpoint
         self.service_name = service_name
@@ -395,7 +397,7 @@ class ExportingTracer(RecordingTracer):
             return False
 
     @contextlib.contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, **attrs: Any) -> Iterator[Optional[Span]]:
         stack = self._stack()
         s = None  # super().span may raise before yielding (ADVICE r3)
         try:
@@ -443,7 +445,7 @@ class ExportingTracer(RecordingTracer):
         if self._thread is not None:
             return
 
-        def loop():
+        def loop() -> None:
             while not self._stop.is_set():
                 self._wake.wait(self.flush_interval)
                 self._wake.clear()

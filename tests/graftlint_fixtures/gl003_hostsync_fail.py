@@ -15,3 +15,13 @@ def leaky_count(words):
 def leaky_item(words):
     s = jnp.sum(words)
     return s.item()                 # device->host scalar
+
+
+def leaky_closure(words, register_callback):
+    # The callback closes over `total`, which is only device-tainted
+    # AFTER the def — closures see the final binding, so the .item()
+    # inside is still a device sync (end-of-scope taint inheritance).
+    def cb():
+        return total.item()
+    total = jnp.sum(words)
+    register_callback(cb)

@@ -1,0 +1,13 @@
+"""Suppression-interplay fixture: a `disable=GL007` on a line that
+ALSO violates GL008 must silence only GL007 — suppressions are
+(rule, line)-keyed, not line-keyed."""
+import jax.numpy as jnp
+
+
+class InterplayHolder:
+    def __init__(self):
+        self._buf = None
+        self._log = []
+
+    def stage(self, words, key):
+        self._buf = jnp.asarray(words); self._log.append(key)  # graftlint: disable=GL007

@@ -1,0 +1,22 @@
+"""GL007 fail fixture: device arrays parked on instance state with no
+path to a LEDGER.register — /debug/memory totals go dark for them."""
+import jax.numpy as jnp
+
+
+class BankHolder:
+    def __init__(self):
+        self._bank = None
+        self._scratch = None
+
+    def cache_bank(self, words):
+        # Direct store, no registration anywhere in this class.
+        self._bank = jnp.asarray(words)
+
+    def stage(self, words):
+        # Helper indirection must NOT satisfy the rule when the helper
+        # never registers either.
+        self._scratch = jnp.zeros((4, 8))
+        self._note()
+
+    def _note(self):
+        return "noted, but never registered"

@@ -1,0 +1,33 @@
+"""GL007 pass fixture: every long-lived device store reaches a ledger
+registration — directly, through helper indirection (the call graph
+follows it), or is annotated transient."""
+import jax.numpy as jnp
+
+from pilosa_tpu.utils.memledger import LEDGER
+
+
+class RegisteredHolder:
+    def __init__(self):
+        self._bank = None
+        self._positions = None
+        self._tmp = None
+
+    def cache_bank(self, words):
+        # Direct registration in the assigning function.
+        self._bank = jnp.asarray(words)
+        LEDGER.register("bank", "fixture", int(self._bank.nbytes))
+
+    def cache_positions(self, pos):
+        # Registration via helper indirection: the interprocedural
+        # call graph follows cache_positions -> _install.
+        self._positions = jnp.asarray(pos)
+        self._install("positions", self._positions)
+
+    def _install(self, key, arr):
+        LEDGER.register("bank", key, int(arr.nbytes))
+
+    def stage_scratch(self, words):
+        # graftlint: transient — replaced within the same request;
+        # never outlives the call that stages it.
+        self._tmp = jnp.asarray(words)
+        return self._tmp

@@ -1,0 +1,20 @@
+"""GL008 fail fixture: long-lived accumulators with no bound in scope
+— the quiet-leak shape (raw `self._seen[key] = v` on a request path)."""
+
+
+class LeakyRecorder:
+    def __init__(self):
+        self._seen = {}
+        self._events = []
+        self._ids = set()
+
+    def observe(self, key, value):
+        # Dict grows per request key: no eviction, cap, ring, or reset
+        # anywhere in the class.
+        self._seen[key] = value
+
+    def log(self, event):
+        self._events.append(event)
+
+    def mark(self, rid):
+        self._ids.add(rid)

@@ -1,0 +1,42 @@
+"""GL008 pass fixture: every growing container shows a bound — ring
+(deque maxlen), LRU eviction, len() cap, fixed literal keys, a
+draining AugAssign, or a reset path."""
+from collections import OrderedDict, deque
+
+
+class BoundedRecorder:
+    def __init__(self):
+        self._ring = deque(maxlen=256)
+        self._lru = OrderedDict()
+        self._capped = {}
+        self._totals = {}
+        self._dirty = set()
+        self._batch = []
+
+    def observe(self, key, value):
+        self._ring.append((key, value))
+        self._lru[key] = value
+        while len(self._lru) > 128:
+            self._lru.popitem(last=False)
+
+    def admit(self, key, value):
+        if len(self._capped) < 64:
+            self._capped[key] = value
+
+    def count(self, n):
+        # Literal subscript keys cannot grow past the number of
+        # distinct literals in the source: a fixed-field record.
+        self._totals["reads"] = self._totals.get("reads", 0) + n
+
+    def stage(self, items):
+        self._dirty |= items
+        self._batch.append(items)
+
+    def drain(self):
+        consumed = set(self._dirty)
+        self._dirty -= consumed
+        return consumed
+
+    def flush(self):
+        out, self._batch = self._batch, []
+        return out

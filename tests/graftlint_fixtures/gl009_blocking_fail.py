@@ -1,0 +1,40 @@
+"""GL009 fail fixture: blocking work under a lock — directly in the
+`with` body, and through a helper the call graph resolves."""
+import subprocess
+import time
+from urllib.request import urlopen
+
+from pilosa_tpu.utils.locks import make_lock
+
+
+class ConvoyedSender:
+    def __init__(self):
+        self._lock = make_lock("ConvoyedSender._lock")
+        self._peers = []
+
+    def deliver(self, msg):
+        with self._lock:
+            # Direct: sleeping while every other sender waits.
+            time.sleep(0.5)
+            self._peers.append(msg)
+
+    def push(self, uri, payload):
+        with self._lock:
+            # Transitive: _post blocks on network I/O.
+            self._post(uri, payload)
+
+    def _post(self, uri, payload):
+        return urlopen(uri, data=payload).read()
+
+    def rebuild(self):
+        with self._lock:
+            # Transitive: a child process wait under the lock.
+            self._make()
+
+    def _make(self):
+        return subprocess.run(["make"], capture_output=True)
+
+    def finish(self, worker):
+        with self._lock:
+            # Direct: joining a thread while holding the lock.
+            worker.join()

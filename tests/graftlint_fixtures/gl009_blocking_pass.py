@@ -1,0 +1,37 @@
+"""GL009 pass fixture: the snapshot-under-the-lock / block-after
+pattern, plus the call shapes that LOOK like sinks but are not
+(str.join, os.path.join, Condition.wait)."""
+import os
+import time
+from urllib.request import urlopen
+
+from pilosa_tpu.utils.locks import make_condition, make_lock
+
+
+class PoliteSender:
+    def __init__(self):
+        self._lock = make_lock("PoliteSender._lock")
+        self._cond = make_condition("PoliteSender._cond")
+        self._pending = []
+
+    def deliver(self, uri):
+        with self._lock:
+            batch = list(self._pending)
+            del self._pending[:]
+        # Blocking work happens AFTER the lock is released.
+        for msg in batch:
+            urlopen(uri, data=msg).read()
+        time.sleep(0.01)
+
+    def describe(self, parts):
+        with self._lock:
+            # str.join / os.path.join are not thread joins.
+            label = ", ".join(parts)
+            return os.path.join("/tmp", label)
+
+    def await_work(self):
+        with self._cond:
+            # Condition.wait RELEASES the lock it waits on — lock-order
+            # business (GL002), not a blocking hazard.
+            self._cond.wait(timeout=1.0)
+            return list(self._pending)

@@ -1,0 +1,32 @@
+"""GL010 fail fixture: open/close effect pairs balanced only on the
+fall-through path — one raise between them leaks the effect."""
+from pilosa_tpu.utils.memledger import LEDGER
+from pilosa_tpu.utils.stats import MemStatsClient
+from pilosa_tpu.utils.timeline import TIMELINE
+
+STATS = MemStatsClient()
+
+
+def risky(payload):
+    return payload["key"]
+
+
+def ledger_pair(arr):
+    LEDGER.register("bank", "k", int(arr.nbytes))
+    out = risky(arr)  # a raise here orphans the ledger row
+    LEDGER.unregister("bank", "k")
+    return out
+
+
+def timeline_pair(payload):
+    handle = TIMELINE.begin("req")
+    out = risky(payload)  # a raise leaves the timeline open forever
+    TIMELINE.finish(handle)
+    return out
+
+
+def gauge_pair(payload):
+    STATS.inc("inflight")
+    out = risky(payload)  # a raise leaves the gauge high for good
+    STATS.dec("inflight")
+    return out

@@ -1,0 +1,49 @@
+"""GL010 pass fixture: exception-safe closers — try/finally, a context
+manager on the opener, weakref.finalize, and the evict-then-install
+idiom (closer BEFORE opener is not a bracket)."""
+import weakref
+
+from pilosa_tpu.utils.memledger import LEDGER
+from pilosa_tpu.utils.stats import MemStatsClient
+from pilosa_tpu.utils.timeline import TIMELINE
+
+STATS = MemStatsClient()
+
+
+def risky(payload):
+    return payload["key"]
+
+
+def ledger_pair_finally(arr):
+    LEDGER.register("bank", "k", int(arr.nbytes))
+    try:
+        return risky(arr)
+    finally:
+        LEDGER.unregister("bank", "k")
+
+
+def timeline_pair_cm(payload):
+    with TIMELINE.begin("req"):
+        return risky(payload)
+
+
+def gauge_pair_finally(payload):
+    STATS.inc("inflight")
+    try:
+        return risky(payload)
+    finally:
+        STATS.dec("inflight")
+
+
+def ledger_pair_finalized(owner, arr):
+    LEDGER.register("bank", "k", int(arr.nbytes))
+    weakref.finalize(owner, LEDGER.unregister, "bank", "k")
+    return owner
+
+
+def evict_then_install(arr):
+    # unregister BEFORE register: the cache-replacement idiom, not an
+    # open/close bracket (nothing to balance on the exception edge).
+    LEDGER.unregister("bank", "old")
+    LEDGER.register("bank", "new", int(arr.nbytes))
+    return arr

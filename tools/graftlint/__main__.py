@@ -1,28 +1,68 @@
 """CLI: ``python -m tools.graftlint [paths...]``.
 
-Exit status: 0 clean, 1 findings, 2 usage/parse error.
+Exit status: 0 clean (baselined-only findings are clean), 1 fresh
+findings, 2 usage/parse error.
+
+CI surface:
+
+- ``--changed [BASE]`` — full-tree analysis, findings reported only in
+  files changed since ``git merge-base HEAD BASE`` (default: main);
+  the fast pre-push mode tools/check.sh --fast runs.
+- ``--format=sarif`` — emit a SARIF 2.1.0 document instead of text;
+  with ``--output FILE`` the document goes to the file and the human
+  text still goes to stdout (one run feeds both the gate log and the
+  CI artifact).
+- ``--baseline FILE`` / ``--write-baseline`` — known-debt ratchet; see
+  tools/graftlint/baseline.py and docs/development.md.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
+from tools.graftlint import baseline as baseline_mod
+from tools.graftlint import sarif as sarif_mod
+from tools.graftlint.diffmode import changed_files
 from tools.graftlint.engine import Config
 from tools.graftlint.runner import lint_paths
 from tools.graftlint.rules import ALL_RULES
+
+DEFAULT_PATHS = ["pilosa_tpu", "tests", "benches", "tools"]
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="graftlint",
-        description="pilosa_tpu project lints: concurrency discipline "
-                    "and TPU hot-path invariants (GL001-GL005)")
-    ap.add_argument("paths", nargs="*", default=["pilosa_tpu", "tests"],
-                    help="files or directories (default: pilosa_tpu "
-                         "tests)")
+        description="pilosa_tpu project lints: concurrency discipline, "
+                    "TPU hot-path invariants, and resource/effect "
+                    "analysis (GL001-GL010)")
+    ap.add_argument("paths", nargs="*", default=DEFAULT_PATHS,
+                    help="files or directories (default: "
+                         f"{' '.join(DEFAULT_PATHS)})")
     ap.add_argument("--select", help="comma-separated rule codes to run")
     ap.add_argument("--ignore", help="comma-separated rule codes to skip")
+    ap.add_argument("--changed", nargs="?", const="main", default=None,
+                    metavar="BASE",
+                    help="report findings only in files changed since "
+                         "the merge-base with BASE (default: main); "
+                         "the whole tree is still analyzed")
+    ap.add_argument("--format", choices=("text", "sarif"),
+                    default="text", dest="fmt",
+                    help="findings output format (default: text)")
+    ap.add_argument("--output", metavar="FILE",
+                    help="write the formatted findings to FILE; with "
+                         "--format=sarif the human text still prints "
+                         "to stdout")
+    ap.add_argument("--baseline", metavar="FILE",
+                    default=baseline_mod.DEFAULT_PATH,
+                    help="known-debt baseline file (default: "
+                         "tools/graftlint/baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="REGENERATE the baseline from the current "
+                         "findings (explicit, reviewed action) and "
+                         "exit 0")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -40,17 +80,79 @@ def main(argv=None) -> int:
     if args.ignore:
         cfg.ignore = {c.strip() for c in args.ignore.split(",")}
     try:
-        findings = lint_paths(args.paths or ["pilosa_tpu", "tests"], cfg)
+        findings = lint_paths(args.paths or DEFAULT_PATHS, cfg)
     except SyntaxError as e:
         print(f"graftlint: parse error: {e}", file=sys.stderr)
         return 2
-    for f in findings:
-        print(f.format())
-    n = len(findings)
+
+    if args.write_baseline and args.changed is not None:
+        # A baseline regenerated from a FILTERED finding set would
+        # silently drop every entry outside the diff.
+        print("graftlint: --write-baseline requires a full-tree run; "
+              "drop --changed", file=sys.stderr)
+        return 2
+
+    filtered = False
+    if args.changed is not None:
+        changed = changed_files(args.changed)
+        if changed is None:
+            print(f"graftlint: --changed: cannot resolve merge-base "
+                  f"with {args.changed!r}; falling back to the full "
+                  f"tree", file=sys.stderr)
+        else:
+            findings = [f for f in findings if f.path in changed]
+            filtered = True
+
+    if args.write_baseline:
+        n = baseline_mod.write(findings, args.baseline)
+        print(f"graftlint: wrote {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} to {args.baseline}")
+        return 0
+
+    fresh, baselined, stale = baseline_mod.apply(
+        findings, baseline_mod.load(args.baseline))
+    if filtered:
+        # Staleness cannot be judged against a diff-filtered finding
+        # set: an entry for an unchanged file matches nothing here yet
+        # its debt still exists. Only full-tree runs report it.
+        stale = []
+
+    if args.fmt == "sarif":
+        doc = sarif_mod.document(fresh, baselined, ALL_RULES)
+        text = json.dumps(doc, indent=2)
+        if args.output:
+            with open(args.output, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+            for f2 in fresh:
+                print(f2.format())
+        else:
+            print(text)
+    else:
+        lines = [f.format() for f in fresh]
+        if args.output:
+            with open(args.output, "w", encoding="utf-8") as f:
+                f.write("".join(ln + "\n" for ln in lines))
+        for ln in lines:
+            print(ln)
+
+    notes = []
+    if baselined:
+        notes.append(f"{len(baselined)} baselined")
+    if stale:
+        notes.append(f"{len(stale)} stale baseline entr"
+                     f"{'y' if len(stale) == 1 else 'ies'} — "
+                     f"regenerate with --write-baseline")
+    suffix = f" ({'; '.join(notes)})" if notes else ""
+    # With SARIF on stdout, the summary moves to stderr so the
+    # document stays parseable when piped.
+    dest = sys.stderr if (args.fmt == "sarif" and not args.output) \
+        else sys.stdout
+    n = len(fresh)
     if n:
-        print(f"graftlint: {n} finding{'s' if n != 1 else ''}")
+        print(f"graftlint: {n} finding{'s' if n != 1 else ''}{suffix}",
+              file=dest)
         return 1
-    print("graftlint: clean")
+    print(f"graftlint: clean{suffix}", file=dest)
     return 0
 
 

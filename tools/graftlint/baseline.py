@@ -1,0 +1,89 @@
+"""Committed findings baseline.
+
+A baseline lets a NEW rule land without blocking CI on legacy findings:
+the known debt is captured in a committed JSON file, reported as
+"baselined" (never as failures), and burned down in follow-up PRs. The
+shipped tree keeps an EMPTY baseline — tests/test_graftlint.py asserts
+it — so the file is a ratchet, not a dumping ground.
+
+Matching is line-agnostic on (path, code, message) with multiset
+semantics: unrelated edits that shift line numbers do not invalidate
+entries, but each entry absorbs at most one finding, so a duplicated
+violation still fails. Entries that no longer match anything are
+reported as stale (the debt was paid; regenerate to drop them).
+
+Regenerating (``--write-baseline``) is an explicit, reviewed action:
+the diff of tools/graftlint/baseline.json IS the review surface — see
+docs/development.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from tools.graftlint.engine import Finding
+
+DEFAULT_PATH = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+_Key = Tuple[str, str, str]
+
+
+def _key(entry: Dict[str, str]) -> _Key:
+    return (entry["path"], entry["code"], entry["message"])
+
+
+def load(path: str = DEFAULT_PATH) -> List[Dict[str, str]]:
+    """The baseline entries; [] when the file is absent."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    return list(doc.get("findings", []))
+
+
+def write(findings: List[Finding], path: str = DEFAULT_PATH) -> int:
+    """Overwrite the baseline with the given findings; returns the
+    entry count."""
+    doc = {
+        "comment": "graftlint known-debt baseline — regenerate ONLY "
+                   "via `python -m tools.graftlint --write-baseline` "
+                   "and review the diff (docs/development.md)",
+        "findings": [
+            {"path": f.path, "code": f.code, "message": f.message}
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return len(doc["findings"])
+
+
+def apply(findings: List[Finding], entries: List[Dict[str, str]],
+          ) -> Tuple[List[Finding], List[Finding], List[Dict[str, str]]]:
+    """Split `findings` against the baseline.
+
+    Returns (fresh, baselined, stale_entries): `fresh` fail the run,
+    `baselined` are known debt, `stale_entries` matched nothing (paid
+    down — regenerate to drop them)."""
+    budget: Dict[_Key, int] = {}
+    for e in entries:
+        budget[_key(e)] = budget.get(_key(e), 0) + 1
+    fresh: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in findings:
+        k = (f.path, f.code, f.message)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            baselined.append(f)
+        else:
+            fresh.append(f)
+    stale = []
+    for e in entries:
+        k = _key(e)
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            stale.append(e)
+    return fresh, baselined, stale

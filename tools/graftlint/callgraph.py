@@ -1,0 +1,147 @@
+"""Shared interprocedural call graph.
+
+Built ONCE per run from the semantic model and reused by every rule
+that follows calls: GL002 (may-acquire fixpoint), GL006 (transitive
+``_note_jit_compile`` reachability), GL007 (ledger registration
+through helper indirection), GL009 (blocking calls reachable from a
+``with <lock>`` body).
+
+Resolution is the conservative scheme GL002 pioneered, lifted here so
+every rule shares one answer to "what might this call reach":
+
+- ``self.m(...)`` resolves within the caller's class;
+- ``x.m(...)`` resolves only when exactly ONE project class defines
+  ``m`` (ambiguous names contribute no edge) and ``m`` is not a
+  builtin container/file method name;
+- bare ``f(...)`` resolves to a module-level function of the caller's
+  own module.
+
+Unresolvable calls contribute no edges: every derived property
+under-approximates, which is the correct direction for rules that must
+never invent a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import walk_shallow
+from tools.graftlint.model import FuncInfo, Model
+
+
+class CallGraph:
+    """funcs: unique FuncInfos; callees/call_sites: the resolvable
+    edges out of each function (keyed by qualname)."""
+
+    def __init__(self, model: Model):
+        self.model = model
+        self.funcs: List[FuncInfo] = list(
+            {id(fi): fi for fi in model.funcs.values()}.values())
+        self.by_qualname: Dict[str, FuncInfo] = {
+            fi.qualname: fi for fi in self.funcs}
+        self.callees: Dict[str, Set[str]] = {}
+        # qualname -> [(Call node, callee FuncInfo)] for provenance.
+        self.call_sites: Dict[str, List[Tuple[ast.Call, FuncInfo]]] = {}
+        for fi in self.funcs:
+            outs: Set[str] = set()
+            sites: List[Tuple[ast.Call, FuncInfo]] = []
+            for node in walk_shallow(fi.node):
+                if isinstance(node, ast.Call):
+                    callee = self.resolve_call(node, fi)
+                    if callee is not None:
+                        outs.add(callee.qualname)
+                        sites.append((node, callee))
+            self.callees[fi.qualname] = outs
+            self.call_sites[fi.qualname] = sites
+        # Per-run memo for derived project-global closures (reaches()
+        # results, lookup tables): rules run check_file once per FILE,
+        # and recomputing an O(total-functions) closure each time would
+        # make the run quadratic. Keyed by rule-chosen name; lives
+        # exactly as long as this graph (one Project run).
+        self._memo: dict = {}
+
+    def memo(self, key: str, build: Callable[[], object]) -> object:
+        hit = self._memo.get(key)
+        if hit is None:
+            hit = self._memo[key] = build()
+        return hit
+
+    # ------------------------------------------------------- resolution
+
+    def resolve_call(self, call: ast.Call,
+                     fi: FuncInfo) -> Optional[FuncInfo]:
+        """Conservative single-target resolution (see module doc)."""
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                return self.model.resolve_method(f.attr, cls=fi.cls)
+            return self.model.resolve_method(f.attr)
+        if isinstance(f, ast.Name):
+            cand = self.model.funcs.get(f.id)
+            if cand is not None and cand.cls is None \
+                    and cand.module == fi.module:
+                return cand
+        return None
+
+    # --------------------------------------------------------- closures
+
+    def transitive_closure(
+            self, direct: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+        """Fixpoint: each function's set grows by the sets of its
+        resolvable callees. `direct` maps qualname -> seed set; missing
+        functions seed empty."""
+        may = {fi.qualname: set(direct.get(fi.qualname, ()))
+               for fi in self.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for q, outs in self.callees.items():
+                cur = may[q]
+                before = len(cur)
+                for callee in outs:
+                    cur |= may.get(callee, set())
+                changed = changed or len(cur) != before
+        return may
+
+    def reaches(self, pred: Callable[[FuncInfo], bool]) -> Set[str]:
+        """Qualnames of every function that satisfies `pred` itself or
+        transitively calls one that does."""
+        hit = {fi.qualname for fi in self.funcs if pred(fi)}
+        changed = True
+        while changed:
+            changed = False
+            for q, outs in self.callees.items():
+                if q not in hit and outs & hit:
+                    hit.add(q)
+                    changed = True
+        return hit
+
+    def first_witness(
+            self, qualname: str, target: Set[str],
+            limit: int = 20) -> Optional[List[str]]:
+        """A short call chain (qualnames) from `qualname` to any member
+        of `target`, for finding provenance; None when unreachable."""
+        if qualname in target:
+            return [qualname]
+        seen = {qualname}
+        frontier: List[List[str]] = [[qualname]]
+        for _ in range(limit):
+            nxt: List[List[str]] = []
+            for path in frontier:
+                for callee in sorted(self.callees.get(path[-1], ())):
+                    if callee in seen:
+                        continue
+                    seen.add(callee)
+                    p = path + [callee]
+                    if callee in target:
+                        return p
+                    nxt.append(p)
+            if not nxt:
+                return None
+            frontier = nxt
+        return None
+
+    def iter_calls(self, fi: FuncInfo) -> Iterable[
+            Tuple[ast.Call, FuncInfo]]:
+        return self.call_sites.get(fi.qualname, ())

@@ -1,0 +1,61 @@
+"""``--changed`` diff mode: report findings only in files touched
+since the merge-base with a base branch.
+
+The WHOLE project is still parsed — cross-file rules (lock-order
+cycles, call-graph closures) need whole-program context to stay sound
+— but only findings whose file changed are reported. That makes the
+fast pre-push loop O(diff) in attention while staying O(tree) in
+analysis, with no soundness cliff.
+
+Changed = ``git diff --name-only $(git merge-base HEAD <base>)``
+(committed, staged, and working-tree edits alike) plus untracked
+files. When the base ref does not exist (fresh clone of a feature
+branch), ``origin/<base>`` is tried before giving up.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import List, Optional, Set
+
+
+def _git(args: List[str], cwd: str) -> Optional[str]:
+    try:
+        out = subprocess.run(
+            ["git", *args], cwd=cwd, capture_output=True, text=True)
+    except OSError:
+        return None
+    if out.returncode != 0:
+        return None
+    return out.stdout
+
+
+def changed_files(base: str = "main",
+                  cwd: str = ".") -> Optional[Set[str]]:
+    """Paths (relative to `cwd`, '/'-separated) changed since the
+    merge-base with `base`, plus untracked files; None when git or the
+    base ref is unavailable (caller falls back to a full scan)."""
+    top = _git(["rev-parse", "--show-toplevel"], cwd)
+    if top is None:
+        return None
+    top = top.strip()
+    mb = _git(["merge-base", "HEAD", base], cwd)
+    if mb is None:
+        mb = _git(["merge-base", "HEAD", f"origin/{base}"], cwd)
+    if mb is None:
+        return None
+    diff = _git(["diff", "--name-only", mb.strip()], cwd)
+    untracked = _git(
+        ["ls-files", "--others", "--exclude-standard"], cwd)
+    if diff is None:
+        return None
+    names = diff.splitlines() + (untracked or "").splitlines()
+    out: Set[str] = set()
+    for name in names:
+        if not name:
+            continue
+        rel = os.path.relpath(
+            os.path.join(top, name), os.path.abspath(cwd))
+        out.add(rel.replace("\\", "/"))
+    return out

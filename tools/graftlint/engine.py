@@ -17,6 +17,9 @@ literals can't fake them):
   ``lambda`` line: marks the function as an explicit
   result-materialization point, exempt from GL003's host-sync rule.
   See docs/development.md for when this is acceptable.
+- ``# graftlint: transient`` — on (or directly above) an assignment
+  line: marks a device array stored on instance/module state as
+  genuinely short-lived, exempt from GL007's ledger-coverage rule.
 """
 
 from __future__ import annotations
@@ -33,6 +36,7 @@ _DISABLE_RE = re.compile(
 _DISABLE_FILE_RE = re.compile(
     r"#\s*graftlint:\s*disable-file=([A-Z0-9_,\s]+)")
 _MATERIALIZE_RE = re.compile(r"#\s*graftlint:\s*materialize\b")
+_TRANSIENT_RE = re.compile(r"#\s*graftlint:\s*transient\b")
 
 
 @dataclass(frozen=True)
@@ -75,6 +79,23 @@ class Config:
     # enclosing function) — an untracked site is a blind spot for the
     # pilosa_executor_retrace series and /debug/queries.
     jit_tracked_paths: Tuple[str, ...] = ("pilosa_tpu/",)
+    # GL007: packages where a device array stored on long-lived
+    # instance/module state must reach LEDGER.register on every path
+    # (so /debug/memory totals stay provable).
+    ledger_paths: Tuple[str, ...] = ("pilosa_tpu/",)
+    # GL008: packages where instance/module-level containers that grow
+    # on request-driven paths must show eviction, a cap, or a ring
+    # bound in scope.
+    growth_paths: Tuple[str, ...] = ("pilosa_tpu/",)
+    # GL009: packages where no blocking call (sleep, socket/HTTP,
+    # thread join, subprocess, device sync) may run while a lock is
+    # held — directly in the `with <lock>` body or in any function
+    # transitively reachable from one.
+    lock_block_paths: Tuple[str, ...] = ("pilosa_tpu/", "tools/")
+    # GL010: packages where paired effects (register/unregister,
+    # TIMELINE.begin/finish, inc/dec) opened and closed in the same
+    # function must close on exception edges too.
+    effect_paths: Tuple[str, ...] = ("pilosa_tpu/",)
     select: Optional[Set[str]] = None
     ignore: Set[str] = field(default_factory=set)
 
@@ -90,6 +111,7 @@ class SourceFile:
         self.line_disables: Dict[int, Set[str]] = {}
         self.file_disables: Set[str] = set()
         self.materialize_lines: Set[int] = set()
+        self.transient_lines: Set[int] = set()
         self._scan_comments()
 
     def _scan_comments(self) -> None:
@@ -124,6 +146,8 @@ class SourceFile:
                     c.strip() for c in m.group(1).split(",") if c.strip())
             if _MATERIALIZE_RE.search(text):
                 self.materialize_lines.update(targets)
+            if _TRANSIENT_RE.search(text):
+                self.transient_lines.update(targets)
 
     def suppressed(self, code: str, line: int) -> bool:
         if code in self.file_disables:
@@ -140,6 +164,12 @@ class SourceFile:
             lines.add(deco.lineno - 1)
         return bool(lines & self.materialize_lines)
 
+    def is_transient(self, node: ast.AST) -> bool:
+        """True when an assignment carries (or sits under) a
+        ``# graftlint: transient`` annotation — on the statement line
+        or the line above it."""
+        return bool({node.lineno, node.lineno - 1} & self.transient_lines)
+
     def in_path(self, prefixes: Sequence[str]) -> bool:
         return any(p in self.path for p in prefixes)
 
@@ -151,6 +181,7 @@ class Project:
         self.files = files
         self.config = config
         self._model = None
+        self._callgraph = None
 
     @property
     def model(self):
@@ -158,6 +189,17 @@ class Project:
             from tools.graftlint.model import build_model
             self._model = build_model(self)
         return self._model
+
+    @property
+    def callgraph(self):
+        """The interprocedural call graph, built ONCE per run and
+        shared by every rule that follows calls (GL002 lock-order,
+        GL006 note-reachability, GL007 ledger coverage, GL009
+        blocking-under-lock)."""
+        if self._callgraph is None:
+            from tools.graftlint.callgraph import CallGraph
+            self._callgraph = CallGraph(self.model)
+        return self._callgraph
 
 
 class Rule:

@@ -6,6 +6,10 @@ from tools.graftlint.rules.gl003_hostsync import GL003HostSync
 from tools.graftlint.rules.gl004_retrace import GL004Retrace
 from tools.graftlint.rules.gl005_dtype import GL005DtypeInvariant
 from tools.graftlint.rules.gl006_jitsite import GL006JitSite
+from tools.graftlint.rules.gl007_ledger import GL007UnregisteredAllocation
+from tools.graftlint.rules.gl008_growth import GL008UnboundedGrowth
+from tools.graftlint.rules.gl009_blocking import GL009BlockingUnderLock
+from tools.graftlint.rules.gl010_pairs import GL010PairedEffects
 
 ALL_RULES = (
     GL001LockDiscipline(),
@@ -14,4 +18,8 @@ ALL_RULES = (
     GL004Retrace(),
     GL005DtypeInvariant(),
     GL006JitSite(),
+    GL007UnregisteredAllocation(),
+    GL008UnboundedGrowth(),
+    GL009BlockingUnderLock(),
+    GL010PairedEffects(),
 )

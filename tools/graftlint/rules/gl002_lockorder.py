@@ -7,11 +7,12 @@ path acquires lock B while holding lock A. Sources of edges:
 - a call made while holding A to a function whose *transitive*
   may-acquire set contains B (fixpoint over the resolvable call graph).
 
-Call resolution is deliberately conservative (see model.py): ``self.m``
-resolves within the class, ``x.m`` only when ``m`` is defined by
-exactly one project class, bare ``f()`` within the defining module.
-Unresolvable calls contribute no edges — GL002 under-approximates and
-never invents a cycle.
+Call resolution rides the SHARED interprocedural call graph
+(``tools.graftlint.callgraph`` — built once per run, reused by
+GL006/GL007/GL009): ``self.m`` resolves within the class, ``x.m`` only
+when ``m`` is defined by exactly one project class, bare ``f()`` within
+the defining module. Unresolvable calls contribute no edges — GL002
+under-approximates and never invents a cycle.
 
 Findings:
 - any cycle among distinct locks (the classic ABBA deadlock), reported
@@ -43,16 +44,16 @@ class GL002LockOrder(Rule):
         model = project.model
         if not model.locks:
             return []
-        infos = list({id(fi): fi for fi in model.funcs.values()}.values())
+        cg = project.callgraph
         direct: Dict[str, Set[str]] = {}
-        for fi in infos:
+        for fi in cg.funcs:
             direct[fi.qualname] = {
                 lock for lock, _node in self._direct_locks(fi, model)}
-        may = self._fixpoint(infos, direct, model)
+        may = cg.transitive_closure(direct)
         edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
         findings: List[Finding] = []
-        for fi in infos:
-            self._collect_edges(fi, model, may, edges, findings)
+        for fi in cg.funcs:
+            self._collect_edges(fi, model, cg, may, edges, findings)
         findings.extend(self._report_cycles(edges, model))
         return findings
 
@@ -92,49 +93,9 @@ class GL002LockOrder(Rule):
                 if lock:
                     yield lock, node
 
-    # -------------------------------------------------------- call graph
-
-    def _resolve_call(self, call: ast.Call, fi: FuncInfo,
-                      model: Model) -> Optional[FuncInfo]:
-        f = call.func
-        if isinstance(f, ast.Attribute):
-            if isinstance(f.value, ast.Name) and f.value.id == "self":
-                return model.resolve_method(f.attr, cls=fi.cls)
-            return model.resolve_method(f.attr)
-        if isinstance(f, ast.Name):
-            cand = model.funcs.get(f.id)
-            if cand is not None and cand.cls is None \
-                    and cand.module == fi.module:
-                return cand
-        return None
-
-    def _fixpoint(self, infos: List[FuncInfo],
-                  direct: Dict[str, Set[str]],
-                  model: Model) -> Dict[str, Set[str]]:
-        callees: Dict[str, Set[str]] = {}
-        for fi in infos:
-            outs: Set[str] = set()
-            for node in walk_shallow(fi.node):
-                if isinstance(node, ast.Call):
-                    callee = self._resolve_call(node, fi, model)
-                    if callee is not None:
-                        outs.add(callee.qualname)
-            callees[fi.qualname] = outs
-        may = {q: set(s) for q, s in direct.items()}
-        changed = True
-        while changed:
-            changed = False
-            for q, outs in callees.items():
-                cur = may[q]
-                before = len(cur)
-                for callee in outs:
-                    cur |= may.get(callee, set())
-                changed = changed or len(cur) != before
-        return may
-
     # ------------------------------------------------------------- edges
 
-    def _collect_edges(self, fi: FuncInfo, model: Model,
+    def _collect_edges(self, fi: FuncInfo, model: Model, cg,
                        may: Dict[str, Set[str]],
                        edges: Dict[Tuple[str, str], Tuple[str, int, str]],
                        findings: List[Finding]) -> None:
@@ -157,7 +118,7 @@ class GL002LockOrder(Rule):
                                 (lk, inner.lineno,
                                  f"nested with in {fi.qualname}"))
                 elif isinstance(inner, ast.Call):
-                    callee = self._resolve_call(inner, fi, model)
+                    callee = cg.resolve_call(inner, fi)
                     if callee is not None:
                         for lk in may.get(callee.qualname, ()):
                             acquired.append(

@@ -18,11 +18,10 @@ Flagged constructs inside non-allow-listed functions:
   of attribute lists needs a one-line justification disable);
 - ``int(x)`` / ``float(x)`` where ``x`` is device-tainted.
 
-Device taint is a per-function forward dataflow: locals assigned from
-``jnp.*`` / ``jax.*`` calls, from functions imported out of
-``pilosa_tpu.ops.*``, from a local previously assigned ``jax.jit(...)``,
-or from expressions containing tainted names. Nested defs/lambdas
-inherit the enclosing taint (closures).
+The taint dataflow and sink definitions live in
+``tools.graftlint.dataflow`` (shared with GL009, which treats the same
+sinks as blocking calls when they run under a lock). Nested
+defs/lambdas inherit the enclosing taint (closures).
 
 Allow-listing:
 - ``# graftlint: materialize`` on the def (see engine docstring);
@@ -38,14 +37,12 @@ from __future__ import annotations
 import ast
 from typing import Iterable, List, Set
 
+from tools.graftlint.dataflow import (
+    imported_device_fns, imports_jax, scan_scope,
+)
 from tools.graftlint.engine import (
     Finding, Project, Rule, SourceFile, dotted_name,
 )
-
-_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
-_DEVICE_MODULE_PREFIXES = ("jnp.", "jax.")
-_OPS_MODULES = ("pilosa_tpu.ops.bitset", "pilosa_tpu.ops.pallas_kernels",
-                "pilosa_tpu.ops")
 
 
 class GL003HostSync(Rule):
@@ -56,40 +53,14 @@ class GL003HostSync(Rule):
                    project: Project) -> Iterable[Finding]:
         if not sf.in_path(project.config.hot_paths):
             return []
-        device_fns = self._imported_device_fns(sf)
-        if not device_fns and not self._imports_jax(sf):
+        device_fns = imported_device_fns(sf)
+        if not device_fns and not imports_jax(sf):
             return []  # pure-host module: no device values can exist
         out: List[Finding] = []
         pending_ok = self._pending_finalizers(sf)
         self._check_scope(sf, sf.tree, set(), device_fns, pending_ok, out,
                           allowed=False)
         return out
-
-    # ------------------------------------------------------------- set-up
-
-    @staticmethod
-    def _imports_jax(sf: SourceFile) -> bool:
-        for node in ast.walk(sf.tree):
-            if isinstance(node, ast.Import):
-                if any(a.name.split(".")[0] == "jax" for a in node.names):
-                    return True
-            elif isinstance(node, ast.ImportFrom):
-                if (node.module or "").split(".")[0] == "jax":
-                    return True
-        return False
-
-    @staticmethod
-    def _imported_device_fns(sf: SourceFile) -> Set[str]:
-        """Names imported from pilosa_tpu.ops.* — calls to these produce
-        device arrays (b_and, popcount, pallas kernels, ...)."""
-        fns: Set[str] = set()
-        for node in ast.walk(sf.tree):
-            if isinstance(node, ast.ImportFrom) \
-                    and node.module in _OPS_MODULES:
-                for a in node.names:
-                    if not a.name.isupper():  # skip WORD_DTYPE-style consts
-                        fns.add(a.asname or a.name)
-        return fns
 
     @staticmethod
     def _pending_finalizers(sf: SourceFile) -> Set[int]:
@@ -114,133 +85,23 @@ class GL003HostSync(Rule):
                     ok.add(id(node))
         return ok
 
-    # ----------------------------------------------------------- analysis
-
     def _check_scope(self, sf: SourceFile, scope: ast.AST,
                      inherited_taint: Set[str], device_fns: Set[str],
                      pending_ok: Set[int], out: List[Finding],
                      allowed: bool) -> None:
-        """Walk one function scope (or module top level): run the taint
-        pass, flag sinks unless `allowed`, recurse into nested scopes
-        with the accumulated taint."""
-        taint = set(inherited_taint)
-        jit_fns: Set[str] = set()
-        nested: List[ast.AST] = []
-
-        def is_device_call(call: ast.Call) -> bool:
-            fn = dotted_name(call.func)
-            if fn is None:
-                return False
-            if fn.startswith(_DEVICE_MODULE_PREFIXES):
-                # jnp.* / jax.* produce device values — except the host
-                # fetcher, which is a sink, not a source.
-                return fn != "jax.device_get"
-            root = fn.split(".")[0]
-            return root in device_fns or root in jit_fns
-
-        def expr_tainted(e: ast.AST) -> bool:
-            # Metadata access (x.shape / x.ndim / x.dtype / x.size) is
-            # host-side and never syncs — skip those subtrees.
-            stack = [e]
-            while stack:
-                n = stack.pop()
-                if isinstance(n, ast.Attribute) \
-                        and n.attr in ("shape", "ndim", "dtype", "size"):
-                    continue
-                if isinstance(n, ast.Name) and n.id in taint:
-                    return True
-                if isinstance(n, ast.Call) and is_device_call(n):
-                    return True
-                stack.extend(ast.iter_child_nodes(n))
-            return False
-
-        for node in _walk_scope(scope):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.Lambda)) and node is not scope:
-                nested.append(node)
-                continue
-            # -- taint propagation
-            if isinstance(node, ast.Assign):
-                if self._is_jit_alias(node.value):
-                    for t in node.targets:
-                        if isinstance(t, ast.Name):
-                            jit_fns.add(t.id)
-                    continue
-                if self._is_host_materializer(node.value):
-                    # np.asarray(device)/int(device)/x.tolist() RESULTS
-                    # are host values: the sink is flagged below, but
-                    # the target must not stay device-tainted.
-                    for t in node.targets:
-                        if isinstance(t, ast.Name):
-                            taint.discard(t.id)
-                elif expr_tainted(node.value):
-                    for t in node.targets:
-                        for n in ast.walk(t):
-                            if isinstance(n, ast.Name):
-                                taint.add(n.id)
-            elif isinstance(node, ast.AugAssign):
-                if expr_tainted(node.value) \
-                        and isinstance(node.target, ast.Name):
-                    taint.add(node.target.id)
-            elif isinstance(node, ast.For):
-                if expr_tainted(node.iter):
-                    for n in ast.walk(node.target):
-                        if isinstance(n, ast.Name):
-                            taint.add(n.id)
-            # -- sinks
-            if allowed or not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            fn = dotted_name(f)
-            if isinstance(f, ast.Attribute) and f.attr in _SYNC_METHODS:
-                base = dotted_name(f.value)
-                if f.attr == "block_until_ready" \
-                        or expr_tainted(f.value) \
-                        or isinstance(f.value, (ast.Attribute, ast.Name)):
-                    self._flag(sf, node, out,
-                               f"`{base or '<expr>'}.{f.attr}()` "
-                               f"synchronizes device->host")
-            elif fn in ("jax.block_until_ready", "jax.device_get"):
-                self._flag(sf, node, out,
-                           f"`{fn}` synchronizes device->host")
-            elif fn in ("np.asarray", "np.array", "numpy.asarray",
-                        "numpy.array") and node.args:
-                arg = node.args[0]
-                if expr_tainted(arg) or isinstance(arg, ast.Attribute):
-                    self._flag(sf, node, out,
-                               f"`{fn}(...)` fetches a device array to "
-                               f"the host")
-            elif isinstance(f, ast.Name) and f.id in ("int", "float") \
-                    and node.args and expr_tainted(node.args[0]):
-                self._flag(sf, node, out,
-                           f"`{f.id}(...)` on a device value blocks on "
-                           f"the transfer")
-
-        for sub in nested:
+        """Scan one scope with the shared dataflow, flag its sinks
+        unless `allowed`, recurse into nested scopes with the
+        accumulated taint (function params are host values by default;
+        closures keep the enclosing taint)."""
+        sinks, nested = scan_scope(scope, inherited_taint, device_fns)
+        if not allowed:
+            for node, what in sinks:
+                self._flag(sf, node, out, what)
+        for sub, taint in nested:
             sub_allowed = allowed or id(sub) in pending_ok \
                 or sf.is_materialize(sub)
-            # Function params are host values by default; closures keep
-            # the enclosing taint.
             self._check_scope(sf, sub, taint, device_fns, pending_ok,
                               out, sub_allowed)
-
-    @staticmethod
-    def _is_host_materializer(value: ast.AST) -> bool:
-        """Calls whose result lives on the host even when their input
-        was a device array."""
-        if not isinstance(value, ast.Call):
-            return False
-        fn = dotted_name(value.func)
-        if fn in ("np.asarray", "np.array", "numpy.asarray",
-                  "numpy.array", "jax.device_get", "int", "float"):
-            return True
-        return isinstance(value.func, ast.Attribute) \
-            and value.func.attr in ("item", "tolist")
-
-    @staticmethod
-    def _is_jit_alias(value: ast.AST) -> bool:
-        return isinstance(value, ast.Call) \
-            and dotted_name(value.func) in ("jax.jit", "jit", "jax.pmap")
 
     def _flag(self, sf: SourceFile, node: ast.AST, out: List[Finding],
               what: str) -> None:
@@ -249,23 +110,3 @@ class GL003HostSync(Rule):
             f"{what} inside a hot-path function — move it behind a "
             f"`# graftlint: materialize` boundary or justify with a "
             f"disable comment"))
-
-
-def _walk_scope(scope: ast.AST):
-    """Yield nodes of one scope in SOURCE ORDER (the taint pass is a
-    single forward sweep); nested function/lambda nodes are yielded (so
-    the caller can recurse) but not descended into."""
-    if isinstance(scope, ast.Lambda):
-        roots = [scope.body]
-    else:
-        roots = list(scope.body)
-
-    def rec(n):
-        yield n
-        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
-                              ast.Lambda)):
-            for c in ast.iter_child_nodes(n):
-                yield from rec(c)
-
-    for r in roots:
-        yield from rec(r)

@@ -12,11 +12,13 @@ is exactly the diagnosis the PR 3 profiler exists to make.
 The check: a jit-building expression (``jax.jit(...)`` call,
 ``@jax.jit`` decorator, or ``functools.partial(jax.jit, ...)``) inside
 a ``jit_tracked_paths`` package must have a ``_note_jit_compile(...)``
-call somewhere in an enclosing function — the idiom every tracked site
-uses (miss branch: note, build, cache). Module-scope jit builds can
-never note a compile on an instance and are flagged unconditionally;
-genuinely compile-once sites (process-global kernels, bench harness
-probes) carry a justified ``# graftlint: disable=GL006``.
+call somewhere in an enclosing function — lexically, or (via the
+shared interprocedural call graph) in a helper the enclosing function
+transitively calls: the miss branch may delegate noting to a
+``_jit_get``-style helper. Module-scope jit builds can never note a
+compile on an instance and are flagged unconditionally; genuinely
+compile-once sites (process-global kernels, bench harness probes)
+carry a justified ``# graftlint: disable=GL006``.
 """
 
 from __future__ import annotations
@@ -65,6 +67,16 @@ class GL006JitSite(Rule):
         if not sf.in_path(project.config.jit_tracked_paths):
             return ()
         out: List[Finding] = []
+        # Call-graph leg: qualnames that note a compile themselves or
+        # transitively call a helper that does (computed once per run,
+        # shared across files via the project call graph).
+        cg = project.callgraph
+        note_reach = cg.memo(
+            "gl006.note_reach",
+            lambda: cg.reaches(lambda fi: _notes_compile(fi.node)))
+        node_qual = cg.memo(
+            "gl006.node_qual",
+            lambda: {id(fi.node): fi.qualname for fi in cg.funcs})
         # note_ok caches per enclosing function whether it (or a scope
         # nested in it) notes compiles.
         note_cache = {}
@@ -73,7 +85,8 @@ class GL006JitSite(Rule):
             for fn in stack:
                 ok = note_cache.get(id(fn))
                 if ok is None:
-                    ok = note_cache[id(fn)] = _notes_compile(fn)
+                    ok = note_cache[id(fn)] = _notes_compile(fn) or \
+                        node_qual.get(id(fn)) in note_reach
                 if ok:
                     return True
             return False

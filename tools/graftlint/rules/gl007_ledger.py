@@ -1,0 +1,136 @@
+"""GL007 — unregistered device allocation.
+
+The /debug/memory contract (PR 5) is that the MemoryLedger's totals
+are PROVABLE: every long-lived device allocation registers, so the sum
+of ledger categories is the sum of what actually occupies HBM. A bank
+stored on an instance without a matching ``LEDGER.register`` breaks
+that proof silently — totals stay plausible while a whole allocation
+class goes dark (exactly how the PR 5 owner-key-set leak survived to
+review).
+
+The check: an assignment that stores a *device-producing expression*
+on long-lived state —
+
+- ``self.X = jnp.asarray(...)`` / ``self.X = jax.*(...)`` /
+  ``self.X = <fn imported from pilosa_tpu.ops.*>(...)``
+
+— must REACH a ledger registration: a ``<ledger>.register(...)`` or
+``<ledger>.track(...)`` call (receiver's terminal name contains
+"ledger", e.g. ``LEDGER.register``) either in the assigning function
+itself or in a function it transitively calls, resolved over the
+shared interprocedural call graph (helper indirection like
+``Fragment.bank -> Fragment._ledger_bank`` is followed; GL002's
+conservative resolution, so an unresolvable helper does NOT satisfy
+the rule).
+
+Escapes:
+- ``# graftlint: transient`` on (or above) the assignment — for
+  genuinely short-lived arrays that happen to park on an attribute
+  (e.g. a scratch buffer replaced within the same request);
+- module-level device arrays are GL004's territory (import-time
+  device work) and are not double-flagged here.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from tools.graftlint.dataflow import imported_device_fns, imports_jax
+from tools.graftlint.engine import (
+    Finding, Project, Rule, SourceFile, dotted_name, walk_shallow,
+)
+
+_REGISTER_ATTRS = {"register", "track"}
+
+
+def _is_ledger_registration(call: ast.Call) -> bool:
+    """A `<ledger>.register(...)` / `<ledger>.track(...)` call: the
+    receiver's terminal name contains "ledger" (LEDGER, self.ledger,
+    self._ledger, ...)."""
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _REGISTER_ATTRS:
+        return False
+    base = dotted_name(f.value)
+    if base is None:
+        return False
+    return "ledger" in base.rsplit(".", 1)[-1].lower()
+
+
+def registers_with_ledger(fn: ast.AST) -> bool:
+    """Does this function lexically contain a ledger registration
+    (including nested closures — a registering helper defined inline
+    still runs on the allocation path)?"""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and _is_ledger_registration(node):
+            return True
+    return False
+
+
+def _device_producing(value: ast.AST, device_fns: Set[str]) -> \
+        Optional[str]:
+    """The producing callable's name when `value` is a call that
+    returns a device array; None otherwise."""
+    if not isinstance(value, ast.Call):
+        return None
+    fn = dotted_name(value.func)
+    if fn is None:
+        return None
+    if fn.startswith(("jnp.", "jax.")) and fn != "jax.device_get":
+        return fn
+    if fn.split(".")[0] in device_fns:
+        return fn
+    return None
+
+
+class GL007UnregisteredAllocation(Rule):
+    code = "GL007"
+    name = "unregistered-device-allocation"
+
+    def check_file(self, sf: SourceFile,
+                   project: Project) -> Iterable[Finding]:
+        if not sf.in_path(project.config.ledger_paths):
+            return []
+        device_fns = imported_device_fns(sf)
+        if not device_fns and not imports_jax(sf):
+            return []  # pure-host module: nothing can allocate on device
+        cg = project.callgraph
+        ledger_reach = cg.memo(
+            "gl007.ledger_reach",
+            lambda: cg.reaches(lambda fi: registers_with_ledger(fi.node)))
+        out: List[Finding] = []
+        for fi in cg.funcs:
+            if fi.sf is not sf:
+                continue
+            for node in walk_shallow(fi.node):
+                if not isinstance(node, ast.Assign):
+                    continue
+                producer = _device_producing(node.value, device_fns)
+                if producer is None:
+                    continue
+                target = self._long_lived_target(node)
+                if target is None:
+                    continue
+                if sf.is_transient(node):
+                    continue
+                if fi.qualname in ledger_reach:
+                    continue
+                out.append(Finding(
+                    sf.path, node.lineno, node.col_offset, self.code,
+                    f"device array from `{producer}(...)` stored on "
+                    f"long-lived state `{target}` but no path from "
+                    f"`{fi.qualname}` reaches a LEDGER.register/track — "
+                    f"/debug/memory totals go dark for this allocation; "
+                    f"register it (cf. Fragment._ledger_bank) or mark "
+                    f"the assignment `# graftlint: transient`"))
+        return out
+
+    @staticmethod
+    def _long_lived_target(node: ast.Assign) -> Optional[str]:
+        """'self.X' when the assignment stores to instance state."""
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                return f"self.{t.attr}"
+        return None

@@ -1,0 +1,278 @@
+"""GL008 — unbounded growth of long-lived containers.
+
+Every observability plane shipped since PR 5 is built on BOUNDED
+state: the profiler slow-query ring, the timeline ring, the hotspot
+LRU maps (whose evictions fold into `evicted` buckets), the watchdog
+flight recorder. The failure mode this rule exists for is the quiet
+accumulator — ``self._seen[key] = v`` on a request-driven path with no
+eviction anywhere — which is a slow memory leak that no test catches
+and the ledger only reports as anonymous host growth (the PR 5
+owner-key-set leak was exactly this shape).
+
+The check, per class in the configured packages: an instance attribute
+initialized to a mutable container (dict/list/set/deque/defaultdict/
+OrderedDict/Counter display or constructor) that some method GROWS
+(``.append/.add/.appendleft/.extend/.insert/.setdefault/.update``,
+``self.X[k] = v``, ``self.X += ...``) must show a BOUND somewhere in
+the same class:
+
+- eviction: ``.pop/.popitem/.popleft/.clear/.remove/.discard`` on the
+  attribute, ``del self.X[...]``, or slice deletion;
+- reassignment to a fresh container outside ``__init__`` (reset/close
+  paths count — the lifecycle ends);
+- a ring bound: ``deque(maxlen=...)``;
+- a cap check: any ``len(self.X)`` comparison in the class (the
+  "evict when over budget" idiom).
+
+Module-level containers get the same treatment with module scope as
+the bound horizon. Growth through aliases (``m = self.X; m[k] = v``)
+is NOT tracked — the rule under-approximates rather than guess at
+aliasing.
+
+Genuinely monotone state (a category->total map bounded by a closed
+key space, an order graph over lock names) carries a justified
+``# graftlint: disable=GL008``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding, Project, Rule, SourceFile, dotted_name, walk_shallow,
+)
+
+_GROW_METHODS = {"append", "add", "appendleft", "extend", "insert",
+                 "setdefault", "update"}
+_EVICT_METHODS = {"pop", "popitem", "popleft", "clear", "remove",
+                  "discard"}
+_MUTABLE_CTORS = {"dict", "list", "set", "defaultdict", "deque",
+                  "OrderedDict", "Counter"}
+
+
+def _container_ctor(value: ast.AST) -> Optional[bool]:
+    """None when `value` is not a mutable-container construction;
+    True when it is AND carries its own bound (deque(maxlen=...));
+    False when it is unbounded."""
+    if isinstance(value, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                          ast.DictComp, ast.SetComp)):
+        return False
+    if isinstance(value, ast.Call):
+        fn = dotted_name(value.func)
+        name = fn.rsplit(".", 1)[-1] if fn else None
+        if name in _MUTABLE_CTORS:
+            if any(kw.arg == "maxlen" and not (
+                    isinstance(kw.value, ast.Constant)
+                    and kw.value.value is None)
+                    for kw in value.keywords):
+                return True
+            return False
+    return None
+
+
+class _AttrState:
+    __slots__ = ("node", "grow_sites", "bounded")
+
+    def __init__(self, node: ast.AST):
+        self.node = node          # the initializing Assign
+        self.grow_sites: List[ast.AST] = []
+        self.bounded = False
+
+
+class GL008UnboundedGrowth(Rule):
+    code = "GL008"
+    name = "unbounded-growth"
+
+    def check_file(self, sf: SourceFile,
+                   project: Project) -> Iterable[Finding]:
+        if not sf.in_path(project.config.growth_paths):
+            return []
+        out: List[Finding] = []
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                self._check_class(sf, node, out)
+        self._check_module(sf, out)
+        return out
+
+    # ------------------------------------------------------------ classes
+
+    def _check_class(self, sf: SourceFile, cls: ast.ClassDef,
+                     out: List[Finding]) -> None:
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        attrs: Dict[str, _AttrState] = {}
+        # Pass 1: container attributes born in __init__ (or any method
+        # that first assigns them a container display/ctor).
+        for m in methods:
+            for node in walk_shallow(m):
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) \
+                        and node.value is not None:
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                for t in targets:
+                    # Tuple-unpack stores count too: the swap-reset
+                    # idiom `groups, self.groups = self.groups, {}`
+                    # bounds the attr's lifetime exactly like a plain
+                    # reassignment. A SUBSCRIPT store (`self.X[k] = v`)
+                    # is growth, not reassignment — only whole-name
+                    # rebinds reset the container.
+                    for sub in self._rebind_targets(t):
+                        attr = self._self_attr(sub)
+                        if attr is None:
+                            continue
+                        st = attrs.get(attr)
+                        if st is not None and m.name != "__init__":
+                            # Reassigned outside __init__: a reset
+                            # path bounds the lifetime.
+                            st.bounded = True
+                    attr = self._self_attr(t)
+                    if attr is None:
+                        continue
+                    kind = _container_ctor(value)
+                    if kind is None:
+                        continue
+                    if attr not in attrs:
+                        st = attrs[attr] = _AttrState(node)
+                        st.bounded = bool(kind)
+        if not attrs:
+            return
+        # Pass 2: growth and bound evidence across every method.
+        for m in methods:
+            for node in walk_shallow(m):
+                self._scan_evidence(
+                    node, attrs,
+                    lambda t: self._self_attr_expr(t))
+        for attr, st in sorted(attrs.items()):
+            if st.grow_sites and not st.bounded:
+                site = st.grow_sites[0]
+                out.append(Finding(
+                    sf.path, site.lineno, site.col_offset, self.code,
+                    f"`self.{attr}` ({cls.name}) grows with no "
+                    f"eviction, cap, ring bound, or reset in scope — "
+                    f"a long-lived accumulator is a slow leak; bound "
+                    f"it (deque(maxlen=), LRU eviction, len() cap) or "
+                    f"justify with a disable comment"))
+
+    # ------------------------------------------------------------- module
+
+    def _check_module(self, sf: SourceFile, out: List[Finding]) -> None:
+        attrs: Dict[str, _AttrState] = {}
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and node.value is not None \
+                    and isinstance(node.target, ast.Name):
+                target, value = node.target, node.value
+            else:
+                continue
+            kind = _container_ctor(value)
+            if kind is not None:
+                st = attrs.setdefault(target.id, _AttrState(node))
+                st.bounded = st.bounded or bool(kind)
+        if not attrs:
+            return
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in walk_shallow(node):
+                    self._scan_evidence(
+                        sub, attrs,
+                        lambda t: t.id if isinstance(t, ast.Name)
+                        else None)
+                # A module function that REASSIGNS the global container
+                # resets it (reset_lock_order-style lifecycle bound).
+                for sub in walk_shallow(node):
+                    if isinstance(sub, ast.Assign):
+                        for t in sub.targets:
+                            if isinstance(t, ast.Name) \
+                                    and t.id in attrs:
+                                attrs[t.id].bounded = True
+        for name, st in sorted(attrs.items()):
+            if st.grow_sites and not st.bounded:
+                site = st.grow_sites[0]
+                out.append(Finding(
+                    sf.path, site.lineno, site.col_offset, self.code,
+                    f"module-level `{name}` grows with no eviction, "
+                    f"cap, ring bound, or reset in scope — bound it or "
+                    f"justify with a disable comment"))
+
+    # ----------------------------------------------------------- evidence
+
+    def _scan_evidence(self, node: ast.AST,
+                       attrs: Dict[str, _AttrState],
+                       resolve) -> None:
+        """Fold one AST node into grow/bound evidence. `resolve` maps a
+        target expression to an attr key or None (self.X for classes,
+        bare names for module state)."""
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute):
+            key = resolve(node.func.value)
+            if key is not None and key in attrs:
+                if node.func.attr in _GROW_METHODS:
+                    attrs[key].grow_sites.append(node)
+                elif node.func.attr in _EVICT_METHODS:
+                    attrs[key].bounded = True
+        elif isinstance(node, ast.Subscript):
+            key = resolve(node.value)
+            if key is not None and key in attrs:
+                if isinstance(node.ctx, ast.Store):
+                    # A string/number-LITERAL subscript key cannot grow
+                    # the container past the number of distinct
+                    # literals in the source — `self._totals["reads"]
+                    # += n` is a fixed-field record, not an
+                    # accumulator.
+                    if not isinstance(node.slice, ast.Constant):
+                        attrs[key].grow_sites.append(node)
+                elif isinstance(node.ctx, ast.Del):
+                    attrs[key].bounded = True
+        elif isinstance(node, ast.AugAssign):
+            key = resolve(node.target)
+            if key is not None and key in attrs:
+                if isinstance(node.op, (ast.Add, ast.BitOr)):
+                    attrs[key].grow_sites.append(node)
+                else:
+                    # self._dirty -= consumed: a draining accumulator
+                    # IS its own eviction.
+                    attrs[key].bounded = True
+        elif isinstance(node, ast.Compare):
+            # len(self.X) <op> ...: the cap-check idiom.
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Name) \
+                        and sub.func.id == "len" and sub.args:
+                    key = resolve(sub.args[0])
+                    if key is not None and key in attrs:
+                        attrs[key].bounded = True
+
+    # ------------------------------------------------------------ helpers
+
+    @staticmethod
+    def _rebind_targets(t: ast.AST) -> Iterable[ast.AST]:
+        """The expressions actually REBOUND by an assignment target:
+        tuple/list elements recursively, starred inners, and plain
+        names/attributes — but never the base of a Subscript (that
+        mutates the container, it does not replace it)."""
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                yield from GL008UnboundedGrowth._rebind_targets(el)
+        elif isinstance(t, ast.Starred):
+            yield from GL008UnboundedGrowth._rebind_targets(t.value)
+        elif isinstance(t, (ast.Name, ast.Attribute)):
+            yield t
+
+    @staticmethod
+    def _self_attr(t: ast.AST) -> Optional[str]:
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return t.attr
+        return None
+
+    @staticmethod
+    def _self_attr_expr(t: ast.AST) -> Optional[str]:
+        return GL008UnboundedGrowth._self_attr(t)

@@ -1,0 +1,259 @@
+"""GL009 — blocking call under a lock.
+
+The PR 5 finalizer deadlock was this rule's motivating incident: code
+that blocks while holding a lock turns every sibling of that lock into
+a convoy, and under PILOSA_TPU_LOCK_CHECK's order-graph mutex it can
+deadlock the process outright. Blocking work belongs OUTSIDE the
+critical section (snapshot under the lock, send after — the pattern
+MemoryLedger.publish and the coalescer flush already follow).
+
+Blocking sinks:
+
+- ``time.sleep`` (any ``*.sleep`` with a time-module receiver, or a
+  bare ``sleep`` imported from time);
+- socket/HTTP client calls: ``urlopen``, ``socket.create_connection``,
+  ``.recv()`` / ``.accept()``;
+- ``Thread.join`` (an ``x.join()`` with no positional args or a
+  numeric timeout — ``", ".join(parts)`` / ``os.path.join(a, b)``
+  never match) and ``Future.result()``;
+- subprocess: ``subprocess.run/call/check_call/check_output`` and
+  ``.communicate()``, ``.wait()`` on a Popen-shaped receiver
+  (``*.wait()`` is ONLY a sink when the receiver is a known
+  subprocess local — Condition.wait releases the lock it waits on and
+  is GL002's business, not a blocking hazard);
+- every device->host sync GL003 knows (``block_until_ready``,
+  ``jax.device_get``, ``.item()``/``.tolist()``/``int()``/``float()``
+  on device-tainted values, via the shared taint dataflow) — a fenced
+  transfer holds the lock for a full device round-trip.
+
+Where the rule looks: syntactically inside a ``with <lock>:`` body
+(lock = a resolvable model lock or a lock-shaped name, GL001's
+heuristic), AND at calls made under the lock to functions whose
+transitive closure (shared call graph) contains a blocking sink — the
+finding names the chain (``f calls g which calls time.sleep``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.dataflow import (
+    imported_device_fns, scan_scope,
+)
+from tools.graftlint.engine import (
+    Finding, Project, Rule, SourceFile, dotted_name, walk_shallow,
+)
+from tools.graftlint.model import FuncInfo
+
+_LOCKISH = re.compile(r"lock|mutex|cond|sem|guard", re.IGNORECASE)
+
+_SUBPROCESS_FNS = {"subprocess.run", "subprocess.call",
+                   "subprocess.check_call", "subprocess.check_output"}
+_SOCKET_FNS = {"socket.create_connection"}
+_URLOPEN_TERMINALS = ("urlopen",)
+
+
+def _sleep_names(sf: SourceFile) -> Set[str]:
+    """Bare names that mean time.sleep in this file (``from time
+    import sleep [as s]``)."""
+    out: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "sleep":
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _popen_locals(fn: ast.AST) -> Set[str]:
+    """Locals assigned subprocess.Popen(...) — their .wait() /
+    .communicate() blocks."""
+    out: Set[str] = set()
+    for node in walk_shallow(fn):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call):
+            callee = dotted_name(node.value.func)
+            if callee in ("subprocess.Popen", "Popen"):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return out
+
+
+def direct_blocking_sinks(
+        sf: SourceFile, fn: ast.AST,
+        sleeps: Optional[Set[str]] = None,
+        device_fns: Optional[Set[str]] = None,
+) -> List[Tuple[ast.AST, str]]:
+    """Every syntactically-blocking call in ONE function scope (nested
+    defs excluded — they run later, possibly without the lock).
+    `sleeps`/`device_fns` are per-FILE facts the project pass
+    precomputes once; when omitted they are derived here."""
+    sinks: List[Tuple[ast.AST, str]] = []
+    if sleeps is None:
+        sleeps = _sleep_names(sf)
+    popens = _popen_locals(fn)
+    for node in walk_shallow(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = dotted_name(f)
+        terminal = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if terminal == "sleep" and (
+                isinstance(f, ast.Attribute)
+                or (isinstance(f, ast.Name) and f.id in sleeps)):
+            sinks.append((node, f"`{name or 'sleep'}(...)` sleeps"))
+        elif terminal in _URLOPEN_TERMINALS:
+            sinks.append((node, f"`{name or terminal}(...)` performs "
+                                f"network I/O"))
+        elif name in _SUBPROCESS_FNS:
+            sinks.append((node, f"`{name}(...)` waits on a child "
+                                f"process"))
+        elif name in _SOCKET_FNS or terminal in ("recv", "accept"):
+            sinks.append((node, f"`{name or terminal}(...)` blocks on "
+                                f"a socket"))
+        elif terminal == "join" and isinstance(f, ast.Attribute) \
+                and self_join_shaped(node):
+            sinks.append((node, f"`{name or '<expr>.join'}()` joins a "
+                                f"thread"))
+        elif terminal == "result" and isinstance(f, ast.Attribute) \
+                and self_join_shaped(node):
+            sinks.append((node, f"`{name or '<expr>.result'}()` blocks "
+                                f"on a future"))
+        elif terminal in ("communicate", "wait") \
+                and isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in popens:
+            sinks.append((node, f"`{name}()` waits on a child process"))
+    # Device syncs via the shared taint dataflow — GL003's sink set in
+    # proven-only mode: only locals the taint pass PROVED device-
+    # resident count (a numpy .tolist() is host work, not blocking).
+    if device_fns is None:
+        device_fns = imported_device_fns(sf)
+    dev_sinks, _nested = scan_scope(fn, set(), device_fns,
+                                    proven_only=True)
+    for node, what in dev_sinks:
+        sinks.append((node, what))
+    return sinks
+
+
+def self_join_shaped(call: ast.Call) -> bool:
+    """True for thread-join / future-result call shapes: no positional
+    args (or a single numeric timeout). ``", ".join(parts)`` and
+    ``os.path.join(a, b)`` take non-numeric positionals and never
+    match; a str-literal receiver is excluded outright."""
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Constant):
+        return False
+    if not call.args:
+        return True
+    return len(call.args) == 1 \
+        and isinstance(call.args[0], ast.Constant) \
+        and isinstance(call.args[0].value, (int, float))
+
+
+class GL009BlockingUnderLock(Rule):
+    code = "GL009"
+    name = "blocking-call-under-lock"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        cfg = project.config
+        cg = project.callgraph
+        model = project.model
+        # Per-function direct sinks (computed for every function once;
+        # the fixpoint needs them all, whatever file they live in).
+        # Per-file facts (sleep import aliases, device-fn imports) are
+        # derived once per file, not once per function.
+        sleeps_by_sf: Dict[int, Set[str]] = {}
+        devfns_by_sf: Dict[int, Set[str]] = {}
+        direct: Dict[str, List[Tuple[ast.AST, str]]] = {}
+        for fi in cg.funcs:
+            sid = id(fi.sf)
+            if sid not in sleeps_by_sf:
+                sleeps_by_sf[sid] = _sleep_names(fi.sf)
+                devfns_by_sf[sid] = imported_device_fns(fi.sf)
+            direct[fi.qualname] = direct_blocking_sinks(
+                fi.sf, fi.node, sleeps_by_sf[sid], devfns_by_sf[sid])
+        blocking = cg.transitive_closure(
+            {q: ({q} if sinks else set())
+             for q, sinks in direct.items()})
+        blocks = {q for q, s in blocking.items() if s}
+        out: List[Finding] = []
+        for fi in cg.funcs:
+            if not fi.sf.in_path(cfg.lock_block_paths):
+                continue
+            self._check_func(fi, cg, model, direct, blocks, out)
+        return out
+
+    # ------------------------------------------------------------- checks
+
+    def _check_func(self, fi: FuncInfo, cg, model,
+                    direct: Dict[str, List[Tuple[ast.AST, str]]],
+                    blocks: Set[str], out: List[Finding]) -> None:
+        sf = fi.sf
+        direct_ids = {id(n): what for n, what in direct[fi.qualname]}
+        for node in walk_shallow(fi.node):
+            if not isinstance(node, ast.With):
+                continue
+            lock = self._lock_name(node, fi, model)
+            if lock is None:
+                continue
+            for inner in walk_shallow(node):
+                if not isinstance(inner, ast.Call):
+                    continue
+                what = direct_ids.get(id(inner))
+                if what is not None:
+                    out.append(Finding(
+                        sf.path, inner.lineno, inner.col_offset,
+                        self.code,
+                        f"{what} while holding `{lock}` — blocking "
+                        f"work convoys every waiter; snapshot under "
+                        f"the lock, block after releasing it"))
+                    continue
+                callee = cg.resolve_call(inner, fi)
+                if callee is not None and callee.qualname in blocks:
+                    chain = cg.first_witness(
+                        callee.qualname,
+                        {q for q in blocks if direct[q]})
+                    via = " -> ".join(chain) if chain \
+                        else callee.qualname
+                    sink_what = ""
+                    if chain and direct.get(chain[-1]):
+                        sink_what = f" ({direct[chain[-1]][0][1]})"
+                    out.append(Finding(
+                        sf.path, inner.lineno, inner.col_offset,
+                        self.code,
+                        f"call under `{lock}` reaches a blocking "
+                        f"sink via {via}{sink_what} — blocking work "
+                        f"convoys every waiter of the lock"))
+
+    def _lock_name(self, with_node: ast.With, fi: FuncInfo,
+                   model) -> Optional[str]:
+        """The held lock's name when this with-statement acquires one:
+        a resolvable model lock, or a lock-shaped terminal name
+        (GL001's heuristic — `with open(path):` never counts)."""
+        for item in with_node.items:
+            expr = item.context_expr
+            name = dotted_name(expr)
+            if name is None:
+                continue
+            # Model resolution first (exact), name shape second.
+            if isinstance(expr, ast.Attribute) \
+                    and isinstance(expr.value, ast.Name):
+                if expr.value.id == "self" and fi.cls is not None:
+                    hit = model.class_lock_attrs.get((fi.cls, expr.attr))
+                    if hit:
+                        return hit
+                hits = model.lock_attr_names.get(expr.attr, set())
+                if len(hits) == 1:
+                    return next(iter(hits))
+            if isinstance(expr, ast.Name):
+                mod_locks = model.module_locks.get(fi.module, {})
+                if expr.id in mod_locks:
+                    return mod_locks[expr.id]
+            if _LOCKISH.search(name.rsplit(".", 1)[-1]):
+                return name
+        return None

@@ -1,0 +1,129 @@
+"""GL010 — paired-effect balance on exception edges.
+
+The telemetry planes are full of open/close effect pairs whose
+imbalance silently corrupts a gauge or leaks a ledger row:
+``LEDGER.register``/``unregister``, ``TIMELINE.begin``/``finish``,
+gauge ``inc``/``dec``. When BOTH halves run in the same function, the
+closer must run on the exception edge too — otherwise one raised
+request leaves a timeline open forever, a gauge permanently high, or a
+ledger entry orphaned (and /debug/memory totals stop being provable).
+
+The check, per function in the configured packages: an *opener* call
+``R.open(...)`` with a matching *closer* ``R.close(...)`` on the SAME
+receiver later in the same function is flagged unless at least one
+closer is exception-safe:
+
+- the closer sits in a ``finally`` block;
+- the closer is installed as a ``weakref.finalize`` / ``atexit``
+  callback (the closer name appears as a finalize argument);
+- the opener itself is the context expression of a ``with`` (the
+  pair's context manager does the balancing).
+
+Pairs checked: ``register``/``unregister``, ``begin``/``finish``,
+``inc``/``dec``, ``incr``/``decr``, ``acquire``/``release`` is GL001's
+territory and excluded here.
+
+Cross-function lifecycles (register in ``__init__``, unregister in
+``close()``) are deliberately NOT flagged: the ledger's owner-weakref
+purge covers them, and a linter guessing at object lifetimes would
+drown the signal. The rule fires only on the same-function shape,
+where a ``try/finally`` is always available and always right.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding, Project, Rule, SourceFile, dotted_name, walk_shallow,
+)
+
+PAIRS = {
+    "register": "unregister",
+    "begin": "finish",
+    "inc": "dec",
+    "incr": "decr",
+}
+
+
+class GL010PairedEffects(Rule):
+    code = "GL010"
+    name = "paired-effect-balance"
+
+    def check_file(self, sf: SourceFile,
+                   project: Project) -> Iterable[Finding]:
+        if not sf.in_path(project.config.effect_paths):
+            return []
+        out: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_func(sf, node, out)
+        return out
+
+    def _check_func(self, sf: SourceFile, fn: ast.AST,
+                    out: List[Finding]) -> None:
+        # Receiver -> opener/closer call sites in this function (nested
+        # defs excluded: a closer inside a callback is ITS function's
+        # business — except finalize-installed closers, handled below).
+        openers: Dict[Tuple[str, str], List[ast.Call]] = {}
+        closers: Dict[Tuple[str, str], List[ast.Call]] = {}
+        finalized: Set[Tuple[str, str]] = set()
+        with_exprs: Set[int] = set()
+        finally_calls: Set[int] = set()
+        for node in walk_shallow(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        with_exprs.add(id(sub))
+            if isinstance(node, ast.Try) and node.finalbody:
+                for st in node.finalbody:
+                    for sub in ast.walk(st):
+                        finally_calls.add(id(sub))
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                recv = dotted_name(f.value)
+                if recv is not None:
+                    if f.attr in PAIRS:
+                        openers.setdefault(
+                            (recv, f.attr), []).append(node)
+                    elif f.attr in PAIRS.values():
+                        closers.setdefault(
+                            (recv, f.attr), []).append(node)
+            # weakref.finalize(obj, R.closer, ...) / atexit.register(
+            # R.closer, ...): the closer runs off-path, which balances
+            # the pair.
+            callee = dotted_name(f)
+            if callee in ("weakref.finalize", "finalize",
+                          "atexit.register"):
+                for arg in node.args:
+                    if isinstance(arg, ast.Attribute):
+                        recv = dotted_name(arg.value)
+                        if recv is not None \
+                                and arg.attr in PAIRS.values():
+                            finalized.add((recv, arg.attr))
+        for (recv, op), sites in sorted(openers.items()):
+            closer = PAIRS[op]
+            opener = sites[0]
+            # Only closers AFTER the opener pair with it: a closer
+            # that precedes it is the evict-old/open-new idiom
+            # (_jit_put unregisters the evicted key before registering
+            # the fresh one), not an open/close bracket.
+            closing = [c for c in closers.get((recv, closer), [])
+                       if c.lineno > opener.lineno]
+            if not closing and (recv, closer) not in finalized:
+                continue  # cross-function lifecycle: out of scope
+            safe = (recv, closer) in finalized or any(
+                id(c) in finally_calls for c in closing)
+            if safe:
+                continue
+            if id(opener) in with_exprs:
+                continue  # `with R.begin(...):` — the CM balances it
+            out.append(Finding(
+                sf.path, opener.lineno, opener.col_offset, self.code,
+                f"`{recv}.{op}(...)` is closed by `{recv}.{closer}` "
+                f"only on the fall-through path — an exception between "
+                f"them leaks the effect; move the `{closer}` into a "
+                f"`finally` (or install it via weakref.finalize)"))

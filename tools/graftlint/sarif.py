@@ -1,0 +1,72 @@
+"""SARIF 2.1.0 emission (``--format=sarif``).
+
+One run object per invocation: the tool.driver carries every
+registered rule (id + first docstring line as the short description),
+results carry ruleId/message/location. The document is what CI uploads
+as the ``graftlint.sarif`` artifact — code-scanning UIs and SARIF
+viewers render it natively; baselined findings are emitted with
+``"baselineState": "unchanged"`` so they display as known, not new.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List, Sequence
+
+from tools.graftlint.engine import Finding, Rule
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _rule_meta(rule: Rule) -> Dict[str, object]:
+    doc = sys.modules[type(rule).__module__].__doc__ or ""
+    first = doc.strip().splitlines()[0].strip() if doc.strip() else ""
+    return {
+        "id": rule.code,
+        "name": rule.name,
+        "shortDescription": {"text": first or rule.name},
+        "defaultConfiguration": {"level": "error"},
+    }
+
+
+def _result(f: Finding, *, baselined: bool) -> Dict[str, object]:
+    out: Dict[str, object] = {
+        "ruleId": f.code,
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {
+                    "startLine": f.line,
+                    # Finding.col is 0-based (ast col_offset); SARIF
+                    # columns are 1-based.
+                    "startColumn": f.col + 1,
+                },
+            },
+        }],
+    }
+    if baselined:
+        out["baselineState"] = "unchanged"
+    return out
+
+
+def document(fresh: Sequence[Finding], baselined: Sequence[Finding],
+             rules: Sequence[Rule]) -> Dict[str, object]:
+    results: List[Dict[str, object]] = []
+    results.extend(_result(f, baselined=False) for f in fresh)
+    results.extend(_result(f, baselined=True) for f in baselined)
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "docs/development.md#graftlint-rule-reference",
+                "rules": [_rule_meta(r) for r in rules],
+            }},
+            "results": results,
+        }],
+    }
