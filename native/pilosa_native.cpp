@@ -46,6 +46,14 @@ constexpr uint8_t kOpRemoveBatch = 3;
 // reference's own files is unaffected.
 constexpr uint8_t kOpAddRoaring = 4;
 
+// Maximum kOpAddRoaring nesting depth. A roaring-record payload is a
+// self-contained file, so a crafted input can nest records inside
+// records; unbounded recursion through replay_ops would exhaust the
+// stack on attacker-controlled depth. Legitimate writers emit
+// snapshot-only payloads (depth 1); the Python codec enforces the same
+// bound (storage/roaring.py MAX_OP_NESTING) so both readers agree.
+constexpr int kMaxOpNesting = 4;
+
 inline uint16_t ru16(const uint8_t* p) { uint16_t v; std::memcpy(&v, p, 2); return v; }
 inline uint32_t ru32(const uint8_t* p) { uint32_t v; std::memcpy(&v, p, 4); return v; }
 inline uint64_t ru64(const uint8_t* p) { uint64_t v; std::memcpy(&v, p, 8); return v; }
@@ -492,7 +500,8 @@ void merge_union(LoadedBitmap* bm, const LoadedBitmap& other) {
   bm->words.swap(words);
 }
 
-bool replay_ops(LoadedBitmap* bm, const uint8_t* data, size_t len, size_t pos) {
+bool replay_ops(LoadedBitmap* bm, const uint8_t* data, size_t len, size_t pos,
+                int depth = 0) {
   ReplayCtx ctx{bm, {}};
   while (pos < len) {
     // A record extending past EOF is a torn tail append (crash mid-write):
@@ -534,10 +543,24 @@ bool replay_ops(LoadedBitmap* bm, const uint8_t* data, size_t len, size_t pos) {
       uint32_t h = crc32_update(0, data + pos, 9);
       h = crc32_update(h, data + pos + 13, value);
       if (chk != h) return fail(bm, "op checksum mismatch");
+      if (depth + 1 >= kMaxOpNesting)
+        return fail(bm, "op nesting too deep");
       LoadedBitmap batch;
       size_t batch_ops = 0;
       if (!parse_snapshot(&batch, data + pos + 13, value, &batch_ops))
         return fail(bm, batch.err);
+      // The payload is a full roaring file: replay any op tail it
+      // carries too (the Python reader does — fuzz corpus
+      // div-nested-op-tail pinned the divergence where this path
+      // silently ignored trailing records). A torn tail INSIDE a
+      // checksummed payload is corruption, not a crash artifact: the
+      // record's crc32 already matched, so fail hard like the Python
+      // reader's from_bytes does.
+      if (!replay_ops(&batch, data + pos + 13, value, batch_ops,
+                      depth + 1))
+        return fail(bm, batch.err);
+      if (batch.tail_dropped)
+        return fail(bm, "op data truncated");
       for (uint64_t w : batch.words) bm->op_n += popcount64(w);
       for (size_t i = 0; i < batch.keys.size(); i++) {
         uint64_t* dst = ctx.locate(batch.keys[i], true);

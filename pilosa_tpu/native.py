@@ -10,36 +10,71 @@ semantics and the fallback: if the shared library is missing it is built
 on first import with `make` (g++ is in the image); if that fails, callers
 get None from load() and use the numpy paths. Set PILOSA_TPU_NO_NATIVE=1
 to force the fallback (used by tests to cross-check both paths).
+
+Sanitizer variants (the native correctness plane, docs/development.md):
+PILOSA_TPU_NATIVE_SAN=asan|ubsan|tsan selects a
+libpilosa_native.{san}.so built with `make SAN=...`
+(-fsanitize=... -fno-omit-frame-pointer -g). Availability-gated like
+the default build: if the variant cannot be built/loaded, load()
+returns None and callers take the Python paths (an unrecognized value
+also yields None — silently loading the uninstrumented library would
+defeat the point of asking for a sanitizer). ASan/TSan runtimes must be
+preloaded into the python process (tools/check.sh --san does this);
+under a sanitizer, untrusted input bytes are staged in exact-size libc
+malloc buffers so one-byte over-reads land in a redzone instead of
+slack inside a Python object.
 """
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import subprocess
 from pilosa_tpu.utils.locks import make_lock
-from typing import List, Optional, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "native")
-_SO_PATH = os.path.join(_NATIVE_DIR, "libpilosa_native.so")
+
+_SAN_VARIANTS = ("asan", "ubsan", "tsan")
 
 _lock = make_lock("native._lock")
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
+# Load results keyed by requested sanitizer variant ('' = plain build):
+# a PILOSA_TPU_NATIVE_SAN set AFTER the plain library was first loaded
+# must get the instrumented .so, not the cached uninstrumented one (and
+# a failed sanitizer load must not poison a later plain request). The
+# key space is closed: '' plus _SAN_VARIANTS.
+_libs: Dict[str, Optional[ctypes.CDLL]] = {}
+_libc: Optional[ctypes.CDLL] = None
+_force_python = 0
 
 CONTAINER_WORDS = 1024
 
 
-def _build() -> bool:
+def active_san() -> str:
+    """The requested sanitizer variant ('' = the plain build). Values
+    outside the matrix read as a bogus request: load() then returns
+    None rather than silently serving the uninstrumented library."""
+    return os.environ.get("PILOSA_TPU_NATIVE_SAN", "").strip().lower()
+
+
+def _so_path(san: str) -> str:
+    name = f"libpilosa_native.{san}.so" if san else "libpilosa_native.so"
+    return os.path.join(_NATIVE_DIR, name)
+
+
+def _build(san: str) -> bool:
     if not os.path.isdir(_NATIVE_DIR):
         return False
+    cmd = ["make", "-C", _NATIVE_DIR]
+    if san:
+        cmd.append(f"SAN={san}")
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
-        return os.path.exists(_SO_PATH)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return os.path.exists(_so_path(san))
     except (OSError, subprocess.SubprocessError):
         return False
 
@@ -66,10 +101,15 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.rb_tail_dropped.argtypes = [ctypes.c_void_p]
     lib.rb_tail_dropped.restype = u64
     lib.rb_copy_out.argtypes = [ctypes.c_void_p, p_u64, p_u64]
+    lib.rb_copy_out.restype = None
     lib.rb_keys.argtypes = [ctypes.c_void_p, p_u64]
+    lib.rb_keys.restype = None
     lib.rb_counts.argtypes = [ctypes.c_void_p, p_u64]
+    lib.rb_counts.restype = None
     lib.rb_export_split.argtypes = [ctypes.c_void_p, u64, p_u16, p_u64]
+    lib.rb_export_split.restype = None
     lib.rb_free.argtypes = [ctypes.c_void_p]
+    lib.rb_free.restype = None
     lib.rb_serialize_cap.argtypes = [u64]
     lib.rb_serialize_cap.restype = u64
     lib.rb_serialize.argtypes = [p_u64, p_u64, u64, p_u8]
@@ -89,9 +129,13 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ib_payload_size.argtypes = [ctypes.c_void_p]
     lib.ib_payload_size.restype = u64
     lib.ib_keys_counts.argtypes = [ctypes.c_void_p, p_u64, p_u64]
+    lib.ib_keys_counts.restype = None
     lib.ib_words.argtypes = [ctypes.c_void_p, p_u64]
+    lib.ib_words.restype = None
     lib.ib_payload.argtypes = [ctypes.c_void_p, p_u8]
+    lib.ib_payload.restype = None
     lib.ib_free.argtypes = [ctypes.c_void_p]
+    lib.ib_free.restype = None
     lib.pn_serialize_groups_cap.argtypes = [u64, u64]
     lib.pn_serialize_groups_cap.restype = u64
     lib.pn_serialize_groups.argtypes = [p_u64, p_u16, p_u64, u64, p_u8]
@@ -103,9 +147,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pn_intersection_count.argtypes = [p_u64, p_u64, u64]
     lib.pn_intersection_count.restype = u64
     lib.pn_row_popcounts.argtypes = [p_u64, u64, u64, p_u64]
+    lib.pn_row_popcounts.restype = None
     lib.pn_build_masks.argtypes = [p_u64, u64, u64, p_u64, p_u64]
     lib.pn_build_masks.restype = u64
     lib.pn_scatter_rows.argtypes = [p_u16, p_u64, u64, p_u64, u64, p_u64]
+    lib.pn_scatter_rows.restype = None
     # The chunk-pointer arrays ride as uint64 address arrays (same ABI as
     # const uint64_t* const* and ~100x cheaper than building per-element
     # ctypes pointer objects).
@@ -118,37 +164,105 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 def load() -> Optional[ctypes.CDLL]:
     """Return the bound native library, building it if needed; None if
-    unavailable (missing toolchain) or disabled via PILOSA_TPU_NO_NATIVE."""
-    global _lib, _tried
+    unavailable (missing toolchain), disabled via PILOSA_TPU_NO_NATIVE,
+    or an unbuildable/unknown PILOSA_TPU_NATIVE_SAN variant was
+    requested."""
     if os.environ.get("PILOSA_TPU_NO_NATIVE"):
         return None
+    san = active_san()
+    if san and san not in _SAN_VARIANTS:
+        return None
     with _lock:
-        if _tried:
-            return _lib
-        _tried = True
+        if san in _libs:
+            return _libs[san]
+        so_path = _so_path(san)
+        lib: Optional[ctypes.CDLL] = None
         # Always run make: it is mtime-based (a no-op when fresh) and
         # rebuilds a stale .so whose symbols predate these bindings.
         # graftlint: disable=GL009 — build-once critical section: the
         # lock EXISTS to make every caller wait for the single
         # first-touch make; there is nothing useful to do before the
         # library is bound, so blocking under it is the point.
-        if not _build() and not os.path.exists(_SO_PATH):
-            return None
-        try:
-            _lib = _bind(ctypes.CDLL(_SO_PATH))
-        except (OSError, AttributeError):
-            # AttributeError = missing symbol in a stale library that
-            # make could not refresh; fall back to the Python paths.
-            _lib = None
-        return _lib
+        if _build(san) or os.path.exists(so_path):
+            try:
+                lib = _bind(ctypes.CDLL(so_path))
+            except (OSError, AttributeError):
+                # AttributeError = missing symbol in a stale library
+                # that make could not refresh; OSError also covers a
+                # sanitizer runtime that is not preloaded into this
+                # process. Fall back to the Python paths either way.
+                lib = None
+        # graftlint: disable=GL008 — closed key space ('' + 3 variants)
+        _libs[san] = lib
+        return lib
 
 
 def available() -> bool:
-    return load() is not None
+    return _force_python == 0 and load() is not None
+
+
+@contextlib.contextmanager
+def force_python() -> Iterator[None]:
+    """Make available() report False inside the block, routing every
+    caller that gates on it (storage/roaring.py) onto the pure-Python
+    paths. Direct entry points (roaring_load_ex etc.) keep working: the
+    differential oracle parses natively while forcing the Python
+    reader. Reentrant; used by the fuzzer and the differential tests."""
+    global _force_python
+    _force_python += 1
+    try:
+        yield
+    finally:
+        _force_python -= 1
 
 
 def _as_u64_ptr(arr: np.ndarray):
     return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+class _StagedBytes:
+    """Untrusted input bytes staged for a native call.
+
+    Plain build: a ctypes copy of the data (the pre-existing path).
+    Sanitizer build: an EXACT-size libc malloc block instead — ASan
+    intercepts malloc and places redzones at the precise boundary, so a
+    one-past-the-end read in the parser faults immediately. A ctypes
+    array cannot give that: its bytes sit inline in the Python object
+    (or inside a pymalloc arena), where an over-read lands in
+    uninstrumented slack and is silent.
+    """
+
+    def __init__(self, data: bytes):
+        self._raw = None
+        self._libc = None
+        if active_san():
+            global _libc
+            if _libc is None:
+                libc = ctypes.CDLL(None)
+                libc.malloc.argtypes = [ctypes.c_size_t]
+                libc.malloc.restype = ctypes.c_void_p
+                libc.free.argtypes = [ctypes.c_void_p]
+                libc.free.restype = None
+                _libc = libc
+            raw = _libc.malloc(max(len(data), 1))
+            if raw:
+                self._raw = raw
+                self._libc = _libc
+                ctypes.memmove(raw, data, len(data))
+                self.ptr = ctypes.cast(
+                    raw, ctypes.POINTER(ctypes.c_uint8))
+                return
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        self._buf = buf  # keepalive
+        self.ptr = ctypes.cast(buf, ctypes.POINTER(ctypes.c_uint8))
+
+    def __enter__(self) -> "ctypes.POINTER(ctypes.c_uint8)":
+        return self.ptr
+
+    def __exit__(self, *exc) -> None:
+        if self._raw is not None:
+            self._libc.free(self._raw)
+            self._raw = None
 
 
 def _as_u8_ptr(buf) -> "ctypes.POINTER(ctypes.c_uint8)":
@@ -191,50 +305,53 @@ def roaring_load_ex(data: bytes,
     lib = load()
     if lib is None:
         return None
-    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
-    h = lib.rb_load(buf, len(data))
-    if not h:
-        raise MemoryError("rb_load allocation failed")
-    try:
-        err = lib.rb_error(h)
-        if err:
-            raise NativeParseError(err.decode())
-        n = lib.rb_container_count(h)
-        keys = np.empty(n, dtype=np.uint64)
-        out = {
-            "op_n": int(lib.rb_op_count(h)),
-            "op_n_small": int(lib.rb_op_small_count(h)),
-            "ops_bytes": int(lib.rb_ops_bytes(h)),
-            "snapshot_bytes": int(lib.rb_snapshot_bytes(h)),
-            "tail_dropped": int(lib.rb_tail_dropped(h)),
-        }
-        if split_max_card is None:
-            words = np.empty((n, CONTAINER_WORDS), dtype=np.uint64)
+    # The staged buffer must outlive rb_free: compact-mode handles keep
+    # refs into the input bytes across the accessor calls below.
+    with _StagedBytes(data) as buf:
+        h = lib.rb_load(buf, len(data))
+        if not h:
+            raise MemoryError("rb_load allocation failed")
+        try:
+            err = lib.rb_error(h)
+            if err:
+                raise NativeParseError(err.decode())
+            n = lib.rb_container_count(h)
+            keys = np.empty(n, dtype=np.uint64)
+            out = {
+                "op_n": int(lib.rb_op_count(h)),
+                "op_n_small": int(lib.rb_op_small_count(h)),
+                "ops_bytes": int(lib.rb_ops_bytes(h)),
+                "snapshot_bytes": int(lib.rb_snapshot_bytes(h)),
+                "tail_dropped": int(lib.rb_tail_dropped(h)),
+            }
+            if split_max_card is None:
+                words = np.empty((n, CONTAINER_WORDS), dtype=np.uint64)
+                if n:
+                    lib.rb_copy_out(h, _as_u64_ptr(keys),
+                                    _as_u64_ptr(words))
+                out["keys"] = [int(k) for k in keys]
+                out["words"] = words
+                return out
+            counts = np.empty(n, dtype=np.uint64)
             if n:
-                lib.rb_copy_out(h, _as_u64_ptr(keys), _as_u64_ptr(words))
+                lib.rb_keys(h, _as_u64_ptr(keys))
+                lib.rb_counts(h, _as_u64_ptr(counts))
+            arr_mask = counts <= split_max_card
+            lows = np.empty(int(counts[arr_mask].sum()), dtype=np.uint16)
+            dense = np.empty((int((~arr_mask).sum()), CONTAINER_WORDS),
+                             dtype=np.uint64)
+            if n:
+                lib.rb_export_split(
+                    h, split_max_card,
+                    lows.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+                    _as_u64_ptr(dense))
             out["keys"] = [int(k) for k in keys]
-            out["words"] = words
+            out["counts"] = counts
+            out["lows"] = lows
+            out["dense"] = dense
             return out
-        counts = np.empty(n, dtype=np.uint64)
-        if n:
-            lib.rb_keys(h, _as_u64_ptr(keys))
-            lib.rb_counts(h, _as_u64_ptr(counts))
-        arr_mask = counts <= split_max_card
-        lows = np.empty(int(counts[arr_mask].sum()), dtype=np.uint16)
-        dense = np.empty((int((~arr_mask).sum()), CONTAINER_WORDS),
-                         dtype=np.uint64)
-        if n:
-            lib.rb_export_split(
-                h, split_max_card,
-                lows.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
-                _as_u64_ptr(dense))
-        out["keys"] = [int(k) for k in keys]
-        out["counts"] = counts
-        out["lows"] = lows
-        out["dense"] = dense
-        return out
-    finally:
-        lib.rb_free(h)
+        finally:
+            lib.rb_free(h)
 
 
 def roaring_serialize(keys: np.ndarray, words: np.ndarray) -> Optional[bytes]:

@@ -67,6 +67,14 @@ OP_ADD_BATCH = 2
 OP_REMOVE_BATCH = 3
 OP_ADD_ROARING = 4  # extension: roaring-snapshot payload, crc32 checksum
 
+# Maximum OP_ADD_ROARING nesting depth. A roaring-record payload is a
+# self-contained file, so crafted input can nest records inside records;
+# unbounded recursion would exhaust the stack on attacker-controlled
+# depth. Legitimate writers emit snapshot-only payloads (depth 1). The
+# native codec enforces the same bound (pilosa_native.cpp kMaxOpNesting)
+# so both readers agree on adversarial input.
+MAX_OP_NESTING = 4
+
 _FNV_OFFSET = 0x811C9DC5
 _FNV_PRIME = 0x01000193
 
@@ -894,15 +902,18 @@ class Bitmap:
 
     @classmethod
     def from_bytes(cls, data: bytes,
-                   tolerate_torn_tail: bool = False) -> "Bitmap":
+                   tolerate_torn_tail: bool = False,
+                   _depth: int = 0) -> "Bitmap":
         """Deserialize (reference unmarshalPilosaRoaring, roaring.go:1037),
         including ops-log replay from the file tail."""
         b = cls()
-        b.read_bytes(data, tolerate_torn_tail=tolerate_torn_tail)
+        b.read_bytes(data, tolerate_torn_tail=tolerate_torn_tail,
+                     _depth=_depth)
         return b
 
     def read_bytes(self, data: bytes,
-                   tolerate_torn_tail: bool = False) -> None:
+                   tolerate_torn_tail: bool = False,
+                   _depth: int = 0) -> None:
         """Deserialize. tolerate_torn_tail=True (Fragment.open recovering
         its OWN file after a crash) drops a final op record torn at EOF
         and reports it via self.tail_dropped; the default keeps fail-hard
@@ -963,8 +974,17 @@ class Bitmap:
         self._counts.clear()
         metas: List[Tuple[int, int, int]] = []
         pos = HEADER_BASE_SIZE
+        prev_key = -1
         for _ in range(n):
             key, typ, card_minus_1 = struct.unpack_from("<QHH", data, pos)
+            # Strictly-increasing keys are a format invariant; a
+            # duplicate would make "last container wins" semantics that
+            # the native reader (and the reference) reject. Fuzz corpus
+            # div-unsorted-keys pinned the divergence where this reader
+            # silently accepted out-of-order keys.
+            if key <= prev_key:
+                raise ValueError("container keys not sorted")
+            prev_key = key
             metas.append((key, typ, card_minus_1 + 1))
             pos += 12
         ops_offset = pos + 4 * n
@@ -1036,7 +1056,9 @@ class Bitmap:
                 self.direct_remove_n(values)
                 self.op_n += len(values)
             elif op_typ == OP_ADD_ROARING:
-                batch = Bitmap.from_bytes(values)
+                if _depth + 1 >= MAX_OP_NESTING:
+                    raise ValueError("op nesting too deep")
+                batch = Bitmap.from_bytes(values, _depth=_depth + 1)
                 self.op_n += batch.count()
                 self.union_in_place(batch)
             self.oplog_bytes += size

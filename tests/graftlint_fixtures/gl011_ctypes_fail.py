@@ -1,0 +1,34 @@
+"""GL011 fail fixture: foreign symbols called through a ctypes handle
+without full argtypes/restype declarations.
+
+`nat_count` declares only restype (argtypes missing -> default int
+conversion truncates the pointer argument on LP64); `nat_load` declares
+neither (its pointer-sized return value is ALSO mangled to c_int);
+`memcpy` is fully declared on a DIFFERENT handle (libc), which must not
+license the same-named symbol on `lib`.
+"""
+
+import ctypes
+
+lib = ctypes.CDLL("libnat_fixture.so")
+lib.nat_count.restype = ctypes.c_uint64
+
+libc = ctypes.CDLL(None)
+libc.memcpy.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                        ctypes.c_size_t]
+libc.memcpy.restype = ctypes.c_void_p
+
+
+def count(buf: bytes) -> int:
+    data = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+    return int(lib.nat_count(data, len(buf)))
+
+
+def load(buf: bytes) -> int:
+    data = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+    return int(lib.nat_load(data, len(buf)))
+
+
+def cross_handle(buf: bytes) -> None:
+    data = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+    lib.memcpy(data, data, len(buf))  # declared on libc, called on lib

@@ -1,0 +1,45 @@
+"""GL011 pass fixture: every called symbol is fully declared, through
+the same idioms native.py uses — a central bind step on an annotated
+handle, an annotated-return loader, and a handle alias.
+"""
+
+from typing import Optional
+
+import ctypes
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.nat_count.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                              ctypes.c_uint64]
+    lib.nat_count.restype = ctypes.c_uint64
+    lib.nat_load.argtypes = [ctypes.POINTER(ctypes.c_uint8),
+                             ctypes.c_uint64]
+    lib.nat_load.restype = ctypes.c_void_p
+    lib.nat_free.argtypes = [ctypes.c_void_p]
+    lib.nat_free.restype = None
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is None:
+        _lib = _bind(ctypes.CDLL("libnat_fixture.so"))
+    return _lib
+
+
+def count(buf: bytes) -> int:
+    lib = load()
+    assert lib is not None
+    data = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+    return int(lib.nat_count(data, len(buf)))
+
+
+def round_trip(buf: bytes) -> None:
+    lib = load()
+    assert lib is not None
+    alias = lib  # alias still resolves to the same declared handle
+    data = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+    handle = alias.nat_load(data, len(buf))
+    alias.nat_free(handle)

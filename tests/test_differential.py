@@ -1,0 +1,211 @@
+"""Three-way differential oracle: native codec == Python codec == device.
+
+The roaring container lattice is implemented three times — the native
+C++ parser (native/pilosa_native.cpp), the Python reference codecs
+(storage/roaring.py), and the packed-word device ops
+(ops/bitset.py + executor/bsi.py). The bulk-ingest path moves bits
+through all three; these property tests pin that they agree bit-exactly
+on generated bitmaps, that serialize∘parse is the identity through
+every reader/writer pairing, and that ``optimize()`` is idempotent.
+
+The byte-level adversarial version of this oracle is
+tools/roaring_fuzz.py (replayed from tests/fuzz_corpus/); this suite
+covers the *valid-input* space plus the device leg the fuzzer cannot
+reach.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import native
+from pilosa_tpu.executor import bsi
+from pilosa_tpu.ops.bitset import (
+    SHARD_WIDTH, b_and, b_or, count_and, count_or, pack_positions,
+    popcount, unpack_positions,
+)
+from pilosa_tpu.storage.roaring import Bitmap, _as_dense
+
+
+def _rand_positions(rng, n, hi=SHARD_WIDTH):
+    return np.unique(rng.integers(0, hi, size=n, dtype=np.uint64))
+
+
+def _force_python_bitmap(data: bytes) -> Bitmap:
+    with native.force_python():
+        return Bitmap.from_bytes(data)
+
+
+def _native_positions(data: bytes) -> np.ndarray:
+    """Sorted positions per the native parser."""
+    loaded = native.roaring_load(data)
+    assert loaded is not None, "native library unavailable"
+    keys, words, _, _ = loaded
+    out = []
+    for i, k in enumerate(keys):
+        bits = np.unpackbits(words[i].view(np.uint8), bitorder="little")
+        pos = np.nonzero(bits)[0].astype(np.uint64)
+        out.append(np.uint64(k << 16) + pos)
+    return np.concatenate(out) if out else np.empty(0, dtype=np.uint64)
+
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native library unavailable")
+
+
+# ------------------------------------------------- parse agreement
+
+
+@needs_native
+@pytest.mark.parametrize("seed,n", [(1, 50), (2, 5000), (3, 60000),
+                                    (4, 200000)])
+def test_three_way_positions_agree(seed, n):
+    """storage bytes -> native parse == python parse == device words."""
+    rng = np.random.default_rng(seed)
+    pos = _rand_positions(rng, n)
+    data = Bitmap(pos).write_bytes()
+
+    np.testing.assert_array_equal(_native_positions(data), pos)
+    np.testing.assert_array_equal(_force_python_bitmap(data).slice(), pos)
+
+    # Device leg: pack -> popcount on device == host cardinality, and
+    # the packed words round-trip back to the same positions.
+    words = pack_positions(pos)
+    assert int(popcount(words)) == len(pos)
+    np.testing.assert_array_equal(unpack_positions(words), pos)
+
+
+@needs_native
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_three_way_algebra_agree(seed):
+    """AND/OR through device ops == roaring set algebra (both codecs)
+    == numpy set ops."""
+    rng = np.random.default_rng(seed)
+    a_pos = _rand_positions(rng, 30000)
+    b_pos = _rand_positions(rng, 30000)
+    a_bytes = Bitmap(a_pos).write_bytes()
+    b_bytes = Bitmap(b_pos).write_bytes()
+
+    # Three parses of each operand must agree before we even compare ops.
+    for data, pos in ((a_bytes, a_pos), (b_bytes, b_pos)):
+        np.testing.assert_array_equal(_native_positions(data), pos)
+        np.testing.assert_array_equal(
+            _force_python_bitmap(data).slice(), pos)
+
+    aw, bw = pack_positions(a_pos), pack_positions(b_pos)
+    want_and = np.intersect1d(a_pos, b_pos)
+    want_or = np.union1d(a_pos, b_pos)
+
+    np.testing.assert_array_equal(
+        unpack_positions(np.asarray(b_and(aw, bw))), want_and)
+    np.testing.assert_array_equal(
+        unpack_positions(np.asarray(b_or(aw, bw))), want_or)
+    assert int(count_and(aw, bw)) == len(want_and)
+    assert int(count_or(aw, bw)) == len(want_or)
+
+    ba = _force_python_bitmap(a_bytes)
+    bb = _force_python_bitmap(b_bytes)
+    np.testing.assert_array_equal(ba.intersect(bb).slice(), want_and)
+    np.testing.assert_array_equal(ba.union(bb).slice(), want_or)
+    assert ba.intersection_count(bb) == len(want_and)
+
+    # Native word kernels over the dense u64 view.
+    a64 = np.ascontiguousarray(aw).view(np.uint64)
+    b64 = np.ascontiguousarray(bw).view(np.uint64)
+    assert native.intersection_count(a64, b64) == len(want_and)
+    assert native.popcount(a64) == len(a_pos)
+
+
+@needs_native
+def test_three_way_bsi_sum_agrees(seed=21, cols=4000, depth=12):
+    """BSI bit planes built from roaring-serialized rows: device
+    sum/eq == host arithmetic (the pack_positions -> BSI path)."""
+    rng = np.random.default_rng(seed)
+    col_ids = _rand_positions(rng, cols)
+    values = rng.integers(0, 1 << depth, size=len(col_ids),
+                          dtype=np.uint64)
+
+    planes = []
+    for bit in range(depth):
+        plane_pos = col_ids[(values >> np.uint64(bit)) & np.uint64(1) == 1]
+        # Round-trip every plane through the storage codec (both
+        # readers) before packing: the ingest path a plane actually
+        # takes into HBM.
+        data = Bitmap(plane_pos).write_bytes()
+        np.testing.assert_array_equal(_native_positions(data), plane_pos)
+        np.testing.assert_array_equal(
+            _force_python_bitmap(data).slice(), plane_pos)
+        planes.append(pack_positions(plane_pos))
+    planes.append(pack_positions(col_ids))  # not-null plane
+    stack = np.stack(planes)[:, None, :]    # [depth+1, S=1, W]
+
+    # sum_count returns per-plane counts; the 2^bit weighting happens
+    # host-side over exact ints (see its docstring).
+    plane_counts, count = bsi.sum_count(stack)
+    plane_counts = np.asarray(plane_counts)
+    total = sum(int(plane_counts[bit]) << bit for bit in range(depth))
+    assert total == int(values.sum())
+    assert int(np.asarray(count)) == len(col_ids)
+
+    probe = int(values[0])
+    eq_mask = np.asarray(bsi.eq(stack, probe))[0]
+    np.testing.assert_array_equal(
+        unpack_positions(eq_mask), col_ids[values == probe])
+
+
+# ------------------------------------------- round-trip + optimize
+
+
+@pytest.mark.parametrize("seed,n", [(31, 10), (32, 3000), (33, 150000)])
+def test_serialize_parse_identity_both_writers(seed, n):
+    """parse(write(b)) == b through the python writer and (when
+    available) the native-path writer, read by both readers."""
+    rng = np.random.default_rng(seed)
+    pos = _rand_positions(rng, n, hi=1 << 24)
+    b = Bitmap(pos)
+
+    with native.force_python():
+        py_bytes = b.write_bytes()
+        np.testing.assert_array_equal(
+            Bitmap.from_bytes(py_bytes).slice(), pos)
+
+    if native.available():
+        nat_bytes = b.write_bytes()
+        np.testing.assert_array_equal(_native_positions(nat_bytes), pos)
+        np.testing.assert_array_equal(
+            _force_python_bitmap(nat_bytes).slice(), pos)
+
+
+@pytest.mark.parametrize("seed", [41, 42])
+def test_optimize_preserves_state_and_is_idempotent(seed):
+    rng = np.random.default_rng(seed)
+    # A mix that crosses the array/dense threshold in both directions.
+    pos = np.concatenate([
+        _rand_positions(rng, 100, hi=1 << 16),
+        (1 << 16) + _rand_positions(rng, 60000, hi=1 << 16),
+        (5 << 16) + _rand_positions(rng, 4096, hi=1 << 16),
+    ])
+    b = Bitmap(np.unique(pos))
+    before = b.slice()
+    b.optimize()
+    np.testing.assert_array_equal(b.slice(), before)
+    assert b.optimize() == 0  # second pass converts nothing
+    np.testing.assert_array_equal(b.slice(), before)
+    # Serialization unaffected by in-memory encoding.
+    np.testing.assert_array_equal(
+        Bitmap.from_bytes(b.write_bytes()).slice(), before)
+
+
+@needs_native
+def test_full_and_empty_container_boundaries():
+    """Cardinality-65536 (card-1 wraps u16) and near-empty containers
+    through all three implementations."""
+    pos = np.concatenate([
+        np.arange(1 << 16, dtype=np.uint64),          # full container 0
+        np.array([(3 << 16) + 7], dtype=np.uint64),   # singleton
+    ])
+    data = Bitmap(pos).write_bytes()
+    np.testing.assert_array_equal(_native_positions(data), pos)
+    np.testing.assert_array_equal(_force_python_bitmap(data).slice(), pos)
+    words = pack_positions(pos)
+    assert int(popcount(words)) == len(pos)
+    np.testing.assert_array_equal(unpack_positions(words), pos)

@@ -34,6 +34,7 @@ def fixture_config() -> Config:
                       "graftlint_fixtures/gl007_gl008"),
         lock_block_paths=("graftlint_fixtures/gl009",),
         effect_paths=("graftlint_fixtures/gl010",),
+        ctypes_paths=("graftlint_fixtures/gl011",),
     )
 
 
@@ -60,6 +61,7 @@ def codes_for(filename, config=None):
     ("gl008_growth_fail.py", "gl008_growth_pass.py", "GL008"),
     ("gl009_blocking_fail.py", "gl009_blocking_pass.py", "GL009"),
     ("gl010_pairs_fail.py", "gl010_pairs_pass.py", "GL010"),
+    ("gl011_ctypes_fail.py", "gl011_ctypes_pass.py", "GL011"),
 ])
 def test_rule_fixtures(fail_fixture, pass_fixture, code):
     fail_codes = codes_for(fail_fixture)
@@ -120,6 +122,36 @@ def test_gl010_flags_every_pair_kind():
     assert codes_for("gl010_pairs_fail.py").count("GL010") == 3
 
 
+def test_gl011_flags_partial_and_missing_declarations():
+    # nat_count has restype but no argtypes; nat_load has neither;
+    # memcpy is declared only on the OTHER handle (libc) but called on
+    # lib. One finding per (handle, symbol), not per call site.
+    assert codes_for("gl011_ctypes_fail.py").count("GL011") == 3
+
+
+def test_gl011_reports_which_attr_is_missing():
+    findings = lint_files(
+        [os.path.join(FIXTURES, "gl011_ctypes_fail.py")],
+        fixture_config())
+    msgs = {f.message for f in findings if f.code == "GL011"}
+    assert any("`nat_count`" in m and "argtypes" in m
+               and "restype" not in m.split("declared")[0]
+               for m in msgs), msgs
+    assert any("`nat_load`" in m and "argtypes or restype" in m
+               for m in msgs), msgs
+
+
+def test_gl011_declarations_are_per_handle():
+    """A full declaration on libc must not silence the same-named
+    symbol called through lib — the corruption is per-library."""
+    findings = lint_files(
+        [os.path.join(FIXTURES, "gl011_ctypes_fail.py")],
+        fixture_config())
+    msgs = {f.message for f in findings if f.code == "GL011"}
+    assert any("`memcpy`" in m and "argtypes or restype" in m
+               for m in msgs), msgs
+
+
 def test_pass_fixtures_fully_clean():
     """Pass fixtures produce NO findings of any rule (not just 'not
     their own rule')."""
@@ -128,7 +160,8 @@ def test_pass_fixtures_fully_clean():
                  "gl003_hostsync_pass.py", "gl004_retrace_pass.py",
                  "gl005_dtype_pass.py", "gl006_jitsite_pass.py",
                  "gl007_ledger_pass.py", "gl008_growth_pass.py",
-                 "gl009_blocking_pass.py", "gl010_pairs_pass.py"):
+                 "gl009_blocking_pass.py", "gl010_pairs_pass.py",
+                 "gl011_ctypes_pass.py"):
         assert codes_for(name) == [], name
 
 

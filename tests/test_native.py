@@ -22,12 +22,8 @@ def _python_bitmap(data: bytes, tolerate_torn_tail: bool = False) -> Bitmap:
     """Force the pure-Python reader regardless of native availability."""
     b = Bitmap.__new__(Bitmap)
     b.__init__()
-    avail = native.available
-    native.available = lambda: False
-    try:
+    with native.force_python():
         b.read_bytes(data, tolerate_torn_tail=tolerate_torn_tail)
-    finally:
-        native.available = avail
     return b
 
 
@@ -62,12 +58,8 @@ def test_native_serialize_byte_identical():
     keys = sorted(b.containers)
     nk = np.array(keys, dtype=np.uint64)
     nw = np.stack([b.containers[k] for k in keys])
-    avail = native.available
-    native.available = lambda: False
-    try:
+    with native.force_python():
         python_bytes = b.write_bytes()
-    finally:
-        native.available = avail
     assert native.roaring_serialize(nk, nw) == python_bytes
 
 
@@ -378,3 +370,44 @@ def test_crash_point_fuzz_reopen_prefix_semantics(tmp_path):
         g.set_bit(3, 2999)
         assert g.bit(3, 2999)
         g.close()
+
+
+# ---------------------------------------------------- sanitizer variants
+
+
+def test_unknown_san_variant_yields_none(monkeypatch):
+    """An unrecognized PILOSA_TPU_NATIVE_SAN must NOT fall back to the
+    uninstrumented library — that would fake a green sanitized run."""
+    monkeypatch.setenv("PILOSA_TPU_NATIVE_SAN", "bogus")
+    assert native.load() is None
+    assert not native.available()
+
+
+def test_load_cache_is_keyed_on_san_variant(monkeypatch):
+    """A variant requested AFTER another was first loaded must not be
+    served that cached library (regression: a single _tried/_lib pair
+    pinned whatever variant touched load() first for process life)."""
+    base_lib = native.load()
+    base = native.active_san()
+    # The counterpart variant must be loadable WITHOUT a runtime
+    # preload, whatever leg this test runs under: plain and ubsan both
+    # qualify (dlopen'ing the asan .so into a process that did not
+    # preload libasan hard-aborts — "runtime does not come first").
+    other = "ubsan" if base != "ubsan" else ""
+    monkeypatch.setenv("PILOSA_TPU_NATIVE_SAN", other)
+    got = native.load()
+    assert got is not base_lib or base_lib is None
+    monkeypatch.setenv("PILOSA_TPU_NATIVE_SAN", base)
+    assert native.load() is base_lib
+
+
+def test_staged_bytes_uses_exact_malloc_block_under_san(monkeypatch):
+    """Under a sanitizer the input staging path must round-trip through
+    the exact-size libc malloc block (where ASan redzones sit)."""
+    monkeypatch.setenv("PILOSA_TPU_NATIVE_SAN", "ubsan")
+    data = bytes(range(256)) * 3
+    staged = native._StagedBytes(data)
+    with staged as ptr:
+        assert staged._raw is not None  # malloc path, not ctypes copy
+        assert bytes(ptr[i] for i in range(len(data))) == data
+    assert staged._raw is None  # freed on exit

@@ -265,3 +265,100 @@ def test_fragment_rows_dense_from_array_containers(tmp_path):
     np.testing.assert_array_equal(frag2.rows_dense([0, 1], 128)[0], want0)
     assert frag2.bit(1, 70000)
     frag2.close()
+
+
+# ---------------------------------------- container-transition properties
+
+
+def _container_lattice_cases(rng):
+    """In-container position sets straddling every encoding boundary:
+    the array<->dense threshold (ARRAY_MAX_SIZE = 4096), the run
+    thresholds in _serialize_container_seq, and the u16 edges."""
+    yield np.array([0], dtype=np.uint64)                     # singleton
+    yield np.array([0, 65535], dtype=np.uint64)              # u16 edges
+    yield np.arange(0, 65536, dtype=np.uint64)               # full
+    yield np.sort(rng.choice(65536, size=4096, replace=False)
+                  ).astype(np.uint64)                        # == threshold
+    yield np.sort(rng.choice(65536, size=4097, replace=False)
+                  ).astype(np.uint64)                        # threshold + 1
+    yield np.arange(100, 5000, dtype=np.uint64)              # one long run
+    yield np.concatenate([np.arange(i, i + 9, dtype=np.uint64)
+                          for i in range(0, 60000, 100)])    # many runs
+    yield np.sort(rng.choice(65536, size=30000, replace=False)
+                  ).astype(np.uint64)                        # dense random
+
+
+def test_array_dense_run_round_trips(rng):
+    """array->dense->array and run->dense->run are identities, and both
+    meet in the same dense words, at every boundary density."""
+    for pos in _container_lattice_cases(rng):
+        arr = pos.astype(np.uint16)
+        dense = rr._array_to_dense(arr)
+        np.testing.assert_array_equal(rr._dense_to_array(dense), arr)
+        runs = rr._dense_to_runs(dense)
+        # Runs are sorted, disjoint, non-adjacent (else they would have
+        # been one run), and expand back to the identical words.
+        assert (runs[:, 0] <= runs[:, 1]).all()
+        if len(runs) > 1:
+            assert (runs[1:, 0].astype(np.uint32)
+                    > runs[:-1, 1].astype(np.uint32) + 1).all()
+        np.testing.assert_array_equal(rr._runs_to_dense(runs), dense)
+        # Cardinality is conserved across all three encodings.
+        n = int(np.bitwise_count(dense).sum())
+        assert n == len(arr)
+        assert n == int((runs[:, 1].astype(np.uint64)
+                         - runs[:, 0].astype(np.uint64) + 1).sum())
+
+
+def test_optimize_flips_encodings_at_boundary_densities(rng):
+    """optimize() re-encodes exactly the containers at or below
+    ARRAY_MAX_SIZE, keeps denser ones dense, and the flip changes no
+    observable state (slice/count/serialization)."""
+    at = np.sort(rng.choice(65536, size=rr.ARRAY_MAX_SIZE,
+                            replace=False)).astype(np.uint64)
+    above = np.sort(rng.choice(65536, size=rr.ARRAY_MAX_SIZE + 1,
+                               replace=False)).astype(np.uint64)
+    pos = np.concatenate([at, (1 << 16) + above])
+    b = rr.Bitmap(pos)
+    before = b.slice()
+    assert b.containers[0].dtype == np.uint64  # mutation path is dense
+    assert b.optimize() == 1                   # only container 0 flips
+    assert b.containers[0].dtype == np.uint16
+    assert b.containers[1].dtype == np.uint64
+    np.testing.assert_array_equal(b.slice(), before)
+    assert b.optimize() == 0                   # idempotent
+    # A mutation re-materializes dense; optimize() flips it back (the
+    # removal keeps the count at the threshold, so it stays eligible).
+    removed = int(at[0])
+    assert b.remove(removed)
+    assert b.containers[0].dtype == np.uint64
+    assert b.optimize() == 1
+    assert not b.contains(removed)
+    # Serialized form is encoding-independent: the optimized bitmap and
+    # a freshly-built one emit identical bytes.
+    b.add(removed)
+    b.optimize()
+    assert b.write_bytes() == rr.Bitmap(pos).write_bytes()
+
+
+def test_serializer_picks_each_container_type_and_reader_inverts(rng):
+    """The writer's run/array/bitmap choice at boundary densities, and
+    read_bytes inverting every choice bit-exactly."""
+    cases = {
+        rr.CONTAINER_RUN: np.arange(0, 60000, dtype=np.uint64),
+        rr.CONTAINER_ARRAY: np.sort(
+            rng.choice(65536, size=1000, replace=False)
+        ).astype(np.uint64),
+        rr.CONTAINER_BITMAP: np.sort(
+            rng.choice(65536, size=30000, replace=False)
+        ).astype(np.uint64),
+    }
+    for want_typ, pos in cases.items():
+        data = rr.Bitmap(pos).write_bytes()
+        (_, n) = struct.unpack_from("<II", data, 0)
+        assert n == 1
+        _, typ, card_minus_1 = struct.unpack_from("<QHH", data, 8)
+        assert typ == want_typ, (want_typ, typ)
+        assert card_minus_1 + 1 == len(pos)
+        got = rr.Bitmap.from_bytes(data)
+        np.testing.assert_array_equal(got.slice(), pos)

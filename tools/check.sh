@@ -9,17 +9,85 @@
 # pyproject.toml and apply wherever the tools exist, e.g. dev laptops).
 # Everything else is mandatory and fails the gate.
 #
-# Usage: tools/check.sh [--fast]
+# Usage: tools/check.sh [--fast|--san]
 #   --fast  skip the full tier-1 pytest sweep (graftlint in --changed
 #           diff mode + native + lock-check + graftlint's own tests
 #           still run). The default path scans the full tree and
 #           writes the graftlint.sarif artifact.
+#   --san   the native sanitizer gate (docs/development.md "Native
+#           correctness plane"): ASan + UBSan builds of the roaring
+#           codec, fuzz-corpus replay + a deterministic fuzz run +
+#           the native-touching test subset under each. ASan needs its
+#           runtime preloaded (python is uninstrumented);
+#           availability-gated on gcc shipping libasan. The TSan
+#           target builds (make -C native SAN=tsan) but is not gated:
+#           TSan startup is nondeterministically flaky on old kernels
+#           (4.4) — run it manually where it works.
 
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 FAST=0
+SAN=0
 [ "${1:-}" = "--fast" ] && FAST=1
+[ "${1:-}" = "--san" ] && SAN=1
+
+if [ "$SAN" = 1 ]; then
+    fail=0
+    step() { printf '\n== %s\n' "$*"; }
+
+    step "sanitizer builds (asan, ubsan, tsan)"
+    make -C native SAN=asan || fail=1
+    make -C native SAN=ubsan || fail=1
+    make -C native SAN=tsan || fail=1
+
+    NATIVE_TESTS="tests/test_native.py tests/test_roaring.py \
+        tests/test_fuzz.py tests/test_differential.py"
+
+    step "UBSan: corpus replay + fuzz + native test subset"
+    # -fno-sanitize-recover: any UB aborts the process = a red run.
+    (
+        export PILOSA_TPU_NATIVE_SAN=ubsan
+        python -m tools.roaring_fuzz --replay \
+            && python -m tools.roaring_fuzz --seed 0 --iters 300 --no-save \
+            && JAX_PLATFORMS=cpu python -m pytest $NATIVE_TESTS -q \
+                -p no:cacheprovider
+    ) || fail=1
+
+    step "ASan: corpus replay + fuzz + native test subset"
+    LIBASAN="$(gcc -print-file-name=libasan.so 2>/dev/null || true)"
+    LIBSTDCXX="$(gcc -print-file-name=libstdc++.so 2>/dev/null || true)"
+    if [ -f "$LIBASAN" ]; then
+        # detect_leaks=0: CPython itself 'leaks' at interpreter exit;
+        # the target is heap corruption / OOB in the parser, which
+        # aborts regardless. Untrusted input is staged in exact-size
+        # malloc buffers (native.py _StagedBytes) so redzones sit at
+        # the precise boundary. libstdc++ rides in the preload too:
+        # python links no C++ runtime, so without it ASan's
+        # __cxa_throw interceptor never resolves and the first C++
+        # exception jaxlib throws turns into an ASan CHECK abort.
+        (
+            export LD_PRELOAD="$LIBASAN $LIBSTDCXX"
+            export ASAN_OPTIONS=detect_leaks=0
+            export PILOSA_TPU_NATIVE_SAN=asan
+            python -m tools.roaring_fuzz --replay \
+                && python -m tools.roaring_fuzz --seed 0 --iters 300 \
+                    --no-save \
+                && JAX_PLATFORMS=cpu python -m pytest $NATIVE_TESTS -q \
+                    -p no:cacheprovider
+        ) || fail=1
+    else
+        echo "libasan.so not found via gcc — ASan leg skipped"
+    fi
+
+    step "result"
+    if [ "$fail" = 0 ]; then
+        echo "check.sh --san: ALL CLEAN"
+    else
+        echo "check.sh --san: FAILURES (see above)"
+    fi
+    exit $fail
+fi
 
 fail=0
 step() { printf '\n== %s\n' "$*"; }
@@ -61,6 +129,12 @@ fi
 
 step "native build (-Wall -Wextra -Werror)"
 make -C native clean all || fail=1
+
+step "native static analysis (clang-tidy, fallback cppcheck)"
+# Pinned check list: native/.clang-tidy. Availability-gated like
+# ruff/mypy (exit 0 + a skip note when neither analyzer is installed);
+# emits native_tidy.sarif alongside graftlint.sarif for CI upload.
+python -m tools.native_tidy --output native_tidy.sarif || fail=1
 
 step "profiler smoke (one profiled query, JAX_PLATFORMS=cpu)"
 JAX_PLATFORMS=cpu python - <<'EOF' || fail=1

@@ -96,6 +96,10 @@ class Config:
     # TIMELINE.begin/finish, inc/dec) opened and closed in the same
     # function must close on exception edges too.
     effect_paths: Tuple[str, ...] = ("pilosa_tpu/",)
+    # GL011: packages where every foreign symbol called through a
+    # ctypes library handle must have argtypes AND restype declared
+    # (the native-boundary contract; pilosa_tpu/native.py _bind).
+    ctypes_paths: Tuple[str, ...] = ("pilosa_tpu/", "tools/", "benches/")
     select: Optional[Set[str]] = None
     ignore: Set[str] = field(default_factory=set)
 

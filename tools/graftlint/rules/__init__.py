@@ -10,6 +10,7 @@ from tools.graftlint.rules.gl007_ledger import GL007UnregisteredAllocation
 from tools.graftlint.rules.gl008_growth import GL008UnboundedGrowth
 from tools.graftlint.rules.gl009_blocking import GL009BlockingUnderLock
 from tools.graftlint.rules.gl010_pairs import GL010PairedEffects
+from tools.graftlint.rules.gl011_ctypes import GL011CtypesBoundary
 
 ALL_RULES = (
     GL001LockDiscipline(),
@@ -22,4 +23,5 @@ ALL_RULES = (
     GL008UnboundedGrowth(),
     GL009BlockingUnderLock(),
     GL010PairedEffects(),
+    GL011CtypesBoundary(),
 )

@@ -1,0 +1,214 @@
+"""GL011 — ctypes-boundary: declare argtypes/restype before calling.
+
+The native boundary (pilosa_tpu/native.py) crosses from Python into
+memory-unsafe C++ through ctypes. An ``extern "C"`` symbol called
+without an ``argtypes`` declaration silently falls back to ctypes'
+default int conversion — a pointer truncated to 32 bits on the way in,
+or a ``c_void_p`` handle mangled on the way out (the classic
+``restype`` default-int bug), neither of which any sanitizer can see
+because the corruption happens *before* the native code runs. The
+contract: every foreign symbol invoked through a library handle must
+have BOTH ``<handle>.<sym>.argtypes = [...]`` and
+``<handle>.<sym>.restype = ...`` declared somewhere in the module
+(native.py centralizes them in ``_bind``, which runs on every load
+path before any call).
+
+What counts as a library handle (per file):
+
+- a name assigned from ``ctypes.CDLL/PyDLL/WinDLL(...)``;
+- a name or attribute annotated with a type mentioning ``CDLL``;
+- a function parameter annotated ``ctypes.CDLL``;
+- a name assigned from a call to a local function whose return
+  annotation mentions ``CDLL`` (the ``lib = load()`` idiom);
+- aliases of any of the above (``_libc = libc``), matched on the
+  terminal name of the receiver chain (``self._libc.free`` ==
+  ``_libc``).
+
+Declarations are keyed per alias-canonicalized handle group, not by
+bare symbol name: ``libc.free.argtypes = ...`` does not license
+``lib.free(...)`` — a same-named symbol on a *different* library is
+its own undeclared foreign call.
+
+Lexical file-wide presence is the enforceable approximation of
+"declared before first call": cross-function textual order does not
+track runtime order, and the real failure mode this rule exists for is
+a symbol with NO declaration at all.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding, Project, Rule, SourceFile, dotted_name,
+)
+
+_LOADER_NAMES = {"CDLL", "PyDLL", "WinDLL", "OleDLL", "LibraryLoader"}
+_DECL_ATTRS = ("argtypes", "restype")
+
+
+def _imports_ctypes(sf: SourceFile) -> bool:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.split(".")[0] == "ctypes" for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            if (node.module or "").split(".")[0] == "ctypes":
+                return True
+    return False
+
+
+def _is_dll_constructor(call: ast.Call) -> bool:
+    fn = dotted_name(call.func)
+    return fn is not None and fn.split(".")[-1] in _LOADER_NAMES
+
+
+def _annotation_mentions_cdll(node: ast.AST) -> bool:
+    try:
+        return "CDLL" in ast.unparse(node)
+    except Exception:
+        return False
+
+
+def _collect_handles(sf: SourceFile) -> Dict[str, str]:
+    """Terminal name -> canonical handle-group name for every ctypes
+    library handle in this file. Aliases (``_libc = libc``) join their
+    source's group; independent handles (two CDLL() results, or a
+    CDLL-annotated name with no aliasing source) are their own group,
+    so declarations on one never license calls through another."""
+    # Union-find over terminal names: an alias assignment merges the
+    # two names' groups even when both were already rooted (e.g. an
+    # annotated module global `_libc: CDLL` later assigned `_libc =
+    # libc` — the annotation roots it first, the alias must still fold
+    # it into libc's declaration group).
+    parent: Dict[str, str] = {}
+
+    def _find(name: str) -> str:
+        while parent[name] != name:
+            parent[name] = parent[parent[name]]
+            name = parent[name]
+        return name
+
+    def _add(name: str) -> None:
+        parent.setdefault(name, name)
+
+    def _union(a: str, b: str) -> None:
+        _add(a)
+        _add(b)
+        ra, rb = _find(a), _find(b)
+        if ra != rb:
+            parent[ra] = rb
+
+    loader_fns: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.returns is not None and \
+                    _annotation_mentions_cdll(node.returns):
+                loader_fns.add(node.name)
+            for arg in (node.args.args + node.args.posonlyargs
+                        + node.args.kwonlyargs):
+                if arg.annotation is not None and \
+                        _annotation_mentions_cdll(arg.annotation):
+                    _add(arg.arg)
+        elif isinstance(node, ast.AnnAssign):
+            if _annotation_mentions_cdll(node.annotation):
+                tgt = dotted_name(node.target)
+                if tgt:
+                    _add(tgt.split(".")[-1])
+    # Assignment pass (two sweeps so aliases of loader results resolve
+    # regardless of lexical order).
+    for _ in range(2):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            alias_of = None
+            is_root = False
+            if isinstance(node.value, ast.Call):
+                fn = dotted_name(node.value.func)
+                if _is_dll_constructor(node.value) or \
+                        (fn is not None
+                         and fn.split(".")[-1] in loader_fns):
+                    is_root = True
+            elif isinstance(node.value, (ast.Name, ast.Attribute)):
+                nm = dotted_name(node.value)
+                if nm and nm.split(".")[-1] in parent:
+                    alias_of = nm.split(".")[-1]
+            if not is_root and alias_of is None:
+                continue
+            for t in node.targets:
+                tgt = dotted_name(t)
+                if not tgt:
+                    continue
+                name = tgt.split(".")[-1]
+                if alias_of is not None:
+                    _union(name, alias_of)
+                else:
+                    _add(name)
+    return {name: _find(name) for name in parent}
+
+
+def _split_symbol(node: ast.AST, handles: Dict[str, str]) -> \
+        Tuple[str, str] | Tuple[None, None]:
+    """(handle-group, symbol) when `node` is `<handle-chain>.<symbol>`."""
+    if not isinstance(node, ast.Attribute):
+        return None, None
+    base = dotted_name(node.value)
+    if base is None:
+        return None, None
+    terminal = base.split(".")[-1]
+    if terminal not in handles:
+        return None, None
+    return handles[terminal], node.attr
+
+
+class GL011CtypesBoundary(Rule):
+    code = "GL011"
+    name = "ctypes-boundary"
+
+    def check_file(self, sf: SourceFile,
+                   project: Project) -> Iterable[Finding]:
+        if not sf.in_path(project.config.ctypes_paths):
+            return []
+        if not _imports_ctypes(sf):
+            return []
+        handles = _collect_handles(sf)
+        if not handles:
+            return []
+
+        # Keyed (handle-group, symbol): a declaration on one library
+        # must not silence a same-named symbol on another.
+        declared: Dict[Tuple[str, str], Set[str]] = {}
+        calls: List[Tuple[Tuple[str, str], ast.Call]] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign):
+                # <handle>.<sym>.argtypes = ... / .restype = ...
+                for t in node.targets:
+                    if isinstance(t, ast.Attribute) \
+                            and t.attr in _DECL_ATTRS:
+                        grp, sym = _split_symbol(t.value, handles)
+                        if sym is not None:
+                            declared.setdefault(
+                                (grp, sym), set()).add(t.attr)
+            elif isinstance(node, ast.Call):
+                grp, sym = _split_symbol(node.func, handles)
+                if sym is not None:
+                    calls.append(((grp, sym), node))
+
+        out: List[Finding] = []
+        reported: Set[Tuple[str, str]] = set()
+        for key, call in calls:
+            sym = key[1]
+            missing = [a for a in _DECL_ATTRS
+                       if a not in declared.get(key, set())]
+            if not missing or key in reported:
+                continue
+            reported.add(key)
+            out.append(Finding(
+                sf.path, call.lineno, call.col_offset, self.code,
+                f"foreign symbol `{sym}` called without "
+                f"{' or '.join(missing)} declared — ctypes falls back "
+                f"to int conversion (pointer truncation / mangled "
+                f"handle); declare both in the bind step before any "
+                f"call (cf. pilosa_tpu/native.py _bind)"))
+        return out

@@ -1,0 +1,215 @@
+"""Static analysis for the native C++ boundary (clang-tidy / cppcheck).
+
+graftlint covers the Python tree; this module is its C++ counterpart
+for ``native/pilosa_native.cpp`` — the only memory-unsafe code in the
+repo, a parser for untrusted serialized bytes. It runs clang-tidy with
+the PINNED check list in ``native/.clang-tidy`` (falling back to
+cppcheck when clang-tidy is absent), normalizes both tools' output into
+one finding shape, and emits a SARIF 2.1.0 artifact
+(``native_tidy.sarif``) that CI uploads alongside ``graftlint.sarif``.
+
+Availability-gated like ruff/mypy: the jax_graft image bakes in neither
+analyzer, so a missing tool is reported and skipped (exit 0) — the
+config still applies wherever the tools exist (dev laptops, CI images
+with llvm). The gate is ``tools/check.sh`` (default path).
+
+CLI::
+
+    python -m tools.native_tidy                     # human text
+    python -m tools.native_tidy --output native_tidy.sarif
+
+Exit status: 0 clean or tool unavailable, 1 findings, 2 usage/crash.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "native")
+SOURCES = ("pilosa_native.cpp",)
+
+# Compile flags the analyzers must mirror from native/Makefile so the
+# analyzed translation unit is the one we ship.
+CXX_FLAGS = ("-O3", "-std=c++17", "-fPIC", "-Wall", "-Wextra",
+             "-pthread")
+
+# cppcheck fallback: keep the intent of the pinned clang-tidy list
+# (bugprone/analyzer-style correctness on untrusted-input parsing).
+# Suppressions mirror native/.clang-tidy and are documented there.
+CPPCHECK_ARGS = (
+    "--enable=warning,portability,performance",
+    "--inline-suppr",
+    "--suppress=missingIncludeSystem",
+    "--error-exitcode=0",  # findings counted from parsed output
+    "--template={file}:{line}:{column}: {severity}: {message} [{id}]",
+)
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+
+# Both tools are driven into one line shape:
+#   path:line:col: severity: message [check-id]
+_LINE_RE = re.compile(
+    r"^(?P<path>[^\s:][^:]*):(?P<line>\d+):(?P<col>\d+):\s*"
+    r"(?P<sev>error|warning|style|performance|portability|note):\s*"
+    r"(?P<msg>.*?)\s*\[(?P<check>[A-Za-z0-9_.,:-]+)\]\s*$")
+
+
+@dataclass(frozen=True)
+class TidyFinding:
+    path: str
+    line: int
+    col: int
+    check: str
+    severity: str
+    message: str
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.check}] {self.message}")
+
+
+def parse_findings(text: str) -> List[TidyFinding]:
+    """Findings from clang-tidy (native format) or cppcheck (driven
+    into the same shape by --template). `note:` continuation lines and
+    prose (statistics, suppression summaries) are dropped."""
+    out: List[TidyFinding] = []
+    for raw in text.splitlines():
+        m = _LINE_RE.match(raw.strip())
+        if not m or m.group("sev") == "note":
+            continue
+        out.append(TidyFinding(
+            path=os.path.relpath(m.group("path"), REPO)
+            if os.path.isabs(m.group("path")) else m.group("path"),
+            line=int(m.group("line")),
+            col=int(m.group("col")),
+            check=m.group("check"),
+            severity=m.group("sev"),
+            message=m.group("msg")))
+    return out
+
+
+def sarif_document(findings: Sequence[TidyFinding],
+                   tool_name: str) -> Dict[str, object]:
+    """SARIF 2.1.0, same shape as tools/graftlint/sarif.py so the two
+    artifacts merge cleanly in code-scanning UIs."""
+    rules: List[Dict[str, object]] = []
+    seen = set()
+    for f in findings:
+        if f.check in seen:
+            continue
+        seen.add(f.check)
+        rules.append({
+            "id": f.check,
+            "name": f.check,
+            "shortDescription": {"text": f.check},
+            "defaultConfiguration": {"level": "error"},
+        })
+    results = [{
+        "ruleId": f.check,
+        "level": "error" if f.severity in ("error", "warning") else "note",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": f.line, "startColumn": f.col},
+            },
+        }],
+    } for f in findings]
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": tool_name,
+                "informationUri":
+                    "docs/development.md#native-correctness-plane",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
+
+def _run(cmd: Sequence[str]) -> Optional[Tuple[int, str]]:
+    """(exit status, combined stdout+stderr), or None when the tool
+    cannot even be spawned. The status rides along so a tool that ran
+    but FAILED (bad flag, unsupported --config-file, crash) is
+    distinguishable from a clean zero-finding pass."""
+    try:
+        proc = subprocess.run(list(cmd), capture_output=True, text=True,
+                              timeout=600, cwd=REPO)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return proc.returncode, (proc.stdout or "") + (proc.stderr or "")
+
+
+def run_clang_tidy(sources: Sequence[str]) -> Optional[Tuple[int, str]]:
+    if shutil.which("clang-tidy") is None:
+        return None
+    cmd = ["clang-tidy", "--quiet",
+           f"--config-file={os.path.join(NATIVE_DIR, '.clang-tidy')}"]
+    cmd += [os.path.join(NATIVE_DIR, s) for s in sources]
+    cmd += ["--"] + list(CXX_FLAGS)
+    return _run(cmd)
+
+
+def run_cppcheck(sources: Sequence[str]) -> Optional[Tuple[int, str]]:
+    if shutil.which("cppcheck") is None:
+        return None
+    cmd = ["cppcheck", *CPPCHECK_ARGS,
+           *(os.path.join(NATIVE_DIR, s) for s in sources)]
+    return _run(cmd)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="native_tidy",
+        description="clang-tidy (fallback cppcheck) over the native "
+                    "roaring codec, with SARIF output")
+    ap.add_argument("--output", metavar="FILE", default=None,
+                    help="also write a SARIF 2.1.0 artifact")
+    args = ap.parse_args(argv)
+
+    res = run_clang_tidy(SOURCES)
+    tool = "clang-tidy"
+    if res is None:
+        res = run_cppcheck(SOURCES)
+        tool = "cppcheck"
+    if res is None:
+        print("native_tidy: neither clang-tidy nor cppcheck installed "
+              "— skipped (pinned config: native/.clang-tidy)")
+        return 0
+
+    status, text = res
+    findings = parse_findings(text)
+    if status != 0 and not findings:
+        # The tool is installed but its run failed outright (unknown
+        # flag, unsupported --config-file, crash): reporting that as a
+        # 0-finding clean pass would silently disable the C++ gate.
+        sys.stderr.write(text)
+        print(f"native_tidy: {tool} exited {status} with no parseable "
+              "findings — analyzer failure, not a clean pass")
+        return 2
+    for f in findings:
+        print(f.format())
+    if args.output:
+        with open(os.path.join(REPO, args.output), "w") as fh:
+            json.dump(sarif_document(findings, tool), fh, indent=2)
+            fh.write("\n")
+    print(f"native_tidy: {tool}: {len(findings)} finding(s) across "
+          f"{len(SOURCES)} file(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,454 @@
+"""Structured roaring-snapshot fuzzer + three-way differential oracle.
+
+The only memory-unsafe code in the tree is the native roaring codec
+(native/pilosa_native.cpp): a parser for *untrusted serialized bytes*
+that the bulk-ingest path pumps terabytes through. This module attacks
+it with structure-aware inputs and checks every outcome against the
+pure-Python reference reader (storage/roaring.py), which must agree
+bit-exactly — same accept/reject verdict, same container keys, same
+positions, same op accounting.
+
+Three layers:
+
+- **Generator** — seeded, deterministic builder of VALID snapshots
+  across the array/bitmap/run container lattice (including shapes the
+  production writer never emits: lying header cardinalities, shared
+  payload offsets, overlapping/unsorted runs, empty run containers)
+  plus op-log tails (single/batch/roaring records, nested payloads).
+- **Mutator** — byte-level corruption of valid files: truncation,
+  corrupted container counts/offsets/types, unsorted keys, bad
+  fnv/crc checksums, oversized batch counts, bit flips, garbage
+  appends.
+- **Oracle** — for every input, the native parse and the Python parse
+  must both fail, or both succeed with identical canonical state; on
+  success the state must survive a serialize -> reparse round trip
+  through BOTH writers and BOTH readers, and ``optimize()`` must be
+  idempotent.
+
+Everything is deterministic for a fixed ``--seed`` (per-case child
+seeds are spawned as ``default_rng([seed, index])``), so a failing case
+number is a reproducer on its own; failing inputs are additionally
+written to the corpus directory (``tests/fuzz_corpus/``) and replayed
+forever after by ``--replay`` (tools/check.sh --san) so a fixed bug
+stays fixed.
+
+CLI::
+
+    python -m tools.roaring_fuzz --seed 7 --iters 500
+    python -m tools.roaring_fuzz --replay tests/fuzz_corpus
+    python -m tools.roaring_fuzz --seed 7 --iters 100 --digest
+
+Exit status: 0 clean, 1 divergence/crash found (reproducer written if
+--corpus-dir), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import struct
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pilosa_tpu import native
+from pilosa_tpu.storage.roaring import (
+    Bitmap, CONTAINER_ARRAY, CONTAINER_BITMAP, CONTAINER_RUN,
+    MAGIC_NUMBER, OP_ADD, OP_ADD_BATCH, OP_REMOVE, OP_REMOVE_BATCH,
+    encode_op, encode_op_roaring,
+)
+
+DEFAULT_CORPUS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fuzz_corpus")
+
+
+# ------------------------------------------------------------- generator
+
+
+def _gen_container(rng: np.random.Generator) -> Tuple[int, int, bytes]:
+    """One container payload: (type, claimed_card_minus_1, payload).
+
+    The claimed cardinality sometimes LIES (readers must treat the
+    payload as authoritative), and run containers may be overlapping,
+    adjacent, out of order, or empty — all shapes the format accepts
+    but the production writer never emits."""
+    typ = int(rng.integers(1, 4))
+    if typ == CONTAINER_ARRAY:
+        card = int(rng.integers(1, 400))
+        vals = np.sort(rng.choice(1 << 16, size=card, replace=False)
+                       ).astype("<u2")
+        payload = vals.tobytes()
+        true_card = card
+    elif typ == CONTAINER_BITMAP:
+        density = rng.choice(["sparse", "half", "full", "empty"])
+        words = np.zeros(1024, dtype="<u8")
+        if density == "sparse":
+            idx = rng.choice(1024, size=8, replace=False)
+            words[idx] = rng.integers(1, 1 << 63, size=8, dtype=np.uint64)
+        elif density == "half":
+            words[:] = rng.integers(0, 1 << 63, size=1024, dtype=np.uint64)
+        elif density == "full":
+            words[:] = np.uint64(0xFFFFFFFFFFFFFFFF)
+        payload = words.tobytes()
+        true_card = int(np.bitwise_count(words).sum())
+    else:
+        run_n = int(rng.integers(0, 8))
+        runs = []
+        for _ in range(run_n):
+            a = int(rng.integers(0, 1 << 16))
+            b = int(rng.integers(0, 1 << 16))
+            if rng.random() < 0.7 and b < a:
+                a, b = b, a  # mostly well-formed; sometimes reversed
+            runs.append((a, b))
+        payload = struct.pack("<H", run_n) + b"".join(
+            struct.pack("<HH", a, b) for a, b in runs)
+        true_card = max(1, sum(max(0, b - a + 1) for a, b in runs))
+    claimed = true_card if rng.random() < 0.8 else int(rng.integers(1, 1 << 16))
+    return typ, (max(1, min(claimed, 1 << 16)) - 1) & 0xFFFF, payload
+
+
+def gen_snapshot(rng: np.random.Generator) -> bytes:
+    """A structurally-valid snapshot section."""
+    n = int(rng.integers(0, 7))
+    entries = [_gen_container(rng) for _ in range(n)]
+    keys = np.sort(rng.choice(1 << 20, size=n, replace=False)).tolist() \
+        if n else []
+    head = struct.pack("<HHI", MAGIC_NUMBER, 0, n)
+    metas = b"".join(
+        struct.pack("<QHH", keys[i], entries[i][0], entries[i][1])
+        for i in range(n))
+    payload_start = 8 + 12 * n + 4 * n
+    offs: List[int] = []
+    payloads = b""
+    for i in range(n):
+        if i and rng.random() < 0.05 and entries[i][0] == entries[i - 1][0]:
+            offs.append(offs[i - 1])  # shared payload offset (aliasing)
+        else:
+            offs.append(payload_start + len(payloads))
+            payloads += entries[i][2]
+    off_block = b"".join(struct.pack("<I", o) for o in offs)
+    return head + metas + off_block + payloads
+
+
+def gen_ops(rng: np.random.Generator, depth: int = 0) -> bytes:
+    """A valid op-log tail; occasionally includes roaring records with
+    their own (nested) op tails, the shape that pinned the
+    div-nested-op-tail divergence."""
+    out = b""
+    for _ in range(int(rng.integers(0, 5))):
+        kind = int(rng.integers(0, 5))
+        if kind == 0:
+            out += encode_op(OP_ADD, int(rng.integers(0, 1 << 24)))
+        elif kind == 1:
+            out += encode_op(OP_REMOVE, int(rng.integers(0, 1 << 24)))
+        elif kind in (2, 3):
+            vals = rng.integers(0, 1 << 24,
+                                size=int(rng.integers(1, 20)),
+                                dtype=np.uint64)
+            out += encode_op(OP_ADD_BATCH if kind == 2 else OP_REMOVE_BATCH,
+                             values=vals)
+        else:
+            payload = gen_snapshot(rng)
+            if depth < 2 and rng.random() < 0.3:
+                payload += gen_ops(rng, depth + 1)
+            out += encode_op_roaring(payload)
+    return out
+
+
+# -------------------------------------------------------------- mutator
+
+MUTATIONS = (
+    "truncate", "flip", "count", "offset", "type", "keys",
+    "checksum", "batch_count", "append",
+)
+
+
+def mutate(rng: np.random.Generator, data: bytes,
+           applied: Optional[List[str]] = None) -> bytes:
+    """Byte-corrupt a file. ``applied`` (when given) collects the kinds
+    that actually wrote — a drawn kind whose structural guard fails is
+    a no-op and is not recorded — so tests can prove no branch went
+    dead after a refactor. The rng draw sequence is identical either
+    way (determinism: corpus names pin content digests)."""
+    buf = bytearray(data)
+    for _ in range(int(rng.integers(1, 4))):
+        if not buf:
+            break
+        hit: Optional[str] = None
+        kind = MUTATIONS[int(rng.integers(0, len(MUTATIONS)))]
+        if kind == "truncate":
+            buf = buf[:int(rng.integers(0, len(buf)))]
+            hit = kind
+        elif kind == "flip":
+            i = int(rng.integers(0, len(buf)))
+            buf[i] ^= 1 << int(rng.integers(0, 8))
+            hit = kind
+        elif kind == "count" and len(buf) >= 8:
+            struct.pack_into(
+                "<I", buf, 4,
+                int(rng.choice([0, 1, 255, 0xFFFF, 0xFFFFFFFF])))
+            hit = kind
+        elif kind == "offset" and len(buf) >= 8:
+            (n,) = struct.unpack_from("<I", buf, 4)
+            if 0 < n < 1 << 16 and len(buf) >= 8 + 12 * n + 4 * n:
+                slot = 8 + 12 * n + 4 * int(rng.integers(0, n))
+                struct.pack_into(
+                    "<I", buf, slot,
+                    int(rng.choice([0, len(buf) - 1, len(buf),
+                                    0xFFFFFFFF])))
+                hit = kind
+        elif kind == "type" and len(buf) >= 8:
+            (n,) = struct.unpack_from("<I", buf, 4)
+            if 0 < n < 1 << 16 and len(buf) >= 8 + 12 * n:
+                slot = 8 + 12 * int(rng.integers(0, n)) + 8
+                struct.pack_into("<H", buf, slot,
+                                 int(rng.integers(0, 6)))
+                hit = kind
+        elif kind == "keys" and len(buf) >= 8:
+            (n,) = struct.unpack_from("<I", buf, 4)
+            if 1 < n < 1 << 16 and len(buf) >= 8 + 12 * n:
+                # Swap two container keys: unsorted/duplicate keys.
+                i, j = rng.choice(n, size=2, replace=False)
+                a = struct.unpack_from("<Q", buf, 8 + 12 * int(i))[0]
+                b = struct.unpack_from("<Q", buf, 8 + 12 * int(j))[0]
+                struct.pack_into("<Q", buf, 8 + 12 * int(i), b)
+                struct.pack_into("<Q", buf, 8 + 12 * int(j), a)
+                hit = kind
+        elif kind == "checksum" and len(buf) >= 4:
+            i = int(rng.integers(max(0, len(buf) - 64), len(buf)))
+            buf[i] ^= 0xFF
+            hit = kind
+        elif kind == "batch_count" and len(buf) >= 21:
+            # Reinterpret a tail slice as an op record and blow up its
+            # value/count field.
+            i = int(rng.integers(max(0, len(buf) - 128), len(buf) - 12))
+            big = (1 << 32, (1 << 64) - 1)[int(rng.integers(0, 2))]
+            struct.pack_into("<Q", buf, i + 1, big)
+            hit = kind
+        elif kind == "append":
+            buf += bytes(rng.integers(0, 256,
+                                      size=int(rng.integers(1, 40)),
+                                      dtype=np.uint8))
+            hit = kind
+        if hit is not None and applied is not None:
+            applied.append(hit)
+    return bytes(buf)
+
+
+def gen_case(seed: int, index: int) -> bytes:
+    """Deterministic case #index for a stream seed."""
+    rng = np.random.default_rng([seed, index])
+    data = gen_snapshot(rng)
+    if rng.random() < 0.7:
+        data += gen_ops(rng)
+    if rng.random() < 0.6:
+        data = mutate(rng, data)
+    return data
+
+
+# --------------------------------------------------------------- oracle
+
+
+def _canon_native(ex: dict) -> Dict[int, bytes]:
+    out = {}
+    for i, k in enumerate(ex["keys"]):
+        out[int(k)] = ex["words"][i].astype("<u8").tobytes()
+    return out
+
+
+def _canon_bitmap(b: Bitmap) -> Dict[int, bytes]:
+    from pilosa_tpu.storage.roaring import _as_dense
+    return {int(k): _as_dense(c).astype("<u8").tobytes()
+            for k, c in b.containers.items()
+            if b.container_count(int(k))}
+
+
+def _load_native(data: bytes):
+    """('ok', state, op_n, dropped) | ('error', msg) | None."""
+    try:
+        ex = native.roaring_load_ex(bytes(data))
+    except (ValueError, MemoryError) as e:
+        return ("error", str(e))
+    if ex is None:
+        return None
+    return ("ok", _canon_native(ex), ex["op_n"], ex["tail_dropped"])
+
+
+def _load_python(data: bytes):
+    """(verdict-tuple, Bitmap | None) — the bitmap rides along so
+    check_case's round-trip/optimize legs reuse the parse (Python parse
+    dominates per-case cost; it must not run twice)."""
+    try:
+        with native.force_python():
+            b = Bitmap.from_bytes(bytes(data), tolerate_torn_tail=True)
+    except (ValueError, OverflowError, IndexError, struct.error) as e:
+        return ("error", str(e)), None
+    return ("ok", _canon_bitmap(b), b.op_n, b.tail_dropped), b
+
+
+def check_case(data: bytes) -> List[str]:
+    """Every oracle violation for one input (empty = clean).
+
+    Native-vs-Python verdict and state agreement, serialize->reparse
+    identity through both writers/readers, optimize() idempotence."""
+    problems: List[str] = []
+    py, b = _load_python(data)
+    nat = _load_native(data)
+    if nat is not None:
+        if nat[0] != py[0]:
+            return [f"verdict diverged: native={nat[0]} ({nat[1] if nat[0] == 'error' else ''}) "
+                    f"python={py[0]} ({py[1] if py[0] == 'error' else ''})"]
+        if nat[0] == "ok":
+            if nat[1] != py[1]:
+                problems.append(
+                    f"state diverged: native keys "
+                    f"{sorted(nat[1])[:8]} != python keys "
+                    f"{sorted(py[1])[:8]}")
+            if nat[2] != py[2]:
+                problems.append(f"op_n diverged: native {nat[2]} != "
+                                f"python {py[2]}")
+            if nat[3] != py[3]:
+                problems.append(f"tail_dropped diverged: native {nat[3]} "
+                                f"!= python {py[3]}")
+    if py[0] != "ok":
+        return problems
+    # Round-trip identity: both writers through both readers. (Byte
+    # equality between writers is NOT asserted: encoding CHOICE is not
+    # part of the format contract.)
+    with native.force_python():
+        py_bytes = b.write_bytes()
+        b2 = Bitmap.from_bytes(py_bytes)
+        if _canon_bitmap(b2) != py[1]:
+            problems.append("python serialize->parse not identity")
+    nat2 = _load_native(py_bytes)
+    if nat2 is not None:
+        if nat2[0] != "ok":
+            problems.append(
+                f"native rejects python-serialized bytes: {nat2[1]}")
+        elif nat2[1] != py[1]:
+            problems.append("native parse of python bytes diverged")
+    if native.available():
+        nat_bytes = b.write_bytes()  # native-path writer
+        with native.force_python():
+            b3 = Bitmap.from_bytes(nat_bytes)
+            if _canon_bitmap(b3) != py[1]:
+                problems.append("python parse of native bytes diverged")
+        # Native write -> native reopen: the exact pairing production
+        # uses on the bulk-ingest path.
+        nat3 = _load_native(nat_bytes)
+        if nat3 is not None:
+            if nat3[0] != "ok":
+                problems.append(
+                    f"native rejects native-serialized bytes: {nat3[1]}")
+            elif nat3[1] != py[1]:
+                problems.append("native parse of native bytes diverged")
+    # optimize() must not change the bit state, and must be idempotent.
+    before = _canon_bitmap(b)
+    b.optimize()
+    if _canon_bitmap(b) != before:
+        problems.append("optimize() changed the bit state")
+    if b.optimize() != 0:
+        problems.append("optimize() not idempotent")
+    return problems
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def save_case(data: bytes, corpus_dir: str, prefix: str) -> str:
+    os.makedirs(corpus_dir, exist_ok=True)
+    name = f"{prefix}-{hashlib.sha256(data).hexdigest()[:12]}.bin"
+    path = os.path.join(corpus_dir, name)
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+def run_fuzz(seed: int, iters: int, corpus_dir: Optional[str],
+             verbose: bool = False) -> int:
+    digest = hashlib.sha256()
+    failures = 0
+    for i in range(iters):
+        data = gen_case(seed, i)
+        digest.update(data)
+        problems = check_case(data)
+        if problems:
+            failures += 1
+            where = ""
+            if corpus_dir:
+                where = " -> " + save_case(data, corpus_dir, "div")
+            print(f"roaring_fuzz: case seed={seed} index={i} "
+                  f"({len(data)} bytes){where}")
+            for p in problems:
+                print(f"  {p}")
+        elif verbose:
+            print(f"case {i}: ok ({len(data)} bytes)")
+    mode = "native+python" if native.available() else \
+        "python-only (native unavailable)"
+    print(f"roaring_fuzz: {iters} cases, {failures} failing, "
+          f"stream sha256 {digest.hexdigest()[:16]} [{mode}]")
+    return 1 if failures else 0
+
+
+def run_replay(corpus_dir: str) -> int:
+    if not os.path.isdir(corpus_dir):
+        print(f"roaring_fuzz: no corpus at {corpus_dir} — nothing to "
+              "replay")
+        return 0
+    names = sorted(n for n in os.listdir(corpus_dir)
+                   if n.endswith(".bin"))
+    failures = 0
+    for name in names:
+        with open(os.path.join(corpus_dir, name), "rb") as f:
+            data = f.read()
+        problems = check_case(data)
+        if problems:
+            failures += 1
+            print(f"roaring_fuzz: REGRESSION {name}")
+            for p in problems:
+                print(f"  {p}")
+    mode = "native+python" if native.available() else \
+        "python-only (native unavailable)"
+    print(f"roaring_fuzz: replayed {len(names)} corpus entries, "
+          f"{failures} regressions [{mode}]")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="roaring_fuzz",
+        description="structured roaring-snapshot fuzzer + native/python "
+                    "differential oracle")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--iters", type=int, default=200)
+    ap.add_argument("--corpus-dir", default=DEFAULT_CORPUS,
+                    help="where failing reproducers are written "
+                         f"(default: {DEFAULT_CORPUS})")
+    ap.add_argument("--no-save", action="store_true",
+                    help="do not write reproducers on failure")
+    ap.add_argument("--replay", metavar="DIR", nargs="?",
+                    const=DEFAULT_CORPUS, default=None,
+                    help="replay a committed corpus instead of fuzzing")
+    ap.add_argument("--digest", action="store_true",
+                    help="only print the generated-stream digest "
+                         "(determinism check)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.replay is not None:
+        return run_replay(args.replay)
+    if args.digest:
+        digest = hashlib.sha256()
+        for i in range(args.iters):
+            digest.update(gen_case(args.seed, i))
+        print(digest.hexdigest())
+        return 0
+    corpus = None if args.no_save else args.corpus_dir
+    return run_fuzz(args.seed, args.iters, corpus, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
