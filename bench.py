@@ -242,7 +242,35 @@ def bench_tpu(holder, partial):
             log(f"bench: timing budget hit after {len(times)} iters")
             break
     stage_timeline_breakdown(ex, q, partial)
+    cache_stats_stanza(ex, partial)
     return float(np.median(times)), want.pairs
+
+
+def cache_stats_stanza(ex, partial):
+    """Cross-request cache engagement during the timed loop (ISSUE 10):
+    how much of the repeated-TopN workload the device rank cache and
+    the result cache served, so the record shows WHICH regime the
+    headline number measured (cold sweeps vs warm cache). The
+    dedicated repeated-traffic bench with an off/on comparison is
+    benches/result_cache_bench.py (docs/perf.md §10). Best-effort: a
+    failure costs the stanza, never the headline number."""
+    try:
+        rc = ex.result_cache.snapshot()
+        partial["result_cache"] = {
+            "hits": rc["hits"], "misses": rc["misses"],
+            "hitRatio": round(rc["hitRatio"], 4),
+            "bytes": rc["bytes"], "enabled": rc["enabled"],
+        }
+        partial["rank_cache"] = {
+            "hits": ex.rank_cache_hits,
+            "patches": ex.rank_cache_patches,
+            "rebuilds": ex.rank_cache_rebuilds,
+            "warm_topn_hits": ex.topn_cache_hits,
+        }
+        log(f"bench: cache stats result={partial['result_cache']} "
+            f"rank={partial['rank_cache']}")
+    except Exception as e:
+        log(f"bench: cache stats failed: {e!r}")
 
 
 def stage_timeline_breakdown(ex, q, partial, iters: int = 3):

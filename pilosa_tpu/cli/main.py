@@ -181,6 +181,17 @@ def cmd_server(args) -> int:
                        ring=cfg.timeline_ring,
                        sample_every=cfg.timeline_sample_every,
                        gap_window_s=cfg.timeline_gap_window_s)
+    # Cross-request cache tier ([cache] section): the generation-keyed
+    # result cache lives on the executor, the device rank-cache store
+    # is process-wide. The PILOSA_TPU_RESULT_CACHE=0 /
+    # PILOSA_TPU_RANK_CACHE=0 env kill switches always win inside
+    # configure().
+    from pilosa_tpu.core.cache import RANK_CACHE
+    api.executor.result_cache.configure(
+        enabled=cfg.cache_result_enabled,
+        max_bytes=cfg.cache_result_max_bytes)
+    RANK_CACHE.configure(enabled=cfg.cache_rank_enabled,
+                         max_entries=cfg.cache_rank_max_entries)
     coalescer = None
     if cfg.coalescer_enabled:
         # Cross-request query coalescer: concurrent single-query POSTs

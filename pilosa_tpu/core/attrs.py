@@ -42,6 +42,11 @@ class AttrStore:
         self._log_fh = None
         self._log_entries = 0
         self._log_bytes = 0
+        # Monotonic mutation counter: attr writes do NOT bump fragment
+        # generations, so generation-keyed caches whose values embed
+        # attrs (the executor's request-level result cache) stamp this
+        # alongside — any set()/set_bulk() invalidates them.
+        self.gen = 0
 
     @property
     def _log_path(self) -> str:
@@ -78,6 +83,7 @@ class AttrStore:
 
     def _apply(self, items: Dict[int, Dict[str, Any]]) -> None:
         """Merge a delta batch into memory (null values delete keys)."""
+        self.gen += 1
         for id_, attrs in items.items():
             cur = self.attrs.setdefault(id_, {})
             for k, v in attrs.items():

@@ -14,10 +14,13 @@ the on-disk encoding here is our own).
 
 from __future__ import annotations
 
+import heapq
 import os
 import struct
 from collections import OrderedDict
-from typing import Dict, Iterable, List, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from pilosa_tpu.utils.locks import make_lock
 
 THRESHOLD_FACTOR = 1.1
 
@@ -47,21 +50,31 @@ class RankedCache:
         self.counts: Dict[int, int] = {}
         self._threshold = 0
         self.saturated = False
+        # One executor serves every request thread, and the sampled
+        # warm-cache self-check repairs caches from a request thread
+        # while write paths keep refreshing them — mutations must not
+        # interleave. Reads of `counts` stay lock-free by design
+        # (invalidate/recalculate REBIND the dict instead of mutating
+        # it in place, so a concurrent reader sees one consistent
+        # snapshot).
+        self._lock = make_lock("RankedCache._lock")
 
     def add(self, row_id: int, count: int) -> None:
-        if self.saturated:
-            return
-        if count == 0:
-            self.counts.pop(row_id, None)
-            return
-        if (len(self.counts) >= self.size * THRESHOLD_FACTOR
-                and count < self._threshold and row_id not in self.counts):
-            self.saturated = True
-            return
-        self.counts[row_id] = count
-        if len(self.counts) > self.size * THRESHOLD_FACTOR:
-            self._recalculate()
-            self.saturated = True
+        with self._lock:
+            if self.saturated:
+                return
+            if count == 0:
+                self.counts.pop(row_id, None)
+                return
+            if (len(self.counts) >= self.size * THRESHOLD_FACTOR
+                    and count < self._threshold
+                    and row_id not in self.counts):
+                self.saturated = True
+                return
+            self.counts[row_id] = count
+            if len(self.counts) > self.size * THRESHOLD_FACTOR:
+                self._recalculate()
+                self.saturated = True
 
     bulk_add = add
 
@@ -77,40 +90,54 @@ class RankedCache:
         return pairs[: self.size]
 
     def _recalculate(self) -> None:
-        pairs = self.top()
+        """Batch top-`size` selection (lock held): heapq.nlargest is
+        O(n log size) against the former full sort's O(n log n), and
+        the survivors land in a FRESH dict (rebind, not in-place) so
+        lock-free readers never observe a half-pruned map."""
+        pairs = heapq.nlargest(self.size, self.counts.items(),
+                               key=lambda kv: (kv[1], -kv[0]))
         self.counts = dict(pairs)
         self._threshold = pairs[-1][1] if len(pairs) >= self.size else 0
 
     def invalidate(self) -> None:
-        self.counts.clear()
-        self._threshold = 0
-        self.saturated = False
+        # O(1): rebind instead of clear() — clear() walks every slot
+        # under the lock AND yanks the dict out from under lock-free
+        # readers mid-iteration.
+        with self._lock:
+            self.counts = {}
+            self._threshold = 0
+            self.saturated = False
 
     def __len__(self) -> int:
         return len(self.counts)
 
 
 class LRUCache:
-    """LRU variant (reference lruCache, cache.go:58 / lru/lru.go)."""
+    """LRU variant (reference lruCache, cache.go:58 / lru/lru.go).
+    Mutations are lock-guarded like RankedCache; get() recency-touches
+    and therefore locks too."""
 
     def __init__(self, size: int = DEFAULT_CACHE_SIZE):
         self.size = size
         self.counts: "OrderedDict[int, int]" = OrderedDict()
+        self._lock = make_lock("LRUCache._lock")
 
     def add(self, row_id: int, count: int) -> None:
-        if row_id in self.counts:
-            self.counts.move_to_end(row_id)
-        self.counts[row_id] = count
-        while len(self.counts) > self.size:
-            self.counts.popitem(last=False)
+        with self._lock:
+            if row_id in self.counts:
+                self.counts.move_to_end(row_id)
+            self.counts[row_id] = count
+            while len(self.counts) > self.size:
+                self.counts.popitem(last=False)
 
     bulk_add = add
 
     def get(self, row_id: int) -> int:
-        if row_id in self.counts:
-            self.counts.move_to_end(row_id)
-            return self.counts[row_id]
-        return 0
+        with self._lock:
+            if row_id in self.counts:
+                self.counts.move_to_end(row_id)
+                return self.counts[row_id]
+            return 0
 
     def ids(self) -> List[int]:
         return sorted(self.counts)
@@ -119,7 +146,8 @@ class LRUCache:
         return sorted(self.counts.items(), key=lambda kv: (-kv[1], kv[0]))
 
     def invalidate(self) -> None:
-        self.counts.clear()
+        with self._lock:
+            self.counts = OrderedDict()
 
     def __len__(self) -> int:
         return len(self.counts)
@@ -204,6 +232,145 @@ def load_cache(cache, path: str, stamp: bytes = b"") -> bool:
         row_id, count = struct.unpack_from("<QQ", data, off + 16 * i)
         cache.add(row_id, count)
     return True
+
+
+# ---------------------------------------------------------------------
+# Device-resident rank cache (ROADMAP item 3b): the RankedCache idea —
+# per-row counts maintained so TopN never rescans rows (reference
+# cache.go:136) — promoted onto a [row_capacity] device vector in HBM.
+# Where the host RankedCache dies the moment cardinality exceeds its
+# bound (saturation latch above), the device vector covers EVERY bank
+# slot at 4 bytes/row, so leaderboard TopN over a warm bank becomes a
+# device top-k over precomputed counts instead of a [R, S, W] popcount
+# sweep. Entries validate lazily against fragment write versions:
+# unchanged versions reuse the vector as-is, small churn patches only
+# the written rows (executor._rank_counts), anything else rebuilds
+# with the one sweep TopN would have paid anyway.
+
+# Kill switch (mirrors PILOSA_TPU_RESULT_CACHE for the result tier).
+RANK_CACHE_ENV = "PILOSA_TPU_RANK_CACHE"
+
+
+def _rank_env_enabled() -> bool:
+    return os.environ.get(RANK_CACHE_ENV, "1") != "0"
+
+
+class RankEntry:
+    """One cached per-row count vector: `counts` is a device [Rcap]
+    array aligned with the ViewBank slot layout it was computed from.
+    `row_ids` is the SLOT-ordered row tuple of that bank (not the
+    sorted row set): equality proves the exact slot layout matches, so
+    the vector — and any incremental patch scattered into it — indexes
+    the same rows. Append-grown banks (`_patch_bank`) and freshly
+    sorted rebuilds hold the same rows in different slots; sorted-set
+    equality would wrongly validate across that."""
+
+    __slots__ = ("versions", "row_ids", "counts", "nbytes")
+
+    def __init__(self, versions: Dict[int, int], row_ids: tuple,
+                 counts: Any, nbytes: int) -> None:
+        self.versions = versions    # {shard: fragment.version} at build
+        self.row_ids = row_ids      # slot-ordered row-id tuple
+        self.counts = counts        # device [Rcap] int32
+        self.nbytes = nbytes
+
+
+class RankCacheStore:
+    """Process-wide LRU registry of RankEntry vectors, keyed
+    (view identity, shard tuple, width) — the BankBudget idiom for a
+    much smaller resource (4 B/row vs 4*S*W B/row for the bank
+    itself). Bounded by entry count; every admit/evict is mirrored
+    into the HBM memory ledger under category "rank_cache" so
+    /debug/memory totals stay provable and the watchdog sees it."""
+
+    def __init__(self, max_entries: int = 64) -> None:
+        self.enabled = _rank_env_enabled()
+        self.max_entries = max(1, int(max_entries))
+        self._lock = make_lock("RankCacheStore._lock")
+        self._entries: "OrderedDict[tuple, Tuple[Any, RankEntry]]" = \
+            OrderedDict()
+        self.evictions = 0
+
+    def configure(self, enabled: Optional[bool] = None,
+                  max_entries: Optional[int] = None) -> None:
+        """[cache] config wiring; the env kill switch always wins."""
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled) and _rank_env_enabled()
+            if max_entries is not None:
+                self.max_entries = max(1, int(max_entries))
+
+    def get(self, view: Any, key: tuple) -> Optional[RankEntry]:
+        with self._lock:
+            ent = self._entries.get((id(view), key))
+            if ent is None:
+                return None
+            self._entries.move_to_end((id(view), key))
+            return ent[1]
+
+    def put(self, view: Any, key: tuple, entry: RankEntry) -> None:
+        from pilosa_tpu.utils.memledger import LEDGER
+        ek = (id(view), key)
+        with self._lock:
+            self._entries.pop(ek, None)
+            while len(self._entries) >= self.max_entries:
+                (_vid, vkey), (v, _e) = self._entries.popitem(last=False)
+                self.evictions += 1
+                # Under the store lock (ledger lock is a leaf): an
+                # evict/re-put interleave must not unregister another
+                # thread's freshly registered entry. The ledger scopes
+                # owner-registered keys to the owner, so unregister
+                # must name the same (owner, key) pair register did.
+                LEDGER.unregister("rank_cache", vkey, owner=v)
+            self._entries[ek] = (view, entry)
+            LEDGER.register(
+                "rank_cache", key, entry.nbytes, owner=view,
+                index=getattr(view, "index", ""),
+                field=getattr(view, "field", ""),
+                view=getattr(view, "name", ""),
+                rows=len(entry.row_ids))
+
+    def forget_view(self, view: Any) -> None:
+        """Drop every entry of a closing view (View.close calls this);
+        ledger rows unregister so /debug/memory never counts freed
+        HBM."""
+        from pilosa_tpu.utils.memledger import LEDGER
+        vid = id(view)
+        with self._lock:
+            dead = [ek for ek in self._entries if ek[0] == vid]
+            for ek in dead:
+                self._entries.pop(ek, None)
+                LEDGER.unregister("rank_cache", ek[1], owner=view)
+
+    def clear(self) -> None:
+        from pilosa_tpu.utils.memledger import LEDGER
+        with self._lock:
+            for ek, (v, _e) in list(self._entries.items()):
+                self._entries.pop(ek, None)
+                LEDGER.unregister("rank_cache", ek[1], owner=v)
+
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(e.nbytes for _, e in self._entries.values())
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "entries": len(self._entries),
+                "bytes": sum(e.nbytes
+                             for _, e in self._entries.values()),
+                "maxEntries": self.max_entries,
+                "evictions": self.evictions,
+            }
+
+
+# The process-wide rank-cache store (one process, one HBM pool — the
+# BANK_BUDGET convention).
+RANK_CACHE = RankCacheStore()
 
 
 class Pairs:

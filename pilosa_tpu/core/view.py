@@ -301,6 +301,9 @@ class View:
             for key in list(self._host_blocks):
                 HOST_BLOCK_BUDGET.forget(self, key)
             self._host_blocks.clear()
+            # Rank-cache vectors are keyed on this view's identity;
+            # drop them (and their ledger rows) with the banks.
+            cache_mod.RANK_CACHE.forget_view(self)
             for frag in self.fragments.values():
                 frag.close()
 
@@ -312,6 +315,18 @@ class View:
 
     def fragment(self, shard: int) -> Optional[Fragment]:
         return self.fragments.get(shard)
+
+    def version_stamp(self) -> tuple:
+        """Every fragment's write version as one orderable tuple — the
+        generation stamp the request-level result cache validates
+        against. ANY mutation anywhere in the view changes it: every
+        write funnels through Fragment._touch_row (version bump), and
+        a fragment created or recreated starts at a fresh process-
+        unique epoch, so a stamp can never read as current across a
+        resize."""
+        with self._lock:
+            return tuple(sorted((s, f.version)
+                                for s, f in self.fragments.items()))
 
     def create_fragment_if_not_exists(self, shard: int) -> Fragment:
         with self._lock:
@@ -799,6 +814,15 @@ class View:
         new_rows = [r for r in row_set if r not in cached.slots]
         if len(cached.slots) + len(new_rows) + 1 > cached.array.shape[0]:
             return None
+        for s, newv in versions.items():
+            old = cached.versions.get(s, -1)
+            if old != newv and (old < 0 or (old >> 48) != (newv >> 48)):
+                # Version epoch moved: the fragment was recreated since
+                # this bank was built (pop + reload), so its
+                # _row_versions no longer attributes writes made in the
+                # old incarnation — rows_changed_since below would
+                # under-patch. Rebuild.
+                return None
         patches = []  # (slot, shard_idx, words)
         for si, s in enumerate(shards):
             f = frags[s]

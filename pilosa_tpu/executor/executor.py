@@ -52,10 +52,12 @@ from pilosa_tpu.ops.bitset import SHARD_WIDTH, WORDS_PER_SHARD, \
     transfer_nbytes
 from pilosa_tpu.pql import Call, Condition, Query, parse_string_cached
 from pilosa_tpu.pql.ast import BETWEEN, EQ, GT, GTE, LT, LTE, NEQ
+from pilosa_tpu.utils.fingerprint import request_key
 from pilosa_tpu.utils.hotspots import WORKLOAD
 from pilosa_tpu.utils.memledger import LEDGER
 from pilosa_tpu.utils.timeline import (
-    LANE_DEVICE, LANE_DISPATCH, LANE_FETCH, LANE_PLAN, TIMELINE,
+    LANE_CACHE, LANE_DEVICE, LANE_DISPATCH, LANE_FETCH, LANE_PLAN,
+    TIMELINE,
 )
 
 _LOG = logging.getLogger("pilosa_tpu.executor")
@@ -214,6 +216,57 @@ def prefetch_pendings(staged) -> None:
                         pass  # transfer still happens in finalize
 
 
+class _CacheFillEval:
+    """Stands between a terminal eval's device output (device array or
+    fusion FusedEval handle) and its consumers so the first HOST
+    materialization also fills the result cache's eval tier — the
+    "existing materialize seam": no extra fence, no extra transfer,
+    the fill rides the fetch the consumer was paying anyway. Mirrors
+    the slice of the FusedEval surface result/finalize code touches."""
+
+    __slots__ = ("inner", "cache", "key", "gen", "_host")
+
+    def __init__(self, inner, cache, key, gen):
+        self.inner = inner
+        self.cache = cache
+        self.key = key
+        self.gen = gen
+        self._host = None
+
+    @property
+    def shape(self):
+        return self.inner.shape
+
+    @property
+    def nbytes(self) -> int:
+        return int(getattr(self.inner, "nbytes", 0) or 0)
+
+    def device_words(self):
+        """Device-side view for consumers that avoid the host bounce
+        (RowResult.count)."""
+        dw = getattr(self.inner, "device_words", None)
+        return dw() if dw is not None else self.inner
+
+    def copy_to_host_async(self) -> None:
+        fn = getattr(self.inner, "copy_to_host_async", None)
+        if fn is not None:
+            fn()
+
+    # graftlint: materialize — this IS the device->host boundary for
+    # cached terminal evals (the FusedEval.host convention): the fetch
+    # happens exactly once, and the host copy both serves the caller
+    # and fills the cache.
+    def __array__(self, dtype=None, copy=None):
+        host = self._host
+        if host is None:
+            host = np.asarray(self.inner)
+            self._host = host
+            self.cache.fill(self.key, self.gen, host, host.nbytes,
+                            tier="eval")
+        return np.asarray(host, dtype=dtype) if dtype is not None \
+            else host
+
+
 # graftlint: materialize — sampled device-time fence: reached ONLY when
 # the active QueryProfile requests device sampling (?profile=true or the
 # configured 1-in-N sample). The unprofiled hot path never calls it, so
@@ -357,13 +410,20 @@ class _StagedEval:
     idxs: List[int]        # traced gather slots (host values)
     params: List[int]      # traced u32 scalars (host values)
     lits: Any              # stacked [L, S, W] device literals or None
-    # Workload-recorder identity: the semantic fingerprint a result
-    # cache would key on (sig + row ids + params — row IDS, not bank
-    # slots, so it is stable across bank rebuilds), and the operand
-    # banks' generation (fragment write versions) it was staged
-    # against. None when recording is disabled.
+    # Workload-recorder AND result-cache identity: the semantic
+    # fingerprint (sig + row ids + params — row IDS, not bank slots,
+    # so it is stable across bank rebuilds), and the operand banks'
+    # generation (fragment write versions) it was staged against —
+    # together the exact (key, generation) pair the eval tier of
+    # executor/result_cache.py caches under. None when both the
+    # workload recorder and the result cache are off.
     fp: Any = None
     gen: Any = None
+    # False when the plan carries eager literal operands (the
+    # >MAX_STATIC_RANGE_VIEWS time-range union): literal content is
+    # not named by fp/gen, so such evals must never be served from or
+    # fill the result cache.
+    cacheable: bool = True
 
     def runner(self) -> Callable:
         """The traceable program body: expr + the mode's reduction."""
@@ -434,6 +494,21 @@ class Executor:
         # attaches; batch-scoped signals (fusion group sizes) that have
         # no per-query profile to ride report through it.
         self.stats = None
+        # Generation-keyed cross-request result cache (ROADMAP item
+        # 3a; executor/result_cache.py): request tier keyed on the
+        # coalescer's request identity, eval tier keyed on the staged
+        # fingerprint + bank generations. PILOSA_TPU_RESULT_CACHE=0
+        # kills it.
+        from pilosa_tpu.executor.result_cache import ResultCache
+        self.result_cache = ResultCache()
+        # Device rank-cache counters (core/cache.RANK_CACHE holds the
+        # vectors; the store is process-wide, the counters per
+        # executor so tests and /metrics attribute them): hits reuse a
+        # warm [R] count vector, patches recompute only written rows,
+        # rebuilds pay the full sweep TopN would have paid anyway.
+        self.rank_cache_hits = 0
+        self.rank_cache_patches = 0
+        self.rank_cache_rebuilds = 0
         # Observability: TopN answers served from warm ranked caches
         # without any device work (reference fragment.top, fragment.go:1067).
         self.topn_cache_hits = 0
@@ -572,6 +647,114 @@ class Executor:
             self.stats.count("executor.fused_queries", group_size)
             self.stats.histogram("executor.fusion_group_size", group_size)
 
+    # -------------------------------------------- request-level result cache
+
+    @contextlib.contextmanager
+    def _dep_capture(self, deps: Optional[dict]):
+        """Attach a request-tier dependency collector to this thread
+        for the duration (None = no capture, zero overhead). The
+        staging seam and the attr/translation read points record the
+        version stamps the cached response will later be validated
+        against."""
+        if deps is None:
+            yield
+            return
+        prev = getattr(self._tls, "deps", None)
+        self._tls.deps = deps
+        try:
+            yield
+        finally:
+            self._tls.deps = prev
+
+    def _request_cache_key(self, index_name: str, query, shards
+                           ) -> Optional[tuple]:
+        """The request tier's cache key, or None when the request is
+        ineligible: cache off, mesh/cluster deployment (remote legs
+        cache per node through the eval tier instead), non-string
+        query, unparseable, or any call outside the staged-eval family
+        (Count + bitmap calls — the flood workload; TopN rides the
+        device rank cache, writes are never cacheable)."""
+        if not self.result_cache.enabled or self.mesh is not None \
+                or self.key_resolver is not None:
+            return None
+        if not isinstance(query, str):
+            return None
+        try:
+            q = parse_string_cached(query)
+        except Exception:
+            return None
+        calls = q.calls if isinstance(q, Query) else [q]
+        for c in calls:
+            if c.name != "Count" and c.name not in _BITMAP_CALLS:
+                return None
+        return ("req",) + request_key(index_name, query, shards)
+
+    def _request_deps_current(self, deps: dict) -> bool:
+        """Revalidate a request-tier dependency snapshot with pure
+        host dict reads — the whole point: a hit touches no parser, no
+        planner, no device."""
+        for dk, val in deps.items():
+            if not isinstance(dk, tuple):
+                return False  # e.g. a stray "uncacheable" marker
+            kind = dk[0]
+            if kind == "view":
+                _, iname, fname, vname = dk
+                idx = self.holder.index(iname)
+                f = idx.field(fname) if idx is not None else None
+                view = f.view(vname) if f is not None else None
+                cur = view.version_stamp() if view is not None else ()
+            elif kind == "rattr":
+                _, iname, fname = dk
+                idx = self.holder.index(iname)
+                f = idx.field(fname) if idx is not None else None
+                cur = f.row_attr_store.gen if f is not None else -1
+            elif kind == "ctrans":
+                _, iname = dk
+                idx = self.holder.index(iname)
+                cur = idx.column_translator.size() \
+                    if idx is not None else -1
+            else:
+                return False
+            if cur != val:
+                return False
+        return True
+
+    def _request_cache_get(self, key: tuple, profile=None
+                           ) -> Optional[Dict[str, Any]]:
+        """Request-tier lookup + hit attribution (cacheHit profile op,
+        timeline `cache` lane slice)."""
+        t0 = time.perf_counter()
+        val = self.result_cache.lookup_request(
+            key, self._request_deps_current)
+        if val is None:
+            return None
+        if profile is not None:
+            dur = time.perf_counter() - t0
+            op = profile.begin_op("cache")
+            op.attrs["cacheHit"] = True
+            profile.end_op(op, dur)
+            tl = getattr(profile, "timeline", None)
+            if tl is not None:
+                TIMELINE.event(tl, "cache", LANE_CACHE, t0, dur,
+                               hit=True)
+        return val
+
+    def _request_cache_fill(self, key: tuple, deps: dict,
+                            resp: Dict[str, Any],
+                            opts: Optional["ExecOptions"] = None
+                            ) -> None:
+        """Fill the request tier after shaping. Refused when the
+        capture flagged a dependency it cannot name (literal operands)
+        or the response embeds columnAttrs (shaped outside the
+        capture window)."""
+        if "uncacheable" in deps or not deps:
+            return
+        if opts is not None and opts.column_attrs:
+            return
+        from pilosa_tpu.executor.result_cache import approx_nbytes
+        self.result_cache.fill(key, dict(deps), resp,
+                               approx_nbytes(resp), tier="request")
+
     # ------------------------------------------------------------------ API
 
     def execute(self, index_name: str, query, shards: Optional[Sequence[int]]
@@ -665,7 +848,8 @@ class Executor:
         return results
 
     def execute_batch(self, requests: Sequence[Tuple[str, Any, Optional[
-            Sequence[int]]]], profiles: Optional[Sequence[Any]] = None
+            Sequence[int]]]], profiles: Optional[Sequence[Any]] = None,
+            deps: Optional[Sequence[Optional[dict]]] = None
             ) -> List[Any]:
         """Execute N independent queries with ONE pipelined device
         drain: every query's calls are dispatched before any result is
@@ -684,9 +868,17 @@ class Executor:
         Returns one entry per request: a (results, opts) tuple on
         success — opts drives response shaping (columnAttrs), see
         shape_response — or the exception instance for that request
-        (per-request errors don't fail the batch)."""
+        (per-request errors don't fail the batch).
+
+        `deps` (optional, aligned with `requests`) carries per-request
+        dependency-capture dicts for the request-tier result cache:
+        a non-None entry is attached to the thread while that
+        request's dispatch and finalize phases run (execute_batch_
+        shaped feeds these and fills the cache after shaping)."""
         from pilosa_tpu.executor.fusion import FusionCollector
         profs = list(profiles) if profiles is not None \
+            else [None] * len(requests)
+        deps_l = list(deps) if deps is not None \
             else [None] * len(requests)
         staged_q: List[Any] = []
         out: List[Any] = [None] * len(requests)
@@ -726,7 +918,8 @@ class Executor:
                 try:
                     if has_writes[j]:
                         fuser.flush()
-                    with self._profiled(profs[j]):
+                    with self._profiled(profs[j]), \
+                            self._dep_capture(deps_l[j]):
                         if has_writes[j]:
                             ctx = contextlib.nullcontext()
                         else:
@@ -746,7 +939,8 @@ class Executor:
             prefetch_pendings(staged)
         for j, (idx, staged, opts) in staged_q:
             try:
-                with self._profiled(profs[j]):
+                with self._profiled(profs[j]), \
+                        self._dep_capture(deps_l[j]):
                     out[j] = (self._finalize_staged(idx, staged), opts)
             except Exception as e:
                 out[j] = e
@@ -759,20 +953,57 @@ class Executor:
         request, either the shaped {"results": ...} dict or the
         exception instance for that request. Shared by API.query_batch
         (the /batch/query route) and the serving-path coalescer — one
-        place owns the shape-or-error contract."""
-        out: List[Any] = []
-        for (index_name, _, _), res in zip(requests,
-                                           self.execute_batch(
-                                               requests,
-                                               profiles=profiles)):
-            if isinstance(res, Exception):
-                out.append(res)
+        place owns the shape-or-error contract.
+
+        This is the batch seam of the request-tier result cache:
+        eligible requests are answered from cache before anything
+        dispatches, and misses execute under dependency capture and
+        fill after shaping. A request positioned AFTER a
+        write-containing batchmate never consults the cache — its
+        lookup would run before that write does, and sequential
+        semantics demand it observe post-write state."""
+        n = len(requests)
+        profs = list(profiles) if profiles is not None else [None] * n
+        out: List[Any] = [None] * n
+        keys: List[Optional[tuple]] = [None] * n
+        deps_l: List[Optional[dict]] = [None] * n
+        run: List[int] = []
+        write_seen = False
+        for j, (index_name, q, shards) in enumerate(requests):
+            forced = profs[j] is not None and getattr(
+                profs[j], "forced", False)
+            key = None
+            if not write_seen and not forced:
+                key = self._request_cache_key(index_name, q, shards)
+            if not write_seen and query_is_write(q):
+                write_seen = True
+            if key is not None:
+                hit = self._request_cache_get(key, profs[j])
+                if hit is not None:
+                    out[j] = hit
+                    continue
+                keys[j] = key
+                deps_l[j] = {}
+            run.append(j)
+        res = self.execute_batch(
+            [requests[j] for j in run],
+            profiles=[profs[j] for j in run],
+            deps=[deps_l[j] for j in run])
+        for j, r in zip(run, res):
+            index_name = requests[j][0]
+            if isinstance(r, Exception):
+                out[j] = r
                 continue
-            results, opts = res
+            results, opts = r
             try:
-                out.append(self.shape_response(index_name, results, opts))
+                shaped = self.shape_response(index_name, results, opts)
             except Exception as e:
-                out.append(e)
+                out[j] = e
+                continue
+            if deps_l[j] is not None:
+                self._request_cache_fill(keys[j], deps_l[j], shaped,
+                                         opts)
+            out[j] = shaped
         return out
 
     def execute_full(self, index_name: str, query,
@@ -780,10 +1011,30 @@ class Executor:
                      ) -> Dict[str, Any]:
         """Execute and return the full JSON-shaped response, including
         `columnAttrs` when an Options(columnAttrs=true) call requested them
-        (reference executor.Execute, executor.go:134-165)."""
-        results, opts = self._execute_query(index_name, query, shards,
-                                            profile=profile)
-        return self.shape_response(index_name, results, opts)
+        (reference executor.Execute, executor.go:134-165).
+
+        Eligible read-only requests ride the request tier of the
+        result cache: a generation-valid repeat returns the cached
+        shaped response without parsing, planning, compiling or
+        dispatching anything; misses execute under dependency capture
+        and fill after shaping. Forced (?profile=true) profiles bypass
+        the lookup — their tree must describe a real execution — but
+        still refresh the fill."""
+        key = self._request_cache_key(index_name, query, shards)
+        forced = profile is not None and getattr(profile, "forced",
+                                                 False)
+        if key is not None and not forced:
+            hit = self._request_cache_get(key, profile)
+            if hit is not None:
+                return hit
+        deps: Optional[dict] = {} if key is not None else None
+        with self._dep_capture(deps):
+            results, opts = self._execute_query(index_name, query,
+                                                shards, profile=profile)
+            resp = self.shape_response(index_name, results, opts)
+        if deps is not None:
+            self._request_cache_fill(key, deps, resp, opts)
+        return resp
 
     def shape_response(self, index_name: str, results, opts: "ExecOptions"
                        ) -> Dict[str, Any]:
@@ -886,6 +1137,18 @@ class Executor:
         while call.name == "Options" and call.children:
             call = call.children[0]
         if isinstance(result, RowResult) and idx.keys:
+            cap = getattr(self._tls, "deps", None)
+            if cap is not None:
+                # The response embeds translated column keys. The
+                # store is append-only (an allocated mapping never
+                # changes), but an id unresolved at fill time can gain
+                # a key later — the size stamp invalidates then.
+                # Stamp-then-read (first stamp wins): taken BEFORE the
+                # resolve, so a key allocated mid-resolve leaves the
+                # stored size behind and the entry fails validation
+                # instead of caching the decimal fallback as current.
+                cap.setdefault(("ctrans", idx.name),
+                               idx.column_translator.size())
             cols = result.columns()  # cached on the result for to_json
             # Keep 1:1 alignment with columns; ids set outside the
             # translator (raw-id imports) fall back to their decimal form.
@@ -919,6 +1182,14 @@ class Executor:
                       shards: Optional[Sequence[int]],
                       opts: Optional["ExecOptions"] = None) -> Any:
         name = call.name
+        cap = getattr(self._tls, "deps", None)
+        if cap is not None and name != "Count" \
+                and name not in _BITMAP_CALLS:
+            # Belt and braces: _request_cache_key already filters to
+            # the staged-eval call family, but any path that slips a
+            # non-staged read under capture must poison the fill, not
+            # cache with incomplete dependencies.
+            cap["uncacheable"] = True
         if name == "Options":
             return self._execute_options(idx, call, shards, opts)
         if name == "Count":
@@ -1084,11 +1355,47 @@ class Executor:
         prof = self._profile()
         t_plan0 = time.perf_counter() if prof is not None else 0.0
         staged = self._stage_tree(idx, call, shards, mode)
+        ckey = None
+        rc = self.result_cache
+        forced = prof is not None and getattr(prof, "forced", False)
+        if fusible and rc.enabled and not forced \
+                and self.mesh is None \
+                and staged.fp is not None and staged.cacheable:
+            # Eval-tier result cache (executor/result_cache.py): the
+            # lookup sits BEFORE the fusion collector, so a hit skips
+            # compile, dispatch and fetch — and a fusion group whose
+            # members all hit simply never forms, let alone launches.
+            # The key adds the index name (fp's operand keys are only
+            # (field, view) — two indexes with same-named fields and
+            # matching bank shapes would otherwise share one key and
+            # evict each other on every lookup) and the concrete shard
+            # tuple (fp covers shard COUNT via the signature; identity
+            # must cover shard IDS); generation equality against the
+            # operand banks' fragment versions is the implicit write
+            # invalidation.
+            ckey = ("eval", idx.name, staged.fp,
+                    tuple(int(s) for s in shards))
+            hit = rc.lookup(ckey, staged.gen)
+            if hit is not None:
+                if prof is not None:
+                    plan_s = time.perf_counter() - t_plan0
+                    node = prof.tree(staged.mode, staged.sig, None,
+                                     plan_s, 0, staged.n_shards)
+                    node.attrs["cacheHit"] = True
+                    tl = prof.timeline
+                    if tl is not None:
+                        TIMELINE.event(tl, "cache", LANE_CACHE,
+                                       t_plan0, plan_s, hit=True)
+                return hit
         if fusible and FUSION_ENABLED and self.mesh is None:
             fuser = getattr(self._tls, "fuser", None)
             if fuser is not None:
-                return fuser.add(staged, prof, t_plan0)
-        return self._run_staged(staged, prof, t_plan0)
+                out = fuser.add(staged, prof, t_plan0)
+                return _CacheFillEval(out, rc, ckey, staged.gen) \
+                    if ckey is not None else out
+        out = self._run_staged(staged, prof, t_plan0)
+        return _CacheFillEval(out, rc, ckey, staged.gen) \
+            if ckey is not None else out
 
     def _stage_tree(self, idx: Index, call: Call, shards: List[int],
                     mode: str) -> "_StagedEval":
@@ -1100,6 +1407,31 @@ class Executor:
 
         plan = _Plan()
         expr = self._plan_call(idx, call, shards, plan)
+        cap = getattr(self._tls, "deps", None)
+        if cap is not None:
+            # Request-tier dependency capture, STAMP-THEN-READ: the
+            # version stamp is taken BEFORE the banks are fetched, so
+            # a write racing the build leaves the stored stamp behind
+            # the current one and the entry fails validation (a
+            # harmless spurious invalidation). Stamping after the read
+            # would let that race cache pre-write data under a
+            # post-write stamp — stale forever. First stamp wins
+            # across a multi-call query for the same reason. One stamp
+            # per operand VIEW (coarser than the per-shard bank
+            # versions — any write or new fragment anywhere in the
+            # view invalidates — which is exactly what makes it
+            # airtight: shard-restriction (_restrict_shards) and
+            # default-shard growth cannot leak a stale hit past it).
+            for key in plan.bank_keys:
+                dk = ("view", idx.name, key[0], key[1])
+                if dk not in cap:
+                    f = idx.field(key[0])
+                    view = f.view(key[1]) if f is not None else None
+                    cap[dk] = view.version_stamp() \
+                        if view is not None else ()
+            if plan.literals:
+                # Literal operand content is not named by the deps.
+                cap["uncacheable"] = True
         banks = [self._get_bank(idx, key, shards,
                                 rows_needed=plan.rows_for.get(key))
                  for key in plan.bank_keys]
@@ -1122,17 +1454,19 @@ class Executor:
                f"|B{[a.shape for a in bank_arrays]}"
                f"|L{None if lits is None else lits.shape}|S{len(shards)}")
         fp = gen = None
-        if WORKLOAD.enabled:
-            # Workload recording at the staging seam: host dict work
-            # only, no device interaction (GL003-clean like memledger).
+        if WORKLOAD.enabled or self.result_cache.enabled:
             # The fingerprint uses ROW IDS from slot_refs (bank slots
             # are append-order-dependent across rebuilds); the
             # generation is the operand banks' fragment-version map —
-            # together the exact key a generation-keyed result cache
-            # would use (ROADMAP item 3).
+            # together the key BOTH the workload recorder's repeat
+            # tracking and the result cache's eval tier use (one
+            # identity, so /debug/hotspots' predicted savings and the
+            # observed hit ratio describe the same keys). Host dict
+            # work only, no device interaction (GL003-clean).
             fp = (sig, tuple((key, row) for _, key, row in
                              plan.slot_refs), tuple(plan.params))
             gen = tuple(tuple(sorted(b.versions.items())) for b in banks)
+        if WORKLOAD.enabled:
             WORKLOAD.record_query(fp, gen, index=idx.name, mode=mode,
                                   n_shards=len(shards), sig=sig)
             prof = self._profile()
@@ -1146,7 +1480,8 @@ class Executor:
                            width=plan.width, n_shards=len(shards),
                            bank_arrays=bank_arrays,
                            idxs=list(plan.idxs), params=list(plan.params),
-                           lits=lits, fp=fp, gen=gen)
+                           lits=lits, fp=fp, gen=gen,
+                           cacheable=not plan.literals)
 
     def _tree_fn(self, staged: "_StagedEval") -> Tuple[Callable, bool]:
         """Compile phase: the jitted program for a staged eval, from
@@ -1737,6 +2072,25 @@ class Executor:
                 self.topn_selfchecks += 1
                 selfcheck_pairs = warm
 
+        # Device rank cache (ROADMAP item 3b; core/cache.RANK_CACHE):
+        # filterless TopN over a warm bank answers from a cached [R]
+        # per-row count vector in HBM — a device top-k (or one tiny
+        # host fetch for restricted candidate sets) instead of the
+        # [R, S, W] popcount sweep below. Version-validated against
+        # the bank's fragment generations: unchanged reuses, small
+        # churn patches only the written rows, anything else rebuilds
+        # with the sweep this path would have paid anyway. The sampled
+        # self-check deliberately bypasses it — its exact leg must
+        # exercise the real sweep.
+        if filter_words is None and not tanimoto and self.mesh is None \
+                and selfcheck_pairs is None:
+            from pilosa_tpu.core.cache import RANK_CACHE
+            if RANK_CACHE.enabled:
+                res = self._topn_rank_cached(view, shards, view_rows,
+                                             all_rows, n, min_threshold)
+                if res is not None:
+                    return res
+
         # Dispatch phase: queue every device program (counts sweeps, and
         # the tanimoto denominator popcount); nothing is fetched yet.
         # The HBM bound must consider the *bank* size (all view rows), not
@@ -2074,6 +2428,199 @@ class Executor:
 
         return _Pending(finalize,
                         arrays=tuple(x for _, vi in outs for x in vi))
+
+    # Row-churn bound for incremental rank-vector patches: more changed
+    # rows than this and the full sweep rebuild is cheaper than the
+    # gather+scatter (and compiles fewer patch-kernel shapes).
+    RANK_PATCH_MAX = int(os.environ.get("PILOSA_TPU_RANK_PATCH_MAX",
+                                        4096))
+
+    def _note_rank(self, kind: str) -> None:
+        names = {"hit": "hits", "patch": "patches",
+                 "rebuild": "rebuilds"}
+        with self._jit_stats_lock:
+            if kind == "hit":
+                self.rank_cache_hits += 1
+            elif kind == "patch":
+                self.rank_cache_patches += 1
+            else:
+                self.rank_cache_rebuilds += 1
+        if self.stats is not None:
+            self.stats.count(f"rank_cache.{names[kind]}", 1)
+
+    def _rank_counts(self, view, bank, shards):
+        """Get-or-refresh the device-resident per-row count vector for
+        `bank` (RankEntry in core/cache.py): [Rcap] counts aligned
+        with the bank's slot layout, validated against its fragment
+        versions. Returns the device array (dispatch queued; nothing
+        fetched)."""
+        import jax
+        import jax.numpy as jnp
+        from pilosa_tpu.core.cache import RANK_CACHE, RankEntry
+        from pilosa_tpu.ops.bitset import popcount
+
+        key = (tuple(int(s) for s in shards),
+               int(bank.array.shape[-1]))
+        # SLOT-ordered row tuple (dict insertion order == slot order:
+        # fresh builds enumerate the sorted row set, _patch_bank
+        # appends at len(slots)). Equality must prove SLOT alignment,
+        # not just row-set equality — an append-grown layout and a
+        # freshly sorted rebuild hold the same rows in different slots,
+        # and patching one with indices from the other would scatter
+        # counts into the wrong rows.
+        bank_rows = tuple(bank.slots)
+        entry = RANK_CACHE.get(view, key)
+        if entry is not None and entry.versions == bank.versions \
+                and entry.row_ids == bank_rows \
+                and int(entry.counts.shape[0]) == int(bank.array.shape[0]):
+            self._note_rank("hit")
+            return entry.counts
+        counts = None
+        if entry is not None and entry.row_ids == bank_rows \
+                and int(entry.counts.shape[0]) == int(bank.array.shape[0]):
+            # Same row set, moved versions: patch only the rows the
+            # writes touched (Fragment._row_versions names them).
+            changed: set = set()
+            ok = True
+            for s, newv in bank.versions.items():
+                old = entry.versions.get(s)
+                if old == newv:
+                    continue
+                frag = view.fragment(s)
+                if frag is None or old is None or old < 0 \
+                        or (old >> 48) != (newv >> 48):
+                    # Epoch mismatch: the fragment was recreated since
+                    # the entry was built (pop + reload across a
+                    # resize). Its _row_versions died with the old
+                    # incarnation, so rows_changed_since(old) cannot
+                    # name writes made before the recreation — the
+                    # patch set is unprovable. Rebuild.
+                    ok = False
+                    break
+                ch = frag.rows_changed_since(old)
+                if not ch:
+                    # Version moved without row attribution: cannot
+                    # prove the patch set — rebuild.
+                    ok = False
+                    break
+                changed.update(int(r) for r in ch)
+            if ok and changed and len(changed) <= self.RANK_PATCH_MAX \
+                    and all(r in bank.slots for r in changed):
+                sel = sorted(bank.slots[r] for r in changed)
+                # Pow2-pad repeating the first slot (idempotent: the
+                # duplicate scatter writes the same recount) so patch
+                # kernels compile O(log churn) shapes, the fused-batch
+                # padding idiom.
+                pad = 1 << (len(sel) - 1).bit_length()
+                sel = sel + [sel[0]] * (pad - len(sel))
+                sel_dev = jnp.asarray(np.asarray(sel, np.int32))
+                pkey = f"rankpatch:{bank.array.shape}:{pad}"
+                fn = self._jit_get(pkey)
+                if fn is None:
+                    self._note_jit_compile()
+
+                    def patch(c, bank_arr, sel_ix):
+                        new = popcount(bank_arr[sel_ix], axis=(-2, -1))
+                        return c.at[sel_ix].set(
+                            new.astype(c.dtype))
+                    fn = jax.jit(patch)
+                    self._jit_put(pkey, fn)
+                counts = self._call_program(fn, entry.counts,
+                                            bank.array, sel_dev)
+                self._note_rank("patch")
+        if counts is None:
+            counts = self._dispatch_counts(bank.array, None)
+            self._note_rank("rebuild")
+        RANK_CACHE.put(view, key,
+                       RankEntry(dict(bank.versions), bank_rows, counts,
+                                 # graftlint: disable=GL003 — .nbytes
+                                 # is shape metadata (rows * 4), not a
+                                 # transfer; no device sync happens.
+                                 int(getattr(counts, "nbytes", 0) or 0)))
+        return counts
+
+    def _topn_rank_cached(self, view, shards, view_rows, all_rows,
+                          n: int, min_threshold: int):
+        """Filterless TopN over the device rank cache, or None when
+        the bank is over budget (the pbank/chunked paths own that
+        regime). Unrestricted leaderboards run a device top-k over the
+        cached counts; candidate-restricted or n=0 calls fetch the [R]
+        vector (4 B/row — negligible next to the sweep it replaces)
+        and reuse the host merge."""
+        import jax
+        import jax.numpy as jnp
+        from pilosa_tpu.core.view import bank_capacity
+
+        width = view.trimmed_words()
+        bank_bytes = bank_capacity(len(view_rows)) * len(shards) \
+            * width * 4
+        if bank_bytes > TOPN_MAX_BANK_BYTES:
+            return None
+        bank = view.device_bank(tuple(shards), mesh=self.mesh,
+                                trim=True)
+        counts = self._rank_counts(view, bank, shards)
+        restricted = all_rows is not view_rows
+        # Slot-ordered rows (insertion order == slot order). The device
+        # top-k leg requires slots to ASCEND with row id: lax.top_k
+        # breaks count ties by lower index, which is (-count, row)
+        # order — the uncached path's lexsort — only then. An
+        # append-grown bank (_patch_bank places new rows at the END)
+        # violates it, so that layout takes the host-merge leg below,
+        # which maps slots explicitly and is exact for any layout.
+        slot_rows = np.fromiter(bank.slots, np.uint64, len(bank.slots))
+        ascending = slot_rows.size < 2 \
+            or bool(np.all(slot_rows[1:] > slot_rows[:-1]))
+        if n and not restricted and ascending:
+            k = min(n, len(bank.slots))
+            if k == 0:
+                return PairsResult([])
+            tkey = f"ranktopk:{counts.shape}:{k}"
+            fn = self._jit_get(tkey)
+            if fn is None:
+                self._note_jit_compile()
+
+                def topk(c, params):
+                    thr = params[0].astype(jnp.int32)
+                    ci = c.astype(jnp.int32)
+                    # Zero slots (and sub-threshold rows) score -1 and
+                    # are dropped in finalize.
+                    score = jnp.where(ci >= jnp.maximum(1, thr),
+                                      ci, -1)
+                    return jax.lax.top_k(score, k)
+                fn = jax.jit(topk)
+                self._jit_put(tkey, fn)
+            params = jnp.asarray(
+                np.asarray([min_threshold], np.uint32))
+            out = self._call_program(fn, counts, params)
+
+            def finalize() -> PairsResult:
+                vals, idxs = (np.asarray(x) for x in out)
+                return PairsResult(
+                    [(int(slot_rows[i]), int(v))
+                     for v, i in zip(vals.tolist(), idxs.tolist())
+                     if v > 0])
+
+            return _Pending(finalize, arrays=tuple(out))
+
+        def finalize() -> PairsResult:
+            c = np.asarray(counts).astype(np.int64)
+            slot_idx = np.fromiter(
+                map(bank.slots.get, all_rows,
+                    itertools.repeat(bank.zero_slot)),
+                dtype=np.int64, count=len(all_rows))
+            rows_arr = np.asarray(all_rows, dtype=np.uint64)
+            counts_arr = c[slot_idx]
+            keep = counts_arr > max(0, min_threshold - 1)
+            rows_arr, counts_arr = rows_arr[keep], counts_arr[keep]
+            rows_arr, counts_arr = _topn_candidates(rows_arr,
+                                                    counts_arr, n)
+            order = np.lexsort((rows_arr, -counts_arr))
+            if n:
+                order = order[:n]
+            return PairsResult([(int(rows_arr[o]), int(counts_arr[o]))
+                                for o in order])
+
+        return _Pending(finalize, arrays=(counts,))
 
     def _repair_topn_caches(self, view, shards) -> None:
         """Rebuild every fragment's cached per-row counts from storage —
@@ -2601,4 +3148,14 @@ class Executor:
         if field is None or isinstance(row_ref, Condition):
             return
         if isinstance(row_ref, int) and not isinstance(row_ref, bool):
+            cap = getattr(self._tls, "deps", None)
+            if cap is not None:
+                # The response embeds row attrs, whose mutations do
+                # NOT bump fragment generations — stamp the attr
+                # store's own counter into the request deps.
+                # Stamp-then-read (first stamp wins): a set_bulk racing
+                # the get() below leaves the stored gen behind, so the
+                # fill can never validate pre-write attrs as current.
+                cap.setdefault(("rattr", idx.name, field.name),
+                               field.row_attr_store.gen)
             res.attrs = field.row_attr_store.get(row_ref)

@@ -1,0 +1,289 @@
+"""Generation-keyed cross-request query result cache (ROADMAP item 3a).
+
+The serving path already amortizes dispatch (coalescer) and compiles
+(fusion), and PR 6's workload plane *measures* heavy cross-request
+repetition (``coalescer.window_repeat``, the cache-opportunity
+``estSavedS`` estimator) without acting on it. This module acts on it:
+the cheapest query is the one never compiled or dispatched.
+
+Two tiers share one LRU byte budget and one counter set:
+
+- **request tier** — key = the canonical request identity from
+  ``utils/fingerprint.request_key`` (the SAME key the coalescer dedups
+  on); value = the fully shaped ``{"results": ...}`` response dict.
+  Validation is by *dependency snapshot*: at fill time the executor
+  records every operand view's ``version_stamp()`` (fragment write
+  versions — bumped by ``Fragment._touch_row`` on every mutation) plus
+  the attr-store and key-translator stamps the response embedded; a
+  hit revalidates them all with pure host dict reads. A hit therefore
+  skips parse, translate, plan, compile, dispatch AND fetch.
+
+- **eval tier** — key = the staged-eval fingerprint carried on
+  ``_StagedEval`` (tree signature + row ids + predicate params — the
+  identity ``utils/hotspots`` records) plus the concrete shard tuple;
+  generation = the operand banks' fragment-version map captured at
+  staging. Value = the eval's HOST output array ([S] counts or [S, W]
+  row words). Hits short-circuit ``_eval_tree`` after planning —
+  before the fusion collector, so a group whose members all hit never
+  launches — and misses fill at the existing materialize seam (the
+  first host fetch of the device output).
+
+Writes invalidate implicitly: any mutation bumps its fragment's
+version, so the stored generation/deps no longer match and the stale
+entry is dropped on its next lookup (or ages out of the LRU).
+
+Observability: hits/misses/evictions counters (per tier and total,
+exported as ``pilosa_result_cache_{hits,misses,evictions}_total``),
+live ``bytes``/``entries`` gauges, a ledger entry under category
+``result_cache`` (host RAM — values are host objects) so
+``/debug/memory`` totals stay provable and the watchdog sees it, and
+a snapshot joined against the workload plane's predicted savings at
+``/debug/hotspots``.
+
+Pure host module: no jax imports, dict work under one leaf lock; the
+only nested acquisition is the memory ledger (itself a leaf), the same
+discipline as ``Executor._jit_put``. ``PILOSA_TPU_RESULT_CACHE=0`` is
+the kill switch; ``[cache]`` config keys layer on top but can never
+re-enable past the env switch.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Optional
+
+from pilosa_tpu.utils.locks import make_lock
+from pilosa_tpu.utils.memledger import LEDGER
+
+RESULT_CACHE_ENV = "PILOSA_TPU_RESULT_CACHE"
+DEFAULT_MAX_BYTES = int(os.environ.get(
+    "PILOSA_TPU_RESULT_CACHE_BYTES", 256 << 20))
+
+TIERS = ("request", "eval")
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(RESULT_CACHE_ENV, "1") != "0"
+
+
+def approx_nbytes(obj: Any) -> int:
+    """Cheap recursive host-size estimate of a shaped JSON response —
+    the LRU byte budget needs a consistent approximation, not an exact
+    figure, and a full json.dumps purely for sizing would double the
+    serialization cost of every miss (the HTTP layer serializes the
+    same dict again right after)."""
+    if isinstance(obj, str):
+        return 48 + len(obj)
+    if isinstance(obj, (list, tuple)):
+        return 56 + 8 * len(obj) + sum(map(approx_nbytes, obj))
+    if isinstance(obj, dict):
+        return 64 + sum(approx_nbytes(k) + approx_nbytes(v)
+                        for k, v in obj.items())
+    return 28  # ints/floats/bools/None: CPython small-object cost
+
+
+class _Entry:
+    __slots__ = ("gen", "value", "nbytes", "tier")
+
+    def __init__(self, gen: Any, value: Any, nbytes: int,
+                 tier: str) -> None:
+        self.gen = gen        # generation snapshot / deps dict
+        self.value = value    # shaped dict (request) | host array (eval)
+        self.nbytes = int(nbytes)
+        self.tier = tier
+
+
+class ResultCache:
+    """LRU byte-budgeted, generation-validated result store (see
+    module docstring). One instance per Executor."""
+
+    def __init__(self, max_bytes: int = DEFAULT_MAX_BYTES,
+                 enabled: Optional[bool] = None) -> None:
+        self.enabled = _env_enabled() if enabled is None else (
+            bool(enabled) and _env_enabled())
+        self.max_bytes = max(0, int(max_bytes))
+        self._lock = make_lock("ResultCache._lock")
+        self._entries: "OrderedDict[Any, _Entry]" = OrderedDict()
+        self.bytes = 0
+        # Cumulative counters, per tier + derived totals. Kept on the
+        # cache (not only in stats) so embedded users and tests read
+        # them without a stats client.
+        self.hits: Dict[str, int] = {t: 0 for t in TIERS}
+        self.misses: Dict[str, int] = {t: 0 for t in TIERS}
+        self.evictions = 0
+        self.invalidations = 0  # stale entries dropped on lookup
+        # Optional utils/stats sink (attached by the API layer, the
+        # WORKLOAD.stats convention) so /metrics counters increment at
+        # event time and stay true monotone counters.
+        self.stats: Optional[Any] = None
+
+    # ---------------------------------------------------------- config
+
+    def configure(self, enabled: Optional[bool] = None,
+                  max_bytes: Optional[int] = None) -> None:
+        """[cache] config wiring. The env kill switch always wins:
+        config can disable a cache the env allows, never the reverse."""
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled) and _env_enabled()
+            if max_bytes is not None:
+                self.max_bytes = max(0, int(max_bytes))
+                self._evict_over_budget()
+                self._ledger()  # a shrink evicts; keep /debug/memory true
+
+    # --------------------------------------------------------- helpers
+
+    def _count(self, name: str, tier: str) -> None:
+        stats = self.stats
+        if stats is not None:
+            stats.count(f"result_cache.{name}", 1)
+            stats.count(f"result_cache.{tier}.{name}", 1)
+
+    def _note_hit(self, tier: str) -> None:
+        # graftlint: disable=GL008 — closed key space: both counter
+        # dicts are pre-seeded with exactly the TIERS keys and only
+        # ever incremented, never grown.
+        self.hits[tier] += 1
+        self._count("hits", tier)
+
+    def _note_miss(self, tier: str) -> None:
+        # graftlint: disable=GL008 — same closed TIERS key space.
+        self.misses[tier] += 1
+        self._count("misses", tier)
+
+    def _ledger(self) -> None:
+        # Lock held (ledger lock is a leaf — the _jit_put precedent):
+        # the aggregate entry tracks the cache's live host bytes so
+        # /debug/memory totals include it and the watchdog's flight
+        # recorder samples it without polling us.
+        LEDGER.register("result_cache", "entries", self.bytes,
+                        owner=self, entries=len(self._entries))
+
+    def _drop_locked(self, key: Any, entry: _Entry) -> None:
+        self._entries.pop(key, None)
+        self.bytes -= entry.nbytes
+
+    def _evict_over_budget(self) -> None:
+        while self._entries and self.bytes > self.max_bytes:
+            _, old = self._entries.popitem(last=False)
+            self.bytes -= old.nbytes
+            self.evictions += 1
+            self._count("evictions", old.tier)
+
+    # ----------------------------------------------------------- reads
+
+    def lookup(self, key: Any, gen: Any, tier: str = "eval"
+               ) -> Optional[Any]:
+        """Eval-tier lookup: hit iff the stored generation equals
+        `gen` exactly. A stale entry is dropped immediately (its bytes
+        are dead weight — the generation can never match again)."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.gen == gen:
+                self._entries.move_to_end(key)
+                self._note_hit(tier)
+                return e.value
+            if e is not None:
+                self._drop_locked(key, e)
+                self.invalidations += 1
+                self._ledger()
+            self._note_miss(tier)
+            return None
+
+    def lookup_request(self, key: Any,
+                       validate: Callable[[Dict[Any, Any]], bool]
+                       ) -> Optional[Any]:
+        """Request-tier lookup: the entry's stored dependency snapshot
+        is revalidated by `validate` (holder version-stamp reads). The
+        validator runs OUTSIDE the cache lock — it takes view locks,
+        and holding ours across that would invert against nothing
+        today but costs nothing to keep leaf-clean."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            e = self._entries.get(key)
+            deps = e.gen if e is not None else None
+            value = e.value if e is not None else None
+        if e is None:
+            with self._lock:
+                self._note_miss("request")
+            return None
+        if validate(deps):
+            with self._lock:
+                if self._entries.get(key) is e:
+                    self._entries.move_to_end(key)
+                self._note_hit("request")
+            return value
+        with self._lock:
+            cur = self._entries.get(key)
+            if cur is e:
+                self._drop_locked(key, e)
+                self.invalidations += 1
+                self._ledger()
+            self._note_miss("request")
+        return None
+
+    # ---------------------------------------------------------- writes
+
+    def fill(self, key: Any, gen: Any, value: Any, nbytes: int,
+             tier: str = "eval") -> None:
+        if not self.enabled or self.max_bytes <= 0:
+            return
+        nbytes = max(0, int(nbytes))
+        if nbytes > self.max_bytes:
+            return  # one oversized value must not flush the cache
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes -= old.nbytes
+            self._entries[key] = _Entry(gen, value, nbytes, tier)
+            self.bytes += nbytes
+            self._evict_over_budget()
+            self._ledger()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries = OrderedDict()
+            self.bytes = 0
+            self._ledger()
+
+    # ------------------------------------------------------- reporting
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /debug/hotspots `resultCache` stanza: observed hit
+        ratios the opportunity estimator's predictions are judged
+        against."""
+        with self._lock:
+            hits = dict(self.hits)
+            misses = dict(self.misses)
+            h = sum(hits.values())
+            m = sum(misses.values())
+            return {
+                "enabled": self.enabled,
+                "bytes": self.bytes,
+                "maxBytes": self.max_bytes,
+                "entries": len(self._entries),
+                "hits": h,
+                "misses": m,
+                "hitRatio": (h / (h + m)) if (h + m) else 0.0,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "tiers": {t: {"hits": hits[t], "misses": misses[t]}
+                          for t in TIERS},
+            }
+
+    def publish(self, stats: Optional[Any]) -> None:
+        """Scrape-time gauges (counters were incremented at event
+        time): pilosa_result_cache_bytes / _entries / _hit_ratio."""
+        if stats is None:
+            return
+        snap = self.snapshot()
+        stats.gauge("result_cache.bytes", snap["bytes"])
+        stats.gauge("result_cache.entries", snap["entries"])
+        stats.gauge("result_cache.hit_ratio", snap["hitRatio"])

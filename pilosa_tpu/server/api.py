@@ -92,6 +92,9 @@ class API:
         # wins, same as the ledger's scrape-time publish target.
         from pilosa_tpu.utils.hotspots import WORKLOAD
         WORKLOAD.stats = self.stats
+        # Result-cache hit/miss/eviction counters increment at event
+        # time through the same last-attached-wins convention.
+        self.executor.result_cache.stats = self.stats
         self.tracer = tracer or NopTracer()
         self.long_query_time = 0.0  # seconds; 0 disables slow-query logs
         # Per-query execution profiler (utils/profile.py): every query
@@ -812,6 +815,14 @@ class API:
         LEDGER.publish(self.stats)
         WORKLOAD.publish(self.stats)
         TIMELINE.publish(self.stats)
+        # Result-cache live gauges (hit/miss/eviction counters
+        # increment at event time); the rank-cache store publishes its
+        # entry/byte gauges the same way.
+        from pilosa_tpu.core.cache import RANK_CACHE
+        self.executor.result_cache.publish(self.stats)
+        rsnap = RANK_CACHE.snapshot()
+        self.stats.gauge("rank_cache.entries", rsnap["entries"])
+        self.stats.gauge("rank_cache.bytes", rsnap["bytes"])
         self.stats.gauge("executor.jit_cache_size",
                          self.executor.jit_cache_size())
 
@@ -833,12 +844,31 @@ class API:
         density-vs-access quadrants joined against the memory ledger.
         Totals are provable from the document: totals.X == tracked.X +
         evicted.X (pinned by test)."""
+        from pilosa_tpu.core.cache import RANK_CACHE
         from pilosa_tpu.utils.hotspots import WORKLOAD
         from pilosa_tpu.utils.memledger import LEDGER
         self.refresh_memory_gauges()
-        return WORKLOAD.snapshot(
+        doc = WORKLOAD.snapshot(
             top_k=top_k,
             bank_entries=LEDGER.entries("bank", "fragment_bank"))
+        # The estimator finally gets validated: OBSERVED result-cache
+        # hit ratios sit next to the PREDICTED estSavedS ranking built
+        # from the same fingerprints, so over- or under-prediction is
+        # one document read apart.
+        rc = self.executor.result_cache.snapshot()
+        doc["resultCache"] = rc
+        doc["rankCache"] = RANK_CACHE.snapshot()
+        doc["rankCache"]["hits"] = self.executor.rank_cache_hits
+        doc["rankCache"]["patches"] = self.executor.rank_cache_patches
+        doc["rankCache"]["rebuilds"] = self.executor.rank_cache_rebuilds
+        doc["opportunity"]["observed"] = {
+            "hits": rc["hits"],
+            "misses": rc["misses"],
+            "hitRatio": rc["hitRatio"],
+            "predictedTotalEstSavedS":
+                doc["opportunity"]["totalEstSavedS"],
+        }
+        return doc
 
     def _node_ident(self):
         if self.cluster is not None:
@@ -991,6 +1021,15 @@ class API:
                 "retraces": self.executor.jit_compiles,
                 "fusedDispatches": self.executor.fused_dispatches,
                 "fusedQueries": self.executor.fused_queries,
+            },
+            # Cross-request cache tier (executor/result_cache.py +
+            # core/cache.RANK_CACHE): hit ratios and live bytes in the
+            # same health document capacity is judged from.
+            "resultCache": self.executor.result_cache.snapshot(),
+            "rankCache": {
+                "hits": self.executor.rank_cache_hits,
+                "patches": self.executor.rank_cache_patches,
+                "rebuilds": self.executor.rank_cache_rebuilds,
             },
             # Cumulative, not ring occupancy (which saturates at the
             # ring bound) — fleet totals must reflect the actual rate.

@@ -58,6 +58,7 @@ import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from pilosa_tpu.server.api import ApiError
+from pilosa_tpu.utils.fingerprint import request_key
 from pilosa_tpu.utils.hotspots import WORKLOAD
 from pilosa_tpu.utils.timeline import LANE_COALESCE, LANE_QUEUE, TIMELINE
 
@@ -364,16 +365,16 @@ class QueryCoalescer:
         coalescer.window_repeat counter."""
         if not WORKLOAD.enabled:
             return
-        from pilosa_tpu.utils.profile import pql_text
         repeats = 0
         for item in batch:
             if item.is_write:
                 continue
-            q = item.query if isinstance(item.query, str) \
-                else pql_text(item.query)
-            key = (item.index, q,
-                   tuple(item.shards) if item.shards is not None
-                   else None)
+            # The ONE canonical request identity
+            # (utils/fingerprint.request_key) — the same key the
+            # in-flush dedup groups on and the executor's request-tier
+            # result cache caches under, so window_repeat predicts
+            # exactly what the cache will later serve.
+            key = request_key(item.index, item.query, item.shards)
             if WORKLOAD.record_request(key):
                 repeats += 1
         if repeats:
@@ -433,9 +434,7 @@ class QueryCoalescer:
             key = None
             forced = item.profile is not None and item.profile.forced
             if dedup_ok and not forced and isinstance(item.query, str):
-                key = (item.index, item.query,
-                       tuple(item.shards) if item.shards is not None
-                       else None)
+                key = request_key(item.index, item.query, item.shards)
             if key is not None and key in groups:
                 owner[groups[key][0]].append(item)
                 continue

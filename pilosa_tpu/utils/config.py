@@ -110,6 +110,24 @@ class Config:
     workload_max_fragments: int = 4096
     workload_max_rows: int = 4096
     workload_max_signatures: int = 1024
+    # Cross-request cache tier (ROADMAP item 3): the generation-keyed
+    # query result cache (executor/result_cache.py — request tier
+    # keyed on the coalescer's request identity, eval tier on the
+    # staged fingerprint + bank generations) and the device-resident
+    # TopN rank cache (core/cache.RANK_CACHE). TOML accepts a [cache]
+    # table (result_enabled / result_max_bytes / rank_enabled /
+    # rank_max_entries) or the flat cache_* spelling; env uses
+    # PILOSA_TPU_CACHE_RESULT_ENABLED etc. The blunt kill switches
+    # PILOSA_TPU_RESULT_CACHE=0 / PILOSA_TPU_RANK_CACHE=0 override
+    # everything (config can disable, never re-enable past them).
+    cache_result_enabled: bool = True
+    # LRU byte budget for cached results (host RAM; ledgered under
+    # category "result_cache" so /debug/memory totals stay provable).
+    cache_result_max_bytes: int = 256 << 20
+    cache_rank_enabled: bool = True
+    # Live per-view rank vectors kept device-resident (HBM; category
+    # "rank_cache"); each is 4 bytes/row.
+    cache_rank_max_entries: int = 64
     # Request-lifecycle timeline plane (utils/timeline.py): bounded
     # per-process ring of per-request stage timelines (queue -> coalesce
     # -> plan -> dispatch -> device -> materialize -> serialize) served
@@ -213,6 +231,10 @@ class Config:
                 "workload top_k/max_* bounds must be >= 1")
         if self.telemetry_ring < 1:
             raise ValueError("telemetry ring must be >= 1")
+        if self.cache_result_max_bytes < 0:
+            raise ValueError("cache result_max_bytes must be >= 0")
+        if self.cache_rank_max_entries < 1:
+            raise ValueError("cache rank_max_entries must be >= 1")
         if self.timeline_ring < 1 or self.timeline_sample_every < 1:
             raise ValueError(
                 "timeline ring/sample_every must be >= 1")

@@ -1,0 +1,48 @@
+"""Stable query fingerprints shared by every identity-keyed surface.
+
+One request identity, one digest function. Before this module the
+coalescer's read-dedup, the workload recorder's rolling repeat window
+and the hotspots signature digests each built their own tuple shape (or
+hashed with process-salted ``hash()``), so "the same query" meant
+subtly different things to different planes. The generation-keyed
+result cache (executor/result_cache.py) keys on exactly these
+identities, so they are defined ONCE here:
+
+- ``request_key(index, query, shards)``: the canonical identity of one
+  serving-path request — the key the coalescer dedups on, the workload
+  recorder windows on, and the request tier of the result cache caches
+  under (plus its generation validation).
+- ``digest(obj)``: a short stable blake2s digest of any repr-able key.
+  NOT ``hash()``: str hashing is salted per process (PYTHONHASHSEED),
+  and fingerprints must name the same identity across cluster nodes
+  and restarts (drain dumps, /cluster/hotspots correlation).
+
+Pure host-side helpers — no jax, no locks, no state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Optional, Sequence, Tuple
+
+
+def digest(obj: Any, size: int = 8) -> str:
+    """Stable short hex digest of a repr-able key (blake2s)."""
+    return hashlib.blake2s(repr(obj).encode(),
+                           digest_size=size).hexdigest()
+
+
+def request_key(index: str, query: Any,
+                shards: Optional[Sequence[int]]
+                ) -> Tuple[str, str, Optional[Tuple[int, ...]]]:
+    """The canonical (index, pql-text, shards) identity of one request.
+    Parsed Call/Query trees serialize back through pql_text so a string
+    and its parsed form key identically; an explicit shard list is
+    order- and type-normalized."""
+    if isinstance(query, str):
+        q = query
+    else:
+        from pilosa_tpu.utils.profile import pql_text
+        q = pql_text(query)
+    return (str(index), q,
+            tuple(int(s) for s in shards) if shards is not None else None)

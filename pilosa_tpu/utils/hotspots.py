@@ -51,12 +51,12 @@ queue (graftlint GL003 stays clean by construction, pinned by test).
 
 from __future__ import annotations
 
-import hashlib
 import math
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from pilosa_tpu.utils.fingerprint import digest
 from pilosa_tpu.utils.locks import make_lock
 
 # Row identities recorded per record_read call: queries naming more
@@ -469,12 +469,13 @@ class WorkloadRecorder:
         saved = (max(0, st.gen_hits - 1) * st.eval_s
                  if st.eval_s is not None else None)
         return {
-            # Stable digest, NOT hash(): str hashing is salted per
-            # process (PYTHONHASHSEED), and the fingerprint must name
-            # the same signature identically across cluster nodes and
-            # restarts (drain dumps, /cluster/hotspots correlation).
-            "fingerprint": hashlib.blake2s(
-                repr(key).encode(), digest_size=8).hexdigest(),
+            # Stable digest (utils/fingerprint.py — shared with the
+            # coalescer dedup key and the result cache), NOT hash():
+            # str hashing is salted per process (PYTHONHASHSEED), and
+            # the fingerprint must name the same signature identically
+            # across cluster nodes and restarts (drain dumps,
+            # /cluster/hotspots correlation).
+            "fingerprint": digest(key),
             "index": st.index,
             "mode": st.mode,
             "shards": st.n_shards,

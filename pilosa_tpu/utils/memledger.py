@@ -46,8 +46,11 @@ from pilosa_tpu.utils.locks import make_rlock
 # Categories whose bytes live in host RAM, not device HBM: excluded
 # from the watchdog's HBM watermark (but still ledgered + exported).
 # "telemetry" covers the tracer span ring and the request-timeline
-# ring (utils/tracing.py / utils/timeline.py register themselves).
-HOST_CATEGORIES = frozenset({"host_block", "telemetry"})
+# ring (utils/tracing.py / utils/timeline.py register themselves);
+# "result_cache" is the generation-keyed query result cache's host
+# values (executor/result_cache.py). The device-resident TopN rank
+# cache ("rank_cache") is HBM and deliberately NOT listed here.
+HOST_CATEGORIES = frozenset({"host_block", "telemetry", "result_cache"})
 
 
 class _Entry:

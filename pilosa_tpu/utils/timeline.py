@@ -59,6 +59,7 @@ LANE_DEVICE = 5
 LANE_FETCH = 6
 LANE_SERIALIZE = 7
 LANE_REMOTE = 8
+LANE_CACHE = 9
 
 LANE_NAMES = {
     LANE_REQUEST: "request",
@@ -70,6 +71,7 @@ LANE_NAMES = {
     LANE_FETCH: "materialize",
     LANE_SERIALIZE: "serialize",
     LANE_REMOTE: "remote",
+    LANE_CACHE: "cache",
 }
 
 # Stage names whose slice durations feed the summary medians (the

@@ -185,6 +185,9 @@ def test_zero_fences_on_recording_path(tmp_holder, monkeypatch):
 
     _seed(tmp_holder)
     api = API(tmp_holder, stats=MemStatsClient())
+    # Repeats must EXECUTE here (the recorder plane is under test);
+    # the result cache would serve them without staging.
+    api.executor.result_cache.enabled = False
     fences = []
     monkeypatch.setattr(ex, "_fence_device",
                         lambda out: fences.append(1) or 0.0)
@@ -251,6 +254,10 @@ def test_synthetic_repeat_structure_and_saved_seconds(tmp_holder):
     saved-seconds attached."""
     _seed(tmp_holder)
     api = API(tmp_holder, stats=MemStatsClient())
+    # The estimator under test prices repeats that EXECUTE; cache off
+    # so all 64 stage (with it on, hits skip staging by design and
+    # the estimator ranks only the remaining miss traffic).
+    api.executor.result_cache.enabled = False
     WORKLOAD.reset()
     for i in range(64):
         api.query("ws", f"Count(Row(f={i % 4}))")
@@ -292,6 +299,7 @@ def test_generation_bump_resets_cacheable_run(tmp_holder):
     been invalidated exactly there)."""
     _seed(tmp_holder)
     api = API(tmp_holder, stats=MemStatsClient())
+    api.executor.result_cache.enabled = False  # repeats must stage
     WORKLOAD.reset()
     for _ in range(4):
         api.query("ws", "Count(Row(f=1))")
@@ -406,6 +414,7 @@ def test_slow_ring_hot_fragments_annotation(tmp_holder):
     current standings for exactly the fragments that query touched."""
     _seed(tmp_holder)
     api = API(tmp_holder, stats=MemStatsClient())
+    api.executor.result_cache.enabled = False  # repeats must stage
     api.long_query_time = 1e-9  # everything is "slow"
     WORKLOAD.reset()
     for _ in range(3):

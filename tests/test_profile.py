@@ -87,6 +87,9 @@ def test_sample_every_fences_one_in_n(tmp_holder, monkeypatch):
 
     _seed_two_shards(tmp_holder)
     api = API(tmp_holder, stats=MemStatsClient())
+    # Sampled fences require the repeats to DISPATCH; the result
+    # cache would serve queries 2-6 without any device work.
+    api.executor.result_cache.enabled = False
     api.profiler.configure(sample_every=3)
     fences = []
     monkeypatch.setattr(ex, "_fence_device",

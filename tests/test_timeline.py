@@ -236,6 +236,9 @@ def test_zero_new_fences_on_unsampled_path(tmp_holder, monkeypatch):
 
     _seed(tmp_holder)
     api = API(tmp_holder, stats=MemStatsClient())
+    # Every repeat must DISPATCH (dispatches_total >= 8 below); the
+    # result cache would serve 6 of the 8 without any device work.
+    api.executor.result_cache.enabled = False
     fences = []
     monkeypatch.setattr(ex, "_fence_device",
                         lambda out: fences.append(1) or 0.0)
@@ -317,6 +320,11 @@ def live_api(tmp_holder):
     _seed(tmp_holder)
     api = API(tmp_holder, stats=MemStatsClient(),
               tracer=RecordingTracer())
+    # These tests assert plan/dispatch/materialize slices on repeated
+    # queries; the result cache would answer the repeats with a single
+    # `cache` slice instead. Cache-ON timeline attribution is pinned
+    # in tests/test_result_cache.py.
+    api.executor.result_cache.enabled = False
     api.coalescer = QueryCoalescer(api.executor, window_s=0.0005,
                                    stats=api.stats, tracer=api.tracer)
     api.coalescer.start()

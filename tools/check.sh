@@ -176,7 +176,10 @@ print("profiler smoke OK")
 EOF
 
 step "fusion smoke (16 same-signature counts -> 1 fused dispatch)"
-JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+# Cache off: exact dispatch counts are the subject here — the result
+# cache would serve the repeats and zero them out (its own smoke and
+# tests/test_result_cache.py pin the cache-ON interplay).
+PILOSA_TPU_RESULT_CACHE=0 JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
 import tempfile
 import numpy as np
 from pilosa_tpu.core.holder import Holder
@@ -202,6 +205,52 @@ with tempfile.TemporaryDirectory() as d:
     assert ex.jit_cache_size() > 0
     h.close()
 print("fusion smoke OK")
+EOF
+
+step "result-cache smoke (32 identical queries -> >=30 hits, 1 fused dispatch)"
+JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+import tempfile
+import numpy as np
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+from pilosa_tpu.utils.memledger import LEDGER
+
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("rc")
+    f = idx.create_field("f")
+    rng = np.random.default_rng(11)
+    rows = rng.integers(0, 8, 4000).astype(np.uint64)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, 4000).astype(np.uint64)
+    f.import_bits(rows, cols)
+    idx.add_existence(cols)
+    ex = Executor(h)
+    assert ex.result_cache.enabled, "result cache must default ON"
+    q = "Count(Row(f=1))"
+    # 32 identical queries: a first coalesced pair (one fused launch
+    # fills the generation-keyed cache), then 30 repeats served from
+    # it — no staging, no compile, no dispatch.
+    first = ex.execute_batch([("rc", q, None), ("rc", q, None)])
+    got = [r[0][0] for r in first]
+    got += [ex.execute_batch([("rc", q, None)])[0][0][0]
+            for _ in range(30)]
+    assert len(got) == 32 and len(set(got)) == 1, got
+    snap = ex.result_cache.snapshot()
+    assert snap["hits"] >= 30, snap
+    assert ex.fused_dispatches == 1, ex.fused_dispatches
+    # Cache memory is ledgered: /debug/memory's result_cache category
+    # equals the cache's own byte gauge.
+    cats = LEDGER.snapshot()["categories"]
+    assert cats.get("result_cache", {}).get("bytes", 0) \
+        == snap["bytes"] > 0, (cats, snap)
+    # Bit-identical with the cache disabled (the
+    # PILOSA_TPU_RESULT_CACHE=0 regime).
+    ex.result_cache.enabled = False
+    off = ex.execute_batch([("rc", q, None)])[0][0][0]
+    assert off == got[0], (off, got[0])
+    h.close()
+print("result-cache smoke OK")
 EOF
 
 step "telemetry smoke (live /debug/memory + /cluster/health)"
@@ -250,7 +299,10 @@ print("telemetry smoke OK")
 EOF
 
 step "hotspots smoke (repeated-query burst -> /debug/hotspots)"
-JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+# Cache off: the workload recorder/estimator under test prices repeats
+# that STAGE; with the cache on, hits skip staging by design and the
+# query window records only the first execution of each identity.
+PILOSA_TPU_RESULT_CACHE=0 JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
 import json
 import tempfile
 import urllib.request
@@ -303,7 +355,11 @@ print("hotspots smoke OK")
 EOF
 
 step "timeline smoke (32-query burst -> /debug/timeline trace-event JSON)"
-JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+# Cache off: the plan/dispatch/materialize stage slices under test
+# only exist for requests that execute — cache hits produce a two-
+# slice (queue, cache) timeline instead (pinned in
+# tests/test_result_cache.py::test_timeline_cache_lane_slice_on_hit).
+PILOSA_TPU_RESULT_CACHE=0 JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
 import json
 import tempfile
 import urllib.request
