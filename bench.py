@@ -461,38 +461,68 @@ def tpu_child():
     print(json.dumps(out), flush=True)
 
 
+def _run_bounded(cmd, timeout, stdout=None):
+    """subprocess.run with a reap that can NEVER block past the
+    timeout. `subprocess.run(timeout=...)` kills the child on expiry
+    but then WAITS UNBOUNDEDLY for it to die — a probe child wedged in
+    uninterruptible tunnel I/O (D state), or a TPU-runtime grandchild
+    holding the stdout pipe open, parks the whole bench there forever.
+    That is exactly how the scheduled rounds since BENCH_r05 timed out
+    "probing the tunnel" without emitting anything. Here the child runs
+    in its own session; on expiry the whole process GROUP gets
+    SIGKILL and the reap itself is bounded — a child the kernel will
+    not release is ABANDONED (it stays in its own session, we stop
+    caring) so the caller always proceeds to emit its record.
+    Returns (rc, stdout_text); rc -1 means timeout/abandoned."""
+    proc = subprocess.Popen(
+        cmd, stdout=stdout, stderr=sys.stderr,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+        return proc.returncode, (out.decode() if out is not None else "")
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            out, _ = proc.communicate(timeout=10)
+            return -1, (out.decode() if out is not None else "")
+        except subprocess.TimeoutExpired:
+            log("bench: child unreapable after SIGKILL; abandoning it")
+            return -1, ""
+
+
 def run_child(argv, timeout):
     """Run this script in a child with a hard timeout; return (rc, stdout)."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)] + argv,
-            stdout=subprocess.PIPE, stderr=sys.stderr, timeout=timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-        return proc.returncode, proc.stdout.decode()
-    except subprocess.TimeoutExpired:
-        return -1, ""
+    return _run_bounded(
+        [sys.executable, os.path.abspath(__file__)] + argv, timeout,
+        stdout=subprocess.PIPE)
 
 
 def probe_backend():
     """Hold-for-window probe: keep probing in a child until the backend
     answers or the hold deadline passes. Each failed probe against a
-    hung tunnel costs its own timeout, so the sleep between probes only
-    bounds spawn churn; the full cycle (~3 min) is shorter than the
-    shortest observed up-window (~6 min), so a window that opens while
-    holding is caught. Returns (ok, error_detail)."""
+    hung tunnel costs its own (bounded — see _run_bounded) timeout, so
+    the sleep between probes only bounds spawn churn; the full cycle
+    (~3 min) is shorter than the shortest observed up-window (~6 min),
+    so a window that opens while holding is caught. The loop also
+    re-checks the deadline BEFORE each attempt, so a late-starting
+    attempt cannot overrun the hold by a whole probe timeout. Returns
+    (ok, error_detail); a False return always reaches the caller, whose
+    fall-through emits the CPU serving-path record."""
     deadline = time.monotonic() + PROBE_HOLD_S
     attempt = 0
     while True:
         attempt += 1
         log(f"bench: probing backend (attempt {attempt}, "
             f"{max(0, deadline - time.monotonic()):.0f}s of hold left)")
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", _PROBE_SRC],
-                stderr=sys.stderr, timeout=PROBE_TIMEOUT_S)
-            if proc.returncode == 0:
-                return True, ""
-        except subprocess.TimeoutExpired:
+        rc, _ = _run_bounded([sys.executable, "-c", _PROBE_SRC],
+                             PROBE_TIMEOUT_S)
+        if rc == 0:
+            return True, ""
+        if rc == -1:
             log("bench: probe timed out")
         if time.monotonic() >= deadline:
             log("bench: hold deadline passed with the backend still "
@@ -501,6 +531,9 @@ def probe_backend():
                            f"{PROBE_HOLD_S:.0f}s probe hold")
         time.sleep(min(PROBE_SLEEP_S,
                        max(1.0, deadline - time.monotonic())))
+        if time.monotonic() >= deadline:
+            return False, (f"backend unreachable for the whole "
+                           f"{PROBE_HOLD_S:.0f}s probe hold")
 
 
 def sidecar_carry(baseline, bits):

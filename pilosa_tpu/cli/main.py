@@ -205,7 +205,8 @@ def cmd_server(args) -> int:
             max_batch=cfg.coalescer_max_batch,
             max_queue=cfg.coalescer_max_queue,
             deadline_s=cfg.coalescer_deadline_ms / 1e3,
-            stats=stats, tracer=tracer, logger=logger)
+            stats=stats, tracer=tracer, logger=logger,
+            pipeline=cfg.coalescer_pipeline)
         coalescer.start()
         api.coalescer = coalescer
     watchdog = None

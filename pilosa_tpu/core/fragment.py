@@ -555,21 +555,38 @@ class Fragment:
         self.cache.bulk_add(row_id, self.row_count(row_id))
 
     def _touch_row(self, row_id: int) -> None:
-        self._dirty.add(row_id)
+        self._touch_rows((row_id,))
+
+    def _touch_rows(self, row_ids) -> None:
+        """Batched generation bump: ONE version increment and ONE
+        workload-plane record per (fragment, batch). A bulk import
+        touching R rows used to bump per row — R version increments
+        and R hotspot records whose only consumer effect is "something
+        changed since the cached generation" (measured 2.4 µs/row,
+        ~10 ms per 4096-row import batch). Every generation consumer
+        compares for equality or `> stamp` (result/rank caches,
+        rows_changed_since, version_stamp), so one bump shared by the
+        whole batch invalidates exactly the same set."""
+        rows = [int(r) for r in row_ids]
+        if not rows:
+            return
         self.version += 1
-        # graftlint: disable=GL008 — one slot per materialized row of
-        # THIS fragment: grows with the stored data (like the row
-        # containers themselves), not with request traffic.
-        self._row_versions[row_id] = self.version
-        # Anti-entropy dirty tracking: every mutation path funnels
-        # through here, so the block-checksum cache re-hashes only
-        # blocks written since the last pass.
-        self._dirty_blocks.add(row_id // HASH_BLOCK_SIZE)
+        v = self.version
+        for row_id in rows:
+            self._dirty.add(row_id)
+            # graftlint: disable=GL008 — one slot per materialized row
+            # of THIS fragment: grows with the stored data (like the
+            # row containers themselves), not with request traffic.
+            self._row_versions[row_id] = v
+            # Anti-entropy dirty tracking: every mutation path funnels
+            # through here, so the block-checksum cache re-hashes only
+            # blocks written since the last pass.
+            self._dirty_blocks.add(row_id // HASH_BLOCK_SIZE)
         # Workload plane: every mutation path funnels through here too,
         # so this one call records write churn AND the generation bump
         # caches key on (utils/hotspots.py; host dict work only).
         WORKLOAD.record_write(self.index, self.field, self.view,
-                              self.shard, generation=self.version)
+                              self.shard, generation=v, n=len(rows))
 
     def rows_changed_since(self, version: int) -> List[int]:
         return [r for r, v in self._row_versions.items() if v > version]
@@ -673,8 +690,8 @@ class Fragment:
                         else key_chunks[0])
                 touched = np.unique(keys // np.uint64(CONTAINERS_PER_ROW))
             self._prelatch_cache_saturation(touched)
+            self._touch_rows(touched.tolist())
             for r in touched.tolist():
-                self._touch_row(int(r))
                 self._cache_update(int(r))
             self._maybe_snapshot()
 
@@ -756,9 +773,10 @@ class Fragment:
                         self.storage._drop_empty(key)
             else:
                 self.storage.union_in_place(other)
-            for key in other.containers:
-                self._touch_row(key // CONTAINERS_PER_ROW)
-            for r in {k // CONTAINERS_PER_ROW for k in other.containers}:
+            rows = sorted({k // CONTAINERS_PER_ROW
+                           for k in other.containers})
+            self._touch_rows(rows)
+            for r in rows:
                 self._cache_update(int(r))
             self._snapshot()
 
@@ -777,8 +795,8 @@ class Fragment:
             self.storage.optimize()
             rows = old_rows | {k // CONTAINERS_PER_ROW
                                for k in self.storage.containers}
+            self._touch_rows(rows)
             for r in rows:
-                self._touch_row(int(r))
                 self._cache_update(int(r))
             self._snapshot()
 
@@ -835,9 +853,8 @@ class Fragment:
                     changed |= self.storage.add(self.pos(i, column_id))
                 else:
                     changed |= self.storage.remove(self.pos(i, column_id))
-                self._touch_row(i)
             changed |= self.storage.add(self.pos(bit_depth, column_id))
-            self._touch_row(bit_depth)
+            self._touch_rows(range(bit_depth + 1))
             self._maybe_snapshot()
             return changed
 
@@ -846,7 +863,7 @@ class Fragment:
             changed = False
             for i in range(bit_depth + 1):
                 changed |= self.storage.remove(self.pos(i, column_id))
-                self._touch_row(i)
+            self._touch_rows(range(bit_depth + 1))
             self._maybe_snapshot()
             return changed
 
@@ -873,10 +890,9 @@ class Fragment:
                 for i in range(bit_depth):
                     self.storage.remove_batch(
                         np.uint64(i * SHARD_WIDTH) + offsets)
-                    self._touch_row(i)
                 self.storage.remove_batch(
                     np.uint64(bit_depth * SHARD_WIDTH) + offsets)
-                self._touch_row(bit_depth)
+                self._touch_rows(range(bit_depth + 1))
                 self._maybe_snapshot()
                 return
             # Columns that already hold a value need their zero planes
@@ -911,8 +927,7 @@ class Fragment:
                     all_rows[c0:c0 + IMPORT_CHUNK_PAIRS],
                     all_cols[c0:c0 + IMPORT_CHUNK_PAIRS],
                     SHARD_WIDTH_EXP)
-            for i in range(bit_depth + 1):
-                self._touch_row(i)
+            self._touch_rows(range(bit_depth + 1))
             self._maybe_snapshot()
 
     def bsi_bank(self, bit_depth: int):

@@ -216,6 +216,39 @@ def prefetch_pendings(staged) -> None:
                         pass  # transfer still happens in finalize
 
 
+class _BatchInFlight:
+    """A dispatched-but-undrained execute_batch: every request's device
+    programs are launched (operand banks snapshotted, fusion groups
+    resolved, async prefetch started); execute_batch_finish blocks on
+    the transfers and builds host results. The handle the pipelined
+    serving path double-buffers on."""
+
+    __slots__ = ("staged_q", "out", "profs", "deps_l")
+
+    def __init__(self, staged_q, out, profs, deps_l):
+        self.staged_q = staged_q
+        self.out = out
+        self.profs = profs
+        self.deps_l = deps_l
+
+
+class _ShapedInFlight:
+    """execute_batch_shaped's in-flight handle: the underlying
+    _BatchInFlight plus the request-cache bookkeeping the shaping half
+    needs (keys/deps for fills, positions of cache hits already
+    answered)."""
+
+    __slots__ = ("flight", "out", "keys", "deps_l", "run", "requests")
+
+    def __init__(self, flight, out, keys, deps_l, run, requests):
+        self.flight = flight
+        self.out = out
+        self.keys = keys
+        self.deps_l = deps_l
+        self.run = run
+        self.requests = requests
+
+
 class _CacheFillEval:
     """Stands between a terminal eval's device output (device array or
     fusion FusedEval handle) and its consumers so the first HOST
@@ -370,6 +403,14 @@ class _Plan:
     rows_for: Dict[Tuple[str, str], set] = dc_field(default_factory=dict)
     shift_bits: int = 0    # total Shift() distance; widens the plan
     width: int = 0         # resolved by _eval_tree before tracing
+    # Megakernel IR (ops/megakernel.py): a postfix record of the same
+    # tree the closures trace, appended by the _plan_* recursion so a
+    # heterogeneous flush can lower N different staged programs into
+    # ONE opcode plan buffer. `ir_ok=False` (eager literals, Shift)
+    # means the staged eval is not lowerable and takes the per-group
+    # fusion path instead.
+    ir: List[tuple] = dc_field(default_factory=list)
+    ir_ok: bool = True
 
     def bank(self, key: Tuple[str, str]) -> int:
         pos = self.bank_pos.get(key)
@@ -424,6 +465,10 @@ class _StagedEval:
     # not named by fp/gen, so such evals must never be served from or
     # fill the result cache.
     cacheable: bool = True
+    # Megakernel IR: the postfix opcode record _Plan collected, or
+    # None when the tree is not lowerable — such evals keep the
+    # per-signature-group vmap fusion path (executor/megakernel.py).
+    ir: Any = None
 
     def runner(self) -> Callable:
         """The traceable program body: expr + the mode's reduction."""
@@ -490,6 +535,15 @@ class Executor:
         # pilosa_executor_fused_{dispatches,queries}_total.
         self.fused_dispatches = 0
         self.fused_queries = 0
+        # Heterogeneous megakernel counters (executor/megakernel.py):
+        # plan-buffer launches (one per mixed cohort), the queries they
+        # covered, total plan entries interpreted and plan bytes
+        # uploaded. /metrics exports them as
+        # pilosa_executor_mega_{launches,queries,plan_entries,plan_bytes}_total.
+        self.mega_launches = 0
+        self.mega_queries = 0
+        self.mega_plan_entries = 0
+        self.mega_plan_bytes = 0
         # Optional stats sink (utils/stats interface) the API layer
         # attaches; batch-scoped signals (fusion group sizes) that have
         # no per-query profile to ride report through it.
@@ -646,6 +700,23 @@ class Executor:
             self.stats.count("executor.fused_dispatches", 1)
             self.stats.count("executor.fused_queries", group_size)
             self.stats.histogram("executor.fusion_group_size", group_size)
+
+    def _note_mega(self, queries: int, plan_entries: int,
+                   plan_bytes: int) -> None:
+        """Account one megakernel launch covering `queries` staged
+        evals via `plan_entries` interpreted instructions ('+=' is not
+        atomic and batches can run from several threads)."""
+        with self._jit_stats_lock:
+            self.mega_launches += 1
+            self.mega_queries += queries
+            self.mega_plan_entries += plan_entries
+            self.mega_plan_bytes += plan_bytes
+        if self.stats is not None:
+            self.stats.count("executor.mega_launches", 1)
+            self.stats.count("executor.mega_queries", queries)
+            self.stats.count("executor.mega_plan_entries", plan_entries)
+            self.stats.count("executor.mega_plan_bytes", plan_bytes)
+            self.stats.histogram("executor.mega_batch_size", queries)
 
     # -------------------------------------------- request-level result cache
 
@@ -875,6 +946,21 @@ class Executor:
         a non-None entry is attached to the thread while that
         request's dispatch and finalize phases run (execute_batch_
         shaped feeds these and fills the cache after shaping)."""
+        return self.execute_batch_finish(
+            self.execute_batch_begin(requests, profiles, deps))
+
+    def execute_batch_begin(self, requests: Sequence[Tuple[str, Any,
+            Optional[Sequence[int]]]],
+            profiles: Optional[Sequence[Any]] = None,
+            deps: Optional[Sequence[Optional[dict]]] = None
+            ) -> "_BatchInFlight":
+        """The dispatch half of execute_batch: parse, plan, fuse and
+        LAUNCH every request's device programs, then start the async
+        result prefetch — and return with results still pending. The
+        pipelined serving path (server/coalescer.py) runs this for
+        batch K+1 while batch K's execute_batch_finish is still
+        draining, overlapping plan build + H2D with device time — the
+        RTT the dispatch floor (docs/perf.md §5) charges per batch."""
         from pilosa_tpu.executor.fusion import FusionCollector
         profs = list(profiles) if profiles is not None \
             else [None] * len(requests)
@@ -937,10 +1023,20 @@ class Executor:
             fuser.flush()
         for _, (_, staged, _) in staged_q:
             prefetch_pendings(staged)
-        for j, (idx, staged, opts) in staged_q:
+        return _BatchInFlight(staged_q, out, profs, deps_l)
+
+    def execute_batch_finish(self, flight: "_BatchInFlight") -> List[Any]:
+        """The drain half of execute_batch: block on every pending
+        transfer and build host results. Safe to run from a different
+        thread than the begin (the pipelined coalescer's finalizer):
+        profile/deps contexts re-attach per request below, and all
+        device programs were dispatched with their operand banks
+        snapshotted."""
+        out = flight.out
+        for j, (idx, staged, opts) in flight.staged_q:
             try:
-                with self._profiled(profs[j]), \
-                        self._dep_capture(deps_l[j]):
+                with self._profiled(flight.profs[j]), \
+                        self._dep_capture(flight.deps_l[j]):
                     out[j] = (self._finalize_staged(idx, staged), opts)
             except Exception as e:
                 out[j] = e
@@ -962,6 +1058,15 @@ class Executor:
         write-containing batchmate never consults the cache — its
         lookup would run before that write does, and sequential
         semantics demand it observe post-write state."""
+        return self.execute_batch_shaped_finish(
+            self.execute_batch_shaped_begin(requests, profiles))
+
+    def execute_batch_shaped_begin(self, requests: Sequence[Tuple[
+            str, Any, Optional[Sequence[int]]]],
+            profiles: Optional[Sequence[Any]] = None) -> "_ShapedInFlight":
+        """Cache lookups + the dispatch half of the shaped batch (see
+        execute_batch_begin); execute_batch_shaped_finish drains,
+        shapes and fills the cache — possibly from another thread."""
         n = len(requests)
         profs = list(profiles) if profiles is not None else [None] * n
         out: List[Any] = [None] * n
@@ -985,10 +1090,18 @@ class Executor:
                 keys[j] = key
                 deps_l[j] = {}
             run.append(j)
-        res = self.execute_batch(
+        flight = self.execute_batch_begin(
             [requests[j] for j in run],
             profiles=[profs[j] for j in run],
             deps=[deps_l[j] for j in run])
+        return _ShapedInFlight(flight, out, keys, deps_l, run,
+                               list(requests))
+
+    def execute_batch_shaped_finish(self, sh: "_ShapedInFlight"
+                                    ) -> List[Any]:
+        out, keys, deps_l, run, requests = (sh.out, sh.keys, sh.deps_l,
+                                            sh.run, sh.requests)
+        res = self.execute_batch_finish(sh.flight)
         for j, r in zip(run, res):
             index_name = requests[j][0]
             if isinstance(r, Exception):
@@ -1481,7 +1594,8 @@ class Executor:
                            bank_arrays=bank_arrays,
                            idxs=list(plan.idxs), params=list(plan.params),
                            lits=lits, fp=fp, gen=gen,
-                           cacheable=not plan.literals)
+                           cacheable=not plan.literals,
+                           ir=tuple(plan.ir) if plan.ir_ok else None)
 
     def _tree_fn(self, staged: "_StagedEval") -> Tuple[Callable, bool]:
         """Compile phase: the jitted program for a staged eval, from
@@ -1617,6 +1731,9 @@ class Executor:
             ex = self._plan_slot_leaf(ef, VIEW_STANDARD, 0, shards, plan)
             sub = self._plan_call(idx, call.children[0], shards, plan)
             plan.sig_parts.append("!")
+            # Not(x) IS existence \ x: the same left-fold "diff" node
+            # the Difference lowering uses (operands pushed in order).
+            plan.ir.append(("fold", "diff", 2))
             return lambda b, i, p, l: jnp.bitwise_and(
                 ex(b, i, p, l), jnp.bitwise_not(sub(b, i, p, l)))
         if name == "Shift":
@@ -1624,6 +1741,7 @@ class Executor:
             sub = self._plan_call(idx, call.children[0], shards, plan)
             plan.sig_parts.append(f"S{n}")
             plan.shift_bits += n  # widen the plan so bits can't fall off
+            plan.ir_ok = False  # word-carry shifts have no mega opcode
             from pilosa_tpu.ops.bitset import shift_bits
             return lambda b, i, p, l: shift_bits(sub(b, i, p, l), n)
         if name in ("Intersect", "Union", "Difference", "Xor"):
@@ -1636,6 +1754,9 @@ class Executor:
                    "Xor": jnp.bitwise_xor,
                    "Difference": lambda a, c: jnp.bitwise_and(
                        a, jnp.bitwise_not(c))}
+            fold = {"Intersect": "and", "Union": "or", "Xor": "xor",
+                    "Difference": "diff"}[name]
+            plan.ir.append(("fold", fold, len(subs)))
             op = ops[name]
             return lambda b, i, p, l: functools.reduce(
                 op, [s(b, i, p, l) for s in subs])
@@ -1663,6 +1784,7 @@ class Executor:
         plan.slot_refs.append((i, key, row_id))
         plan.rows_for.setdefault(key, set()).add(row_id)
         plan.sig_parts.append(f"r{pos}")
+        plan.ir.append(("slot", pos, i))
         return lambda b, idxs, p, l: _align_words(b[pos][idxs[i]],
                                                   plan.width)
 
@@ -1687,12 +1809,14 @@ class Executor:
             views = [v for v in field.views_for_range(start, end)
                      if field.view(v) is not None]
             if not views:
+                plan.ir.append(("zero",))
                 return (lambda b, i, p, l:
                         jnp.zeros((len(shards), plan.width), jnp.uint32))
             if len(views) <= MAX_STATIC_RANGE_VIEWS:
                 subs = [self._plan_slot_leaf(field, vn, row_id, shards, plan)
                         for vn in views]
                 plan.sig_parts.append(f"U{len(subs)}")
+                plan.ir.append(("fold", "or", len(subs)))
                 return lambda b, i, p, l: functools.reduce(
                     jnp.bitwise_or, [s(b, i, p, l) for s in subs])
             # Literal: precompute the union eagerly, pass as one operand.
@@ -1710,6 +1834,7 @@ class Executor:
             k = len(plan.literals)
             plan.literals.append(arr)
             plan.sig_parts.append(f"l{k}")
+            plan.ir_ok = False  # literal content is not plan-buffer data
             return lambda b, i, p, l: l[k]
         return self._plan_slot_leaf(field, VIEW_STANDARD, row_id, shards,
                                     plan)
@@ -1739,8 +1864,11 @@ class Executor:
                                 plan.width)
 
         op = cond.op
-        zeros = (lambda b, i, p, l:
-                 jnp.zeros((len(shards), plan.width), jnp.uint32))
+
+        def zeros_leaf():
+            plan.ir.append(("zero",))
+            return (lambda b, i, p, l:
+                    jnp.zeros((len(shards), plan.width), jnp.uint32))
 
         def push_value(base: int) -> int:
             """Base values ride as two u32 limbs in the traced params
@@ -1760,10 +1888,11 @@ class Executor:
             hi, ok_hi = bsig.base_value_clamped(lo_hi[1], "<=")
             if not (ok_lo and ok_hi) or lo > hi:
                 plan.sig_parts.append("z")
-                return zeros
+                return zeros_leaf()
             j = push_value(lo)
             k = push_value(hi)
             plan.sig_parts.append(f"c><{pos}d{depth}")
+            plan.ir.append(("bsi", "between", pos, i0, depth, j, k, True))
             return lambda b, i, p, l: bsi.between(
                 planes_of(b, i), limbs(p, j), limbs(p, k))
         value = int(cond.value)
@@ -1771,12 +1900,13 @@ class Executor:
         if op in (EQ, NEQ) and not in_range:
             if op == EQ:
                 plan.sig_parts.append("z")
-                return zeros
+                return zeros_leaf()
             plan.sig_parts.append(f"cn{pos}d{depth}")
+            plan.ir.append(("bsi", "notnull", pos, i0, depth, 0, 0, False))
             return lambda b, i, p, l: bsi.not_null(planes_of(b, i))
         if op in (LT, LTE, GT, GTE) and not in_range:
             plan.sig_parts.append("z")
-            return zeros
+            return zeros_leaf()
         if op in (LT, LTE):
             allow_eq = (op == LTE) or (value > bsig.max)
         elif op in (GT, GTE):
@@ -1793,6 +1923,16 @@ class Executor:
             GTE: lambda pl, v: bsi.gt(pl, v, allow_eq=True),
         }
         kern = kernels[op]
+        # The megakernel lowering (ops/megakernel.py lower_bsi) expands
+        # these into the exact AND/OR/ANDNOT scan executor/bsi.py runs,
+        # branch decisions taken on the HOST param values the unfused
+        # path feeds the traced jnp.where selects.
+        ir_kind = {EQ: "eq", NEQ: "neq", LT: "lt", LTE: "lt",
+                   GT: "gt", GTE: "gt"}[op]
+        ir_allow = allow_eq if op in (LT, GT) else True
+        if op in (EQ, NEQ):
+            ir_allow = False
+        plan.ir.append(("bsi", ir_kind, pos, i0, depth, j, 0, ir_allow))
         plan.sig_parts.append(f"c{op}{int(allow_eq)}{pos}d{depth}")
         return lambda b, i, p, l: kern(planes_of(b, i), limbs(p, j))
 

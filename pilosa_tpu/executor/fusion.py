@@ -314,5 +314,14 @@ class FusionCollector:
 
     def flush(self) -> None:
         groups, self.groups = self.groups, {}
+        if not groups:
+            return
+        if len(groups) > 1:
+            # Heterogeneous flush: groups whose staged evals lowered
+            # to megakernel IR pack — across signatures — into ONE
+            # plan-buffer launch per shard-count cohort
+            # (executor/megakernel.py); the rest run per-group below.
+            from pilosa_tpu.executor.megakernel import run_megakernel
+            groups = run_megakernel(self.executor, groups)
         for group in groups.values():
             group.run()

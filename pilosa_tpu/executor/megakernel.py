@@ -1,0 +1,314 @@
+"""Executor leg of the heterogeneous megakernel (ops/megakernel.py).
+
+FusionCollector.flush hands its signature groups here first: groups
+whose staged evals lowered to megakernel IR are packed — across
+DIFFERENT signatures — into one plan buffer and ONE compiled-program
+launch per shard-count cohort; everything else (literal operands,
+Shift, solo cohorts where the vmapped per-group program is already
+optimal) flows back to the per-group fusion path untouched.
+
+The launch stands UNDER the existing _FuseGroup plumbing: each taken
+group's ``out`` becomes a _MegaView selecting its member lanes from
+the launch's shared (counts, rows) outputs, so every FusedEval handle
+already returned to result code resolves unchanged — one host fetch
+per launch output, per-entry slices bit-identical to the unfused path
+(tests/test_megakernel.py pins this op-by-op).
+
+Kill switch: PILOSA_TPU_MEGAKERNEL=0 restores per-group fusion
+exactly. PILOSA_TPU_MEGA_BYTES caps the launch's register-slab HBM
+footprint; an over-budget cohort falls back rather than OOM.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pilosa_tpu.ops import megakernel as mk
+from pilosa_tpu.utils.hotspots import WORKLOAD
+from pilosa_tpu.utils.memledger import LEDGER
+from pilosa_tpu.utils.timeline import (
+    LANE_DEVICE, LANE_DISPATCH, TIMELINE,
+)
+
+def _default_enabled() -> bool:
+    """PILOSA_TPU_MEGAKERNEL: 1 forces on, 0 kills, default `auto` =
+    on exactly when the backend is a TPU. The launch collapse pays
+    where the per-launch floor is the bottleneck (tunnel RTT 22 µs–
+    70 ms, docs/perf.md §5); on CPU an XLA launch costs ~20 µs while
+    the interpreter's per-launch slab gather is real memcpy, so the
+    per-group vmap path measured faster there (benches/
+    mega_burst_bench.py: 300 vs 72 q/s mixed) — the same
+    measured-tradeoff gating as the Pallas bank-sweep kernels."""
+    flag = os.environ.get("PILOSA_TPU_MEGAKERNEL", "auto").strip().lower()
+    if flag in ("1", "true", "yes", "on"):
+        return True
+    if flag in ("0", "false", "no", "off"):
+        return False
+    try:
+        import jax
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+# Evaluated once at first flush-time import (banks exist by then, so
+# the backend is initialized); tests and benches toggle the module
+# attribute directly, exactly like executor.FUSION_ENABLED.
+MEGAKERNEL_ENABLED = _default_enabled()
+
+# Register-slab HBM budget per launch: the interpreter materializes
+# [T_pad, S, W] uint32 registers (gathered operand rows + scratch); a
+# cohort whose slab would exceed this runs per-group instead.
+MEGA_MAX_BYTES = int(os.environ.get("PILOSA_TPU_MEGA_BYTES", 1 << 30))
+
+
+class _MegaView:
+    """One group's window onto a launch's shared outputs. Satisfies
+    exactly the slice of the device-array surface _FuseGroup/FusedEval
+    resolution touches: ``[b]`` for device_words, ``np.asarray`` for
+    the one shared host fetch, ``copy_to_host_async`` for prefetch."""
+
+    __slots__ = ("launch", "mode", "lanes", "width")
+
+    def __init__(self, launch: "_MegaLaunch", mode: str,
+                 lanes: List[int], width: int) -> None:
+        self.launch = launch
+        self.mode = mode
+        self.lanes = lanes
+        self.width = width
+
+    def _dev(self) -> Any:
+        out = self.launch.out
+        return out[0] if self.mode == "count" else out[1]
+
+    def __getitem__(self, b: int) -> Any:
+        lane = self.lanes[b]
+        if self.mode == "count":
+            return self._dev()[lane]
+        return self._dev()[lane, :, :self.width]
+
+    # graftlint: materialize — the FusedEval.host convention: the
+    # launch output fetches ONCE (cached on the launch) and every
+    # group view slices the shared host copy.
+    def __array__(self, dtype: Any = None, copy: Any = None) -> np.ndarray:
+        host = self.launch.host(self.mode)
+        out = host[self.lanes]
+        if self.mode != "count":
+            out = out[:, :, :self.width]
+        return np.asarray(out, dtype=dtype) if dtype is not None else out
+
+    def copy_to_host_async(self) -> None:
+        fn = getattr(self._dev(), "copy_to_host_async", None)
+        if fn is not None:
+            fn()
+
+
+class _MegaLaunch:
+    """One dispatched plan-buffer program and its shared outputs."""
+
+    __slots__ = ("out", "_host_counts", "_host_rows", "__weakref__")
+
+    def __init__(self, out: Tuple[Any, Any]) -> None:
+        self.out = out
+        self._host_counts: Optional[np.ndarray] = None
+        self._host_rows: Optional[np.ndarray] = None
+
+    # graftlint: materialize — shared device->host boundary for the
+    # whole launch (see _MegaView.__array__).
+    def host(self, mode: str) -> np.ndarray:
+        if mode == "count":
+            if self._host_counts is None:
+                self._host_counts = np.asarray(self.out[0])
+            return self._host_counts
+        if self._host_rows is None:
+            self._host_rows = np.asarray(self.out[1])
+        return self._host_rows
+
+
+def _eligible(group: Any) -> bool:
+    rep = group.entries[0]
+    return rep.ir is not None and rep.mode in ("count", "row") \
+        and rep.lits is None
+
+
+def run_megakernel(executor: Any, groups: Dict[tuple, Any]
+                   ) -> Dict[tuple, Any]:
+    """Take what lowers, launch one program per shard-count cohort,
+    return the groups the caller must still run per-group. Build
+    failures fall back silently (results must never depend on the
+    megakernel); failures after dispatch surface per member exactly
+    like _FuseGroup errors."""
+    if not MEGAKERNEL_ENABLED or executor.mesh is not None:
+        return groups
+    cohorts: Dict[int, List[Any]] = {}
+    remaining: Dict[tuple, Any] = {}
+    for key, group in groups.items():
+        if group.entries and _eligible(group):
+            cohorts.setdefault(group.entries[0].n_shards, []).append(group)
+        else:
+            remaining[key] = group
+    for n_shards, cohort in cohorts.items():
+        # A single-signature cohort already runs as one (vmapped)
+        # launch — the interpreter buys nothing and loses the lane
+        # parallelism, so only heterogeneous cohorts take this path.
+        if len(cohort) < 2:
+            for g in cohort:
+                remaining[("solo", id(g))] = g
+            continue
+        try:
+            plan, w_mega, lanes = _build(cohort)
+        except Exception:
+            # Lowering is best-effort by contract: any surprise means
+            # the per-group path answers instead.
+            for g in cohort:
+                remaining[("fallback", id(g))] = g
+            continue
+        if mk.slab_nbytes(plan.n_regs, n_shards, w_mega) > MEGA_MAX_BYTES:
+            for g in cohort:
+                remaining[("budget", id(g))] = g
+            continue
+        _launch(executor, cohort, plan, n_shards, w_mega, lanes)
+    return remaining
+
+
+def _build(cohort: List[Any]) -> Tuple[mk.Plan, int, List[List[int]]]:
+    """Lower every entry of every group into one plan; returns the
+    plan, the launch word width, and per-group member lanes."""
+    w_mega = max(e.width for g in cohort for e in g.entries)
+    low = mk.Lowering()
+    lanes: List[List[int]] = []
+    for g in cohort:
+        g_lanes = []
+        for e in g.entries:
+            g_lanes.append(low.add_entry(e.ir, e.bank_arrays, e.idxs,
+                                         e.params, e.width, e.mode))
+        lanes.append(g_lanes)
+    return low.finish(), w_mega, lanes
+
+
+def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
+            n_shards: int, w_mega: int,
+            lanes: List[List[int]]) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    ex = executor
+    n_entries = sum(len(g.entries) for g in cohort)
+    try:
+        key = plan.sig(n_shards, w_mega)
+        fn = ex._jit_get(key)
+        jit_hit = fn is not None
+        if fn is None:
+            ex._note_jit_compile()
+            from pilosa_tpu.ops import pallas_kernels
+            fn = jax.jit(mk.build_program(
+                n_shards, w_mega, plan.n_regs,
+                use_pallas=pallas_kernels.enabled()))
+            ex._jit_put(key, fn)
+        # Plan buffers are per-launch data (the whole point: new mixed
+        # composition, same compiled program) — upload them now and
+        # charge the bytes as this launch's plan-buffer H2D.
+        slots_dev = tuple(jnp.asarray(s) for s in plan.slots)
+        widths_dev = jnp.asarray(plan.widths)
+        instrs_dev = jnp.asarray(plan.instrs)
+        out_count_dev = jnp.asarray(plan.out_count)
+        out_row_dev = jnp.asarray(plan.out_row)
+        plan_bytes = plan.plan_nbytes
+        t0 = time.perf_counter()
+        out = ex._call_program(fn, plan.banks, slots_dev, widths_dev,
+                               instrs_dev, out_count_dev, out_row_dev)
+        dispatch_s = time.perf_counter() - t0
+    except Exception as e:
+        for g in cohort:
+            g.error = e
+            g.entries, g.profs, g.nodes = [], [], []
+        return
+    launch = _MegaLaunch(out)
+    try:
+        for g, g_lanes in zip(cohort, lanes):
+            rep = g.entries[0]
+            g.out = _MegaView(launch, rep.mode, g_lanes, rep.width)
+            g.batched = True
+        # Ledger the launch's device residents: live bytes are the real
+        # lanes' outputs; padding is the pow2 capacity slack in the slab,
+        # instruction buffer and output lanes. Keyed on the launch object,
+        # unregistered when the last member's response drops it.
+        lane_bytes = sum(
+            int(np.prod((e.n_shards,) if e.mode == "count"
+                        else (e.n_shards, e.width))) * 4
+            for g in cohort for e in g.entries)
+        slab = mk.slab_nbytes(plan.n_regs, n_shards, w_mega)
+        live_slab = mk.slab_nbytes(plan.n_slots, n_shards, w_mega)
+        LEDGER.track(launch, "fusion_pad", lane_bytes,
+                     padded_bytes=(slab - live_slab) + plan_bytes,
+                     batch=n_entries, groups=len(cohort),
+                     planEntries=plan.n_instrs)
+        ex._note_mega(n_entries, plan.n_instrs, plan_bytes)
+        _attribute(ex, cohort, launch, jit_hit, t0, dispatch_s, plan,
+                   plan_bytes, n_entries)
+    except Exception as e:
+        # Per-member error isolation, the _FuseGroup.run contract: an
+        # async device failure surfacing here (e.g. the sampled
+        # _fence_device inside _attribute) lands on THIS cohort's
+        # groups — FusedEval._out checks `error` before `out`, so the
+        # already-assigned views never serve — and batchmates in other
+        # cohorts/groups are unharmed.
+        for g in cohort:
+            g.error = e
+    finally:
+        for g in cohort:
+            g.entries, g.profs, g.nodes = [], [], []
+
+
+def _attribute(ex: Any, cohort: List[Any], launch: _MegaLaunch,
+               jit_hit: bool, t_disp: float, dispatch_s: float,
+               plan: mk.Plan, plan_bytes: int, n_entries: int) -> None:
+    """Profiler/timeline attribution, the _FuseGroup._attribute
+    convention: the program ran once for the whole launch, so every
+    member sees the shared dispatch (and sampled device) time labeled
+    with its launch coordinates."""
+    fence_profs: List[Tuple[Any, Any]] = []
+    mega_index = 0
+    for g in cohort:
+        for prof, node in zip(g.profs, g.nodes):
+            b = mega_index
+            mega_index += 1
+            if prof is None or node is None:
+                continue
+            prof.tree_jit(node, jit_hit)
+            prof.tree_h2d(node, plan_bytes // max(1, n_entries))
+            prof.tree_dispatch(node, dispatch_s)
+            node.attrs["megaBatch"] = n_entries
+            node.attrs["megaIndex"] = b
+            node.attrs["planEntries"] = plan.n_instrs
+            node.attrs["planBytes"] = plan_bytes
+            prof.set_fused(n_entries)
+            if prof.timeline is not None:
+                TIMELINE.event(prof.timeline, "dispatch", LANE_DISPATCH,
+                               t_disp, dispatch_s, megaBatch=n_entries,
+                               megaIndex=b, planEntries=plan.n_instrs,
+                               planBytes=plan_bytes)
+            if prof.sample_device:
+                fence_profs.append((prof, node))
+    device_s = 0.0
+    if fence_profs:
+        from pilosa_tpu.executor.executor import _fence_device
+        t_dev = time.perf_counter()
+        device_s = _fence_device(launch.out)
+        for prof, node in fence_profs:
+            prof.tree_device(node, device_s)
+            if prof.timeline is not None:
+                TIMELINE.event(prof.timeline, "device", LANE_DEVICE,
+                               t_dev, device_s, megaBatch=n_entries)
+    # Cache-opportunity attribution AFTER the (sampled) fence — the
+    # per-entry share of one launch, same cost basis as the fused and
+    # unfused paths.
+    per_eval = (dispatch_s + device_s) / max(1, n_entries)
+    for g in cohort:
+        for e in g.entries:
+            if e.fp is not None:
+                WORKLOAD.note_eval_seconds(e.fp, per_eval)

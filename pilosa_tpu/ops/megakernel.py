@@ -1,0 +1,409 @@
+"""Heterogeneous staged-query megakernel: N *different* compiled tree
+programs in ONE device launch.
+
+Same-signature fusion (executor/fusion.py) collapses structurally
+identical queries into one vmapped program, but a realistic mixed burst
+still pays one XLA launch per distinct query shape — and docs/perf.md
+§5 shows the serving path is floor-bound by exactly that per-launch
+host/tunnel cost. The fix here is the classic accelerator-offload move
+(the FPGA bitmap-accelerator line of work, PAPERS.md arXiv 1803.11207):
+make the query PLAN data instead of code. The bitmap op mix is tiny and
+regular (AND/OR/XOR/ANDNOT over packed words + popcount reduce — the
+Roaring survey's whole op table, arXiv 1709.07821), so every staged
+eval lowers to a handful of register instructions, and one
+opcode-interpreting program executes the concatenated instruction
+streams of an arbitrary mixed batch in a single launch.
+
+Execution model
+---------------
+* A *register* is one ``[S, W]`` uint32 word slab (S shards, W words).
+* Registers ``0..n_slots-1`` are gathered operand rows: per distinct
+  bank, ``bank[slots]`` fitted to the launch width and masked down to
+  each owning entry's plan width (bit-identical to the unfused path's
+  per-leaf ``_align_words``; zero-extension commutes with every opcode
+  below, so pad words stay zero end to end).
+* Registers above ``n_slots`` are scratch, allocated by the lowering.
+* The plan buffer is an int32 ``[P, 4]`` array of ``(opcode, dst, a,
+  b)`` rows; the interpreter fori-loops over it, ``lax.switch``-ing on
+  the opcode. Instructions, slots, widths and output indices are all
+  *data* — a new mixed-batch composition re-uses the compiled
+  interpreter as long as the pow2-padded capacities match, so the
+  compile cache holds O(log) variants, not one per composition.
+* Outputs: ``counts[out_count] = popcount(reg)`` for count-mode
+  entries (the fused AND+popcount the Tanimoto top-K workload is made
+  of) and ``rows[out_row] = reg`` for row-mode entries, each entry
+  slicing its lane (and its plan width) off the shared result.
+
+BSI comparison predicates lower too: the executor/bsi.py scans are
+pure AND/OR/ANDNOT folds whose per-bit branches depend only on the
+*host-known* predicate value, so ``v > 300`` becomes ~2·depth plan
+rows — value changes change plan bytes, never the compiled program.
+
+The default interpreter is a jitted jnp program (one XLA launch — the
+launch count is what the dispatch floor charges for); an opt-in Pallas
+flavor of the instruction loop lives in ops/pallas_kernels.py under
+the same PILOSA_TPU_PALLAS gate as the bank-sweep kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+# Opcodes (plan-buffer rows are (opcode, dst, a, b); ZERO/COPY ignore b).
+OP_AND = 0
+OP_OR = 1
+OP_XOR = 2
+OP_ANDNOT = 3   # dst = a & ~b  (Difference, Not-via-existence)
+OP_ZERO = 4     # dst = 0
+OP_COPY = 5     # dst = a
+
+OP_NAMES = ("and", "or", "xor", "andnot", "zero", "copy")
+
+_FOLD_OPS = {"and": OP_AND, "or": OP_OR, "xor": OP_XOR, "diff": OP_ANDNOT}
+
+
+def pow2_at_least(n: int) -> int:
+    """Smallest power of two >= max(n, 1) — the capacity buckets that
+    keep the interpreter's compile cache O(log) in every axis."""
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+class Lowering:
+    """Accumulates one launch's plan across N staged evals.
+
+    Slot registers are discovered in IR order but must land bank-grouped
+    in the slab (the gather concatenates per-bank), so instructions are
+    emitted in a token space and remapped by ``finish()`` once every
+    bank's slot list is complete.
+    """
+
+    def __init__(self) -> None:
+        # bank identity -> (dense index, ordered slot-value list)
+        self.bank_order: List[Any] = []      # bank arrays, launch order
+        self.bank_slots: List[List[int]] = []
+        self.bank_widths: List[List[int]] = []
+        self._bank_pos: Dict[int, int] = {}
+        # (bank, slot, width) -> token: entries referencing the SAME
+        # operand row share one slab register (slot registers are
+        # read-only — folds write scratch), so the flagship
+        # Count(Intersect(Row(fp=Q), Row(fp=c_i))) flood gathers the
+        # shared query row Q once, not once per candidate.
+        self._slot_pos: Dict[Tuple[int, int, int],
+                             Tuple[str, int, int]] = {}
+        # token-space program; slot tokens are ("s", bank, k), scratch
+        # tokens are plain ints counted from 0.
+        self.instrs: List[Tuple[int, Any, Any, Any]] = []
+        self.n_scratch = 0
+        self.out_count: List[Any] = []   # token per count-mode entry
+        self.out_row: List[Any] = []     # token per row-mode entry
+
+    # ------------------------------------------------------------ building
+
+    # GL008 disables below: a Lowering is a ONE-LAUNCH builder — it
+    # lives from FusionCollector.flush to Plan construction and is
+    # dropped with the flush, so its accumulators are bounded by the
+    # batch being lowered, not by process lifetime.
+    def _bank(self, array: Any) -> int:
+        pos = self._bank_pos.get(id(array))
+        if pos is None:
+            pos = len(self.bank_order)
+            # graftlint: disable=GL008 — per-launch builder state.
+            self._bank_pos[id(array)] = pos
+            # graftlint: disable=GL008 — per-launch builder state.
+            self.bank_order.append(array)
+            # graftlint: disable=GL008 — per-launch builder state.
+            self.bank_slots.append([])
+            # graftlint: disable=GL008 — per-launch builder state.
+            self.bank_widths.append([])
+        return pos
+
+    def _slot(self, array: Any, slot: int, width: int) -> Tuple[str, int, int]:
+        b = self._bank(array)
+        key = (b, int(slot), int(width))
+        token = self._slot_pos.get(key)
+        if token is None:
+            self.bank_slots[b].append(int(slot))
+            self.bank_widths[b].append(int(width))
+            token = ("s", b, len(self.bank_slots[b]) - 1)
+            # graftlint: disable=GL008 — per-launch builder state.
+            self._slot_pos[key] = token
+        return token
+
+    def _scratch(self) -> int:
+        self.n_scratch += 1
+        return self.n_scratch - 1
+
+    def _emit(self, op: int, dst: Any, a: Any, b: Any) -> None:
+        # graftlint: disable=GL008 — per-launch builder state.
+        self.instrs.append((op, dst, a, b))
+
+    def add_entry(self, ir: Sequence[tuple], bank_arrays: Sequence[Any],
+                  idxs: Sequence[int], params: Sequence[int],
+                  width: int, mode: str) -> int:
+        """Lower one staged eval's postfix IR; returns the entry's lane
+        in its mode's output array."""
+        stack: List[Any] = []
+        for node in ir:
+            kind = node[0]
+            if kind == "slot":
+                _, pos, i = node
+                stack.append(self._slot(bank_arrays[pos], idxs[i], width))
+            elif kind == "zero":
+                r = self._scratch()
+                self._emit(OP_ZERO, r, r, r)
+                stack.append(r)
+            elif kind == "fold":
+                _, opname, n = node
+                ops = stack[-n:]
+                del stack[-n:]
+                acc = ops[0]
+                if n > 1:
+                    # Left fold into a scratch register: slot registers
+                    # may be shared across entries (same bank slot), so
+                    # they are read-only.
+                    r = self._scratch()
+                    self._emit(_FOLD_OPS[opname], r, acc, ops[1])
+                    for operand in ops[2:]:
+                        self._emit(_FOLD_OPS[opname], r, r, operand)
+                    acc = r
+                stack.append(acc)
+            elif kind == "bsi":
+                _, bkind, pos, i0, depth, j, k, allow_eq = node
+                planes = [self._slot(bank_arrays[pos], idxs[i0 + d], width)
+                          for d in range(depth + 1)]
+                stack.append(self._lower_bsi(
+                    bkind, planes, depth, params, j, k, allow_eq))
+            else:  # pragma: no cover - planner and lowering move together
+                raise ValueError(f"unknown megakernel IR node {node!r}")
+        if len(stack) != 1:  # pragma: no cover - structural invariant
+            raise ValueError(f"unbalanced megakernel IR ({len(stack)})")
+        root = stack[0]
+        if mode == "count":
+            # graftlint: disable=GL008 — per-launch builder state.
+            self.out_count.append(root)
+            return len(self.out_count) - 1
+        # graftlint: disable=GL008 — per-launch builder state.
+        self.out_row.append(root)
+        return len(self.out_row) - 1
+
+    # ------------------------------------------------------ BSI expansion
+
+    @staticmethod
+    def _value(params: Sequence[int], j: int) -> int:
+        """Reassemble the two u32 limbs executor params carry."""
+        return int(params[j]) | (int(params[j + 1]) << 32)
+
+    def _lower_bsi(self, kind: str, planes: List[Any], depth: int,
+                   params: Sequence[int], j: int, k: int,
+                   allow_eq: bool) -> Any:
+        """Expand one comparison into the exact bit-plane scan
+        executor/bsi.py traces, with the per-bit branch taken on the
+        host value instead of a traced select — bit-identical because
+        ``jnp.where(vb, x, y)`` with a concrete vb IS x or y."""
+        nn = planes[depth]  # not-null plane
+        if kind == "notnull":
+            return nn
+        if kind == "eq" or kind == "neq":
+            value = self._value(params, j)
+            m = self._scratch()
+            self._emit(OP_COPY, m, nn, nn)
+            for i in range(depth):
+                op = OP_AND if (value >> i) & 1 else OP_ANDNOT
+                self._emit(op, m, m, planes[i])
+            if kind == "eq":
+                return m
+            r = self._scratch()
+            self._emit(OP_ANDNOT, r, nn, m)
+            return r
+        if kind == "between":
+            lo = self._lower_scan(planes, depth, self._value(params, j),
+                                  "gt", True)
+            hi = self._lower_scan(planes, depth, self._value(params, k),
+                                  "lt", True)
+            self._emit(OP_AND, lo, lo, hi)
+            return lo
+        return self._lower_scan(planes, depth, self._value(params, j),
+                                kind, allow_eq)
+
+    def _lower_scan(self, planes: List[Any], depth: int, value: int,
+                    kind: str, allow_eq: bool) -> Any:
+        """The MSB-first lt/gt scan (executor/bsi.py lt/gt): `matched`
+        accumulates, `eq_prefix` narrows, strictly in source order."""
+        matched = self._scratch()
+        self._emit(OP_ZERO, matched, matched, matched)
+        eqp = self._scratch()
+        self._emit(OP_COPY, eqp, planes[depth], planes[depth])
+        tmp = self._scratch()
+        for i in reversed(range(depth)):
+            vb = (value >> i) & 1
+            grows = vb if kind == "lt" else (1 - vb)
+            if grows:
+                # lt: values with 0 under a predicate 1-bit are smaller;
+                # gt: values with 1 under a predicate 0-bit are larger.
+                op = OP_ANDNOT if kind == "lt" else OP_AND
+                self._emit(op, tmp, eqp, planes[i])
+                self._emit(OP_OR, matched, matched, tmp)
+            self._emit(OP_AND if vb else OP_ANDNOT, eqp, eqp, planes[i])
+        if allow_eq:
+            self._emit(OP_OR, matched, matched, eqp)
+        return matched
+
+    # ------------------------------------------------------------ finish
+
+    def finish(self) -> "Plan":
+        """Resolve tokens to bank-grouped register numbers and pad every
+        axis to its pow2 capacity bucket."""
+        offsets: List[int] = []
+        total = 0
+        for slots in self.bank_slots:
+            offsets.append(total)
+            total += len(slots)
+        n_slots = total
+
+        def reg(token: Any) -> int:
+            if isinstance(token, tuple):
+                _, b, kth = token
+                return offsets[b] + kth
+            return n_slots + int(token)
+
+        n_regs = n_slots + self.n_scratch
+        # +1 spare register: pad instructions and pad output lanes need
+        # a dead destination that no real lane reads.
+        t_pad = pow2_at_least(n_regs + 1)
+        spare = t_pad - 1
+        instrs = [(op, reg(d), reg(a), reg(b))
+                  for op, d, a, b in self.instrs]
+        p_pad = pow2_at_least(len(instrs))
+        n_instrs = len(instrs)
+        instrs += [(OP_ZERO, spare, spare, spare)] * (p_pad - n_instrs)
+        widths = [w for ws in self.bank_widths for w in ws]
+        out_count = [reg(t) for t in self.out_count]
+        out_row = [reg(t) for t in self.out_row]
+        nc, nr = len(out_count), len(out_row)
+        out_count += [spare] * (pow2_at_least(nc) - nc)
+        out_row += [spare] * (pow2_at_least(nr) - nr)
+        return Plan(
+            banks=tuple(self.bank_order),
+            slots=tuple(np.asarray(s, np.int32) for s in self.bank_slots),
+            widths=np.asarray(widths + [0] * (t_pad - n_slots), np.int32),
+            instrs=np.asarray(instrs, np.int32).reshape(p_pad, 4),
+            out_count=np.asarray(out_count, np.int32),
+            out_row=np.asarray(out_row, np.int32),
+            n_slots=n_slots, n_regs=t_pad, n_instrs=n_instrs)
+
+
+class Plan:
+    """One launch's finished plan buffers (host numpy; the executor
+    uploads them and counts the bytes as plan-buffer H2D)."""
+
+    __slots__ = ("banks", "slots", "widths", "instrs", "out_count",
+                 "out_row", "n_slots", "n_regs", "n_instrs")
+
+    def __init__(self, banks: Tuple[Any, ...],
+                 slots: Tuple[np.ndarray, ...], widths: np.ndarray,
+                 instrs: np.ndarray, out_count: np.ndarray,
+                 out_row: np.ndarray, n_slots: int, n_regs: int,
+                 n_instrs: int) -> None:
+        self.banks = banks
+        self.slots = slots
+        self.widths = widths
+        self.instrs = instrs
+        self.out_count = out_count
+        self.out_row = out_row
+        self.n_slots = n_slots
+        self.n_regs = n_regs
+        self.n_instrs = n_instrs
+
+    @property
+    def plan_nbytes(self) -> int:
+        """Bytes of plan data uploaded per launch (the telemetry
+        number: how much H2D one mixed batch costs instead of N
+        launches)."""
+        return int(self.instrs.nbytes + self.widths.nbytes
+                   + self.out_count.nbytes + self.out_row.nbytes
+                   + sum(int(s.nbytes) for s in self.slots))
+
+    def sig(self, n_shards: int, w_mega: int) -> str:
+        """Compile-cache key: capacities + operand bank shapes + the
+        per-bank slot-list lengths — every axis the traced program
+        specializes on, nothing else (instruction CONTENT is data)."""
+        bshapes = [(tuple(getattr(a, "shape", ())), len(s))
+                   for a, s in zip(self.banks, self.slots)]
+        return (f"mega|S{n_shards}|W{w_mega}|T{self.n_regs}"
+                f"|P{self.instrs.shape[0]}|C{len(self.out_count)}"
+                f"|R{len(self.out_row)}|B{bshapes}")
+
+
+def slab_nbytes(n_regs: int, n_shards: int, w_mega: int) -> int:
+    """HBM footprint of the launch's register slab."""
+    return int(n_regs) * int(n_shards) * int(w_mega) * 4
+
+
+def build_program(n_shards: int, w_mega: int, t_pad: int,
+                  use_pallas: bool = False) -> Callable[..., Any]:
+    """The traceable interpreter body for one capacity bucket. The
+    caller jits it (through the executor's LRU compile cache, so the
+    retrace counter sees every real signature miss)."""
+    import jax
+    import jax.numpy as jnp
+
+    from pilosa_tpu.ops.bitset import popcount
+
+    def _fit(rows: Any) -> Any:
+        """Slice or zero-pad the word axis to the launch width — the
+        launch-level _align_words."""
+        w = rows.shape[-1]
+        if w > w_mega:
+            return rows[..., :w_mega]
+        if w < w_mega:
+            return jnp.pad(rows, [(0, 0)] * (rows.ndim - 1)
+                           + [(0, w_mega - w)])
+        return rows
+
+    def run(banks: Tuple[Any, ...], slots: Tuple[Any, ...], widths: Any,
+            instrs: Any, out_count: Any, out_row: Any) -> Tuple[Any, Any]:
+        parts = [_fit(bank[sl]) for bank, sl in zip(banks, slots)]
+        if parts:
+            slab = jnp.concatenate(parts, axis=0)
+        else:
+            slab = jnp.zeros((0, n_shards, w_mega), jnp.uint32)
+        n_slots = slab.shape[0]
+        # Mask every gathered row down to its entry's plan width: ops
+        # below keep zero-extended words zero, so per-entry outputs
+        # sliced back to plan width are bit-identical to the unfused
+        # per-plan programs.
+        wmask = (jnp.arange(w_mega, dtype=jnp.int32)[None, :]
+                 < widths[:n_slots, None])
+        slab = jnp.where(wmask[:, None, :], slab, jnp.uint32(0))
+        slab = jnp.concatenate(
+            [slab, jnp.zeros((t_pad - n_slots, n_shards, w_mega),
+                             jnp.uint32)], axis=0)
+
+        if use_pallas:
+            from pilosa_tpu.ops import pallas_kernels
+            slab = pallas_kernels.mega_interpret(slab, instrs)
+        else:
+            branches = (
+                lambda a, b: jnp.bitwise_and(a, b),
+                lambda a, b: jnp.bitwise_or(a, b),
+                lambda a, b: jnp.bitwise_xor(a, b),
+                lambda a, b: jnp.bitwise_and(a, jnp.bitwise_not(b)),
+                lambda a, b: jnp.zeros_like(a),
+                lambda a, b: a,
+            )
+
+            def body(i: Any, sl: Any) -> Any:
+                op = instrs[i, 0]
+                va = sl[instrs[i, 2]]
+                vb = sl[instrs[i, 3]]
+                res = jax.lax.switch(op, branches, va, vb)
+                return sl.at[instrs[i, 1]].set(res)
+
+            slab = jax.lax.fori_loop(0, instrs.shape[0], body, slab)
+        counts = popcount(slab[out_count], axis=-1)   # [Nc, S] uint32
+        rows = slab[out_row]                          # [Nr, S, W]
+        return counts, rows
+
+    return run

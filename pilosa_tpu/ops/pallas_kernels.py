@@ -277,6 +277,78 @@ def pbank_membership_counts(pos_grouped: jax.Array, qtop_pad: jax.Array,
     return out.reshape(R)
 
 
+# ---------------------------------------------------------------------------
+# Heterogeneous staged-query megakernel — the instruction-interpreter
+# loop of ops/megakernel.py as ONE Pallas kernel: the [P, 4] plan
+# buffer (opcode, dst, a, b) sits in SMEM, the register slab in VMEM,
+# and a fori loop inside the kernel body walks the plan, dynamically
+# loading the two operand registers each entry names, dispatching on
+# its opcode, and storing the destination register in place. The
+# read-after-write chain between plan entries (entry k reads what
+# entry k-1 wrote) lives INSIDE one kernel invocation, so it is
+# sequential by construction — a grid-per-entry formulation with
+# aliased outputs reads stale operand blocks and is wrong.
+#
+# Status: correctness-pinned in interpret mode (tests/
+# test_pallas_kernels.py) like the bank-sweep kernels above, and
+# reached only under the same PILOSA_TPU_PALLAS=1 opt-in
+# (executor/megakernel.py builds the jnp fori/switch interpreter
+# otherwise, which XLA compiles to the same single launch). The whole
+# slab must fit VMEM in this formulation — the flood-workload slabs
+# (a few hundred trimmed registers) do; validate on hardware via the
+# bench probe before flipping the default, as with every kernel here.
+
+
+def _mega_loop_kernel(n_instrs: int) -> Callable[..., None]:
+    def kernel(instr_ref: Any, slab_ref: Any, out_ref: Any) -> None:
+        from jax.experimental import pallas as pl
+
+        out_ref[...] = slab_ref[...]
+
+        def body(i: Any, carry: Any) -> Any:
+            op = instr_ref[i, 0]
+            va = pl.load(out_ref, (pl.ds(instr_ref[i, 2], 1),))
+            vb = pl.load(out_ref, (pl.ds(instr_ref[i, 3], 1),))
+            zero = jnp.zeros_like(va)
+            res = jnp.where(
+                op == 0, jnp.bitwise_and(va, vb),
+                jnp.where(op == 1, jnp.bitwise_or(va, vb),
+                          jnp.where(op == 2, jnp.bitwise_xor(va, vb),
+                                    jnp.where(op == 3,
+                                              jnp.bitwise_and(
+                                                  va,
+                                                  jnp.bitwise_not(vb)),
+                                              jnp.where(op == 4, zero,
+                                                        va)))))
+            pl.store(out_ref, (pl.ds(instr_ref[i, 1], 1),), res)
+            return carry
+
+        jax.lax.fori_loop(0, n_instrs, body, 0)
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def mega_interpret(slab: jax.Array, instrs: jax.Array, *,
+                   interpret: bool = False) -> jax.Array:
+    """Run a [P, 4] int32 plan buffer (opcode, dst, a, b) over a
+    [T, S, W] uint32 register slab; returns the final slab."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    T, S, W = slab.shape
+    P = instrs.shape[0]
+    return pl.pallas_call(
+        _mega_loop_kernel(P),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((T, S, W), lambda: (0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((T, S, W), lambda: (0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((T, S, W), slab.dtype),
+        interpret=interpret,
+    )(instrs, slab)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def bsi_plane_counts(planes: jax.Array, mask: jax.Array, *,
                      interpret: bool = False) -> jax.Array:

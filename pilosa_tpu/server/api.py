@@ -1021,6 +1021,13 @@ class API:
                 "retraces": self.executor.jit_compiles,
                 "fusedDispatches": self.executor.fused_dispatches,
                 "fusedQueries": self.executor.fused_queries,
+                # Heterogeneous megakernel (executor/megakernel.py):
+                # mixed-signature flushes collapsed to single
+                # plan-buffer launches, and what those plans cost.
+                "megaLaunches": self.executor.mega_launches,
+                "megaQueries": self.executor.mega_queries,
+                "megaPlanEntries": self.executor.mega_plan_entries,
+                "megaPlanBytes": self.executor.mega_plan_bytes,
             },
             # Cross-request cache tier (executor/result_cache.py +
             # core/cache.RANK_CACHE): hit ratios and live bytes in the

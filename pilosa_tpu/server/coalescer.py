@@ -52,6 +52,7 @@ direct path whenever the coalescer is absent, stopped, or ineligible
 
 from __future__ import annotations
 
+import os
 import threading
 from pilosa_tpu.utils.locks import make_condition
 import time
@@ -66,6 +67,15 @@ from pilosa_tpu.utils.timeline import LANE_COALESCE, LANE_QUEUE, TIMELINE
 # the dispatcher; result imminent) or EJECTED (deadline passed while
 # queued; the dispatcher must skip it).
 _PENDING, _CLAIMED, _EJECTED = 0, 1, 2
+
+# RTT-hiding pipelined dispatch (kill switch): while batch K's results
+# drain on the finalizer thread, the dispatcher plans + launches batch
+# K+1 — the plan-build and H2D that docs/perf.md §5 shows sitting
+# serially inside every flush otherwise. Depth is exactly one in-flight
+# batch (double buffering); write-containing or single-item flushes
+# barrier and run the exact serial path, so results are always
+# identical to PILOSA_TPU_PIPELINE=0.
+PIPELINE_ENABLED = os.environ.get("PILOSA_TPU_PIPELINE", "1") != "0"
 
 
 class CoalescerStopped(RuntimeError):
@@ -125,7 +135,7 @@ class QueryCoalescer:
     def __init__(self, executor, window_s: float = 0.0015,
                  max_batch: int = 64, max_queue: int = 256,
                  deadline_s: float = 0.0, stats=None, tracer=None,
-                 logger=None):
+                 logger=None, pipeline: Optional[bool] = None):
         from pilosa_tpu.utils.stats import NopStatsClient
         from pilosa_tpu.utils.tracing import NopTracer
         self.executor = executor
@@ -136,6 +146,14 @@ class QueryCoalescer:
         self.stats = stats or NopStatsClient()
         self.tracer = tracer or NopTracer()
         self.logger = logger
+        # Pipelined dispatch: config default (None -> on) gated by the
+        # PILOSA_TPU_PIPELINE env kill switch, and by the executor
+        # actually exposing the begin/finish split (stub executors in
+        # tests don't).
+        self.pipeline = (PIPELINE_ENABLED
+                         and (pipeline is None or bool(pipeline))
+                         and hasattr(executor,
+                                     "execute_batch_shaped_begin"))
         self._queue: List[_Item] = []
         # Items claimed out of _queue for the batch being built or
         # executed — tracked on self so the dispatcher-death handler
@@ -150,6 +168,16 @@ class QueryCoalescer:
         # that span have already "waited" (continuous batching), so the
         # next flush takes them without re-running the window timer.
         self._busy = False
+        # Pipelined-dispatch plumbing: the (depth-1) hand-off slot to
+        # the finalizer thread plus its lifecycle flag. `_pl_pending`
+        # holds exactly one in-flight batch's finalize work; the
+        # dispatcher blocks on the slot before handing off the next —
+        # that IS the double buffer.
+        self._pl_cond = make_condition("QueryCoalescer._pl_cond")
+        self._pl_pending: Optional[tuple] = None
+        self._pl_stop = False
+        self._pl_thread: Optional[threading.Thread] = None
+        self.pipelined_flushes = 0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -175,6 +203,13 @@ class QueryCoalescer:
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="query-coalescer")
         self._thread.start()
+        if self.pipeline and (self._pl_thread is None
+                              or not self._pl_thread.is_alive()):
+            self._pl_stop = False
+            self._pl_thread = threading.Thread(
+                target=self._finalize_loop, daemon=True,
+                name="query-coalescer-finalize")
+            self._pl_thread.start()
 
     def stop(self, timeout: float = 30.0) -> None:
         """Graceful drain: stop admitting, execute everything queued,
@@ -198,6 +233,16 @@ class QueryCoalescer:
                         "dispatcher still executing a batch", timeout)
                 return
             self._thread = None
+        # The dispatcher barriers its own in-flight batch before
+        # exiting, so the finalizer is idle here — stop it too.
+        with self._pl_cond:
+            self._pl_stop = True
+            self._pl_cond.notify_all()
+        ft = self._pl_thread
+        if ft is not None:
+            ft.join(timeout=timeout)
+            if not ft.is_alive():
+                self._pl_thread = None
 
     # --------------------------------------------------------------- submit
 
@@ -285,18 +330,29 @@ class QueryCoalescer:
                         self._busy = False
                         self._cond.wait()
                     if not self._queue and self._stop:
-                        return
+                        break  # drain the pipeline below, then exit
                     reason = self._collect_window()
                     batch = self._claim_batch()
                     busy_next = bool(self._queue)
                 if batch:
-                    self._execute(batch, reason)
+                    if self._can_pipeline(batch):
+                        self._execute_pipelined(batch, reason)
+                    else:
+                        # Writes (and the single-item direct path)
+                        # run serially AFTER the in-flight batch fully
+                        # drains: a write must not mutate fragment
+                        # state a draining read could still lazily
+                        # consult (TopN chunking) — the pipelined path
+                        # keeps exactly the sequential semantics.
+                        self._pipeline_barrier()
+                        self._execute(batch, reason)
                 self._inflight = []
                 with self._cond:
                     # Items that arrived while executing have waited
                     # their window already: take them on the next loop
                     # pass without re-arming the timer.
                     self._busy = busy_next or bool(self._queue)
+            self._pipeline_barrier()
         except BaseException as e:  # dispatcher died: strand nobody
             if self.logger is not None:
                 self.logger.printf("coalescer dispatcher died: %r", e)
@@ -416,14 +472,14 @@ class QueryCoalescer:
             item.result = e
         item.event.set()
 
-    def _execute_batched(self, batch: List[_Item], span,
-                         reason: str = "window") -> None:
-        """One executor batch for N requests, deduplicating identical
-        read-only queries when the flush carries no writes (a write in
-        the batch orders against its batchmates, so reads that would
-        straddle it must each run in position). Forced profiles
-        (?profile=true) never dedup: their tree must describe this
-        request's own execution, not a batchmate's."""
+    def _dedup(self, batch: List[_Item]) -> Tuple[
+            List[Tuple[str, Any, Optional[Sequence[int]]]],
+            List[Any], List[List[_Item]]]:
+        """Collapse identical read-only queries when the flush carries
+        no writes (a write in the batch orders against its batchmates,
+        so reads that would straddle it must each run in position).
+        Forced profiles (?profile=true) never dedup: their tree must
+        describe this request's own execution, not a batchmate's."""
         dedup_ok = not any(it.is_write for it in batch)
         groups: Dict[Tuple[str, str, Optional[Tuple[int, ...]]],
                      List[int]] = {}
@@ -445,12 +501,13 @@ class QueryCoalescer:
             owner.append([item])
         if len(reqs) < len(batch):
             self.stats.count("coalescer.deduped", len(batch) - len(reqs))
-        if span is not None:
-            span.set("unique", len(reqs))
-        # Queue wait ends when execution STARTS — stamped before the
-        # batch runs, so the histogram separates window/queue time from
-        # device time (coalescer.request covers the end-to-end sum).
-        exec_start = time.perf_counter()
+        return reqs, profiles, owner
+
+    def _stamp_queue_wait(self, batch: List[_Item], exec_start: float,
+                          reason: str) -> None:
+        """Queue wait ends when execution STARTS — stamped before the
+        batch runs, so the histogram separates window/queue time from
+        device time (coalescer.request covers the end-to-end sum)."""
         for item in batch:
             self.stats.timing("coalescer.queue_wait",
                               exec_start - item.enqueued_at)
@@ -462,6 +519,16 @@ class QueryCoalescer:
                 TIMELINE.event(getattr(item.profile, "timeline", None),
                                "queue", LANE_QUEUE, item.enqueued_at,
                                wait, batch=len(batch), reason=reason)
+
+    def _execute_batched(self, batch: List[_Item], span,
+                         reason: str = "window") -> None:
+        """One executor batch for N requests, identical reads deduped
+        (see _dedup)."""
+        reqs, profiles, owner = self._dedup(batch)
+        if span is not None:
+            span.set("unique", len(reqs))
+        exec_start = time.perf_counter()
+        self._stamp_queue_wait(batch, exec_start, reason)
         shaped = self.executor.execute_batch_shaped(reqs,
                                                     profiles=profiles)
         flush_s = time.perf_counter() - exec_start
@@ -483,6 +550,111 @@ class QueryCoalescer:
                      sum(1 for p in profiles
                          if p is not None
                          and getattr(p, "fused_batch", None)))
+        for res, items in zip(shaped, owner):
+            for item in items:
+                item.result = res
+                item.event.set()
+
+    # ------------------------------------------------------- pipelined path
+
+    def _can_pipeline(self, batch: List[_Item]) -> bool:
+        """Read-only multi-item flushes pipeline; anything else (a
+        write that must order against in-flight reads, or a singleton
+        that takes the exact direct path) barriers and runs serially."""
+        return (self.pipeline and self._pl_thread is not None
+                and self._pl_thread.is_alive() and len(batch) > 1
+                and not any(it.is_write for it in batch))
+
+    def _pipeline_barrier(self) -> None:
+        """Wait until no batch is in flight on the finalizer."""
+        if self._pl_thread is None:
+            return
+        with self._pl_cond:
+            while self._pl_pending is not None:
+                self._pl_cond.wait()
+
+    def _execute_pipelined(self, batch: List[_Item], reason: str) -> None:
+        """Dispatch half on this (dispatcher) thread — parse, plan,
+        fuse, LAUNCH, start prefetch — then hand the in-flight handle
+        to the finalizer and return to collecting the next window.
+        While the previous batch drains device->host, this one's plan
+        build and H2D run concurrently: the overlap that buys back the
+        per-flush RTT (docs/perf.md §5, scored by
+        pilosa_device_idle_ratio)."""
+        self.stats.count(f"coalescer.flush.{reason}", 1)
+        self.stats.histogram("coalescer.batch_size", len(batch))
+        self._note_workload(batch)
+        try:
+            with self.tracer.span("Coalescer.flush", n=len(batch),
+                                  reason=reason, pipelined=True) as span:
+                reqs, profiles, owner = self._dedup(batch)
+                if span is not None:
+                    span.set("unique", len(reqs))
+                exec_start = time.perf_counter()
+                self._stamp_queue_wait(batch, exec_start, reason)
+                sh = self.executor.execute_batch_shaped_begin(
+                    reqs, profiles=profiles)
+        except Exception as e:  # dispatch failed: resolve everyone now
+            if self.logger is not None:
+                self.logger.printf("coalescer pipelined dispatch "
+                                   "failed: %r", e)
+            for item in batch:
+                if not item.event.is_set():
+                    item.result = e
+                    item.event.set()
+            return
+        self.pipelined_flushes += 1
+        self.stats.count("coalescer.pipelined", 1)
+        with self._pl_cond:
+            # Depth-1 double buffer: wait for the PREVIOUS batch's
+            # drain slot, then occupy it. The wait happens AFTER this
+            # batch dispatched, so its device work already overlaps
+            # the predecessor's drain.
+            while self._pl_pending is not None:
+                self._pl_cond.wait()
+            self._pl_pending = (batch, owner, sh, exec_start, reason,
+                                len(reqs))
+            self._pl_cond.notify_all()
+
+    def _finalize_loop(self) -> None:
+        """Finalizer thread: drain in-flight batches' device->host
+        transfers, shape responses, resolve requesters. Never dies on
+        a batch failure — the error resolves to that batch's items."""
+        while True:
+            with self._pl_cond:
+                while self._pl_pending is None and not self._pl_stop:
+                    self._pl_cond.wait()
+                if self._pl_pending is None:
+                    return
+                work = self._pl_pending
+            try:
+                self._finish_pipelined(*work)
+            except BaseException as e:  # strand nobody, keep draining
+                if self.logger is not None:
+                    self.logger.printf("coalescer pipelined finalize "
+                                       "failed: %r", e)
+                for item in work[0]:
+                    if not item.event.is_set():
+                        item.result = (e if isinstance(e, Exception)
+                                       else CoalescerStopped(repr(e)))
+                        item.event.set()
+            finally:
+                with self._pl_cond:
+                    self._pl_pending = None
+                    self._pl_cond.notify_all()
+
+    def _finish_pipelined(self, batch: List[_Item],
+                          owner: List[List[_Item]], sh: Any,
+                          exec_start: float, reason: str,
+                          unique: int) -> None:
+        shaped = self.executor.execute_batch_shaped_finish(sh)
+        flush_s = time.perf_counter() - exec_start
+        for item in batch:
+            if item.profile is not None:
+                TIMELINE.event(getattr(item.profile, "timeline", None),
+                               "coalesce", LANE_COALESCE, exec_start,
+                               flush_s, batch=len(batch), unique=unique,
+                               reason=reason, pipelined=True)
         for res, items in zip(shaped, owner):
             for item in items:
                 item.result = res

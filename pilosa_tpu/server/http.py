@@ -354,6 +354,13 @@ class Handler(BaseHTTPRequestHandler):
                             "fusedDispatches":
                                 api.executor.fused_dispatches,
                             "fusedQueries": api.executor.fused_queries,
+                            "megaLaunches":
+                                api.executor.mega_launches,
+                            "megaQueries": api.executor.mega_queries,
+                            "megaPlanEntries":
+                                api.executor.mega_plan_entries,
+                            "megaPlanBytes":
+                                api.executor.mega_plan_bytes,
                             "jitCacheSize":
                                 api.executor.jit_cache_size()})
             elif path == "/debug/memory":

@@ -50,6 +50,11 @@ class Config:
     coalescer_max_batch: int = 64      # size cap -> early flush
     coalescer_max_queue: int = 256     # admission bound -> 429 past it
     coalescer_deadline_ms: float = 0.0  # per-request queue deadline; 0 off
+    # RTT-hiding pipelined dispatch: batch K+1 plans/launches on the
+    # dispatcher while batch K's results drain on a finalizer thread
+    # (double-buffered, read-only flushes only — writes barrier).
+    # PILOSA_TPU_PIPELINE=0 is the absolute kill switch over this.
+    coalescer_pipeline: bool = True
     # TPU
     mesh_devices: int = 0         # 0 = all visible devices
     mesh_replicas: int = 1

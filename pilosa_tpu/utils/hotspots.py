@@ -332,23 +332,25 @@ class WorkloadRecorder:
             stats.count("fragment.reads", n_shards)
 
     def record_write(self, index: str, field: str, view: str,
-                     shard: int, generation: Optional[int] = None
-                     ) -> None:
-        """One fragment mutation (called by Fragment._touch_row with
-        the bumped write version — the generation every cache keys
-        on)."""
+                     shard: int, generation: Optional[int] = None,
+                     n: int = 1) -> None:
+        """`n` fragment row mutations in one batch (called by
+        Fragment._touch_rows with the bumped write version — the
+        generation every cache keys on). Bulk imports record once per
+        (fragment, batch) with n = rows touched, so write totals keep
+        per-row semantics without per-row plane calls."""
         if not self.enabled:
             return
         now = self.clock()
         with self._lock:
             st = self._frag((index, field, view, int(shard)))
-            st.writes.add(1, now, self.half_life_s)
+            st.writes.add(n, now, self.half_life_s)
             if generation is not None:
                 st.generation = int(generation)
-            self._totals["fragmentWrites"] += 1
+            self._totals["fragmentWrites"] += n
         stats = self.stats
         if stats is not None:
-            stats.count("fragment.writes", 1)
+            stats.count("fragment.writes", n)
 
     def record_invalidation(self, index: str, field: str, view: str,
                             shards: Sequence[int]) -> None:

@@ -106,6 +106,11 @@ def test_write_fences_fusion_and_tail_read_observes_it(ex, monkeypatch):
 
 
 def test_mixed_signatures_form_independent_groups(ex, monkeypatch):
+    # Megakernel OFF: this test pins the per-signature-group fallback
+    # (the PILOSA_TPU_MEGAKERNEL=0 regime). The megakernel collapses
+    # the same batch to ONE launch — tests/test_megakernel.py.
+    from pilosa_tpu.executor import megakernel as megamod
+    monkeypatch.setattr(megamod, "MEGAKERNEL_ENABLED", False)
     reqs = ([("i", f"Count(Row(f={r}))", None) for r in (1, 2, 3)]
             + [("i", f"Row(f={r})", None) for r in (4, 5)]
             + [("i", "Count(Intersect(Row(f=6), Row(g=7)))", None)])

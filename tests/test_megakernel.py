@@ -1,0 +1,448 @@
+"""Heterogeneous staged-query megakernel (ops/megakernel.py +
+executor/megakernel.py) and RTT-hiding pipelined dispatch
+(server/coalescer.py): a mixed-signature batch must collapse to
+exactly ONE plan-buffer launch with per-query results bit-identical to
+the unfused/unpipelined path, the kill switches must restore the
+per-group / serial paths exactly, and the dispatch-gap analyzer's
+``pilosa_device_idle_ratio`` must strictly drop when pipelining
+overlaps batch K+1's plan/H2D with batch K's drain. Launch counts are
+asserted deterministically through the ``Executor._call_program``
+funnel stub (the tests/test_fusion.py idiom)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor import megakernel as megamod
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+N_ROWS = 16
+
+
+@pytest.fixture
+def ex(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    rng = np.random.default_rng(23)
+    rows = rng.integers(0, N_ROWS, 6000).astype(np.uint64)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, 6000).astype(np.uint64)
+    f.import_bits(rows, cols)
+    g.import_bits(rows[::2], cols[::2])
+    # Negative min: BSI base-value offsets are in play, so the lowered
+    # plane scans run against offset-encoded predicates like the
+    # traced path does.
+    idx.create_field("v", FieldOptions(type="int", min=-500, max=10000))
+    vcols = rng.integers(0, 2 * SHARD_WIDTH, 900).astype(np.uint64)
+    idx.field("v").import_values(
+        vcols, rng.integers(-500, 10000, 900).astype(np.int64))
+    idx.add_existence(cols)
+    executor = Executor(h)
+    # Exact launch counts are the subject; the result cache would
+    # serve repeats and zero them out (cache-ON interplay is pinned in
+    # tests/test_result_cache.py).
+    executor.result_cache.enabled = False
+    # The default is `auto` (TPU-only — the launch collapse loses on
+    # CPU where launches are ~free); force it ON so the CPU test run
+    # exercises the megakernel path.
+    prev = megamod.MEGAKERNEL_ENABLED
+    megamod.MEGAKERNEL_ENABLED = True
+    yield executor
+    megamod.MEGAKERNEL_ENABLED = prev
+    h.close()
+
+
+def count_dispatches(monkeypatch):
+    calls = []
+    orig = Executor._call_program
+
+    def stub(self, fn, *args):
+        calls.append(fn)
+        return orig(self, fn, *args)
+
+    monkeypatch.setattr(Executor, "_call_program", stub)
+    return calls
+
+
+MIXED = ([("i", f"Count(Row(f={r}))", None) for r in (1, 2, 3)]
+         + [("i", f"Row(g={r})", None) for r in (4, 5)]
+         + [("i", "Count(Intersect(Row(f=6), Row(g=7)))", None)]
+         + [("i", "Count(Row(v > 300))", None)]
+         + [("i", "Row(v < 9000)", None)])
+
+
+def test_mixed_signatures_collapse_to_one_launch(ex, monkeypatch):
+    direct = [ex.execute_full(i, q, shards=s) for i, q, s in MIXED]
+    calls = count_dispatches(monkeypatch)
+    jc0 = ex.jit_compiles
+    shaped = ex.execute_batch_shaped(MIXED)
+    assert shaped == direct
+    assert len(calls) == 1, "a mixed batch must be ONE launch"
+    assert ex.mega_launches == 1
+    assert ex.mega_queries == len(MIXED)
+    assert ex.mega_plan_entries > 0
+    assert ex.mega_plan_bytes > 0
+    # The per-group vmap path never ran.
+    assert ex.fused_dispatches == 0
+    assert ex.jit_compiles == jc0 + 1, "one interpreter compile"
+    # Same composition again: same capacities -> cached program, one
+    # more launch, zero new compiles.
+    assert ex.execute_batch_shaped(MIXED) == direct
+    assert len(calls) == 2
+    assert ex.jit_compiles == jc0 + 1
+    assert ex.mega_launches == 2
+
+
+def test_kill_switch_restores_per_group_fusion(ex, monkeypatch):
+    direct = [ex.execute_full(i, q, shards=s) for i, q, s in MIXED]
+    monkeypatch.setattr(megamod, "MEGAKERNEL_ENABLED", False)
+    calls = count_dispatches(monkeypatch)
+    shaped = ex.execute_batch_shaped(MIXED)
+    assert shaped == direct, "kill switch must not change results"
+    assert ex.mega_launches == 0
+    assert len(calls) == 5, "5 signature groups under the fallback"
+    assert ex.fused_dispatches >= 1
+
+
+OPS = [
+    "Count(Row(f=1))",
+    "Row(f=2)",
+    "Count(Union(Row(f=1), Row(g=2), Row(f=3)))",
+    "Count(Intersect(Row(f=4), Row(g=4)))",
+    "Count(Difference(Row(f=5), Row(g=5)))",
+    "Count(Xor(Row(f=6), Row(g=6)))",
+    "Not(Row(f=7))",
+    "Count(Not(Row(g=8)))",
+    "Row(f=999)",                      # absent row -> zero-slot leaf
+    "Count(Row(v > 300))",
+    "Count(Row(v >= 300))",
+    "Count(Row(v < 4000))",
+    "Count(Row(v <= 4000))",
+    "Count(Row(v == 1234))",
+    "Count(Row(v != 1234))",
+    "Count(Row(v == -800))",           # out of range -> zeros leaf
+    "Count(Row(v != -800))",           # out of range -> not-null
+    "Count(Row(-100 < v < 500))",      # between
+    "Row(v > -499)",
+    "Count(Intersect(Row(f=1), Row(v > 2000)))",
+]
+
+
+def test_every_opcode_bit_identical(ex, monkeypatch):
+    """Every lowerable op family, mixed in one batch: AND/OR/XOR/
+    ANDNOT folds, existence-Not, zero leaves, and the whole BSI
+    comparison table (the host-value-specialized plane scans) must
+    match the traced per-group programs bit for bit."""
+    reqs = [("i", q, None) for q in OPS]
+    direct = [ex.execute_full(i, q, shards=s) for i, q, s in reqs]
+    calls = count_dispatches(monkeypatch)
+    shaped = ex.execute_batch_shaped(reqs)
+    assert shaped == direct
+    assert len(calls) == 1
+    assert ex.mega_queries == len(OPS)
+
+
+def test_unlowerable_shift_falls_back_beside_megakernel(ex, monkeypatch):
+    reqs = ([("i", f"Count(Row(f={r}))", None) for r in (1, 2)]
+            + [("i", f"Row(g={r})", None) for r in (3, 4)]
+            + [("i", "Count(Shift(Row(f=5), n=3))", None)])
+    direct = [ex.execute_full(i, q, shards=s) for i, q, s in reqs]
+    calls = count_dispatches(monkeypatch)
+    shaped = ex.execute_batch_shaped(reqs)
+    assert shaped == direct
+    # One megakernel launch for the 4 lowerable evals + one solo
+    # program for the Shift (no mega opcode for word carries).
+    assert len(calls) == 2
+    assert ex.mega_launches == 1
+    assert ex.mega_queries == 4
+
+
+def test_write_fences_megakernel_batches(ex, monkeypatch):
+    (c0,) = ex.execute("i", "Count(Row(f=5))")
+    r0 = ex.execute("i", "Row(g=5)")[0].columns().tolist()
+    calls = count_dispatches(monkeypatch)
+    free_col = 2 * SHARD_WIDTH - 7
+    out = ex.execute_batch([
+        ("i", "Count(Row(f=5))", None),
+        ("i", "Row(g=5)", None),
+        ("i", f"Set({free_col}, f=5)", None),
+        ("i", "Count(Row(f=5))", None),
+        ("i", "Row(g=5)", None),
+    ])
+    assert out[0][0][0] == c0, "head read sees pre-write state"
+    assert out[1][0][0].columns().tolist() == r0
+    assert out[2][0][0] is True
+    assert out[3][0][0] == c0 + 1, "tail read observes the write"
+    assert out[4][0][0].columns().tolist() == r0
+    # Two mega launches (head pair, tail pair) split by the fence.
+    assert len(calls) == 2
+    assert ex.mega_launches == 2
+    assert ex.mega_queries == 4
+
+
+def test_single_signature_batches_keep_vmap_fusion(ex, monkeypatch):
+    """A homogeneous batch is already one (vmapped) launch — the
+    interpreter must not take it."""
+    queries = [f"Count(Row(f={r}))" for r in range(8)]
+    direct = [ex.execute("i", q)[0] for q in queries]
+    calls = count_dispatches(monkeypatch)
+    out = ex.execute_batch([("i", q, None) for q in queries])
+    assert [r[0][0] for r in out] == direct
+    assert len(calls) == 1
+    assert ex.fused_dispatches == 1
+    assert ex.mega_launches == 0
+
+
+def test_slab_budget_falls_back_per_group(ex, monkeypatch):
+    monkeypatch.setattr(megamod, "MEGA_MAX_BYTES", 1)
+    direct = [ex.execute_full(i, q, shards=s) for i, q, s in MIXED]
+    calls = count_dispatches(monkeypatch)
+    assert ex.execute_batch_shaped(MIXED) == direct
+    assert ex.mega_launches == 0
+    assert len(calls) == 5
+
+
+def test_profile_attribution_mega_fields(ex):
+    from pilosa_tpu.utils.profile import QueryProfile
+    # The Intersect contributes real plan instructions (a gather-only
+    # launch legitimately has planEntries == 0).
+    reqs = ([("i", f"Count(Row(f={r}))", None) for r in (1, 2)]
+            + [("i", "Count(Intersect(Row(f=3), Row(g=3)))", None)])
+    profs = [QueryProfile("i", q) for _, q, _ in reqs]
+    ex.execute_batch(reqs, profiles=profs)
+    seen = set()
+    for p in profs:
+        evals = [n for op in p.ops for n in op.children
+                 if n.name.startswith("eval:")]
+        assert evals, p.ops
+        node = evals[0]
+        assert node.attrs["megaBatch"] == 3
+        assert node.attrs["planEntries"] > 0
+        assert node.attrs["planBytes"] > 0
+        assert node.attrs["jit"] in ("hit", "miss")
+        seen.add(node.attrs["megaIndex"])
+        assert p.fused_batch == 3
+    assert seen == {0, 1, 2}, "each member gets its own launch lane"
+
+
+def test_post_dispatch_failure_isolates_per_member(ex, monkeypatch):
+    """An async device failure surfacing AFTER the launch (at the
+    sampled _fence_device inside attribution) must land on the
+    cohort's members as per-request errors — the _FuseGroup.run
+    isolation contract — and leave the executor serving."""
+    from pilosa_tpu.executor import executor as exmod
+    from pilosa_tpu.utils.profile import QueryProfile
+
+    def boom(out):
+        raise RuntimeError("simulated async device failure")
+
+    monkeypatch.setattr(exmod, "_fence_device", boom)
+    profs = [QueryProfile("i", "q", sample_device=True)
+             for _ in range(2)]
+    out = ex.execute_batch_shaped(
+        [("i", "Count(Row(f=1))", None), ("i", "Row(g=2)", None)],
+        profiles=profs)
+    assert all(isinstance(r, Exception) for r in out), out
+    monkeypatch.undo()
+    assert ex.execute("i", "Count(Row(f=1))")[0] >= 0
+
+
+def test_shared_operand_rows_share_one_slab_register(ex):
+    """The Tanimoto shape: N Count(Intersect(Row(fp=Q), Row(fp=c)))
+    probes share the query row Q — the lowering must gather it ONCE
+    per launch, not once per referencing entry."""
+    from pilosa_tpu.ops.megakernel import Lowering
+    bank = object()
+    low = Lowering()
+    ir = (("slot", 0, 0), ("slot", 0, 1), ("fold", "and", 2))
+    for c in (5, 6, 7):
+        low.add_entry(ir, [bank], [3, c], [], 8, "count")
+    plan = low.finish()
+    # Slots: shared Q row (slot 3) once + three distinct candidates.
+    assert sorted(plan.slots[0].tolist()) == [3, 5, 6, 7]
+
+
+def test_error_isolation_beside_megakernel(ex, monkeypatch):
+    calls = count_dispatches(monkeypatch)
+    out = ex.execute_batch([
+        ("i", "Count(Row(f=1))", None),
+        ("i", "Count(Row(nosuch=1))", None),  # plan-time error
+        ("i", "Row(g=2)", None),
+    ])
+    assert isinstance(out[1], Exception)
+    assert out[0][0][0] == ex.execute("i", "Count(Row(f=1))")[0]
+    assert out[2][0][0].columns().tolist() == \
+        ex.execute("i", "Row(g=2)")[0].columns().tolist()
+    assert ex.mega_queries == 2
+
+
+# --------------------------------------------------------------- pipelined
+
+
+def _burst(co, queries, results, errors):
+    barrier = threading.Barrier(len(queries))
+
+    def worker(i, q):
+        try:
+            barrier.wait()
+            results[i] = co.submit("i", q)
+        except Exception as e:  # noqa: BLE001
+            errors.append((q, e))
+
+    threads = [threading.Thread(target=worker, args=(i, q))
+               for i, q in enumerate(queries)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+
+
+def _mixed_queries(n):
+    qs = []
+    for k in range(n):
+        r = k % N_ROWS
+        qs.append([f"Count(Row(f={r}))", f"Row(g={r})",
+                   f"Count(Intersect(Row(f={r}), Row(g={r})))",
+                   f"Count(Union(Row(f={r}), Row(g={r})))"][k % 4])
+    return qs
+
+
+def test_pipelined_coalescer_bit_identical(ex):
+    from pilosa_tpu.server.coalescer import QueryCoalescer
+    from pilosa_tpu.utils.stats import MemStatsClient
+    queries = _mixed_queries(48)
+    direct = {i: ex.execute_full("i", q) for i, q in enumerate(queries)}
+    co = QueryCoalescer(ex, window_s=0.005, max_batch=8,
+                        stats=MemStatsClient(), pipeline=True)
+    assert co.pipeline
+    co.start()
+    results, errors = {}, []
+    try:
+        _burst(co, queries, results, errors)
+    finally:
+        co.stop()
+    assert not errors, errors
+    assert results == direct, "pipelined responses differ from direct"
+    assert co.pipelined_flushes >= 1
+    assert ex.mega_launches >= 1
+
+
+def test_pipeline_kill_switch_serial_path(ex):
+    from pilosa_tpu.server.coalescer import QueryCoalescer
+    from pilosa_tpu.utils.stats import MemStatsClient
+    queries = _mixed_queries(24)
+    direct = {i: ex.execute_full("i", q) for i, q in enumerate(queries)}
+    co = QueryCoalescer(ex, window_s=0.005, max_batch=8,
+                        stats=MemStatsClient(), pipeline=False)
+    assert not co.pipeline
+    co.start()
+    results, errors = {}, []
+    try:
+        _burst(co, queries, results, errors)
+    finally:
+        co.stop()
+    assert not errors, errors
+    assert results == direct
+    assert co.pipelined_flushes == 0
+
+
+def test_pipelined_write_observes_sequencing(ex):
+    """A write arriving among pipelined read flushes barriers: the
+    post-write read must observe it (sequential semantics per item)."""
+    from pilosa_tpu.server.coalescer import QueryCoalescer
+    from pilosa_tpu.utils.stats import MemStatsClient
+    co = QueryCoalescer(ex, window_s=0.002, max_batch=8,
+                        stats=MemStatsClient(), pipeline=True)
+    co.start()
+    try:
+        results, errors = {}, []
+        _burst(co, _mixed_queries(16), results, errors)
+        assert not errors, errors
+        (c0,) = ex.execute("i", "Count(Row(f=3))")
+        free_col = 2 * SHARD_WIDTH - 11
+        assert co.submit("i", f"Set({free_col}, f=3)")["results"] == [True]
+        assert co.submit("i", "Count(Row(f=3))")["results"] == [c0 + 1]
+    finally:
+        co.stop()
+
+
+def test_idle_ratio_strictly_decreases_with_pipeline(ex, monkeypatch):
+    """The satellite acceptance: under a 64-thread mixed-signature
+    burst, pilosa_device_idle_ratio with pipelined dispatch is
+    strictly below the unpipelined ratio on the same workload — the
+    gap analyzer scoring the overlap win.
+
+    On CPU there is no tunnel, so both legs of the latency the
+    pipeline reorders are injected synthetically, sized like §5's
+    floor: a 20 ms enqueue-side cost (plan + H2D under tunnel RTT)
+    INSIDE the timed dispatch window, and a 3 ms drain cost per shaped
+    response. Serially they alternate — every drain is pure idle
+    between dispatches; pipelined, batch K+1's dispatch lands inside
+    batch K's drain, so the analyzer's busy intervals cover the gaps.
+    Thread-scheduler jitter still moves single runs around, so each
+    mode's ratio is the median of three bursts."""
+    import statistics
+    import time as time_mod
+
+    from pilosa_tpu.server.coalescer import QueryCoalescer
+    from pilosa_tpu.utils.stats import MemStatsClient
+    from pilosa_tpu.utils.timeline import TIMELINE
+
+    queries = _mixed_queries(64)
+    # Warm every compiled variant so no burst pays tracing time.
+    for q in queries:
+        ex.execute_full("i", q)
+    ex.execute_batch_shaped([("i", q, None) for q in queries[:8]])
+
+    orig_call = Executor._call_program
+
+    def rtt_call(self, fn, *args):
+        def slow_fn(*a):
+            time_mod.sleep(0.02)
+            return fn(*a)
+        return orig_call(self, slow_fn, *args)
+
+    orig_shape = Executor.shape_response
+
+    def slow_shape(self, *a, **k):
+        time_mod.sleep(0.003)
+        return orig_shape(self, *a, **k)
+
+    monkeypatch.setattr(Executor, "_call_program", rtt_call)
+    monkeypatch.setattr(Executor, "shape_response", slow_shape)
+
+    pipelined_flushes = []
+
+    def run(pipeline):
+        TIMELINE.reset()
+        co = QueryCoalescer(ex, window_s=0.002, max_batch=8,
+                            stats=MemStatsClient(), pipeline=pipeline)
+        co.start()
+        results, errors = {}, []
+        try:
+            _burst(co, queries, results, errors)
+        finally:
+            co.stop()
+        assert not errors, errors
+        assert len(results) == len(queries)
+        gap = TIMELINE.gap_summary()
+        assert gap["dispatches"] >= 2
+        if pipeline:
+            assert co.pipelined_flushes >= 1
+            pipelined_flushes.append(co.pipelined_flushes)
+        else:
+            assert co.pipelined_flushes == 0
+        return gap["idleRatio"]
+
+    serial_ratio = statistics.median(run(False) for _ in range(3))
+    pipe_ratio = statistics.median(run(True) for _ in range(3))
+    assert pipelined_flushes
+    assert pipe_ratio < serial_ratio, (
+        f"pipelined idle ratio {pipe_ratio:.3f} must drop below the "
+        f"serial {serial_ratio:.3f}")

@@ -191,3 +191,56 @@ def test_pbank_membership_auto_resolves_per_backend(tmp_path,
     forms = {key[3] for key in executor_mod.Executor._PBANK_KERNELS}
     assert "search" in forms
     assert "auto" not in forms
+
+
+# ------------------------------------------------------- megakernel loop
+
+
+def _mega_reference(slab, instrs):
+    """Host reference for the plan-buffer interpreter."""
+    from pilosa_tpu.ops import megakernel as mk
+    ref = slab.copy()
+    for op, d, a, b in instrs:
+        va, vb = ref[a], ref[b]
+        ref[d] = {mk.OP_AND: va & vb, mk.OP_OR: va | vb,
+                  mk.OP_XOR: va ^ vb, mk.OP_ANDNOT: va & ~vb,
+                  mk.OP_ZERO: np.zeros_like(va), mk.OP_COPY: va}[op]
+    return ref
+
+
+def test_mega_interpret_matches_reference_with_raw_chains():
+    """The Pallas plan-buffer loop must honor read-after-write chains
+    BETWEEN plan entries (entry k reading the register entry k-1
+    wrote) — the property a grid-per-entry formulation breaks."""
+    from pilosa_tpu.ops import megakernel as mk
+    rng = np.random.default_rng(5)
+    slab = rng.integers(0, 2**32, (16, 2, 8), dtype=np.uint32)
+    instrs = np.array([
+        [mk.OP_AND, 12, 0, 1],
+        [mk.OP_OR, 12, 12, 2],      # reads its own prior write
+        [mk.OP_ANDNOT, 13, 3, 12],  # reads entry 1's write
+        [mk.OP_XOR, 13, 13, 4],
+        [mk.OP_COPY, 14, 13, 0],
+        [mk.OP_ZERO, 15, 15, 15],
+        [mk.OP_OR, 14, 14, 15],
+    ], np.int32)
+    out = np.asarray(pk.mega_interpret(jnp.asarray(slab),
+                                       jnp.asarray(instrs),
+                                       interpret=True))
+    assert np.array_equal(out, _mega_reference(slab, instrs))
+
+
+def test_mega_interpret_random_programs():
+    from pilosa_tpu.ops import megakernel as mk
+    rng = np.random.default_rng(17)
+    slab = rng.integers(0, 2**32, (8, 1, 4), dtype=np.uint32)
+    for _ in range(5):
+        p = int(rng.integers(1, 12))
+        instrs = np.stack([
+            rng.integers(0, 6, p), rng.integers(0, 8, p),
+            rng.integers(0, 8, p), rng.integers(0, 8, p),
+        ], axis=1).astype(np.int32)
+        out = np.asarray(pk.mega_interpret(jnp.asarray(slab),
+                                           jnp.asarray(instrs),
+                                           interpret=True))
+        assert np.array_equal(out, _mega_reference(slab, instrs))

@@ -207,6 +207,107 @@ with tempfile.TemporaryDirectory() as d:
 print("fusion smoke OK")
 EOF
 
+step "megakernel smoke (32 mixed-signature queries -> 1 launch, kill-switch bit-identity)"
+# Cache off for the same reason as the fusion smoke; megakernel forced
+# ON (default is auto = TPU-only) so the CPU gate exercises the path.
+PILOSA_TPU_RESULT_CACHE=0 PILOSA_TPU_MEGAKERNEL=1 JAX_PLATFORMS=cpu \
+    python - <<'EOF' || fail=1
+import tempfile
+import numpy as np
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor import megakernel as megamod
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("mega")
+    f = idx.create_field("f"); g = idx.create_field("g")
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, 8, 4000).astype(np.uint64)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, 4000).astype(np.uint64)
+    f.import_bits(rows, cols); g.import_bits(rows[::2], cols[::2])
+    idx.add_existence(cols)
+    ex = Executor(h)
+    assert megamod.MEGAKERNEL_ENABLED, "env force must enable"
+    # 32 queries over 4 distinct signatures: one mixed burst.
+    reqs = []
+    for k in range(32):
+        r = k % 8
+        reqs.append(("mega", [f"Count(Row(f={r}))", f"Row(g={r})",
+                              f"Count(Intersect(Row(f={r}), Row(g={r})))",
+                              f"Count(Union(Row(f={r}), Row(g={r})))"
+                              ][(k // 8) % 4], None))
+    calls = []
+    orig = Executor._call_program
+    def stub(self, fn, *args):
+        calls.append(fn)
+        return orig(self, fn, *args)
+    Executor._call_program = stub
+    on = ex.execute_batch_shaped(reqs)
+    Executor._call_program = orig
+    assert len(calls) == 1, f"mixed burst must be ONE launch, got {len(calls)}"
+    assert ex.mega_launches == 1 and ex.mega_queries == 32, \
+        (ex.mega_launches, ex.mega_queries)
+    # The PILOSA_TPU_MEGAKERNEL=0 + PILOSA_TPU_PIPELINE=0 regime:
+    # per-group fusion, serial dispatch — responses must be
+    # bit-identical.
+    megamod.MEGAKERNEL_ENABLED = False
+    off = ex.execute_batch_shaped(reqs)
+    assert on == off, "megakernel responses differ from kill-switch path"
+    assert ex.mega_launches == 1, "kill switch must stop launches"
+    h.close()
+print("megakernel smoke OK")
+EOF
+
+step "pipelined-dispatch smoke (coalesced burst, pipeline on vs off)"
+PILOSA_TPU_RESULT_CACHE=0 JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+import tempfile, threading
+import numpy as np
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.server.coalescer import QueryCoalescer
+from pilosa_tpu.utils.stats import MemStatsClient
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("pl")
+    f = idx.create_field("f")
+    rng = np.random.default_rng(9)
+    rows = rng.integers(0, 8, 4000).astype(np.uint64)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, 4000).astype(np.uint64)
+    f.import_bits(rows, cols)
+    idx.add_existence(cols)
+    ex = Executor(h)
+    queries = [f"Count(Row(f={r % 8}))" if r % 2 else f"Row(f={r % 8})"
+               for r in range(32)]
+    def burst(pipeline):
+        co = QueryCoalescer(ex, window_s=0.005, max_batch=8,
+                            stats=MemStatsClient(), pipeline=pipeline)
+        co.start()
+        results, errors = {}, []
+        barrier = threading.Barrier(len(queries))
+        def worker(i, q):
+            try:
+                barrier.wait()
+                results[i] = co.submit("pl", q)
+            except Exception as e:
+                errors.append(e)
+        ts = [threading.Thread(target=worker, args=(i, q))
+              for i, q in enumerate(queries)]
+        [t.start() for t in ts]; [t.join(timeout=60) for t in ts]
+        co.stop()
+        assert not errors, errors
+        return results, co.pipelined_flushes
+    on, pl_on = burst(True)
+    off, pl_off = burst(False)
+    assert pl_on >= 1 and pl_off == 0, (pl_on, pl_off)
+    assert on == off, "pipelined responses differ from serial path"
+    h.close()
+print("pipelined-dispatch smoke OK")
+EOF
+
 step "result-cache smoke (32 identical queries -> >=30 hits, 1 fused dispatch)"
 JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
 import tempfile
