@@ -544,6 +544,13 @@ class Executor:
         self.mega_queries = 0
         self.mega_plan_entries = 0
         self.mega_plan_bytes = 0
+        # Plan-IR verification gate (ops/megakernel.verify_plan,
+        # PILOSA_TPU_PLAN_VERIFY): plans checked before dispatch and
+        # plans rejected (a reject means a lowering bug — the launch
+        # raised instead of executing wrong bits). /metrics exports
+        # pilosa_executor_plan_verify_{passes,rejects}_total.
+        self.plan_verify_passes = 0
+        self.plan_verify_rejects = 0
         # Optional stats sink (utils/stats interface) the API layer
         # attaches; batch-scoped signals (fusion group sizes) that have
         # no per-query profile to ride report through it.
@@ -717,6 +724,20 @@ class Executor:
             self.stats.count("executor.mega_plan_entries", plan_entries)
             self.stats.count("executor.mega_plan_bytes", plan_bytes)
             self.stats.histogram("executor.mega_batch_size", queries)
+
+    def _note_plan_verify(self, ok: bool) -> None:
+        """Account one pre-launch plan verification (ops/megakernel.
+        verify_plan). A reject is a lowering bug surfacing as a
+        request error instead of wrong bits — the counter pair is the
+        production signal that the gate is live and clean."""
+        with self._jit_stats_lock:
+            if ok:
+                self.plan_verify_passes += 1
+            else:
+                self.plan_verify_rejects += 1
+        if self.stats is not None:
+            self.stats.count("executor.plan_verify_passes" if ok
+                             else "executor.plan_verify_rejects", 1)
 
     # -------------------------------------------- request-level result cache
 

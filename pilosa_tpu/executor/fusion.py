@@ -57,7 +57,7 @@ class FusedEval:
     __slots__ = ("group", "b", "shape", "slice_nbytes")
 
     def __init__(self, group: "_FuseGroup", b: int,
-                 shape: Tuple[int, ...]):
+                 shape: Tuple[int, ...]) -> None:
         self.group = group
         self.b = b
         self.shape = shape  # per-query output shape ([S] or [S, W])
@@ -67,7 +67,7 @@ class FusedEval:
     def nbytes(self) -> int:
         return self.slice_nbytes
 
-    def _out(self):
+    def _out(self) -> Any:
         g = self.group
         if g.error is not None:
             raise g.error
@@ -80,7 +80,7 @@ class FusedEval:
                 raise g.error
         return g.out
 
-    def device_words(self):
+    def device_words(self) -> Any:
         """This query's output as a device array (one slice op)."""
         out = self._out()
         return out[self.b] if self.group.batched else out
@@ -95,7 +95,7 @@ class FusedEval:
             g.host = np.asarray(out)
         return g.host[self.b] if g.batched else g.host
 
-    def __array__(self, dtype=None, copy=None):
+    def __array__(self, dtype: Any = None, copy: Any = None) -> np.ndarray:
         a = self.host()
         return np.asarray(a, dtype=dtype) if dtype is not None else a
 
@@ -114,7 +114,7 @@ class _FuseGroup:
     __slots__ = ("executor", "entries", "profs", "nodes", "out", "host",
                  "batched", "error", "__weakref__")
 
-    def __init__(self, executor):
+    def __init__(self, executor: Any) -> None:
         self.executor = executor
         self.entries: List[Any] = []      # _StagedEval, batch order
         self.profs: List[Any] = []        # QueryProfile or None
@@ -124,7 +124,7 @@ class _FuseGroup:
         self.batched = False
         self.error: Optional[Exception] = None
 
-    def add(self, staged, prof, t_plan0: float) -> FusedEval:
+    def add(self, staged: Any, prof: Any, t_plan0: float) -> FusedEval:
         node = None
         if prof is not None:
             # jit hit/miss is unknown until the group compiles at
@@ -296,11 +296,11 @@ class FusionCollector:
     `flush()` runs every open group — called before a write-containing
     request dispatches (the fence) and once after the dispatch loop."""
 
-    def __init__(self, executor):
+    def __init__(self, executor: Any) -> None:
         self.executor = executor
         self.groups: Dict[tuple, _FuseGroup] = {}
 
-    def add(self, staged, prof, t_plan0: float) -> FusedEval:
+    def add(self, staged: Any, prof: Any, t_plan0: float) -> FusedEval:
         """Stage one eval; returns its FusedEval handle. Grouping is
         by (sig, bank-array identity): the signature equates tree
         shape, widths and shard count, and identity equates the actual

@@ -66,6 +66,25 @@ MEGAKERNEL_ENABLED = _default_enabled()
 MEGA_MAX_BYTES = int(os.environ.get("PILOSA_TPU_MEGA_BYTES", 1 << 30))
 
 
+def _default_verify_mode() -> str:
+    """PILOSA_TPU_PLAN_VERIFY: `on` checks every plan before launch,
+    `off` disables the gate, default `auto` checks the first launch of
+    each jit-cache key (every fresh capacity bucket / bank composition
+    is verified once; steady-state repeats of a proven shape skip the
+    host pass). tests/conftest.py and tools/check.sh pin `on`."""
+    flag = os.environ.get("PILOSA_TPU_PLAN_VERIFY", "auto").strip().lower()
+    if flag in ("1", "true", "yes", "on"):
+        return "on"
+    if flag in ("0", "false", "no", "off"):
+        return "off"
+    return "auto"
+
+
+# Module attribute like MEGAKERNEL_ENABLED: tests and tools toggle it
+# directly; the env var sets the process default.
+PLAN_VERIFY_MODE = _default_verify_mode()
+
+
 class _MegaView:
     """One group's window onto a launch's shared outputs. Satisfies
     exactly the slice of the device-array surface _FuseGroup/FusedEval
@@ -202,6 +221,22 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
         key = plan.sig(n_shards, w_mega)
         fn = ex._jit_get(key)
         jit_hit = fn is not None
+        # Plan-IR verification gate: the checked-IR contract
+        # (ops/megakernel.verify_plan) runs BEFORE anything is
+        # uploaded or dispatched. `on` = every launch, `auto` = the
+        # first launch per jit-cache key (a fresh compiled shape's
+        # first plan is always checked). A reject raises here — it is
+        # caught below and lands on the cohort's groups per member, so
+        # a lowering bug surfaces as request errors, never as wrong
+        # bits on device.
+        if PLAN_VERIFY_MODE == "on" or (PLAN_VERIFY_MODE == "auto"
+                                        and not jit_hit):
+            try:
+                mk.verify_plan(plan, n_shards, w_mega)
+            except mk.PlanVerifyError:
+                ex._note_plan_verify(False)
+                raise
+            ex._note_plan_verify(True)
         if fn is None:
             ex._note_jit_compile()
             from pilosa_tpu.ops import pallas_kernels

@@ -47,9 +47,14 @@ the same PILOSA_TPU_PALLAS gate as the bank-sweep kernels.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
+
+# A token-space register name while the Lowering accumulates: slot
+# tokens are ("s", bank, k) until finish() resolves bank-grouped
+# numbering, scratch tokens are plain ints counted from 0.
+Token = Union[Tuple[str, int, int], int]
 
 # Opcodes (plan-buffer rows are (opcode, dst, a, b); ZERO/COPY ignore b).
 OP_AND = 0
@@ -94,10 +99,16 @@ class Lowering:
                              Tuple[str, int, int]] = {}
         # token-space program; slot tokens are ("s", bank, k), scratch
         # tokens are plain ints counted from 0.
-        self.instrs: List[Tuple[int, Any, Any, Any]] = []
+        self.instrs: List[Tuple[int, Token, Token, Token]] = []
         self.n_scratch = 0
-        self.out_count: List[Any] = []   # token per count-mode entry
-        self.out_row: List[Any] = []     # token per row-mode entry
+        self.out_count: List[Token] = []  # token per count-mode entry
+        self.out_row: List[Token] = []    # token per row-mode entry
+        # Per-output-lane plan widths (real lanes only, lane order):
+        # the verification plane's ground truth for the masking
+        # invariant — every word of an output register at index >= the
+        # entry's plan width must be provably zero (verify_plan).
+        self.out_count_widths: List[int] = []
+        self.out_row_widths: List[int] = []
 
     # ------------------------------------------------------------ building
 
@@ -183,9 +194,13 @@ class Lowering:
         if mode == "count":
             # graftlint: disable=GL008 — per-launch builder state.
             self.out_count.append(root)
+            # graftlint: disable=GL008 — per-launch builder state.
+            self.out_count_widths.append(int(width))
             return len(self.out_count) - 1
         # graftlint: disable=GL008 — per-launch builder state.
         self.out_row.append(root)
+        # graftlint: disable=GL008 — per-launch builder state.
+        self.out_row_widths.append(int(width))
         return len(self.out_row) - 1
 
     # ------------------------------------------------------ BSI expansion
@@ -291,7 +306,9 @@ class Lowering:
             instrs=np.asarray(instrs, np.int32).reshape(p_pad, 4),
             out_count=np.asarray(out_count, np.int32),
             out_row=np.asarray(out_row, np.int32),
-            n_slots=n_slots, n_regs=t_pad, n_instrs=n_instrs)
+            n_slots=n_slots, n_regs=t_pad, n_instrs=n_instrs,
+            lane_count_widths=tuple(self.out_count_widths),
+            lane_row_widths=tuple(self.out_row_widths))
 
 
 class Plan:
@@ -299,13 +316,16 @@ class Plan:
     uploads them and counts the bytes as plan-buffer H2D)."""
 
     __slots__ = ("banks", "slots", "widths", "instrs", "out_count",
-                 "out_row", "n_slots", "n_regs", "n_instrs")
+                 "out_row", "n_slots", "n_regs", "n_instrs",
+                 "lane_count_widths", "lane_row_widths")
 
     def __init__(self, banks: Tuple[Any, ...],
                  slots: Tuple[np.ndarray, ...], widths: np.ndarray,
                  instrs: np.ndarray, out_count: np.ndarray,
                  out_row: np.ndarray, n_slots: int, n_regs: int,
-                 n_instrs: int) -> None:
+                 n_instrs: int,
+                 lane_count_widths: Tuple[int, ...] = (),
+                 lane_row_widths: Tuple[int, ...] = ()) -> None:
         self.banks = banks
         self.slots = slots
         self.widths = widths
@@ -315,6 +335,11 @@ class Plan:
         self.n_slots = n_slots
         self.n_regs = n_regs
         self.n_instrs = n_instrs
+        # Real (unpadded) output-lane plan widths, lane order — the
+        # verifier's masking-invariant targets; their lengths are the
+        # real lane counts (out_count/out_row are pow2-padded).
+        self.lane_count_widths = lane_count_widths
+        self.lane_row_widths = lane_row_widths
 
     @property
     def plan_nbytes(self) -> int:
@@ -339,6 +364,261 @@ class Plan:
 def slab_nbytes(n_regs: int, n_shards: int, w_mega: int) -> int:
     """HBM footprint of the launch's register slab."""
     return int(n_regs) * int(n_shards) * int(w_mega) * 4
+
+
+# --------------------------------------------------------- verification
+#
+# The plan buffer is DATA handed to one compiled interpreter, so a
+# lowering bug produces wrong bits silently: the fori/switch machine
+# happily reads a register nothing wrote (zeros), clobbers a shared
+# operand row another entry still needs, or popcounts words past an
+# entry's plan width. verify_plan() is the pre-launch type checker for
+# that machine — every invariant below is one the shipped lowering
+# maintains by construction and a mutated or mis-lowered plan breaks.
+# It is pure host numpy (no jax import, no device touch) so the
+# planverify/plan_fuzz tools can sweep thousands of plans cheaply and
+# the production gate costs microseconds per launch.
+
+
+class PlanVerifyError(ValueError):
+    """A plan buffer failed pre-launch verification. Raised BEFORE the
+    interpreter dispatches; the message names the instruction/lane and
+    the invariant it broke."""
+
+
+_READS_A = (OP_AND, OP_OR, OP_XOR, OP_ANDNOT, OP_COPY)
+_READS_B = (OP_AND, OP_OR, OP_XOR, OP_ANDNOT)
+
+
+def _is_pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
+    """Validate one launch's plan buffers against the interpreter's
+    execution model; raise :class:`PlanVerifyError` on the first
+    violation, return ``None`` when every invariant holds.
+
+    Checked invariants (the megakernel IR type system):
+
+    * **Structural** — ``instrs`` is int32 ``[P, 4]`` with ``P`` a pow2
+      capacity >= ``n_instrs``; ``n_regs`` pow2 with room for the slab
+      spare register above ``n_slots``; output-lane arrays pow2-padded;
+      per-bank slot lists consistent with ``n_slots``.
+    * **Gather bounds** — every slot index addresses a real row of its
+      bank ([0, rows)), and 3-d banks carry exactly ``n_shards``
+      shards, so ``bank[slots]`` can never gather out of bounds.
+    * **Width masks** — slot registers carry a plan width in
+      ``[1, w_mega]``; pad registers carry width 0 (their mask rows are
+      never read).
+    * **Opcodes** — every executed instruction's opcode is in the
+      table; a byte flip into lax.switch's clamp region would silently
+      execute the wrong branch.
+    * **Register bounds + slot protection** — dst/a/b address real
+      registers, and no instruction writes a slot register: gathered
+      operand rows are SHARED across entries (the Tanimoto query row),
+      so they are read-only by contract.
+    * **Def-before-use** — an operand a real instruction actually
+      reads (per-opcode: ZERO reads nothing, COPY reads ``a``) is
+      either a gathered slot or a scratch register some earlier
+      instruction wrote. The interpreter zero-fills scratch, so a RAW
+      violation doesn't crash — it silently computes on zeros, the
+      exact hazard class that sank the grid-per-entry Pallas
+      formulation.
+    * **Pad-tail no-ops** — instructions past ``n_instrs`` must be
+      ``ZERO`` into a non-slot register that no real output lane
+      reads: provably invisible to every result.
+    * **Masking invariant (abstract interpretation)** — each register
+      is abstracted to the least upper bound on its nonzero word span
+      (words at index >= z are provably zero). Slot registers enter at
+      their masked plan width; AND takes ``min``, OR/XOR ``max``,
+      ANDNOT keeps the left span, COPY propagates, ZERO resets — i.e.
+      zero-extension commutes with every opcode. Each real output
+      lane's register must prove ``z <= lane plan width``, which is
+      exactly what makes per-entry slices (and full-width popcounts)
+      bit-identical to the unfused per-plan programs.
+    """
+    instrs = plan.instrs
+    if instrs.ndim != 2 or instrs.shape[1] != 4:
+        raise PlanVerifyError(
+            f"instrs must be [P, 4], got shape {instrs.shape}")
+    if instrs.dtype != np.int32:
+        raise PlanVerifyError(
+            f"instrs must be int32, got {instrs.dtype}")
+    T = int(plan.n_regs)
+    P = int(instrs.shape[0])
+    n_slots = int(plan.n_slots)
+    n_instrs = int(plan.n_instrs)
+    if not _is_pow2(T) or T <= n_slots:
+        raise PlanVerifyError(
+            f"n_regs={T} must be a pow2 capacity > n_slots={n_slots} "
+            f"(the pad/spare register lives above the slots)")
+    if not _is_pow2(P) or not 0 <= n_instrs <= P:
+        raise PlanVerifyError(
+            f"instr capacity P={P} must be pow2 >= n_instrs={n_instrs}")
+    if len(plan.banks) != len(plan.slots):
+        raise PlanVerifyError(
+            f"{len(plan.banks)} banks but {len(plan.slots)} slot lists")
+    if sum(len(s) for s in plan.slots) != n_slots:
+        raise PlanVerifyError(
+            f"per-bank slot lists sum to "
+            f"{sum(len(s) for s in plan.slots)} != n_slots={n_slots}")
+    if plan.widths.shape != (T,):
+        raise PlanVerifyError(
+            f"widths must be [n_regs]={T}, got {plan.widths.shape}")
+    nc = len(plan.lane_count_widths)
+    nr = len(plan.lane_row_widths)
+    if len(plan.out_count) != pow2_at_least(nc) or nc > len(plan.out_count):
+        raise PlanVerifyError(
+            f"out_count holds {len(plan.out_count)} lanes for {nc} "
+            f"real count entries (pow2 pad expected)")
+    if len(plan.out_row) != pow2_at_least(nr) or nr > len(plan.out_row):
+        raise PlanVerifyError(
+            f"out_row holds {len(plan.out_row)} lanes for {nr} "
+            f"real row entries (pow2 pad expected)")
+
+    # Gather bounds: slot indices inside each bank, shard axis aligned.
+    for b, (bank, slots) in enumerate(zip(plan.banks, plan.slots)):
+        shape = getattr(bank, "shape", None)
+        if not isinstance(shape, tuple) or not shape:
+            continue  # opaque bank (tests stub them); widths still check
+        rows = int(shape[0])
+        for j, s in enumerate(np.asarray(slots).tolist()):
+            if not 0 <= int(s) < rows:
+                raise PlanVerifyError(
+                    f"bank {b} slot[{j}]={int(s)} outside its "
+                    f"{rows}-row bank")
+        if len(shape) == 3 and int(shape[1]) != int(n_shards):
+            raise PlanVerifyError(
+                f"bank {b} carries {int(shape[1])} shards, launch "
+                f"expects {int(n_shards)}")
+
+    # Width masks: slot registers in [1, w_mega], pad registers 0.
+    # graftlint: disable=GL003 — plan buffers are HOST numpy (built by
+    # Lowering.finish, uploaded later); no device sync happens here.
+    widths = plan.widths.tolist()
+    for k in range(n_slots):
+        if not 1 <= int(widths[k]) <= int(w_mega):
+            raise PlanVerifyError(
+                f"slot register {k} width {int(widths[k])} outside "
+                f"[1, w_mega={int(w_mega)}]")
+    for k in range(n_slots, T):
+        if int(widths[k]) != 0:
+            raise PlanVerifyError(
+                f"pad register {k} carries width {int(widths[k])} "
+                f"(must be 0: its mask row is never gathered)")
+
+    # Real instructions: opcode table, register bounds, slot
+    # protection, def-before-use, and the abstract width lattice.
+    # span[r] = least upper bound on r's nonzero word span; None =
+    # never written (reads of it are RAW violations even though the
+    # machine would silently read zeros).
+    span: List[Optional[int]] = [int(widths[k]) for k in range(n_slots)]
+    span += [None] * (T - n_slots)
+    # graftlint: disable=GL003 — host numpy plan buffer, as above.
+    rows_list = instrs.tolist()
+    for i in range(n_instrs):
+        op, dst, a, b = (int(x) for x in rows_list[i])
+        if not 0 <= op < len(OP_NAMES):
+            raise PlanVerifyError(
+                f"instr {i}: opcode {op} not in the table "
+                f"(0..{len(OP_NAMES) - 1})")
+        for nm, r in (("dst", dst), ("a", a), ("b", b)):
+            if not 0 <= r < T:
+                raise PlanVerifyError(
+                    f"instr {i} ({OP_NAMES[op]}): {nm}={r} outside "
+                    f"the {T}-register slab")
+        if dst < n_slots:
+            raise PlanVerifyError(
+                f"instr {i} ({OP_NAMES[op]}): writes slot register "
+                f"{dst} — gathered operand rows are shared across "
+                f"entries and read-only")
+        reads = []
+        if op in _READS_A:
+            reads.append(("a", a))
+        if op in _READS_B:
+            reads.append(("b", b))
+        for nm, r in reads:
+            if r >= n_slots and span[r] is None:
+                raise PlanVerifyError(
+                    f"instr {i} ({OP_NAMES[op]}): reads scratch "
+                    f"register {r} ({nm}) before any instruction "
+                    f"defines it (RAW chain broken — the machine "
+                    f"would silently read zeros)")
+        # Zero-extension transfer function per opcode. Read operands
+        # were just proven defined, so their spans are concrete ints.
+        za = span[a] if op in _READS_A else 0
+        zb = span[b] if op in _READS_B else 0
+        za = 0 if za is None else int(za)
+        zb = 0 if zb is None else int(zb)
+        if op == OP_ZERO:
+            span[dst] = 0
+        elif op in (OP_COPY, OP_ANDNOT):
+            span[dst] = za
+        elif op == OP_AND:
+            span[dst] = min(za, zb)
+        else:  # OR / XOR
+            span[dst] = max(za, zb)
+
+    # Real output lanes: in-bounds, defined, and width-masked.
+    # graftlint: disable=GL003 — host numpy plan buffer, as above.
+    out_count = plan.out_count.tolist()
+    # graftlint: disable=GL003 — host numpy plan buffer, as above.
+    out_row = plan.out_row.tolist()
+    for mode, lanes, lane_widths in (
+            ("count", out_count, plan.lane_count_widths),
+            ("row", out_row, plan.lane_row_widths)):
+        for j, r in enumerate(lanes):
+            if not 0 <= int(r) < T:
+                raise PlanVerifyError(
+                    f"{mode} lane {j}: register {int(r)} outside the "
+                    f"{T}-register slab")
+        for j, w in enumerate(lane_widths):
+            r = int(lanes[j])
+            sv = span[r]
+            if sv is None:
+                raise PlanVerifyError(
+                    f"{mode} lane {j}: reads register {r} that no "
+                    f"instruction defines")
+            z = int(sv)
+            if not 1 <= int(w) <= int(w_mega):
+                raise PlanVerifyError(
+                    f"{mode} lane {j}: plan width {int(w)} outside "
+                    f"[1, w_mega={int(w_mega)}]")
+            if z > int(w):
+                raise PlanVerifyError(
+                    f"{mode} lane {j}: register {r} may carry "
+                    f"nonzero words up to {z}, past the entry's plan "
+                    f"width {int(w)} — the masking invariant "
+                    f"(zero-extension commutes with every opcode) "
+                    f"does not hold")
+
+    # Pad tail: provably no-ops. Writes happen after every real read,
+    # so a pad instruction is invisible exactly when it is a ZERO into
+    # a non-slot register no real output lane references.
+    real_out = {int(out_count[j]) for j in range(nc)}
+    real_out |= {int(out_row[j]) for j in range(nr)}
+    for i in range(n_instrs, P):
+        op, dst, a, b = (int(x) for x in rows_list[i])
+        if op != OP_ZERO:
+            name = OP_NAMES[op] if 0 <= op < len(OP_NAMES) else op
+            raise PlanVerifyError(
+                f"pad instr {i}: opcode {name} — pad-tail "
+                f"instructions must be ZERO")
+        for nm, r in (("dst", dst), ("a", a), ("b", b)):
+            if not 0 <= r < T:
+                raise PlanVerifyError(
+                    f"pad instr {i}: {nm}={r} outside the "
+                    f"{T}-register slab")
+        if dst < n_slots:
+            raise PlanVerifyError(
+                f"pad instr {i}: zeroes slot register {dst} — pads "
+                f"must write a dead register")
+        if dst in real_out:
+            raise PlanVerifyError(
+                f"pad instr {i}: zeroes register {dst} that a real "
+                f"output lane reads — the pad tail would corrupt a "
+                f"result")
 
 
 def build_program(n_shards: int, w_mega: int, t_pad: int,

@@ -331,11 +331,26 @@ def _mega_loop_kernel(n_instrs: int) -> Callable[..., None]:
 def mega_interpret(slab: jax.Array, instrs: jax.Array, *,
                    interpret: bool = False) -> jax.Array:
     """Run a [P, 4] int32 plan buffer (opcode, dst, a, b) over a
-    [T, S, W] uint32 register slab; returns the final slab."""
+    [T, S, W] uint32 register slab; returns the final slab.
+
+    This flavor interprets the SAME IR as the jnp fori/switch program
+    (ops/megakernel.build_program) and inherits the same pre-launch
+    contract: the executor runs ops/megakernel.verify_plan over every
+    plan before either interpreter sees it (PILOSA_TPU_PLAN_VERIFY),
+    so opcode/register/width invariants are already proven host-side.
+    Only the structural shape of the buffers is re-asserted here —
+    trace-time, zero device cost — because a malformed buffer handed
+    directly to pallas_call would fail far less legibly in Mosaic."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     T, S, W = slab.shape
+    assert instrs.ndim == 2 and instrs.shape[1] == 4, (
+        f"plan buffer must be [P, 4], got {instrs.shape}")
+    assert instrs.dtype == jnp.int32, (
+        f"plan buffer must be int32, got {instrs.dtype}")
+    assert slab.dtype == jnp.uint32, (
+        f"register slab must be uint32, got {slab.dtype}")
     P = instrs.shape[0]
     return pl.pallas_call(
         _mega_loop_kernel(P),

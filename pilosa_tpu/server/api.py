@@ -1028,6 +1028,11 @@ class API:
                 "megaQueries": self.executor.mega_queries,
                 "megaPlanEntries": self.executor.mega_plan_entries,
                 "megaPlanBytes": self.executor.mega_plan_bytes,
+                # Plan-IR verification gate (PILOSA_TPU_PLAN_VERIFY):
+                # a nonzero reject count means a lowering bug raised
+                # instead of executing — page-worthy.
+                "planVerifyPasses": self.executor.plan_verify_passes,
+                "planVerifyRejects": self.executor.plan_verify_rejects,
             },
             # Cross-request cache tier (executor/result_cache.py +
             # core/cache.RANK_CACHE): hit ratios and live bytes in the

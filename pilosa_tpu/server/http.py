@@ -361,6 +361,10 @@ class Handler(BaseHTTPRequestHandler):
                                 api.executor.mega_plan_entries,
                             "megaPlanBytes":
                                 api.executor.mega_plan_bytes,
+                            "planVerifyPasses":
+                                api.executor.plan_verify_passes,
+                            "planVerifyRejects":
+                                api.executor.plan_verify_rejects,
                             "jitCacheSize":
                                 api.executor.jit_cache_size()})
             elif path == "/debug/memory":

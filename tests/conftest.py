@@ -5,6 +5,11 @@ multi-chip path; real-TPU benching happens in bench.py, not tests)."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Plan-IR verification gate default for the whole suite: every
+# megakernel launch is checked (production default is `auto` =
+# first-launch-per-jit-cache-key; docs/development.md "Plan-IR
+# verification plane").
+os.environ.setdefault("PILOSA_TPU_PLAN_VERIFY", "on")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

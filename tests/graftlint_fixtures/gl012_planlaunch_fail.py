@@ -1,0 +1,23 @@
+"""GL012 fail fixture: a plan buffer (.instrs) reaches the
+_call_program funnel with no path to verify_plan."""
+import jax.numpy as jnp
+
+
+class BadLauncher:
+    def launch(self, executor, plan, banks):
+        # The handoff marker: the plan buffer is read and uploaded...
+        instrs_dev = jnp.asarray(plan.instrs)
+        widths_dev = jnp.asarray(plan.widths)
+        # ...and dispatched without ever passing the checker.
+        return executor._call_program(plan.fn, banks, widths_dev,
+                                      instrs_dev)
+
+
+class AlsoBad:
+    def helper_does_not_verify(self, plan):
+        return plan.n_instrs
+
+    def launch(self, executor, plan):
+        self.helper_does_not_verify(plan)
+        buf = plan.instrs
+        return executor._call_program(plan.fn, buf)

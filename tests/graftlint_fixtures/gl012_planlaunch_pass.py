@@ -1,0 +1,32 @@
+"""GL012 pass fixture: launch sites that reach verify_plan — lexically
+or through a helper the call graph resolves — before the funnel."""
+import jax.numpy as jnp
+
+from pilosa_tpu.ops.megakernel import verify_plan
+
+
+class DirectLauncher:
+    def launch(self, executor, plan, banks, n_shards, w_mega):
+        verify_plan(plan, n_shards, w_mega)
+        instrs_dev = jnp.asarray(plan.instrs)
+        return executor._call_program(plan.fn, banks, instrs_dev)
+
+
+def _checked(plan, n_shards, w_mega):
+    verify_plan(plan, n_shards, w_mega)
+
+
+class HelperLauncher:
+    """The call-graph leg: verification delegated to a module helper."""
+
+    def launch(self, executor, plan, banks, n_shards, w_mega):
+        _checked(plan, n_shards, w_mega)
+        instrs_dev = jnp.asarray(plan.instrs)
+        return executor._call_program(plan.fn, banks, instrs_dev)
+
+
+class NoPlanInvolved:
+    """A funnel call with no plan buffer in sight must not flag."""
+
+    def dispatch(self, executor, fn, bank, idxs):
+        return executor._call_program(fn, bank, idxs)

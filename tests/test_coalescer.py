@@ -386,3 +386,190 @@ def test_config_coalescer_section(tmp_path):
     with pytest.raises(ValueError, match="unknown config key"):
         p.write_text("[coalescer]\nnot_a_key = 1\n")
         load_config(str(p))
+
+
+# ---------------------------------------------- pipelined error paths
+#
+# The RTT-hiding pipelined dispatcher (PR 11) splits a flush into a
+# begin half on the dispatcher thread and a _ShapedInFlight drain on
+# the finalizer thread. A drain that THROWS must propagate to exactly
+# the in-flight batch's requests, must not wedge the depth-1 double
+# buffer, and must not leak into the next batch — pinned here (this
+# file also runs under PILOSA_TPU_LOCK_CHECK=1 in the check.sh
+# lock-order lane, so the error paths hold the lock discipline too).
+
+
+@pytest.fixture
+def plex(tmp_path):
+    """In-process executor over the seeded index (the pipelined paths
+    under test live below the HTTP layer)."""
+    from pilosa_tpu.executor import Executor
+    h = Holder(str(tmp_path / "pl"))
+    h.open()
+    seed_data(h)
+    ex = Executor(h)
+    ex.result_cache.enabled = False
+    yield ex
+    h.close()
+
+
+def _pl_burst(co, queries, timeout=60):
+    """Submit every query from its own thread; returns ({i: result},
+    {i: exception}) with no worker left hanging."""
+    results, errors = {}, {}
+    barrier = threading.Barrier(len(queries))
+
+    def worker(i, q):
+        try:
+            barrier.wait()
+            results[i] = co.submit("c", q)
+        except Exception as e:  # noqa: BLE001 — the subject under test
+            errors[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i, q))
+               for i, q in enumerate(queries)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    assert not any(t.is_alive() for t in threads), \
+        "a submitter wedged — the pipeline lost its batch"
+    return results, errors
+
+
+_PL_QUERIES = [f"Count(Row(f={r % 8}))" if r % 2 else f"Row(f={r % 8})"
+               for r in range(16)]
+
+
+def test_pipelined_finalizer_exception_propagates_and_recovers(plex):
+    """A finalizer-thread exception in the _ShapedInFlight drain lands
+    on that batch's requests as per-request errors, the depth-1 buffer
+    clears, and the very next burst serves correctly."""
+    from pilosa_tpu.executor import Executor
+
+    direct = {i: plex.execute_full("c", q)
+              for i, q in enumerate(_PL_QUERIES)}
+    orig_finish = Executor.execute_batch_shaped_finish
+    state = {"boom": True}
+
+    def failing_finish(self, sh):
+        if state["boom"]:
+            state["boom"] = False
+            raise RuntimeError("injected drain failure")
+        return orig_finish(self, sh)
+
+    Executor.execute_batch_shaped_finish = failing_finish
+    co = QueryCoalescer(plex, window_s=0.005, max_batch=8,
+                        stats=MemStatsClient(), pipeline=True)
+    co.start()
+    try:
+        results, errors = _pl_burst(co, _PL_QUERIES)
+        assert co.pipelined_flushes >= 1
+        assert errors, "the failing drain must surface somewhere"
+        for i, e in errors.items():
+            assert "injected drain failure" in str(e), (i, e)
+        # Requests outside the failed batch are untouched — correct
+        # results, not errors.
+        for i, res in results.items():
+            assert res == direct[i], (i, _PL_QUERIES[i])
+        # The double buffer is clear (not wedged) ...
+        with co._pl_cond:
+            assert co._pl_pending is None
+        # ... and the next burst is fully correct: the error did not
+        # leak forward.
+        results2, errors2 = _pl_burst(co, _PL_QUERIES)
+        assert not errors2, errors2
+        assert results2 == direct
+        assert co.pipelined_flushes >= 2
+    finally:
+        # Restore FIRST: a stop() that raises (the wedge this test
+        # exists to catch) must not leak the patch into later tests.
+        Executor.execute_batch_shaped_finish = orig_finish
+        co.stop()
+
+
+def test_pipelined_drain_failure_respects_batch_boundaries(plex):
+    """While batch K's drain fails on the finalizer, batch K+1 has
+    already dispatched (the overlap the pipeline exists for): K+1's
+    requests must still resolve correctly — errors stay inside K."""
+    from pilosa_tpu.executor import Executor
+
+    direct = {i: plex.execute_full("c", q)
+              for i, q in enumerate(_PL_QUERIES)}
+    orig_begin = Executor.execute_batch_shaped_begin
+    orig_finish = Executor.execute_batch_shaped_finish
+    second_begin = threading.Event()
+    state = {"begins": 0, "doomed": None}
+    lock = threading.Lock()
+
+    def tagged_begin(self, reqs, profiles=None):
+        sh = orig_begin(self, reqs, profiles=profiles)
+        with lock:
+            state["begins"] += 1
+            if state["begins"] == 1:
+                state["doomed"] = sh
+            elif state["begins"] == 2:
+                second_begin.set()
+        return sh
+
+    def gated_finish(self, sh):
+        if sh is state["doomed"]:
+            # Hold the drain until the NEXT batch is in flight, then
+            # fail: the overlap window is provably open.
+            second_begin.wait(timeout=30)
+            raise RuntimeError("injected drain failure")
+        return orig_finish(self, sh)
+
+    Executor.execute_batch_shaped_begin = tagged_begin
+    Executor.execute_batch_shaped_finish = gated_finish
+    co = QueryCoalescer(plex, window_s=0.005, max_batch=4,
+                        stats=MemStatsClient(), pipeline=True)
+    co.start()
+    try:
+        results, errors = _pl_burst(co, _PL_QUERIES)
+        assert second_begin.is_set(), \
+            "test premise: a second batch dispatched during the drain"
+        assert errors, "the doomed batch's requests must error"
+        for i, e in errors.items():
+            assert "injected drain failure" in str(e), (i, e)
+        for i, res in results.items():
+            assert res == direct[i], (i, _PL_QUERIES[i])
+        with co._pl_cond:
+            assert co._pl_pending is None
+    finally:
+        Executor.execute_batch_shaped_begin = orig_begin
+        Executor.execute_batch_shaped_finish = orig_finish
+        co.stop()
+
+
+def test_pipelined_finalizer_base_exception_wrapped(plex):
+    """A non-Exception BaseException from the drain must not kill the
+    finalizer silently: items resolve with a CoalescerStopped wrapper
+    and the loop keeps draining subsequent batches."""
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.server.coalescer import CoalescerStopped
+
+    orig_finish = Executor.execute_batch_shaped_finish
+    state = {"boom": True}
+
+    def failing_finish(self, sh):
+        if state["boom"]:
+            state["boom"] = False
+            raise SystemExit("injected non-Exception failure")
+        return orig_finish(self, sh)
+
+    Executor.execute_batch_shaped_finish = failing_finish
+    co = QueryCoalescer(plex, window_s=0.005, max_batch=8,
+                        stats=MemStatsClient(), pipeline=True)
+    co.start()
+    try:
+        results, errors = _pl_burst(co, _PL_QUERIES)
+        assert errors
+        for e in errors.values():
+            assert isinstance(e, CoalescerStopped), e
+        results2, errors2 = _pl_burst(co, _PL_QUERIES[:8])
+        assert not errors2, errors2
+        assert len(results2) == 8
+    finally:
+        Executor.execute_batch_shaped_finish = orig_finish
+        co.stop()

@@ -35,6 +35,7 @@ def fixture_config() -> Config:
         lock_block_paths=("graftlint_fixtures/gl009",),
         effect_paths=("graftlint_fixtures/gl010",),
         ctypes_paths=("graftlint_fixtures/gl011",),
+        plan_paths=("graftlint_fixtures/gl012",),
     )
 
 
@@ -62,6 +63,7 @@ def codes_for(filename, config=None):
     ("gl009_blocking_fail.py", "gl009_blocking_pass.py", "GL009"),
     ("gl010_pairs_fail.py", "gl010_pairs_pass.py", "GL010"),
     ("gl011_ctypes_fail.py", "gl011_ctypes_pass.py", "GL011"),
+    ("gl012_planlaunch_fail.py", "gl012_planlaunch_pass.py", "GL012"),
 ])
 def test_rule_fixtures(fail_fixture, pass_fixture, code):
     fail_codes = codes_for(fail_fixture)
@@ -70,6 +72,19 @@ def test_rule_fixtures(fail_fixture, pass_fixture, code):
     pass_codes = codes_for(pass_fixture)
     assert code not in pass_codes, \
         f"{pass_fixture}: expected no {code}, got {pass_codes}"
+
+
+def test_gl012_counts_and_callgraph_leg():
+    """Both unverified launchers in the fail fixture flag (direct and
+    helper-that-does-not-verify); the pass fixture's call-graph leg
+    (verify delegated to a module helper) stays clean — pinned by the
+    parametrized pair above, counted exactly here."""
+    findings = lint_files(
+        [os.path.join(FIXTURES, "gl012_planlaunch_fail.py")],
+        fixture_config())
+    gl12 = [f for f in findings if f.code == "GL012"]
+    assert len(gl12) == 2, gl12
+    assert all("verify_plan" in f.message for f in gl12)
 
 
 def test_gl001_context_manager_is_not_a_lock():

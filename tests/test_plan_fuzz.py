@@ -1,0 +1,157 @@
+"""Plan-space fuzzer (tools/plan_fuzz): deterministic generation, the
+three-way differential (megakernel / vmap fusion / packed-numpy
+oracle) clean on a seeded slice, and the committed tests/plan_corpus/
+entries replaying clean. The heavyweight 300-case sweep runs in the
+tools/check.sh plan-fuzz gate lane; tier-1 pins the machinery."""
+
+import json
+import os
+
+import pytest
+
+from tools.plan_fuzz import (
+    DEFAULT_CORPUS, Harness, case_bytes, gen_case, render_query,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def test_generation_is_deterministic():
+    import hashlib
+    def digest(seed, n):
+        d = hashlib.sha256()
+        for i in range(n):
+            d.update(case_bytes(gen_case(seed, i)))
+        return d.hexdigest()
+    assert digest(0, 20) == digest(0, 20)
+    assert digest(0, 20) != digest(1, 20)
+    # (seed, index) child streams: a case is independent of its
+    # position in the run.
+    assert case_bytes(gen_case(3, 7)) == case_bytes(gen_case(3, 7))
+
+
+def test_generator_covers_the_ir_surface():
+    """Over a modest window the forest must exercise every node kind
+    the lowering handles: both modes, all four folds, Not, cmp,
+    between, the shared-operand flood, and absent rows."""
+    kinds, modes = set(), set()
+    shared_flood = 0
+
+    def walk(t):
+        kinds.add(t[0])
+        if t[0] in ("and", "or", "xor", "diff", "not"):
+            for s in t[1:]:
+                walk(s)
+
+    for i in range(60):
+        case = gen_case(0, i)
+        for mode, tree in case:
+            modes.add(mode)
+            walk(tree)
+        # The Tanimoto tail: >=2 probes ANDing the SAME f row against
+        # candidates (the shared-operand dedup the lowering must do).
+        probes = [t for m, t in case
+                  if t[0] == "and" and len(t) == 3
+                  and t[1][0] == "row" and t[2][0] == "row"
+                  and t[1][1] == "f" and t[2][1] == "f"]
+        q_rows = [t[1][2] for t in probes]
+        if any(q_rows.count(q) >= 2 for q in q_rows):
+            shared_flood += 1
+    assert modes == {"count", "rows"}
+    for want in ("row", "cmp", "between", "not", "and", "or", "xor",
+                 "diff"):
+        assert want in kinds, (want, kinds)
+    assert shared_flood > 0, "Tanimoto shared-operand flood never drawn"
+
+
+def test_render_is_valid_pql():
+    q = render_query("count", ["and", ["row", "f", 1],
+                               ["cmp", "v", "gte", -3]])
+    assert q == "Count(Intersect(Row(f=1), Row(v >= -3)))"
+    q2 = render_query("rows", ["between", "v", -100, 500])
+    assert q2 == "Row(-100 < v < 500)"
+    q3 = render_query("count", ["not", ["row", "g", 2]])
+    assert q3 == "Count(Not(Row(g=2)))"
+
+
+def test_seeded_slice_differential_clean():
+    """A seeded slice of the real fuzz loop: three-way bit-exact, all
+    captured plans verified, every applied mutation rejected."""
+    h = Harness(data_seed=2)
+    try:
+        for i in range(4):
+            problems = h.check_case(gen_case(2, i), mutate_seed=2)
+            assert not problems, (i, problems)
+    finally:
+        h.close()
+
+
+def test_committed_corpus_replays_clean():
+    """The smaller committed entries replay in tier-1 (the full
+    corpus incl. the ~100-query BSI table runs in the check.sh
+    lane)."""
+    names = sorted(n for n in os.listdir(DEFAULT_CORPUS)
+                   if n.endswith(".json"))
+    assert names, "tests/plan_corpus must ship seed entries"
+    light = [n for n in names
+             if not n.startswith("bsi-boundaries")][:4]
+    h = Harness(data_seed=0)
+    try:
+        for name in light:
+            with open(os.path.join(DEFAULT_CORPUS, name)) as f:
+                doc = json.load(f)
+            assert doc.get("dataSeed") == 0
+            problems = h.check_case(doc["queries"], mutate_seed=0)
+            assert not problems, (name, problems)
+    finally:
+        h.close()
+
+
+def test_corpus_names_pin_content():
+    """Entry names carry the sha256[:12] of the exact file bytes (the
+    append-only triage contract: regenerated-but-different files are
+    visible in review)."""
+    import hashlib
+    for name in os.listdir(DEFAULT_CORPUS):
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(DEFAULT_CORPUS, name), "rb") as f:
+            blob = f.read()
+        digest = hashlib.sha256(blob).hexdigest()[:12]
+        assert name.rsplit("-", 1)[1] == f"{digest}.json", \
+            f"{name}: content drifted from its digest"
+
+
+def test_oracle_matches_direct_execution():
+    """The packed-numpy oracle against execute_full directly — the
+    leg-(c) semantics pinned without the batch machinery."""
+    h = Harness(data_seed=1)
+    try:
+        trees = [
+            ["count", ["row", "f", 1]],
+            ["count", ["not", ["row", "f", 1]]],
+            ["count", ["cmp", "v", "lte", 300]],
+            ["count", ["cmp", "w", "eq", 3]],
+            ["count", ["between", "z", -4096, 4096]],
+            ["rows", ["diff", ["row", "f", 2], ["row", "g", 2]]],
+        ]
+        for mode, tree in trees:
+            q = render_query(mode, tree)
+            got = h.executor.execute_full("pf", q)["results"][0]
+            exp = h.oracle.expected(mode, tree)
+            assert got == exp, (q, got, exp)
+    finally:
+        h.close()
+
+
+def test_harness_dataset_has_depth_diversity():
+    """The three BSI fields land at distinct bit-depths (boundary
+    depths are the point of the sweep)."""
+    h = Harness(data_seed=0)
+    try:
+        idx = h.holder.index("pf")
+        depths = {idx.field(f).bsi_groups[f].bit_depth
+                  for f in ("v", "w", "z")}
+    finally:
+        h.close()
+    assert len(depths) == 3, depths

@@ -1,0 +1,68 @@
+"""tools/sarif_merge: per-tool runs concatenate under one SARIF
+envelope (the single CI artifact check.sh uploads), absent
+availability-gated inputs skip cleanly, malformed inputs fail."""
+
+import json
+
+import pytest
+
+from tools.sarif_merge import main, merge_documents
+
+
+def _doc(tool, n_results=0):
+    return {
+        "$schema": "s", "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {"name": tool, "rules": []}},
+            "results": [{"ruleId": f"{tool}-R", "level": "error",
+                         "message": {"text": str(i)}}
+                        for i in range(n_results)],
+        }],
+    }
+
+
+def test_runs_concatenate_in_argument_order(tmp_path):
+    a = tmp_path / "a.sarif"
+    b = tmp_path / "b.sarif"
+    out = tmp_path / "merged.sarif"
+    a.write_text(json.dumps(_doc("graftlint", 2)))
+    b.write_text(json.dumps(_doc("planverify", 1)))
+    assert main([str(a), str(b), "--output", str(out)]) == 0
+    merged = json.loads(out.read_text())
+    names = [r["tool"]["driver"]["name"] for r in merged["runs"]]
+    assert names == ["graftlint", "planverify"]
+    assert merged["version"] == "2.1.0"
+    assert sum(len(r["results"]) for r in merged["runs"]) == 3
+
+
+def test_absent_inputs_skip_without_failing(tmp_path, capsys):
+    a = tmp_path / "a.sarif"
+    out = tmp_path / "merged.sarif"
+    a.write_text(json.dumps(_doc("planverify")))
+    rc = main([str(a), str(tmp_path / "missing.sarif"),
+               "--output", str(out)])
+    assert rc == 0
+    assert "absent" in capsys.readouterr().out
+    assert len(json.loads(out.read_text())["runs"]) == 1
+
+
+def test_malformed_input_fails(tmp_path):
+    bad = tmp_path / "bad.sarif"
+    out = tmp_path / "merged.sarif"
+    bad.write_text("{}")
+    assert main([str(bad), "--output", str(out)]) == 2
+
+
+def test_merge_documents_preserves_run_objects():
+    d1, d2 = _doc("a", 1), _doc("b")
+    merged = merge_documents([d1, d2])
+    assert merged["runs"][0] is d1["runs"][0]
+    assert merged["runs"][1] is d2["runs"][0]
+
+
+def test_empty_merge_is_valid_sarif(tmp_path):
+    out = tmp_path / "merged.sarif"
+    with pytest.raises(SystemExit):
+        main(["--output", str(out)])  # inputs are required
+    merged = merge_documents([])
+    assert merged["runs"] == []

@@ -136,6 +136,21 @@ step "native static analysis (clang-tidy, fallback cppcheck)"
 # emits native_tidy.sarif alongside graftlint.sarif for CI upload.
 python -m tools.native_tidy --output native_tidy.sarif || fail=1
 
+step "plan-IR verifier self-sweep (tools/planverify)"
+# The checked-IR contract, device-free: every plan the shipped
+# megakernel lowering emits across the opcode/BSI table must pass
+# verify_plan, and every mutation in the coverage set must be
+# rejected. Emits planverify.sarif beside the other analyzers.
+python -m tools.planverify --output planverify.sarif || fail=1
+
+if [ "$FAST" != 1 ]; then
+    step "SARIF merge (graftlint + native_tidy + planverify -> check.sarif)"
+    # One artifact for CI, one run object per tool (SARIF's own
+    # composition model); availability-gated inputs may be absent.
+    python -m tools.sarif_merge --output check.sarif \
+        graftlint.sarif native_tidy.sarif planverify.sarif || fail=1
+fi
+
 step "profiler smoke (one profiled query, JAX_PLATFORMS=cpu)"
 JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
 import tempfile
@@ -209,8 +224,11 @@ EOF
 
 step "megakernel smoke (32 mixed-signature queries -> 1 launch, kill-switch bit-identity)"
 # Cache off for the same reason as the fusion smoke; megakernel forced
-# ON (default is auto = TPU-only) so the CPU gate exercises the path.
-PILOSA_TPU_RESULT_CACHE=0 PILOSA_TPU_MEGAKERNEL=1 JAX_PLATFORMS=cpu \
+# ON (default is auto = TPU-only) so the CPU gate exercises the path;
+# plan verification pinned ON (production default is auto) so every
+# launch in the gate also passes the checked-IR contract.
+PILOSA_TPU_RESULT_CACHE=0 PILOSA_TPU_MEGAKERNEL=1 \
+    PILOSA_TPU_PLAN_VERIFY=on JAX_PLATFORMS=cpu \
     python - <<'EOF' || fail=1
 import tempfile
 import numpy as np
@@ -249,6 +267,9 @@ with tempfile.TemporaryDirectory() as d:
     assert len(calls) == 1, f"mixed burst must be ONE launch, got {len(calls)}"
     assert ex.mega_launches == 1 and ex.mega_queries == 32, \
         (ex.mega_launches, ex.mega_queries)
+    # The launch passed the plan-IR verification gate (checked IR).
+    assert ex.plan_verify_passes == 1 and ex.plan_verify_rejects == 0, \
+        (ex.plan_verify_passes, ex.plan_verify_rejects)
     # The PILOSA_TPU_MEGAKERNEL=0 + PILOSA_TPU_PIPELINE=0 regime:
     # per-group fusion, serial dispatch — responses must be
     # bit-identical.
@@ -259,6 +280,29 @@ with tempfile.TemporaryDirectory() as d:
     h.close()
 print("megakernel smoke OK")
 EOF
+
+step "plan-fuzz gate (corpus replay + deterministic sweep + digest stability)"
+# The plan-space differential oracle (tools/plan_fuzz): committed
+# corpus replays clean, then a seeded sweep — every batch bit-exact
+# across megakernel / vmap fusion / packed numpy, every captured plan
+# verified, every mutation rejected. Fast mode replays the corpus
+# only; the default path adds the 300-case sweep and pins generator
+# determinism (two --digest runs must agree).
+if [ "$FAST" = 1 ]; then
+    JAX_PLATFORMS=cpu python -m tools.plan_fuzz --replay || fail=1
+else
+    (
+        set -e
+        JAX_PLATFORMS=cpu python -m tools.plan_fuzz --replay
+        JAX_PLATFORMS=cpu python -m tools.plan_fuzz --seed 0 \
+            --iters 300 --no-save
+        d1=$(python -m tools.plan_fuzz --seed 0 --iters 300 --digest)
+        d2=$(python -m tools.plan_fuzz --seed 0 --iters 300 --digest)
+        [ -n "$d1" ] && [ "$d1" = "$d2" ] || {
+            echo "plan_fuzz: digest UNSTABLE ($d1 vs $d2)"; exit 1; }
+        echo "plan_fuzz: digest stable ($d1)"
+    ) || fail=1
+fi
 
 step "pipelined-dispatch smoke (coalesced burst, pipeline on vs off)"
 PILOSA_TPU_RESULT_CACHE=0 JAX_PLATFORMS=cpu python - <<'EOF' || fail=1

@@ -100,6 +100,11 @@ class Config:
     # ctypes library handle must have argtypes AND restype declared
     # (the native-boundary contract; pilosa_tpu/native.py _bind).
     ctypes_paths: Tuple[str, ...] = ("pilosa_tpu/", "tools/", "benches/")
+    # GL012: packages where a function that hands a megakernel plan
+    # buffer (an `.instrs` read) to the `_call_program` dispatch
+    # funnel must reach ops/megakernel.verify_plan first — future IR
+    # extensions cannot add an unverified launch path.
+    plan_paths: Tuple[str, ...] = ("pilosa_tpu/",)
     select: Optional[Set[str]] = None
     ignore: Set[str] = field(default_factory=set)
 

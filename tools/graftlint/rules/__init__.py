@@ -11,6 +11,7 @@ from tools.graftlint.rules.gl008_growth import GL008UnboundedGrowth
 from tools.graftlint.rules.gl009_blocking import GL009BlockingUnderLock
 from tools.graftlint.rules.gl010_pairs import GL010PairedEffects
 from tools.graftlint.rules.gl011_ctypes import GL011CtypesBoundary
+from tools.graftlint.rules.gl012_planlaunch import GL012UnverifiedPlanLaunch
 
 ALL_RULES = (
     GL001LockDiscipline(),
@@ -24,4 +25,5 @@ ALL_RULES = (
     GL009BlockingUnderLock(),
     GL010PairedEffects(),
     GL011CtypesBoundary(),
+    GL012UnverifiedPlanLaunch(),
 )

@@ -1,0 +1,104 @@
+"""GL012 — unverified plan-buffer launch.
+
+The megakernel makes query plans *data*: an int32 ``[P, 4]``
+``(opcode, dst, a, b)`` buffer interpreted by one compiled program
+(ops/megakernel.py). ``verify_plan()`` is the pre-launch type checker
+for that machine — opcode table, register bounds, slot-write
+protection, RAW chains, pad no-ops, the width-masking invariant — and
+``executor/megakernel._launch`` runs it under ``PILOSA_TPU_PLAN_VERIFY``
+before anything reaches the device. A *new* launch path that uploads a
+plan buffer without passing the checker re-opens exactly the silent
+wrong-bits class the verification plane exists to close, and ROADMAP
+items 1/2/5 all plan to extend this IR (re-layout ops, ingest ops,
+multi-chip cohorts), so bypasses are a matter of time, not of if.
+
+The check: inside ``plan_paths`` packages, a function that BOTH reads
+a plan buffer (an ``<expr>.instrs`` attribute access — the handoff
+marker every plan-carrying launch site exhibits) AND calls the
+``_call_program`` dispatch funnel must reach a ``verify_plan(...)``
+call — lexically, or in a function it transitively calls (the shared
+interprocedural call graph, GL002's conservative resolution). Both
+markers in one function and no path to the checker is a finding; the
+fix is calling ``ops.megakernel.verify_plan`` (or a helper that does)
+before the dispatch, gated however the site needs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from tools.graftlint.engine import Finding, Project, Rule, SourceFile
+
+_FUNNEL = "_call_program"
+_VERIFIER = "verify_plan"
+_MARKER_ATTR = "instrs"
+
+
+def _terminal_call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _calls_name(fn: ast.AST, name: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and _terminal_call_name(node) == name:
+            return True
+    return False
+
+
+def _reads_plan_buffer(fn: ast.AST) -> bool:
+    """An `<expr>.instrs` read anywhere in the function: the marker
+    that a megakernel plan buffer is being handed around."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr == _MARKER_ATTR \
+                and isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+def _funnel_call(fn: ast.AST) -> ast.Call:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and _terminal_call_name(node) == _FUNNEL:
+            return node
+    raise AssertionError("caller checked _calls_name first")
+
+
+class GL012UnverifiedPlanLaunch(Rule):
+    code = "GL012"
+    name = "unverified-plan-launch"
+
+    def check_file(self, sf: SourceFile,
+                   project: Project) -> Iterable[Finding]:
+        if not sf.in_path(project.config.plan_paths):
+            return ()
+        out: List[Finding] = []
+        cg = project.callgraph
+        verify_reach = cg.memo(
+            "gl012.verify_reach",
+            lambda: cg.reaches(
+                lambda fi: _calls_name(fi.node, _VERIFIER)))
+        for fi in cg.funcs:
+            if fi.sf is not sf:
+                continue
+            if not _calls_name(fi.node, _FUNNEL):
+                continue
+            if not _reads_plan_buffer(fi.node):
+                continue
+            if _calls_name(fi.node, _VERIFIER) \
+                    or fi.qualname in verify_reach:
+                continue
+            call = _funnel_call(fi.node)
+            out.append(Finding(
+                sf.path, call.lineno, call.col_offset, self.code,
+                f"`{fi.qualname}` hands a plan buffer (.instrs) to the "
+                f"`{_FUNNEL}` funnel but no path from it reaches "
+                f"`{_VERIFIER}` — an unverified plan launch bypasses "
+                f"the checked-IR contract (PILOSA_TPU_PLAN_VERIFY, "
+                f"docs/development.md \"Plan-IR verification plane\")"))
+        return out

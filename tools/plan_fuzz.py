@@ -1,0 +1,545 @@
+"""Plan-space fuzzer + three-way differential oracle for the
+megakernel IR (the device-side sibling of tools/roaring_fuzz.py).
+
+PR 11 made query plans *data*: an int32 ``[P, 4]`` opcode buffer over
+a gathered register slab, executed by one jitted interpreter
+(ops/megakernel.py). This tool attacks that plane the way the roaring
+fuzzer attacks the native parser:
+
+- **Generator** — seeded, deterministic random query forests over a
+  fixed synthetic dataset: bitwise folds (AND/OR/XOR/Difference at
+  fanouts 2..4, nested), existence-Not, the full BSI comparison table
+  across three int fields at boundary bit-depths (2, 14, 21 planes)
+  with boundary predicate values, shared operand rows (the Tanimoto
+  probe shape, deduped to one slab register), absent rows, and batch
+  sizes crossing pow2 pad edges.
+- **Three-way differential** — every generated batch runs through
+  (a) the megakernel interpreter (``MEGAKERNEL_ENABLED=True``: one
+  plan-buffer launch per cohort), (b) the per-group vmap fusion path
+  (the ``PILOSA_TPU_MEGAKERNEL=0`` regime), and (c) a packed-numpy
+  host oracle (uint64 bit words, ``np.bitwise_count``); the shaped
+  responses must be bit-exact across all three.
+- **Verifier leg** — every plan the live lowering builds during (a)
+  is captured at the ``executor/megakernel._build`` seam: it must
+  pass ``ops/megakernel.verify_plan``, and every applied mutation
+  from the shared coverage set (``tools/planverify.PLAN_MUTATIONS``:
+  opcode/slot/dst/operand/out-lane/width byte corruption) must be
+  REJECTED — a mutated plan never reaches a launch.
+
+Everything is deterministic for a fixed ``--seed`` (per-case child
+seeds spawn as ``default_rng([seed, index])``), so a failing case
+number is a reproducer on its own; failing cases are additionally
+written to the corpus directory (``tests/plan_corpus/``) as JSON query
+forests and replayed forever after by ``--replay`` (tools/check.sh
+plan-fuzz lane) so a fixed bug stays fixed.
+
+CLI::
+
+    python -m tools.plan_fuzz --seed 7 --iters 300
+    python -m tools.plan_fuzz --replay tests/plan_corpus
+    python -m tools.plan_fuzz --seed 7 --iters 100 --digest
+
+Exit status: 0 clean, 1 divergence found (reproducer written unless
+--no-save), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from tools.planverify import PLAN_MUTATIONS, mutate_plan
+
+DEFAULT_CORPUS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "plan_corpus")
+
+N_ROWS = 16          # set-field rows 0..15 (+ absent row 99)
+ABSENT_ROW = 99
+
+# BSI fields at boundary bit-depths: depth = bits of (max - min).
+BSI_FIELDS: Dict[str, Tuple[int, int]] = {
+    "v": (-500, 10000),          # 14 planes, negative base offset
+    "w": (0, 3),                 # 2 planes, the minimal scan
+    "z": (-(1 << 20), 1 << 20),  # 21 planes
+}
+
+_CMP_OPS = ("eq", "neq", "lt", "lte", "gt", "gte")
+_CMP_PQL = {"eq": "==", "neq": "!=", "lt": "<", "lte": "<=",
+            "gt": ">", "gte": ">="}
+_FOLDS = ("and", "or", "xor", "diff")
+_FOLD_PQL = {"and": "Intersect", "or": "Union", "xor": "Xor",
+             "diff": "Difference"}
+
+
+def _value_pool(lo: int, hi: int) -> List[int]:
+    """Boundary predicate values for one field's range: the ends,
+    just inside/outside them, zero crossings, and pow2 edges inside
+    the range (out-of-range values exercise the zeros/not-null
+    lowerings)."""
+    pool = {lo, hi, lo + 1, hi - 1, lo - 1, hi + 1, 0, 1, -1}
+    span = hi - lo
+    k = 1
+    while k < span:
+        for v in (lo + k, lo + k - 1, lo + k + 1):
+            if lo - 2 <= v <= hi + 2:
+                pool.add(v)
+        k <<= 1
+    return sorted(pool)
+
+
+# ------------------------------------------------------- dataset/oracle
+
+
+class HostOracle:
+    """The packed-numpy ground truth: every row / BSI field as uint64
+    bit words over the full column space, evaluated with the same
+    bitwise algebra the device programs use."""
+
+    def __init__(self, n_cols: int) -> None:
+        self.n_cols = n_cols
+        self.n_words = n_cols // 64
+        self.bits: Dict[Tuple[str, int], np.ndarray] = {}
+        self.has: Dict[str, np.ndarray] = {}    # bool[n_cols]
+        self.vals: Dict[str, np.ndarray] = {}   # int64[n_cols]
+        self.exist = np.zeros(self.n_words, np.uint64)
+
+    def _pack(self, mask: np.ndarray) -> np.ndarray:
+        return np.packbits(mask, bitorder="little").view(np.uint64)
+
+    def add_bits(self, field: str, rows: np.ndarray,
+                 cols: np.ndarray) -> None:
+        for r in np.unique(rows):
+            mask = np.zeros(self.n_cols, bool)
+            mask[cols[rows == r]] = True
+            self.bits[(field, int(r))] = self._pack(mask)
+
+    def add_values(self, field: str, cols: np.ndarray,
+                   values: np.ndarray) -> None:
+        has = np.zeros(self.n_cols, bool)
+        vals = np.zeros(self.n_cols, np.int64)
+        has[cols] = True
+        vals[cols] = values
+        self.has[field] = has
+        self.vals[field] = vals
+
+    def add_existence(self, cols: np.ndarray) -> None:
+        mask = np.zeros(self.n_cols, bool)
+        mask[cols] = True
+        self.exist |= self._pack(mask)
+
+    # ------------------------------------------------------------- eval
+
+    def _zero(self) -> np.ndarray:
+        return np.zeros(self.n_words, np.uint64)
+
+    def eval(self, tree: Sequence[Any]) -> np.ndarray:
+        kind = tree[0]
+        if kind == "row":
+            _, field, row = tree
+            return self.bits.get((field, int(row)), self._zero())
+        if kind == "cmp":
+            _, field, op, value = tree
+            v = self.vals[field]
+            m = {"eq": v == value, "neq": v != value,
+                 "lt": v < value, "lte": v <= value,
+                 "gt": v > value, "gte": v >= value}[op]
+            return self._pack(m & self.has[field])
+        if kind == "between":
+            _, field, lo, hi = tree
+            # `lo < f < hi` parses to an inclusive BETWEEN with both
+            # bounds bumped inward (pql/parser.py _try_conditional).
+            v = self.vals[field]
+            return self._pack((v > lo) & (v < hi) & self.has[field])
+        if kind == "not":
+            return self.exist & ~self.eval(tree[1])
+        if kind in _FOLDS:
+            acc = self.eval(tree[1])
+            for sub in tree[2:]:
+                rhs = self.eval(sub)
+                if kind == "and":
+                    acc = acc & rhs
+                elif kind == "or":
+                    acc = acc | rhs
+                elif kind == "xor":
+                    acc = acc ^ rhs
+                else:
+                    acc = acc & ~rhs
+            return acc
+        raise ValueError(f"unknown tree node {tree!r}")
+
+    def expected(self, mode: str, tree: Sequence[Any]) -> Any:
+        words = self.eval(tree)
+        if mode == "count":
+            return int(np.bitwise_count(words).sum())
+        cols = np.flatnonzero(
+            np.unpackbits(words.view(np.uint8), bitorder="little"))
+        return {"columns": cols.tolist()}
+
+
+def render(tree: Sequence[Any]) -> str:
+    kind = tree[0]
+    if kind == "row":
+        return f"Row({tree[1]}={int(tree[2])})"
+    if kind == "cmp":
+        return f"Row({tree[1]} {_CMP_PQL[tree[2]]} {int(tree[3])})"
+    if kind == "between":
+        return f"Row({int(tree[2])} < {tree[1]} < {int(tree[3])})"
+    if kind == "not":
+        return f"Not({render(tree[1])})"
+    if kind in _FOLDS:
+        inner = ", ".join(render(s) for s in tree[1:])
+        return f"{_FOLD_PQL[kind]}({inner})"
+    raise ValueError(f"unknown tree node {tree!r}")
+
+
+def render_query(mode: str, tree: Sequence[Any]) -> str:
+    body = render(tree)
+    return f"Count({body})" if mode == "count" else body
+
+
+# ------------------------------------------------------------ generator
+
+
+def _leaf_row(rng: np.random.Generator) -> List[Any]:
+    field = ("f", "g")[int(rng.integers(0, 2))]
+    row = ABSENT_ROW if rng.random() < 0.06 \
+        else int(rng.integers(0, N_ROWS))
+    return ["row", field, row]
+
+
+def _leaf_cmp(rng: np.random.Generator) -> List[Any]:
+    field = sorted(BSI_FIELDS)[int(rng.integers(0, len(BSI_FIELDS)))]
+    pool = _value_pool(*BSI_FIELDS[field])
+    op = _CMP_OPS[int(rng.integers(0, len(_CMP_OPS)))]
+    return ["cmp", field, op, int(pool[int(rng.integers(0, len(pool)))])]
+
+
+def _leaf_between(rng: np.random.Generator) -> List[Any]:
+    field = sorted(BSI_FIELDS)[int(rng.integers(0, len(BSI_FIELDS)))]
+    pool = _value_pool(*BSI_FIELDS[field])
+    a = int(pool[int(rng.integers(0, len(pool)))])
+    b = int(pool[int(rng.integers(0, len(pool)))])
+    lo, hi = (a, b) if a <= b else (b, a)
+    return ["between", field, lo, hi + int(lo == hi) + 1]
+
+
+def _fold(rng: np.random.Generator) -> str:
+    return _FOLDS[int(rng.integers(0, len(_FOLDS)))]
+
+
+def _gen_tree(rng: np.random.Generator) -> List[Any]:
+    """One tree from a bounded skeleton catalog: shapes stay inside a
+    small signature space so compiled-program churn amortizes across
+    the run, while leaves (rows, predicate values) roam free."""
+    shape = int(rng.integers(0, 12))
+    if shape == 0:
+        return _leaf_row(rng)
+    if shape == 1:
+        return _leaf_cmp(rng)
+    if shape == 2:
+        return _leaf_between(rng)
+    if shape == 3:
+        return ["not", _leaf_row(rng)]
+    if shape == 4:
+        return ["not", _leaf_cmp(rng)]
+    if shape == 5:
+        return [_fold(rng), _leaf_row(rng), _leaf_row(rng)]
+    if shape == 6:
+        return [_fold(rng), _leaf_row(rng), _leaf_row(rng),
+                _leaf_row(rng)]
+    if shape == 7:
+        return [_fold(rng), _leaf_row(rng), _leaf_cmp(rng)]
+    if shape == 8:
+        return [_fold(rng), _leaf_cmp(rng), _leaf_cmp(rng)]
+    if shape == 9:
+        return ["and", ["or", _leaf_row(rng), _leaf_row(rng)],
+                _leaf_row(rng)]
+    if shape == 10:
+        return [_fold(rng), _leaf_row(rng), _leaf_between(rng)]
+    return ["diff", _leaf_row(rng), _leaf_row(rng), _leaf_row(rng),
+            _leaf_row(rng)]
+
+
+def gen_case(seed: int, index: int) -> List[List[Any]]:
+    """Deterministic case #index: a list of [mode, tree] queries.
+    Batch sizes deliberately cross pow2 output-lane pad edges, and a
+    third of cases append a shared-operand probe flood (the Tanimoto
+    shape — one query row ANDed against several candidates, which the
+    lowering must dedup to a single slab register)."""
+    rng = np.random.default_rng([seed, index])
+    n = int(rng.integers(3, 10))
+    case: List[List[Any]] = []
+    for _ in range(n):
+        mode = "count" if rng.random() < 0.6 else "rows"
+        case.append([mode, _gen_tree(rng)])
+    if rng.random() < 0.33:
+        q = int(rng.integers(0, N_ROWS))
+        for _ in range(int(rng.integers(2, 5))):
+            c = int(rng.integers(0, N_ROWS))
+            case.append(["count", ["and", ["row", "f", q],
+                                   ["row", "f", c]]])
+    return case
+
+
+def case_bytes(case: List[List[Any]]) -> bytes:
+    """Canonical bytes for digests and corpus names."""
+    return json.dumps(case, separators=(",", ":"),
+                      sort_keys=False).encode()
+
+
+# -------------------------------------------------------------- harness
+
+
+class Harness:
+    """One live holder/executor + its packed-numpy twin, shared across
+    every case of a run (the jit cache warms across cases exactly like
+    production traffic)."""
+
+    def __init__(self, data_seed: int = 0) -> None:
+        from pilosa_tpu.core.field import FieldOptions
+        from pilosa_tpu.core.holder import Holder
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+        self.n_cols = 2 * SHARD_WIDTH
+        self._tmp = tempfile.TemporaryDirectory(prefix="plan_fuzz_")
+        self.holder = Holder(self._tmp.name)
+        self.holder.open()
+        rng = np.random.default_rng([data_seed, 77])
+        idx = self.holder.create_index("pf")
+        self.oracle = HostOracle(self.n_cols)
+        all_cols: List[np.ndarray] = []
+        for field, frac in (("f", 1.0), ("g", 0.5)):
+            n = int(6000 * frac)
+            rows = rng.integers(0, N_ROWS, n).astype(np.uint64)
+            cols = rng.integers(0, self.n_cols, n).astype(np.uint64)
+            idx.create_field(field).import_bits(rows, cols)
+            self.oracle.add_bits(field, rows, cols)
+            all_cols.append(cols)
+        for field, (lo, hi) in sorted(BSI_FIELDS.items()):
+            idx.create_field(field, FieldOptions(type="int", min=lo,
+                                                 max=hi))
+            cols = rng.choice(self.n_cols, size=1500,
+                              replace=False).astype(np.uint64)
+            vals = rng.integers(lo, hi + 1, 1500).astype(np.int64)
+            idx.field(field).import_values(cols, vals)
+            self.oracle.add_values(field, cols, vals)
+            all_cols.append(cols)
+        exist = np.unique(np.concatenate(all_cols))
+        idx.add_existence(exist)
+        self.oracle.add_existence(exist)
+        self.executor = Executor(self.holder)
+        # Exact-path differential: the result cache would serve leg
+        # (b) from leg (a)'s fills and mask a divergence.
+        self.executor.result_cache.enabled = False
+
+    def close(self) -> None:
+        self.holder.close()
+        self._tmp.cleanup()
+
+    # ---------------------------------------------------------- checking
+
+    def check_case(self, case: List[List[Any]],
+                   mutate_seed: int = 0) -> List[str]:
+        """Every oracle violation for one query forest (empty = clean):
+        the three-way differential plus the captured-plan verify +
+        mutation-rejection legs."""
+        from pilosa_tpu.executor import megakernel as megamod
+        from pilosa_tpu.ops import megakernel as mk
+
+        problems: List[str] = []
+        reqs = [("pf", render_query(mode, tree), None)
+                for mode, tree in case]
+        expected = [self.oracle.expected(mode, tree)
+                    for mode, tree in case]
+
+        captured: List[Tuple[mk.Plan, int, int]] = []
+        orig_build = megamod._build
+
+        def capture_build(cohort: List[Any]) -> Tuple[mk.Plan, int, Any]:
+            plan, w_mega, lanes = orig_build(cohort)
+            captured.append(
+                (plan, cohort[0].entries[0].n_shards, w_mega))
+            return plan, w_mega, lanes
+
+        prev_enabled = megamod.MEGAKERNEL_ENABLED
+        megamod._build = capture_build
+        try:
+            megamod.MEGAKERNEL_ENABLED = True
+            mega = self.executor.execute_batch_shaped(reqs)
+            megamod.MEGAKERNEL_ENABLED = False
+            vmap = self.executor.execute_batch_shaped(reqs)
+        finally:
+            megamod._build = orig_build
+            megamod.MEGAKERNEL_ENABLED = prev_enabled
+
+        for i, (resp_m, resp_v, exp) in enumerate(zip(mega, vmap,
+                                                      expected)):
+            q = reqs[i][1]
+            for name, resp in (("megakernel", resp_m), ("vmap", resp_v)):
+                if isinstance(resp, Exception):
+                    problems.append(f"[{i}] {q}: {name} raised {resp!r}")
+            if any(isinstance(r, Exception) for r in (resp_m, resp_v)):
+                continue
+            got_m = resp_m["results"][0]
+            got_v = resp_v["results"][0]
+            if got_m != got_v:
+                problems.append(
+                    f"[{i}] {q}: megakernel {_brief(got_m)} != vmap "
+                    f"{_brief(got_v)}")
+            if got_m != exp:
+                problems.append(
+                    f"[{i}] {q}: device {_brief(got_m)} != numpy "
+                    f"oracle {_brief(exp)}")
+
+        # Verifier leg: the live lowering's plans must verify clean,
+        # and every applied mutation must be rejected pre-launch.
+        for pi, (plan, n_shards, w_mega) in enumerate(captured):
+            try:
+                mk.verify_plan(plan, n_shards, w_mega)
+            except mk.PlanVerifyError as e:
+                problems.append(
+                    f"plan {pi}: live lowering rejected by "
+                    f"verify_plan: {e}")
+                continue
+            for ki, kind in enumerate(PLAN_MUTATIONS):
+                rng = np.random.default_rng([mutate_seed, pi, ki])
+                mutated = mutate_plan(rng, plan, kind, w_mega=w_mega)
+                if mutated is None:
+                    continue
+                try:
+                    mk.verify_plan(mutated, n_shards, w_mega)
+                except mk.PlanVerifyError:
+                    continue
+                problems.append(
+                    f"plan {pi}: mutation '{kind}' escaped "
+                    f"verify_plan — a corrupted plan buffer would "
+                    f"launch")
+        return problems
+
+
+def _brief(x: Any) -> str:
+    s = repr(x)
+    return s if len(s) <= 80 else s[:77] + "..."
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def save_case(case: List[List[Any]], data_seed: int, corpus_dir: str,
+              prefix: str, note: str = "") -> str:
+    os.makedirs(corpus_dir, exist_ok=True)
+    doc = {"dataSeed": data_seed, "note": note, "queries": case}
+    blob = json.dumps(doc, indent=1).encode()
+    name = f"{prefix}-{hashlib.sha256(blob).hexdigest()[:12]}.json"
+    path = os.path.join(corpus_dir, name)
+    with open(path, "wb") as f:
+        f.write(blob)
+    return path
+
+
+def run_fuzz(seed: int, iters: int, corpus_dir: Optional[str],
+             verbose: bool = False) -> int:
+    digest = hashlib.sha256()
+    failures = 0
+    h = Harness(data_seed=seed)
+    try:
+        for i in range(iters):
+            case = gen_case(seed, i)
+            digest.update(case_bytes(case))
+            problems = h.check_case(case, mutate_seed=seed)
+            if problems:
+                failures += 1
+                where = ""
+                if corpus_dir:
+                    where = " -> " + save_case(
+                        case, seed, corpus_dir, "div",
+                        note=f"seed={seed} index={i}")
+                print(f"plan_fuzz: case seed={seed} index={i} "
+                      f"({len(case)} queries){where}")
+                for p in problems:
+                    print(f"  {p}")
+            elif verbose:
+                print(f"case {i}: ok ({len(case)} queries)")
+    finally:
+        h.close()
+    print(f"plan_fuzz: {iters} cases, {failures} failing, "
+          f"stream sha256 {digest.hexdigest()[:16]}")
+    return 1 if failures else 0
+
+
+def run_replay(corpus_dir: str) -> int:
+    if not os.path.isdir(corpus_dir):
+        print(f"plan_fuzz: no corpus at {corpus_dir} — nothing to "
+              "replay")
+        return 0
+    names = sorted(n for n in os.listdir(corpus_dir)
+                   if n.endswith(".json"))
+    failures = 0
+    harnesses: Dict[int, Harness] = {}
+    try:
+        for name in names:
+            with open(os.path.join(corpus_dir, name)) as f:
+                doc = json.load(f)
+            ds = int(doc.get("dataSeed", 0))
+            h = harnesses.get(ds)
+            if h is None:
+                h = harnesses[ds] = Harness(data_seed=ds)
+            problems = h.check_case(doc["queries"], mutate_seed=ds)
+            if problems:
+                failures += 1
+                print(f"plan_fuzz: REGRESSION {name}")
+                for p in problems:
+                    print(f"  {p}")
+    finally:
+        for h in harnesses.values():
+            h.close()
+    print(f"plan_fuzz: replayed {len(names)} corpus entries, "
+          f"{failures} regressions")
+    return 1 if failures else 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="plan_fuzz",
+        description="megakernel plan-space fuzzer + three-way "
+                    "differential oracle (megakernel / vmap fusion / "
+                    "packed numpy)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--corpus-dir", default=DEFAULT_CORPUS,
+                    help="where failing reproducers are written "
+                         f"(default: {DEFAULT_CORPUS})")
+    ap.add_argument("--no-save", action="store_true",
+                    help="do not write reproducers on failure")
+    ap.add_argument("--replay", metavar="DIR", nargs="?",
+                    const=DEFAULT_CORPUS, default=None,
+                    help="replay a committed corpus instead of fuzzing")
+    ap.add_argument("--digest", action="store_true",
+                    help="only print the generated-stream digest "
+                         "(determinism check; no execution)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.replay is not None:
+        return run_replay(args.replay)
+    if args.digest:
+        digest = hashlib.sha256()
+        for i in range(args.iters):
+            digest.update(case_bytes(gen_case(args.seed, i)))
+        print(digest.hexdigest())
+        return 0
+    corpus = None if args.no_save else args.corpus_dir
+    return run_fuzz(args.seed, args.iters, corpus, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,406 @@
+"""Plan-IR verifier self-sweep + SARIF gate (the static-analysis leg
+of the plan verification plane).
+
+``ops/megakernel.verify_plan`` is the pre-launch type checker for the
+megakernel's ``[P, 4]`` plan buffers. This tool proves, without a
+device and without importing jax, that the checker and the shipped
+lowering agree:
+
+- **PV001 lowering-emits-invalid-plan** — a synthetic lowering sweep
+  covering the full opcode table (AND/OR/XOR/ANDNOT folds at widths
+  2..4, zero leaves, existence-Not) and the full BSI comparison table
+  (eq/neq/notnull/lt/lte/gt/gte/between at boundary bit-depths 1, 7,
+  31, 63 with boundary predicate values) plus shared-operand and
+  pow2-pad-edge shapes, each built through the REAL
+  ``ops/megakernel.Lowering`` and handed to ``verify_plan`` — every
+  plan the lowering emits must verify clean.
+- **PV002 mutation-escapes-verifier** — every plan from the sweep is
+  byte-mutated across the :data:`PLAN_MUTATIONS` kinds (bad opcode,
+  writes to shared slot registers, register indices out of the slab,
+  broken RAW chains, corrupted output lanes / pad aliasing, width-mask
+  overruns, out-of-bank gather indices); each applied mutation must be
+  REJECTED by ``verify_plan`` before it could ever launch.
+
+``tools/plan_fuzz.py`` reuses :func:`mutate_plan` against plans the
+*live executor* lowers, so the mutation table here is the single
+coverage set the acceptance criteria name.
+
+CLI::
+
+    python -m tools.planverify                  # sweep, human summary
+    python -m tools.planverify --output planverify.sarif
+
+Exit status: 0 clean, 1 findings (SARIF still written), 2 usage error.
+The SARIF artifact merges with graftlint.sarif / native_tidy.sarif
+into one multi-run document via ``tools/sarif_merge.py`` (check.sh).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pilosa_tpu.ops import megakernel as mk
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+
+# Where the checked contract lives; SARIF findings anchor there.
+_VERIFIER_URI = "pilosa_tpu/ops/megakernel.py"
+
+RULES: Tuple[Tuple[str, str, str], ...] = (
+    ("PV001", "lowering-emits-invalid-plan",
+     "a plan built by the shipped megakernel lowering failed "
+     "verify_plan — the checker and the lowering disagree"),
+    ("PV002", "mutation-escapes-verifier",
+     "a corrupted plan buffer passed verify_plan — the launch gate "
+     "would execute a broken plan"),
+)
+
+
+# ------------------------------------------------------------ mutations
+
+# The mutation-kind coverage set: every kind corrupts a plan in a way
+# verify_plan MUST reject (each maps to one checked invariant).
+PLAN_MUTATIONS: Tuple[str, ...] = (
+    "opcode",        # opcode byte outside the table
+    "dst_slot",      # instruction writes a shared (read-only) slot reg
+    "dst_range",     # destination outside the register slab
+    "src_range",     # read operand outside the register slab
+    "src_undef",     # read operand's RAW chain broken (undefined reg)
+    "out_range",     # output lane outside the register slab
+    "out_pad_alias", # real output lane aliased onto the pad register
+    "width",         # slot width mask past the launch width
+    "slot_row",      # gather index outside the operand bank
+)
+
+
+def clone_plan(plan: mk.Plan) -> mk.Plan:
+    """Deep-copy the mutable buffers (metadata is shared: mutations
+    model byte corruption of uploaded data, not of host bookkeeping)."""
+    return mk.Plan(
+        banks=plan.banks,
+        slots=tuple(s.copy() for s in plan.slots),
+        widths=plan.widths.copy(),
+        instrs=plan.instrs.copy(),
+        out_count=plan.out_count.copy(),
+        out_row=plan.out_row.copy(),
+        n_slots=plan.n_slots, n_regs=plan.n_regs,
+        n_instrs=plan.n_instrs,
+        lane_count_widths=plan.lane_count_widths,
+        lane_row_widths=plan.lane_row_widths)
+
+
+def _real_reading_instrs(plan: mk.Plan) -> List[int]:
+    return [i for i in range(plan.n_instrs)
+            if int(plan.instrs[i, 0]) != mk.OP_ZERO]
+
+
+def _spare_unwritten(plan: mk.Plan) -> bool:
+    spare = plan.n_regs - 1
+    return all(int(plan.instrs[i, 1]) != spare
+               for i in range(plan.n_instrs))
+
+
+def mutate_plan(rng: np.random.Generator, plan: mk.Plan,
+                kind: str, w_mega: int) -> Optional[mk.Plan]:
+    """Apply one mutation kind to a copy of ``plan``; returns the
+    corrupted plan, or None when the kind's structural guard does not
+    apply (e.g. no instructions to corrupt). Guards are chosen so an
+    applied mutation is ALWAYS a verify_plan reject — the fuzzer
+    asserts exactly that. ``w_mega`` is the launch width the plan will
+    be verified against; the "width" kind must overrun IT, not just
+    the widest slot (a max-slot-width+1 corruption inside [1, w_mega]
+    can legitimately verify when the slot feeds its lane through an
+    AND)."""
+    p = clone_plan(plan)
+    T = p.n_regs
+    spare = T - 1
+    nc = len(p.lane_count_widths)
+    nr = len(p.lane_row_widths)
+    if kind == "opcode":
+        if p.n_instrs < 1:
+            return None
+        i = int(rng.integers(0, p.n_instrs))
+        p.instrs[i, 0] = int(rng.choice([6, 7, 42, 127, -1]))
+        return p
+    if kind == "dst_slot":
+        if p.n_instrs < 1 or p.n_slots < 1:
+            return None
+        i = int(rng.integers(0, p.n_instrs))
+        p.instrs[i, 1] = int(rng.integers(0, p.n_slots))
+        return p
+    if kind == "dst_range":
+        if p.n_instrs < 1:
+            return None
+        i = int(rng.integers(0, p.n_instrs))
+        p.instrs[i, 1] = int(rng.choice([T, T + 3, -1]))
+        return p
+    if kind == "src_range":
+        cands = _real_reading_instrs(p)
+        if not cands:
+            return None
+        i = cands[int(rng.integers(0, len(cands)))]
+        op = int(p.instrs[i, 0])
+        col = 3 if op in mk._READS_B and rng.random() < 0.5 else 2
+        p.instrs[i, col] = int(rng.choice([T, -2]))
+        return p
+    if kind == "src_undef":
+        cands = _real_reading_instrs(p)
+        if not cands or not _spare_unwritten(p):
+            return None
+        i = cands[int(rng.integers(0, len(cands)))]
+        op = int(p.instrs[i, 0])
+        col = 3 if op in mk._READS_B and rng.random() < 0.5 else 2
+        p.instrs[i, col] = spare
+        return p
+    if kind == "out_range":
+        if nc + nr < 1:
+            return None
+        j = int(rng.integers(0, nc + nr))
+        bad = int(rng.choice([T, T + 1, -1]))
+        if j < nc:
+            p.out_count[j] = bad
+        else:
+            p.out_row[j - nc] = bad
+        return p
+    if kind == "out_pad_alias":
+        if nc + nr < 1 or not _spare_unwritten(p):
+            return None
+        j = int(rng.integers(0, nc + nr))
+        if j < nc:
+            p.out_count[j] = spare
+        else:
+            p.out_row[j - nc] = spare
+        return p
+    if kind == "width":
+        if p.n_slots < 1:
+            return None
+        k = int(rng.integers(0, p.n_slots))
+        p.widths[k] = int(w_mega) + 1 + int(rng.integers(0, 4))
+        return p
+    if kind == "slot_row":
+        for b, (bank, slots) in enumerate(zip(p.banks, p.slots)):
+            shape = getattr(bank, "shape", None)
+            if isinstance(shape, tuple) and shape and len(slots):
+                j = int(rng.integers(0, len(slots)))
+                p.slots[b][j] = int(shape[0]) + 1 + int(rng.integers(0, 5))
+                return p
+        return None
+    raise ValueError(f"unknown mutation kind {kind!r}")
+
+
+# --------------------------------------------------------------- sweep
+
+_N_SHARDS = 2
+_BANK_ROWS = 70  # covers depth-63 BSI planes + a not-null plane
+
+
+def _bank(w: int) -> np.ndarray:
+    """A shape-carrying operand bank (contents never read host-side)."""
+    return np.zeros((_BANK_ROWS, _N_SHARDS, w), np.uint32)
+
+
+def _limbs(value: int) -> List[int]:
+    return [value & 0xFFFFFFFF, (value >> 32) & 0xFFFFFFFF]
+
+
+def _bsi_values(depth: int) -> List[int]:
+    """Boundary predicate values for one bit-depth: all-zeros,
+    all-ones, single low/high bit, alternating bits."""
+    top = (1 << depth) - 1
+    vals = {0, 1, top, max(0, top - 1), 1 << (depth - 1),
+            top & 0x5555555555555555}
+    return sorted(vals)
+
+
+def synthetic_plans() -> List[Tuple[str, mk.Plan, int, int]]:
+    """(name, plan, n_shards, w_mega) across the opcode/BSI table and
+    the structural edge shapes — every plan built through the real
+    Lowering, exactly as executor/megakernel._build drives it."""
+    out: List[Tuple[str, mk.Plan, int, int]] = []
+
+    def finish(name: str, low: mk.Lowering, w_mega: int) -> None:
+        out.append((name, low.finish(), _N_SHARDS, w_mega))
+
+    # Fold table at widths 2..4, count and row modes, one plan each.
+    for opname in ("and", "or", "xor", "diff"):
+        for n in (2, 3, 4):
+            low = mk.Lowering()
+            bank = _bank(8)
+            ir = tuple(("slot", 0, i) for i in range(n)) \
+                + (("fold", opname, n),)
+            low.add_entry(ir, [bank], list(range(n)), [], 8, "count")
+            low.add_entry(ir, [bank], list(range(1, n + 1)), [], 8,
+                          "row")
+            finish(f"fold-{opname}-{n}", low, 8)
+
+    # Existence-Not: ex \ sub, the ("fold", "diff", 2) lowering.
+    low = mk.Lowering()
+    bank = _bank(8)
+    low.add_entry((("slot", 0, 0), ("slot", 0, 1), ("fold", "diff", 2)),
+                  [bank], [0, 3], [], 8, "count")
+    finish("not-existence", low, 8)
+
+    # Zero leaves (empty time ranges / out-of-range EQ).
+    low = mk.Lowering()
+    bank = _bank(8)
+    low.add_entry((("zero",),), [bank], [], [], 8, "row")
+    low.add_entry((("zero",), ("slot", 0, 0), ("fold", "or", 2)),
+                  [bank], [1], [], 8, "count")
+    finish("zero-leaves", low, 8)
+
+    # Pure-gather row plan: NO instructions at all (n_instrs=0, the
+    # pad tail is the whole buffer).
+    low = mk.Lowering()
+    bank = _bank(4)
+    low.add_entry((("slot", 0, 0),), [bank], [5], [], 4, "row")
+    finish("gather-only", low, 4)
+
+    # Shared operand rows (the Tanimoto probe flood).
+    low = mk.Lowering()
+    bank = _bank(8)
+    ir = (("slot", 0, 0), ("slot", 0, 1), ("fold", "and", 2))
+    for c in (5, 6, 7, 9):
+        low.add_entry(ir, [bank], [3, c], [], 8, "count")
+    finish("shared-operand", low, 8)
+
+    # Full BSI comparison table at boundary bit-depths.
+    for depth in (1, 7, 31, 63):
+        low = mk.Lowering()
+        bank = _bank(16)
+        idxs = list(range(depth + 1))  # planes 0..depth-1 + not-null
+        for kind in ("eq", "neq", "notnull", "lt", "gt"):
+            for value in _bsi_values(depth):
+                for allow_eq in ((False, True) if kind in ("lt", "gt")
+                                 else (False,)):
+                    params = _limbs(value)
+                    ir = (("bsi", kind, 0, 0, depth, 0, 0, allow_eq),)
+                    low.add_entry(ir, [bank], idxs, params, 16, "count")
+        # between at the depth's extremes.
+        lo, hi = 1, (1 << depth) - 1
+        params = _limbs(lo) + _limbs(hi)
+        low.add_entry((("bsi", "between", 0, 0, depth, 0, 2, True),),
+                      [bank], idxs, params, 16, "count")
+        finish(f"bsi-depth-{depth}", low, 16)
+
+    # Heterogeneous mixed plan: folds + BSI + zero + row lanes over
+    # two banks of different widths (w_mega = the max).
+    low = mk.Lowering()
+    b8, b4 = _bank(8), _bank(4)
+    low.add_entry((("slot", 0, 0),), [b8], [1], [], 8, "count")
+    low.add_entry((("slot", 0, 0), ("slot", 1, 1), ("fold", "and", 2)),
+                  [b8, b4], [2, 3], [], 8, "count")
+    low.add_entry((("slot", 0, 0),), [b4], [4], [], 4, "row")
+    low.add_entry((("bsi", "lt", 0, 0, 7, 0, 0, True),),
+                  [b8], list(range(8)), _limbs(99), 8, "count")
+    low.add_entry((("zero",),), [b8], [], [], 8, "row")
+    finish("mixed-heterogeneous", low, 8)
+
+    return out
+
+
+# ---------------------------------------------------------------- SARIF
+
+
+def sarif_document(findings: Sequence[Tuple[str, str]]) -> Dict[str, object]:
+    """One SARIF 2.1.0 run for the planverify tool; ``findings`` are
+    (ruleId, message) pairs (empty on a clean sweep)."""
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "planverify",
+                "informationUri":
+                    "docs/development.md#plan-ir-verification-plane",
+                "rules": [{
+                    "id": code,
+                    "name": name,
+                    "shortDescription": {"text": desc},
+                    "defaultConfiguration": {"level": "error"},
+                } for code, name, desc in RULES],
+            }},
+            "results": [{
+                "ruleId": code,
+                "level": "error",
+                "message": {"text": msg},
+                "locations": [{
+                    "physicalLocation": {
+                        "artifactLocation": {"uri": _VERIFIER_URI},
+                        "region": {"startLine": 1, "startColumn": 1},
+                    },
+                }],
+            } for code, msg in findings],
+        }],
+    }
+
+
+# ------------------------------------------------------------------ CLI
+
+
+def run_sweep(seed: int, verbose: bool = False) -> List[Tuple[str, str]]:
+    """The PV001/PV002 sweep; returns findings (empty = clean)."""
+    findings: List[Tuple[str, str]] = []
+    plans = synthetic_plans()
+    mutations_applied = 0
+    for case_i, (name, plan, n_shards, w_mega) in enumerate(plans):
+        try:
+            mk.verify_plan(plan, n_shards, w_mega)
+        except mk.PlanVerifyError as e:
+            findings.append((
+                "PV001",
+                f"plan '{name}' from the shipped lowering rejected: {e}"))
+            continue
+        for kind_i, kind in enumerate(PLAN_MUTATIONS):
+            rng = np.random.default_rng([seed, case_i, kind_i])
+            mutated = mutate_plan(rng, plan, kind, w_mega=w_mega)
+            if mutated is None:
+                continue
+            mutations_applied += 1
+            try:
+                mk.verify_plan(mutated, n_shards, w_mega)
+            except mk.PlanVerifyError:
+                continue
+            findings.append((
+                "PV002",
+                f"plan '{name}' + mutation '{kind}' passed "
+                f"verify_plan — the gate would launch a corrupted "
+                f"plan buffer"))
+        if verbose:
+            print(f"  {name}: ok ({plan.n_instrs} instrs, "
+                  f"{plan.n_slots} slots)")
+    print(f"planverify: {len(plans)} lowered plans, "
+          f"{mutations_applied} mutations applied, "
+          f"{len(findings)} findings")
+    return findings
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="planverify",
+        description="plan-IR verifier self-sweep: the shipped lowering "
+                    "must verify clean, corrupted plans must reject")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--output", metavar="FILE", default=None,
+                    help="write the SARIF artifact here "
+                         "(merged into check.sarif by check.sh)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    findings = run_sweep(args.seed, verbose=args.verbose)
+    for code, msg in findings:
+        print(f"planverify: {code} {msg}")
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(sarif_document(findings), f, indent=2)
+        print(f"planverify: SARIF -> {args.output}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""Merge SARIF 2.1.0 documents into one multi-run artifact.
+
+check.sh produces one SARIF file per analysis tool — graftlint.sarif
+(python AST rules), native_tidy.sarif (clang-tidy/cppcheck over the
+native codec), planverify.sarif (the plan-IR verifier self-sweep) —
+but CI wants ONE upload. SARIF's own composition model is the `runs`
+array: each tool keeps its driver metadata and results as its own run
+object, so a merged document is simply the concatenation of the
+inputs' runs under one envelope. Nothing is rewritten; a viewer shows
+per-tool rule tables exactly as the individual files would.
+
+CLI::
+
+    python -m tools.sarif_merge --output check.sarif \
+        graftlint.sarif native_tidy.sarif planverify.sarif
+
+Missing inputs are skipped with a note (tools are availability-gated:
+e.g. native_tidy only emits where clang-tidy/cppcheck exist); an input
+that exists but does not parse as SARIF fails the merge. Exit 0 on
+success (even if some inputs were skipped), 2 on usage/parse errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def merge_documents(docs: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """One envelope, every input's runs in argument order."""
+    runs: List[Dict[str, Any]] = []
+    for doc in docs:
+        runs.extend(doc.get("runs", []))
+    return {"$schema": _SCHEMA, "version": "2.1.0", "runs": runs}
+
+
+def load_sarif(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "runs" not in doc:
+        raise ValueError(f"{path}: not a SARIF document (no 'runs')")
+    return doc
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="sarif_merge",
+        description="merge per-tool SARIF artifacts into one "
+                    "multi-run document for CI upload")
+    ap.add_argument("inputs", nargs="+", metavar="FILE")
+    ap.add_argument("--output", "-o", required=True, metavar="FILE")
+    args = ap.parse_args(argv)
+
+    docs: List[Dict[str, Any]] = []
+    merged_names: List[str] = []
+    for path in args.inputs:
+        if not os.path.exists(path):
+            print(f"sarif_merge: {path} absent — skipped "
+                  "(availability-gated tool)")
+            continue
+        try:
+            doc = load_sarif(path)
+        except (ValueError, json.JSONDecodeError) as e:
+            print(f"sarif_merge: {e}", file=sys.stderr)
+            return 2
+        docs.append(doc)
+        merged_names.append(path)
+    merged = merge_documents(docs)
+    with open(args.output, "w") as f:
+        json.dump(merged, f, indent=2)
+    tools = [r.get("tool", {}).get("driver", {}).get("name", "?")
+             for r in merged["runs"]]
+    results = sum(len(r.get("results", [])) for r in merged["runs"])
+    print(f"sarif_merge: {len(merged['runs'])} runs "
+          f"({', '.join(tools) or 'none'}) from "
+          f"{len(merged_names)} files, {results} results "
+          f"-> {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
