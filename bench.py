@@ -501,6 +501,40 @@ def run_child(argv, timeout):
         stdout=subprocess.PIPE)
 
 
+CAPACITY_TIMEOUT_S = float(os.environ.get(
+    "PILOSA_BENCH_CAPACITY_TIMEOUT_S", 300))
+
+
+def capacity_lane():
+    """Capacity record (hybrid layout, ISSUE 13): resident
+    shards-per-byte dense vs hybrid on a small Zipfian-density corpus
+    plus the hot-q/s guardrail and sparse rows/s — measured in a
+    bounded CPU child (it is pure layout math and must never touch
+    the tunnel, so BENCH_* records track the capacity axis even when
+    the device is unreachable). Returns the stanza or an error dict;
+    never raises."""
+    cmd = [sys.executable, "-c",
+           "import os; os.environ['JAX_PLATFORMS'] = 'cpu'; "
+           "import sys, runpy; "
+           "sys.argv = ['layout_bench', '--rows', '2000', "
+           "'--iters', '50']; "
+           "runpy.run_module('benches.layout_bench', "
+           "run_name='__main__')"]
+    rc, out = _run_bounded(cmd, CAPACITY_TIMEOUT_S,
+                           stdout=subprocess.PIPE)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        keep = ("shardsPerByteRatio", "bytesPerShardDense",
+                "bytesPerShardHybrid", "shardsPerGiBDense",
+                "shardsPerGiBHybrid", "hotQpsDense", "hotQpsHybrid",
+                "hotRegressionPct", "sparseRowsPerS")
+        return {k: rec[k] for k in keep if k in rec}
+    return {"error": f"capacity child rc={rc}, no record parsed"}
+
+
 def probe_backend():
     """Hold-for-window probe: keep probing in a child until the backend
     answers or the hold deadline passes. Each failed probe against a
@@ -746,6 +780,10 @@ def main():
         carried = sidecar_carry(baseline, bits)
         if carried is not None:
             result["last_measured_tpu"] = carried
+    # Capacity lane beside q/s: the hybrid-layout shards-per-byte
+    # ratio and its hot-path guardrail, so the record tracks the
+    # capacity axis from this round on.
+    result["capacity"] = capacity_lane()
     emit_record(result, final=True)
 
 

@@ -235,6 +235,21 @@ def cmd_server(args) -> int:
             extra_gauges=_telemetry_gauges)
         watchdog.start()
         api.watchdog = watchdog
+    # Adaptive hybrid bank layout (core/layout.py): the background
+    # re-layout pass demotes sparse/cold views to compact device
+    # SparseBanks under the same HBM watermark the watchdog warns on.
+    # PILOSA_TPU_HYBRID_LAYOUT=0 kills the whole plane regardless.
+    from pilosa_tpu.core.view import BANK_BUDGET as _BANK_BUDGET
+    api.layout.configure(
+        enabled=cfg.layout_enabled,
+        interval_s=cfg.layout_interval_s,
+        demote_density=cfg.layout_demote_density,
+        min_bytes=cfg.layout_min_bytes,
+        promote_rate=cfg.layout_promote_rate,
+        watermark_bytes=int(_BANK_BUDGET.budget
+                            * cfg.telemetry_hbm_watermark))
+    if cfg.layout_enabled and cfg.layout_interval_s > 0:
+        api.layout.start()
     from pilosa_tpu.utils.diagnostics import (
         DiagnosticsCollector, RuntimeMonitor,
     )
@@ -349,6 +364,7 @@ def cmd_server(args) -> int:
         if anti_entropy is not None:
             anti_entropy.stop()
         diagnostics.stop()
+        api.layout.stop()
         if runtime_monitor is not None:
             runtime_monitor.stop()
         # Telemetry drain: watchdog ring + slow-query ring dump to the

@@ -1,0 +1,385 @@
+"""Adaptive hybrid bank layout: the workload-driven re-layout pass
+that closes the measure→act loop (ROADMAP item 1).
+
+PR 5's memledger *quantifies* HBM waste (live vs padded bytes per
+bank), PR 6's workload plane *ranks* demotion candidates (the
+``demotionScore = (1 - density) * bytes / (1 + rate)`` quadrants at
+``/debug/hotspots``) — and until this module nothing acted on either
+signal: every view served queries from dense ``ViewBank``s whose rows
+pad to the full trimmed width, so a sparse row costs the same HBM as a
+full one. This is exactly the array-vs-bitmap decision Roaring makes
+per container (PAPERS.md 1402.6407/1603.06549, ``storage/roaring.py``
+host-side); here it is made per VIEW for the device-resident banks:
+
+- **Hot/dense views** stay in dense ``ViewBank``s — the gather-only
+  hot path is untouched, which is what bounds the q/s regression.
+- **Sparse/cold views** demote to :class:`~pilosa_tpu.core.view.
+  SparseBank`s (encoded set-bit positions, ~4 B/set bit), served
+  through the megakernel IR's ``OP_EXPAND`` opcode / the jitted
+  ``expand_positions`` scatter — bit-identical to dense by the same
+  carry-free-add argument as the sparse-upload path, pinned by the
+  plan fuzzer's three-way differential.
+
+:class:`LayoutManager` is the background pass (modeled on
+``Bitmap.optimize``, storage/roaring.py): each run joins the ledger's
+bank entries (bytes, pad share, sampled live-bit density) against the
+workload recorder's per-view read rates, demotes the highest-scoring
+sparse-cold banks — always when the memledger watchdog's HBM
+watermark is crossed, otherwise only banks under the density
+threshold — and promotes sparse views whose read rate climbed back
+above the promotion threshold. Every flip follows the rank-cache
+epoch discipline PR 10 proved: representations change, DATA never
+does, so a racing query can at worst take a spurious cache miss or
+serve from the representation it planned against — never a stale hit
+(tests/test_layout.py pins the interleavings under
+``PILOSA_TPU_LOCK_CHECK``).
+
+Kill switch: ``PILOSA_TPU_HYBRID_LAYOUT=0`` disables sparse planning
+AND the re-layout pass outright; results are byte-identical either
+way (tools/layout_smoke.py gates exactly that).
+
+Host-side module: the pass itself never touches the device beyond the
+``sparse_bank`` builds it explicitly requests (which are ordinary
+bank builds under the HBM budget).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from pilosa_tpu.core.view import VIEW_BSI_PREFIX
+from pilosa_tpu.utils.hotspots import WORKLOAD
+from pilosa_tpu.utils.locks import make_lock
+from pilosa_tpu.utils.memledger import LEDGER
+
+# The blunt kill switch over the whole hybrid layout: planning never
+# emits sparse leaves and the re-layout pass refuses to run. Module
+# attribute like executor.FUSION_ENABLED — tests toggle it directly,
+# the env var sets the process default.
+HYBRID_LAYOUT_ENABLED = os.environ.get(
+    "PILOSA_TPU_HYBRID_LAYOUT", "1") != "0"
+
+
+def entry_density_score(info: Dict[str, Any], rate: float):
+    """(density, demotionScore) of one ledger bank entry: density is
+    the pad-share times the clamped sampled live-bit density, score is
+    ``(1 - density) * bytes / (1 + rate)`` — THE quadrant formula, the
+    single implementation behind BankBudget eviction and the re-layout
+    ranking. (hotspots._bank_quadrants keeps a self-contained copy of
+    the same formula: that module is deliberately import-light and
+    importing it from here would close a cycle — a formula change must
+    land in both, pinned by the tests comparing their rankings.)
+    Returns None for unpriceable entries."""
+    nbytes = int(info.get("bytes", 0) or 0)
+    if nbytes <= 0:
+        return None
+    padded = int(info.get("paddedBytes", 0) or 0)
+    density = max(0.0, 1.0 - padded / nbytes)
+    live = info.get("liveDensity")
+    if live is not None:
+        try:
+            density *= max(0.0, min(1.0, float(live)))
+        except (TypeError, ValueError):
+            pass
+    return density, (1.0 - density) * nbytes / (1.0 + rate)
+
+
+def demotion_scores(entries) -> Dict[Any, float]:
+    """Demotion score per BankBudget entry key ((id(view), cache_key)
+    -> score) for the entries the ledger + workload plane can price —
+    applied at eviction time so HBM pressure evicts the
+    sparsest-coldest bank first. Unpriceable entries are simply absent
+    (the caller treats them as score 0 and falls back to LRU)."""
+    from pilosa_tpu.core.view import BankBudget
+
+    rates = WORKLOAD.view_read_rates()
+    out: Dict[Any, float] = {}
+    for ek in entries:
+        info = LEDGER.entry_info(BankBudget.LEDGER_CATEGORIES, ek)
+        if info is None:
+            continue
+        ds = entry_density_score(
+            info, rates.get((info.get("index", ""),
+                             info.get("field", ""),
+                             info.get("view", "")), 0.0))
+        if ds is not None:
+            out[ek] = ds[1]
+    return out
+
+
+class LayoutManager:
+    """The background re-layout pass + its counters/gauges (the
+    ``pilosa_layout_*`` family on /metrics, the ``layout`` stanza in
+    /debug/memory and /internal/health).
+
+    ``relayout_once()`` is one complete pass (the thread just calls it
+    every ``interval_s``); it is also the unit tests and the smoke
+    drive directly. Thread-safe: one pass at a time, counters under a
+    leaf lock."""
+
+    def __init__(self, holder: Any, interval_s: float = 30.0,
+                 demote_density: float = 0.25,
+                 min_bytes: int = 1 << 20,
+                 promote_rate: float = 0.5,
+                 watermark_bytes: int = 0,
+                 stats: Optional[Any] = None,
+                 logger: Optional[Any] = None) -> None:
+        self.holder = holder
+        self.enabled = True
+        self.interval_s = max(0.0, float(interval_s))
+        self.demote_density = float(demote_density)
+        self.min_bytes = int(min_bytes)
+        self.promote_rate = float(promote_rate)
+        self.watermark_bytes = int(watermark_bytes)
+        self.stats = stats
+        self.logger = logger
+        self._lock = make_lock("LayoutManager._lock")
+        self._run_lock = make_lock("LayoutManager._run_lock")
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # Cumulative counters (monotone; also stats.count-ed at event
+        # time so the exported pilosa_layout_* stay true counters).
+        self.relayout_runs = 0
+        self.demotions = 0
+        self.promotions = 0
+        self.demote_failures = 0
+        self.bytes_reclaimed = 0   # device-byte drop summed over runs
+        self.last_run_at: Optional[float] = None
+        self.last_delta_bytes = 0  # signed device delta of the last run
+
+    # ---------------------------------------------------------- configure
+
+    def configure(self, enabled: Optional[bool] = None,
+                  interval_s: Optional[float] = None,
+                  demote_density: Optional[float] = None,
+                  min_bytes: Optional[int] = None,
+                  promote_rate: Optional[float] = None,
+                  watermark_bytes: Optional[int] = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if interval_s is not None:
+                self.interval_s = max(0.0, float(interval_s))
+            if demote_density is not None:
+                self.demote_density = float(demote_density)
+            if min_bytes is not None:
+                self.min_bytes = int(min_bytes)
+            if promote_rate is not None:
+                self.promote_rate = float(promote_rate)
+            if watermark_bytes is not None:
+                self.watermark_bytes = int(watermark_bytes)
+
+    # ------------------------------------------------------------ the pass
+
+    def _resolve_view(self, index: str, field: str, view: str):
+        idx = self.holder.index(index)
+        f = idx.field(field) if idx is not None else None
+        return f.view(view) if f is not None else None
+
+    @staticmethod
+    def _eligible(view) -> bool:
+        """A view the hybrid layout may demote: a row-leaf view (BSI
+        plane banks gather depth+1 rows per leaf and stay dense) whose
+        trimmed width fits the u16 bitpos encoding."""
+        from pilosa_tpu.core.fragment import CONTAINER_BITS
+        if view is None or view.name.startswith(VIEW_BSI_PREFIX):
+            return False
+        if not view.fragments:
+            return False
+        return view.trimmed_words() * 32 <= CONTAINER_BITS
+
+    def _sparse_views(self) -> List[Any]:
+        out = []
+        for idx in list(self.holder.indexes.values()):
+            for f in list(idx.fields.values()):
+                for v in list(f.views.values()):
+                    if v.layout_mode == "sparse":
+                        out.append(v)
+        return out
+
+    def demote(self, view) -> bool:
+        """Dense -> sparse: drop the view's dense cached banks and
+        prebuild the SparseBank so the before/after byte delta is
+        ledger-provable immediately (lazy rebuild would defer the
+        *gain*, not just the cost). Host storage is compacted first
+        (``Fragment.optimize_storage`` — the ``Bitmap.optimize`` this
+        pass is modeled on): point writes densify their row's
+        container for mutation, and a Set-built view would otherwise
+        read as "too dense" for the positions gather even though its
+        rows are nearly empty. Reverts (and counts a failure) when the
+        view is GENUINELY too dense for the sparse codec."""
+        if not self._eligible(view):
+            return False
+        for frag in list(view.fragments.values()):
+            try:
+                frag.optimize_storage()
+            except Exception:
+                pass  # compaction is an optimization, never a gate
+        view.set_layout("sparse")
+        shards = tuple(view.available_shards())
+        bank = view.sparse_bank(shards) if shards else None
+        if shards and bank is None:
+            view.set_layout("dense")
+            with self._lock:
+                self.demote_failures += 1
+            return False
+        with self._lock:
+            self.demotions += 1
+        if self.stats is not None:
+            self.stats.count("layout.demotions", 1)
+        if self.logger is not None:
+            self.logger.printf(
+                "layout: demoted %s/%s/%s to sparse (%d rows, %d "
+                "bytes resident)", view.index, view.field, view.name,
+                bank.n_rows if bank else 0,
+                bank.nbytes if bank else 0)
+        return True
+
+    def promote(self, view) -> bool:
+        """Sparse -> dense: drop the SparseBank; the dense bank
+        rebuilds lazily on the next query (promotion is triggered by
+        heat, so "next query" is imminent and pays one build — the
+        same cost a cold dense view pays today)."""
+        if not view.set_layout("dense"):
+            return False
+        with self._lock:
+            self.promotions += 1
+        if self.stats is not None:
+            self.stats.count("layout.promotions", 1)
+        if self.logger is not None:
+            self.logger.printf("layout: promoted %s/%s/%s to dense",
+                               view.index, view.field, view.name)
+        return True
+
+    def relayout_once(self) -> Dict[str, Any]:
+        """One complete re-layout pass; returns its summary (also the
+        shape of the health/debug stanza's lastRun)."""
+        if not (self.enabled and HYBRID_LAYOUT_ENABLED):
+            return {"ran": False, "reason": "disabled"}
+        with self._run_lock:
+            device_before = LEDGER.total_bytes(device_only=True)
+            over = bool(self.watermark_bytes
+                        and device_before >= self.watermark_bytes)
+            rates = WORKLOAD.view_read_rates()
+            demoted = promoted = 0
+            # Demotion leg: ledger dense-bank entries scored by the
+            # quadrant formula, sparsest-coldest first.
+            cands: List[Tuple[float, float, Dict[str, Any]]] = []
+            for e in LEDGER.entries("bank"):
+                if int(e.get("bytes", 0) or 0) < self.min_bytes \
+                        or not e.get("view"):
+                    continue
+                rate = rates.get((e["index"], e["field"], e["view"]),
+                                 0.0)
+                ds = entry_density_score(e, rate)
+                if ds is None:
+                    continue
+                density, score = ds
+                cands.append((score, density, e))
+            cands.sort(key=lambda c: -c[0])
+            for score, density, e in cands:
+                # Watermark pressure demotes the ranking top-down;
+                # below the watermark only genuinely sparse banks
+                # (density under the threshold) move — a merely-cold
+                # dense bank is the LRU budget's job, not ours.
+                if not over and density > self.demote_density:
+                    continue
+                view = self._resolve_view(e["index"], e["field"],
+                                          e["view"])
+                if view is None or view.layout_mode == "sparse":
+                    continue
+                rate = rates.get((e["index"], e["field"], e["view"]),
+                                 0.0)
+                if rate > self.promote_rate and not over:
+                    continue  # hot stays dense unless pressure forces
+                if self.demote(view):
+                    demoted += 1
+            # Promotion leg: sparse views whose read rate climbed back.
+            for view in self._sparse_views():
+                rate = rates.get((view.index, view.field, view.name),
+                                 0.0)
+                if rate > self.promote_rate:
+                    if self.promote(view):
+                        promoted += 1
+            device_after = LEDGER.total_bytes(device_only=True)
+            delta = device_after - device_before
+            with self._lock:
+                self.relayout_runs += 1
+                self.last_run_at = time.time()
+                self.last_delta_bytes = delta
+                if delta < 0:
+                    self.bytes_reclaimed += -delta
+            if self.stats is not None:
+                self.stats.count("layout.relayout_runs", 1)
+            return {"ran": True, "overWatermark": over,
+                    "demoted": demoted, "promoted": promoted,
+                    "deviceBytesBefore": device_before,
+                    "deviceBytesAfter": device_after,
+                    "deltaBytes": delta}
+
+    # ------------------------------------------------------------- reading
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The layout stanza for /debug/memory and /internal/health."""
+        sparse = self._sparse_views()
+        sparse_bytes = sum(
+            int(t.get("bytes", 0))
+            for t in [LEDGER.totals().get("sparse_bank", {})])
+        with self._lock:
+            return {
+                "enabled": bool(self.enabled and HYBRID_LAYOUT_ENABLED),
+                "sparseViews": len(sparse),
+                "sparseBankBytes": sparse_bytes,
+                "relayoutRuns": self.relayout_runs,
+                "demotions": self.demotions,
+                "promotions": self.promotions,
+                "demoteFailures": self.demote_failures,
+                "bytesReclaimed": self.bytes_reclaimed,
+                "lastRunAt": self.last_run_at,
+                "lastDeltaBytes": self.last_delta_bytes,
+                "watermarkBytes": self.watermark_bytes,
+            }
+
+    def publish(self, stats: Optional[Any]) -> None:
+        """Scrape-time gauges (counters increment at event time, so
+        pilosa_layout_{demotions,promotions,relayout_runs}_total stay
+        true monotone counters)."""
+        if stats is None:
+            return
+        s = self.snapshot()
+        stats.gauge("layout.sparse_views", s["sparseViews"])
+        stats.gauge("layout.sparse_bank_bytes", s["sparseBankBytes"])
+        stats.gauge("layout.bytes_reclaimed", s["bytesReclaimed"])
+
+    # ----------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None or self.interval_s <= 0:
+            return
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.relayout_once()
+                except Exception:
+                    pass  # a bad pass must not end the layout plane
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="layout-relayout")
+        self._thread.start()
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.interval_s + 5)
+            self._thread = None

@@ -29,6 +29,11 @@ VIEW_BSI_PREFIX = "bsig_"
 # dominates on a tunnel-attached chip.
 SPARSE_UPLOAD = os.environ.get("PILOSA_TPU_SPARSE_UPLOAD", "1") != "0"
 
+# Demotion-ranked BankBudget eviction (hybrid layout satellite): under
+# HBM pressure the sparsest-coldest cached bank is evicted first
+# instead of the merely-oldest. 0 restores pure LRU.
+SMART_EVICT = os.environ.get("PILOSA_TPU_LAYOUT_EVICT", "1") != "0"
+
 _EXPAND_FN = None
 _EXPAND_SENTINEL = 0xFFFFFFFF
 
@@ -80,18 +85,27 @@ def _expand_sparse_chunk(pos16: np.ndarray, lens: np.ndarray,
 
 
 class BankBudget:
-    """Process-wide LRU accounting of cached device banks, bounding total
+    """Process-wide accounting of cached device banks, bounding total
     HBM spent on operand banks. The reference never needs this because it
     streams one shard at a time from mmap (executor.go:2377); here banks
     persist in HBM across queries for reuse, so an explicit budget decides
     what stays resident. Evicted banks drop out of their view's cache (the
-    device array frees once the last query referencing it drains)."""
+    device array frees once the last query referencing it drains).
+
+    Eviction is demotion-ranked, not pure LRU: under pressure the
+    victim is the entry with the highest workload-plane demotion score
+    ((1 - live density) * bytes / (1 + read rate), the same ranking
+    /debug/hotspots serves) so the sparsest-coldest bank goes first;
+    entries the ledger/workload plane cannot score fall back to score
+    0, and ties break LRU (oldest insertion wins) — a process with no
+    workload data evicts exactly as the old pure-LRU budget did.
+    PILOSA_TPU_LAYOUT_EVICT=0 restores pure LRU outright."""
 
     # Ledger categories a view registers its cached entries under; an
     # eviction must clear whichever one the key belongs to (keys are
     # disjoint across categories, and unregister is idempotent, so
-    # clearing all three is one cheap dict miss per non-owner).
-    LEDGER_CATEGORIES = ("bank", "pbank", "host_block")
+    # clearing each is one cheap dict miss per non-owner).
+    LEDGER_CATEGORIES = ("bank", "pbank", "sparse_bank", "host_block")
 
     def __init__(self, budget_bytes: int, cache_attr: str = "_bank_cache"):
         self.budget = budget_bytes
@@ -102,6 +116,36 @@ class BankBudget:
         self._entries: "OrderedDict" = OrderedDict()
         self.total = 0
         self.evictions = 0
+
+    def _eviction_scores(self):
+        """Demotion scores for the current entries (computed ONCE per
+        admit's eviction run, under the lock — scores cannot move
+        mid-admit while the lock is held). The scorer reads the memory
+        ledger + workload recorder — both leaf locks acquired strictly
+        after this one (BankBudget -> Ledger/Workload is the only
+        nesting direction, so the order graph stays acyclic under
+        PILOSA_TPU_LOCK_CHECK)."""
+        if not SMART_EVICT or len(self._entries) < 2:
+            return {}
+        try:
+            from pilosa_tpu.core.layout import demotion_scores
+            return demotion_scores(self._entries)
+        except Exception:
+            return {}
+
+    def _pick_victim(self, scores):
+        """Key of the entry to evict (called under the lock): highest
+        demotion score wins, ties (and unscorable entries) resolve to
+        the LRU-oldest."""
+        if scores:
+            best_ek, best = None, -1.0
+            for ek in self._entries:  # oldest first -> LRU ties
+                s = scores.get(ek, 0.0)
+                if s > best:
+                    best_ek, best = ek, s
+            if best_ek is not None and best > 0.0:
+                return best_ek
+        return next(iter(self._entries))
 
     def admit(self, view: "View", key, nbytes: Optional[int] = None
               ) -> None:
@@ -116,8 +160,13 @@ class BankBudget:
             old = self._entries.pop(ek, None)
             if old is not None:
                 self.total -= old[1]
+            scores = None
             while self._entries and self.total + nbytes > self.budget:
-                (vid, vkey), (v, nb) = self._entries.popitem(last=False)
+                if scores is None:
+                    scores = self._eviction_scores()
+                vid, vkey = self._pick_victim(scores)
+                scores.pop((vid, vkey), None)
+                v, nb = self._entries.pop((vid, vkey))
                 self.total -= nb
                 self.evictions += 1
                 getattr(v, self.cache_attr).pop(vkey, None)
@@ -208,6 +257,47 @@ class PositionsBank:
         self.nbytes = nbytes
 
 
+class SparseBank:
+    """First-class QUERY-SERVABLE sparse device bank (the hybrid
+    layout's compact representation): every row's SET bit positions as
+    one encoded uint32 array plus a per-row-slot offset table —
+    ~4 bytes per set bit instead of ``4 * width`` per row slot, which
+    is the shards-per-chip capacity win for sparse/cold views. This
+    generalizes :class:`PositionsBank` (a TopN-sweep special case)
+    into the executor's operand format: a Row leaf over a sparse-
+    resident view stages an ``("xslot", ...)`` IR node whose program
+    scatter-expands ``rows[slot]`` to the dense ``[S, W]`` register on
+    device (ops/megakernel.expand_positions) — bit-identical to the
+    dense bank row because expansion is exactly the inverse of the
+    positions gather.
+
+    Encoding: ``pos[k] = (shard_idx << 16) | bitpos`` (bitpos < 2^16
+    because sparse banks exist only for trimmed widths within one
+    container, the same constraint as Fragment.rows_positions);
+    ``starts`` has ``capacity + 1`` i32 offsets with rows beyond the
+    real set left empty, so absent rows resolve to the zero register
+    through ``zero_slot`` exactly like a dense bank's all-zero slot.
+    ``arrays`` is a stable ``(pos, starts)`` tuple — fusion groups and
+    the megakernel lowering key operand identity on it."""
+
+    __slots__ = ("arrays", "slots", "zero_slot", "versions", "nbytes",
+                 "width", "n_shards", "n_rows")
+
+    def __init__(self, arrays, slots, zero_slot, versions, nbytes,
+                 width, n_shards, n_rows):
+        self.arrays = arrays        # (pos u32 [Ppad], starts i32 [cap+1])
+        self.slots = slots          # row id -> slot
+        self.zero_slot = zero_slot
+        self.versions = versions    # {shard: fragment.version} at build
+        self.nbytes = nbytes
+        self.width = width          # the dense width expansion targets
+        self.n_shards = n_shards
+        self.n_rows = n_rows
+
+    def slot(self, row_id: int) -> int:
+        return self.slots.get(row_id, self.zero_slot)
+
+
 # Positions per device segment. The TopN kernel's cumsum array is
 # i32-indexed (x64 stays off), so segment position counts must stay
 # well under 2^31; the build enforces the cap EXACTLY by splitting
@@ -263,6 +353,16 @@ class View:
         self.fragments: Dict[int, Fragment] = {}
         self._lock = make_rlock("View._lock")
         self.on_new_shard = None  # callback(shard) for shard broadcasts
+        # Hybrid device layout (core/layout.py): "dense" serves Row
+        # leaves from ViewBanks, "sparse" from SparseBanks (set by the
+        # background re-layout pass or an operator). Planning snapshots
+        # the mode once per staged query; a flip mid-flight only
+        # changes which (correct) representation the NEXT staging
+        # picks, never the bits — cache safety needs no layout epoch
+        # because the two layouts compile under DISTINCT signatures
+        # (the x-vs-r sig parts + sparse expansion widths) and data
+        # validity is already guarded by the fragment versions.
+        self.layout_mode = "dense"
         self._bank_cache: Dict[tuple, ViewBank] = {}
         # Host-side packed blocks for transient row-subset banks (the
         # chunked-TopN stream): repeated sweeps over an unchanged
@@ -344,20 +444,52 @@ class View:
 
     # -- device bank --------------------------------------------------------
 
-    def _ledger_bank(self, cache_key, bank: "ViewBank",
-                     n_rows: int) -> None:
+    def _ledger_bank(self, cache_key, bank: "ViewBank", n_rows: int,
+                     live_density=None) -> None:
         """Register a cached dense bank with the HBM ledger: total vs
         pow2-pad bytes (capacity rows beyond n_rows + the zero slot),
-        tagged so /debug/memory's top-K names the occupant. Keyed
-        identically to the BankBudget entry, which unregisters it on
-        eviction."""
+        tagged so /debug/memory's top-K names the occupant, plus the
+        popcount-sampled TRUE live-bit density of the real rows (the
+        hotspots demotion quadrants' input — pow2-pad share alone
+        scores a full-width-but-sparse row as dense). Keyed identically
+        to the BankBudget entry, which unregisters it on eviction."""
         cap, s, w = (int(x) for x in bank.array.shape)
         row_bytes = s * w * 4
+        meta = dict(index=self.index, field=self.field, view=self.name,
+                    nShards=s, rows=n_rows)
+        if live_density is not None:
+            meta["liveDensity"] = round(float(live_density), 6)
         LEDGER.register(
             "bank", cache_key, cap * row_bytes,
             padded_bytes=max(0, cap - n_rows - 1) * row_bytes,
-            owner=self, index=self.index, field=self.field,
-            view=self.name, nShards=s, rows=n_rows)
+            owner=self, **meta)
+
+    # Rows popcount-sampled per bank build for the true-density meta:
+    # enough to place a bank in its density quadrant, cheap enough
+    # (storage count_range, no device work) to ride every build/patch.
+    DENSITY_SAMPLE_ROWS = 256
+
+    def _sampled_live_density(self, frags, row_set, width, shards):
+        """Fraction of the bank's REAL row slots' bits that are set,
+        estimated from an even sample of rows (popcount via the
+        fragments' storage count — host-side only). None when there is
+        nothing to sample."""
+        if not row_set or not shards or width <= 0:
+            return None
+        step = max(1, len(row_set) // self.DENSITY_SAMPLE_ROWS)
+        sample = row_set[::step][:self.DENSITY_SAMPLE_ROWS]
+        try:
+            bits = 0
+            for s in shards:
+                f = frags.get(s) if isinstance(frags, dict) else None
+                if f is None:
+                    continue
+                for r in sample:
+                    bits += f.row_count(r)
+            denom = len(sample) * len(shards) * width * 32
+            return min(1.0, bits / denom) if denom else None
+        except Exception:
+            return None  # density is telemetry; never fail a build
 
     # Word granularity of declared-bound trims: 128 u32 words = 4096
     # bits = one full VPU lane row, and exactly a Morgan fingerprint.
@@ -447,10 +579,19 @@ class View:
                     patched = self._patch_bank(cached, frags, versions,
                                                row_set, shards, width)
                     if patched is not None:
+                        # Patch path: carry the PRIOR density estimate
+                        # forward — a <=half-bank cell patch moves the
+                        # true density negligibly, and resampling here
+                        # would put 256 x nShards row popcounts on the
+                        # incremental fast path the patch exists for.
+                        prior = LEDGER.entry_info(
+                            ("bank",), (id(self), cache_key))
                         self._bank_cache[cache_key] = patched
                         BANK_BUDGET.touch(self, cache_key)
-                        self._ledger_bank(cache_key, patched,
-                                          len(row_set))
+                        self._ledger_bank(
+                            cache_key, patched, len(row_set),
+                            live_density=(prior or {}).get(
+                                "liveDensity"))
                         return patched
             else:
                 row_set = sorted(set(rows))
@@ -536,7 +677,10 @@ class View:
             if rows is None or cache_rows:
                 self._bank_cache[cache_key] = bank
                 BANK_BUDGET.admit(self, cache_key)
-                self._ledger_bank(cache_key, bank, len(row_set))
+                self._ledger_bank(
+                    cache_key, bank, len(row_set),
+                    live_density=self._sampled_live_density(
+                        frags, row_set, width, shards))
             return bank
 
     def _build_pbank_segments(self, frag, rows: list, width: int,
@@ -802,6 +946,136 @@ class View:
             nbytes += nb
             row_lo += n_rows
         return segments, nbytes
+
+    # -- hybrid layout (driven by core/layout.py) ----------------------------
+
+    def set_layout(self, mode: str) -> bool:
+        """Flip this view's serving layout ("dense" | "sparse").
+        Returns True when the mode actually changed. The flip drops
+        the OTHER representation's cached device banks so the HBM
+        frees immediately (the byte delta the re-layout pass proves
+        against the ledger); host blocks stay —
+        they are host RAM and make a later promotion re-upload instead
+        of re-gather. Data is never touched, so a stale *hit* is
+        impossible: a query staged before the flip keeps serving from
+        the representation it planned against, both of which hold the
+        same bits (pinned by tests/test_layout.py)."""
+        if mode not in ("dense", "sparse"):
+            raise ValueError(f"unknown layout mode {mode!r}")
+        with self._lock:
+            if self.layout_mode == mode:
+                return False
+            self.layout_mode = mode
+            drop = []
+            for key in list(self._bank_cache):
+                tagged = isinstance(key, tuple) and key \
+                    and isinstance(key[0], str)
+                sparse_key = tagged and key[0] == "sbank"
+                pbank_key = tagged and key[0] == "pbank"
+                if mode == "sparse" and not (sparse_key or pbank_key):
+                    drop.append(key)
+                elif mode == "dense" and sparse_key:
+                    drop.append(key)
+            for key in drop:
+                self._bank_cache.pop(key, None)
+                BANK_BUDGET.forget(self, key)
+        return True
+
+    def sparse_bank(self, shards) -> Optional["SparseBank"]:
+        """Device-resident :class:`SparseBank` over `shards` covering
+        every present row, or None when the layout does not qualify
+        (width spanning a full container — the u16 bitpos encoding
+        needs sub-container trim — or a genuinely dense view, where
+        ``rows_positions`` bails and dense banks win anyway). Cached
+        per (shard tuple, width) under the HBM budget with the same
+        stamp-then-read version discipline as ``device_bank``: a write
+        racing the build bumps a fragment version, the cached versions
+        read stale, and the next query rebuilds — spurious miss
+        allowed, stale hit never. A None return self-heals the layout
+        back to dense so staging stops asking."""
+        import jax.numpy as jnp
+
+        shards = tuple(shards)
+        with self._lock:
+            frags = {s: self.fragments.get(s) for s in shards}
+            versions = {s: (f.version if f else -1)
+                        for s, f in frags.items()}
+            width = self.trimmed_words()
+            if width * 32 > CONTAINER_BITS:
+                return None
+            key = ("sbank", shards, width)
+            cached = self._bank_cache.get(key)
+            if isinstance(cached, SparseBank) \
+                    and cached.versions == versions:
+                BANK_BUDGET.touch(self, key)
+                return cached
+            row_set = sorted({r for f in frags.values() if f
+                              for r in f.row_ids()})
+            n_rows = len(row_set)
+            per_shard = []
+            for si, s in enumerate(shards):
+                f = frags[s]
+                if f is None:
+                    per_shard.append((np.empty(0, np.uint32),
+                                      np.zeros(n_rows, np.int64)))
+                    continue
+                rp = f.rows_positions(row_set, width)
+                if rp is None:
+                    return None  # too dense for the sparse layout
+                pos16, lens, rows_at = rp
+                if len(rows_at) != n_rows:
+                    full = np.zeros(n_rows, np.int64)
+                    full[rows_at] = lens
+                    lens = full
+                per_shard.append(
+                    (pos16.astype(np.uint32) | np.uint32(si << 16),
+                     lens.astype(np.int64)))
+            cap = bank_capacity(n_rows)
+            if per_shard and n_rows:
+                lens_mat = np.stack([ls for _, ls in per_shard])
+            else:
+                lens_mat = np.zeros((len(shards), n_rows), np.int64)
+            row_tot = lens_mat.sum(axis=0)
+            total = int(row_tot.sum())
+            if total >= (1 << 31):
+                return None  # starts are i32; such a view is not sparse
+            starts = np.zeros(cap + 1, np.int64)
+            np.cumsum(row_tot, out=starts[1:n_rows + 1])
+            starts[n_rows + 1:] = starts[n_rows]
+            # Per-(row, shard) destination: row start + the exclusive
+            # prefix of earlier shards' lengths for that row, so each
+            # row's positions concatenate shard-ascending (the encoded
+            # shard index keeps them decodable either way).
+            prior = np.cumsum(lens_mat, axis=0) - lens_mat
+            p_pad = 1 << max(10, (total - 1).bit_length() if total
+                             else 0)
+            pos = np.zeros(p_pad, np.uint32)
+            for si, (enc, _ls) in enumerate(per_shard):
+                if not len(enc):
+                    continue
+                ls = lens_mat[si]
+                dst0 = starts[:n_rows] + prior[si]
+                within = np.arange(len(enc)) \
+                    - np.repeat(np.cumsum(ls) - ls, ls)
+                pos[np.repeat(dst0, ls) + within] = enc
+            starts32 = starts.astype(np.int32)
+            arrays = (jnp.asarray(pos), jnp.asarray(starts32))
+            nbytes = int(pos.nbytes + starts32.nbytes)
+            slots = {r: i for i, r in enumerate(row_set)}
+            bank = SparseBank(arrays, slots, cap - 1, versions, nbytes,
+                              width, len(shards), n_rows)
+            self._bank_cache[key] = bank
+            BANK_BUDGET.admit(self, key, nbytes=nbytes)
+            # Ideal footprint: 4 B per real position + one i32 offset
+            # per real row (+1); the rest is pow2 pos/row-capacity pad.
+            ideal = total * 4 + (n_rows + 1) * 4
+            LEDGER.register(
+                "sparse_bank", key, nbytes,
+                padded_bytes=max(0, nbytes - ideal), owner=self,
+                index=self.index, field=self.field, view=self.name,
+                nShards=len(shards), rows=n_rows, positions=total,
+                liveDensity=1.0, width=width)
+            return bank
 
     def _patch_bank(self, cached: "ViewBank", frags, versions, row_set,
                     shards, width):

@@ -411,6 +411,20 @@ class _Plan:
     # fusion path instead.
     ir: List[tuple] = dc_field(default_factory=list)
     ir_ok: bool = True
+    # Hybrid layout (core/layout.py): per bank key, whether its leaves
+    # serve from the view's SparseBank ("xslot" IR nodes + the
+    # expand_positions program) instead of the dense ViewBank. The
+    # decision snapshots the view's layout mode ONCE per key per plan
+    # (a background flip mid-staging cannot split one bank between two
+    # representations); force_dense carries keys whose sparse build
+    # bailed so the restage plans them dense.
+    bank_sparse: Dict[Tuple[str, str], bool] = \
+        dc_field(default_factory=dict)
+    force_dense: set = dc_field(default_factory=set)
+    # bank pos -> the built SparseBank's dense expansion width, filled
+    # by _stage_tree once banks exist (leaf closures read it at trace
+    # time, after staging resolved every width).
+    sparse_widths: Dict[int, int] = dc_field(default_factory=dict)
 
     def bank(self, key: Tuple[str, str]) -> int:
         pos = self.bank_pos.get(key)
@@ -1539,53 +1553,69 @@ class Executor:
         batch fusion pass groups on."""
         import jax.numpy as jnp
 
-        plan = _Plan()
-        expr = self._plan_call(idx, call, shards, plan)
-        cap = getattr(self._tls, "deps", None)
-        if cap is not None:
-            # Request-tier dependency capture, STAMP-THEN-READ: the
-            # version stamp is taken BEFORE the banks are fetched, so
-            # a write racing the build leaves the stored stamp behind
-            # the current one and the entry fails validation (a
-            # harmless spurious invalidation). Stamping after the read
-            # would let that race cache pre-write data under a
-            # post-write stamp — stale forever. First stamp wins
-            # across a multi-call query for the same reason. One stamp
-            # per operand VIEW (coarser than the per-shard bank
-            # versions — any write or new fragment anywhere in the
-            # view invalidates — which is exactly what makes it
-            # airtight: shard-restriction (_restrict_shards) and
-            # default-shard growth cannot leak a stale hit past it).
-            for key in plan.bank_keys:
-                dk = ("view", idx.name, key[0], key[1])
-                if dk not in cap:
-                    f = idx.field(key[0])
-                    view = f.view(key[1]) if f is not None else None
-                    cap[dk] = view.version_stamp() \
-                        if view is not None else ()
-            if plan.literals:
-                # Literal operand content is not named by the deps.
-                cap["uncacheable"] = True
-        banks = [self._get_bank(idx, key, shards,
-                                rows_needed=plan.rows_for.get(key))
-                 for key in plan.bank_keys]
+        from pilosa_tpu.core.view import SparseBank
+
+        # Hybrid layout restage loop: a sparse-planned key whose
+        # SparseBank build bails (the view densified since the layout
+        # decision) self-heals the view to dense and replans ONCE with
+        # that key forced dense — bounded by the key count, and in
+        # practice one extra host walk on a rare transition. The deps
+        # stamps inside the loop keep the STAMP-THEN-READ order (first
+        # stamp wins, so a restage cannot move a stamp past a read).
+        force_dense: set = set()
+        while True:
+            plan = _Plan()
+            plan.force_dense = force_dense
+            expr = self._plan_call(idx, call, shards, plan)
+            self._capture_deps(idx, plan)
+            known = len(force_dense)
+            banks, retry = self._stage_banks(idx, plan, shards,
+                                             force_dense)
+            if not retry:
+                break
+            if len(force_dense) == known:  # pragma: no cover
+                # Each retry forces one MORE key dense, so the loop is
+                # bounded by the plan's distinct sparse keys; a bail
+                # that adds nothing would mean _stage_banks broke that
+                # contract — fail loudly instead of spinning.
+                raise ExecutionError(
+                    "hybrid-layout staging failed to settle on a "
+                    "bank representation")
         for i, key, row in plan.slot_refs:
             plan.idxs[i] = banks[plan.bank_pos[key]].slot(row)
         # Width resolves AFTER banks are built: a write landing between
         # planning and bank build can widen a view, and the plan width
         # must cover every actual bank width or _align_words would slice
-        # off real set bits (plan-time widths alone are a TOCTOU).
-        plan.widths.extend(b.array.shape[-1] for b in banks)
+        # off real set bits (plan-time widths alone are a TOCTOU). A
+        # SparseBank's width is the dense width its rows expand to.
+        plan.widths.extend(
+            b.width if isinstance(b, SparseBank) else b.array.shape[-1]
+            for b in banks)
         plan.resolve_width()
-        bank_arrays = tuple(b.array for b in banks)
+        bank_arrays = tuple(
+            b.arrays if isinstance(b, SparseBank) else b.array
+            for b in banks)
         lits = None
         if plan.literals:
             lits = jnp.stack([_align_words(a, plan.width)
                               for a in plan.literals])
             if self.mesh is not None:
                 lits = self.mesh.put_row(lits)
+        # Sparse operands show as their (pos, starts) shape pair: a
+        # layout flip must land in a DIFFERENT signature (different
+        # program) even when the dense bank shape matches. Their dense
+        # EXPANSION widths are part of the signature too — the leaf
+        # closure bakes plan.sparse_widths[pos] as a trace constant,
+        # and a view widening can change the width while leaving every
+        # array SHAPE (pow2 pos pad, row capacity) and plan.width
+        # untouched, so without this a stale compiled program would
+        # silently drop the widened bits (dense leaves are covered
+        # because their bank width IS the array's last dim).
+        bshapes = [tuple(x.shape for x in a) if isinstance(a, tuple)
+                   else a.shape for a in bank_arrays]
+        xw = sorted(plan.sparse_widths.items())
         sig = (f"{mode}|{''.join(plan.sig_parts)}|W{plan.width}"
-               f"|B{[a.shape for a in bank_arrays]}"
+               f"|B{bshapes}{f'|XW{xw}' if xw else ''}"
                f"|L{None if lits is None else lits.shape}|S{len(shards)}")
         fp = gen = None
         if WORKLOAD.enabled or self.result_cache.enabled:
@@ -1617,6 +1647,70 @@ class Executor:
                            lits=lits, fp=fp, gen=gen,
                            cacheable=not plan.literals,
                            ir=tuple(plan.ir) if plan.ir_ok else None)
+
+    def _capture_deps(self, idx: Index, plan: _Plan) -> None:
+        """Request-tier dependency capture, STAMP-THEN-READ: the
+        version stamp is taken BEFORE the banks are fetched, so a
+        write racing the build leaves the stored stamp behind the
+        current one and the entry fails validation (a harmless
+        spurious invalidation). Stamping after the read would let that
+        race cache pre-write data under a post-write stamp — stale
+        forever. First stamp wins across a multi-call query (and
+        across hybrid-layout restages) for the same reason. One stamp
+        per operand VIEW (coarser than the per-shard bank versions —
+        any write or new fragment anywhere in the view invalidates —
+        which is exactly what makes it airtight: shard-restriction
+        (_restrict_shards) and default-shard growth cannot leak a
+        stale hit past it)."""
+        cap = getattr(self._tls, "deps", None)
+        if cap is None:
+            return
+        for key in plan.bank_keys:
+            dk = ("view", idx.name, key[0], key[1])
+            if dk not in cap:
+                f = idx.field(key[0])
+                view = f.view(key[1]) if f is not None else None
+                cap[dk] = view.version_stamp() \
+                    if view is not None else ()
+        if plan.literals:
+            # Literal operand content is not named by the deps.
+            cap["uncacheable"] = True
+
+    def _stage_banks(self, idx: Index, plan: _Plan, shards,
+                     force_dense: set):
+        """Build every operand bank the plan names — SparseBanks for
+        sparse-planned keys, dense (possibly row-subset) ViewBanks for
+        the rest. Returns (banks, retry): retry=True means a sparse
+        build bailed, the offending key is now in `force_dense`, and
+        the caller must replan."""
+        banks: List[Any] = []
+        for key in plan.bank_keys:
+            if plan.bank_sparse.get(key):
+                bank = self._get_sparse_bank(idx, key, shards)
+                if bank is None:
+                    force_dense.add(key)
+                    return banks, True
+                plan.sparse_widths[plan.bank_pos[key]] = bank.width
+                banks.append(bank)
+            else:
+                banks.append(self._get_bank(
+                    idx, key, shards,
+                    rows_needed=plan.rows_for.get(key)))
+        return banks, False
+
+    def _get_sparse_bank(self, idx: Index, key: Tuple[str, str],
+                         shards):
+        """The SparseBank operand for a sparse-planned leaf, or None
+        when the build bails (too dense / view gone) — in which case
+        the view self-heals to dense so staging stops asking."""
+        field = idx.field(key[0])
+        view = field.view(key[1]) if field is not None else None
+        if view is None:
+            return None
+        bank = view.sparse_bank(tuple(shards))
+        if bank is None:
+            view.set_layout("dense")
+        return bank
 
     def _tree_fn(self, staged: "_StagedEval") -> Tuple[Callable, bool]:
         """Compile phase: the jitted program for a staged eval, from
@@ -1792,18 +1886,56 @@ class Executor:
             return CONTAINER_BITS // 32
         return view.trimmed_words()
 
+    def _leaf_sparse(self, field: Field, view_name: str, key,
+                     plan: _Plan) -> bool:
+        """Hybrid-layout decision for one bank key: True when the
+        view's leaves serve from its SparseBank. Snapshot of the
+        view's layout mode — the plan's choice stays authoritative for
+        this staging even if the background pass flips the mode
+        mid-flight (both representations hold the same bits, so the
+        only cost of racing is which correct program compiles)."""
+        from pilosa_tpu.core import layout as layout_mod
+        from pilosa_tpu.core.fragment import CONTAINER_BITS
+        if not layout_mod.HYBRID_LAYOUT_ENABLED or self.mesh is not None:
+            return False
+        if key in plan.force_dense:
+            return False
+        view = field.view(view_name)
+        if view is None or view.layout_mode != "sparse":
+            return False
+        return view.trimmed_words() * 32 <= CONTAINER_BITS
+
     def _plan_slot_leaf(self, field: Field, view_name: str, row_id: int,
                         shards, plan: _Plan):
         """A single-row leaf: bank[slot] with the slot traced, padded to
         the plan width (banks are width-trimmed per view). The slot value
-        is a placeholder until _eval_tree builds the bank."""
+        is a placeholder until _eval_tree builds the bank. Over a
+        sparse-resident view (hybrid layout) the leaf instead stages an
+        "xslot": the program scatter-expands the SparseBank row to the
+        dense register on device (ops/megakernel.expand_positions) —
+        bit-identical to the dense gather, under a distinct signature
+        so the two layouts never share a compiled program or a cached
+        result entry."""
         key = (field.name, view_name)
         pos = plan.bank(key)
+        sparse = plan.bank_sparse.get(key)
+        if sparse is None:
+            sparse = self._leaf_sparse(field, view_name, key, plan)
+            plan.bank_sparse[key] = sparse
         plan.widths.append(self._view_width(field, view_name))
         i = len(plan.idxs)
         plan.idxs.append(0)
         plan.slot_refs.append((i, key, row_id))
         plan.rows_for.setdefault(key, set()).add(row_id)
+        if sparse:
+            from pilosa_tpu.ops.megakernel import expand_positions
+            plan.sig_parts.append(f"x{pos}")
+            plan.ir.append(("xslot", pos, i))
+            n_shards = len(shards)
+            return lambda b, idxs, p, l: _align_words(
+                expand_positions(b[pos][0], b[pos][1], idxs[i],
+                                 n_shards, plan.sparse_widths[pos]),
+                plan.width)
         plan.sig_parts.append(f"r{pos}")
         plan.ir.append(("slot", pos, i))
         return lambda b, idxs, p, l: _align_words(b[pos][idxs[i]],
@@ -1872,6 +2004,9 @@ class Executor:
         view_name = view_bsi_name(field.name)
         key = (field.name, view_name)
         pos = plan.bank(key)
+        # BSI plane banks stay dense: each leaf gathers depth+1 rows,
+        # which the hybrid layout's per-row expansion has no win on.
+        plan.bank_sparse.setdefault(key, False)
         plan.widths.append(self._view_width(field, view_name))
         i0 = len(plan.idxs)
         rows_set = plan.rows_for.setdefault(key, set())

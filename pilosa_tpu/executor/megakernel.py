@@ -240,22 +240,30 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
         if fn is None:
             ex._note_jit_compile()
             from pilosa_tpu.ops import pallas_kernels
+            # The Pallas instruction loop predates OP_EXPAND; a plan
+            # with sparse operands takes the jnp interpreter (the
+            # expansion itself is a pre-loop scatter either way).
             fn = jax.jit(mk.build_program(
                 n_shards, w_mega, plan.n_regs,
-                use_pallas=pallas_kernels.enabled()))
+                use_pallas=pallas_kernels.enabled()
+                and not plan.xslots))
             ex._jit_put(key, fn)
         # Plan buffers are per-launch data (the whole point: new mixed
         # composition, same compiled program) — upload them now and
-        # charge the bytes as this launch's plan-buffer H2D.
+        # charge the bytes as this launch's plan-buffer H2D. Sparse
+        # banks (plan.xbanks) are already device-resident pairs; only
+        # their slot lists upload.
         slots_dev = tuple(jnp.asarray(s) for s in plan.slots)
         widths_dev = jnp.asarray(plan.widths)
         instrs_dev = jnp.asarray(plan.instrs)
         out_count_dev = jnp.asarray(plan.out_count)
         out_row_dev = jnp.asarray(plan.out_row)
+        xslots_dev = tuple(jnp.asarray(s) for s in plan.xslots)
         plan_bytes = plan.plan_nbytes
         t0 = time.perf_counter()
         out = ex._call_program(fn, plan.banks, slots_dev, widths_dev,
-                               instrs_dev, out_count_dev, out_row_dev)
+                               instrs_dev, out_count_dev, out_row_dev,
+                               plan.xbanks, xslots_dev)
         dispatch_s = time.perf_counter() - t0
     except Exception as e:
         for g in cohort:
@@ -277,7 +285,8 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
                         else (e.n_shards, e.width))) * 4
             for g in cohort for e in g.entries)
         slab = mk.slab_nbytes(plan.n_regs, n_shards, w_mega)
-        live_slab = mk.slab_nbytes(plan.n_slots, n_shards, w_mega)
+        live_slab = mk.slab_nbytes(plan.n_slots + plan.n_xslots,
+                                   n_shards, w_mega)
         LEDGER.track(launch, "fusion_pad", lane_bytes,
                      padded_bytes=(slab - live_slab) + plan_bytes,
                      batch=n_entries, groups=len(cohort),

@@ -22,7 +22,14 @@ Execution model
   each owning entry's plan width (bit-identical to the unfused path's
   per-leaf ``_align_words``; zero-extension commutes with every opcode
   below, so pad words stay zero end to end).
-* Registers above ``n_slots`` are scratch, allocated by the lowering.
+* Registers ``n_slots..n_slots+n_xslots-1`` are *expand* registers
+  (hybrid layout): rows of device-resident sparse banks
+  (core/view.SparseBank — encoded set-bit positions instead of dense
+  words), scatter-expanded to dense ``[S, W]`` rows before the
+  instruction loop and importable into the dataflow ONLY through the
+  ``OP_EXPAND`` opcode (verify_plan's expand typing rule).
+* Registers above the gathered/expanded operands are scratch,
+  allocated by the lowering.
 * The plan buffer is an int32 ``[P, 4]`` array of ``(opcode, dst, a,
   b)`` rows; the interpreter fori-loops over it, ``lax.switch``-ing on
   the opcode. Instructions, slots, widths and output indices are all
@@ -63,8 +70,18 @@ OP_XOR = 2
 OP_ANDNOT = 3   # dst = a & ~b  (Difference, Not-via-existence)
 OP_ZERO = 4     # dst = 0
 OP_COPY = 5     # dst = a
+# Sparse-expand: dst = the dense [S, W] expansion of expand register
+# `a`. Expand registers (slab indices [n_slots, n_slots + n_xslots))
+# hold rows of device-resident SPARSE banks (core/view.SparseBank:
+# encoded bit positions, ~4 B/set bit) scatter-expanded by the
+# interpreter before the instruction loop. They are the hybrid
+# layout's typed boundary: only OP_EXPAND may read an expand register
+# — a bitwise opcode addressing one directly is a type error
+# (verify_plan), because the expansion (and its width mask) is what
+# makes the register bit-identical to the dense bank row it replaces.
+OP_EXPAND = 6   # dst = expanded(a); a must be an expand register
 
-OP_NAMES = ("and", "or", "xor", "andnot", "zero", "copy")
+OP_NAMES = ("and", "or", "xor", "andnot", "zero", "copy", "expand")
 
 _FOLD_OPS = {"and": OP_AND, "or": OP_OR, "xor": OP_XOR, "diff": OP_ANDNOT}
 
@@ -73,6 +90,39 @@ def pow2_at_least(n: int) -> int:
     """Smallest power of two >= max(n, 1) — the capacity buckets that
     keep the interpreter's compile cache O(log) in every axis."""
     return 1 << max(0, int(n) - 1).bit_length()
+
+
+def expand_positions(pos: Any, starts: Any, slot: Any, n_shards: int,
+                     width: int) -> Any:
+    """Dense ``[n_shards, width]`` uint32 row from a sparse bank's
+    encoded positions: ``pos`` carries ``(shard_idx << 16) | bitpos``
+    per SET bit (sorted per row; bitpos < 2^16 because sparse banks
+    only exist for trimmed widths within one container), ``starts`` is
+    the per-row-slot i32 offset table, ``slot`` the traced row slot.
+    The scatter uses add, which ORs because positions are unique per
+    (row, shard) — the same carry-free argument as
+    view._expand_sparse_chunk. Positions at/after ``width * 32`` (a
+    write widened the view after the bank was built) and the pos
+    buffer's pad tail both land on a scratch word past the row and add
+    zero, so the result is always exactly the masked dense row."""
+    import jax.numpy as jnp
+
+    lo = starts[slot]
+    hi = starts[slot + 1]
+    idx = jnp.arange(pos.shape[0], dtype=jnp.int32)
+    bitpos = (pos & jnp.uint32(0xFFFF)).astype(jnp.int32)
+    shard = (pos >> 16).astype(jnp.int32)
+    total = int(n_shards) * int(width)
+    sel = (idx >= lo) & (idx < hi) & (bitpos < width * 32) \
+        & (shard < n_shards)
+    word = jnp.where(sel, shard * width + (bitpos >> 5), total)
+    bit = jnp.where(sel,
+                    jnp.left_shift(jnp.uint32(1),
+                                   (pos & jnp.uint32(31))),
+                    jnp.uint32(0))
+    flat = jnp.zeros((total + 1,), jnp.uint32)
+    flat = flat.at[word].add(bit, mode="drop", unique_indices=False)
+    return flat[:total].reshape(n_shards, width)
 
 
 class Lowering:
@@ -97,6 +147,17 @@ class Lowering:
         # shared query row Q once, not once per candidate.
         self._slot_pos: Dict[Tuple[int, int, int],
                              Tuple[str, int, int]] = {}
+        # Sparse (hybrid-layout) operands: per sparse bank a
+        # (pos, starts) device pair plus its ordered slot list; expand
+        # registers are numbered after the dense slots in finish().
+        self.xbank_order: List[Any] = []     # (pos, starts) pairs
+        self.xbank_slots: List[List[int]] = []
+        self.xbank_widths: List[List[int]] = []
+        self._xbank_pos: Dict[int, int] = {}
+        # (xbank, slot, width) -> the SCRATCH token holding its
+        # OP_EXPAND result: entries sharing a sparse operand row share
+        # one expansion, not one per reference.
+        self._xslot_expanded: Dict[Tuple[int, int, int], int] = {}
         # token-space program; slot tokens are ("s", bank, k), scratch
         # tokens are plain ints counted from 0.
         self.instrs: List[Tuple[int, Token, Token, Token]] = []
@@ -142,6 +203,37 @@ class Lowering:
             self._slot_pos[key] = token
         return token
 
+    def _xbank(self, pair: Any) -> int:
+        pos = self._xbank_pos.get(id(pair))
+        if pos is None:
+            pos = len(self.xbank_order)
+            # graftlint: disable=GL008 — per-launch builder state.
+            self._xbank_pos[id(pair)] = pos
+            # graftlint: disable=GL008 — per-launch builder state.
+            self.xbank_order.append(pair)
+            # graftlint: disable=GL008 — per-launch builder state.
+            self.xbank_slots.append([])
+            # graftlint: disable=GL008 — per-launch builder state.
+            self.xbank_widths.append([])
+        return pos
+
+    def _xslot(self, pair: Any, slot: int, width: int) -> int:
+        """Sparse operand row: returns the scratch token holding its
+        OP_EXPAND result (one expand register + one expansion per
+        distinct (bank, slot, width), however many entries share it)."""
+        b = self._xbank(pair)
+        key = (b, int(slot), int(width))
+        token = self._xslot_expanded.get(key)
+        if token is None:
+            self.xbank_slots[b].append(int(slot))
+            self.xbank_widths[b].append(int(width))
+            xtok = ("x", b, len(self.xbank_slots[b]) - 1)
+            token = self._scratch()
+            self._emit(OP_EXPAND, token, xtok, xtok)
+            # graftlint: disable=GL008 — per-launch builder state.
+            self._xslot_expanded[key] = token
+        return token
+
     def _scratch(self) -> int:
         self.n_scratch += 1
         return self.n_scratch - 1
@@ -161,6 +253,13 @@ class Lowering:
             if kind == "slot":
                 _, pos, i = node
                 stack.append(self._slot(bank_arrays[pos], idxs[i], width))
+            elif kind == "xslot":
+                # Hybrid-layout sparse leaf: bank_arrays[pos] is the
+                # SparseBank's (pos, starts) device pair; the operand
+                # value is the scratch holding its OP_EXPAND result.
+                _, pos, i = node
+                stack.append(self._xslot(bank_arrays[pos], idxs[i],
+                                         width))
             elif kind == "zero":
                 r = self._scratch()
                 self._emit(OP_ZERO, r, r, r)
@@ -269,21 +368,31 @@ class Lowering:
 
     def finish(self) -> "Plan":
         """Resolve tokens to bank-grouped register numbers and pad every
-        axis to its pow2 capacity bucket."""
+        axis to its pow2 capacity bucket. Slab layout: dense slot
+        registers, then expand registers (sparse operands), then
+        scratch, then the pow2 pad with its spare register on top."""
         offsets: List[int] = []
         total = 0
         for slots in self.bank_slots:
             offsets.append(total)
             total += len(slots)
         n_slots = total
+        xoffsets: List[int] = []
+        xtotal = 0
+        for slots in self.xbank_slots:
+            xoffsets.append(xtotal)
+            xtotal += len(slots)
+        n_xslots = xtotal
 
         def reg(token: Any) -> int:
             if isinstance(token, tuple):
-                _, b, kth = token
+                kind, b, kth = token
+                if kind == "x":
+                    return n_slots + xoffsets[b] + kth
                 return offsets[b] + kth
-            return n_slots + int(token)
+            return n_slots + n_xslots + int(token)
 
-        n_regs = n_slots + self.n_scratch
+        n_regs = n_slots + n_xslots + self.n_scratch
         # +1 spare register: pad instructions and pad output lanes need
         # a dead destination that no real lane reads.
         t_pad = pow2_at_least(n_regs + 1)
@@ -294,6 +403,7 @@ class Lowering:
         n_instrs = len(instrs)
         instrs += [(OP_ZERO, spare, spare, spare)] * (p_pad - n_instrs)
         widths = [w for ws in self.bank_widths for w in ws]
+        widths += [w for ws in self.xbank_widths for w in ws]
         out_count = [reg(t) for t in self.out_count]
         out_row = [reg(t) for t in self.out_row]
         nc, nr = len(out_count), len(out_row)
@@ -302,13 +412,18 @@ class Lowering:
         return Plan(
             banks=tuple(self.bank_order),
             slots=tuple(np.asarray(s, np.int32) for s in self.bank_slots),
-            widths=np.asarray(widths + [0] * (t_pad - n_slots), np.int32),
+            widths=np.asarray(
+                widths + [0] * (t_pad - n_slots - n_xslots), np.int32),
             instrs=np.asarray(instrs, np.int32).reshape(p_pad, 4),
             out_count=np.asarray(out_count, np.int32),
             out_row=np.asarray(out_row, np.int32),
             n_slots=n_slots, n_regs=t_pad, n_instrs=n_instrs,
             lane_count_widths=tuple(self.out_count_widths),
-            lane_row_widths=tuple(self.out_row_widths))
+            lane_row_widths=tuple(self.out_row_widths),
+            xbanks=tuple(self.xbank_order),
+            xslots=tuple(np.asarray(s, np.int32)
+                         for s in self.xbank_slots),
+            n_xslots=n_xslots)
 
 
 class Plan:
@@ -317,7 +432,8 @@ class Plan:
 
     __slots__ = ("banks", "slots", "widths", "instrs", "out_count",
                  "out_row", "n_slots", "n_regs", "n_instrs",
-                 "lane_count_widths", "lane_row_widths")
+                 "lane_count_widths", "lane_row_widths",
+                 "xbanks", "xslots", "n_xslots")
 
     def __init__(self, banks: Tuple[Any, ...],
                  slots: Tuple[np.ndarray, ...], widths: np.ndarray,
@@ -325,7 +441,10 @@ class Plan:
                  out_row: np.ndarray, n_slots: int, n_regs: int,
                  n_instrs: int,
                  lane_count_widths: Tuple[int, ...] = (),
-                 lane_row_widths: Tuple[int, ...] = ()) -> None:
+                 lane_row_widths: Tuple[int, ...] = (),
+                 xbanks: Tuple[Any, ...] = (),
+                 xslots: Tuple[np.ndarray, ...] = (),
+                 n_xslots: int = 0) -> None:
         self.banks = banks
         self.slots = slots
         self.widths = widths
@@ -340,6 +459,13 @@ class Plan:
         # real lane counts (out_count/out_row are pow2-padded).
         self.lane_count_widths = lane_count_widths
         self.lane_row_widths = lane_row_widths
+        # Sparse (hybrid-layout) operands: per sparse bank a
+        # (pos, starts) device pair + its slot list; the expand
+        # registers live at slab indices [n_slots, n_slots + n_xslots)
+        # and are readable only through OP_EXPAND (verify_plan).
+        self.xbanks = xbanks
+        self.xslots = xslots
+        self.n_xslots = n_xslots
 
     @property
     def plan_nbytes(self) -> int:
@@ -348,7 +474,8 @@ class Plan:
         launches)."""
         return int(self.instrs.nbytes + self.widths.nbytes
                    + self.out_count.nbytes + self.out_row.nbytes
-                   + sum(int(s.nbytes) for s in self.slots))
+                   + sum(int(s.nbytes) for s in self.slots)
+                   + sum(int(s.nbytes) for s in self.xslots))
 
     def sig(self, n_shards: int, w_mega: int) -> str:
         """Compile-cache key: capacities + operand bank shapes + the
@@ -356,9 +483,12 @@ class Plan:
         specializes on, nothing else (instruction CONTENT is data)."""
         bshapes = [(tuple(getattr(a, "shape", ())), len(s))
                    for a, s in zip(self.banks, self.slots)]
+        xshapes = [(tuple(getattr(p, "shape", ()) for p in pair),
+                    len(s))
+                   for pair, s in zip(self.xbanks, self.xslots)]
         return (f"mega|S{n_shards}|W{w_mega}|T{self.n_regs}"
                 f"|P{self.instrs.shape[0]}|C{len(self.out_count)}"
-                f"|R{len(self.out_row)}|B{bshapes}")
+                f"|R{len(self.out_row)}|B{bshapes}|X{xshapes}")
 
 
 def slab_nbytes(n_regs: int, n_shards: int, w_mega: int) -> int:
@@ -415,9 +545,20 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
       table; a byte flip into lax.switch's clamp region would silently
       execute the wrong branch.
     * **Register bounds + slot protection** — dst/a/b address real
-      registers, and no instruction writes a slot register: gathered
-      operand rows are SHARED across entries (the Tanimoto query row),
-      so they are read-only by contract.
+      registers, and no instruction writes a slot OR expand register:
+      gathered operand rows are SHARED across entries (the Tanimoto
+      query row), so they are read-only by contract.
+    * **Expand typing (hybrid layout)** — expand registers (slab
+      indices ``[n_slots, n_slots + n_xslots)``) hold scatter-expanded
+      sparse-bank rows. ONLY ``OP_EXPAND`` may read one (a bitwise
+      opcode addressing one directly would bypass the expansion
+      contract), ``OP_EXPAND``'s ``a`` operand must BE one (expanding
+      a dense slot or scratch register is meaningless), its ``dst``
+      must be scratch, and the result's abstract span is the expand
+      register's declared width — sparse expansion enters the masking
+      lattice exactly where the dense row it replaces would. Sparse
+      slot indices must address real rows of their (pos, starts) pair
+      (``starts`` has rows + 1 entries).
     * **Def-before-use** — an operand a real instruction actually
       reads (per-opcode: ZERO reads nothing, COPY reads ``a``) is
       either a gathered slot or a scratch register some earlier
@@ -448,11 +589,14 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
     T = int(plan.n_regs)
     P = int(instrs.shape[0])
     n_slots = int(plan.n_slots)
+    n_xslots = int(getattr(plan, "n_xslots", 0))
+    n_gathered = n_slots + n_xslots
     n_instrs = int(plan.n_instrs)
-    if not _is_pow2(T) or T <= n_slots:
+    if not _is_pow2(T) or T <= n_gathered:
         raise PlanVerifyError(
             f"n_regs={T} must be a pow2 capacity > n_slots={n_slots} "
-            f"(the pad/spare register lives above the slots)")
+            f"+ n_xslots={n_xslots} (the pad/spare register lives "
+            f"above the gathered/expanded operands)")
     if not _is_pow2(P) or not 0 <= n_instrs <= P:
         raise PlanVerifyError(
             f"instr capacity P={P} must be pow2 >= n_instrs={n_instrs}")
@@ -463,6 +607,15 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
         raise PlanVerifyError(
             f"per-bank slot lists sum to "
             f"{sum(len(s) for s in plan.slots)} != n_slots={n_slots}")
+    if len(plan.xbanks) != len(plan.xslots):
+        raise PlanVerifyError(
+            f"{len(plan.xbanks)} sparse banks but {len(plan.xslots)} "
+            f"sparse slot lists")
+    if sum(len(s) for s in plan.xslots) != n_xslots:
+        raise PlanVerifyError(
+            f"per-sparse-bank slot lists sum to "
+            f"{sum(len(s) for s in plan.xslots)} != "
+            f"n_xslots={n_xslots}")
     if plan.widths.shape != (T,):
         raise PlanVerifyError(
             f"widths must be [n_regs]={T}, got {plan.widths.shape}")
@@ -493,16 +646,33 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
                 f"bank {b} carries {int(shape[1])} shards, launch "
                 f"expects {int(n_shards)}")
 
-    # Width masks: slot registers in [1, w_mega], pad registers 0.
+    # Sparse gather bounds: each sparse slot addresses a real row of
+    # its (pos, starts) pair (starts carries rows + 1 offsets).
+    for b, (pair, slots) in enumerate(zip(plan.xbanks, plan.xslots)):
+        starts = pair[1] if isinstance(pair, (tuple, list)) \
+            and len(pair) == 2 else None
+        sshape = getattr(starts, "shape", None)
+        if not isinstance(sshape, tuple) or not sshape:
+            continue  # opaque pair (tests stub them)
+        rows = int(sshape[0]) - 1
+        for j, s in enumerate(np.asarray(slots).tolist()):
+            if not 0 <= int(s) < rows:
+                raise PlanVerifyError(
+                    f"sparse bank {b} slot[{j}]={int(s)} outside its "
+                    f"{rows}-row starts table")
+
+    # Width masks: slot AND expand registers in [1, w_mega], pad
+    # registers 0.
     # graftlint: disable=GL003 — plan buffers are HOST numpy (built by
     # Lowering.finish, uploaded later); no device sync happens here.
     widths = plan.widths.tolist()
-    for k in range(n_slots):
+    for k in range(n_gathered):
         if not 1 <= int(widths[k]) <= int(w_mega):
+            kind = "slot" if k < n_slots else "expand"
             raise PlanVerifyError(
-                f"slot register {k} width {int(widths[k])} outside "
+                f"{kind} register {k} width {int(widths[k])} outside "
                 f"[1, w_mega={int(w_mega)}]")
-    for k in range(n_slots, T):
+    for k in range(n_gathered, T):
         if int(widths[k]) != 0:
             raise PlanVerifyError(
                 f"pad register {k} carries width {int(widths[k])} "
@@ -513,8 +683,9 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
     # span[r] = least upper bound on r's nonzero word span; None =
     # never written (reads of it are RAW violations even though the
     # machine would silently read zeros).
-    span: List[Optional[int]] = [int(widths[k]) for k in range(n_slots)]
-    span += [None] * (T - n_slots)
+    span: List[Optional[int]] = [int(widths[k])
+                                 for k in range(n_gathered)]
+    span += [None] * (T - n_gathered)
     # graftlint: disable=GL003 — host numpy plan buffer, as above.
     rows_list = instrs.tolist()
     for i in range(n_instrs):
@@ -528,18 +699,34 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
                 raise PlanVerifyError(
                     f"instr {i} ({OP_NAMES[op]}): {nm}={r} outside "
                     f"the {T}-register slab")
-        if dst < n_slots:
+        if dst < n_gathered:
+            kind = ("slot" if dst < n_slots else "expand")
             raise PlanVerifyError(
-                f"instr {i} ({OP_NAMES[op]}): writes slot register "
-                f"{dst} — gathered operand rows are shared across "
-                f"entries and read-only")
+                f"instr {i} ({OP_NAMES[op]}): writes {kind} register "
+                f"{dst} — gathered/expanded operand rows are shared "
+                f"across entries and read-only")
+        if op == OP_EXPAND:
+            # Expand typing: `a` must BE an expand register; the
+            # result enters the width lattice at that register's
+            # declared (masked) width.
+            if not n_slots <= a < n_gathered:
+                raise PlanVerifyError(
+                    f"instr {i} (expand): a={a} is not an expand "
+                    f"register (expected [{n_slots}, {n_gathered}))")
+            span[dst] = int(widths[a])
+            continue
         reads = []
         if op in _READS_A:
             reads.append(("a", a))
         if op in _READS_B:
             reads.append(("b", b))
         for nm, r in reads:
-            if r >= n_slots and span[r] is None:
+            if n_slots <= r < n_gathered:
+                raise PlanVerifyError(
+                    f"instr {i} ({OP_NAMES[op]}): reads expand "
+                    f"register {r} ({nm}) directly — sparse operands "
+                    f"are readable only through OP_EXPAND")
+            if r >= n_gathered and span[r] is None:
                 raise PlanVerifyError(
                     f"instr {i} ({OP_NAMES[op]}): reads scratch "
                     f"register {r} ({nm}) before any instruction "
@@ -575,6 +762,11 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
                     f"{T}-register slab")
         for j, w in enumerate(lane_widths):
             r = int(lanes[j])
+            if n_slots <= r < n_gathered:
+                raise PlanVerifyError(
+                    f"{mode} lane {j}: reads expand register {r} "
+                    f"directly — sparse operands are readable only "
+                    f"through OP_EXPAND")
             sv = span[r]
             if sv is None:
                 raise PlanVerifyError(
@@ -610,10 +802,10 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
                 raise PlanVerifyError(
                     f"pad instr {i}: {nm}={r} outside the "
                     f"{T}-register slab")
-        if dst < n_slots:
+        if dst < n_gathered:
             raise PlanVerifyError(
-                f"pad instr {i}: zeroes slot register {dst} — pads "
-                f"must write a dead register")
+                f"pad instr {i}: zeroes slot/expand register {dst} — "
+                f"pads must write a dead register")
         if dst in real_out:
             raise PlanVerifyError(
                 f"pad instr {i}: zeroes register {dst} that a real "
@@ -643,22 +835,34 @@ def build_program(n_shards: int, w_mega: int, t_pad: int,
         return rows
 
     def run(banks: Tuple[Any, ...], slots: Tuple[Any, ...], widths: Any,
-            instrs: Any, out_count: Any, out_row: Any) -> Tuple[Any, Any]:
+            instrs: Any, out_count: Any, out_row: Any,
+            xbanks: Tuple[Any, ...] = (),
+            xslots: Tuple[Any, ...] = ()) -> Tuple[Any, Any]:
         parts = [_fit(bank[sl]) for bank, sl in zip(banks, slots)]
+        # Expand registers: each sparse bank's referenced rows
+        # scatter-expand to dense [S, w_mega] rows (one vmapped
+        # expansion per bank), stacked into the slab right after the
+        # dense slots — OP_EXPAND instructions then import them into
+        # the dataflow at their masked widths.
+        for pair, sl in zip(xbanks, xslots):
+            pos, starts = pair
+            parts.append(jax.vmap(
+                lambda r, _p=pos, _s=starts: expand_positions(
+                    _p, _s, r, n_shards, w_mega))(sl))
         if parts:
             slab = jnp.concatenate(parts, axis=0)
         else:
             slab = jnp.zeros((0, n_shards, w_mega), jnp.uint32)
-        n_slots = slab.shape[0]
-        # Mask every gathered row down to its entry's plan width: ops
-        # below keep zero-extended words zero, so per-entry outputs
-        # sliced back to plan width are bit-identical to the unfused
-        # per-plan programs.
+        n_gathered = slab.shape[0]
+        # Mask every gathered/expanded row down to its entry's plan
+        # width: ops below keep zero-extended words zero, so per-entry
+        # outputs sliced back to plan width are bit-identical to the
+        # unfused per-plan programs.
         wmask = (jnp.arange(w_mega, dtype=jnp.int32)[None, :]
-                 < widths[:n_slots, None])
+                 < widths[:n_gathered, None])
         slab = jnp.where(wmask[:, None, :], slab, jnp.uint32(0))
         slab = jnp.concatenate(
-            [slab, jnp.zeros((t_pad - n_slots, n_shards, w_mega),
+            [slab, jnp.zeros((t_pad - n_gathered, n_shards, w_mega),
                              jnp.uint32)], axis=0)
 
         if use_pallas:
@@ -671,6 +875,11 @@ def build_program(n_shards: int, w_mega: int, t_pad: int,
                 lambda a, b: jnp.bitwise_xor(a, b),
                 lambda a, b: jnp.bitwise_and(a, jnp.bitwise_not(b)),
                 lambda a, b: jnp.zeros_like(a),
+                lambda a, b: a,
+                # OP_EXPAND: the expand register was materialized (and
+                # width-masked) above, so importing it is the identity
+                # on its value — the opcode's job is the TYPED
+                # boundary, enforced pre-launch by verify_plan.
                 lambda a, b: a,
             )
 

@@ -109,6 +109,13 @@ class API:
         # Always-on memory watchdog (utils/memledger.MemoryWatchdog),
         # attached by cli/main.py; the health plane reports its state.
         self.watchdog = None
+        # Adaptive hybrid bank layout (core/layout.py): the background
+        # re-layout pass. Constructed unconditionally (its counters
+        # and the layout stanza must exist even when the thread is
+        # off); cli/main.py configures thresholds and starts the loop.
+        from pilosa_tpu.core.layout import LayoutManager
+        self.layout = LayoutManager(holder, stats=self.stats,
+                                    logger=self.logger)
         self.cluster_executor = None
         self.syncer = None
         self.resize_puller = None
@@ -823,6 +830,9 @@ class API:
         rsnap = RANK_CACHE.snapshot()
         self.stats.gauge("rank_cache.entries", rsnap["entries"])
         self.stats.gauge("rank_cache.bytes", rsnap["bytes"])
+        # Hybrid-layout gauges (pilosa_layout_*): sparse-view count,
+        # resident sparse-bank bytes, cumulative reclaimed bytes.
+        self.layout.publish(self.stats)
         self.stats.gauge("executor.jit_cache_size",
                          self.executor.jit_cache_size())
 
@@ -833,7 +843,12 @@ class API:
         construction (pinned by test)."""
         from pilosa_tpu.utils.memledger import LEDGER
         self.refresh_memory_gauges()
-        return LEDGER.snapshot(top_k=top_k)
+        doc = LEDGER.snapshot(top_k=top_k)
+        # The hybrid-layout stanza rides the memory document (capacity
+        # is exactly what re-layout acts on); a separate key, so the
+        # totalBytes == sum(categories) invariant is untouched.
+        doc["layout"] = self.layout.snapshot()
+        return doc
 
     def debug_hotspots(self, top_k: Optional[int] = None
                        ) -> Dict[str, Any]:
@@ -1064,6 +1079,10 @@ class API:
                 "lastSampleAt": (wd.last_sample_at if wd is not None
                                  else None),
             },
+            # Adaptive hybrid layout (core/layout.py): how many views
+            # serve sparse, what re-layout reclaimed, when it last ran
+            # — the capacity axis in the same health document.
+            "layout": self.layout.snapshot(),
         }
 
     @staticmethod

@@ -133,6 +133,30 @@ class Config:
     # Live per-view rank vectors kept device-resident (HBM; category
     # "rank_cache"); each is 4 bytes/row.
     cache_rank_max_entries: int = 64
+    # Adaptive hybrid bank layout (core/layout.py): the background
+    # re-layout pass that demotes sparse/cold views to compact device
+    # SparseBanks and promotes them back when they heat up, driven by
+    # the hotspots demotion ranking under the memledger HBM watermark.
+    # TOML accepts a [layout] table (enabled / interval_s /
+    # demote_density / min_bytes / promote_rate) or the flat layout_*
+    # spelling; env uses PILOSA_TPU_LAYOUT_*. The blunt kill switch
+    # PILOSA_TPU_HYBRID_LAYOUT=0 overrides everything (no sparse
+    # planning, no re-layout — config can disable, never re-enable
+    # past it). interval_s = 0 disables only the background thread
+    # (manual relayout and sparse serving still work).
+    layout_enabled: bool = True
+    layout_interval_s: float = 30.0
+    # Banks whose live density (pad share x sampled live bits) falls
+    # below this demote even without HBM pressure; above the HBM
+    # watermark the ranking demotes top-down regardless.
+    layout_demote_density: float = 0.25
+    # Banks smaller than this never demote (the win wouldn't cover
+    # the bookkeeping).
+    layout_min_bytes: int = 1 << 20
+    # Sparse views whose decayed read rate climbs above this promote
+    # back to dense (and dense banks hotter than it resist demotion
+    # below the watermark).
+    layout_promote_rate: float = 0.5
     # Request-lifecycle timeline plane (utils/timeline.py): bounded
     # per-process ring of per-request stage timelines (queue -> coalesce
     # -> plan -> dispatch -> device -> materialize -> serialize) served
@@ -240,6 +264,15 @@ class Config:
             raise ValueError("cache result_max_bytes must be >= 0")
         if self.cache_rank_max_entries < 1:
             raise ValueError("cache rank_max_entries must be >= 1")
+        if self.layout_interval_s < 0:
+            raise ValueError("layout interval_s must be >= 0")
+        if not 0 <= self.layout_demote_density <= 1:
+            raise ValueError(
+                "layout demote_density must be in [0, 1]")
+        if self.layout_min_bytes < 0:
+            raise ValueError("layout min_bytes must be >= 0")
+        if self.layout_promote_rate < 0:
+            raise ValueError("layout promote_rate must be >= 0")
         if self.timeline_ring < 1 or self.timeline_sample_every < 1:
             raise ValueError(
                 "timeline ring/sample_every must be >= 1")

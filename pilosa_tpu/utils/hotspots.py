@@ -436,6 +436,21 @@ class WorkloadRecorder:
         out.sort(key=lambda d: (-d["readRate"], -d["reads"]))
         return out[:max(0, int(top))]
 
+    def view_read_rates(self) -> Dict[Tuple[str, str, str], float]:
+        """Summed decayed fragment read rate per (index, field, view)
+        — the access axis of the demotion ranking, shared by the bank
+        quadrants, the BankBudget eviction scorer and the hybrid-
+        layout re-layout pass (core/layout.py). One pass over the
+        tracked fragments under the leaf lock; host dict work only."""
+        now = self.clock()
+        hl = self.half_life_s
+        out: Dict[Tuple[str, str, str], float] = {}
+        with self._lock:
+            for fk, st in self._fragments.items():
+                key = (fk[0], fk[1], fk[2])
+                out[key] = out.get(key, 0.0) + st.reads.value(now, hl)
+        return out
+
     def summary(self) -> Dict[str, Any]:
         """The /internal/health workload stanza: cheap cumulative
         counters + the live repeat ratios."""
@@ -584,6 +599,17 @@ class WorkloadRecorder:
                 continue
             padded = int(e.get("paddedBytes", 0) or 0)
             density = max(0.0, 1.0 - padded / nbytes)
+            # True live-bit density when the bank build sampled one
+            # (popcount-based, core/view._sampled_live_density): the
+            # pad share only sees pow2 capacity slack, so a FULL-WIDTH
+            # row of mostly-zero words scored dense before this —
+            # exactly the rows the hybrid layout exists to demote.
+            live = e.get("liveDensity")
+            if live is not None:
+                try:
+                    density *= max(0.0, min(1.0, float(live)))
+                except (TypeError, ValueError):
+                    live = None
             key = (e.get("index", ""), e.get("field", ""),
                    e.get("view", ""))
             rate = rate_by_view.get(key, 0.0)
@@ -593,10 +619,11 @@ class WorkloadRecorder:
                 "index": key[0], "field": key[1], "view": key[2],
                 "category": e.get("category", "bank"),
                 "bytes": nbytes, "paddedBytes": padded,
-                "density": density, "readRate": rate,
+                "density": density, "liveDensity": live,
+                "readRate": rate,
                 "quadrant": quadrant,
-                # Sparse and cold banks demote first: padding waste
-                # scaled down by recent access.
+                # Sparse and cold banks demote first: padding + dead-
+                # bit waste scaled down by recent access.
                 "demotionScore": (1.0 - density) * nbytes
                 / (1.0 + rate),
             })

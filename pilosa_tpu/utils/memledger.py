@@ -244,6 +244,20 @@ class MemoryLedger:
                      "paddedBytes": e.padded, **e.meta}
                     for e in entries]
 
+    def entry_info(self, categories, key: Any) -> Optional[Dict[str, Any]]:
+        """Bytes/padding/meta of the live entry for `key` under any of
+        `categories`, or None. `key` is the already-SCOPED key ((id(
+        owner), key) for owned entries) — the BankBudget eviction
+        scorer holds exactly that form."""
+        self._drain_dead()
+        with self._lock:
+            for c in categories:
+                e = self._entries.get((c, key))
+                if e is not None:
+                    return {"category": c, "bytes": e.nbytes,
+                            "paddedBytes": e.padded, **e.meta}
+        return None
+
     def entries(self, *categories: str) -> List[Dict[str, Any]]:
         """Every live entry of the given categories, with bytes/padding
         and registration meta — the workload plane joins bank entries

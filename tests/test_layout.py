@@ -1,0 +1,531 @@
+"""Adaptive hybrid bank layout (core/layout.py, view.SparseBank, the
+megakernel OP_EXPAND path): bit-identity across layouts and paths,
+the re-layout pass's ledger-provable byte deltas, demotion-ranked
+BankBudget eviction, the true-live-density demotion quadrants, and
+the cache-interaction invariants (spurious miss allowed, stale hit
+never — the PR 10 epoch-guard pattern exercised by its third
+invalidation source)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import layout as layout_mod
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.core.layout import LayoutManager, demotion_scores
+from pilosa_tpu.core.view import BankBudget, SparseBank
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops import megakernel as mk
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+from pilosa_tpu.utils.hotspots import WORKLOAD
+from pilosa_tpu.utils.memledger import LEDGER
+
+
+@pytest.fixture(autouse=True)
+def _fresh_workload():
+    WORKLOAD.reset()
+    yield
+    WORKLOAD.reset()
+
+
+def _build(tmp, sparse_rows=600, seed=5, two_shards=True):
+    """Holder with a narrow sparse-eligible field "s" (many near-empty
+    rows), a dense field "f", and existence."""
+    h = Holder(tmp)
+    h.open()
+    idx = h.create_index("i")
+    rng = np.random.default_rng(seed)
+    s_rows = np.repeat(np.arange(sparse_rows, dtype=np.uint64), 2)
+    s_cols = rng.integers(0, 4096, 2 * sparse_rows).astype(np.uint64)
+    if two_shards:
+        # A second shard's worth of sparse bits for multi-shard plans.
+        half = len(s_cols) // 2
+        s_cols[half:] += SHARD_WIDTH
+    idx.create_field("s").import_bits(s_rows, s_cols)
+    f_rows = rng.integers(0, 8, 6000).astype(np.uint64)
+    f_cols = rng.integers(0, 2 * SHARD_WIDTH, 6000).astype(np.uint64)
+    idx.create_field("f").import_bits(f_rows, f_cols)
+    idx.add_existence(np.concatenate([s_cols, f_cols]))
+    return h, idx
+
+
+QUERIES = (
+    ["Count(Row(s={r}))".format(r=r) for r in range(6)]
+    + ["Row(s=2)", "Row(s=999)", "Count(Row(s=9999))",
+       "Count(Intersect(Row(s=1), Row(f=1)))",
+       "Count(Union(Row(s=2), Row(s=3), Row(f=2)))",
+       "Count(Difference(Row(f=3), Row(s=3)))",
+       "Count(Xor(Row(s=4), Row(f=4)))",
+       "Count(Not(Row(s=5)))"]
+)
+
+
+def _results(ex, queries):
+    out = []
+    for q in queries:
+        res = ex.execute("i", q)
+        out.append([r.columns() if hasattr(r, "columns") else r
+                    for r in res])
+    return repr(out)
+
+
+def test_sparse_layout_bit_identity_unfused_and_fused(tmp_path):
+    h, idx = _build(str(tmp_path))
+    try:
+        ex = Executor(h)
+        ex.result_cache.enabled = False
+        dense = _results(ex, QUERIES)
+        view = idx.field("s").view("standard")
+        assert view.set_layout("sparse")
+        assert _results(ex, QUERIES) == dense
+        # Fused (vmap) batch path, sparse operands stacked by idxs.
+        reqs = [("i", q, None) for q in QUERIES]
+        from pilosa_tpu.executor import megakernel as megamod
+        prev = megamod.MEGAKERNEL_ENABLED
+        try:
+            megamod.MEGAKERNEL_ENABLED = False
+            fused = ex.execute_batch_shaped(reqs)
+            view.set_layout("dense")
+            assert ex.execute_batch_shaped(reqs) == fused
+        finally:
+            megamod.MEGAKERNEL_ENABLED = prev
+    finally:
+        h.close()
+
+
+def test_megakernel_expand_launch_bit_identity(tmp_path):
+    h, idx = _build(str(tmp_path))
+    try:
+        ex = Executor(h)
+        ex.result_cache.enabled = False
+        idx.field("s").view("standard").set_layout("sparse")
+        reqs = [("i", q, None) for q in QUERIES]
+        from pilosa_tpu.executor import megakernel as megamod
+        prev = megamod.MEGAKERNEL_ENABLED
+        try:
+            megamod.MEGAKERNEL_ENABLED = True
+            on = ex.execute_batch_shaped(reqs)
+            assert ex.mega_launches >= 1
+            # Every launch passed the plan-IR gate (conftest pins
+            # PILOSA_TPU_PLAN_VERIFY=on), OP_EXPAND included.
+            assert ex.plan_verify_rejects == 0
+            assert ex.plan_verify_passes >= 1
+            megamod.MEGAKERNEL_ENABLED = False
+            off = ex.execute_batch_shaped(reqs)
+        finally:
+            megamod.MEGAKERNEL_ENABLED = prev
+        assert on == off
+    finally:
+        h.close()
+
+
+def test_sparse_bank_write_invalidation(tmp_path):
+    """Version discipline: a write after the sparse bank build makes
+    the cached bank read stale and rebuild — the new bit must appear
+    (spurious miss allowed, stale hit never)."""
+    h, idx = _build(str(tmp_path), two_shards=False)
+    try:
+        ex = Executor(h)
+        ex.result_cache.enabled = False
+        view = idx.field("s").view("standard")
+        view.set_layout("sparse")
+        before = ex.execute("i", "Count(Row(s=1))")[0]
+        idx.field("s").set_bit(1, 4000)
+        after = ex.execute("i", "Count(Row(s=1))")[0]
+        assert after == before + 1
+        idx.field("s").clear_bit(1, 4000)
+        assert ex.execute("i", "Count(Row(s=1))")[0] == before
+    finally:
+        h.close()
+
+
+def test_result_cache_no_stale_hit_across_relayout(tmp_path):
+    """Satellite: promote/demote between two identical queries with
+    the result cache ON — results bit-identical (relayout moves
+    representation, never data), and a write after the flip still
+    invalidates (the generation guard is layout-independent)."""
+    h, idx = _build(str(tmp_path), two_shards=False)
+    try:
+        ex = Executor(h)
+        assert ex.result_cache.enabled
+        view = idx.field("s").view("standard")
+        q = "Count(Row(s=3))"
+        r1 = ex.execute("i", q)[0]
+        view.set_layout("sparse")    # invalidation source #3
+        r2 = ex.execute("i", q)[0]
+        assert r2 == r1
+        idx.field("s").set_bit(3, 4001)
+        assert ex.execute("i", q)[0] == r1 + 1
+        view.set_layout("dense")
+        assert ex.execute("i", q)[0] == r1 + 1
+    finally:
+        h.close()
+
+
+def test_relayout_under_lock_check_subprocess(tmp_path):
+    """The satellite's LOCK_CHECK leg: demote/promote racing queries
+    under the runtime lock-order checker — no cycle in the acquisition
+    graph (BankBudget -> Ledger/Workload scoring included), results
+    bit-identical."""
+    script = r"""
+import os, tempfile, threading
+import numpy as np
+os.environ["JAX_PLATFORMS"] = "cpu"
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.utils.locks import lock_order_violations
+
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("i")
+    rows = np.repeat(np.arange(200, dtype=np.uint64), 2)
+    cols = np.random.default_rng(0).integers(0, 4096, 400).astype(np.uint64)
+    idx.create_field("s").import_bits(rows, cols)
+    idx.add_existence(cols)
+    ex = Executor(h)
+    view = idx.field("s").view("standard")
+    want = ex.execute("i", "Count(Row(s=1))")[0]
+    stop = threading.Event()
+    errs = []
+    def flipper():
+        m = 0
+        while not stop.is_set():
+            view.set_layout("sparse" if m % 2 == 0 else "dense")
+            m += 1
+    def querier():
+        try:
+            for _ in range(40):
+                got = ex.execute("i", "Count(Row(s=1))")[0]
+                assert got == want, (got, want)
+        except Exception as e:
+            errs.append(e)
+    t1 = threading.Thread(target=flipper)
+    qs = [threading.Thread(target=querier) for _ in range(3)]
+    t1.start(); [t.start() for t in qs]
+    [t.join() for t in qs]; stop.set(); t1.join()
+    assert not errs, errs
+    assert not lock_order_violations(), lock_order_violations()
+    h.close()
+print("LOCK_CHECK_OK")
+"""
+    env = dict(os.environ)
+    env["PILOSA_TPU_LOCK_CHECK"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "LOCK_CHECK_OK" in proc.stdout
+
+
+def test_relayout_pass_ledger_delta_and_promotion(tmp_path):
+    h, idx = _build(str(tmp_path), sparse_rows=1500)
+    try:
+        ex = Executor(h)
+        ex.result_cache.enabled = False
+        want = _results(ex, QUERIES[:4])
+        before = LEDGER.total_bytes(device_only=True)
+        assert before > 0
+        mgr = LayoutManager(h, min_bytes=1024)
+        WORKLOAD.reset()  # cold heat map: "s" demotes
+        summary = mgr.relayout_once()
+        assert summary["ran"] and summary["demoted"] >= 1, summary
+        assert summary["deltaBytes"] < 0, summary
+        snap = mgr.snapshot()
+        assert snap["demotions"] >= 1 and snap["sparseViews"] >= 1
+        assert snap["bytesReclaimed"] > 0
+        assert _results(ex, QUERIES[:4]) == want
+        # Heat the sparse view back up -> the next pass promotes.
+        for _ in range(30):
+            ex.execute("i", "Count(Row(s=1))")
+        s2 = mgr.relayout_once()
+        assert s2["promoted"] >= 1, s2
+        assert idx.field("s").view("standard").layout_mode == "dense"
+        assert _results(ex, QUERIES[:4]) == want
+    finally:
+        h.close()
+
+
+def test_demote_compacts_point_write_densified_storage(tmp_path):
+    """A view built from point Set()s (every written row's container
+    densified for mutation) must still demote: the pass runs
+    Fragment.optimize_storage (the Bitmap.optimize model) before the
+    positions gather — found by the live-server drive, pinned here."""
+    h = Holder(str(tmp_path))
+    h.open()
+    try:
+        idx = h.create_index("i")
+        f = idx.create_field("sp")
+        for r in range(300):
+            f.set_bit(r, (r * 7) % 4096)
+            f.set_bit(r, (r * 13) % 4096)
+        ex = Executor(h)
+        ex.result_cache.enabled = False
+        want = ex.execute("i", "Count(Row(sp=5))")[0]
+        ex.execute("i", "Count(Row(sp=1))")  # materialize the bank
+        WORKLOAD.reset()
+        mgr = LayoutManager(h, min_bytes=1024)
+        summary = mgr.relayout_once()
+        assert summary["demoted"] == 1, summary
+        assert mgr.demote_failures == 0
+        assert idx.field("sp").view("standard").layout_mode == "sparse"
+        assert ex.execute("i", "Count(Row(sp=5))")[0] == want
+        f.set_bit(5, 4000)
+        assert ex.execute("i", "Count(Row(sp=5))")[0] == want + 1
+    finally:
+        h.close()
+
+
+def test_kill_switch_disables_sparse_planning(tmp_path):
+    h, idx = _build(str(tmp_path), two_shards=False)
+    try:
+        ex = Executor(h)
+        ex.result_cache.enabled = False
+        view = idx.field("s").view("standard")
+        view.set_layout("sparse")
+        from pilosa_tpu.pql.parser import parse_string
+        call = parse_string("Row(s=1)").calls[0]
+        prev = layout_mod.HYBRID_LAYOUT_ENABLED
+        try:
+            layout_mod.HYBRID_LAYOUT_ENABLED = False
+            staged = ex._stage_tree(idx, call, [0], "row")
+            # Dense program: no (pos, starts) pairs among operands.
+            assert not any(isinstance(a, tuple)
+                           for a in staged.bank_arrays)
+            mgr = LayoutManager(h)
+            assert mgr.relayout_once() == {"ran": False,
+                                           "reason": "disabled"}
+        finally:
+            layout_mod.HYBRID_LAYOUT_ENABLED = prev
+        staged = ex._stage_tree(idx, call, [0], "row")
+        assert any(isinstance(a, tuple) for a in staged.bank_arrays)
+    finally:
+        h.close()
+
+
+def test_sparse_bank_too_dense_self_heals(tmp_path):
+    """A view marked sparse whose data is actually dense: the build
+    bails, the view self-heals to dense, and the query still answers
+    from the dense path."""
+    h = Holder(str(tmp_path))
+    h.open()
+    try:
+        idx = h.create_index("i")
+        f = idx.create_field("d")
+        rng = np.random.default_rng(2)
+        # A few rows with ~60% of a 4096-col window set: dense-encoded
+        # containers dominate and rows_positions bails.
+        for r in range(4):
+            cols = rng.choice(4096, size=2500,
+                              replace=False).astype(np.uint64)
+            f.import_bits(np.full(2500, r, np.uint64), cols)
+        idx.add_existence(np.arange(4096, dtype=np.uint64))
+        ex = Executor(h)
+        ex.result_cache.enabled = False
+        view = f.view("standard")
+        dense = ex.execute("i", "Count(Row(d=1))")[0]
+        view.set_layout("sparse")
+        assert ex.execute("i", "Count(Row(d=1))")[0] == dense
+        assert view.layout_mode == "dense"  # self-healed
+    finally:
+        h.close()
+
+
+# --------------------------------------------------------- verify_plan
+
+
+def _xpair(rows, positions=256):
+    return (np.zeros(positions, np.uint32),
+            np.zeros(rows + 1, np.int32))
+
+
+def test_verify_plan_expand_typing():
+    low = mk.Lowering()
+    xp = _xpair(16)
+    bank = np.zeros((8, 2, 8), np.uint32)
+    low.add_entry((("slot", 0, 0), ("xslot", 1, 1), ("fold", "and", 2)),
+                  [bank, xp], [1, 3], [], 8, "count")
+    plan = low.finish()
+    assert plan.n_xslots == 1
+    mk.verify_plan(plan, 2, 8)  # clean
+
+    # OP_EXPAND importing a non-expand register.
+    from tools.planverify import clone_plan
+    p = clone_plan(plan)
+    for i in range(p.n_instrs):
+        if int(p.instrs[i, 0]) == mk.OP_EXPAND:
+            p.instrs[i, 2] = 0  # dense slot
+            break
+    with pytest.raises(mk.PlanVerifyError, match="not an expand"):
+        mk.verify_plan(p, 2, 8)
+
+    # A bitwise opcode reading the expand register directly.
+    p = clone_plan(plan)
+    for i in range(p.n_instrs):
+        if int(p.instrs[i, 0]) == mk.OP_AND:
+            p.instrs[i, 2] = p.n_slots  # the expand register
+            break
+    with pytest.raises(mk.PlanVerifyError, match="only through"):
+        mk.verify_plan(p, 2, 8)
+
+    # Sparse gather index past the starts table.
+    p = clone_plan(plan)
+    p.xslots[0][0] = 99
+    with pytest.raises(mk.PlanVerifyError, match="starts table"):
+        mk.verify_plan(p, 2, 8)
+
+    # Writing an expand register.
+    p = clone_plan(plan)
+    p.instrs[0, 1] = p.n_slots
+    with pytest.raises(mk.PlanVerifyError, match="read-only"):
+        mk.verify_plan(p, 2, 8)
+
+
+def test_plan_mutations_cover_expand_kinds():
+    from tools.planverify import PLAN_MUTATIONS, mutate_plan
+    low = mk.Lowering()
+    xp = _xpair(16)
+    ir = (("xslot", 0, 0), ("xslot", 0, 1), ("fold", "or", 2))
+    low.add_entry(ir, [xp], [2, 5], [], 8, "count")
+    plan = low.finish()
+    mk.verify_plan(plan, 2, 8)
+    applied = 0
+    for ki, kind in enumerate(PLAN_MUTATIONS):
+        rng = np.random.default_rng([7, ki])
+        mutated = mutate_plan(rng, plan, kind, w_mega=8)
+        if mutated is None:
+            continue
+        applied += 1
+        with pytest.raises(mk.PlanVerifyError):
+            mk.verify_plan(mutated, 2, 8)
+    assert applied >= 8  # the expand kinds applied on this plan
+
+
+# ------------------------------------------------- eviction + density
+
+
+def test_bank_budget_evicts_sparsest_coldest_first(tmp_path):
+    """Pinning: under pressure the demotion-ranked victim (sparse,
+    cold) goes before an OLDER dense-hot bank — score beats LRU, and
+    LRU still breaks ties."""
+    h, idx = _build(str(tmp_path), sparse_rows=1200, two_shards=False)
+    try:
+        ex = Executor(h)
+        ex.result_cache.enabled = False
+        # Materialize both dense banks (ledger rows carry liveDensity).
+        ex.execute("i", "Count(Row(f=1))")
+        ex.execute("i", "Count(Row(s=1))")
+        fview = idx.field("f").view("standard")
+        sview = idx.field("s").view("standard")
+        fkey = next(k for k in fview._bank_cache)
+        skey = next(k for k in sview._bank_cache)
+        f_nb = LEDGER.entry_info(("bank",), (id(fview), fkey))["bytes"]
+        s_nb = LEDGER.entry_info(("bank",), (id(sview), skey))["bytes"]
+        # Keep "f" hot, "s" cold.
+        WORKLOAD.reset()
+        for _ in range(50):
+            WORKLOAD.record_read("i", "f", "standard", [0, 1])
+        scores = demotion_scores({(id(fview), fkey): (fview, f_nb),
+                                  (id(sview), skey): (sview, s_nb)})
+        assert scores[(id(sview), skey)] > scores[(id(fview), fkey)]
+        # HOT admitted FIRST (LRU would evict it); ranking must evict
+        # the sparse-cold bank instead.
+        budget = BankBudget(f_nb + s_nb)
+        budget.admit(fview, fkey, nbytes=f_nb)
+        budget.admit(sview, skey, nbytes=s_nb)
+        budget.admit(fview, ("trigger",), nbytes=16)
+        assert skey not in sview._bank_cache, "sparse-cold must evict"
+        assert fkey in fview._bank_cache, "dense-hot must survive"
+    finally:
+        h.close()
+
+
+def test_live_density_reaches_quadrants(tmp_path):
+    """A full-width-but-sparse bank scores demotable: its ledger row
+    carries the sampled live-bit density and the hotspots quadrant
+    density reflects it (pad share alone would call it dense)."""
+    h, idx = _build(str(tmp_path), sparse_rows=1024, two_shards=False)
+    try:
+        ex = Executor(h)
+        ex.result_cache.enabled = False
+        ex.execute("i", "Count(Row(s=1))")
+        entry = next(e for e in LEDGER.entries("bank")
+                     if e.get("field") == "s")
+        assert 0 < entry["liveDensity"] < 0.05, entry
+        # 1024 rows + zero slot pad to 2048 -> pad share alone says
+        # ~50% dense; the LIVE density must drag the quadrant down.
+        banks = WORKLOAD.snapshot(
+            top_k=10, bank_entries=[entry])["opportunity"]["banks"]
+        assert banks and banks[0]["quadrant"].startswith("sparse-")
+        assert banks[0]["density"] < 0.05
+        assert banks[0]["demotionScore"] > 0
+    finally:
+        h.close()
+
+
+# ------------------------------------------------ config + surfaces
+
+
+def test_config_layout_keys(tmp_path):
+    from pilosa_tpu.utils.config import load_config
+    p = tmp_path / "c.toml"
+    p.write_text("[layout]\nenabled = false\ninterval_s = 7.5\n"
+                 "demote_density = 0.1\nmin_bytes = 4096\n"
+                 "promote_rate = 2.0\n")
+    cfg = load_config(str(p))
+    assert cfg.layout_enabled is False
+    assert cfg.layout_interval_s == 7.5
+    assert cfg.layout_demote_density == 0.1
+    assert cfg.layout_min_bytes == 4096
+    assert cfg.layout_promote_rate == 2.0
+    p.write_text("layout_demote_density = 1.5\n")
+    with pytest.raises(ValueError):
+        load_config(str(p))
+
+
+def test_health_and_memory_layout_stanza(tmp_path):
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.utils.stats import MemStatsClient, prometheus_text
+    h, idx = _build(str(tmp_path), two_shards=False)
+    try:
+        api = API(h, stats=MemStatsClient())
+        api.query("i", "Count(Row(s=1))")
+        mem = api.debug_memory()
+        assert mem["totalBytes"] == sum(
+            c["bytes"] for c in mem["categories"].values())
+        assert "layout" in mem and "sparseViews" in mem["layout"]
+        health = api.node_health()
+        for k in ("enabled", "sparseViews", "demotions", "promotions",
+                  "relayoutRuns", "bytesReclaimed"):
+            assert k in health["layout"], health["layout"]
+        api.refresh_memory_gauges()
+        met = prometheus_text(api.stats)
+        assert "pilosa_layout_sparse_views" in met
+    finally:
+        h.close()
+
+
+def test_sparse_bank_structure(tmp_path):
+    h, idx = _build(str(tmp_path))
+    try:
+        view = idx.field("s").view("standard")
+        bank = view.sparse_bank((0, 1))
+        assert isinstance(bank, SparseBank)
+        pos, starts = bank.arrays
+        assert int(starts[-1]) == int(starts[bank.n_rows])
+        # Absent rows resolve to the empty zero slot.
+        z = bank.slot(10**6)
+        assert z == bank.zero_slot
+        s0, s1 = int(starts[z]), int(starts[z + 1])
+        assert s0 == s1
+        # Cached: same versions alias the same object.
+        assert view.sparse_bank((0, 1)) is bank
+        # Compact: resident bytes well under the dense equivalent.
+        from pilosa_tpu.core.view import bank_capacity
+        dense_bytes = (bank_capacity(bank.n_rows) * 2
+                       * view.trimmed_words() * 4)
+        assert bank.nbytes < dense_bytes / 10
+    finally:
+        h.close()

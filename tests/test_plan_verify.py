@@ -36,7 +36,14 @@ def ex(tmp_path):
     vcols = rng.integers(0, 2 * SHARD_WIDTH, 900).astype(np.uint64)
     idx.field("v").import_values(
         vcols, rng.integers(-500, 10000, 900).astype(np.int64))
-    idx.add_existence(cols)
+    # "s": a sparse-RESIDENT field (hybrid layout) so live plans carry
+    # OP_EXPAND and the expand mutation kinds apply.
+    s = idx.create_field("s")
+    srows = np.repeat(np.arange(300, dtype=np.uint64), 2)
+    scols = rng.integers(0, 4096, 600).astype(np.uint64)
+    s.import_bits(srows, scols)
+    assert s.view("standard").set_layout("sparse")
+    idx.add_existence(np.concatenate([cols, scols]))
     executor = Executor(h)
     executor.result_cache.enabled = False
     prev = megamod.MEGAKERNEL_ENABLED
@@ -167,7 +174,11 @@ def test_every_mutation_kind_rejected_on_live_plans(ex, monkeypatch):
     captured = capture_plans(monkeypatch)
     ex.execute_batch_shaped(MIXED)
     big = MIXED + [("i", "Count(Row(-100 < v < 500))", None),
-                   ("i", "Row(v <= 9000)", None)]
+                   ("i", "Row(v <= 9000)", None),
+                   # Sparse-resident operands: the OP_EXPAND path, so
+                   # the expand_* / xslot_row mutation kinds apply.
+                   ("i", "Count(Row(s=1))", None),
+                   ("i", "Count(Intersect(Row(s=2), Row(f=2)))", None)]
     ex.execute_batch_shaped(big)
     assert captured
     applied = set()

@@ -560,6 +560,13 @@ with tempfile.TemporaryDirectory() as d:
 print("timeline smoke OK")
 EOF
 
+step "hybrid-layout smoke (skewed corpus -> re-layout -> ledger delta + kill-switch identity)"
+# Cache off inside the tool (exact-path differential); plan
+# verification pinned ON so every sparse-expand launch also passes
+# the checked-IR contract (the OP_EXPAND typing rule).
+PILOSA_TPU_PLAN_VERIFY=on JAX_PLATFORMS=cpu \
+    python -m tools.layout_smoke || fail=1
+
 step "lock-order runtime check (PILOSA_TPU_LOCK_CHECK=1)"
 PILOSA_TPU_LOCK_CHECK=1 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_coalescer.py tests/test_concurrency.py \

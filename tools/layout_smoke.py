@@ -1,0 +1,110 @@
+"""Hybrid-layout smoke (tools/check.sh lane): build a skewed-density
+index, trigger the re-layout pass, and assert the three contract
+points end to end —
+
+1. **Ledger byte delta**: demotion drops resident bank bytes, the
+   SparseBank appears under its own category, and /debug/memory totals
+   stay provable (totalBytes == sum of category bytes).
+2. **Bit identity**: a 32-query burst (counts, rows, folds, Not) is
+   byte-identical across dense-before, sparse-after, and the
+   ``PILOSA_TPU_HYBRID_LAYOUT=0`` kill-switch regime.
+3. **Counters**: the layout stanza reports the demotion and the
+   ``pilosa_layout_*`` family exports.
+
+Exit status: 0 clean, 1 any assertion failed.
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+
+import numpy as np
+
+
+def main() -> int:
+    from pilosa_tpu.core import layout as layout_mod
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.server.api import API
+    from pilosa_tpu.utils.hotspots import WORKLOAD
+    from pilosa_tpu.utils.stats import MemStatsClient, prometheus_text
+
+    WORKLOAD.reset()
+    with tempfile.TemporaryDirectory() as d:
+        h = Holder(d)
+        h.open()
+        idx = h.create_index("smoke")
+        rng = np.random.default_rng(13)
+        # Skewed density: "cold" holds 3000 rows of ~2 set bits each
+        # (the demotion candidate), "hot" a handful of well-filled
+        # rows (must stay dense). Narrow column space keeps trimmed
+        # widths sparse-eligible.
+        cold_rows = np.repeat(np.arange(3000, dtype=np.uint64), 2)
+        cold_cols = rng.integers(0, 4096, 6000).astype(np.uint64)
+        idx.create_field("cold").import_bits(cold_rows, cold_cols)
+        hot_rows = rng.integers(0, 8, 20000).astype(np.uint64)
+        hot_cols = rng.integers(0, 4096, 20000).astype(np.uint64)
+        idx.create_field("hot").import_bits(hot_rows, hot_cols)
+        idx.add_existence(np.concatenate([cold_cols, hot_cols]))
+        api = API(h, stats=MemStatsClient())
+        ex = api.executor
+        ex.result_cache.enabled = False  # exact-path differential
+
+        burst = []
+        for k in range(32):
+            r = k % 8
+            burst.append(("smoke", [
+                f"Count(Row(cold={r}))",
+                f"Row(cold={r + 8})",
+                f"Count(Intersect(Row(cold={r}), Row(hot={r})))",
+                f"Count(Not(Row(cold={r})))",
+            ][(k // 8) % 4], None))
+
+        dense = ex.execute_batch_shaped(burst)
+        mem1 = api.debug_memory()
+        bank_before = mem1["categories"].get("bank", {}).get("bytes", 0)
+        assert bank_before > 0, mem1["categories"]
+
+        # Decay the burst's heat so "cold" reads as cold, then re-layout.
+        WORKLOAD.configure(half_life_s=0.001)
+        import time
+        time.sleep(0.05)
+        api.layout.configure(min_bytes=1024)
+        summary = api.layout.relayout_once()
+        WORKLOAD.configure(half_life_s=600.0)
+        assert summary["ran"] and summary["demoted"] >= 1, summary
+        assert summary["deltaBytes"] < 0, summary
+
+        mem2 = api.debug_memory()
+        assert mem2["totalBytes"] == sum(
+            c["bytes"] for c in mem2["categories"].values()), mem2
+        sparse_bytes = mem2["categories"].get(
+            "sparse_bank", {}).get("bytes", 0)
+        bank_after = mem2["categories"].get("bank", {}).get("bytes", 0)
+        assert sparse_bytes > 0, mem2["categories"]
+        assert bank_after < bank_before, (bank_before, bank_after)
+        assert mem2["layout"]["demotions"] >= 1, mem2["layout"]
+
+        sparse = ex.execute_batch_shaped(burst)
+        assert sparse == dense, "sparse-layout burst diverged from dense"
+
+        # Kill-switch regime: sparse planning off, same bits.
+        layout_mod.HYBRID_LAYOUT_ENABLED = False
+        try:
+            killed = ex.execute_batch_shaped(burst)
+        finally:
+            layout_mod.HYBRID_LAYOUT_ENABLED = True
+        assert killed == dense, "kill-switch burst diverged from dense"
+
+        met = prometheus_text(api.stats)
+        assert "pilosa_layout_demotions_total" in met, "no layout counters"
+        assert "pilosa_layout_sparse_views" in met, "no layout gauges"
+        h.close()
+    print("layout smoke OK: bank bytes %d -> %d (+%d sparse), "
+          "32-query burst bit-identical across dense/sparse/kill-switch"
+          % (bank_before, bank_after, sparse_bytes))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

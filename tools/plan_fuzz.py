@@ -11,8 +11,11 @@ fuzzer attacks the native parser:
   fanouts 2..4, nested), existence-Not, the full BSI comparison table
   across three int fields at boundary bit-depths (2, 14, 21 planes)
   with boundary predicate values, shared operand rows (the Tanimoto
-  probe shape, deduped to one slab register), absent rows, and batch
-  sizes crossing pow2 pad edges.
+  probe shape, deduped to one slab register), absent rows, batch
+  sizes crossing pow2 pad edges, and a SPARSE-resident field ("s",
+  hybrid layout: its standard view serves from a SparseBank through
+  the OP_EXPAND path) mixed freely into the same folds so sparse,
+  dense and BSI operands meet inside single plans.
 - **Three-way differential** — every generated batch runs through
   (a) the megakernel interpreter (``MEGAKERNEL_ENABLED=True``: one
   plan-buffer launch per cohort), (b) the per-group vmap fusion path
@@ -209,7 +212,9 @@ def render_query(mode: str, tree: Sequence[Any]) -> str:
 
 
 def _leaf_row(rng: np.random.Generator) -> List[Any]:
-    field = ("f", "g")[int(rng.integers(0, 2))]
+    # "s" is the SPARSE-resident field (hybrid layout): every case has
+    # a fair chance of mixing OP_EXPAND operands into its folds.
+    field = ("f", "g", "s")[int(rng.integers(0, 3))]
     row = ABSENT_ROW if rng.random() < 0.06 \
         else int(rng.integers(0, N_ROWS))
     return ["row", field, row]
@@ -324,6 +329,17 @@ class Harness:
             idx.create_field(field).import_bits(rows, cols)
             self.oracle.add_bits(field, rows, cols)
             all_cols.append(cols)
+        # "s": a narrow sparse field whose standard view is marked
+        # SPARSE (hybrid layout) — its Row leaves stage "xslot" IR and
+        # serve through OP_EXPAND, so every mixed case differentials
+        # the sparse path against vmap fusion and the numpy oracle.
+        rows = rng.integers(0, N_ROWS, 400).astype(np.uint64)
+        cols = rng.integers(0, 4096, 400).astype(np.uint64)
+        idx.create_field("s").import_bits(rows, cols)
+        self.oracle.add_bits("s", rows, cols)
+        all_cols.append(cols)
+        sview = idx.field("s").view("standard")
+        assert sview is not None and sview.set_layout("sparse")
         for field, (lo, hi) in sorted(BSI_FIELDS.items()):
             idx.create_field(field, FieldOptions(type="int", min=lo,
                                                  max=hi))

@@ -76,6 +76,9 @@ PLAN_MUTATIONS: Tuple[str, ...] = (
     "out_pad_alias", # real output lane aliased onto the pad register
     "width",         # slot width mask past the launch width
     "slot_row",      # gather index outside the operand bank
+    "expand_src",    # OP_EXPAND importing a non-expand register
+    "expand_read",   # bitwise opcode reading an expand reg directly
+    "xslot_row",     # sparse gather index outside its starts table
 )
 
 
@@ -92,7 +95,10 @@ def clone_plan(plan: mk.Plan) -> mk.Plan:
         n_slots=plan.n_slots, n_regs=plan.n_regs,
         n_instrs=plan.n_instrs,
         lane_count_widths=plan.lane_count_widths,
-        lane_row_widths=plan.lane_row_widths)
+        lane_row_widths=plan.lane_row_widths,
+        xbanks=plan.xbanks,
+        xslots=tuple(s.copy() for s in plan.xslots),
+        n_xslots=plan.n_xslots)
 
 
 def _real_reading_instrs(plan: mk.Plan) -> List[int]:
@@ -123,10 +129,12 @@ def mutate_plan(rng: np.random.Generator, plan: mk.Plan,
     nc = len(p.lane_count_widths)
     nr = len(p.lane_row_widths)
     if kind == "opcode":
+        # 6 is OP_EXPAND (a REAL opcode since the hybrid layout):
+        # corruption values start past the table's end.
         if p.n_instrs < 1:
             return None
         i = int(rng.integers(0, p.n_instrs))
-        p.instrs[i, 0] = int(rng.choice([6, 7, 42, 127, -1]))
+        p.instrs[i, 0] = int(rng.choice([7, 9, 42, 127, -1]))
         return p
     if kind == "dst_slot":
         if p.n_instrs < 1 or p.n_slots < 1:
@@ -191,6 +199,43 @@ def mutate_plan(rng: np.random.Generator, plan: mk.Plan,
                 p.slots[b][j] = int(shape[0]) + 1 + int(rng.integers(0, 5))
                 return p
         return None
+    if kind == "expand_src":
+        # An OP_EXPAND importing a NON-expand register (the spare
+        # scratch, or a dense slot): the expand typing rule must fire.
+        cands = [i for i in range(p.n_instrs)
+                 if int(p.instrs[i, 0]) == mk.OP_EXPAND]
+        if not cands:
+            return None
+        i = cands[int(rng.integers(0, len(cands)))]
+        bad = 0 if p.n_slots and rng.random() < 0.5 else spare
+        p.instrs[i, 2] = int(bad)
+        return p
+    if kind == "expand_read":
+        # A bitwise opcode reading an expand register directly —
+        # bypassing the OP_EXPAND boundary is a type error even though
+        # the machine would read a materialized value.
+        if p.n_xslots < 1:
+            return None
+        cands = [i for i in range(p.n_instrs)
+                 if int(p.instrs[i, 0]) not in (mk.OP_ZERO,
+                                                mk.OP_EXPAND)]
+        if not cands:
+            return None
+        i = cands[int(rng.integers(0, len(cands)))]
+        op = int(p.instrs[i, 0])
+        col = 3 if op in mk._READS_B and rng.random() < 0.5 else 2
+        p.instrs[i, col] = p.n_slots + int(rng.integers(0, p.n_xslots))
+        return p
+    if kind == "xslot_row":
+        for b, (pair, slots) in enumerate(zip(p.xbanks, p.xslots)):
+            starts = pair[1] if isinstance(pair, (tuple, list)) \
+                and len(pair) == 2 else None
+            sshape = getattr(starts, "shape", None)
+            if isinstance(sshape, tuple) and sshape and len(slots):
+                j = int(rng.integers(0, len(slots)))
+                p.xslots[b][j] = int(sshape[0]) + int(rng.integers(0, 5))
+                return p
+        return None
     raise ValueError(f"unknown mutation kind {kind!r}")
 
 
@@ -203,6 +248,13 @@ _BANK_ROWS = 70  # covers depth-63 BSI planes + a not-null plane
 def _bank(w: int) -> np.ndarray:
     """A shape-carrying operand bank (contents never read host-side)."""
     return np.zeros((_BANK_ROWS, _N_SHARDS, w), np.uint32)
+
+
+def _xpair(rows: int, positions: int = 1024):
+    """A shape-carrying sparse (pos, starts) pair (the hybrid layout's
+    SparseBank.arrays form; contents never read host-side)."""
+    return (np.zeros(positions, np.uint32),
+            np.zeros(rows + 1, np.int32))
 
 
 def _limbs(value: int) -> List[int]:
@@ -300,6 +352,31 @@ def synthetic_plans() -> List[Tuple[str, mk.Plan, int, int]]:
                   [b8], list(range(8)), _limbs(99), 8, "count")
     low.add_entry((("zero",),), [b8], [], [], 8, "row")
     finish("mixed-heterogeneous", low, 8)
+
+    # Sparse-expand plans (hybrid layout, OP_EXPAND): pure sparse
+    # lanes in both modes, shared sparse operands deduped to one
+    # expand register, and a mixed dense+sparse fold.
+    low = mk.Lowering()
+    xp = _xpair(16)
+    low.add_entry((("xslot", 0, 0),), [xp], [3], [], 8, "count")
+    low.add_entry((("xslot", 0, 0),), [xp], [5], [], 8, "row")
+    finish("expand-lanes", low, 8)
+
+    low = mk.Lowering()
+    xp = _xpair(16)
+    ir = (("xslot", 0, 0), ("xslot", 0, 1), ("fold", "and", 2))
+    for c in (1, 2, 4, 8):
+        low.add_entry(ir, [xp], [0, c], [], 8, "count")
+    finish("expand-shared-operand", low, 8)
+
+    low = mk.Lowering()
+    bank, xp = _bank(8), _xpair(16)
+    low.add_entry((("slot", 0, 0), ("xslot", 1, 1), ("fold", "or", 2)),
+                  [bank, xp], [2, 7], [], 8, "count")
+    low.add_entry((("xslot", 1, 0), ("slot", 0, 1),
+                   ("fold", "diff", 2)),
+                  [bank, xp], [9, 3], [], 8, "row")
+    finish("expand-mixed-dense", low, 8)
 
     return out
 
