@@ -157,6 +157,37 @@ def cmd_server(args) -> int:
     api.logger = logger
     api.long_query_time = cfg.long_query_time
     api.executor.max_writes_per_request = cfg.max_writes_per_request
+    # Fan-out resilience ([cluster] keys): per-request deadline budget,
+    # failover backoff, hedged reads, and the three RPC-timeout classes
+    # that used to be hard-coded client literals.
+    if api.cluster_executor is not None:
+        api.cluster_executor.configure(
+            fanout_deadline_s=cfg.cluster_fanout_deadline_s,
+            backoff_base_s=cfg.cluster_backoff_base_s,
+            backoff_cap_s=cfg.cluster_backoff_cap_s,
+            hedge_quantile=cfg.cluster_hedge_quantile)
+        api._client.configure(
+            timeout=cfg.cluster_rpc_timeout_s,
+            health_timeout=cfg.cluster_health_timeout_s,
+            resize_pull_timeout=cfg.cluster_resize_pull_timeout_s)
+    # Fault-injection plane (utils/failpoints.py): arm configured
+    # sites and enable the test-only /internal/failpoints surface.
+    # Env entries were already merged into cfg.failpoints by
+    # load_config (env="" skips a second parse). Production servers
+    # with no failpoint config never enable any of this.
+    if cfg.failpoints:
+        from pilosa_tpu.utils.failpoints import FAILPOINTS
+        FAILPOINTS.configure(cfg.failpoints, env="")
+        FAILPOINTS.http_enabled = True
+        logger.printf("failpoints ARMED (test-only surface enabled): %s",
+                      ", ".join(f"{k}={v}"
+                                for k, v in sorted(cfg.failpoints.items())))
+    elif os.environ.get("PILOSA_TPU_FAILPOINTS_HTTP", "") in ("1", "true"):
+        # Chaos harnesses that arm everything over HTTP at runtime
+        # (tools/chaos.py) enable the surface without arming anything.
+        from pilosa_tpu.utils.failpoints import FAILPOINTS
+        FAILPOINTS.http_enabled = True
+        logger.printf("failpoints surface enabled (nothing armed)")
     # Query profiler policy: device-fence 1-in-N unforced queries and
     # bound the /debug/queries slow-query ring (utils/profile.py;
     # ?profile=true always fences regardless of sample_every).

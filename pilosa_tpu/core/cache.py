@@ -290,6 +290,9 @@ class RankCacheStore:
         self._entries: "OrderedDict[tuple, Tuple[Any, RankEntry]]" = \
             OrderedDict()
         self.evictions = 0
+        # Entries dropped because a resize moved shard ownership
+        # (server/api.py _note_placement_change).
+        self.placement_invalidations = 0
 
     def configure(self, enabled: Optional[bool] = None,
                   max_entries: Optional[int] = None) -> None:
@@ -349,6 +352,31 @@ class RankCacheStore:
                 self._entries.pop(ek, None)
                 LEDGER.unregister("rank_cache", ek[1], owner=v)
 
+    def invalidate_shards(self, moved: Any) -> int:
+        """Drop entries whose count vector covers a shard whose owner
+        set changed in a resize (`moved`: set of ``(index, shard)``
+        pairs). The per-shard version stamps already refuse a stale
+        reuse; this reclaims the HBM at the placement transition and
+        makes the drop observable (placement_invalidations). Returns
+        the number of entries dropped."""
+        if not moved:
+            return 0
+        from pilosa_tpu.utils.memledger import LEDGER
+        by_index: Dict[str, set] = {}
+        for iname, shard in moved:
+            by_index.setdefault(str(iname), set()).add(int(shard))
+        with self._lock:
+            dead = []
+            for ek, (v, e) in self._entries.items():
+                shs = by_index.get(str(getattr(v, "index", "")))
+                if shs and shs & {int(s) for s in e.versions}:
+                    dead.append(ek)
+            for ek in dead:
+                v, _e = self._entries.pop(ek)
+                LEDGER.unregister("rank_cache", ek[1], owner=v)
+            self.placement_invalidations += len(dead)
+            return len(dead)
+
     def nbytes(self) -> int:
         with self._lock:
             return sum(e.nbytes for _, e in self._entries.values())
@@ -365,6 +393,7 @@ class RankCacheStore:
                              for _, e in self._entries.values()),
                 "maxEntries": self.max_entries,
                 "evictions": self.evictions,
+                "placementInvalidations": self.placement_invalidations,
             }
 
 

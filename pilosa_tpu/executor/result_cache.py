@@ -113,6 +113,9 @@ class ResultCache:
         self.misses: Dict[str, int] = {t: 0 for t in TIERS}
         self.evictions = 0
         self.invalidations = 0  # stale entries dropped on lookup
+        # Entries dropped because a resize moved their shards' ownership
+        # (API._note_placement_change — the placement epoch guard).
+        self.placement_invalidations = 0
         # Optional utils/stats sink (attached by the API layer, the
         # WORKLOAD.stats convention) so /metrics counters increment at
         # event time and stay true monotone counters.
@@ -250,6 +253,35 @@ class ResultCache:
             self.bytes = 0
             self._ledger()
 
+    def invalidate_placement(self, moved: Any) -> int:
+        """Drop eval-tier entries whose shard tuple intersects `moved`
+        (a set of ``(index, shard)`` pairs whose owner set changed in a
+        resize — API._moved_shards). The generation stamps already make
+        a stale HIT impossible; this makes the dead bytes provably gone
+        at the placement transition instead of lingering until an LRU
+        eviction. Request-tier entries are untouched: that tier only
+        fills on non-clustered deployments (no placement to move).
+        Returns the number of entries dropped."""
+        if not moved:
+            return 0
+        moved = {(str(i), int(s)) for i, s in moved}
+        with self._lock:
+            dead = []
+            for key, e in self._entries.items():
+                if not (isinstance(key, tuple) and len(key) >= 4
+                        and key[0] == "eval"):
+                    continue
+                iname, shard_tuple = key[1], key[3]
+                if any((iname, int(s)) in moved for s in shard_tuple):
+                    dead.append((key, e))
+            for key, e in dead:
+                self._drop_locked(key, e)
+            if dead:
+                self.placement_invalidations += len(dead)
+                self.invalidations += len(dead)
+                self._ledger()
+            return len(dead)
+
     # ------------------------------------------------------- reporting
 
     def __len__(self) -> int:
@@ -274,6 +306,7 @@ class ResultCache:
                 "hitRatio": (h / (h + m)) if (h + m) else 0.0,
                 "evictions": self.evictions,
                 "invalidations": self.invalidations,
+                "placementInvalidations": self.placement_invalidations,
                 "tiers": {t: {"hits": hits[t], "misses": misses[t]}
                           for t in TIERS},
             }

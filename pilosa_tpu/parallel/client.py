@@ -11,11 +11,23 @@ from __future__ import annotations
 
 import http.client
 import json
+from pilosa_tpu.utils.failpoints import (
+    FAILPOINTS, FailpointDrop, FailpointError,
+)
 from pilosa_tpu.utils.locks import make_lock
 from typing import Any, Dict, List, Optional
 from urllib.parse import urlsplit
 
 from pilosa_tpu.server import wire
+
+# Fault-injection sites on the four ways an internal RPC actually fails
+# in production (utils/failpoints.py catalog): connect refused /
+# partitioned, mid-flight connection loss, a 5xx answer, and a torn
+# response body (the one that parses into a NON-ClientError).
+_FP_CONNECT = FAILPOINTS.register("client.connect")
+_FP_READ = FAILPOINTS.register("client.read")
+_FP_5XX = FAILPOINTS.register("client.5xx")
+_FP_TORN = FAILPOINTS.register("client.torn_body")
 
 
 class ClientError(RuntimeError):
@@ -67,17 +79,36 @@ class _ConnPool:
         raw.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
         return conn
 
-    def get(self, scheme: str, host: str, port: int):
+    def get(self, scheme: str, host: str, port: int,
+            timeout: Optional[float] = None):
         """-> (connection, reused): reused=True means it came from the
-        idle pool and may have been closed server-side while idle."""
+        idle pool and may have been closed server-side while idle.
+        `timeout` overrides the socket timeout for THIS request only —
+        the connection still pools (put() restores the default), so a
+        per-request deadline no longer costs a TCP(+TLS) handshake the
+        way the old dedicated-connection path did."""
         with self._lock:
             idle = self._idle.get((scheme, host, port))
-            if idle:
-                return idle.pop(), True
-        return self._new_conn(scheme, host, port, self.timeout), False
+            conn = idle.pop() if idle else None
+        if conn is not None:
+            if timeout is not None:
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
+            return conn, True
+        return self._new_conn(scheme, host, port,
+                              self.timeout if timeout is None
+                              else timeout), False
 
     def put(self, scheme: str, host: str, port: int,
             conn: http.client.HTTPConnection) -> None:
+        if conn.timeout != self.timeout:
+            # Restore the pool default before the conn serves another
+            # request (a short health-probe timeout must not leak onto
+            # the next 30 s query leg, nor vice versa).
+            conn.timeout = self.timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(self.timeout)
         with self._lock:
             idle = self._idle.setdefault((scheme, host, port), [])
             if len(idle) < self.MAX_IDLE_PER_HOST:
@@ -94,15 +125,39 @@ class _ConnPool:
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0, tracer=None,
-                 ssl_context=None):
+    # Class-level defaults for the three RPC classes (overridden per
+    # instance by the [cluster] config keys — cli/main.py wiring). The
+    # old scattered 5 s / 30 s / 600 s literals all resolve here now.
+    DEFAULT_TIMEOUT = 30.0       # general RPC ([cluster] rpc_timeout_s)
+    HEALTH_TIMEOUT = 5.0         # health/hotspots/timeline probes
+    RESIZE_PULL_TIMEOUT = 600.0  # synchronous resize pull pass
+
+    def __init__(self, timeout: float = DEFAULT_TIMEOUT, tracer=None,
+                 ssl_context=None,
+                 health_timeout: float = HEALTH_TIMEOUT,
+                 resize_pull_timeout: float = RESIZE_PULL_TIMEOUT):
         """`ssl_context` verifies https peers (config.client_ssl_context
         builds it: CA bundle or skip-verify, reference
         server/server.go:244 InsecureSkipVerify). None + an https URI =
         strict system-CA verification."""
         self.timeout = timeout
+        self.health_timeout = health_timeout
+        self.resize_pull_timeout = resize_pull_timeout
         self.tracer = tracer
         self._pool = _ConnPool(timeout, ssl_context=ssl_context)
+
+    def configure(self, timeout: Optional[float] = None,
+                  health_timeout: Optional[float] = None,
+                  resize_pull_timeout: Optional[float] = None) -> None:
+        """[cluster] config wiring (cli/main.py): rpc_timeout_s /
+        health_timeout_s / resize_pull_timeout_s."""
+        if timeout is not None:
+            self.timeout = float(timeout)
+            self._pool.timeout = float(timeout)
+        if health_timeout is not None:
+            self.health_timeout = float(health_timeout)
+        if resize_pull_timeout is not None:
+            self.resize_pull_timeout = float(resize_pull_timeout)
 
     def drop_idle(self) -> None:
         """Close every idle pooled connection (test harnesses use this to
@@ -129,22 +184,25 @@ class InternalClient:
             headers["Accept"] = f"{wire.CONTENT_TYPE}, application/json"
         if self.tracer is not None:
             self.tracer.inject(headers)
+        try:
+            _FP_5XX.fire(url=url)
+        except FailpointError as e:
+            raise ClientError(f"{method} {url}: 500: failpoint",
+                              status=500, body="failpoint") from e
         parts = urlsplit(url)
         scheme = parts.scheme or "http"
         host = parts.hostname or "localhost"
         port = parts.port or (443 if scheme == "https" else 80)
         path = parts.path + (f"?{parts.query}" if parts.query else "")
-        one_off = timeout is not None
         try:
-            if one_off:  # non-default timeout: dedicated connection
-                conn, reused = self._pool._new_conn(scheme, host, port,
-                                                    timeout), False
-            else:
-                conn, reused = self._pool.get(scheme, host, port)
+            _FP_CONNECT.fire(url=url)
+            conn, reused = self._pool.get(scheme, host, port,
+                                          timeout=timeout)
         except OSError as e:  # eager connect: refused/unreachable
             raise ClientError(f"{method} {url}: {e}") from e
         try:
             try:
+                _FP_READ.fire(url=url)
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
             except (http.client.HTTPException, ConnectionError,
@@ -168,9 +226,19 @@ class InternalClient:
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
             payload = resp.read()
+            try:
+                _FP_TORN.fire(url=url)
+            except FailpointDrop:
+                payload = b""  # response lost after the server acted
+            except FailpointError:
+                # Torn body: the connection died mid-read. The parse
+                # below then raises a NON-ClientError (JSONDecodeError /
+                # WireError) — exactly the class the scatter-gather
+                # accounting must survive.
+                payload = payload[: len(payload) // 2]
             status = resp.status
             ctype = resp.headers.get("Content-Type") or ""
-            reusable = not one_off and not resp.will_close
+            reusable = not resp.will_close
             if reusable:
                 self._pool.put(scheme, host, port, conn)
             else:
@@ -194,22 +262,28 @@ class InternalClient:
     # -- query fan-out (reference QueryNode, http/client.go:241) -------------
 
     def query_node(self, uri: str, index: str, pql: str,
-                   shards: List[int]) -> List[Any]:
-        return self.query_node_full(uri, index, pql, shards)["results"]
+                   shards: List[int],
+                   timeout: Optional[float] = None) -> List[Any]:
+        return self.query_node_full(uri, index, pql, shards,
+                                    timeout=timeout)["results"]
 
     def query_node_full(self, uri: str, index: str, pql: str,
-                        shards: List[int],
-                        profile: bool = False) -> Dict[str, Any]:
+                        shards: List[int], profile: bool = False,
+                        timeout: Optional[float] = None
+                        ) -> Dict[str, Any]:
         """query_node returning the FULL response dict. With
         profile=True the ?profile=true flag propagates to the remote
         node, whose response carries its own execution-profile fragment
         under "profile" — the coordinator merges these into one tree
-        (cluster_executor._map_reduce -> QueryProfile.add_node_fragment)."""
+        (cluster_executor._map_reduce -> QueryProfile.add_node_fragment).
+        `timeout` is the scatter leg's share of the request's fan-out
+        deadline budget (cluster_executor._map_reduce); None keeps the
+        client default."""
         q = ",".join(str(s) for s in shards)
         p = "&profile=true" if profile else ""
         return self._req("POST", f"{uri}/index/{index}/query"
                                  f"?shards={q}&remote=true{p}",
-                         pql.encode("utf-8"))
+                         pql.encode("utf-8"), timeout=timeout)
 
     # -- imports (reference importNode, http/client.go:439) ------------------
 
@@ -276,15 +350,17 @@ class InternalClient:
     def status(self, uri: str) -> dict:
         return self._req("GET", f"{uri}/status")
 
-    def node_health(self, uri: str, timeout: float = 5.0) -> dict:
+    def node_health(self, uri: str,
+                    timeout: Optional[float] = None) -> dict:
         """One node's health self-report (GET /internal/health) for the
-        coordinator's /cluster/health merge. Short dedicated-connection
-        timeout: the health plane must report a wedged node as
-        unhealthy, not hang the whole fleet document behind it."""
+        coordinator's /cluster/health merge. Short timeout (default
+        `health_timeout`, [cluster] health_timeout_s): the health plane
+        must report a wedged node as unhealthy, not hang the whole
+        fleet document behind it."""
         return self._req("GET", f"{uri}/internal/health",
-                         timeout=timeout)
+                         timeout=timeout or self.health_timeout)
 
-    def node_hotspots(self, uri: str, timeout: float = 5.0,
+    def node_hotspots(self, uri: str, timeout: Optional[float] = None,
                       top_k: Optional[int] = None) -> dict:
         """One node's workload snapshot (GET /debug/hotspots) for the
         /cluster/hotspots merge — same short-timeout rule as
@@ -293,10 +369,10 @@ class InternalClient:
         one bound."""
         q = f"?topk={int(top_k)}" if top_k is not None else ""
         return self._req("GET", f"{uri}/debug/hotspots{q}",
-                         timeout=timeout)
+                         timeout=timeout or self.health_timeout)
 
     def node_timeline(self, uri: str, trace_id: str,
-                      timeout: float = 5.0) -> dict:
+                      timeout: Optional[float] = None) -> dict:
         """One node's timeline slices for a trace id (GET
         /debug/timeline?trace=...) for the coordinator's
         /cluster/timeline assembly — same short-timeout rule as
@@ -304,7 +380,7 @@ class InternalClient:
         from urllib.parse import quote
         return self._req("GET",
                          f"{uri}/debug/timeline?trace={quote(trace_id)}",
-                         timeout=timeout)
+                         timeout=timeout or self.health_timeout)
 
     def local_shards(self, uri: str) -> Dict[str, List[int]]:
         return self._req("GET", f"{uri}/internal/local-shards")
@@ -317,13 +393,15 @@ class InternalClient:
     def join(self, uri: str, node: dict) -> dict:
         return self._req("POST", f"{uri}/internal/join", obj=node)
 
-    def resize_pull(self, uri: str, timeout: float = 600.0) -> dict:
+    def resize_pull(self, uri: str,
+                    timeout: Optional[float] = None) -> dict:
         """Synchronous pull pass on a member during a resize job (the data
         motion of the reference's ResizeInstruction, cluster.go:1251).
-        Long timeout (dedicated connection): the node streams every
-        fragment it now owns."""
+        Long timeout (default `resize_pull_timeout`, [cluster]
+        resize_pull_timeout_s): the node streams every fragment it now
+        owns."""
         return self._req("POST", f"{uri}/internal/resize/pull", body=b"",
-                         timeout=timeout)
+                         timeout=timeout or self.resize_pull_timeout)
 
     def cluster_message(self, uri: str, message: dict) -> None:
         self._req("POST", f"{uri}/internal/cluster/message", obj=message)

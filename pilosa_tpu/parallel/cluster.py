@@ -20,9 +20,11 @@ from __future__ import annotations
 
 import json
 import os
+import time
+from collections import deque
 from pilosa_tpu.utils.locks import make_rlock
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from pilosa_tpu.parallel.hashing import (
     DEFAULT_PARTITION_N, shard_nodes,
@@ -78,6 +80,19 @@ class Cluster:
         # joins must not adopt the new placement until the LAST job's
         # pulls complete).
         self.resize_gen = 0
+        # Monotone placement generation: bumped on every membership or
+        # placement adoption (add/remove node, resize completion). The
+        # serving layer keys cache invalidation on it — a result/rank
+        # cache entry filled under one placement must not survive into
+        # the next unexamined (the PR 10 epoch-guard pattern applied to
+        # topology instead of fragments).
+        self.placement_gen = 0
+        # Bounded cluster lifecycle event ring: membership changes,
+        # failure-detector verdicts, resize begin/complete. Served in
+        # /internal/health (clusterEvents), merged fleet-wide at
+        # GET /cluster/timeline, so a chaos kill/recovery is visible in
+        # the same planes an operator already watches.
+        self.events: "deque[Dict[str, Any]]" = deque(maxlen=256)
         # Pinned key-translation primary. None = lexically-first member
         # (single-node / static bootstrap). Pinned before the first
         # dynamic membership change so a joiner with a smaller id cannot
@@ -140,17 +155,43 @@ class Cluster:
         with self._lock:
             return sorted(self._nodes)
 
+    # -- lifecycle events ----------------------------------------------------
+
+    def _note_event(self, typ: str, node_id: Optional[str] = None,
+                    **detail: Any) -> None:
+        """Record one lifecycle event (lock held by callers). Ring-
+        bounded; pure host dict work."""
+        ev: Dict[str, Any] = {"time": time.time(), "type": typ,
+                              "state": self.state}
+        if node_id is not None:
+            ev["node"] = node_id
+        ev.update(detail)
+        self.events.append(ev)
+
+    def recent_events(self, last: int = 64) -> List[Dict[str, Any]]:
+        with self._lock:
+            evs = list(self.events)
+        last = int(last)
+        return evs[-last:] if last > 0 else []
+
     def add_node(self, node: Node) -> None:
         with self._lock:
+            fresh = node.id not in self._nodes
             self._nodes[node.id] = node
             self._update_state()
+            if fresh:
+                self.placement_gen += 1
+                self._note_event("node-join", node.id, uri=node.uri)
             self.save()
 
     def remove_node(self, node_id: str) -> None:
         with self._lock:
-            self._nodes.pop(node_id, None)
+            removed = self._nodes.pop(node_id, None)
             self.down_ids.discard(node_id)
             self._update_state()
+            if removed is not None:
+                self.placement_gen += 1
+                self._note_event("node-leave", node_id)
             self.save()
 
     def node_by_id(self, node_id: str) -> Optional[Node]:
@@ -185,6 +226,7 @@ class Cluster:
             self.down_ids.add(node_id)
             if self.state == STATE_NORMAL:
                 self.state = STATE_DEGRADED
+            self._note_event("node-down", node_id)
             return True
 
     def mark_up(self, node_id: str) -> bool:
@@ -195,6 +237,7 @@ class Cluster:
             self.down_ids &= set(self._nodes)
             if self.state == STATE_DEGRADED and not self.down_ids:
                 self.state = STATE_NORMAL
+            self._note_event("node-up", node_id)
             return True
 
     # -- resize lifecycle ----------------------------------------------------
@@ -213,6 +256,8 @@ class Cluster:
                                    else self.nodes())  # RLock: safe
             self.state = STATE_RESIZING
             self.resize_gen += 1
+            self._note_event("resize-begin", gen=self.resize_gen,
+                             prev=[n.id for n in self.prev_nodes])
             self.save()
             return list(self.prev_nodes)
 
@@ -221,9 +266,17 @@ class Cluster:
         reads and return to NORMAL (reference broadcasts NORMAL after the
         job completes, cluster.go:1048-1060)."""
         with self._lock:
+            was_resizing = self.prev_nodes is not None \
+                or self.state == STATE_RESIZING
             self.prev_nodes = None
             if self.state == STATE_RESIZING:
                 self.state = STATE_NORMAL
+            if was_resizing:
+                # The new placement takes over for reads: anything
+                # keyed on the old placement is now suspect.
+                self.placement_gen += 1
+                self._note_event("resize-complete", gen=self.resize_gen,
+                                 members=sorted(self._nodes))
             self.save()
 
     # -- placement ----------------------------------------------------------
@@ -256,6 +309,24 @@ class Cluster:
         prev = self.shard_nodes(index, shard, previous=True)
         seen = {n.id for n in prev}
         return prev + [n for n in cur if n.id not in seen]
+
+    def route_shards(self, index: str, shards: List[int],
+                     exclude_ids: Optional[set] = None
+                     ) -> "tuple[Dict[str, List[int]], bool]":
+        """shards_by_node with the RESIZING check made ATOMICALLY with
+        the placement computation, returning (by_node, used_previous).
+        A topology change landing between a caller's separate state
+        read and its placement math could otherwise route a shard to a
+        just-joined owner that has not pulled yet — which answers
+        without error and the merge silently undercounts (caught live
+        by tools/chaos.py: a TopN missing exactly one shard during a
+        join). The RLock makes the nested per-shard placement reads
+        consistent with the state check."""
+        with self._lock:
+            previous = self.state == STATE_RESIZING
+            return self.shards_by_node(index, shards,
+                                       exclude_ids=exclude_ids,
+                                       previous=previous), previous
 
     def owners_match_membership(self, member_ids: List[str]) -> bool:
         """True when this node's membership equals `member_ids` — used to
@@ -338,6 +409,7 @@ class Cluster:
             out = {"state": self.state,
                    "localID": self.local.id,
                    "replicaN": self.replica_n,
+                   "placementGen": self.placement_gen,
                    "nodes": [{**n.to_json(),
                               "state": ("DOWN" if n.id in self.down_ids
                                         else "READY")}

@@ -18,9 +18,12 @@ chip mesh.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
+from collections import deque
 from pilosa_tpu.utils.locks import make_lock
+from pilosa_tpu.utils.stats import NopStatsClient
 from pilosa_tpu.utils.timeline import LANE_REMOTE, TIMELINE
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -120,22 +123,108 @@ def merge_results(call: Call, parts: List[Any]) -> Any:
     return parts[0]
 
 
+class _Leg:
+    """Accounting for one scatter leg of a fan-out round: the shards it
+    must deliver, a first-success-wins settle latch (`done` — primary
+    vs hedge must never both merge), and the count of in-flight
+    attempts (`pending`) so the leg only reads as failed when EVERY
+    attempt for it has failed. `event` fires when the primary attempt
+    concludes (the hedge monitor waits on it)."""
+
+    __slots__ = ("node", "shards", "done", "pending", "event")
+
+    def __init__(self, node, shards: Sequence[int]) -> None:
+        self.node = node
+        self.shards = list(shards)
+        self.done = False
+        self.pending = 1
+        self.event = threading.Event()
+
+
 class ClusterExecutor:
     """Coordinator-side fan-out. Wraps a local Executor; remote legs use
     InternalClient. Replica failover: a failed node's shards re-map onto
-    the next replica (reference executor.go:2313-2324)."""
+    the next replica (reference executor.go:2313-2324).
+
+    Fan-out hardening (the resilience plane, docs/architecture.md):
+
+    - a per-request **deadline budget** (`fanout_deadline_s`) is
+      propagated to every remote leg as its RPC timeout, so one wedged
+      peer can never hold a request past the budget;
+    - failover rounds back off **exponentially with jitter**
+      (`backoff_base_s`/`backoff_cap_s`) instead of hammering a
+      recovering cluster;
+    - routing honors the failure detector (heartbeat `mark_down`):
+      `shards_by_node` deprioritizes down replicas per shard, so a
+      known-dead node costs zero request timeouts yet stays usable as
+      the last resort for a shard with no up candidate (the detector
+      may be stale); the per-request skip is counted
+      (`cluster.excluded_nodes`);
+    - optional **hedged reads** (`hedge_quantile` > 0): a leg slower
+      than that quantile of the recent leg-latency window is re-issued
+      to a spare replica, first success wins;
+    - **shard accounting**: every scatter leg must deliver its shards
+      or the round fails over — ANY exception (not just ClientError)
+      marks the leg failed, and a post-join audit confirms every shard
+      merged (a lost partition can never silently undercount)."""
+
+    FANOUT_DEADLINE_S = 30.0
+    BACKOFF_BASE_S = 0.05
+    BACKOFF_CAP_S = 2.0
+    HEDGE_QUANTILE = 0.0  # 0 disables hedged reads
+    HEDGE_FLOOR_S = 0.005
+    HEDGE_MIN_SAMPLES = 8
 
     def __init__(self, local_executor, cluster: Cluster,
                  client: Optional[InternalClient] = None, logger=None,
-                 broadcaster=None):
+                 broadcaster=None, stats=None):
         self.local = local_executor
         self.cluster = cluster
         self.client = client or InternalClient()
         self.logger = logger
+        self.stats = stats or NopStatsClient()
         # Optional queued-retry path for the shards-changed push (a
         # briefly-down peer otherwise serves undercounts for up to the
         # TTL after it returns).
         self.broadcaster = broadcaster
+        self.fanout_deadline_s = self.FANOUT_DEADLINE_S
+        self.backoff_base_s = self.BACKOFF_BASE_S
+        self.backoff_cap_s = self.BACKOFF_CAP_S
+        self.hedge_quantile = self.HEDGE_QUANTILE
+        # Rolling window of successful remote-leg durations; the hedge
+        # trigger is a quantile of this window, so "slow" means slow
+        # relative to THIS cluster's live behavior, not a magic number.
+        self._leg_lat: "deque[float]" = deque(maxlen=128)
+        self._leg_lat_lock = make_lock("ClusterExecutor._leg_lat_lock")
+
+    def configure(self, fanout_deadline_s: Optional[float] = None,
+                  backoff_base_s: Optional[float] = None,
+                  backoff_cap_s: Optional[float] = None,
+                  hedge_quantile: Optional[float] = None) -> None:
+        """[cluster] config wiring (cli/main.py)."""
+        if fanout_deadline_s is not None:
+            self.fanout_deadline_s = float(fanout_deadline_s)
+        if backoff_base_s is not None:
+            self.backoff_base_s = max(0.0, float(backoff_base_s))
+        if backoff_cap_s is not None:
+            self.backoff_cap_s = max(0.0, float(backoff_cap_s))
+        if hedge_quantile is not None:
+            self.hedge_quantile = min(1.0, max(0.0,
+                                               float(hedge_quantile)))
+
+    def _hedge_delay(self) -> Optional[float]:
+        """How long a leg may run before it is hedged, or None when
+        hedging is off or the latency window is too thin to name a
+        quantile."""
+        q = self.hedge_quantile
+        if not q:
+            return None
+        with self._leg_lat_lock:
+            lats = sorted(self._leg_lat)
+        if len(lats) < self.HEDGE_MIN_SAMPLES:
+            return None
+        return max(self.HEDGE_FLOOR_S,
+                   lats[min(len(lats) - 1, int(len(lats) * q))])
 
     # -- shard discovery ----------------------------------------------------
 
@@ -266,13 +355,15 @@ class ClusterExecutor:
 
     def _map_reduce(self, index: str, call: Call, shards: List[int],
                     profile=None) -> Any:
-        from pilosa_tpu.parallel.cluster import STATE_RESIZING
-        # While RESIZING, route reads against the pre-change placement:
+        # While RESIZING, reads route against the pre-change placement:
         # those nodes are guaranteed to still hold the data (pulls never
         # delete source copies), where the new placement may point at an
         # owner that has not pulled yet and would silently undercount
         # (reference instead rejects queries in RESIZING, api.go:76-99).
-        previous = self.cluster.state == STATE_RESIZING
+        # The check is made atomically with the placement math inside
+        # Cluster.route_shards — reading the state separately leaves a
+        # window where a landing join routes a shard to the unpulled
+        # joiner (a live chaos-harness find).
         # Remote profile propagation only for forced profiles
         # (?profile=true): passive sampling must not make every fan-out
         # leg pay device fencing on its node.
@@ -289,21 +380,67 @@ class ClusterExecutor:
             if profile is not None else None
         if trace_id is None and hasattr(tracer, "current_trace_id"):
             trace_id = tracer.current_trace_id()
+        deadline = (time.monotonic() + self.fanout_deadline_s) \
+            if self.fanout_deadline_s > 0 else None
+
+        def remaining() -> Optional[float]:
+            return None if deadline is None \
+                else deadline - time.monotonic()
+
         excluded: set = set()
+        # Known-down nodes need no request-level exclusion here:
+        # shards_by_node deprioritizes down_ids PER SHARD (a down
+        # replica is picked only when no up candidate remains —
+        # strictly finer than any whole-round exclusion-and-readmit),
+        # so a heartbeat-marked node receives zero RPCs unless it is
+        # the last resort for some shard (pinned by test). Counted so
+        # /metrics shows the proactive skips.
+        pre_down = set(self.cluster.down_ids)
+        if pre_down:
+            self.stats.count("cluster.excluded_nodes", len(pre_down))
         last_err: Optional[Exception] = None
-        for _ in range(max(1, self.cluster.replica_n)):
+        want_shards = {int(s) for s in shards}
+        for attempt in range(max(1, self.cluster.replica_n)):
+            if attempt:
+                # Failover round: exponential backoff + full jitter,
+                # capped and clipped to the remaining deadline budget —
+                # a recovering cluster gets breathing room instead of a
+                # synchronized retry stampede.
+                delay = min(self.backoff_cap_s,
+                            self.backoff_base_s * (2 ** (attempt - 1)))
+                delay *= 0.5 + random.random() / 2
+                rem = remaining()
+                if rem is not None:
+                    delay = min(delay, max(0.0, rem))
+                if delay > 0:
+                    time.sleep(delay)
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                raise last_err or ClientError(
+                    f"map_reduce: fan-out deadline "
+                    f"({self.fanout_deadline_s:g}s) exhausted")
             try:
-                by_node = self.cluster.shards_by_node(index, shards,
-                                                      exclude_ids=excluded,
-                                                      previous=previous)
+                by_node, previous = self.cluster.route_shards(
+                    index, shards, exclude_ids=excluded)
             except RuntimeError as e:
                 raise last_err or e
             parts: List[Any] = []
+            accounted: set = set()
             failed = False
             results_lock = make_lock("ClusterExecutor.results_lock")
-            threads = []
+            threads: List[threading.Thread] = []
+            legs: List[_Leg] = []
+            # Set once every leg has concluded (settled or all attempts
+            # failed). The gather waits on THIS, not on thread joins —
+            # a leg settled by its hedge must not wait out the slow
+            # primary's socket.
+            gather_evt = threading.Event()
 
-            def run_remote(node, node_shards):
+            def _conclude_locked():
+                if all(l.done or l.pending <= 0 for l in legs):
+                    gather_evt.set()
+
+            def run_remote(node, leg: _Leg, hedge: bool = False):
                 nonlocal failed, last_err
                 # Scatter threads have no open span: adopt the
                 # request's trace id so the outgoing leg injects the
@@ -317,44 +454,100 @@ class ClusterExecutor:
                 # via /cluster/timeline).
                 tl = getattr(profile, "timeline", None) \
                     if profile is not None else None
+                lane = f"hedge:{node.id}" if hedge \
+                    else f"remote:{node.id}"
                 t0 = time.perf_counter()
                 try:
+                    rem_leg = remaining()
+                    if rem_leg is not None and rem_leg <= 0:
+                        raise ClientError(
+                            f"node {node.id}: fan-out deadline "
+                            f"exhausted before dispatch")
                     res = self.client.query_node_full(
-                        node.uri, index, call.to_pql(), node_shards,
-                        profile=want_profile)
-                    TIMELINE.event(tl, f"remote:{node.id}", LANE_REMOTE,
-                                   t0, time.perf_counter() - t0,
+                        node.uri, index, call.to_pql(), leg.shards,
+                        profile=want_profile, timeout=rem_leg)
+                    dur = time.perf_counter() - t0
+                    # A malformed response body must take the failure
+                    # path below, not tear the thread down silently.
+                    part = res["results"][0]
+                    # The RPC genuinely succeeded, so its duration is
+                    # real signal for the hedge quantile even when the
+                    # hedge race is about to discard the result.
+                    with self._leg_lat_lock:
+                        self._leg_lat.append(dur)
+                    with results_lock:
+                        if leg.done:
+                            return  # hedge race: first success merged
+                        leg.done = True
+                        parts.append(part)
+                        accounted.update(int(s) for s in leg.shards)
+                        _conclude_locked()
+                    # Winner-only side effects, AFTER settling: the
+                    # losing attempt of a hedge race must not add a
+                    # second profile fragment (device time would
+                    # double-count) or a success slice for a result
+                    # that never merged.
+                    TIMELINE.event(tl, lane, LANE_REMOTE, t0, dur,
                                    remote=node.id,
-                                   shards=len(node_shards))
+                                   shards=len(leg.shards))
                     if want_profile and res.get("profile") is not None:
                         profile.add_node_fragment(node.id,
                                                   res["profile"])
-                    with results_lock:
-                        parts.append(res["results"][0])
-                except ClientError as e:
-                    TIMELINE.event(tl, f"remote:{node.id}", LANE_REMOTE,
+                except Exception as e:
+                    # EVERY exception accounts the leg as failed — a
+                    # non-ClientError (torn-body JSON decode, a
+                    # malformed response shape) previously killed the
+                    # scatter thread with `failed` still False and the
+                    # merge silently undercounted the lost partition.
+                    TIMELINE.event(tl, lane, LANE_REMOTE,
                                    t0, time.perf_counter() - t0,
                                    remote=node.id, error=str(e)[:200])
                     with results_lock:
+                        # The node did fail its RPC: excluding it from
+                        # later rounds is right either way. But the
+                        # failover/loss counters fire only when the
+                        # LEG actually lost the result — a late
+                        # primary failure after the hedge merged is
+                        # not a failover.
                         excluded.add(node.id)
-                        failed = True
-                        last_err = e
-                    if self.logger is not None:
-                        self.logger.printf("node %s failed, failing over: %s",
-                                           node.id, e)
+                        lost = not leg.done
+                        if lost:
+                            leg.pending -= 1
+                            if leg.pending <= 0:
+                                failed = True
+                                last_err = e
+                        _conclude_locked()
+                    if lost:
+                        if not isinstance(e, ClientError):
+                            self.stats.count("cluster.partition_losses",
+                                             1)
+                        self.stats.count("cluster.failovers", 1)
+                        if self.logger is not None:
+                            self.logger.printf(
+                                "node %s failed (%s), failing over: %s",
+                                node.id, type(e).__name__, e)
+                finally:
+                    if not hedge:
+                        leg.event.set()
 
-            # Dispatch every remote leg before running the local one so the
-            # local evaluation overlaps the network round trips.
+            # Build EVERY leg before starting any thread: a fast leg
+            # concluding while later legs are still being appended
+            # would otherwise see "all legs concluded" and fire the
+            # gather early. Then dispatch every remote leg before
+            # running the local one so the local evaluation overlaps
+            # the network round trips.
             local_shards = None
             for node_id, node_shards in by_node.items():
                 if node_id == self.cluster.local.id:
                     local_shards = node_shards
                 else:
                     node = self.cluster.node_by_id(node_id)
-                    t = threading.Thread(target=run_remote,
-                                         args=(node, node_shards))
-                    t.start()
-                    threads.append(t)
+                    legs.append(_Leg(node, node_shards))
+            for leg in legs:
+                t = threading.Thread(target=run_remote,
+                                     args=(leg.node, leg), daemon=True)
+                t.start()
+                threads.append(t)
             if local_shards is not None:
                 # The coordinator's own leg records into the root
                 # profile directly — its ops ARE the tree's trunk.
@@ -362,12 +555,99 @@ class ClusterExecutor:
                                            shards=local_shards,
                                            profile=profile)
                 parts.append(result_to_json(local[0]))
-            for t in threads:
-                t.join()
-            if not failed:
-                return merge_results(call, parts)
+                accounted.update(int(s) for s in local_shards)
+            self._maybe_hedge(index, legs, threads, run_remote,
+                              excluded, results_lock, previous)
+            if legs:
+                rem = remaining()
+                gather_evt.wait(rem if rem is not None else None)
+            with results_lock:
+                # Deadline-expired stragglers (the gather timed out
+                # with a leg still in flight): latch the leg done so a
+                # late settle can never append into a round we have
+                # already judged, and account it as a failure.
+                for leg in legs:
+                    if not leg.done and leg.pending > 0:
+                        leg.done = True
+                        excluded.add(leg.node.id)
+                        failed = True
+                        last_err = last_err or ClientError(
+                            f"node {leg.node.id}: no response within "
+                            f"the fan-out deadline")
+                round_ok = not failed
+                if round_ok:
+                    # Defense in depth behind the Exception catch: the
+                    # merge runs ONLY when every requested shard was
+                    # delivered by some leg. An unaccounted shard is a
+                    # lost partition, never a quiet undercount.
+                    missing = want_shards - accounted
+                    if missing:
+                        round_ok = False
+                        failed = True
+                        self.stats.count("cluster.partition_losses", 1)
+                        last_err = ClientError(
+                            f"shards {sorted(missing)} unaccounted "
+                            f"after fan-out")
+                parts_snapshot = list(parts)
+            if round_ok:
+                return merge_results(call, parts_snapshot)
             # retry: re-map every shard against remaining nodes
         raise last_err or RuntimeError("map_reduce failed")
+
+    def _maybe_hedge(self, index: str, legs: List[_Leg],
+                     threads: List[threading.Thread], run_remote,
+                     excluded: set, results_lock,
+                     previous: bool) -> None:
+        """Hedged reads: a leg whose primary attempt is still in
+        flight past the configured latency quantile is re-issued to a
+        spare replica — first success wins (the `_Leg.done` latch
+        guarantees exactly one merge). Only a replica that can serve
+        the WHOLE leg hedges; splitting a leg would split its merge
+        accounting."""
+        hedge_delay = self._hedge_delay()
+        if hedge_delay is None or not legs:
+            return
+        hedge_at = time.monotonic() + hedge_delay
+        for leg in legs:
+            wait = hedge_at - time.monotonic()
+            if wait > 0:
+                leg.event.wait(wait)
+            if leg.event.is_set():
+                continue  # concluded (or failed — round handles it)
+            with results_lock:
+                if leg.done or leg.pending <= 0:
+                    continue
+                avoid = set(excluded) | {leg.node.id}
+            try:
+                # shards_by_node deprioritizes down-marked replicas
+                # itself — no point hedging INTO a dead node.
+                alt = self.cluster.shards_by_node(
+                    index, leg.shards, exclude_ids=avoid,
+                    previous=previous)
+            except RuntimeError:
+                continue  # no spare replica covers this leg
+            if len(alt) != 1:
+                continue
+            (alt_id, _alt_shards), = alt.items()
+            if alt_id == self.cluster.local.id:
+                continue
+            alt_node = self.cluster.node_by_id(alt_id)
+            if alt_node is None:
+                continue
+            with results_lock:
+                if leg.done or leg.pending <= 0:
+                    continue
+                leg.pending += 1
+            self.stats.count("cluster.hedged_reads", 1)
+            if self.logger is not None:
+                self.logger.printf(
+                    "hedging slow leg %s -> replica %s (>%.3fs)",
+                    leg.node.id, alt_id, hedge_delay)
+            t = threading.Thread(target=run_remote,
+                                 args=(alt_node, leg, True),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
 
     # -- writes -------------------------------------------------------------
 

@@ -24,6 +24,16 @@ from typing import Dict, List, Optional
 
 from pilosa_tpu.parallel.client import ClientError, InternalClient
 from pilosa_tpu.parallel.cluster import Cluster
+from pilosa_tpu.utils.failpoints import (
+    FAILPOINTS, FailpointDrop, FailpointError,
+)
+
+# One heartbeat probe about to be sent: `error`/`partition` count as a
+# failed probe (drives mark_down after suspect_after rounds), `drop`
+# silently loses the probe (no failure, no success — a lossy network),
+# `delay` slows the prober. The receive side is the `api.status` site:
+# arming error THERE makes a node look dead to every prober.
+_FP_HB_PROBE = FAILPOINTS.register("heartbeat.probe")
 
 
 class Heartbeater:
@@ -100,8 +110,12 @@ class Heartbeater:
         self.last_round_probes = len(targets)
         for node in targets:
             try:
+                try:
+                    _FP_HB_PROBE.fire(uri=node.uri)
+                except FailpointDrop:
+                    continue  # probe lost in flight: no verdict either way
                 self.client.status(node.uri)
-            except ClientError:
+            except (ClientError, FailpointError):
                 n = self._fails.get(node.id, 0) + 1
                 self._fails[node.id] = n
                 if n >= self.suspect_after and \

@@ -20,6 +20,13 @@ import numpy as np
 
 from pilosa_tpu.parallel.client import ClientError, InternalClient
 from pilosa_tpu.parallel.cluster import Cluster
+from pilosa_tpu.utils.failpoints import FAILPOINTS
+
+# Per-(peer, shard) fragment fetch during a resize pull: `error` fails
+# the pull pass (the job stays RESIZING, reads keep the pre-change
+# placement), `delay` holds the cluster mid-resize so the chaos harness
+# can strike inside the window (tools/chaos.py).
+_FP_RESIZE_PULL = FAILPOINTS.register("resize.pull")
 
 
 class HolderSyncer:
@@ -195,6 +202,11 @@ class ResizePuller:
         here: during the pull the cluster stays RESIZING so reads keep
         routing against the pre-change placement."""
         with self._pull_lock:
+            # graftlint: disable=GL009 — the only blocking sink on this
+            # path is the resize.pull failpoint's `delay` mode
+            # (utils/failpoints.py), whose purpose IS to hold the pull
+            # pass open so the chaos harness can strike mid-resize;
+            # disarmed (production) the site is one attribute read.
             return self._pull_owned_locked()
 
     def _pull_owned_locked(self) -> int:
@@ -290,6 +302,11 @@ class ResizePuller:
         be stale."""
         if not self.cluster.owns_shard(idx.name, shard):
             return 0
+        # Fires per (peer, shard): an injected error propagates out of
+        # pull_owned (it is NOT a ClientError, so the per-view fetch
+        # handling below does not swallow it) and fails the resize
+        # job's pull pass — the cluster stays safely RESIZING.
+        _FP_RESIZE_PULL.fire(uri=peer.uri, index=idx.name, shard=shard)
         fetched = 0
         for fname, field in list(idx.fields.items()):
             try:

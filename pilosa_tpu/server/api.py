@@ -21,7 +21,18 @@ from pilosa_tpu.core import timeq
 from pilosa_tpu.executor import Executor
 from pilosa_tpu.executor.results import result_to_json
 from pilosa_tpu.ops.bitset import SHARD_WIDTH
+from pilosa_tpu.utils.failpoints import FAILPOINTS
 from pilosa_tpu import __version__
+
+# Fault-injection sites on the server seams (utils/failpoints.py
+# catalog). `api.status` is what heartbeat probes hit — arming error
+# there makes THIS node look dead to every prober while its data plane
+# keeps running; `api.query` fails every query leg routed here (the
+# failpoint "kill": coordinators must fail over); `resize.job.rpc` is
+# the coordinator's per-node pull RPC inside the resize job.
+_FP_STATUS = FAILPOINTS.register("api.status")
+_FP_QUERY = FAILPOINTS.register("api.query")
+_FP_RESIZE_RPC = FAILPOINTS.register("resize.job.rpc")
 
 
 def export_fragment_lines(idx, field_name: str, shard: int):
@@ -134,7 +145,7 @@ class API:
             self.broadcaster = AsyncBroadcaster(client, logger=self.logger)
             self.cluster_executor = ClusterExecutor(
                 self.executor, cluster, client,
-                broadcaster=self.broadcaster)
+                broadcaster=self.broadcaster, stats=self.stats)
             self.syncer = HolderSyncer(holder, cluster, client)
             self.resize_puller = ResizePuller(holder, cluster, client)
             self.executor.key_resolver = self._resolve_key_via_primary
@@ -290,6 +301,7 @@ class API:
         opt.Remote, executor.go:2236). `profile=True` (the
         ?profile=true surface) embeds the execution profile tree in the
         response with device-time fencing on."""
+        _FP_QUERY.fire(index=index, remote=remote)
         tl = self._begin_timeline(index)
         prof = self.profiler.begin(index, query, shards,
                                    force=bool(profile))
@@ -330,6 +342,7 @@ class API:
                 or self.cluster_executor is not None):
             return self.query(index, query, shards=shards, remote=remote,
                               profile=profile)
+        _FP_QUERY.fire(index=index, remote=remote)
         from pilosa_tpu.server.coalescer import CoalescerStopped
         tl = self._begin_timeline(index)
         prof = self.profiler.begin(index, query, shards,
@@ -1083,6 +1096,19 @@ class API:
             # serve sparse, what re-layout reclaimed, when it last ran
             # — the capacity axis in the same health document.
             "layout": self.layout.snapshot(),
+            # Fault-injection plane (utils/failpoints.py): armed site
+            # count + cumulative fires. Nonzero `armed` on a
+            # production node is itself a finding.
+            "failpoints": {k: v for k, v in FAILPOINTS.snapshot().items()
+                           if k in ("armed", "fired")},
+            # This node's view of the cluster lifecycle (bounded ring:
+            # node-down/up, join/leave, resize begin/complete) — the
+            # chaos-visible record GET /cluster/timeline merges
+            # fleet-wide.
+            "clusterEvents": (self.cluster.recent_events(32)
+                              if self.cluster is not None else []),
+            "placementGen": (self.cluster.placement_gen
+                             if self.cluster is not None else 0),
         }
 
     @staticmethod
@@ -1181,6 +1207,77 @@ class API:
             "nodes": nodes,
             "totals": self._merge_health_totals(responded),
         }
+
+    def cluster_timeline_events(self) -> Dict[str, Any]:
+        """The GET /cluster/timeline document (no trace id): every
+        member's cluster lifecycle event ring — heartbeat down/up
+        verdicts, membership changes, resize begin/complete — merged
+        chronologically, each event stamped with the node that
+        OBSERVED it, plus Chrome trace-event instants (`ph:"i"`) so
+        the same document loads in Perfetto beside the per-request
+        timelines. A chaos kill and its recovery are visible here and
+        in /cluster/health, by design (ROADMAP item 3)."""
+        from pilosa_tpu.utils.timeline import TimelineRecorder
+        health = self.cluster_health()
+        merged: List[Dict[str, Any]] = []
+        trace_events: List[Dict[str, Any]] = []
+        for pid, nd in enumerate(health["nodes"]):
+            evs = nd.get("clusterEvents") or []
+            if evs:
+                trace_events.extend(TimelineRecorder.metadata_events(
+                    pid, str(nd.get("id", pid))))
+            for ev in evs:
+                merged.append({**ev, "observer": nd.get("id")})
+                trace_events.append({
+                    "ph": "i", "s": "g", "pid": pid, "tid": 0,
+                    "ts": float(ev.get("time", 0.0)) * 1e6,
+                    "name": ev.get("type", "event"),
+                    "args": {k: v for k, v in ev.items()
+                             if k not in ("time", "type")},
+                })
+        merged.sort(key=lambda e: e.get("time", 0.0))
+        return {
+            "state": health["state"],
+            "totalNodes": health["totalNodes"],
+            "respondedNodes": sum(1 for n in health["nodes"]
+                                  if "clusterEvents" in n),
+            "events": merged,
+            "displayTimeUnit": "ms",
+            "traceEvents": trace_events,
+        }
+
+    # ------------------------------------------------- fault injection
+
+    def failpoints_snapshot(self) -> Dict[str, Any]:
+        """GET /internal/failpoints: registered sites, armed specs,
+        hit counts. Test-only: 403 unless the plane was enabled at
+        boot (any failpoint config present) or by a test harness."""
+        self._failpoints_gate()
+        return FAILPOINTS.snapshot()
+
+    def failpoints_update(self, body: Dict[str, Any]) -> Dict[str, Any]:
+        """POST /internal/failpoints: body {"arm": {site: spec},
+        "disarm": [site, ...], "disarm_all": bool}. Disarms apply
+        before arms so one request can atomically retarget the plane."""
+        self._failpoints_gate()
+        try:
+            if body.get("disarm_all"):
+                FAILPOINTS.disarm_all()
+            for name in body.get("disarm") or []:
+                FAILPOINTS.disarm(name)
+            for name, spec in (body.get("arm") or {}).items():
+                FAILPOINTS.arm(name, str(spec))
+        except (KeyError, ValueError) as e:
+            raise ApiError(str(e), 400)
+        return FAILPOINTS.snapshot()
+
+    @staticmethod
+    def _failpoints_gate() -> None:
+        if not FAILPOINTS.http_enabled:
+            raise ApiError(
+                "failpoints surface disabled (enable with "
+                "PILOSA_TPU_FAILPOINTS / [failpoints] config at boot)",
+                403)
 
     def cluster_hotspots(self, top_k: Optional[int] = None
                          ) -> Dict[str, Any]:
@@ -1409,6 +1506,7 @@ class API:
 
         def pull_one(node, errors):
             try:
+                _FP_RESIZE_RPC.fire(uri=node.uri, node=node.id)
                 if node.id == self.cluster.local.id:
                     self.resize_puller.pull_owned()
                 else:
@@ -1442,6 +1540,44 @@ class API:
 
         threading.Thread(target=run, daemon=True).start()
 
+    def _moved_shards(self) -> set:
+        """Shards whose owner set differs between the pinned pre-change
+        placement and the current one — the set placement-change cache
+        invalidation must cover. Must run while `prev_nodes` is still
+        pinned (before end_resize clears it); pure host placement math,
+        no RPCs."""
+        moved: set = set()
+        if self.cluster is None or self.cluster.prev_nodes is None:
+            return moved
+        for iname, idx in list(self.holder.indexes.items()):
+            for shard in idx.available_shards():
+                prev = [n.id for n in self.cluster.shard_nodes(
+                    iname, int(shard), previous=True)]
+                cur = [n.id for n in self.cluster.shard_nodes(
+                    iname, int(shard))]
+                if prev != cur:
+                    moved.add((iname, int(shard)))
+        return moved
+
+    def _note_placement_change(self, moved: set) -> None:
+        """The resize just adopted a new placement: drop result/rank
+        cache entries covering shards whose ownership moved (the PR 10
+        epoch-guard pattern keyed on placement, not fragment,
+        generations). The version stamps already make a stale HIT
+        impossible — this makes the stale BYTES provably gone at the
+        transition, and the counter makes it observable."""
+        if not moved:
+            return
+        from pilosa_tpu.core.cache import RANK_CACHE
+        dropped = self.executor.result_cache.invalidate_placement(moved)
+        dropped += RANK_CACHE.invalidate_shards(moved)
+        self.stats.count("cluster.placement_invalidations", dropped)
+        self.logger.printf(
+            "resize: placement change moved %d shard(s); dropped %d "
+            "result/rank cache entr%s (placement gen %d)",
+            len(moved), dropped, "y" if dropped == 1 else "ies",
+            self.cluster.placement_gen)
+
     def _finish_resize(self) -> None:
         """Adopt the new placement everywhere (reference: job DONE → save
         topology, broadcast NORMAL, cluster.go:1048-1060). The broadcast
@@ -1450,7 +1586,9 @@ class API:
         rides the retried async queue so a briefly-down peer converges
         instead of sticking RESIZING forever."""
         members = self.cluster.member_ids()
+        moved = self._moved_shards()
         self.cluster.end_resize()
+        self._note_placement_change(moved)
         # The pinned translate primary rides along as a second chance for
         # any peer that missed the node-join/leave broadcast carrying it
         # (divergent pins would mint colliding ids indefinitely).
@@ -1507,7 +1645,9 @@ class API:
             members = msg.get("members")
             if members is None or \
                     self.cluster.owners_match_membership(members):
+                moved = self._moved_shards()
                 self.cluster.end_resize()
+                self._note_placement_change(moved)
         elif typ == "topology":
             if msg.get("prev"):
                 self.cluster.begin_resize(
@@ -1736,6 +1876,9 @@ class API:
                 for idx in self.holder.indexes.values()}
 
     def status(self) -> Dict[str, Any]:
+        # Heartbeat probes hit this: an armed error here is the
+        # failpoint way to make THIS node look dead fleet-wide.
+        _FP_STATUS.fire()
         if self.cluster is not None:
             return self.cluster.status()
         return {"state": "NORMAL",

@@ -73,6 +73,7 @@ _EP_STATIC = frozenset({
     # Internal/cluster routes are fixed strings: an explicit whitelist,
     # NOT a prefix match — unknown paths under these prefixes must fold
     # into "other" like everything else or a scanner mints series.
+    "/cluster/timeline", "/internal/failpoints",
     "/internal/health", "/internal/nodes", "/internal/local-shards",
     "/internal/views", "/internal/join", "/internal/cluster/message",
     "/internal/sync", "/internal/resize/pull", "/internal/shards/max",
@@ -393,10 +394,19 @@ class Handler(BaseHTTPRequestHandler):
                 self._json(api.debug_timeline(
                     last=int(q["last"]) if q.get("last") else None,
                     trace=q.get("trace")))
+            elif path == "/cluster/timeline":
+                # Cluster lifecycle timeline (no trace id): merged
+                # membership/failure/resize events from every member —
+                # where a chaos kill and its recovery are visible.
+                self._json(api.cluster_timeline_events())
             elif m := re.fullmatch(r"/cluster/timeline/([^/]+)", path):
                 # Multi-node timeline for one trace id: legs assembled
                 # by the traceparent the cluster already propagates.
                 self._json(api.cluster_timeline(m.group(1)))
+            elif path == "/internal/failpoints":
+                # Test-only fault-injection surface (403 unless the
+                # plane was enabled at boot — utils/failpoints.py).
+                self._json(api.failpoints_snapshot())
             elif path == "/cluster/health":
                 # Coordinator-merged fleet health: per-node memory,
                 # queue depth, jit/retrace/slow-query counters,
@@ -657,6 +667,8 @@ class Handler(BaseHTTPRequestHandler):
                 keys = api.translate_ids_local(b["index"], b.get("field"),
                                                ids)
                 self._json({"ids": ids, "keys": keys})
+            elif path == "/internal/failpoints":
+                self._json(api.failpoints_update(self._body_json()))
             elif path == "/internal/sync":
                 self._json(api.sync_now())
             elif path == "/internal/resize/pull":

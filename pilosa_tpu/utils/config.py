@@ -203,6 +203,36 @@ class Config:
     # seed returns. A restarted member re-announcing through its seeds
     # is a no-op (idempotent rejoin).
     cluster_seeds: list = field(default_factory=list)
+    # Fan-out resilience knobs (parallel/cluster_executor.py; TOML
+    # accepts the [cluster] table — the same table as peers/replicas —
+    # or the flat cluster_* spelling; env PILOSA_TPU_CLUSTER_*). These
+    # replace the old scattered 5 s / 30 s / 600 s client literals.
+    # Per-request scatter-gather deadline: every remote leg gets the
+    # REMAINING budget as its RPC timeout, so one wedged peer can
+    # never hold a request past it. 0 disables (legs fall back to
+    # rpc_timeout_s alone).
+    cluster_fanout_deadline_s: float = 30.0
+    # Internal-client default RPC timeout (InternalClient.timeout).
+    cluster_rpc_timeout_s: float = 30.0
+    # Health/hotspots/timeline probe timeout (a wedged node must be
+    # REPORTED by the fleet documents, not waited on).
+    cluster_health_timeout_s: float = 5.0
+    # Synchronous resize pull pass (the node streams every fragment it
+    # now owns — minutes on big holders).
+    cluster_resize_pull_timeout_s: float = 600.0
+    # Exponential backoff between failover rounds: base doubles per
+    # round up to cap, with full jitter.
+    cluster_backoff_base_s: float = 0.05
+    cluster_backoff_cap_s: float = 2.0
+    # Hedged reads: a scatter leg slower than this quantile of the
+    # recent leg-latency window is re-issued to a spare replica (first
+    # success wins, bit-exact by the settle latch). 0 disables.
+    cluster_hedge_quantile: float = 0.0
+    # Fault-injection plane (utils/failpoints.py): site -> spec table,
+    # e.g. [failpoints] "client.connect" = "error". Also settable via
+    # PILOSA_TPU_FAILPOINTS="site=spec;site=spec". Any entry enables
+    # the test-only POST /internal/failpoints surface.
+    failpoints: dict = field(default_factory=dict)
     advertise: str = ""  # URI peers reach us at; default <scheme>://<bind>
     # TLS (reference server/config.go:120-166: TLS.CertificatePath,
     # TLS.CertificateKeyPath, TLS.SkipCertificateVerification; listener
@@ -281,6 +311,27 @@ class Config:
         if not 0 <= self.telemetry_hbm_watermark <= 1:
             raise ValueError(
                 "telemetry hbm_watermark must be in [0, 1]")
+        if self.cluster_fanout_deadline_s < 0:
+            raise ValueError("cluster fanout_deadline_s must be >= 0")
+        if self.cluster_rpc_timeout_s <= 0 \
+                or self.cluster_health_timeout_s <= 0 \
+                or self.cluster_resize_pull_timeout_s <= 0:
+            raise ValueError(
+                "cluster rpc/health/resize_pull timeouts must be > 0")
+        if self.cluster_backoff_base_s < 0 \
+                or self.cluster_backoff_cap_s < 0:
+            raise ValueError("cluster backoff base/cap must be >= 0")
+        if not 0 <= self.cluster_hedge_quantile < 1:
+            raise ValueError(
+                "cluster hedge_quantile must be in [0, 1)")
+        if self.failpoints:
+            from pilosa_tpu.utils.failpoints import parse_spec
+            for site, spec in self.failpoints.items():
+                parse_spec(str(spec))  # raises ValueError on bad spec
+                if not isinstance(site, str) or not site:
+                    raise ValueError(
+                        f"failpoint site names must be strings: "
+                        f"{site!r}")
 
     def server_ssl_context(self):
         """ssl.SSLContext for the listener, or None when TLS is off
@@ -314,6 +365,7 @@ class Config:
 
     def to_toml(self) -> str:
         lines = []
+        tables = []
         for k, v in asdict(self).items():
             if isinstance(v, str):
                 lines.append(f'{k} = "{v}"')
@@ -322,9 +374,17 @@ class Config:
             elif isinstance(v, list):
                 items = ", ".join(f'"{x}"' for x in v)
                 lines.append(f"{k} = [{items}]")
+            elif isinstance(v, dict):
+                if v:  # dotted keys need a real table, emitted last
+                    tables.append((k, v))
             else:
                 lines.append(f"{k} = {v}")
-        return "\n".join(lines) + "\n"
+        out = "\n".join(lines) + "\n"
+        for name, tbl in tables:
+            out += f"\n[{name}]\n"
+            for sk, sv in tbl.items():
+                out += f'"{sk}" = "{sv}"\n'
+        return out
 
 
 def load_config(path: Optional[str] = None,
@@ -341,6 +401,15 @@ def load_config(path: Optional[str] = None,
         settable = {f.name for f in fields(cfg)}
         for k, v in data.items():
             k = k.replace("-", "_")
+            if k == "failpoints":
+                # Site names carry dots ("client.connect") — the table
+                # stays a dict instead of flattening to field names.
+                if not isinstance(v, dict):
+                    raise ValueError("[failpoints] must be a table of "
+                                     "site = \"spec\" entries")
+                cfg.failpoints = {str(sk): str(sv)
+                                  for sk, sv in v.items()}
+                continue
             if isinstance(v, dict):
                 # TOML table, e.g. [coalescer] window_ms = 2.0 -> the
                 # flat coalescer_window_ms field (reference nests its
@@ -367,6 +436,21 @@ def load_config(path: Optional[str] = None,
                 setattr(cfg, k, float(env))
             elif isinstance(cur, list):
                 setattr(cfg, k, [s for s in env.split(",") if s])
+            elif isinstance(cur, dict):
+                # PILOSA_TPU_FAILPOINTS="site=spec;site=spec" — env
+                # entries merge over (and win against) the TOML table.
+                merged = dict(cur)
+                for part in env.split(";"):
+                    part = part.strip()
+                    if not part:
+                        continue
+                    if "=" not in part:
+                        raise ValueError(
+                            f"bad {ENV_PREFIX}{k.upper()} entry "
+                            f"{part!r} (want site=spec)")
+                    name, spec = part.split("=", 1)
+                    merged[name.strip()] = spec.strip()
+                setattr(cfg, k, merged)
             else:
                 setattr(cfg, k, env)
     for k, v in (overrides or {}).items():

@@ -1,0 +1,287 @@
+"""Fault-injection plane: named failpoint sites on the seams that can
+actually fail in production.
+
+The resilience work (ROADMAP item 3) needs faults that are *cheap to
+inject and exact to place*: a connect refusal on one RPC, a torn
+response body, a slow resize pull, a dead heartbeat target. Process
+signals (SIGSTOP/SIGKILL, tests/test_cluster_procs.py) prove the
+end-to-end story but cannot aim at one seam; this registry can. The
+design follows the failpoint idiom the reference ecosystem uses for
+exactly this (pingcap/failpoint, gofail): sites are *registered once at
+module import* and *fired* at the seam; a disarmed site is one
+attribute read.
+
+Sites (the catalog, mirrored in docs/architecture.md):
+
+=====================  ====================================================
+``client.connect``     ``InternalClient._req`` before the connection is
+                       acquired — error/partition surface as transport
+                       ``ClientError``.
+``client.read``        after the request is written, before the response
+                       is read — a reused keep-alive connection takes the
+                       stale-retry path first, exactly like a real
+                       mid-flight close.
+``client.5xx``         forces a synthetic ``500`` ``ClientError`` as if
+                       the peer answered it.
+``client.torn_body``   truncates the response payload in half — the
+                       parse below raises a NON-``ClientError``
+                       (``ValueError``/``WireError``), the class of
+                       failure that silently undercounted before this PR.
+``resize.pull``        ``ResizePuller._maybe_pull`` per (peer, shard)
+                       fragment fetch — error fails the pull pass (the
+                       resize job stays RESIZING), delay holds the
+                       cluster mid-resize so chaos can strike inside the
+                       window.
+``resize.job.rpc``     the coordinator's per-node resize-pull RPC in the
+                       resize job (``server/api.py _start_resize_job``).
+``heartbeat.probe``    one heartbeat probe about to be sent — error
+                       counts as a failed probe (drives ``mark_down``),
+                       drop skips the probe entirely.
+``api.status``         the ``/status`` answer (what heartbeat probes
+                       hit): arming ``error`` here makes THIS node look
+                       dead to every prober without stopping its data
+                       plane.
+``api.query``          the query entry on THIS node — arming ``error``
+                       makes every query leg routed here fail (the
+                       failpoint "kill": coordinators must fail over).
+=====================  ====================================================
+
+Spec syntax (env ``PILOSA_TPU_FAILPOINTS``, ``[failpoints]`` config
+table, ``POST /internal/failpoints``)::
+
+    site=mode[(arg)][xN] [; site=... ]
+
+    client.connect=error                # every fire raises
+    client.read=errorx2                 # first 2 fires raise, then disarm
+    resize.pull=delay(1.5)              # sleep 1.5 s per fire
+    client.connect=partition(:10102)    # raise only when the target URI
+                                        # contains ":10102"
+    heartbeat.probe=drop                # silently swallow the operation
+
+Modes: ``error`` raises :class:`FailpointError` (a ``ConnectionError``
+subclass, so client seams surface it exactly like a real transport
+failure); ``drop`` raises :class:`FailpointDrop` (sites that can lose an
+operation silently interpret it; everywhere else it's an error);
+``delay(seconds)`` sleeps and continues; ``partition(substr)`` raises
+only when the fire context's ``uri``/``url`` contains ``substr`` — a
+directional network partition.
+
+Zero overhead disarmed: ``Site.fire()`` returns on one ``self.spec is
+None`` read; no lock, no dict lookup, no string work. The registry lock
+guards arm/disarm only.
+
+The HTTP surface is test-only: ``cli/main.py`` enables it when any
+failpoint configuration is present at boot (env or config) —
+production servers that never opt in answer 403. graftlint GL013 pins
+that every site name is registered exactly once, at module level.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+from pilosa_tpu.utils.locks import make_lock
+
+ENV_VAR = "PILOSA_TPU_FAILPOINTS"
+
+
+class FailpointError(ConnectionError):
+    """An injected failure. Subclasses ConnectionError so the client
+    seams it fires on treat it exactly like a real transport error."""
+
+
+class FailpointDrop(FailpointError):
+    """An injected silent loss: sites that can drop an operation
+    (heartbeat probes) swallow it; everywhere else it is an error."""
+
+
+_SPEC_RE = re.compile(
+    r"^(?P<mode>error|drop|delay|partition)"
+    r"(?:\((?P<arg>[^)]*)\))?"
+    r"(?:x(?P<count>\d+))?$")
+
+
+class _Spec:
+    __slots__ = ("mode", "arg", "remaining", "raw")
+
+    def __init__(self, mode: str, arg: str, remaining: int,
+                 raw: str) -> None:
+        self.mode = mode
+        self.arg = arg
+        self.remaining = remaining  # fires left; -1 = unlimited
+        self.raw = raw
+
+
+def parse_spec(text: str) -> _Spec:
+    m = _SPEC_RE.match(text.strip())
+    if m is None:
+        raise ValueError(f"bad failpoint spec {text!r} (expected "
+                         f"mode[(arg)][xN], mode in error/drop/delay/"
+                         f"partition)")
+    mode = m.group("mode")
+    arg = m.group("arg") or ""
+    if mode == "delay":
+        try:
+            float(arg or "x")
+        except ValueError:
+            raise ValueError(
+                f"delay spec needs numeric seconds: {text!r}") from None
+    if mode == "partition" and not arg:
+        raise ValueError(
+            f"partition spec needs a URI substring: {text!r}")
+    count = m.group("count")
+    return _Spec(mode, arg, int(count) if count else -1, text.strip())
+
+
+class Site:
+    """One registered failpoint site. ``spec`` is None when disarmed —
+    the ONLY state the hot path reads."""
+
+    __slots__ = ("name", "spec", "hits", "_registry")
+
+    def __init__(self, name: str, registry: "FailpointRegistry") -> None:
+        self.name = name
+        self.spec: Optional[_Spec] = None
+        self.hits = 0
+        self._registry = registry
+
+    def fire(self, **ctx: Any) -> None:
+        """Evaluate the site. Disarmed: one attribute read, return.
+        Armed: sleep (delay), raise FailpointError (error /
+        partition-on-match) or FailpointDrop (drop). Count-limited
+        specs self-disarm after their last fire."""
+        spec = self.spec
+        if spec is None:
+            return
+        self._registry._fire(self, spec, ctx)
+
+
+class FailpointRegistry:
+    """Process-wide site registry. Sites register at module import
+    (exactly once — GL013 pins it); activation comes from the env, the
+    ``[failpoints]`` config table, or the test-only HTTP surface."""
+
+    def __init__(self) -> None:
+        self._lock = make_lock("FailpointRegistry._lock")
+        self._sites: Dict[str, Site] = {}
+        # Test-only HTTP surface gate (POST /internal/failpoints):
+        # cli/main.py sets this when any failpoint config is present at
+        # boot; in-process tests set it directly.
+        self.http_enabled = False
+        self.fired_total = 0
+
+    # ------------------------------------------------------- registration
+
+    def register(self, name: str) -> Site:
+        """Register a site name (module-import time). Raises on
+        duplicates: two sites sharing a name would make arm() ambiguous
+        and the catalog a lie."""
+        with self._lock:
+            if name in self._sites:
+                raise ValueError(f"failpoint {name!r} registered twice")
+            site = Site(name, self)
+            # graftlint: disable=GL008 — bounded by the static site
+            # catalog: register() runs once per site at module import
+            # (GL013 pins exactly-once), never on a request path.
+            self._sites[name] = site
+            return site
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._sites)
+
+    # --------------------------------------------------------- activation
+
+    def arm(self, name: str, spec: str) -> None:
+        parsed = parse_spec(spec)
+        with self._lock:
+            site = self._sites.get(name)
+            if site is None:
+                raise KeyError(f"unknown failpoint {name!r} "
+                               f"(registered: {sorted(self._sites)})")
+            site.spec = parsed
+
+    def disarm(self, name: str) -> None:
+        with self._lock:
+            site = self._sites.get(name)
+            if site is None:
+                raise KeyError(f"unknown failpoint {name!r}")
+            site.spec = None
+
+    def disarm_all(self) -> None:
+        with self._lock:
+            for site in self._sites.values():
+                site.spec = None
+
+    def configure(self, mapping: Optional[Dict[str, str]] = None,
+                  env: Optional[str] = None) -> None:
+        """Boot-time activation: a ``[failpoints]`` config table and/or
+        the ``PILOSA_TPU_FAILPOINTS`` env string
+        (``site=spec;site=spec``). Env wins on conflicts, matching the
+        config precedence everywhere else. Unknown site names raise —
+        a typo must not silently disarm a chaos run."""
+        specs: Dict[str, str] = dict(mapping or {})
+        text = os.environ.get(ENV_VAR, "") if env is None else env
+        for part in text.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(
+                    f"bad {ENV_VAR} entry {part!r} (want site=spec)")
+            name, spec = part.split("=", 1)
+            specs[name.strip()] = spec.strip()
+        for name, spec in specs.items():
+            self.arm(name, str(spec))
+
+    # -------------------------------------------------------------- fire
+
+    def _fire(self, site: Site, spec: _Spec, ctx: Dict[str, Any]) -> None:
+        if spec.mode == "partition":
+            target = str(ctx.get("uri") or ctx.get("url") or "")
+            if spec.arg not in target:
+                return
+        with self._lock:
+            # Re-read under the lock: a concurrent disarm wins.
+            if site.spec is not spec:
+                return
+            if spec.remaining == 0:
+                site.spec = None
+                return
+            if spec.remaining > 0:
+                spec.remaining -= 1
+                if spec.remaining == 0:
+                    site.spec = None
+            site.hits += 1
+            self.fired_total += 1
+        if spec.mode == "delay":
+            time.sleep(float(spec.arg))
+            return
+        if spec.mode == "drop":
+            raise FailpointDrop(f"failpoint {site.name}: drop")
+        raise FailpointError(
+            f"failpoint {site.name}: {spec.mode}"
+            + (f"({spec.arg})" if spec.arg else ""))
+
+    # --------------------------------------------------------- reporting
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The GET /internal/failpoints document + the health-plane
+        stanza: every registered site, its armed spec (or null) and
+        cumulative hit count."""
+        with self._lock:
+            sites = {
+                name: {"armed": s.spec.raw if s.spec else None,
+                       "hits": s.hits}
+                for name, s in sorted(self._sites.items())
+            }
+            armed = sum(1 for s in self._sites.values()
+                        if s.spec is not None)
+            return {"sites": sites, "armed": armed,
+                    "fired": self.fired_total}
+
+
+FAILPOINTS = FailpointRegistry()

@@ -1,0 +1,15 @@
+"""GL013 fixtures: duplicate registration, in-function registration,
+computed name. Expected findings: 3."""
+
+from pilosa_tpu.utils.failpoints import FAILPOINTS
+
+_FP_A = FAILPOINTS.register("fixture.site_a")
+_FP_DUP = FAILPOINTS.register("fixture.site_a")  # duplicate name
+
+_NAME = "fixture." + "computed"
+_FP_C = FAILPOINTS.register(_NAME)  # not a string literal
+
+
+def lazy_register():
+    # registered per call — the second call raises at runtime
+    return FAILPOINTS.register("fixture.lazy")

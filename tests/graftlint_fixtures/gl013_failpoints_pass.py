@@ -1,0 +1,13 @@
+"""GL013 passing fixture: unique literal names at module level; local
+registries (test fixtures) are out of scope. Expected findings: 0."""
+
+from pilosa_tpu.utils.failpoints import FAILPOINTS, FailpointRegistry
+
+_FP_OK = FAILPOINTS.register("fixture.pass_site")
+
+
+def test_scoped_registry():
+    # A LOCAL registry may register wherever it likes — only the
+    # process-wide FAILPOINTS carries the catalog contract.
+    reg = FailpointRegistry()
+    return reg.register("fixture.local")

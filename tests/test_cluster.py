@@ -1974,3 +1974,449 @@ def test_cluster_timeline_reports_unreachable_node(tmp_path):
         nodes[2].holder.close()
         for nd in nodes[:2]:
             nd.stop()
+
+
+# ---------------------------------------------------------------------
+# Resilience plane (fan-out hardening + fault injection + placement
+# epoch guard — docs/architecture.md "Resilience plane").
+
+
+def _seed_bits(base, index="ci", field="f", shards=6):
+    req(base, "POST", f"/index/{index}", {"options": {}})
+    req(base, "POST", f"/index/{index}/field/{field}", {"options": {}})
+    cols = [s * SHARD_WIDTH + 1 for s in range(shards)]
+    req(base, "POST", f"/index/{index}/field/{field}/import",
+        {"rowIDs": [1] * shards, "columnIDs": cols})
+    return cols
+
+
+def test_scatter_leg_nonclient_error_fails_over(tmp_path):
+    """The silent-undercount regression (ISSUE 15 satellite 1): a
+    non-ClientError from a scatter leg (here a stubbed ValueError — a
+    torn-body JSON decode in production) must mark the leg failed and
+    fail over, never merge short. Before the fix the exception killed
+    the thread with `failed` still False and the merge undercounted."""
+    nodes = run_cluster(tmp_path, 2, replica_n=2)
+    try:
+        base = nodes[0].uri
+        _seed_bits(base)
+        ce = nodes[0].api.cluster_executor
+        real = ce.client.query_node_full
+
+        def torn(uri, *a, **kw):
+            raise ValueError("torn response body")
+        ce.client.query_node_full = torn
+        # replica_n=2: every shard also lives locally, so failover must
+        # serve the exact answer with zero remote help.
+        res = req(base, "POST", "/index/ci/query", b"Count(Row(f=1))")
+        assert res["results"] == [6]
+        counters = nodes[0].api.stats.snapshot()["counters"]
+        assert counters.get("cluster.partition_losses", 0) >= 1
+        assert counters.get("cluster.failovers", 0) >= 1
+        ce.client.query_node_full = real
+        res = req(base, "POST", "/index/ci/query", b"Count(Row(f=1))")
+        assert res["results"] == [6]
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_marked_down_node_receives_zero_rpcs(tmp_path):
+    """Pre-seeded exclusion (satellite 2): a node the failure detector
+    marked down must receive ZERO query RPCs — proactive failover
+    instead of paying a full client timeout per request."""
+    nodes = run_cluster(tmp_path, 2, replica_n=2)
+    try:
+        base = nodes[0].uri
+        _seed_bits(base)
+        ce = nodes[0].api.cluster_executor
+        calls = []
+        real = ce.client.query_node_full
+
+        def counting(uri, *a, **kw):
+            calls.append(uri)
+            return real(uri, *a, **kw)
+        ce.client.query_node_full = counting
+        down_id = nodes[1].api.cluster.local.id
+        assert nodes[0].api.cluster.mark_down(down_id)
+        for _ in range(5):
+            res = req(base, "POST", "/index/ci/query",
+                      b"Count(Row(f=1))")
+            assert res["results"] == [6]
+        assert nodes[1].uri not in calls, calls
+        counters = nodes[0].api.stats.snapshot()["counters"]
+        assert counters.get("cluster.excluded_nodes", 0) >= 5
+        # Recovery: marked up again, RPCs resume.
+        nodes[0].api.cluster.mark_up(down_id)
+        for _ in range(5):
+            req(base, "POST", "/index/ci/query", b"Count(Row(f=1))")
+        assert nodes[1].uri in calls
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_down_replicas_readmitted_as_last_resort(tmp_path):
+    """A stale detector verdict must not fail a servable request: a
+    shard whose every candidate is down-marked still routes to the
+    down node as last resort rather than erroring (replica_n=1 ->
+    node 1's shards have no other home)."""
+    nodes = run_cluster(tmp_path, 2, replica_n=1)
+    try:
+        base = nodes[0].uri
+        _seed_bits(base)
+        down_id = nodes[1].api.cluster.local.id
+        assert nodes[0].api.cluster.mark_down(down_id)
+        res = req(base, "POST", "/index/ci/query", b"Count(Row(f=1))")
+        assert res["results"] == [6]  # served THROUGH the down-marked node
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_fanout_deadline_bounds_wedged_peer(tmp_path):
+    """The per-request deadline budget: a wedged peer (stub sleeping
+    far past it) fails the request within the budget instead of
+    holding it for the flat client timeout."""
+    import time as _t
+    nodes = run_cluster(tmp_path, 2, replica_n=1)
+    try:
+        base = nodes[0].uri
+        _seed_bits(base)
+        ce = nodes[0].api.cluster_executor
+        ce.configure(fanout_deadline_s=0.4, backoff_base_s=0.01,
+                     backoff_cap_s=0.02)
+
+        def wedged(uri, *a, **kw):
+            _t.sleep(5.0)
+            raise AssertionError("unreachable")
+        ce.client.query_node_full = wedged
+        t0 = _t.monotonic()
+        with pytest.raises(urllib.error.HTTPError):
+            req(base, "POST", "/index/ci/query", b"Count(Row(f=1))")
+        assert _t.monotonic() - t0 < 3.0  # not the 5 s stub, never 30 s
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_hedged_read_serves_from_replica(tmp_path):
+    """Hedged reads: a leg slower than the configured latency quantile
+    re-issues to the spare replica; first success wins, the settle
+    latch keeps the merge exact (never double-counted)."""
+    import time as _t
+    nodes = run_cluster(tmp_path, 3, replica_n=2)
+    try:
+        base = nodes[0].uri
+        c0 = nodes[0].api.cluster
+        # Find a shard whose owners are exactly nodes 1 and 2 — the
+        # hedge then has a single non-local alternative.
+        ids = {nd.api.cluster.local.id: nd for nd in nodes}
+        local_id = c0.local.id
+        shard = next(
+            s for s in range(64)
+            if local_id not in [n.id for n in c0.shard_nodes("ci", s)])
+        owners = [n.id for n in c0.shard_nodes("ci", shard)]
+        slow_id, fast_id = owners[0], owners[1]
+        req(base, "POST", "/index/ci", {"options": {}})
+        req(base, "POST", "/index/ci/field/f", {"options": {}})
+        req(base, "POST", "/index/ci/field/f/import",
+            {"rowIDs": [1, 1], "columnIDs": [shard * SHARD_WIDTH + 1,
+                                             shard * SHARD_WIDTH + 2]})
+        ce = nodes[0].api.cluster_executor
+        ce.configure(hedge_quantile=0.5)
+        ce._leg_lat.extend([0.01] * 16)
+        real = ce.client.query_node_full
+        slow_uri = ids[slow_id].uri
+
+        def slow_primary(uri, *a, **kw):
+            if uri == slow_uri:
+                _t.sleep(1.0)
+            return real(uri, *a, **kw)
+        ce.client.query_node_full = slow_primary
+        t0 = _t.monotonic()
+        res = req(base, "POST", "/index/ci/query",
+                  b"Count(Row(f=1))")
+        dur = _t.monotonic() - t0
+        assert res["results"] == [2]  # exact: hedge merged exactly once
+        assert dur < 0.9, dur  # answered from the hedge, not the sleeper
+        counters = nodes[0].api.stats.snapshot()["counters"]
+        assert counters.get("cluster.hedged_reads", 0) >= 1
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_mid_join_routing_never_targets_unpulled_joiner(tmp_path):
+    """Chaos-harness regression (the live find): routing must make the
+    RESIZING check atomically with the placement math. A join landing
+    between a separate state read and shards_by_node once routed a
+    shard to the unpulled joiner, which answered without error and the
+    TopN merge silently lost one shard."""
+    nodes = run_cluster(tmp_path, 2, replica_n=1)
+    try:
+        base = nodes[0].uri
+        _seed_bits(base)
+        c0 = nodes[0].api.cluster
+        c0.begin_resize()
+        c0.add_node(Node("zzz-unpulled-joiner", "http://127.0.0.1:1"))
+        by_node, previous = c0.route_shards("ci", list(range(6)))
+        assert previous is True
+        assert "zzz-unpulled-joiner" not in by_node
+        # Queries during the pinned window keep routing to data holders.
+        res = req(base, "POST", "/index/ci/query", b"Count(Row(f=1))")
+        assert res["results"] == [6]
+        res = req(base, "POST", "/index/ci/query", b"TopN(f, n=1)")
+        assert res["results"] == [[{"id": 1, "count": 6}]]
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_placement_change_invalidates_cache_entries(tmp_path):
+    """The placement epoch guard: eval-tier result-cache entries whose
+    shard ownership moved in a resize are provably dropped at the
+    adoption point (PR 10's epoch pattern keyed on placement)."""
+    nodes = run_cluster(tmp_path, 1, replica_n=1)
+    try:
+        base = nodes[0].uri
+        _seed_bits(base)
+        # Warm the eval tier (the second run records the hit path; the
+        # first fills).
+        for _ in range(3):
+            res = req(base, "POST", "/index/ci/query",
+                      b"Count(Row(f=1))")
+            assert res["results"] == [6]
+        api0 = nodes[0].api
+        rc = api0.executor.result_cache
+        eval_keys = [k for k in rc._entries
+                     if isinstance(k, tuple) and k and k[0] == "eval"]
+        assert eval_keys, "eval tier never filled"
+        c0 = api0.cluster
+        gen0 = c0.placement_gen
+        c0.begin_resize()
+        c0.add_node(Node("zzz-joiner", "http://127.0.0.1:1"))
+        moved = api0._moved_shards()
+        assert moved, "adding a member moved no shard ownership"
+        c0.end_resize()
+        api0._note_placement_change(moved)
+        assert c0.placement_gen > gen0
+        assert rc.placement_invalidations >= 1
+        left = [k for k in rc._entries
+                if isinstance(k, tuple) and k and k[0] == "eval"
+                and any((k[1], int(s)) in moved for s in k[3])]
+        assert not left, f"moved-shard entries survived: {left}"
+        counters = api0.stats.snapshot()["counters"]
+        assert counters.get("cluster.placement_invalidations", 0) >= 1
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_rank_cache_invalidate_shards_unit():
+    from pilosa_tpu.core.cache import RankCacheStore, RankEntry
+
+    class _View:
+        index = "ci"
+        field = "f"
+        name = "standard"
+
+    store = RankCacheStore(max_entries=8)
+    v1, v2 = _View(), _View()
+    v2.index = "other"
+    store.put(v1, ("k1",), RankEntry({0: 1, 3: 2}, (1, 2), None, 16))
+    store.put(v2, ("k2",), RankEntry({0: 1}, (1,), None, 8))
+    assert store.invalidate_shards(set()) == 0
+    assert store.invalidate_shards({("ci", 7)}) == 0
+    assert store.invalidate_shards({("ci", 3)}) == 1  # v1 covers shard 3
+    assert len(store) == 1 and store.placement_invalidations == 1
+    assert store.invalidate_shards({("other", 0)}) == 1
+    assert len(store) == 0
+    assert store.snapshot()["placementInvalidations"] == 2
+
+
+def test_cluster_lifecycle_events_and_timeline(tmp_path):
+    """Kill/recovery verdicts and resize transitions are visible in
+    the health plane and the cluster lifecycle timeline — the planes
+    the chaos harness asserts against."""
+    nodes = run_cluster(tmp_path, 2, replica_n=1)
+    try:
+        base = nodes[0].uri
+        c0 = nodes[0].api.cluster
+        down_id = nodes[1].api.cluster.local.id
+        assert c0.mark_down(down_id)
+        assert c0.mark_up(down_id)
+        c0.begin_resize()
+        c0.end_resize()
+        health = req(base, "GET", "/internal/health")
+        kinds = [e["type"] for e in health["clusterEvents"]]
+        for want in ("node-down", "node-up", "resize-begin",
+                     "resize-complete"):
+            assert want in kinds, (want, kinds)
+        assert "failpoints" in health and "armed" in health["failpoints"]
+        assert health["placementGen"] >= 1
+        tl = req(base, "GET", "/cluster/timeline")
+        got = {e["type"] for e in tl["events"]}
+        assert {"node-down", "node-up"} <= got
+        # Perfetto-loadable: instants carry ph/ts/pid and the observer.
+        inst = [e for e in tl["traceEvents"] if e.get("ph") == "i"]
+        assert inst and all("ts" in e and "pid" in e for e in inst)
+        down_evs = [e for e in tl["events"] if e["type"] == "node-down"]
+        assert any(e.get("node") == down_id for e in down_evs)
+        assert all("observer" in e for e in tl["events"])
+    finally:
+        for nd in nodes:
+            nd.stop()
+
+
+def test_failpoint_5xx_kill_and_disarmed_identity(tmp_path):
+    """A failpoint-killed peer (client.5xx scoped to its port) fails
+    over bit-exactly; with everything disarmed the same queries serve
+    identically — the disarmed-is-identical pin."""
+    from pilosa_tpu.utils.failpoints import FAILPOINTS
+    nodes = run_cluster(tmp_path, 2, replica_n=2)
+    try:
+        base = nodes[0].uri
+        _seed_bits(base)
+        want = req(base, "POST", "/index/ci/query",
+                   b"Count(Row(f=1)) Row(f=1)")["results"]
+        port1 = nodes[1].uri.rsplit(":", 1)[1]
+        FAILPOINTS.arm("client.5xx", f"partition(:{port1})")
+        for _ in range(4):
+            res = req(base, "POST", "/index/ci/query",
+                      b"Count(Row(f=1)) Row(f=1)")
+            assert res["results"] == want
+        assert FAILPOINTS.snapshot()["sites"]["client.5xx"]["hits"] > 0
+        FAILPOINTS.disarm_all()
+        for _ in range(4):
+            res = req(base, "POST", "/index/ci/query",
+                      b"Count(Row(f=1)) Row(f=1)")
+            assert res["results"] == want
+    finally:
+        FAILPOINTS.disarm_all()
+        for nd in nodes:
+            nd.stop()
+
+
+def test_resize_puller_source_order_unit():
+    """_source_order (satellite 4): pre-change owners first (they
+    served every write of the ending epoch), then current owners,
+    then any other holder."""
+    from types import SimpleNamespace as NS
+
+    from pilosa_tpu.parallel.syncer import ResizePuller
+    n = {i: NS(id=f"n{i}", uri=f"u{i}") for i in range(4)}
+
+    class FC:
+        def shard_nodes(self, index, shard, previous=False):
+            return [n[1], n[2]] if previous else [n[2], n[3]]
+
+    rp = ResizePuller(holder=None, cluster=FC(), client=NS())
+    order = rp._source_order("i", 0, [n[0], n[3], n[2], n[1]])
+    assert [x.id for x in order] == ["n1", "n2", "n3", "n0"]
+    # Holders missing from either placement keep their position at the
+    # tail; placement nodes not holding the shard are skipped.
+    order = rp._source_order("i", 0, [n[0], n[3]])
+    assert [x.id for x in order] == ["n3", "n0"]
+
+
+def test_resize_puller_regain_ownership_refreshes(tmp_path):
+    """Satellite 4, the regain-ownership path: a node re-acquiring a
+    shard must REFRESH from the authoritative pre-change owner
+    (replace_with_bytes — never trust the stale local copy, which may
+    resurrect bits cleared while it wasn't an owner)."""
+    from types import SimpleNamespace as NS
+
+    import numpy as np
+
+    from pilosa_tpu.parallel.syncer import ResizePuller
+
+    # Authoritative copy: bits (0,2),(0,3).
+    h_auth = Holder(str(tmp_path / "auth"))
+    h_auth.open()
+    fa = h_auth.create_index("ri",
+                             track_existence=False).create_field("rf")
+    fa.import_bits(np.array([0, 0], np.uint64),
+                   np.array([2, 3], np.uint64))
+    auth_bytes = fa.view().fragment(0).write_bytes()
+    h_auth.close()
+
+    # Local stale copy: bit (0,1) — cleared upstream while this node
+    # wasn't an owner.
+    h = Holder(str(tmp_path / "local"))
+    h.open()
+    idx = h.create_index("ri", track_existence=False)
+    f = idx.create_field("rf")
+    f.import_bits(np.array([0], np.uint64), np.array([1], np.uint64))
+
+    class Client:
+        def views(self, uri, index, field):
+            return ["standard"]
+
+        def retrieve_shard(self, uri, index, field, view, shard):
+            return auth_bytes
+
+    class FC:
+        def owns_shard(self, index, shard):
+            return True
+
+    rp = ResizePuller(h, FC(), client=Client())
+    peer = NS(id="peer", uri="u-peer")
+    # Held and NOT refreshing (was already an owner): untouched.
+    assert rp._maybe_pull(peer, idx, 0, refresh=False) == 0
+    frag = f.view().fragment(0)
+    assert sorted(frag.row_columns(0).tolist()) == [1]
+    # Regained ownership: refresh replaces with the authoritative copy.
+    assert rp._maybe_pull(peer, idx, 0, refresh=True) == 1
+    frag = f.view().fragment(0)
+    assert sorted(frag.row_columns(0).tolist()) == [2, 3]
+    h.close()
+
+
+def test_pull_owned_regain_sets_refresh(tmp_path):
+    """_pull_owned_locked computes refresh=not was_owner: a node in
+    the CURRENT owner set but not the PREVIOUS one pulls with
+    refresh=True; a previous-epoch owner pulls refresh=False."""
+    from types import SimpleNamespace as NS
+
+    from pilosa_tpu.parallel.syncer import ResizePuller
+
+    h = Holder(str(tmp_path / "h"))
+    h.open()
+    h.create_index("ri").create_field("rf")
+
+    local = NS(id="me", uri="u-me")
+    peer = NS(id="peer", uri="u-peer")
+
+    class Client:
+        def schema(self, uri):
+            return {"indexes": [{"name": "ri", "options": {},
+                                 "fields": [{"name": "rf",
+                                             "options": {}}],
+                                 "shards": [0]}]}
+
+    class FC:
+        def __init__(self, was_owner):
+            self.local = local
+            self.was_owner = was_owner
+
+        def known_nodes(self):
+            return [local, peer]
+
+        def owns_shard(self, index, shard):
+            return True
+
+        def shard_nodes(self, index, shard, previous=False):
+            if previous:
+                return [local, peer] if self.was_owner else [peer]
+            return [local]
+
+    seen = []
+    for was_owner in (True, False):
+        rp = ResizePuller(h, FC(was_owner), client=Client())
+        rp._maybe_pull = lambda p, idx, s, refresh=False: (
+            seen.append(refresh), 0)[1]
+        rp.pull_owned()
+    assert seen[0] is False   # previous owner: copy is current
+    assert seen[-1] is True   # regained: must refresh
+    h.close()

@@ -1,0 +1,279 @@
+"""Fault-injection plane tests (pilosa_tpu/utils/failpoints.py): spec
+parsing, mode semantics, count exhaustion, the registry contract, the
+test-only HTTP surface gate, and the real client seams — including the
+pin that a fully DISARMED registry changes nothing."""
+
+import json
+import urllib.request
+
+import pytest
+
+# Imported for their side effect: seam modules register their failpoint
+# sites at import (client.*, heartbeat.probe, resize.pull) — a bare
+# single-node API would otherwise never load them.
+import pilosa_tpu.parallel.client  # noqa: F401
+import pilosa_tpu.parallel.heartbeat  # noqa: F401
+import pilosa_tpu.parallel.syncer  # noqa: F401
+from pilosa_tpu.utils.failpoints import (
+    FAILPOINTS, FailpointDrop, FailpointError, FailpointRegistry,
+    parse_spec,
+)
+
+
+# ----------------------------------------------------------- spec parse
+
+
+def test_parse_spec_forms():
+    s = parse_spec("error")
+    assert (s.mode, s.arg, s.remaining) == ("error", "", -1)
+    s = parse_spec("errorx3")
+    assert (s.mode, s.remaining) == ("error", 3)
+    s = parse_spec("delay(0.25)")
+    assert (s.mode, s.arg) == ("delay", "0.25")
+    s = parse_spec("partition(:10102)x2")
+    assert (s.mode, s.arg, s.remaining) == ("partition", ":10102", 2)
+    s = parse_spec("drop")
+    assert s.mode == "drop"
+
+
+@pytest.mark.parametrize("bad", [
+    "explode", "", "error(x", "delay", "delay(abc)", "partition",
+    "partition()", "errorx", "error x2",
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        parse_spec(bad)
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_register_duplicate_raises():
+    reg = FailpointRegistry()
+    reg.register("a.site")
+    with pytest.raises(ValueError, match="registered twice"):
+        reg.register("a.site")
+
+
+def test_arm_unknown_site_raises():
+    reg = FailpointRegistry()
+    with pytest.raises(KeyError, match="unknown failpoint"):
+        reg.arm("nope", "error")
+    with pytest.raises(KeyError):
+        reg.disarm("nope")
+
+
+def test_disarmed_fire_is_noop():
+    reg = FailpointRegistry()
+    site = reg.register("quiet")
+    site.fire(uri="anything")  # no raise, no state
+    assert site.hits == 0
+    assert reg.snapshot() == {
+        "sites": {"quiet": {"armed": None, "hits": 0}},
+        "armed": 0, "fired": 0}
+
+
+def test_error_drop_delay_partition_modes():
+    import time
+    reg = FailpointRegistry()
+    err = reg.register("e")
+    drp = reg.register("d")
+    dly = reg.register("s")
+    par = reg.register("p")
+    reg.configure({"e": "error", "d": "drop", "s": "delay(0.01)",
+                   "p": "partition(:9999)"}, env="")
+    with pytest.raises(FailpointError):
+        err.fire()
+    with pytest.raises(FailpointDrop):
+        drp.fire()
+    t0 = time.perf_counter()
+    dly.fire()  # sleeps, continues
+    assert time.perf_counter() - t0 >= 0.01
+    par.fire(uri="http://h:1234/x")  # no match: silent
+    with pytest.raises(FailpointError):
+        par.fire(uri="http://h:9999/x")
+    # FailpointError is ConnectionError-shaped so client seams treat it
+    # exactly like a real transport failure.
+    assert issubclass(FailpointError, ConnectionError)
+    snap = reg.snapshot()
+    assert snap["fired"] == 4  # the unmatched partition fire is free
+    assert snap["sites"]["p"]["hits"] == 1
+
+
+def test_count_exhaustion_self_disarms():
+    reg = FailpointRegistry()
+    site = reg.register("limited")
+    reg.arm("limited", "errorx2")
+    for _ in range(2):
+        with pytest.raises(FailpointError):
+            site.fire()
+    site.fire()  # exhausted: disarmed
+    assert site.spec is None
+    assert reg.snapshot()["sites"]["limited"] == {"armed": None,
+                                                  "hits": 2}
+
+
+def test_configure_env_string_and_unknown_name():
+    reg = FailpointRegistry()
+    a = reg.register("a")
+    reg.register("b")
+    reg.configure({"a": "delay(0)"}, env="b=errorx1; a=error")
+    # env wins over the mapping for the same site
+    assert a.spec is not None and a.spec.mode == "error"
+    with pytest.raises(KeyError):
+        reg.configure({"typo.site": "error"}, env="")
+
+
+# ------------------------------------------------------- http surface
+
+
+def _api(tmp_path):
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.server.api import API
+    holder = Holder(str(tmp_path / "fp"))
+    holder.open()
+    return API(holder), holder
+
+
+def test_http_surface_gated(tmp_path):
+    from pilosa_tpu.server.api import ApiError
+    api, holder = _api(tmp_path)
+    was = FAILPOINTS.http_enabled
+    try:
+        FAILPOINTS.http_enabled = False
+        with pytest.raises(ApiError) as ei:
+            api.failpoints_snapshot()
+        assert ei.value.status == 403
+        with pytest.raises(ApiError):
+            api.failpoints_update({"arm": {"api.query": "error"}})
+        FAILPOINTS.http_enabled = True
+        snap = api.failpoints_snapshot()
+        assert "client.connect" in snap["sites"]
+        out = api.failpoints_update(
+            {"arm": {"api.status": "delay(0)"}})
+        assert out["sites"]["api.status"]["armed"] == "delay(0)"
+        out = api.failpoints_update({"disarm_all": True})
+        assert out["armed"] == 0
+        with pytest.raises(ApiError) as ei:
+            api.failpoints_update({"arm": {"nope": "error"}})
+        assert ei.value.status == 400
+    finally:
+        FAILPOINTS.disarm_all()
+        FAILPOINTS.http_enabled = was
+        holder.close()
+
+
+def test_http_route_serves_and_gates(tmp_path):
+    from pilosa_tpu.server import serve
+    api, holder = _api(tmp_path)
+    server = serve(api, "localhost", 0, background=True)
+    port = server.server_address[1]
+    was = FAILPOINTS.http_enabled
+    try:
+        FAILPOINTS.http_enabled = True
+        body = json.dumps(
+            {"arm": {"heartbeat.probe": "dropx1"}}).encode()
+        r = urllib.request.Request(
+            f"http://localhost:{port}/internal/failpoints",
+            data=body, method="POST")
+        with urllib.request.urlopen(r, timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert doc["sites"]["heartbeat.probe"]["armed"] == "dropx1"
+        with urllib.request.urlopen(
+                f"http://localhost:{port}/internal/failpoints",
+                timeout=10) as resp:
+            doc = json.loads(resp.read())
+        assert doc["armed"] == 1
+        FAILPOINTS.disarm_all()
+        FAILPOINTS.http_enabled = False
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://localhost:{port}/internal/failpoints",
+                timeout=10)
+        assert ei.value.code == 403
+    finally:
+        FAILPOINTS.disarm_all()
+        FAILPOINTS.http_enabled = was
+        server.shutdown()
+        server.server_close()
+        holder.close()
+
+
+# ------------------------------------------------------- client seams
+
+
+def test_client_seams_inject_expected_shapes(tmp_path):
+    """The four InternalClient._req sites produce exactly the failure
+    classes the catalog documents: 5xx -> ClientError(status=500),
+    connect -> transport ClientError, torn body -> a NON-ClientError
+    parse failure (the silent-undercount class). Disarmed afterwards,
+    the same calls answer normally — the zero-overhead pin."""
+    from pilosa_tpu.parallel.client import ClientError, InternalClient
+    from pilosa_tpu.server import serve
+    api, holder = _api(tmp_path)
+    server = serve(api, "localhost", 0, background=True)
+    uri = f"http://localhost:{server.server_address[1]}"
+    client = InternalClient(timeout=10)
+    try:
+        baseline = client.status(uri)
+
+        FAILPOINTS.arm("client.5xx", "errorx1")
+        with pytest.raises(ClientError) as ei:
+            client.status(uri)
+        assert ei.value.status == 500
+
+        FAILPOINTS.arm("client.connect", "errorx1")
+        with pytest.raises(ClientError) as ei:
+            client.status(uri)
+        assert ei.value.status is None  # transport, not HTTP
+
+        FAILPOINTS.arm("client.torn_body", "errorx1")
+        with pytest.raises(Exception) as ei:
+            client.schema(uri)
+        assert not isinstance(ei.value, ClientError), ei.value
+
+        # drop mode on the torn site: the whole body is lost — the
+        # codec layer refuses it (non-ClientError), same class as torn
+        FAILPOINTS.arm("client.torn_body", "dropx1")
+        with pytest.raises(Exception) as ei:
+            client.status(uri)
+        assert not isinstance(ei.value, ClientError), ei.value
+
+        # partition scoped by URI substring: other targets unaffected
+        FAILPOINTS.arm("client.connect", "partition(:1)x1")
+        assert client.status(uri) == baseline  # no match, no fire
+
+        FAILPOINTS.disarm_all()
+        assert client.status(uri) == baseline  # disarmed = identical
+        snap = FAILPOINTS.snapshot()
+        assert snap["armed"] == 0 and snap["fired"] >= 4
+    finally:
+        FAILPOINTS.disarm_all()
+        server.shutdown()
+        server.server_close()
+        holder.close()
+
+
+def test_heartbeat_probe_site_drop_and_error(tmp_path):
+    """heartbeat.probe drop = probe lost (no verdict); error = failed
+    probe driving mark_down after suspect_after rounds."""
+    from pilosa_tpu.parallel.cluster import Cluster, Node, STATE_NORMAL
+    from pilosa_tpu.parallel.heartbeat import Heartbeater
+    c = Cluster(Node("n0", "http://127.0.0.1:1"), replica_n=1)
+    c.add_node(Node("n1", "http://127.0.0.1:9"))  # unreachable anyway
+    c.set_state(STATE_NORMAL)
+    hb = Heartbeater(c, interval=0, suspect_after=2, timeout=0.2)
+    try:
+        FAILPOINTS.arm("heartbeat.probe", "drop")
+        hb.probe_once()
+        hb.probe_once()
+        assert not c.down_ids  # lost probes carry no verdict
+        FAILPOINTS.arm("heartbeat.probe", "error")
+        hb.probe_once()
+        assert not c.down_ids  # one failure: suspect, not down
+        hb.probe_once()
+        assert "n1" in c.down_ids  # second consecutive: down
+        ev = [e["type"] for e in c.recent_events()]
+        assert "node-down" in ev
+    finally:
+        FAILPOINTS.disarm_all()

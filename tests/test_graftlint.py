@@ -36,6 +36,7 @@ def fixture_config() -> Config:
         effect_paths=("graftlint_fixtures/gl010",),
         ctypes_paths=("graftlint_fixtures/gl011",),
         plan_paths=("graftlint_fixtures/gl012",),
+        failpoint_paths=("graftlint_fixtures/gl013",),
     )
 
 
@@ -64,6 +65,7 @@ def codes_for(filename, config=None):
     ("gl010_pairs_fail.py", "gl010_pairs_pass.py", "GL010"),
     ("gl011_ctypes_fail.py", "gl011_ctypes_pass.py", "GL011"),
     ("gl012_planlaunch_fail.py", "gl012_planlaunch_pass.py", "GL012"),
+    ("gl013_failpoints_fail.py", "gl013_failpoints_pass.py", "GL013"),
 ])
 def test_rule_fixtures(fail_fixture, pass_fixture, code):
     fail_codes = codes_for(fail_fixture)
@@ -85,6 +87,22 @@ def test_gl012_counts_and_callgraph_leg():
     gl12 = [f for f in findings if f.code == "GL012"]
     assert len(gl12) == 2, gl12
     assert all("verify_plan" in f.message for f in gl12)
+
+
+def test_gl013_counts_and_kinds():
+    """Exactly three findings in the fail fixture — duplicate name,
+    computed name, in-function registration — and local
+    FailpointRegistry instances stay out of scope (the pass fixture's
+    test-scoped registry, pinned by the parametrized pair)."""
+    findings = lint_files(
+        [os.path.join(FIXTURES, "gl013_failpoints_fail.py")],
+        fixture_config())
+    gl13 = [f for f in findings if f.code == "GL013"]
+    assert len(gl13) == 3, gl13
+    msgs = " | ".join(f.message for f in gl13)
+    assert "registered twice" in msgs
+    assert "string literal" in msgs
+    assert "inside a function" in msgs
 
 
 def test_gl001_context_manager_is_not_a_lock():

@@ -1,0 +1,515 @@
+"""Chaos harness: zero-downtime resize under fault injection.
+
+Drives a REAL multi-process cluster (3 `pilosa-tpu server` processes +
+one seed-joining fourth) through the scenario ROADMAP item 3 demands
+proof of:
+
+1.  **Seed + oracle** — a deterministic corpus (distinct per-row counts,
+    so every merge order is tie-free) imported through node 0, and a
+    single-node in-process oracle loaded with the same corpus. Every
+    traffic response is compared against the oracle byte-for-byte.
+2.  **Resize window** — node 3 joins through a seed, triggering a
+    cluster resize; its resize pulls are slowed by the ``resize.pull``
+    failpoint (``delay``), holding the cluster in RESIZING long enough
+    for chaos to strike *inside* the window.
+3.  **Kill mid-resize** — node 2 is "killed" via failpoints
+    (``api.query=error`` + ``api.status=error`` over the test-only
+    ``POST /internal/failpoints`` surface): every query leg routed to
+    it fails and every heartbeat probe sees it dead, while live mixed
+    traffic keeps flowing through nodes 0/1. The harness asserts zero
+    request errors (failover + the shard-accounting guarantee) and
+    bit-exact results throughout.
+4.  **Recovery** — the failpoints disarm; the harness asserts the
+    node-down AND node-up verdicts are visible in ``/cluster/health``
+    and in the cluster lifecycle timeline (``GET /cluster/timeline``),
+    beside the resize-begin/resize-complete events.
+5.  **Torn-body bursts** — with the cluster NORMAL again, the
+    coordinator's own client is armed with one-shot torn response
+    bodies scoped to query legs (``client.torn_body =
+    partition(/query)x1``): the first scatter leg of a request parses
+    garbage, the failover round reads clean, and the response must
+    STILL be bit-exact — the end-to-end proof of the silent-undercount
+    fix (a lost partition fails over; it never merges short).
+
+Usage::
+
+    python -m tools.chaos             # full run (64 traffic threads)
+    python -m tools.chaos --smoke     # check.sh lane (smaller, faster)
+
+Exit status 0 = every assertion held. The pytest wrapper is
+tests/test_chaos.py (slow tier).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+SHARD_WIDTH = 1 << 20  # ops.bitset.SHARD_WIDTH without importing jax
+
+ROWS = 3
+SHARDS = 4
+REPLICAS = 2
+
+
+# ----------------------------------------------------------------- http
+
+
+def req(port: int, method: str, path: str, body: Any = None,
+        timeout: float = 30.0) -> Any:
+    data = None
+    if body is not None:
+        data = body if isinstance(body, bytes) \
+            else json.dumps(body).encode()
+    r = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
+                               data=data, method=method)
+    with urllib.request.urlopen(r, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def free_ports(n: int) -> List[int]:
+    import socket
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+# -------------------------------------------------------------- cluster
+
+
+class ChaosCluster:
+    """N server processes on localhost with the failpoints surface
+    enabled, plus an optional seed-joining extra node whose resize
+    pulls are failpoint-delayed."""
+
+    def __init__(self, tmp: str, n: int = 3, replicas: int = REPLICAS):
+        self.tmp = tmp
+        self.n = n
+        self.ports = free_ports(n + 1)  # last one for the joiner
+        self.uris = [f"http://127.0.0.1:{p}" for p in self.ports]
+        self.procs: List[Optional[subprocess.Popen]] = [None] * (n + 1)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        self.env = dict(os.environ)
+        self.env["JAX_PLATFORMS"] = "cpu"
+        self.env["PYTHONPATH"] = repo
+        # Enable the test-only /internal/failpoints surface everywhere
+        # without arming anything (cli/main.py).
+        self.env["PILOSA_TPU_FAILPOINTS_HTTP"] = "1"
+        peers = ", ".join(f'"{u}"' for u in self.uris[:n])
+        for i in range(n):
+            d = os.path.join(tmp, f"node{i}")
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "config.toml"), "w") as f:
+                f.write(
+                    f'bind = "127.0.0.1:{self.ports[i]}"\n'
+                    f"cluster_peers = [{peers}]\n"
+                    f"cluster_replicas = {replicas}\n"
+                    "cluster_fanout_deadline_s = 15.0\n"
+                    "cluster_backoff_base_s = 0.02\n"
+                    "cluster_backoff_cap_s = 0.25\n"
+                    "anti_entropy_interval = 0\n"
+                    "heartbeat_interval = 0.5\n"
+                    "heartbeat_suspect = 2\n"
+                    "heartbeat_probes = 3\n"
+                    "translate_replication_interval = 0\n"
+                    "metric_poll_interval = 0\n")
+        # Joiner config: seeds + slowed resize pulls (the window).
+        d = os.path.join(tmp, f"node{n}")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "config.toml"), "w") as f:
+            f.write(
+                f'bind = "127.0.0.1:{self.ports[n]}"\n'
+                f'cluster_seeds = ["{self.uris[0]}"]\n'
+                f"cluster_replicas = {replicas}\n"
+                "cluster_fanout_deadline_s = 15.0\n"
+                "anti_entropy_interval = 0\n"
+                "heartbeat_interval = 0.5\n"
+                "heartbeat_suspect = 2\n"
+                "translate_replication_interval = 0\n"
+                "metric_poll_interval = 0\n"
+                "[failpoints]\n"
+                '"resize.pull" = "delay(0.35)"\n')
+
+    def start(self, i: int) -> None:
+        d = os.path.join(self.tmp, f"node{i}")
+        log = open(os.path.join(d, "server.log"), "ab")
+        self.procs[i] = subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "-d", d, "-c", os.path.join(d, "config.toml"),
+             "--platform", "cpu"],
+            stdout=log, stderr=log, env=self.env)
+
+    def log_tail(self, i: int, n: int = 2000) -> str:
+        p = os.path.join(self.tmp, f"node{i}", "server.log")
+        try:
+            with open(p, "rb") as f:
+                return f.read()[-n:].decode("utf-8", "replace")
+        except OSError:
+            return "<no log>"
+
+    def wait_ready(self, idxs, deadline_s: float = 180.0) -> None:
+        deadline = time.time() + deadline_s
+        for i in idxs:
+            while True:
+                try:
+                    req(self.ports[i], "GET", "/status", timeout=5)
+                    break
+                except (urllib.error.URLError, OSError):
+                    p = self.procs[i]
+                    if p is not None and p.poll() is not None:
+                        raise RuntimeError(
+                            f"node {i} exited rc={p.returncode}:\n"
+                            + self.log_tail(i))
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f"node {i} never became ready:\n"
+                            + self.log_tail(i))
+                    time.sleep(0.4)
+
+    def start_all(self) -> None:
+        for i in range(self.n):
+            self.start(i)
+        self.wait_ready(range(self.n))
+
+    def stop_all(self) -> None:
+        for p in self.procs:
+            if p is not None and p.poll() is None:
+                p.terminate()
+        for p in self.procs:
+            if p is not None:
+                try:
+                    p.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=10)
+
+
+# --------------------------------------------------------------- corpus
+
+
+def corpus_bits(base: int) -> List[Tuple[int, int]]:
+    """Deterministic (row, col) bits with DISTINCT per-row counts
+    (row r holds base*(r+1) bits per shard), so TopN/GroupBy merges
+    are tie-free and every merge order yields one canonical answer."""
+    bits = []
+    for r in range(ROWS):
+        for s in range(SHARDS):
+            for k in range(base * (r + 1)):
+                bits.append((r, s * SHARD_WIDTH + r * 100_000 + k))
+    return bits
+
+
+QUERY_SET = tuple(
+    [f"Count(Row(cf={r}))" for r in range(ROWS)]
+    + [f"Row(cf={r})" for r in range(ROWS)]
+    + ["TopN(cf, n=2)",
+       "Count(Union(Row(cf=0), Row(cf=1)))",
+       "Count(Intersect(Row(cf=0), Row(cf=1)))"])
+
+
+def import_corpus(port: int, bits: List[Tuple[int, int]],
+                  batch: int = 2000) -> None:
+    req(port, "POST", "/index/ci", {})
+    req(port, "POST", "/index/ci/field/cf", {})
+    for i in range(0, len(bits), batch):
+        chunk = bits[i:i + batch]
+        req(port, "POST", "/index/ci/field/cf/import",
+            {"rowIDs": [r for r, _ in chunk],
+             "columnIDs": [c for _, c in chunk]}, timeout=60)
+
+
+def build_oracle(tmp: str, bits: List[Tuple[int, int]]
+                 ) -> Dict[str, Any]:
+    """Single-node in-process oracle: same corpus, no cluster, one
+    executor — the ground truth every clustered response must equal."""
+    import numpy as np
+
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.server.api import API
+
+    d = os.path.join(tmp, "oracle")
+    holder = Holder(d)
+    holder.open()
+    api = API(holder)
+    api.create_index("ci")
+    api.create_field("ci", "cf")
+    api.import_bits("ci", "cf",
+                    rows=np.asarray([r for r, _ in bits], np.uint64),
+                    columns=np.asarray([c for _, c in bits], np.uint64))
+    out = {q: api.query("ci", q)["results"] for q in QUERY_SET}
+    holder.close()
+    return out
+
+
+# -------------------------------------------------------------- traffic
+
+
+class Traffic:
+    """Mixed live read traffic against a set of coordinator ports.
+    Every response is compared to the oracle; errors and mismatches
+    are recorded, never swallowed."""
+
+    def __init__(self, ports: List[int], oracle: Dict[str, Any],
+                 threads: int = 64):
+        self.ports = ports
+        self.oracle = oracle
+        self.n_threads = threads
+        self.stop_evt = threading.Event()
+        self.lock = threading.Lock()
+        self.ok = 0
+        self.errors: List[str] = []
+        self.mismatches: List[str] = []
+        self._threads: List[threading.Thread] = []
+
+    def _worker(self, seed: int) -> None:
+        rng = random.Random(seed)
+        while not self.stop_evt.is_set():
+            q = rng.choice(QUERY_SET)
+            port = rng.choice(self.ports)
+            try:
+                res = req(port, "POST", "/index/ci/query",
+                          q.encode(), timeout=30)["results"]
+            except Exception as e:
+                with self.lock:
+                    self.errors.append(f"{port} {q}: "
+                                       f"{type(e).__name__}: {e}")
+                continue
+            if res != self.oracle[q]:
+                with self.lock:
+                    self.mismatches.append(
+                        f"{port} {q}: got {res!r} "
+                        f"want {self.oracle[q]!r}")
+            else:
+                with self.lock:
+                    self.ok += 1
+
+    def start(self) -> None:
+        for i in range(self.n_threads):
+            t = threading.Thread(target=self._worker, args=(i,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self.stop_evt.set()
+        for t in self._threads:
+            t.join(timeout=60)
+
+
+# ----------------------------------------------------------- assertions
+
+
+def wait_for(pred, timeout_s: float, what: str, every: float = 0.25):
+    deadline = time.time() + timeout_s
+    last = None
+    while time.time() < deadline:
+        try:
+            got = pred()
+            if got:
+                return got
+            last = got
+        except Exception as e:  # transient while nodes churn
+            last = f"{type(e).__name__}: {e}"
+        time.sleep(every)
+    raise AssertionError(f"timed out waiting for {what}: last={last!r}")
+
+
+def run(threads: int = 64, base: int = 40, verbose: bool = True
+        ) -> Dict[str, Any]:
+    """One full chaos scenario. Returns a result summary dict; raises
+    AssertionError on any violated invariant."""
+
+    def log(msg: str) -> None:
+        if verbose:
+            print(f"chaos: {msg}", flush=True)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # oracle is in-process
+    tmp = tempfile.mkdtemp(prefix="pilosa_chaos_")
+    cluster = ChaosCluster(tmp)
+    summary: Dict[str, Any] = {}
+    try:
+        log(f"booting {cluster.n}-node cluster "
+            f"(+1 joiner held back) in {tmp}")
+        cluster.start_all()
+
+        bits = corpus_bits(base)
+        log(f"importing corpus: {len(bits)} bits, {SHARDS} shards")
+        import_corpus(cluster.ports[0], bits)
+        log("building single-node oracle (in-process)")
+        oracle = build_oracle(tmp, bits)
+
+        # Corpus visible and exact through every node before chaos.
+        for i in range(cluster.n):
+            for q in QUERY_SET:
+                res = req(cluster.ports[i], "POST", "/index/ci/query",
+                          q.encode())["results"]
+                assert res == oracle[q], \
+                    f"pre-chaos divergence node{i} {q}: {res!r}"
+
+        survivors = [cluster.ports[0], cluster.ports[1]]
+        traffic = Traffic(survivors, oracle, threads=threads)
+        log(f"starting {threads}-thread live traffic on nodes 0/1")
+        traffic.start()
+
+        # --- resize window: node 3 seed-joins with slowed pulls.
+        log("starting joiner (node 3): resize.pull=delay armed")
+        cluster.start(cluster.n)
+        wait_for(lambda: req(cluster.ports[0], "GET",
+                             "/status")["state"] == "RESIZING",
+                 90, "cluster RESIZING after join")
+        log("cluster RESIZING — killing node 2 via failpoints")
+
+        # --- kill node 2 via failpoints, inside the resize window.
+        req(cluster.ports[2], "POST", "/internal/failpoints",
+            {"arm": {"api.query": "error", "api.status": "error"}})
+        down = wait_for(
+            lambda: any(n.get("down") for n in req(
+                cluster.ports[0], "GET",
+                "/cluster/health")["nodes"]),
+            30, "failure detector marks node 2 down")
+        assert down
+        log("node 2 marked down; traffic continuing through failover")
+        time.sleep(2.0)  # live traffic against the degraded cluster
+
+        # --- recovery.
+        log("disarming node 2 (recovery)")
+        req(cluster.ports[2], "POST", "/internal/failpoints",
+            {"disarm_all": True})
+        wait_for(
+            lambda: not any(n.get("down") for n in req(
+                cluster.ports[0], "GET",
+                "/cluster/health")["nodes"]),
+            30, "failure detector marks node 2 up")
+        log("node 2 recovered")
+
+        # --- resize completes; placement adopted everywhere.
+        wait_for(
+            lambda: all(req(p, "GET", "/status")["state"] == "NORMAL"
+                        for p in cluster.ports),
+            120, "cluster NORMAL on every node after resize")
+        log("resize complete (NORMAL everywhere)")
+        time.sleep(1.0)  # traffic over the adopted placement
+        # Stop the live traffic BEFORE the torn-body phase: a traffic
+        # request catching one-shot tears on BOTH of its failover
+        # rounds (burst k's, then freshly re-armed burst k+1's) errors
+        # — which is CORRECT (never a wrong answer) but is not the
+        # availability property this traffic exists to measure.
+        traffic.stop()
+
+        # --- torn-body bursts: one-shot torn bodies scoped to query
+        # legs (partition(/query)x1 — only the FIRST leg of a request
+        # tears, the failover round reads clean), repeated several
+        # times. Every response must be bit-exact: the end-to-end
+        # proof that a lost partition fails over instead of merging
+        # short (the silent-undercount fix). Tearing EVERY leg is also
+        # correct behavior but surfaces as an explicit request error
+        # once replicas are exhausted — never a wrong answer.
+        log("torn-body bursts on node 0 (undercount proof)")
+        torn_total = 0
+        for _ in range(8):
+            req(cluster.ports[0], "POST", "/internal/failpoints",
+                {"arm": {"client.torn_body": "partition(/query)x1"}})
+            for _ in range(20):
+                q = random.choice(QUERY_SET)
+                res = req(cluster.ports[0], "POST", "/index/ci/query",
+                          q.encode(), timeout=30)["results"]
+                assert res == oracle[q], (
+                    f"torn-body divergence {q}: {res!r} != "
+                    f"{oracle[q]!r}")
+                hits = req(cluster.ports[0], "GET",
+                           "/internal/failpoints"
+                           )["sites"]["client.torn_body"]["hits"]
+                if hits > torn_total:
+                    torn_total = hits
+                    break  # this burst's tear was consumed, exactly
+        req(cluster.ports[0], "POST", "/internal/failpoints",
+            {"disarm_all": True})
+        assert torn_total >= 4, \
+            f"torn_body fired only {torn_total} times — burst too thin"
+        log(f"torn-body bursts exact ({torn_total} bodies torn, "
+            f"failover recovered each)")
+
+        # --- invariants.
+        assert not traffic.mismatches, (
+            f"{len(traffic.mismatches)} WRONG ANSWERS under chaos: "
+            + "; ".join(traffic.mismatches[:5]))
+        assert not traffic.errors, (
+            f"{len(traffic.errors)} request errors through survivors "
+            f"(availability breach): " + "; ".join(traffic.errors[:5]))
+        assert traffic.ok > 50, \
+            f"traffic too thin to prove anything: {traffic.ok}"
+
+        # Kill + recovery + resize visible in the cluster timeline and
+        # health plane.
+        tl = req(cluster.ports[0], "GET", "/cluster/timeline")
+        kinds = {e["type"] for e in tl["events"]}
+        for want in ("node-down", "node-up", "resize-begin",
+                     "resize-complete"):
+            assert want in kinds, \
+                f"{want} missing from /cluster/timeline: {sorted(kinds)}"
+        # All-healthy can lag the burst by a probe round or a slow
+        # health RPC under load — poll, don't snapshot.
+        health = wait_for(
+            lambda: (lambda h: h if all(n.get("healthy")
+                                        for n in h["nodes"]) else None)(
+                req(cluster.ports[0], "GET", "/cluster/health")),
+            30, "every node healthy after the chaos run")
+        gens = [n.get("placementGen", 0) for n in health["nodes"]
+                if "placementGen" in n]
+        assert gens and all(g >= 1 for g in gens), \
+            f"placement generation never advanced: {gens}"
+        # The failpoint "kill" actually fired on node 2.
+        fp2 = req(cluster.ports[2], "GET", "/internal/failpoints")
+        assert fp2["sites"]["api.status"]["hits"] > 0, fp2
+        assert fp2["fired"] > 0
+
+        summary = {
+            "ok": traffic.ok,
+            "errors": len(traffic.errors),
+            "mismatches": len(traffic.mismatches),
+            "tornBodies": torn_total,
+            "events": sorted(kinds),
+            "placementGens": gens,
+        }
+        log(f"PASS: {summary}")
+        return summary
+    finally:
+        cluster.stop_all()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="smaller/faster run for the check.sh lane")
+    ap.add_argument("--threads", type=int, default=None)
+    args = ap.parse_args(argv)
+    threads = args.threads or (12 if args.smoke else 64)
+    base = 16 if args.smoke else 40
+    try:
+        run(threads=threads, base=base)
+    except AssertionError as e:
+        print(f"chaos: FAIL: {e}", file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

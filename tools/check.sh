@@ -567,6 +567,17 @@ step "hybrid-layout smoke (skewed corpus -> re-layout -> ledger delta + kill-swi
 PILOSA_TPU_PLAN_VERIFY=on JAX_PLATFORMS=cpu \
     python -m tools.layout_smoke || fail=1
 
+step "chaos smoke (3-proc cluster, failpoint-killed node mid-resize, bit-exact + availability + clean drain)"
+# The resilience-plane gate (ISSUE 15): live mixed traffic against a
+# real multi-process cluster while a seed-join resize runs with
+# failpoint-delayed pulls, one node failpoint-killed and recovered
+# inside the window, torn scatter-leg bodies injected afterwards.
+# Asserts zero request errors, bit-exact results vs a single-node
+# oracle, the kill/recovery visible in /cluster/timeline +
+# /cluster/health, and a clean drain (the harness SIGTERMs every
+# node and fails on unreaped children).
+JAX_PLATFORMS=cpu python -m tools.chaos --smoke || fail=1
+
 step "lock-order runtime check (PILOSA_TPU_LOCK_CHECK=1)"
 PILOSA_TPU_LOCK_CHECK=1 JAX_PLATFORMS=cpu \
     python -m pytest tests/test_coalescer.py tests/test_concurrency.py \

@@ -105,6 +105,11 @@ class Config:
     # funnel must reach ops/megakernel.verify_plan first — future IR
     # extensions cannot add an unverified launch path.
     plan_paths: Tuple[str, ...] = ("pilosa_tpu/",)
+    # GL013: packages where FAILPOINTS.register sites live — each name
+    # a string literal, registered exactly once, at module level (the
+    # failpoint-catalog contract, pilosa_tpu/utils/failpoints.py).
+    failpoint_paths: Tuple[str, ...] = ("pilosa_tpu/", "tools/",
+                                        "benches/")
     select: Optional[Set[str]] = None
     ignore: Set[str] = field(default_factory=set)
 

@@ -12,6 +12,7 @@ from tools.graftlint.rules.gl009_blocking import GL009BlockingUnderLock
 from tools.graftlint.rules.gl010_pairs import GL010PairedEffects
 from tools.graftlint.rules.gl011_ctypes import GL011CtypesBoundary
 from tools.graftlint.rules.gl012_planlaunch import GL012UnverifiedPlanLaunch
+from tools.graftlint.rules.gl013_failpoints import GL013FailpointRegistry
 
 ALL_RULES = (
     GL001LockDiscipline(),
@@ -26,4 +27,5 @@ ALL_RULES = (
     GL010PairedEffects(),
     GL011CtypesBoundary(),
     GL012UnverifiedPlanLaunch(),
+    GL013FailpointRegistry(),
 )

@@ -1,0 +1,103 @@
+"""GL013 — failpoint registration discipline.
+
+The fault-injection plane (pilosa_tpu/utils/failpoints.py) promises a
+*catalog*: every site name names exactly one seam, registered exactly
+once, armable by name from config/env/HTTP. That promise is structural
+— ``FAILPOINTS.register("name")`` at module import returns the site
+handle the seam fires — and it breaks silently in two ways: the same
+name registered from two modules (``register`` raises at import, but
+only when BOTH modules load — a conditional import hides it until
+production), or a registration inside a function (fires per call:
+second call raises, or worse, a fresh never-armed site per call if
+someone "fixes" that by catching).
+
+The check, inside ``failpoint_paths`` packages:
+
+- every ``FAILPOINTS.register(...)`` argument must be a string literal
+  (a computed name cannot be cataloged or armed reliably);
+- each literal name must be unique across the whole scanned tree;
+- the call must be a module-level statement (import-time, exactly
+  once), not nested in a function or method.
+
+Local ``FailpointRegistry()`` instances (test fixtures) are exempt:
+only the process-wide ``FAILPOINTS`` receiver is matched.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from tools.graftlint.engine import Finding, Project, Rule, SourceFile
+
+_REGISTRY = "FAILPOINTS"
+
+
+def _register_calls(sf: SourceFile) -> List[Tuple[ast.Call, bool]]:
+    """Every FAILPOINTS.register(...) call in the file, paired with
+    whether it sits at module level (directly in a module-body
+    statement, outside any function/class-method body)."""
+    out: List[Tuple[ast.Call, bool]] = []
+
+    def walk(node: ast.AST, in_func: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            nested = in_func or isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.Lambda))
+            if isinstance(child, ast.Call) \
+                    and isinstance(child.func, ast.Attribute) \
+                    and child.func.attr == "register" \
+                    and isinstance(child.func.value, ast.Name) \
+                    and child.func.value.id == _REGISTRY:
+                out.append((child, not in_func))
+            walk(child, nested)
+
+    walk(sf.tree, False)
+    return out
+
+
+class GL013FailpointRegistry(Rule):
+    code = "GL013"
+    name = "failpoint-registry"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        seen: Dict[str, Tuple[str, int]] = {}
+        out: List[Finding] = []
+        for sf in project.files:
+            if not sf.in_path(project.config.failpoint_paths):
+                continue
+            if sf.path.endswith("utils/failpoints.py"):
+                continue  # the registry defines register(), not sites
+            for call, module_level in _register_calls(sf):
+                if not call.args or not isinstance(
+                        call.args[0], ast.Constant) \
+                        or not isinstance(call.args[0].value, str):
+                    out.append(Finding(
+                        sf.path, call.lineno, call.col_offset,
+                        self.code,
+                        "failpoint name must be a string literal — a "
+                        "computed name cannot be cataloged or armed "
+                        "reliably (docs/architecture.md failpoint "
+                        "catalog)"))
+                    continue
+                name = call.args[0].value
+                if not module_level:
+                    out.append(Finding(
+                        sf.path, call.lineno, call.col_offset,
+                        self.code,
+                        f"failpoint {name!r} registered inside a "
+                        f"function — sites register exactly once at "
+                        f"module import (FAILPOINTS.register raises "
+                        f"on the second call)"))
+                if name in seen:
+                    first_path, first_line = seen[name]
+                    out.append(Finding(
+                        sf.path, call.lineno, call.col_offset,
+                        self.code,
+                        f"failpoint {name!r} registered twice (first "
+                        f"at {first_path}:{first_line}) — duplicate "
+                        f"names make arm() ambiguous and only raise "
+                        f"when both modules happen to load"))
+                else:
+                    seen[name] = (sf.path, call.lineno)
+        return out
