@@ -26,6 +26,15 @@ artifact (progress chatter on stderr):
   launches. The client-side top-K is validated bit-exactly against a
   packed-numpy Tanimoto on the same data.
 
+* ``opt``: the PR 16 cost-based plan optimizer lane — a 64-thread
+  shared-subtree burst (every query reuses Intersect/Threshold
+  subtrees across requests) replayed with the megakernel forced ON
+  under ``PILOSA_TPU_PLAN_OPT`` on vs off. Responses must be
+  BYTE-IDENTICAL; the record carries the measured plan-entry and
+  plan+slab byte reduction plus the optimizer counters (cse hits,
+  folds reordered) that /metrics exports as
+  ``pilosa_executor_opt_*_total``.
+
 Env knobs: MEGA_BENCH_THREADS (64), MEGA_BENCH_QUERIES (256 total),
 MEGA_BENCH_ROWS (16), MEGA_BENCH_BITS (400000), MEGA_BENCH_REPEATS
 (5), MEGA_BENCH_BATCH (16), MEGA_BENCH_MOLECULES (20000),
@@ -320,14 +329,130 @@ def lane_tanimoto():
         h.close()
 
 
+def lane_opt():
+    """Plan-optimizer on/off over a shared-subtree burst: same
+    schedule, megakernel forced ON both times, PLAN_OPT toggled.
+    Responses byte-identical; plan entries / plan+slab bytes drop."""
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import megakernel as megamod
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+    from pilosa_tpu.server.coalescer import QueryCoalescer
+    from pilosa_tpu.utils.stats import MemStatsClient
+
+    log(f"mega-bench: building opt-lane holder ({N_BITS} bits)")
+    with tempfile.TemporaryDirectory() as tmp:
+        h = Holder(tmp)
+        h.open()
+        idx = h.create_index("bench")
+        f = idx.create_field("f")
+        g = idx.create_field("g")
+        rng = np.random.default_rng(42)
+        rows = rng.integers(0, N_ROWS, N_BITS).astype(np.uint64)
+        cols = rng.integers(0, 2 * SHARD_WIDTH,
+                            N_BITS).astype(np.uint64)
+        f.import_bits(rows, cols)
+        g.import_bits(rows[::2], cols[::2])
+        idx.add_existence(cols)
+        ex = Executor(h)
+        ex.result_cache.enabled = False
+        # Shared-subtree families: every query around row r reuses the
+        # Intersect(Row(f=r), Row(g=r)) subtree (once commuted — the
+        # canonicalized fingerprint must still hit), plus a Threshold
+        # whose top rung is that same AND. This is the cross-request
+        # shape the CSE pass exists for.
+        queries = []
+        for k in range(N_QUERIES):
+            r = k % N_ROWS
+            r2 = (r + 1) % N_ROWS
+            queries.append([
+                f"Count(Intersect(Row(f={r}), Row(g={r})))",
+                f"Intersect(Row(g={r}), Row(f={r}))",
+                f"Count(Union(Intersect(Row(f={r}), Row(g={r})), "
+                f"Row(f={r2})))",
+                f"Count(Threshold(Row(f={r}), Row(g={r}), "
+                f"Row(f={r2}), k=2))"][(k // N_ROWS) % 4])
+        perm = np.random.default_rng(3).permutation(len(queries))
+        queries = [queries[int(p)] for p in perm]
+        for q in queries:  # warm every compiled variant
+            ex.execute_full("bench", q)
+
+        prev_mega = megamod.MEGAKERNEL_ENABLED
+        prev_opt = megamod.PLAN_OPT_ENABLED
+        megamod.MEGAKERNEL_ENABLED = True
+        stats, shapes = {}, {}
+        try:
+            for name, opt_on in (("opt-off", False), ("opt-on", True)):
+                log(f"mega-bench: config {name}")
+                megamod.PLAN_OPT_ENABLED = opt_on
+                entries0 = ex.mega_plan_entries
+                pbytes0 = ex.mega_plan_bytes
+                launches0 = ex.mega_launches
+                c0 = (ex.opt_cse_hits, ex.opt_entries_eliminated,
+                      ex.opt_folds_reordered, ex.opt_bytes_saved)
+                walls, results = [], None
+                for _ in range(REPEATS):
+                    co = QueryCoalescer(
+                        ex, window_s=0.002, max_batch=MAX_BATCH,
+                        max_queue=4 * len(queries),
+                        stats=MemStatsClient(), pipeline=True)
+                    co.start()
+                    try:
+                        results, wall = burst(co, queries)
+                    finally:
+                        co.stop()
+                    walls.append(wall)
+                stats[name] = {
+                    "qps": len(queries) / statistics.median(walls),
+                    "mega_launches": ex.mega_launches - launches0,
+                    "plan_entries": ex.mega_plan_entries - entries0,
+                    "plan_bytes": ex.mega_plan_bytes - pbytes0,
+                    "cse_hits": ex.opt_cse_hits - c0[0],
+                    "entries_eliminated":
+                        ex.opt_entries_eliminated - c0[1],
+                    "folds_reordered": ex.opt_folds_reordered - c0[2],
+                    "bytes_saved": ex.opt_bytes_saved - c0[3],
+                }
+                shapes[name] = results
+        finally:
+            megamod.MEGAKERNEL_ENABLED = prev_mega
+            megamod.PLAN_OPT_ENABLED = prev_opt
+        assert shapes["opt-on"] == shapes["opt-off"], \
+            "optimizer responses differ from kill-switch path"
+        off, on = stats["opt-off"], stats["opt-on"]
+        assert on["cse_hits"] > 0, "shared-subtree burst must CSE"
+        assert off["cse_hits"] == 0 and off["bytes_saved"] == 0, \
+            "kill switch must keep the optimizer fully out"
+        emit({
+            "bench": "mega_burst_opt",
+            "threads": min(N_THREADS, N_QUERIES),
+            "queries": len(queries),
+            "repeats": REPEATS,
+            "configs": stats,
+            "plan_entry_reduction": round(
+                1 - on["plan_entries"] / max(1, off["plan_entries"]),
+                4),
+            "plan_byte_reduction": round(
+                1 - on["plan_bytes"] / max(1, off["plan_bytes"]), 4),
+            "slab_bytes_saved": on["bytes_saved"],
+            "bit_identical_opt_on_off": True,
+            "backend": "cpu",
+        })
+        h.close()
+
+
 def main():
-    lanes = sys.argv[1:] or ["mixed", "tanimoto"]
-    if os.path.exists(ARTIFACT):
+    lanes = sys.argv[1:] or ["mixed", "tanimoto", "opt"]
+    # A full run regenerates the artifact; a single-lane rerun appends
+    # to the committed record set instead of destroying it.
+    if not sys.argv[1:] and os.path.exists(ARTIFACT):
         os.remove(ARTIFACT)
     if "mixed" in lanes:
         lane_mixed()
     if "tanimoto" in lanes:
         lane_tanimoto()
+    if "opt" in lanes:
+        lane_opt()
 
 
 if __name__ == "__main__":

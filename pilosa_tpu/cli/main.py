@@ -223,6 +223,12 @@ def cmd_server(args) -> int:
         max_bytes=cfg.cache_result_max_bytes)
     RANK_CACHE.configure(enabled=cfg.cache_rank_enabled,
                          max_entries=cfg.cache_rank_max_entries)
+    # Plan optimizer ([optimizer] section): the env kill switch
+    # PILOSA_TPU_PLAN_OPT=0 always wins — config can disable the
+    # optimizer, never re-enable it past the blunt switch.
+    from pilosa_tpu.executor import megakernel as _megamod
+    if not cfg.optimizer_enabled:
+        _megamod.PLAN_OPT_ENABLED = False
     coalescer = None
     if cfg.coalescer_enabled:
         # Cross-request query coalescer: concurrent single-query POSTs

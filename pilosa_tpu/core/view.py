@@ -459,6 +459,11 @@ class View:
                     nShards=s, rows=n_rows)
         if live_density is not None:
             meta["liveDensity"] = round(float(live_density), 6)
+            # Feed the plan optimizer's cost model: fold operands sort
+            # cheapest-first by this sampled density (order-only — a
+            # stale value can cost speed, never bits).
+            from pilosa_tpu.ops import plan_opt
+            plan_opt.note_bank_density(bank.array, live_density)
         LEDGER.register(
             "bank", cache_key, cap * row_bytes,
             padded_bytes=max(0, cap - n_rows - 1) * row_bytes,

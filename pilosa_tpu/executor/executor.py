@@ -62,7 +62,8 @@ from pilosa_tpu.utils.timeline import (
 
 _LOG = logging.getLogger("pilosa_tpu.executor")
 
-_BITMAP_CALLS = {"Row", "Range", "Intersect", "Union", "Difference", "Xor",
+_BITMAP_CALLS = {"Row", "Range", "Threshold",
+                 "Intersect", "Union", "Difference", "Xor",
                  "Not", "Shift"}
 
 # Calls that mutate fragment bitmaps. Used to decide whether a deferred
@@ -565,6 +566,17 @@ class Executor:
         # pilosa_executor_plan_verify_{passes,rejects}_total.
         self.plan_verify_passes = 0
         self.plan_verify_rejects = 0
+        # Plan optimizer (ops/plan_opt.py, PILOSA_TPU_PLAN_OPT):
+        # plans rewritten, CSE fingerprint hits, instructions
+        # eliminated, fold chains density-reordered, and slab +
+        # plan-buffer bytes the rewrites dropped. /metrics exports
+        # pilosa_executor_opt_{plans,cse_hits,entries_eliminated,
+        # folds_reordered,bytes_saved}_total.
+        self.opt_plans = 0
+        self.opt_cse_hits = 0
+        self.opt_entries_eliminated = 0
+        self.opt_folds_reordered = 0
+        self.opt_bytes_saved = 0
         # Optional stats sink (utils/stats interface) the API layer
         # attaches; batch-scoped signals (fusion group sizes) that have
         # no per-query profile to ride report through it.
@@ -752,6 +764,27 @@ class Executor:
         if self.stats is not None:
             self.stats.count("executor.plan_verify_passes" if ok
                              else "executor.plan_verify_rejects", 1)
+
+    def _note_opt(self, opt: Any) -> None:
+        """Account one optimized plan launch (ops/plan_opt.OptStats —
+        the before/after the megakernel leg attaches to the plan).
+        '+=' is not atomic and batches can run from several
+        threads."""
+        with self._jit_stats_lock:
+            self.opt_plans += 1
+            self.opt_cse_hits += opt.cse_hits
+            self.opt_entries_eliminated += opt.entries_eliminated
+            self.opt_folds_reordered += opt.folds_reordered
+            self.opt_bytes_saved += opt.bytes_saved
+        if self.stats is not None:
+            self.stats.count("executor.opt_plans", 1)
+            self.stats.count("executor.opt_cse_hits", opt.cse_hits)
+            self.stats.count("executor.opt_entries_eliminated",
+                             opt.entries_eliminated)
+            self.stats.count("executor.opt_folds_reordered",
+                             opt.folds_reordered)
+            self.stats.count("executor.opt_bytes_saved",
+                             opt.bytes_saved)
 
     # -------------------------------------------- request-level result cache
 
@@ -1399,7 +1432,8 @@ class Executor:
             out.add(ef)
             return all(self._referenced_fields(idx, c, out)
                        for c in call.children)
-        if name in ("Intersect", "Union", "Difference", "Xor", "Shift"):
+        if name in ("Intersect", "Union", "Difference", "Xor", "Shift",
+                    "Threshold"):
             return bool(call.children) and all(
                 self._referenced_fields(idx, c, out)
                 for c in call.children)
@@ -1875,6 +1909,56 @@ class Executor:
             op = ops[name]
             return lambda b, i, p, l: functools.reduce(
                 op, [s(b, i, p, l) for s in subs])
+        if name == "Threshold":
+            # Threshold(k=K, r1, ..., rN): columns set in at least K of
+            # the N operand rows (the N-of-M / θ-threshold operator of
+            # the bitmap-index literature). K=1 degenerates to Union,
+            # K=N to Intersect; both reuse the fold node so they CSE
+            # with real folds of the same operands.
+            if not call.children:
+                raise ExecutionError("Threshold() requires row arguments")
+            k = call.args.get("k")
+            # Strict integer: uint_arg would silently truncate k=1.5,
+            # and an off-by-one threshold is a silent wrong answer.
+            if isinstance(k, bool) or not isinstance(k, int) or k < 1:
+                raise ExecutionError(
+                    "Threshold() requires an integer argument k >= 1")
+            n = len(call.children)
+            subs = [self._plan_call(idx, c, shards, plan)
+                    for c in call.children]
+            plan.sig_parts.append(f"T{k}n{n}")
+            if k > n:
+                # More votes required than operands supplied: the
+                # empty row. The operands were still planned (deps
+                # capture and width resolution stay uniform), so the
+                # lowering consumes them via the thresh node, which
+                # maps k > n to a zeroed register.
+                plan.ir.append(("thresh", k, n))
+                return lambda b, i, p, l: jnp.zeros_like(
+                    subs[0](b, i, p, l))
+            if k == 1:
+                plan.ir.append(("fold", "or", n))
+                return lambda b, i, p, l: functools.reduce(
+                    jnp.bitwise_or, [s(b, i, p, l) for s in subs])
+            if k == n:
+                plan.ir.append(("fold", "and", n))
+                return lambda b, i, p, l: functools.reduce(
+                    jnp.bitwise_and, [s(b, i, p, l) for s in subs])
+            plan.ir.append(("thresh", k, n))
+
+            def _thresh(b, i, p, l, _k=k, _subs=subs):
+                # Thermometer accumulate: t[j] holds "at least j+1 of
+                # the operands seen so far" — word-parallel, no
+                # per-bit popcount (cf. bit-sliced N-of-M evaluation).
+                vals = [s(b, i, p, l) for s in _subs]
+                t = [jnp.zeros_like(vals[0]) for _ in range(_k)]
+                for x in vals:
+                    for j in range(_k - 1, 0, -1):
+                        t[j] = jnp.bitwise_or(
+                            t[j], jnp.bitwise_and(t[j - 1], x))
+                    t[0] = jnp.bitwise_or(t[0], x)
+                return t[_k - 1]
+            return _thresh
         raise ExecutionError(f"{name} is not a row query")
 
     def _view_width(self, field: Field, view_name: str) -> int:

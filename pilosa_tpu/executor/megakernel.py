@@ -85,6 +85,23 @@ def _default_verify_mode() -> str:
 PLAN_VERIFY_MODE = _default_verify_mode()
 
 
+def _default_opt_enabled() -> bool:
+    """PILOSA_TPU_PLAN_OPT: the cost-based plan optimizer
+    (ops/plan_opt.py — cross-request CSE, density-ordered folds, DCE +
+    register compaction, width narrowing) runs over every finished
+    plan by default; 0 is the blunt kill switch that launches the raw
+    Lowering output instead. The `[optimizer]` config section
+    (utils/config.py, wired in cli/main.py) can also disable it, but
+    never re-enables past this env var."""
+    flag = os.environ.get("PILOSA_TPU_PLAN_OPT", "on").strip().lower()
+    return flag not in ("0", "false", "no", "off")
+
+
+# Module attribute, toggled directly by tests/benches like
+# MEGAKERNEL_ENABLED; the env var sets the process default.
+PLAN_OPT_ENABLED = _default_opt_enabled()
+
+
 class _MegaView:
     """One group's window onto a launch's shared outputs. Satisfies
     exactly the slice of the device-array surface _FuseGroup/FusedEval
@@ -196,7 +213,11 @@ def run_megakernel(executor: Any, groups: Dict[tuple, Any]
 
 def _build(cohort: List[Any]) -> Tuple[mk.Plan, int, List[List[int]]]:
     """Lower every entry of every group into one plan; returns the
-    plan, the launch word width, and per-group member lanes."""
+    plan, the launch word width, and per-group member lanes. The plan
+    optimizer runs HERE — inside the build, before the verify gate —
+    so every downstream consumer (the _launch verifier, the plan_fuzz
+    capture hook, the telemetry) sees exactly the plan that will
+    dispatch."""
     w_mega = max(e.width for g in cohort for e in g.entries)
     low = mk.Lowering()
     lanes: List[List[int]] = []
@@ -206,7 +227,17 @@ def _build(cohort: List[Any]) -> Tuple[mk.Plan, int, List[List[int]]]:
             g_lanes.append(low.add_entry(e.ir, e.bank_arrays, e.idxs,
                                          e.params, e.width, e.mode))
         lanes.append(g_lanes)
-    return low.finish(), w_mega, lanes
+    plan = low.finish()
+    if PLAN_OPT_ENABLED:
+        try:
+            from pilosa_tpu.ops import plan_opt
+            plan, _stats = plan_opt.optimize_plan(
+                plan, cohort[0].entries[0].n_shards, w_mega)
+        except Exception:
+            # Best-effort by contract: a surprised optimizer means the
+            # raw Lowering plan launches, never a failed request.
+            pass
+    return plan, w_mega, lanes
 
 
 def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
@@ -292,6 +323,8 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
                      batch=n_entries, groups=len(cohort),
                      planEntries=plan.n_instrs)
         ex._note_mega(n_entries, plan.n_instrs, plan_bytes)
+        if plan.opt_stats is not None:
+            ex._note_opt(plan.opt_stats)
         _attribute(ex, cohort, launch, jit_hit, t0, dispatch_s, plan,
                    plan_bytes, n_entries)
     except Exception as e:
@@ -316,6 +349,7 @@ def _attribute(ex: Any, cohort: List[Any], launch: _MegaLaunch,
     member sees the shared dispatch (and sampled device) time labeled
     with its launch coordinates."""
     fence_profs: List[Tuple[Any, Any]] = []
+    opt = plan.opt_stats
     mega_index = 0
     for g in cohort:
         for prof, node in zip(g.profs, g.nodes):
@@ -330,12 +364,21 @@ def _attribute(ex: Any, cohort: List[Any], launch: _MegaLaunch,
             node.attrs["megaIndex"] = b
             node.attrs["planEntries"] = plan.n_instrs
             node.attrs["planBytes"] = plan_bytes
+            if opt is not None:
+                # The optimizer's before/after so a profile reader can
+                # attribute the reduction without the /metrics deltas.
+                node.attrs["planEntriesBefore"] = opt.entries_before
+                node.attrs["planEntriesAfter"] = opt.entries_after
             prof.set_fused(n_entries)
             if prof.timeline is not None:
+                extra = {}
+                if opt is not None:
+                    extra = dict(planEntriesBefore=opt.entries_before,
+                                 planEntriesAfter=opt.entries_after)
                 TIMELINE.event(prof.timeline, "dispatch", LANE_DISPATCH,
                                t_disp, dispatch_s, megaBatch=n_entries,
                                megaIndex=b, planEntries=plan.n_instrs,
-                               planBytes=plan_bytes)
+                               planBytes=plan_bytes, **extra)
             if prof.sample_device:
                 fence_profs.append((prof, node))
     device_s = 0.0

@@ -80,8 +80,19 @@ OP_COPY = 5     # dst = a
 # (verify_plan), because the expansion (and its width mask) is what
 # makes the register bit-identical to the dense bank row it replaces.
 OP_EXPAND = 6   # dst = expanded(a); a must be an expand register
+# Threshold accumulate: dst = dst | (a & b) — the thermometer step of
+# the N-of-M counter (arXiv 1402.4466 §threshold queries). A K-of-N
+# Threshold lowers to K accumulator registers t_1..t_K where t_j holds
+# "columns with >= j of the operands seen so far"; folding operand x
+# in is t_j |= t_{j-1} & x for j = K..2 plus t_1 |= x, so the whole
+# query is O(K·N) plan rows of the SAME word-parallel ops as the rest
+# of the table — no per-column counters, no widening. THRESH is the
+# one opcode that READS its dst (verify_plan demands the accumulator
+# is defined first: a missed t_j init would silently under-count).
+OP_THRESH = 7
 
-OP_NAMES = ("and", "or", "xor", "andnot", "zero", "copy", "expand")
+OP_NAMES = ("and", "or", "xor", "andnot", "zero", "copy", "expand",
+            "thresh")
 
 _FOLD_OPS = {"and": OP_AND, "or": OP_OR, "xor": OP_XOR, "diff": OP_ANDNOT}
 
@@ -279,6 +290,11 @@ class Lowering:
                         self._emit(_FOLD_OPS[opname], r, r, operand)
                     acc = r
                 stack.append(acc)
+            elif kind == "thresh":
+                _, kval, n = node
+                ops = stack[-n:]
+                del stack[-n:]
+                stack.append(self._lower_thresh(int(kval), ops))
             elif kind == "bsi":
                 _, bkind, pos, i0, depth, j, k, allow_eq = node
                 planes = [self._slot(bank_arrays[pos], idxs[i0 + d], width)
@@ -301,6 +317,35 @@ class Lowering:
         # graftlint: disable=GL008 — per-launch builder state.
         self.out_row_widths.append(int(width))
         return len(self.out_row) - 1
+
+    def _lower_thresh(self, k: int, ops: List[Any]) -> Any:
+        """Thermometer N-of-M counter: after folding every operand,
+        ``t_j`` holds the columns where at least ``j`` operands are
+        set; the query's answer is ``t_k``. The executor maps the
+        degenerate edges (k <= 1 -> OR fold, k == n -> AND fold)
+        before lowering, but the expansion is correct for any
+        1 <= k <= n; k > n (more votes than operands) is the empty
+        row — a zeroed register, with the already-staged operands
+        consumed from the stack. Descending ``j`` order is
+        load-bearing: each step must read the PREVIOUS operand's
+        t_{j-1}."""
+        n = len(ops)
+        if k < 1:
+            raise ValueError(f"thresh k={k} must be >= 1")
+        if k > n:
+            r = self._scratch()
+            self._emit(OP_ZERO, r, r, r)
+            return r
+        regs = []
+        for _ in range(k):
+            r = self._scratch()
+            self._emit(OP_ZERO, r, r, r)
+            regs.append(r)
+        for x in ops:
+            for j in range(k - 1, 0, -1):
+                self._emit(OP_THRESH, regs[j], regs[j - 1], x)
+            self._emit(OP_OR, regs[0], regs[0], x)
+        return regs[k - 1]
 
     # ------------------------------------------------------ BSI expansion
 
@@ -433,7 +478,7 @@ class Plan:
     __slots__ = ("banks", "slots", "widths", "instrs", "out_count",
                  "out_row", "n_slots", "n_regs", "n_instrs",
                  "lane_count_widths", "lane_row_widths",
-                 "xbanks", "xslots", "n_xslots")
+                 "xbanks", "xslots", "n_xslots", "opt_stats")
 
     def __init__(self, banks: Tuple[Any, ...],
                  slots: Tuple[np.ndarray, ...], widths: np.ndarray,
@@ -466,6 +511,10 @@ class Plan:
         self.xbanks = xbanks
         self.xslots = xslots
         self.n_xslots = n_xslots
+        # Filled by ops/plan_opt.optimize_plan when the optimizer ran
+        # over this plan (None otherwise): the before/after entry and
+        # byte accounting the executor's opt telemetry reports.
+        self.opt_stats = None
 
     @property
     def plan_nbytes(self) -> int:
@@ -516,8 +565,11 @@ class PlanVerifyError(ValueError):
     the invariant it broke."""
 
 
-_READS_A = (OP_AND, OP_OR, OP_XOR, OP_ANDNOT, OP_COPY)
-_READS_B = (OP_AND, OP_OR, OP_XOR, OP_ANDNOT)
+_READS_A = (OP_AND, OP_OR, OP_XOR, OP_ANDNOT, OP_COPY, OP_THRESH)
+_READS_B = (OP_AND, OP_OR, OP_XOR, OP_ANDNOT, OP_THRESH)
+# THRESH is the accumulate opcode: dst = dst | (a & b), so dst is a
+# READ operand too and must be defined before the instruction runs.
+_READS_DST = (OP_THRESH,)
 
 
 def _is_pow2(n: int) -> bool:
@@ -577,7 +629,10 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
       zero-extension commutes with every opcode. Each real output
       lane's register must prove ``z <= lane plan width``, which is
       exactly what makes per-entry slices (and full-width popcounts)
-      bit-identical to the unfused per-plan programs.
+      bit-identical to the unfused per-plan programs. ``THRESH``
+      (``dst = dst | (a & b)``) additionally READS its dst: the
+      accumulator must be defined (a missed thermometer init would
+      silently under-count) and its span joins ``min(za, zb)``.
     """
     instrs = plan.instrs
     if instrs.ndim != 2 or instrs.shape[1] != 4:
@@ -720,6 +775,11 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
             reads.append(("a", a))
         if op in _READS_B:
             reads.append(("b", b))
+        if op in _READS_DST:
+            # THRESH accumulates (dst = dst | (a & b)): an undefined
+            # accumulator means a missed thermometer init — the
+            # machine would OR into zeros and silently under-count.
+            reads.append(("dst", dst))
         for nm, r in reads:
             if n_slots <= r < n_gathered:
                 raise PlanVerifyError(
@@ -744,6 +804,12 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
             span[dst] = za
         elif op == OP_AND:
             span[dst] = min(za, zb)
+        elif op == OP_THRESH:
+            # dst | (a & b): the old accumulator span joins the AND of
+            # the operand spans — dst was just proven defined above.
+            zd = span[dst]
+            zd = 0 if zd is None else int(zd)
+            span[dst] = max(zd, min(za, zb))
         else:  # OR / XOR
             span[dst] = max(za, zb)
 
@@ -869,25 +935,32 @@ def build_program(n_shards: int, w_mega: int, t_pad: int,
             from pilosa_tpu.ops import pallas_kernels
             slab = pallas_kernels.mega_interpret(slab, instrs)
         else:
+            # Branches take (d, a, b): d is the CURRENT dst value, read
+            # for the THRESH accumulate and ignored by every other
+            # opcode (XLA drops the dead gather per branch).
             branches = (
-                lambda a, b: jnp.bitwise_and(a, b),
-                lambda a, b: jnp.bitwise_or(a, b),
-                lambda a, b: jnp.bitwise_xor(a, b),
-                lambda a, b: jnp.bitwise_and(a, jnp.bitwise_not(b)),
-                lambda a, b: jnp.zeros_like(a),
-                lambda a, b: a,
+                lambda d, a, b: jnp.bitwise_and(a, b),
+                lambda d, a, b: jnp.bitwise_or(a, b),
+                lambda d, a, b: jnp.bitwise_xor(a, b),
+                lambda d, a, b: jnp.bitwise_and(a, jnp.bitwise_not(b)),
+                lambda d, a, b: jnp.zeros_like(a),
+                lambda d, a, b: a,
                 # OP_EXPAND: the expand register was materialized (and
                 # width-masked) above, so importing it is the identity
                 # on its value — the opcode's job is the TYPED
                 # boundary, enforced pre-launch by verify_plan.
-                lambda a, b: a,
+                lambda d, a, b: a,
+                # OP_THRESH: thermometer accumulate (N-of-M counting).
+                lambda d, a, b: jnp.bitwise_or(
+                    d, jnp.bitwise_and(a, b)),
             )
 
             def body(i: Any, sl: Any) -> Any:
                 op = instrs[i, 0]
+                vd = sl[instrs[i, 1]]
                 va = sl[instrs[i, 2]]
                 vb = sl[instrs[i, 3]]
-                res = jax.lax.switch(op, branches, va, vb)
+                res = jax.lax.switch(op, branches, vd, va, vb)
                 return sl.at[instrs[i, 1]].set(res)
 
             slab = jax.lax.fori_loop(0, instrs.shape[0], body, slab)

@@ -307,9 +307,12 @@ def _mega_loop_kernel(n_instrs: int) -> Callable[..., None]:
 
         def body(i: Any, carry: Any) -> Any:
             op = instr_ref[i, 0]
+            vd = pl.load(out_ref, (pl.ds(instr_ref[i, 1], 1),))
             va = pl.load(out_ref, (pl.ds(instr_ref[i, 2], 1),))
             vb = pl.load(out_ref, (pl.ds(instr_ref[i, 3], 1),))
             zero = jnp.zeros_like(va)
+            # OP_THRESH (7) reads the CURRENT dst: thermometer
+            # accumulate dst | (a & b) — see ops/megakernel.OP_THRESH.
             res = jnp.where(
                 op == 0, jnp.bitwise_and(va, vb),
                 jnp.where(op == 1, jnp.bitwise_or(va, vb),
@@ -320,6 +323,9 @@ def _mega_loop_kernel(n_instrs: int) -> Callable[..., None]:
                                                   jnp.bitwise_not(vb)),
                                               jnp.where(op == 4, zero,
                                                         va)))))
+            res = jnp.where(
+                op == 7, jnp.bitwise_or(vd, jnp.bitwise_and(va, vb)),
+                res)
             pl.store(out_ref, (pl.ds(instr_ref[i, 1], 1),), res)
             return carry
 

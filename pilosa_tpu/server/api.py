@@ -1061,6 +1061,17 @@ class API:
                 # instead of executing — page-worthy.
                 "planVerifyPasses": self.executor.plan_verify_passes,
                 "planVerifyRejects": self.executor.plan_verify_rejects,
+                # Plan optimizer (ops/plan_opt.py, PILOSA_TPU_PLAN_OPT):
+                # how much work CSE / fold reordering / DCE shaved off
+                # launched megakernel plans.
+                "opt": {
+                    "plans": self.executor.opt_plans,
+                    "cseHits": self.executor.opt_cse_hits,
+                    "entriesEliminated":
+                        self.executor.opt_entries_eliminated,
+                    "foldsReordered": self.executor.opt_folds_reordered,
+                    "bytesSaved": self.executor.opt_bytes_saved,
+                },
             },
             # Cross-request cache tier (executor/result_cache.py +
             # core/cache.RANK_CACHE): hit ratios and live bytes in the

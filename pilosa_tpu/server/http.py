@@ -366,6 +366,14 @@ class Handler(BaseHTTPRequestHandler):
                                 api.executor.plan_verify_passes,
                             "planVerifyRejects":
                                 api.executor.plan_verify_rejects,
+                            "optPlans": api.executor.opt_plans,
+                            "optCseHits": api.executor.opt_cse_hits,
+                            "optEntriesEliminated":
+                                api.executor.opt_entries_eliminated,
+                            "optFoldsReordered":
+                                api.executor.opt_folds_reordered,
+                            "optBytesSaved":
+                                api.executor.opt_bytes_saved,
                             "jitCacheSize":
                                 api.executor.jit_cache_size()})
             elif path == "/debug/memory":

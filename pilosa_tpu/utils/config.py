@@ -133,6 +133,18 @@ class Config:
     # Live per-view rank vectors kept device-resident (HBM; category
     # "rank_cache"); each is 4 bytes/row.
     cache_rank_max_entries: int = 64
+    # Cost-based plan optimizer (ops/plan_opt.py): the pass pipeline
+    # that rewrites verified megakernel plans between lowering and
+    # launch — cross-request CSE, density-ordered fold reordering,
+    # dead-register elimination and lane width narrowing. Every
+    # optimized plan still passes verify_plan and stays bit-identical;
+    # the knob exists for triage (rule the optimizer out in one move)
+    # and A/B measurement. TOML accepts an [optimizer] table
+    # (enabled) or the flat optimizer_* spelling; env uses
+    # PILOSA_TPU_OPTIMIZER_ENABLED. The blunt kill switch
+    # PILOSA_TPU_PLAN_OPT=0 overrides everything (config can disable,
+    # never re-enable past it).
+    optimizer_enabled: bool = True
     # Adaptive hybrid bank layout (core/layout.py): the background
     # re-layout pass that demotes sparse/cold views to compact device
     # SparseBanks and promotes them back when they heat up, driven by

@@ -1,0 +1,25 @@
+"""GL014 fail fixture: an opcode table whose coverage tables drifted.
+
+Three violations, one of each shape the rule detects:
+
+- ``"newop"`` is in OP_NAMES but has no OPCODE_MUTATIONS entry — the
+  classic "shipped an opcode without fuzzer teeth" gap.
+- ``"ghost"`` has a coverage row but is not a real opcode — a stale
+  row left behind by a rename, hiding the table's true coverage.
+- ``"or"`` maps to ``"flip_bits"`` which is not in PLAN_MUTATIONS —
+  the sweep would never apply it, so the row vouches for nothing.
+
+Both tables live in this one file so the single-file fixture harness
+exercises the cross-file rule (opcode_table_paths and
+mutation_table_paths both point at the gl014 fixture prefix).
+"""
+
+OP_NAMES = ("and", "or", "newop")
+
+PLAN_MUTATIONS = ("opcode", "src_range")
+
+OPCODE_MUTATIONS = {
+    "and": ("opcode", "src_range"),
+    "or": ("flip_bits",),
+    "ghost": ("opcode",),
+}

@@ -1,0 +1,17 @@
+"""GL014 pass fixture: opcode and coverage tables in lockstep.
+
+Every OP_NAMES entry has a non-empty OPCODE_MUTATIONS row, every row
+names a real opcode, and every listed kind exists in PLAN_MUTATIONS —
+the invariant the real pair (pilosa_tpu/ops/megakernel.py and
+tools/planverify.py) maintains.
+"""
+
+OP_NAMES = ("and", "or", "thresh")
+
+PLAN_MUTATIONS = ("opcode", "src_range", "thresh_off_by_one")
+
+OPCODE_MUTATIONS = {
+    "and": ("opcode", "src_range"),
+    "or": ("opcode",),
+    "thresh": ("opcode", "thresh_off_by_one"),
+}

@@ -37,6 +37,8 @@ def fixture_config() -> Config:
         ctypes_paths=("graftlint_fixtures/gl011",),
         plan_paths=("graftlint_fixtures/gl012",),
         failpoint_paths=("graftlint_fixtures/gl013",),
+        opcode_table_paths=("graftlint_fixtures/gl014",),
+        mutation_table_paths=("graftlint_fixtures/gl014",),
     )
 
 
@@ -66,6 +68,8 @@ def codes_for(filename, config=None):
     ("gl011_ctypes_fail.py", "gl011_ctypes_pass.py", "GL011"),
     ("gl012_planlaunch_fail.py", "gl012_planlaunch_pass.py", "GL012"),
     ("gl013_failpoints_fail.py", "gl013_failpoints_pass.py", "GL013"),
+    ("gl014_opcodecoverage_fail.py", "gl014_opcodecoverage_pass.py",
+     "GL014"),
 ])
 def test_rule_fixtures(fail_fixture, pass_fixture, code):
     fail_codes = codes_for(fail_fixture)
@@ -103,6 +107,26 @@ def test_gl013_counts_and_kinds():
     assert "registered twice" in msgs
     assert "string literal" in msgs
     assert "inside a function" in msgs
+
+
+def test_gl014_counts_and_kinds():
+    """Exactly three findings in the fail fixture — uncovered opcode,
+    stale coverage row, unknown mutation kind — and the rule stays
+    silent when either table is outside the lint scope (partial-path
+    runs fall back to planverify's PV003 runtime check)."""
+    findings = lint_files(
+        [os.path.join(FIXTURES, "gl014_opcodecoverage_fail.py")],
+        fixture_config())
+    gl14 = [f for f in findings if f.code == "GL014"]
+    assert len(gl14) == 3, gl14
+    msgs = " | ".join(f.message for f in gl14)
+    assert "'newop' has no OPCODE_MUTATIONS entry" in msgs
+    assert "'ghost' names no opcode" in msgs
+    assert "'flip_bits' which is not in PLAN_MUTATIONS" in msgs
+    # Scope miss on either table => no findings, not false positives.
+    cfg = fixture_config()
+    cfg.mutation_table_paths = ("graftlint_fixtures/elsewhere",)
+    assert codes_for("gl014_opcodecoverage_fail.py", cfg) == []
 
 
 def test_gl001_context_manager_is_not_a_lock():

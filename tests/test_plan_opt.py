@@ -1,0 +1,343 @@
+"""Cost-based plan optimizer (ops/plan_opt.py): per-pass unit tests
+over plans built through the real Lowering, plus executor-level
+threshold truth tables and the PILOSA_TPU_PLAN_OPT kill-switch
+bit-identity contract across the unfused / fused / megakernel legs.
+
+The pass-level tests pin EXACT counts (entries, cse hits, reorders,
+narrowed lanes) so a regression shows up as a number, not a timing;
+every optimized plan is pushed back through ``verify_plan`` because
+"the optimizer only emits verifiable plans" is the contract the
+PV001 sweep (tools/planverify.py) enforces fleet-wide."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core.field import FieldOptions
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor import executor as exmod
+from pilosa_tpu.executor import megakernel as megamod
+from pilosa_tpu.ops import megakernel as mk
+from pilosa_tpu.ops import plan_opt
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+N_SHARDS = 2
+BANK_ROWS = 32
+
+
+def bank(w):
+    """Shape-carrying operand bank (contents never read host-side)."""
+    return np.zeros((BANK_ROWS, N_SHARDS, w), np.uint32)
+
+
+def run_opt(low, w_mega=8):
+    plan = low.finish()
+    new, stats = plan_opt.optimize_plan(plan, N_SHARDS, w_mega)
+    mk.verify_plan(new, N_SHARDS, w_mega)
+    return plan, new, stats
+
+
+# ------------------------------------------------------------------ CSE
+
+
+def test_cse_identical_entries_collapse_to_one_row():
+    """Four identical AND entries: one fold row survives, every count
+    lane aliases the same register, and the freed scratch shrinks the
+    register file (slab bytes drop with it)."""
+    low = mk.Lowering()
+    b = bank(8)
+    ir = (("slot", 0, 0), ("slot", 0, 1), ("fold", "and", 2))
+    for _ in range(4):
+        low.add_entry(ir, [b], [3, 5], [], 8, "count")
+    plan, new, st = run_opt(low)
+    assert st.entries_before == 4
+    assert st.entries_after == 1
+    assert new.n_instrs == 1
+    assert st.cse_hits == 3
+    assert st.entries_eliminated == 3
+    assert len({int(r) for r in new.out_count[:4]}) == 1
+    assert st.regs_after <= st.regs_before
+    assert st.bytes_saved > 0
+
+
+def test_cse_matches_commuted_operands():
+    """AND(a, b) and AND(b, a) are the same value — the fingerprint
+    sorts commutative operands, so the commuted twin is a hit."""
+    low = mk.Lowering()
+    b = bank(8)
+    low.add_entry((("slot", 0, 0), ("slot", 0, 1), ("fold", "and", 2)),
+                  [b], [3, 5], [], 8, "count")
+    low.add_entry((("slot", 0, 0), ("slot", 0, 1), ("fold", "and", 2)),
+                  [b], [5, 3], [], 8, "count")
+    _, new, st = run_opt(low)
+    assert new.n_instrs == 1
+    assert st.cse_hits == 1
+    assert int(new.out_count[0]) == int(new.out_count[1])
+
+
+def test_cse_never_commutes_andnot():
+    """Difference is pinned: diff(a, b) and diff(b, a) are different
+    values and must keep separate rows."""
+    low = mk.Lowering()
+    b = bank(8)
+    low.add_entry((("slot", 0, 0), ("slot", 0, 1), ("fold", "diff", 2)),
+                  [b], [3, 5], [], 8, "count")
+    low.add_entry((("slot", 0, 0), ("slot", 0, 1), ("fold", "diff", 2)),
+                  [b], [5, 3], [], 8, "count")
+    _, new, st = run_opt(low)
+    assert new.n_instrs == 2
+    assert st.cse_hits == 0
+    assert int(new.out_count[0]) != int(new.out_count[1])
+
+
+def test_cse_shared_subtree_across_requests():
+    """The cross-request shape the batch planner produces: N entries
+    sharing one operand. Each keeps its distinct fold, but the shared
+    gather slot is one register and the folds stay one row each."""
+    low = mk.Lowering()
+    b = bank(8)
+    ir = (("slot", 0, 0), ("slot", 0, 1), ("fold", "and", 2))
+    for c in (5, 6, 7, 9):
+        low.add_entry(ir, [b], [3, c], [], 8, "count")
+    _, new, st = run_opt(low)
+    assert new.n_instrs == 4
+    assert st.cse_hits == 0
+    assert len({int(r) for r in new.out_count[:4]}) == 4
+
+
+# -------------------------------------------------------- fold reorder
+
+
+def test_reorder_commutative_density_ascending():
+    """An OR chain sorts its operands cheapest-first (ties keep
+    program order); the chain head rewrites in place."""
+    rows = [[mk.OP_OR, 3, 0, 1], [mk.OP_OR, 3, 3, 2]]
+    st = plan_opt.OptStats()
+    plan_opt._reorder_folds(rows, {0: 0.9, 1: 0.5, 2: 0.1}, st)
+    assert st.folds_reordered == 1
+    assert rows == [[mk.OP_OR, 3, 2, 1], [mk.OP_OR, 3, 3, 0]]
+
+
+def test_reorder_andnot_head_pinned_densest_negative_first():
+    """ANDNOT keeps its left operand (the value being subtracted
+    from); the negatives sort densest-first so the accumulator
+    shrinks early."""
+    rows = [[mk.OP_ANDNOT, 4, 0, 1],
+            [mk.OP_ANDNOT, 4, 4, 2],
+            [mk.OP_ANDNOT, 4, 4, 3]]
+    st = plan_opt.OptStats()
+    plan_opt._reorder_folds(
+        rows, {0: 0.2, 1: 0.1, 2: 0.9, 3: 0.5}, st)
+    assert st.folds_reordered == 1
+    assert rows == [[mk.OP_ANDNOT, 4, 0, 2],
+                    [mk.OP_ANDNOT, 4, 4, 3],
+                    [mk.OP_ANDNOT, 4, 4, 1]]
+
+
+def test_reorder_is_stable_without_density_signal():
+    """No ledger signal -> every operand weighs the same -> program
+    order is already sorted and the pass must not count a reorder
+    (the CSE fingerprints depend on the order being canonical)."""
+    rows = [[mk.OP_OR, 3, 0, 1], [mk.OP_OR, 3, 3, 2]]
+    before = [list(r) for r in rows]
+    st = plan_opt.OptStats()
+    plan_opt._reorder_folds(rows, {}, st)
+    assert st.folds_reordered == 0
+    assert rows == before
+
+
+def test_reorder_end_to_end_preserves_verification():
+    """Through the full pipeline: three banks with sampled densities,
+    one OR fold across them — the reorder is observed in stats and
+    the rewritten plan still verifies."""
+    low = mk.Lowering()
+    b_dense, b_mid, b_sparse = bank(8), bank(8), bank(8)
+    plan_opt.note_bank_density(b_dense, 0.9)
+    plan_opt.note_bank_density(b_mid, 0.5)
+    plan_opt.note_bank_density(b_sparse, 0.05)
+    low.add_entry((("slot", 0, 0), ("slot", 1, 1), ("slot", 2, 2),
+                   ("fold", "or", 3)),
+                  [b_dense, b_mid, b_sparse], [1, 2, 3], [], 8, "count")
+    _, new, st = run_opt(low)
+    assert st.folds_reordered == 1
+    assert new.n_instrs == 2
+
+
+# ------------------------------------------------------ width narrowing
+
+
+def test_narrowing_follows_zero_extension_lattice():
+    """The absent-row shape: an AND with a zero leaf is provably empty
+    past limb 1 (verify_plan's span transfer takes the min), so its
+    w=8 count lane narrows to 1. The OR with the same zero leaf keeps
+    the operand's full span and must NOT narrow."""
+    low = mk.Lowering()
+    b = bank(8)
+    low.add_entry((("zero",), ("slot", 0, 0), ("fold", "and", 2)),
+                  [b], [2], [], 8, "count")
+    low.add_entry((("zero",), ("slot", 0, 0), ("fold", "or", 2)),
+                  [b], [2], [], 8, "count")
+    _, new, st = run_opt(low)
+    assert st.narrowed_lanes == 1
+    assert new.lane_count_widths[0] == 1
+    assert new.lane_count_widths[1] == 8
+
+
+def test_gather_only_row_plan_survives():
+    """A plan with NO instructions (pure gather row lane) goes through
+    the pipeline: the lane's slot register must get an input value
+    number even though no instruction ever read it."""
+    low = mk.Lowering()
+    b = bank(4)
+    low.add_entry((("slot", 0, 0),), [b], [5], [], 4, "row")
+    _, new, st = run_opt(low, w_mega=4)
+    assert new.n_instrs == 0
+    assert st.entries_eliminated == 0
+    assert tuple(new.lane_row_widths) == (4,)
+
+
+# --------------------------------------------------------- density feed
+
+
+def test_density_feed_roundtrip_and_cap():
+    a, b = bank(4), bank(4)
+    plan_opt.note_bank_density(a, 0.25)
+    assert plan_opt.bank_density(a) == 0.25
+    plan_opt.note_bank_density(b, None)  # best-effort: None is a no-op
+    assert plan_opt.bank_density(b) == plan_opt.DEFAULT_DENSITY
+    # The id()->density map is bounded: old entries evict FIFO.
+    keep = [np.zeros(1, np.uint32)
+            for _ in range(plan_opt._DENSITY_CAP)]
+    for arr in keep:
+        plan_opt.note_bank_density(arr, 0.75)
+    assert plan_opt.bank_density(a) == plan_opt.DEFAULT_DENSITY
+    assert plan_opt.bank_density(keep[-1]) == 0.75
+
+
+# ------------------------------------------- executor: threshold + kill
+
+
+N_ROWS = 16
+
+
+@pytest.fixture
+def ex(tmp_path):
+    h = Holder(str(tmp_path))
+    h.open()
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    rng = np.random.default_rng(41)
+    rows = rng.integers(0, N_ROWS, 6000).astype(np.uint64)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, 6000).astype(np.uint64)
+    f.import_bits(rows, cols)
+    g.import_bits(rows[::2], cols[::2])
+    idx.create_field("v", FieldOptions(type="int", min=-500, max=10000))
+    vcols = rng.integers(0, 2 * SHARD_WIDTH, 900).astype(np.uint64)
+    idx.field("v").import_values(
+        vcols, rng.integers(-500, 10000, 900).astype(np.int64))
+    idx.add_existence(cols)
+    executor = Executor(h)
+    executor.result_cache.enabled = False
+    prev = megamod.MEGAKERNEL_ENABLED
+    megamod.MEGAKERNEL_ENABLED = True
+    yield executor
+    megamod.MEGAKERNEL_ENABLED = prev
+    h.close()
+
+
+A, B, C = "Row(f=1)", "Row(f=2)", "Row(g=3)"
+
+# Threshold(k) over {A, B, C} == the union of all k-subsets'
+# intersections — the classic expansion the thermometer lowering
+# replaces. Each pair below must be bit-identical.
+THRESH_EQUIV = [
+    (f"Threshold({A}, {B}, {C}, k=1)", f"Union({A}, {B}, {C})"),
+    (f"Threshold({A}, {B}, {C}, k=2)",
+     f"Union(Intersect({A}, {B}), Intersect({A}, {C}), "
+     f"Intersect({B}, {C}))"),
+    (f"Threshold({A}, {B}, {C}, k=3)", f"Intersect({A}, {B}, {C})"),
+    (f"Threshold({A}, {B}, {C}, k=4)", f"Difference({A}, {A})"),
+]
+
+
+def test_threshold_truth_table_row_and_count(ex):
+    for thresh, equiv in THRESH_EQUIV:
+        assert ex.execute_full("i", thresh) \
+            == ex.execute_full("i", equiv), thresh
+        assert ex.execute_full("i", f"Count({thresh})") \
+            == ex.execute_full("i", f"Count({equiv})"), thresh
+
+
+def test_threshold_in_megakernel_batch_matches_direct(ex):
+    reqs = [("i", f"Count({t})", None) for t, _ in THRESH_EQUIV] \
+        + [("i", THRESH_EQUIV[1][0], None)]
+    direct = [ex.execute_full(i, q, shards=s) for i, q, s in reqs]
+    assert ex.execute_batch_shaped(reqs) == direct
+    assert ex.mega_launches == 1
+
+
+def test_threshold_argument_validation(ex):
+    for bad in ("Threshold(Row(f=1), Row(f=2))",      # k missing
+                "Threshold(Row(f=1), k=0)",           # k < 1
+                "Threshold(Row(f=1), k=1.5)",         # non-integer k
+                "Threshold(k=2)"):                    # no rows
+        with pytest.raises(Exception):
+            ex.execute_full("i", bad)
+
+
+# Shared-subtree burst: the cross-request CSE shape (every query
+# reuses Intersect(A, B)) plus a threshold rider.
+SHARED = ([("i", f"Count(Intersect({A}, {B}))", None)] * 2
+          + [("i", f"Count(Intersect(Intersect({A}, {B}), Row(g={r})))",
+              None) for r in (4, 5, 6)]
+          + [("i", f"Count(Threshold({A}, {B}, Row(g=4), k=2))", None)]
+          + [("i", f"Intersect({B}, {A})", None)])
+
+
+def test_optimizer_bit_identity_and_counters(ex):
+    """Opt ON, megakernel ON: one launch, CSE observed, results
+    bit-identical to the direct path."""
+    direct = [ex.execute_full(i, q, shards=s) for i, q, s in SHARED]
+    assert ex.execute_batch_shaped(SHARED) == direct
+    assert ex.mega_launches == 1
+    assert ex.opt_plans == 1
+    assert ex.opt_cse_hits > 0
+    assert ex.opt_entries_eliminated > 0
+    assert ex.opt_bytes_saved > 0
+
+
+def test_kill_switch_bit_identity_across_paths(ex, monkeypatch):
+    """PILOSA_TPU_PLAN_OPT=0 (module switch) and the megakernel /
+    fusion fallbacks all agree bit-for-bit with the optimized path."""
+    optimized = ex.execute_batch_shaped(SHARED)
+    plans_after_opt = ex.opt_plans
+
+    monkeypatch.setattr(megamod, "PLAN_OPT_ENABLED", False)
+    assert ex.execute_batch_shaped(SHARED) == optimized
+    assert ex.opt_plans == plans_after_opt, \
+        "kill switch must keep the optimizer fully out of the path"
+
+    monkeypatch.setattr(megamod, "MEGAKERNEL_ENABLED", False)
+    assert ex.execute_batch_shaped(SHARED) == optimized  # fused leg
+
+    monkeypatch.setattr(exmod, "FUSION_ENABLED", False)
+    assert ex.execute_batch_shaped(SHARED) == optimized  # unfused leg
+
+
+def test_profile_carries_before_after_entry_counts(ex):
+    """The profile tree's megakernel node reports planEntriesBefore/
+    After so a perf regression in the optimizer is visible per
+    request, not just in process counters."""
+    from pilosa_tpu.utils.profile import QueryProfile
+    profs = [QueryProfile(i, q) for i, q, _ in SHARED]
+    ex.execute_batch(SHARED, profiles=profs)
+    found = []
+    for p in profs:
+        for op in p.ops:
+            for node in op.children:
+                if "planEntriesBefore" in node.attrs:
+                    found.append(node.attrs)
+    assert found, "no profile node carried optimizer attribution"
+    for attrs in found:
+        assert attrs["planEntriesAfter"] < attrs["planEntriesBefore"]

@@ -178,7 +178,11 @@ def test_every_mutation_kind_rejected_on_live_plans(ex, monkeypatch):
                    # Sparse-resident operands: the OP_EXPAND path, so
                    # the expand_* / xslot_row mutation kinds apply.
                    ("i", "Count(Row(s=1))", None),
-                   ("i", "Count(Intersect(Row(s=2), Row(f=2)))", None)]
+                   ("i", "Count(Intersect(Row(s=2), Row(f=2)))", None),
+                   # Threshold: OP_THRESH thermometer rows, so the
+                   # thresh_off_by_one mutation kind applies.
+                   ("i", "Count(Threshold(Row(f=1), Row(f=3), "
+                         "Row(g=5), k=2))", None)]
     ex.execute_batch_shaped(big)
     assert captured
     applied = set()

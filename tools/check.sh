@@ -281,6 +281,63 @@ with tempfile.TemporaryDirectory() as d:
 print("megakernel smoke OK")
 EOF
 
+step "plan-optimizer smoke (64 shared-subtree queries -> CSE hits, kill-switch bit-identity)"
+# The PR 16 cost-based optimizer (ops/plan_opt.py): a shared-subtree
+# burst must produce cross-request CSE hits with the optimized launch
+# still passing the plan-IR verification gate, and PILOSA_TPU_PLAN_OPT
+# off must keep the optimizer fully out of the path at byte-identical
+# responses. Threshold queries ride along so the OP_THRESH lowering
+# is in the gated plan.
+PILOSA_TPU_RESULT_CACHE=0 PILOSA_TPU_MEGAKERNEL=1 \
+    PILOSA_TPU_PLAN_VERIFY=on JAX_PLATFORMS=cpu \
+    python - <<'EOF' || fail=1
+import tempfile
+import numpy as np
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor import megakernel as megamod
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("opt")
+    f = idx.create_field("f"); g = idx.create_field("g")
+    rng = np.random.default_rng(9)
+    rows = rng.integers(0, 8, 4000).astype(np.uint64)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, 4000).astype(np.uint64)
+    f.import_bits(rows, cols); g.import_bits(rows[::2], cols[::2])
+    idx.add_existence(cols)
+    ex = Executor(h)
+    assert megamod.PLAN_OPT_ENABLED, "default must be on"
+    # 64 queries, every one reusing the Intersect(f=r, g=r) subtree
+    # (once commuted) plus a Threshold rider over the same rows.
+    reqs = []
+    for k in range(64):
+        r = k % 8
+        reqs.append(("opt", [
+            f"Count(Intersect(Row(f={r}), Row(g={r})))",
+            f"Intersect(Row(g={r}), Row(f={r}))",
+            f"Count(Union(Intersect(Row(f={r}), Row(g={r})), Row(f={(r+1)%8})))",
+            f"Count(Threshold(Row(f={r}), Row(g={r}), Row(f={(r+1)%8}), k=2))",
+            ][(k // 8) % 4], None))
+    on = ex.execute_batch_shaped(reqs)
+    assert ex.mega_launches == 1 and ex.opt_plans == 1, \
+        (ex.mega_launches, ex.opt_plans)
+    assert ex.opt_cse_hits > 0, "shared-subtree burst must CSE"
+    assert ex.opt_entries_eliminated > 0 and ex.opt_bytes_saved > 0, \
+        (ex.opt_entries_eliminated, ex.opt_bytes_saved)
+    # Optimized plan passed the verification gate (checked IR).
+    assert ex.plan_verify_passes == 1 and ex.plan_verify_rejects == 0, \
+        (ex.plan_verify_passes, ex.plan_verify_rejects)
+    # PILOSA_TPU_PLAN_OPT=0 regime: raw Lowering plans, byte-identical.
+    megamod.PLAN_OPT_ENABLED = False
+    off = ex.execute_batch_shaped(reqs)
+    assert on == off, "optimizer responses differ from kill-switch path"
+    assert ex.opt_plans == 1, "kill switch must stop optimizer runs"
+    h.close()
+print("plan-optimizer smoke OK")
+EOF
+
 step "plan-fuzz gate (corpus replay + deterministic sweep + digest stability)"
 # The plan-space differential oracle (tools/plan_fuzz): committed
 # corpus replays clean, then a seeded sweep — every batch bit-exact

@@ -110,6 +110,13 @@ class Config:
     # failpoint-catalog contract, pilosa_tpu/utils/failpoints.py).
     failpoint_paths: Tuple[str, ...] = ("pilosa_tpu/", "tools/",
                                         "benches/")
+    # GL014: where the megakernel opcode table (OP_NAMES) and the
+    # fuzzer coverage tables (OPCODE_MUTATIONS / PLAN_MUTATIONS) live.
+    # Every opcode must map to at least one mutation kind the PV002
+    # sweep applies — a new opcode cannot ship without fuzzer teeth.
+    opcode_table_paths: Tuple[str, ...] = (
+        "pilosa_tpu/ops/megakernel.py",)
+    mutation_table_paths: Tuple[str, ...] = ("tools/planverify.py",)
     select: Optional[Set[str]] = None
     ignore: Set[str] = field(default_factory=set)
 

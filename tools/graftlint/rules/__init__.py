@@ -13,6 +13,7 @@ from tools.graftlint.rules.gl010_pairs import GL010PairedEffects
 from tools.graftlint.rules.gl011_ctypes import GL011CtypesBoundary
 from tools.graftlint.rules.gl012_planlaunch import GL012UnverifiedPlanLaunch
 from tools.graftlint.rules.gl013_failpoints import GL013FailpointRegistry
+from tools.graftlint.rules.gl014_opcodecoverage import GL014OpcodeCoverage
 
 ALL_RULES = (
     GL001LockDiscipline(),
@@ -28,4 +29,5 @@ ALL_RULES = (
     GL011CtypesBoundary(),
     GL012UnverifiedPlanLaunch(),
     GL013FailpointRegistry(),
+    GL014OpcodeCoverage(),
 )

@@ -1,0 +1,125 @@
+"""GL014 — megakernel opcode without fuzzer mutation coverage.
+
+The plan-IR verification plane only has teeth while its coverage
+tables move together: ``ops/megakernel.OP_NAMES`` is the opcode table
+the interpreter executes, and ``tools/planverify.OPCODE_MUTATIONS``
+maps every opcode to the ``PLAN_MUTATIONS`` kinds that corrupt plans
+containing it (each kind a guaranteed ``verify_plan`` reject, asserted
+by the PV002 sweep and the plan_fuzz verifier leg). History motivates
+the lint: OP_EXPAND (hybrid layout) and OP_THRESH (threshold queries)
+each extended the opcode table, and each needed matching verifier
+cases AND mutation kinds before the differential fuzzer could vouch
+for plans containing them. An opcode that ships without a mutation
+mapping is a fuzzer blind spot — plans using it would launch with the
+verifier's weakest guarantees and nothing attacking them.
+
+The check (cross-file): parse the ``OP_NAMES`` tuple from files under
+``opcode_table_paths`` and the ``OPCODE_MUTATIONS`` dict +
+``PLAN_MUTATIONS`` tuple from files under ``mutation_table_paths``.
+Every opcode must have a non-empty ``OPCODE_MUTATIONS`` entry, every
+entry must name a real opcode, and every kind an entry lists must
+exist in ``PLAN_MUTATIONS``. When either table is outside the lint
+scope (partial-path runs) the rule stays silent — the PV003 runtime
+check in ``tools/planverify.run_sweep`` is the backstop.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from tools.graftlint.engine import Finding, Project, Rule, SourceFile
+
+
+def _const_strings(node: ast.AST) -> Optional[List[str]]:
+    """The string elements of a Tuple/List literal, or None when the
+    node is anything else (a computed table is out of scope)."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out: List[str] = []
+    for el in node.elts:
+        if not isinstance(el, ast.Constant) or not isinstance(el.value,
+                                                              str):
+            return None
+        out.append(el.value)
+    return out
+
+
+def _module_assign(sf: SourceFile, name: str) -> Optional[ast.AST]:
+    """The value node of a module-level ``name = ...`` /
+    ``name: T = ...`` assignment."""
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == name:
+                    return node.value
+        elif isinstance(node, ast.AnnAssign):
+            if isinstance(node.target, ast.Name) \
+                    and node.target.id == name:
+                return node.value
+    return None
+
+
+class GL014OpcodeCoverage(Rule):
+    code = "GL014"
+    name = "opcode-missing-mutation-coverage"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        cfg = project.config
+        opcode_sf = names_node = op_names = None
+        for sf in project.files:
+            if not sf.in_path(cfg.opcode_table_paths):
+                continue
+            value = _module_assign(sf, "OP_NAMES")
+            names = _const_strings(value) if value is not None else None
+            if names:
+                opcode_sf, names_node, op_names = sf, value, names
+                break
+        mut_sf = mut_node = None
+        mutations = None
+        kinds: Optional[List[str]] = None
+        for sf in project.files:
+            if not sf.in_path(cfg.mutation_table_paths):
+                continue
+            value = _module_assign(sf, "OPCODE_MUTATIONS")
+            if isinstance(value, ast.Dict):
+                mut_sf, mut_node, mutations = sf, value, value
+                pk = _module_assign(sf, "PLAN_MUTATIONS")
+                kinds = _const_strings(pk) if pk is not None else None
+                break
+        if op_names is None or mutations is None:
+            return ()
+
+        covered = {}
+        out: List[Finding] = []
+        for k, v in zip(mutations.keys, mutations.values):
+            if not isinstance(k, ast.Constant) \
+                    or not isinstance(k.value, str):
+                continue  # computed key: out of scope
+            entry_kinds = _const_strings(v)
+            covered[k.value] = entry_kinds
+            if k.value not in op_names:
+                out.append(Finding(
+                    mut_sf.path, k.lineno, k.col_offset, self.code,
+                    f"OPCODE_MUTATIONS entry '{k.value}' names no "
+                    f"opcode in OP_NAMES ({opcode_sf.path}) — stale "
+                    f"coverage rows hide real gaps"))
+            for kind in (entry_kinds or ()):
+                if kinds is not None and kind not in kinds:
+                    out.append(Finding(
+                        mut_sf.path, v.lineno, v.col_offset, self.code,
+                        f"opcode '{k.value}' maps to mutation kind "
+                        f"'{kind}' which is not in PLAN_MUTATIONS — "
+                        f"the sweep would never apply it"))
+        for opname in op_names:
+            if not covered.get(opname):
+                out.append(Finding(
+                    opcode_sf.path, names_node.lineno,
+                    names_node.col_offset, self.code,
+                    f"opcode '{opname}' has no OPCODE_MUTATIONS entry "
+                    f"in {mut_sf.path} — a new opcode must ship with "
+                    f"a verify_plan case and at least one mutation "
+                    f"kind that corrupts plans containing it "
+                    f"(docs/development.md \"Plan-IR verification "
+                    f"plane\")"))
+        return out

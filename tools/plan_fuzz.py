@@ -12,10 +12,13 @@ fuzzer attacks the native parser:
   across three int fields at boundary bit-depths (2, 14, 21 planes)
   with boundary predicate values, shared operand rows (the Tanimoto
   probe shape, deduped to one slab register), absent rows, batch
-  sizes crossing pow2 pad edges, and a SPARSE-resident field ("s",
+  sizes crossing pow2 pad edges, a SPARSE-resident field ("s",
   hybrid layout: its standard view serves from a SparseBank through
   the OP_EXPAND path) mixed freely into the same folds so sparse,
-  dense and BSI operands meet inside single plans.
+  dense and BSI operands meet inside single plans, and Threshold
+  (N-of-M) queries across interior and degenerate k — the OP_THRESH
+  thermometer expansion, its Union/Intersect edges and the k > n
+  empty row — nested freely under folds.
 - **Three-way differential** — every generated batch runs through
   (a) the megakernel interpreter (``MEGAKERNEL_ENABLED=True``: one
   plan-buffer launch per cohort), (b) the per-group vmap fusion path
@@ -23,11 +26,15 @@ fuzzer attacks the native parser:
   host oracle (uint64 bit words, ``np.bitwise_count``); the shaped
   responses must be bit-exact across all three.
 - **Verifier leg** — every plan the live lowering builds during (a)
-  is captured at the ``executor/megakernel._build`` seam: it must
-  pass ``ops/megakernel.verify_plan``, and every applied mutation
-  from the shared coverage set (``tools/planverify.PLAN_MUTATIONS``:
-  opcode/slot/dst/operand/out-lane/width byte corruption) must be
-  REJECTED — a mutated plan never reaches a launch.
+  is captured at the ``executor/megakernel._build`` seam — AFTER the
+  plan optimizer has run, so CSE'd / reordered / narrowed plans are
+  what gets verified and mutated. Each must pass
+  ``ops/megakernel.verify_plan``, and every applied mutation from
+  the shared coverage set (``tools/planverify.PLAN_MUTATIONS``:
+  opcode/slot/dst/operand/out-lane/width byte corruption plus the
+  optimizer-bug shapes cse_alias / reorder_noncommutative /
+  narrow_below_span / thresh_off_by_one) must be REJECTED — a
+  mutated plan never reaches a launch.
 
 Everything is deterministic for a fixed ``--seed`` (per-case child
 seeds spawn as ``default_rng([seed, index])``), so a failing case
@@ -176,6 +183,19 @@ class HostOracle:
                 else:
                     acc = acc & ~rhs
             return acc
+        if kind == "thresh":
+            # Packed-word thermometer (the same algebra OP_THRESH
+            # lowers to): t[j] = "at least j+1 operands so far".
+            k = int(tree[1])
+            subs = [self.eval(s) for s in tree[2:]]
+            if k > len(subs):
+                return self._zero()
+            t = [self._zero() for _ in range(k)]
+            for x in subs:
+                for j in range(k - 1, 0, -1):
+                    t[j] = t[j] | (t[j - 1] & x)
+                t[0] = t[0] | x
+            return t[k - 1]
         raise ValueError(f"unknown tree node {tree!r}")
 
     def expected(self, mode: str, tree: Sequence[Any]) -> Any:
@@ -200,6 +220,9 @@ def render(tree: Sequence[Any]) -> str:
     if kind in _FOLDS:
         inner = ", ".join(render(s) for s in tree[1:])
         return f"{_FOLD_PQL[kind]}({inner})"
+    if kind == "thresh":
+        inner = ", ".join(render(s) for s in tree[2:])
+        return f"Threshold({inner}, k={int(tree[1])})"
     raise ValueError(f"unknown tree node {tree!r}")
 
 
@@ -244,7 +267,7 @@ def _gen_tree(rng: np.random.Generator) -> List[Any]:
     """One tree from a bounded skeleton catalog: shapes stay inside a
     small signature space so compiled-program churn amortizes across
     the run, while leaves (rows, predicate values) roam free."""
-    shape = int(rng.integers(0, 12))
+    shape = int(rng.integers(0, 15))
     if shape == 0:
         return _leaf_row(rng)
     if shape == 1:
@@ -269,7 +292,27 @@ def _gen_tree(rng: np.random.Generator) -> List[Any]:
                 _leaf_row(rng)]
     if shape == 10:
         return [_fold(rng), _leaf_row(rng), _leaf_between(rng)]
-    return ["diff", _leaf_row(rng), _leaf_row(rng), _leaf_row(rng),
+    if shape == 11:
+        return ["diff", _leaf_row(rng), _leaf_row(rng), _leaf_row(rng),
+                _leaf_row(rng)]
+    if shape == 12:
+        # Threshold at a random interior-or-edge k over row leaves
+        # (k can land on 1 = Union, n = Intersect, n + 1 = empty).
+        n = int(rng.integers(2, 6))
+        k = int(rng.integers(1, n + 2))
+        return ["thresh", k] + [_leaf_row(rng) for _ in range(n)]
+    if shape == 13:
+        # Threshold mixing BSI comparisons into the thermometer.
+        n = int(rng.integers(2, 5))
+        k = int(rng.integers(1, n + 1))
+        subs = [_leaf_row(rng) if rng.random() < 0.5
+                else _leaf_cmp(rng) for _ in range(n)]
+        return ["thresh", k] + subs
+    # Threshold nested inside a fold (the optimizer CSEs the early
+    # thermometer rungs against sibling Intersects of the same rows).
+    n = int(rng.integers(2, 5))
+    k = int(rng.integers(2, n + 1))
+    return ["and", ["thresh", k] + [_leaf_row(rng) for _ in range(n)],
             _leaf_row(rng)]
 
 

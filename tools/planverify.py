@@ -59,6 +59,9 @@ RULES: Tuple[Tuple[str, str, str], ...] = (
     ("PV002", "mutation-escapes-verifier",
      "a corrupted plan buffer passed verify_plan — the launch gate "
      "would execute a broken plan"),
+    ("PV003", "opcode-missing-mutation-coverage",
+     "an opcode in the megakernel table has no mutation-kind "
+     "coverage — a new opcode shipped without fuzzer teeth"),
 )
 
 
@@ -79,7 +82,36 @@ PLAN_MUTATIONS: Tuple[str, ...] = (
     "expand_src",    # OP_EXPAND importing a non-expand register
     "expand_read",   # bitwise opcode reading an expand reg directly
     "xslot_row",     # sparse gather index outside its starts table
+    # Optimizer-bug shapes (PR 16): each models one way a broken
+    # plan-optimizer pass would corrupt a plan, phrased as the typed
+    # violation verify_plan is guaranteed to catch.
+    "cse_alias",     # CSE aliases a read onto a subtree defined LATER
+    "reorder_noncommutative",  # reorder hoists a read past its def
+    "narrow_below_span",       # lane narrowed under its proven span
+    "thresh_off_by_one",       # thermometer rung reads an uninit reg
 )
+
+
+# Per-opcode fuzzer coverage: every entry of ``ops/megakernel.OP_NAMES``
+# must map to at least one PLAN_MUTATIONS kind that exercises its
+# checked invariants (graftlint GL014 cross-checks this table against
+# the opcode table statically; run_sweep re-checks it at runtime as
+# PV003). Adding an opcode without extending this table is a lint
+# error BEFORE it is a fuzzer blind spot.
+OPCODE_MUTATIONS: Dict[str, Tuple[str, ...]] = {
+    "and": ("opcode", "src_range", "src_undef", "cse_alias",
+            "reorder_noncommutative", "narrow_below_span"),
+    "or": ("opcode", "src_range", "src_undef", "cse_alias",
+           "narrow_below_span"),
+    "xor": ("opcode", "src_range", "src_undef", "cse_alias",
+            "narrow_below_span"),
+    "andnot": ("opcode", "src_range", "src_undef",
+               "reorder_noncommutative"),
+    "zero": ("dst_slot", "dst_range", "out_pad_alias"),
+    "copy": ("src_undef", "cse_alias"),
+    "expand": ("expand_src", "expand_read", "xslot_row"),
+    "thresh": ("opcode", "thresh_off_by_one", "narrow_below_span"),
+}
 
 
 def clone_plan(plan: mk.Plan) -> mk.Plan:
@@ -129,12 +161,13 @@ def mutate_plan(rng: np.random.Generator, plan: mk.Plan,
     nc = len(p.lane_count_widths)
     nr = len(p.lane_row_widths)
     if kind == "opcode":
-        # 6 is OP_EXPAND (a REAL opcode since the hybrid layout):
-        # corruption values start past the table's end.
+        # 6 is OP_EXPAND and 7 is OP_THRESH (REAL opcodes since the
+        # hybrid layout / the plan optimizer): corruption values start
+        # past the table's end.
         if p.n_instrs < 1:
             return None
         i = int(rng.integers(0, p.n_instrs))
-        p.instrs[i, 0] = int(rng.choice([7, 9, 42, 127, -1]))
+        p.instrs[i, 0] = int(rng.choice([8, 9, 42, 127, -1]))
         return p
     if kind == "dst_slot":
         if p.n_instrs < 1 or p.n_slots < 1:
@@ -236,7 +269,133 @@ def mutate_plan(rng: np.random.Generator, plan: mk.Plan,
                 p.xslots[b][j] = int(sshape[0]) + int(rng.integers(0, 5))
                 return p
         return None
+    n_gathered = p.n_slots + p.n_xslots
+    if kind == "cse_alias":
+        # A CSE pass that aliases a use onto the WRONG subtree — one
+        # whose defining instruction runs LATER. Redirect a real read
+        # at a scratch register first written after it: verify_plan's
+        # def-before-use walk must reject the forward reference.
+        first_write: Dict[int, int] = {}
+        for i in range(p.n_instrs):
+            d = int(p.instrs[i, 1])
+            if d >= n_gathered and d not in first_write:
+                first_write[d] = i
+        pairs = []
+        for i in _real_reading_instrs(p):
+            op = int(p.instrs[i, 0])
+            if op == mk.OP_EXPAND:
+                continue
+            for r, j in first_write.items():
+                if j > i:
+                    pairs.append((i, r))
+        if not pairs:
+            return None
+        i, r = pairs[int(rng.integers(0, len(pairs)))]
+        op = int(p.instrs[i, 0])
+        col = 3 if op in mk._READS_B and rng.random() < 0.5 else 2
+        p.instrs[i, col] = r
+        return p
+    if kind == "reorder_noncommutative":
+        # A fold-reorder pass that moves an instruction above the
+        # definition it reads (the bug class density-ordered
+        # reordering risks on ANDNOT chains). Swap a reader with the
+        # FIRST write of the scratch it reads: the read now precedes
+        # every write, a broken RAW chain verify_plan must reject.
+        first_write = {}
+        for i in range(p.n_instrs):
+            d = int(p.instrs[i, 1])
+            if d >= n_gathered and d not in first_write:
+                first_write[d] = i
+        pairs = []
+        for i in _real_reading_instrs(p):
+            op = int(p.instrs[i, 0])
+            if op == mk.OP_EXPAND:
+                continue
+            srcs = [int(p.instrs[i, 2])] if op in mk._READS_A else []
+            if op in mk._READS_B:
+                srcs.append(int(p.instrs[i, 3]))
+            for r in srcs:
+                j = first_write.get(r)
+                if j is not None and j < i:
+                    pairs.append((j, i))
+        if not pairs:
+            return None
+        j, i = pairs[int(rng.integers(0, len(pairs)))]
+        p.instrs[[j, i]] = p.instrs[[i, j]]
+        return p
+    if kind == "narrow_below_span":
+        # A width-narrowing pass that trims a lane BELOW the register's
+        # proven nonzero span — set bits past the new width would be
+        # silently dropped; the masking-invariant check must fire.
+        spans = _final_spans(p)
+        cands = []
+        for m, (lanes, lw) in enumerate((
+                (p.out_count, p.lane_count_widths),
+                (p.out_row, p.lane_row_widths))):
+            for j in range(len(lw)):
+                z = spans.get(int(lanes[j]))
+                if z is not None and z >= 2:
+                    cands.append((m, j, z))
+        if not cands:
+            return None
+        m, j, z = cands[int(rng.integers(0, len(cands)))]
+        # Lane-width lists are shared metadata in clone_plan; replace,
+        # never mutate in place.
+        if m == 0:
+            lw = list(p.lane_count_widths)
+            lw[j] = z - 1
+            p.lane_count_widths = lw
+        else:
+            lw = list(p.lane_row_widths)
+            lw[j] = z - 1
+            p.lane_row_widths = lw
+        return p
+    if kind == "thresh_off_by_one":
+        # An off-by-one in the thermometer chain: a THRESH rung reads
+        # a register no instruction initialised (t_{k} instead of
+        # t_{k-1} with t_k allocated but never zeroed). Point the
+        # accumulator read at the unwritten spare.
+        cands = [i for i in range(p.n_instrs)
+                 if int(p.instrs[i, 0]) == mk.OP_THRESH]
+        if not cands or not _spare_unwritten(p):
+            return None
+        i = cands[int(rng.integers(0, len(cands)))]
+        p.instrs[i, 2] = spare
+        return p
     raise ValueError(f"unknown mutation kind {kind!r}")
+
+
+def _final_spans(p: mk.Plan) -> Dict[int, Optional[int]]:
+    """Replay verify_plan's zero-extension transfer over the plan's
+    real instructions: register -> final nonzero word span (None =
+    never defined). Host-side twin of the lattice the checker walks,
+    used to pick mutation targets that are PROVABLY rejects."""
+    n_gathered = p.n_slots + p.n_xslots
+    widths = p.widths.tolist()
+    span: Dict[int, Optional[int]] = {
+        k: int(widths[k]) for k in range(n_gathered)}
+    for i in range(p.n_instrs):
+        op, dst, a, b = (int(x) for x in p.instrs[i])
+        if op == mk.OP_EXPAND:
+            span[dst] = int(widths[a]) if 0 <= a < len(widths) else 0
+            continue
+        za = span.get(a) if op in mk._READS_A else 0
+        zb = span.get(b) if op in mk._READS_B else 0
+        za = 0 if za is None else int(za)
+        zb = 0 if zb is None else int(zb)
+        if op == mk.OP_ZERO:
+            span[dst] = 0
+        elif op in (mk.OP_COPY, mk.OP_ANDNOT):
+            span[dst] = za
+        elif op == mk.OP_AND:
+            span[dst] = min(za, zb)
+        elif op == mk.OP_THRESH:
+            zd = span.get(dst)
+            zd = 0 if zd is None else int(zd)
+            span[dst] = max(zd, min(za, zb))
+        else:
+            span[dst] = max(za, zb)
+    return span
 
 
 # --------------------------------------------------------------- sweep
@@ -378,6 +537,41 @@ def synthetic_plans() -> List[Tuple[str, mk.Plan, int, int]]:
                   [bank, xp], [9, 3], [], 8, "row")
     finish("expand-mixed-dense", low, 8)
 
+    # Threshold (N-of-M) plans: thermometer expansions at interior k,
+    # the k == n AND-degenerate the lowering still expands, and the
+    # k > n empty-row edge (operands consumed, answer a zeroed reg).
+    for k, n in ((2, 3), (3, 4), (2, 2), (5, 3)):
+        low = mk.Lowering()
+        bank = _bank(8)
+        ir = tuple(("slot", 0, i) for i in range(n)) \
+            + (("thresh", k, n),)
+        low.add_entry(ir, [bank], list(range(n)), [], 8, "count")
+        low.add_entry(ir, [bank], list(range(1, n + 1)), [], 8, "row")
+        finish(f"thresh-{k}of{n}", low, 8)
+
+    # Threshold nested inside a fold (the Intersect(Threshold(...))
+    # shape) — the thermometer result feeds a downstream AND.
+    low = mk.Lowering()
+    bank = _bank(8)
+    ir = (("slot", 0, 0), ("slot", 0, 1), ("slot", 0, 2),
+          ("thresh", 2, 3), ("slot", 0, 3), ("fold", "and", 2))
+    low.add_entry(ir, [bank], [0, 1, 2, 3], [], 8, "count")
+    finish("thresh-nested-fold", low, 8)
+
+    # Optimizer-shaped plans: every sweep plan above, run through the
+    # REAL optimize_plan pipeline (ops/plan_opt.py, pure host numpy).
+    # The optimizer's own contract is "every emitted plan verifies
+    # clean", so PV001 on these catches a pass that emits well-formed-
+    # looking but ill-typed plans, and PV002 proves the mutation set
+    # still bites on CSE'd / reordered / narrowed shapes.
+    from pilosa_tpu.ops import plan_opt
+    opt_out: List[Tuple[str, mk.Plan, int, int]] = []
+    for name, plan, n_shards, w_mega in out:
+        opt, _stats = plan_opt.optimize_plan(plan, n_shards, w_mega)
+        if opt is not plan:
+            opt_out.append((f"{name}+opt", opt, n_shards, w_mega))
+    out.extend(opt_out)
+
     return out
 
 
@@ -421,8 +615,30 @@ def sarif_document(findings: Sequence[Tuple[str, str]]) -> Dict[str, object]:
 
 
 def run_sweep(seed: int, verbose: bool = False) -> List[Tuple[str, str]]:
-    """The PV001/PV002 sweep; returns findings (empty = clean)."""
+    """The PV001/PV002/PV003 sweep; returns findings (empty = clean)."""
     findings: List[Tuple[str, str]] = []
+    # PV003: the per-opcode coverage table must span the opcode table
+    # exactly, and only name real mutation kinds (graftlint GL014 is
+    # the static twin of this check).
+    for opname in mk.OP_NAMES:
+        kinds = OPCODE_MUTATIONS.get(opname)
+        if not kinds:
+            findings.append((
+                "PV003",
+                f"opcode '{opname}' has no OPCODE_MUTATIONS entry — "
+                f"extend the mutation table before shipping it"))
+            continue
+        for k in kinds:
+            if k not in PLAN_MUTATIONS:
+                findings.append((
+                    "PV003",
+                    f"opcode '{opname}' names unknown mutation kind "
+                    f"'{k}'"))
+    for opname in OPCODE_MUTATIONS:
+        if opname not in mk.OP_NAMES:
+            findings.append((
+                "PV003",
+                f"OPCODE_MUTATIONS names '{opname}', not an opcode"))
     plans = synthetic_plans()
     mutations_applied = 0
     for case_i, (name, plan, n_shards, w_mega) in enumerate(plans):
