@@ -55,25 +55,32 @@ class AttrStore:
     def open(self) -> None:
         if not self.path:
             return
-        if os.path.exists(self.path):
-            with open(self.path) as f:
-                raw = json.load(f)
-            self.attrs = {int(k): v for k, v in raw.items()}
-        if os.path.exists(self._log_path):
-            keep = 0
-            with open(self._log_path, "rb") as f:
-                for line in f:
-                    try:
-                        delta = json.loads(line)
-                    except ValueError:
-                        break  # torn tail: stop at the first bad line
-                    self._apply({int(k): v for k, v in delta.items()})
-                    keep += len(line)
-                    self._log_entries += 1
-            if keep < os.path.getsize(self._log_path):
-                with open(self._log_path, "ab") as f:
-                    f.truncate(keep)
-            self._log_bytes = keep
+        # Under the lock: open() normally runs before the store is
+        # shared, but the acquisition costs nothing and makes the
+        # publication of `attrs` ordered against concurrent get()s if
+        # a holder ever reopens live.
+        with self._lock:
+            if os.path.exists(self.path):
+                with open(self.path) as f:
+                    raw = json.load(f)
+                self.attrs = {int(k): v for k, v in raw.items()}
+            if os.path.exists(self._log_path):
+                keep = 0
+                with open(self._log_path, "rb") as f:
+                    for line in f:
+                        try:
+                            delta = json.loads(line)
+                        except ValueError:
+                            break  # torn tail: stop at the first bad
+                            # line
+                        self._apply(
+                            {int(k): v for k, v in delta.items()})
+                        keep += len(line)
+                        self._log_entries += 1
+                if keep < os.path.getsize(self._log_path):
+                    with open(self._log_path, "ab") as f:
+                        f.truncate(keep)
+                self._log_bytes = keep
 
     def close(self) -> None:
         with self._lock:

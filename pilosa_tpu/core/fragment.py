@@ -1007,8 +1007,11 @@ class Fragment:
         return out
 
     def _invalidate_block_checksums(self) -> None:
-        self._block_digests = None
-        self._dirty_blocks.clear()
+        # Reentrant lock: callers (benches, maintenance) may or may
+        # not hold it; checksum_blocks reads both fields under it.
+        with self._lock:
+            self._block_digests = None
+            self._dirty_blocks.clear()
 
     def block_data(self, block: int) -> Tuple[np.ndarray, np.ndarray]:
         """(row_ids, column_ids) pairs in a block (reference blockData,

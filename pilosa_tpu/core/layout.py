@@ -48,7 +48,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from pilosa_tpu.core.view import VIEW_BSI_PREFIX
 from pilosa_tpu.utils.hotspots import WORKLOAD
@@ -63,7 +63,9 @@ HYBRID_LAYOUT_ENABLED = os.environ.get(
     "PILOSA_TPU_HYBRID_LAYOUT", "1") != "0"
 
 
-def entry_density_score(info: Dict[str, Any], rate: float):
+def entry_density_score(
+        info: Dict[str, Any],
+        rate: float) -> Optional[Tuple[float, float]]:
     """(density, demotionScore) of one ledger bank entry: density is
     the pad-share times the clamped sampled live-bit density, score is
     ``(1 - density) * bytes / (1 + rate)`` — THE quadrant formula, the
@@ -87,7 +89,7 @@ def entry_density_score(info: Dict[str, Any], rate: float):
     return density, (1.0 - density) * nbytes / (1.0 + rate)
 
 
-def demotion_scores(entries) -> Dict[Any, float]:
+def demotion_scores(entries: Iterable[Any]) -> Dict[Any, float]:
     """Demotion score per BankBudget entry key ((id(view), cache_key)
     -> score) for the entries the ledger + workload plane can price —
     applied at eviction time so HBM pressure evicts the
@@ -174,13 +176,14 @@ class LayoutManager:
 
     # ------------------------------------------------------------ the pass
 
-    def _resolve_view(self, index: str, field: str, view: str):
+    def _resolve_view(self, index: str, field: str,
+                      view: str) -> Optional[Any]:
         idx = self.holder.index(index)
         f = idx.field(field) if idx is not None else None
         return f.view(view) if f is not None else None
 
     @staticmethod
-    def _eligible(view) -> bool:
+    def _eligible(view: Any) -> bool:
         """A view the hybrid layout may demote: a row-leaf view (BSI
         plane banks gather depth+1 rows per leaf and stay dense) whose
         trimmed width fits the u16 bitpos encoding."""
@@ -189,10 +192,10 @@ class LayoutManager:
             return False
         if not view.fragments:
             return False
-        return view.trimmed_words() * 32 <= CONTAINER_BITS
+        return bool(view.trimmed_words() * 32 <= CONTAINER_BITS)
 
     def _sparse_views(self) -> List[Any]:
-        out = []
+        out: List[Any] = []
         for idx in list(self.holder.indexes.values()):
             for f in list(idx.fields.values()):
                 for v in list(f.views.values()):
@@ -200,7 +203,7 @@ class LayoutManager:
                         out.append(v)
         return out
 
-    def demote(self, view) -> bool:
+    def demote(self, view: Any) -> bool:
         """Dense -> sparse: drop the view's dense cached banks and
         prebuild the SparseBank so the before/after byte delta is
         ledger-provable immediately (lazy rebuild would defer the
@@ -238,7 +241,7 @@ class LayoutManager:
                 bank.nbytes if bank else 0)
         return True
 
-    def promote(self, view) -> bool:
+    def promote(self, view: Any) -> bool:
         """Sparse -> dense: drop the SparseBank; the dense bank
         rebuilds lazily on the next query (promotion is triggered by
         heat, so "next query" is imminent and pays one build — the

@@ -882,10 +882,17 @@ class View:
                 return None
         row_ids = frag.row_ids()  # sorted immutable tuple (contract)
         built = None
+        # graftlint: disable=GL015 — deliberate lock-free rebuild: the
+        # bank is stamped with the versions read under the first
+        # acquisition, so a write landing during the build makes the
+        # stamp stale and the next probe rebuilds (write-back is
+        # last-writer-wins; a stale bank is never SERVED, only stored).
         if isinstance(cached, PositionsBank) \
                 and cached.row_ids == row_ids:
+            # graftlint: disable=GL015 — same version-stamp argument.
             built = self._patch_pbank(cached, frag, width)
         if built is None:
+            # graftlint: disable=GL015 — same version-stamp argument.
             built = self._build_pbank_segments(frag, row_ids, width, 0)
         if built is None:
             return None

@@ -126,8 +126,16 @@ class AsyncBroadcaster:
                         return
                     with self._lock:
                         nxt, backoff = self._backoff.get(uri, (0.0, 0.0))
+                    # graftlint: disable=GL015 — the backoff gate is
+                    # advisory: a racing re-arm at worst drains one
+                    # poll tick early, and _drain_peer re-reads the
+                    # queue under the lock before every send.
                     if now < nxt:
                         continue
+                    # graftlint: disable=GL015 — backoff is a retry
+                    # hint, not state: _drain_peer resets it from the
+                    # send outcome, re-checking the head under the
+                    # lock before each pop.
                     self._drain_peer(uri, backoff)
                 with self._lock:
                     if not any(self._queues.values()):

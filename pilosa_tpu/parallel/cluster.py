@@ -304,6 +304,13 @@ class Cluster:
         with self._lock:
             resizing = self.state == STATE_RESIZING and \
                 self.prev_nodes is not None
+        # graftlint: disable=GL015 — widening-only guard: a resize
+        # STARTING after the check loses nothing (cur is the union
+        # source both sides agree on until prev_nodes is set), and
+        # shard_nodes(previous=True) re-validates prev_nodes under the
+        # lock — a resize FINISHING in the window falls back to the
+        # current epoch. Read routing, where staleness undercounted,
+        # is route_shards — check and act in ONE acquisition.
         if not resizing:
             return cur
         prev = self.shard_nodes(index, shard, previous=True)

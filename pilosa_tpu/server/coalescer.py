@@ -198,14 +198,16 @@ class QueryCoalescer:
             # old dispatcher running — never spawn a second one over
             # the same queue.
             return
-        self._stop = False
-        self._running = True
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="query-coalescer")
+        with self._cond:
+            self._stop = False
+            self._running = True
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="query-coalescer")
         self._thread.start()
         if self.pipeline and (self._pl_thread is None
                               or not self._pl_thread.is_alive()):
-            self._pl_stop = False
+            with self._pl_cond:
+                self._pl_stop = False
             self._pl_thread = threading.Thread(
                 target=self._finalize_loop, daemon=True,
                 name="query-coalescer-finalize")
@@ -232,7 +234,8 @@ class QueryCoalescer:
                         "coalescer drain timed out after %.0fs; "
                         "dispatcher still executing a batch", timeout)
                 return
-            self._thread = None
+            with self._cond:
+                self._thread = None
         # The dispatcher barriers its own in-flight batch before
         # exiting, so the finalizer is idle here — stop it too.
         with self._pl_cond:
@@ -351,6 +354,10 @@ class QueryCoalescer:
                     # Items that arrived while executing have waited
                     # their window already: take them on the next loop
                     # pass without re-arming the timer.
+                    # graftlint: disable=GL015 — busy_next snapshots
+                    # the queue at claim time ON PURPOSE and is OR-ed
+                    # with a fresh read: staleness can only err toward
+                    # one extra busy pass, never a lost wakeup.
                     self._busy = busy_next or bool(self._queue)
             self._pipeline_barrier()
         except BaseException as e:  # dispatcher died: strand nobody

@@ -21,10 +21,22 @@ Granularity notes:
   statically.
 - ``DebugCondition.wait`` pops the condition from the held stack for
   the duration of the wait (the underlying lock really is released),
-  so edges observed across a wait reflect what is actually held.
+  so edges observed across a wait reflect what is actually held — and
+  the wait's eventual RE-ACQUIRE is recorded as an acquisition edge
+  from the NOTIFY side: delivering a notify while holding lock A means
+  the waiter's re-acquire of the condition is ordered after A, so
+  ``notify`` records A -> cond (catching a notify-side cycle, e.g.
+  ``with cond: with A: notify`` against any A-before-cond order). A
+  lock held ACROSS the wait that the notify path also needs is the
+  lost-wakeup deadlock shape — reported directly.
 - Violations both raise at the offending acquire AND accumulate in
   ``lock_order_violations()`` so a test session can assert emptiness
   even when application code swallows the raise.
+
+Third factory mode: while a ``pilosa_tpu.utils.sched.Scheduler`` is
+active, the factories return its Sched* wrappers instead — every
+acquire/release/wait/notify becomes a deterministic-interleaving yield
+point so tools/interleave.py can model-check real modules unchanged.
 """
 
 from __future__ import annotations
@@ -36,6 +48,8 @@ from __future__ import annotations
 import os
 import threading
 from typing import Dict, List, Optional, Set, Tuple
+
+from pilosa_tpu.utils import sched as _sched
 
 
 def _enabled() -> bool:
@@ -57,6 +71,8 @@ class _OrderGraph:
         self._edges: Dict[str, Set[str]] = {}
         # (held, acquiring) -> provenance string, for reports.
         self._seen: Dict[Tuple[str, str], str] = {}
+        # cond name -> lock names some waiter held ACROSS a wait on it.
+        self._wait_retained: Dict[str, Set[str]] = {}
         self.violations: List[str] = []
 
     def before_acquire(self, held: List[str], name: str) -> None:
@@ -92,6 +108,50 @@ class _OrderGraph:
                     stack.append((nxt, path + [nxt]))
         return None
 
+    def note_wait(self, cond: str, retained: List[str]) -> None:
+        """A waiter is about to drop `cond` while still holding
+        `retained` — remembered so a later notify can detect the
+        lost-wakeup shape (notify path needs a lock a waiter keeps)."""
+        if not retained:
+            return
+        with self._mu:
+            self._wait_retained.setdefault(cond, set()).update(retained)
+
+    def on_notify(self, cond: str, notifier_held: List[str]) -> None:
+        """The waiter's ``wait()`` re-acquire of `cond`, recorded as an
+        acquisition edge from the notify side: the re-acquire is
+        enabled while the notifier's other locks are held, so each
+        held -> cond edge participates in cycle detection exactly like
+        a direct acquisition. Also flags the lost-wakeup deadlock: a
+        lock some waiter retained across its wait that this notify
+        path is holding."""
+        held = [h for h in notifier_held if h != cond]
+        msgs: List[str] = []
+        with self._mu:
+            stuck = self._wait_retained.get(cond, set()) & set(held)
+            for r in sorted(stuck):
+                msgs.append(
+                    f"condition {cond!r}: notify path holds {r!r}, "
+                    f"which a waiter retains across its wait "
+                    f"(lost-wakeup deadlock)")
+            for h in held:
+                self._edges.setdefault(h, set()).add(cond)
+                self._seen.setdefault(
+                    (h, cond),
+                    f"{h} held while notifying {cond} (waiter "
+                    f"re-acquire edge)")
+            if not msgs:
+                cycle = self._find_cycle(cond, set(held))
+                if cycle is not None:
+                    msgs.append(
+                        "lock-order cycle through condition: "
+                        + " -> ".join(cycle)
+                        + f" (thread {threading.current_thread().name}"
+                        + f" notifies {cond!r} holding {held!r})")
+            self.violations.extend(msgs)
+        if msgs:
+            raise LockOrderError(msgs[0])
+
     def edges(self) -> Dict[str, Set[str]]:
         with self._mu:
             return {k: set(v) for k, v in self._edges.items()}
@@ -100,6 +160,7 @@ class _OrderGraph:
         with self._mu:
             self._edges.clear()
             self._seen.clear()
+            self._wait_retained.clear()
             self.violations.clear()
 
 
@@ -218,15 +279,25 @@ class DebugCondition:
 
     # Condition protocol ------------------------------------------------
     def wait(self, timeout: Optional[float] = None) -> bool:
+        # Locks retained across the wait feed the notify-side
+        # lost-wakeup check; the re-acquire itself routes through
+        # _CondShim._acquire_restore -> DebugLock.acquire, so its
+        # held -> cond edges are recorded like any acquisition.
+        _GRAPH.note_wait(self.name,
+                         [h for h in _held() if h != self.name])
         return self._cond.wait(timeout)
 
     def wait_for(self, predicate, timeout: Optional[float] = None):
+        _GRAPH.note_wait(self.name,
+                         [h for h in _held() if h != self.name])
         return self._cond.wait_for(predicate, timeout)
 
     def notify(self, n: int = 1) -> None:
+        _GRAPH.on_notify(self.name, list(_held()))
         self._cond.notify(n)
 
     def notify_all(self) -> None:
+        _GRAPH.on_notify(self.name, list(_held()))
         self._cond.notify_all()
 
     def __repr__(self) -> str:
@@ -272,13 +343,24 @@ class _CondShim:
 
 def make_lock(name: str):
     """A mutex named for diagnostics: plain threading.Lock normally,
-    order-checked DebugLock under PILOSA_TPU_LOCK_CHECK=1."""
+    order-checked DebugLock under PILOSA_TPU_LOCK_CHECK=1, and a
+    scheduler-instrumented SchedLock while an interleaving explorer
+    (pilosa_tpu.utils.sched.Scheduler) is active."""
+    sch = _sched.active_scheduler()
+    if sch is not None:
+        return _sched.SchedLock(name, sch)
     return DebugLock(name) if _enabled() else threading.Lock()
 
 
 def make_rlock(name: str):
+    sch = _sched.active_scheduler()
+    if sch is not None:
+        return _sched.SchedRLock(name, sch)
     return DebugRLock(name) if _enabled() else threading.RLock()
 
 
 def make_condition(name: str):
+    sch = _sched.active_scheduler()
+    if sch is not None:
+        return _sched.SchedCondition(name, sch)
     return DebugCondition(name) if _enabled() else threading.Condition()

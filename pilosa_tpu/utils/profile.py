@@ -344,7 +344,10 @@ class Profiler:
     def configure(self, sample_every: Optional[int] = None,
                   ring_size: Optional[int] = None) -> None:
         if sample_every is not None:
-            self.sample_every = max(0, int(sample_every))
+            # Under the lock: begin() divides by it inside the same
+            # critical section that bumps _seq.
+            with self._lock:
+                self.sample_every = max(0, int(sample_every))
         if ring_size is not None:
             with self._lock:
                 self._ring = deque(self._ring, maxlen=max(1, int(ring_size)))

@@ -1,0 +1,38 @@
+"""GL015 fail fixture: check-then-act across lock scopes — a stale
+guard used under a re-acquisition, one passed into a call that takes
+the lock again, and an early-return guard ahead of placement math."""
+from pilosa_tpu.utils.locks import make_lock
+
+
+class Registry:
+    def __init__(self):
+        self._lock = make_lock("Registry._lock")
+        self.state = "NORMAL"
+        self.items = {}
+
+    def _place(self, previous):
+        with self._lock:
+            return dict(self.items) if previous else {}
+
+    def route(self):
+        # Guard read under one acquisition, consumed by a helper that
+        # re-acquires: the resize-routing race shape.
+        with self._lock:
+            previous = self.state == "RESIZING"
+        return self._place(previous)
+
+    def bump(self):
+        # Stale index used under a separate acquisition.
+        with self._lock:
+            n = len(self.items)
+        with self._lock:
+            self.items[n] = "x"
+
+    def fan_out(self):
+        # Early-return guard: the check and the placement math run
+        # under different acquisitions.
+        with self._lock:
+            quiet = self.state == "NORMAL"
+        if not quiet:
+            return {}
+        return self._place(False)

@@ -1,0 +1,46 @@
+"""GL015 pass fixture: the safe shapes — check and act under ONE
+acquisition, snapshot-then-send with no re-acquire, and double-checked
+fill (the second critical section re-validates before acting)."""
+from pilosa_tpu.utils.locks import make_lock
+
+
+def send(payload):
+    return payload
+
+
+class Registry:
+    def __init__(self):
+        self._lock = make_lock("Registry._lock")
+        self.state = "NORMAL"
+        self.items = {}
+
+    def _place_locked(self, previous):
+        # Callers hold the lock; no acquisition here.
+        return dict(self.items) if previous else {}
+
+    def route(self):
+        # Check and act atomically: one critical section.
+        with self._lock:
+            previous = self.state == "RESIZING"
+            return self._place_locked(previous)
+
+    def publish(self):
+        # Snapshot under the lock, send after — nothing re-acquires.
+        with self._lock:
+            snap = dict(self.items)
+        return send(snap)
+
+    def fill(self, key):
+        # Double-checked: the stale probe only gates the attempt; the
+        # second critical section re-reads before mutating.
+        with self._lock:
+            cur = self.items.get(key)
+        if cur is not None:
+            return cur
+        built = object()
+        with self._lock:
+            fresh = self.items.get(key)
+            if fresh is None:
+                self.items[key] = built
+                fresh = built
+        return fresh

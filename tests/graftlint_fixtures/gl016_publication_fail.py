@@ -1,0 +1,28 @@
+"""GL016 fail fixture: attributes read under the class lock but
+assigned outside it — plain store, augmented store, and a helper whose
+call sites do NOT all hold the lock."""
+from pilosa_tpu.utils.locks import make_lock
+
+
+class Stats:
+    def __init__(self):
+        self._lock = make_lock("Stats._lock")
+        self.total = 0
+        self.rate = 0.0
+        self.label = ""
+
+    def snapshot(self):
+        with self._lock:
+            return (self.total, self.rate, self.label)
+
+    def bump(self, n):
+        self.total += n  # unsynchronized publication
+
+    def set_rate(self, r):
+        self.rate = r  # unsynchronized publication
+
+    def rename(self, s):
+        self._apply_label(s)  # caller does NOT hold the lock
+
+    def _apply_label(self, s):
+        self.label = s

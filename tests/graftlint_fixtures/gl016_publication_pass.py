@@ -1,0 +1,31 @@
+"""GL016 pass fixture: the safe shapes — stores under the lock, a
+lock-held helper (every call site inside the critical section), and an
+attribute never consumed under the lock."""
+from pilosa_tpu.utils.locks import make_lock
+
+
+class Stats:
+    def __init__(self):
+        self._lock = make_lock("Stats._lock")
+        self.total = 0
+        self.label = ""
+
+    def snapshot(self):
+        with self._lock:
+            return self.total
+
+    def bump(self, n):
+        with self._lock:
+            self._bump_held(n)
+
+    def rebase(self):
+        with self._lock:
+            self._bump_held(0)
+
+    def _bump_held(self, n):
+        # Both call sites hold the lock: synchronized by callers.
+        self.total += n
+
+    def rename(self, s):
+        # Never read under the lock — not this rule's business.
+        self.label = s

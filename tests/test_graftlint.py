@@ -39,6 +39,8 @@ def fixture_config() -> Config:
         failpoint_paths=("graftlint_fixtures/gl013",),
         opcode_table_paths=("graftlint_fixtures/gl014",),
         mutation_table_paths=("graftlint_fixtures/gl014",),
+        atomicity_paths=("graftlint_fixtures/gl015",),
+        publication_paths=("graftlint_fixtures/gl016",),
     )
 
 
@@ -70,6 +72,8 @@ def codes_for(filename, config=None):
     ("gl013_failpoints_fail.py", "gl013_failpoints_pass.py", "GL013"),
     ("gl014_opcodecoverage_fail.py", "gl014_opcodecoverage_pass.py",
      "GL014"),
+    ("gl015_checkthenact_fail.py", "gl015_checkthenact_pass.py", "GL015"),
+    ("gl016_publication_fail.py", "gl016_publication_pass.py", "GL016"),
 ])
 def test_rule_fixtures(fail_fixture, pass_fixture, code):
     fail_codes = codes_for(fail_fixture)
@@ -127,6 +131,38 @@ def test_gl014_counts_and_kinds():
     cfg = fixture_config()
     cfg.mutation_table_paths = ("graftlint_fixtures/elsewhere",)
     assert codes_for("gl014_opcodecoverage_fail.py", cfg) == []
+
+
+def test_gl015_counts_and_kinds():
+    """Exactly three findings in the fail fixture — guard handed to a
+    re-acquiring helper (the resize-routing shape), stale index used
+    under a separate acquisition, early-return guard ahead of placement
+    math — and each names the stale local."""
+    findings = lint_files(
+        [os.path.join(FIXTURES, "gl015_checkthenact_fail.py")],
+        fixture_config())
+    gl15 = [f for f in findings if f.code == "GL015"]
+    assert len(gl15) == 3, gl15
+    msgs = " | ".join(f.message for f in gl15)
+    assert "`previous`" in msgs and "re-acquires the lock" in msgs
+    assert "`n` was computed" in msgs
+    assert "`quiet`" in msgs
+
+
+def test_gl016_counts_and_kinds():
+    """Exactly three findings in the fail fixture — augmented store,
+    plain store, and a helper whose call sites do not all hold the
+    lock — each naming the attribute and the witnessing reader."""
+    findings = lint_files(
+        [os.path.join(FIXTURES, "gl016_publication_fail.py")],
+        fixture_config())
+    gl16 = [f for f in findings if f.code == "GL016"]
+    assert len(gl16) == 3, gl16
+    msgs = " | ".join(f.message for f in gl16)
+    assert "`self.total`" in msgs
+    assert "`self.rate`" in msgs
+    assert "`self.label`" in msgs
+    assert "snapshot" in msgs
 
 
 def test_gl001_context_manager_is_not_a_lock():
@@ -218,7 +254,8 @@ def test_pass_fixtures_fully_clean():
                  "gl005_dtype_pass.py", "gl006_jitsite_pass.py",
                  "gl007_ledger_pass.py", "gl008_growth_pass.py",
                  "gl009_blocking_pass.py", "gl010_pairs_pass.py",
-                 "gl011_ctypes_pass.py"):
+                 "gl011_ctypes_pass.py", "gl015_checkthenact_pass.py",
+                 "gl016_publication_pass.py"):
         assert codes_for(name) == [], name
 
 
@@ -551,6 +588,70 @@ def test_debugcondition_wait_releases_held_stack(clean_graph):
     assert hits == ["woke"]
     # Reverse order in the waiter thread after wake would now trip; the
     # plain wake path must be violation-free.
+    assert lock_order_violations() == []
+
+
+def test_notify_side_cycle_through_condition(clean_graph):
+    """The waiter's wait() re-acquire is recorded from the NOTIFY side:
+    ``with cond: with A: notify()`` acquires in cond -> A order (clean
+    for the acquire-side checker) yet wakes waiters whose re-acquire of
+    cond is ordered AFTER A — the A -> cond edge closes the cycle that
+    only the notify path can see."""
+    from pilosa_tpu.utils.locks import (
+        DebugCondition, DebugLock, LockOrderError, lock_order_violations,
+    )
+    cond = DebugCondition("t.cond")
+    a = DebugLock("t.A")
+    with pytest.raises(LockOrderError, match="cycle through condition"):
+        with cond:
+            with a:  # establishes cond -> A; held at the notify
+                cond.notify_all()
+    assert lock_order_violations()
+
+
+def test_notify_records_reacquire_edge(clean_graph):
+    from pilosa_tpu.utils.locks import (
+        DebugCondition, DebugLock, lock_order_edges,
+    )
+    cond = DebugCondition("t.cond")
+    a = DebugLock("t.A")
+    with a:
+        with cond:
+            cond.notify()
+    assert "t.cond" in lock_order_edges().get("t.A", set())
+
+
+def test_notify_lost_wakeup_retained_lock(clean_graph):
+    """A lock held ACROSS a wait that the notify path also holds is the
+    lost-wakeup deadlock shape — flagged at the notify even when the
+    timed wait keeps the test itself live."""
+    from pilosa_tpu.utils.locks import (
+        DebugCondition, DebugLock, LockOrderError,
+    )
+    cond = DebugCondition("t.cond")
+    outer = DebugLock("t.outer")
+
+    def waiter():
+        with outer:          # retained across the wait
+            with cond:
+                cond.wait(timeout=0.5)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.1)
+    with pytest.raises(LockOrderError, match="lost-wakeup"):
+        with outer:          # notify path needs what the waiter keeps
+            with cond:
+                cond.notify_all()
+    t.join(timeout=5)
+
+
+def test_notify_without_extra_locks_is_silent(clean_graph):
+    from pilosa_tpu.utils.locks import DebugCondition, lock_order_violations
+    cond = DebugCondition("t.cond")
+    with cond:
+        cond.notify_all()
     assert lock_order_violations() == []
 
 

@@ -1,0 +1,273 @@
+"""Concurrency verification plane: the deterministic interleaving
+explorer (pilosa_tpu/utils/sched.py + tools/interleave.py).
+
+Pins the plane's own contract: schedule enumeration is deterministic
+(the --digest pin), the wait-for graph catches a minimal AB/BA
+deadlock, the three re-introduced historical races are found within
+the default schedule budget, every good scenario sweeps clean, and the
+pinned corpus replays to its recorded verdicts.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from pilosa_tpu.utils import sched
+
+import tools.interleave as il
+
+CORPUS = os.path.join(os.path.dirname(__file__), "interleave_corpus")
+
+
+# ------------------------------------------------- scheduler basics
+
+
+def test_factories_return_sched_wrappers_under_scheduler():
+    from pilosa_tpu.utils.locks import (make_condition, make_lock,
+                                        make_rlock)
+    with sched.Scheduler(sched.schedule_decider([])):
+        assert isinstance(make_lock("x"), sched.SchedLock)
+        assert isinstance(make_rlock("x"), sched.SchedRLock)
+        assert isinstance(make_condition("x"), sched.SchedCondition)
+    # Back to uninstrumented primitives once the scheduler deactivates.
+    assert not isinstance(make_lock("x"), sched.SchedLock)
+
+
+def _explore_increment_finals(use_lock, budget):
+    finals = set()
+
+    def run_with(decide):
+        with sched.Scheduler(decide) as s:
+            from pilosa_tpu.utils.locks import make_lock
+            lock = make_lock("L")
+            state = {"n": 0}
+
+            def inc():
+                if use_lock:
+                    with lock:
+                        v = state["n"]
+                        sched.checkpoint()
+                        state["n"] = v + 1
+                else:
+                    v = state["n"]
+                    sched.checkpoint()
+                    state["n"] = v + 1
+
+            s.spawn("t1", inc)
+            s.spawn("t2", inc)
+            out = s.run()
+        assert not out.failed
+        finals.add(state["n"])
+        return out
+
+    sched.explore_dfs(run_with, budget)
+    return finals
+
+
+def test_unlocked_increment_races_locked_does_not():
+    # Exhaustive over the schedule space: the lost update IS reachable
+    # without the lock, and unreachable in EVERY interleaving with it.
+    assert _explore_increment_finals(False, 100) == {1, 2}
+    assert _explore_increment_finals(True, 200) == {2}
+
+
+def test_explore_enumerates_deterministically():
+    def run_with(decide):
+        with sched.Scheduler(decide) as s:
+            from pilosa_tpu.utils.locks import make_lock
+            lock = make_lock("L")
+
+            def worker():
+                with lock:
+                    sched.checkpoint()
+
+            s.spawn("a", worker)
+            s.spawn("b", worker)
+            return s.run()
+
+    one = [schedule for schedule, _ in sched.explore_dfs(run_with, 50)]
+    two = [schedule for schedule, _ in sched.explore_dfs(run_with, 50)]
+    assert one == two
+    assert len(one) == len({tuple(s) for s in one})  # no duplicates
+
+
+def test_deadlock_abba_minimal():
+    """The wait-for graph names both parties of an AB/BA deadlock."""
+
+    def run_with(decide):
+        with sched.Scheduler(decide) as s:
+            from pilosa_tpu.utils.locks import make_lock
+            a, b = make_lock("A"), make_lock("B")
+
+            def t1():
+                with a:
+                    with b:
+                        pass
+
+            def t2():
+                with b:
+                    with a:
+                        pass
+
+            s.spawn("t1", t1)
+            s.spawn("t2", t2)
+            return s.run()
+
+    deadlocks = [o.deadlock for _, o in sched.explore_dfs(run_with, 500)
+                 if o.deadlock is not None]
+    assert deadlocks, "AB/BA deadlock not found"
+    assert "t1" in deadlocks[0] and "t2" in deadlocks[0]
+    assert "'A'" in deadlocks[0] and "'B'" in deadlocks[0]
+
+
+def test_timed_wait_fires_only_at_quiescence():
+    def run_with(decide):
+        log = []
+        with sched.Scheduler(decide) as s:
+            from pilosa_tpu.utils.locks import make_condition
+            cond = make_condition("C")
+
+            def waiter():
+                with cond:
+                    log.append(cond.wait(timeout=0.01))
+
+            s.spawn("w", waiter)
+            out = s.run()
+        return out, log
+
+    out, log = run_with(sched.schedule_decider([]))
+    assert not out.failed
+    assert log == [False]  # timed out, did not deadlock
+
+
+def test_untimed_wait_without_notifier_is_deadlock():
+    def run_with(decide):
+        with sched.Scheduler(decide) as s:
+            from pilosa_tpu.utils.locks import make_condition
+            cond = make_condition("C")
+
+            def waiter():
+                with cond:
+                    cond.wait()
+
+            s.spawn("w", waiter)
+            return s.run()
+
+    out = run_with(sched.schedule_decider([]))
+    assert out.deadlock is not None
+    assert "no notifier" in out.deadlock
+
+
+# ------------------------------------------------ the scenario corpus
+
+
+GOOD = [s for s in il.SCENARIOS if not s.known_bad]
+KNOWN_BAD = [s for s in il.SCENARIOS if s.known_bad]
+HISTORICAL = ["bad_resize_two_step_route", "bad_bank_cache_unlocked_evict",
+              "bad_cache_stamp_then_read"]
+
+
+def test_corpus_has_the_three_historical_races():
+    names = {s.name for s in KNOWN_BAD}
+    assert set(HISTORICAL) <= names
+
+
+@pytest.mark.parametrize("scn", GOOD, ids=lambda s: s.name)
+def test_good_scenarios_sweep_clean(scn):
+    runs, failures = il.sweep(scn, scn.budget)
+    assert not failures, failures[:3]
+    assert runs > 10  # the sweep actually explored
+
+
+@pytest.mark.parametrize("scn", KNOWN_BAD, ids=lambda s: s.name)
+def test_known_bad_found_within_default_budget(scn):
+    """Each seeded re-introduction of a historical race must be found
+    deterministically within the DEFAULT budget — the explorer's own
+    regression gate."""
+    runs, failures = il.sweep(scn, il.DEFAULT_BUDGET)
+    assert failures, (f"{scn.name}: not caught within "
+                      f"{il.DEFAULT_BUDGET} schedules")
+
+
+def test_known_bad_failure_is_replayable():
+    """A found schedule is a complete reproducer: replaying it yields
+    the same verdict kind, twice."""
+    scn = il.scenario_by_name("bad_bank_cache_unlocked_evict")
+    _, failures = il.sweep(scn, il.DEFAULT_BUDGET)
+    pinned = failures[0]
+    r1 = il.judge(scn, il.run_once(
+        scn, sched.schedule_decider(pinned.schedule)))
+    r2 = il.judge(scn, il.run_once(
+        scn, sched.schedule_decider(pinned.schedule)))
+    assert r1.kind == r2.kind == pinned.kind
+
+
+def test_seed_index_reproducer_contract():
+    """(seed, index) regenerates the exact schedule — the
+    roaring_fuzz/plan_fuzz contract."""
+    import numpy as np
+    scn = il.scenario_by_name("bank_cache_miss_race")
+    a = il.run_once(scn, sched.rng_decider(np.random.default_rng([7, 3])))
+    b = il.run_once(scn, sched.rng_decider(np.random.default_rng([7, 3])))
+    assert a.schedule == b.schedule
+
+
+def test_digest_pin(capsys):
+    """Schedule-enumeration determinism: the full-sweep digest is
+    identical across back-to-back runs in one process."""
+    assert il.main(["--digest", "--no-save"]) == 0
+    d1 = capsys.readouterr().out.strip().splitlines()[-1]
+    assert il.main(["--digest", "--no-save"]) == 0
+    d2 = capsys.readouterr().out.strip().splitlines()[-1]
+    assert d1 == d2
+    assert len(d1) == 64  # sha256 hex
+
+
+def test_corpus_replay_green(capsys):
+    assert os.path.isdir(CORPUS), "pinned corpus missing"
+    entries = [f for f in os.listdir(CORPUS) if f.endswith(".json")]
+    assert len(entries) >= 4
+    assert il.main(["--replay"]) == 0
+
+
+def test_corpus_entries_are_wellformed():
+    for fname in sorted(os.listdir(CORPUS)):
+        if not fname.endswith(".json"):
+            continue
+        with open(os.path.join(CORPUS, fname)) as fh:
+            entry = json.load(fh)
+        assert {"scenario", "schedule", "expect"} <= set(entry)
+        il.scenario_by_name(entry["scenario"])  # must still exist
+        assert all(isinstance(c, int) for c in entry["schedule"])
+
+
+def test_sarif_output_shape(tmp_path):
+    out = tmp_path / "interleave.sarif"
+    rc = il.main(["--scenario", "bank_cache_miss_race",
+                  "--output", str(out), "--no-save"])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "interleave"
+    assert run["results"] == []  # green run: no findings
+
+
+def test_gate_flags_a_missing_known_bad(monkeypatch):
+    """If a 'known-bad' scenario stops failing (the race got fixed but
+    the fixture wasn't retired), the gate must fail loudly."""
+
+    class Fixed(il.Scenario):
+        name = "bad_fixture_actually_fixed"
+        known_bad = True
+
+        def build(self):
+            return None
+
+        def workers(self, state):
+            return [("t", lambda: None)]
+
+    ok, msg, _ = il.gate_scenario(Fixed(), 20)
+    assert not ok and "NOT caught" in msg

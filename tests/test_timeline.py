@@ -385,7 +385,16 @@ def test_slo_histograms_per_endpoint(live_api):
     except urllib.error.HTTPError as e:
         assert e.code == 404
         e.read()
-    met = urllib.request.urlopen(base + "/metrics").read().decode()
+    # The SLO observation runs in the handler's finally block, AFTER
+    # the response body went out — poll until all three landed.
+    met = ""
+    for _ in range(200):
+        met = urllib.request.urlopen(base + "/metrics").read().decode()
+        if ('endpoint="/schema"' in met
+                and 'endpoint="/index/{index}/query"' in met
+                and 'endpoint="other",status="404"' in met):
+            break
+        time.sleep(0.01)
     assert '# TYPE pilosa_http_request_seconds histogram' in met
     assert 'endpoint="/index/{index}/query"' in met
     assert 'endpoint="/schema"' in met
@@ -404,8 +413,15 @@ def test_slow_non_query_endpoint_cross_links_ring(live_api):
     api, base = live_api
     api.long_query_time = 1e-9
     urllib.request.urlopen(base + "/schema").read()
-    recs = [r for r in api.profiler.slow_queries()
-            if r.get("kind") == "http"]
+    # The SLO observation runs in the handler's finally block, AFTER
+    # the response body went out — the client can get here first.
+    recs = []
+    for _ in range(200):
+        recs = [r for r in api.profiler.slow_queries()
+                if r.get("kind") == "http"]
+        if recs:
+            break
+        time.sleep(0.01)
     assert recs, api.profiler.slow_queries()
     assert recs[0]["query"] == "GET /schema"
 

@@ -143,12 +143,47 @@ step "plan-IR verifier self-sweep (tools/planverify)"
 # rejected. Emits planverify.sarif beside the other analyzers.
 python -m tools.planverify --output planverify.sarif || fail=1
 
+step "interleave gate (corpus replay + known-bad detection + digest stability)"
+# The deterministic interleaving explorer (tools/interleave): the
+# committed reproducer corpus replays red-on-known-bad /
+# green-on-fixed, and every seeded known-bad scenario (the PR 8/10/14
+# races, re-introduced as fixtures) is found within the default
+# budget. Fast mode replays the corpus only; the default path adds the
+# full sweep (good scenarios clean, known-bad caught) and pins
+# exploration determinism (two --digest runs must agree), emitting
+# interleave.sarif beside the other analyzers.
+if [ "$FAST" = 1 ]; then
+    JAX_PLATFORMS=cpu python -m tools.interleave --replay || fail=1
+else
+    (
+        set -e
+        JAX_PLATFORMS=cpu python -m tools.interleave --replay
+        # DFS gate: good scenarios sweep clean, every known-bad race
+        # is caught within its budget; the SARIF artifact comes from
+        # this sweep.
+        JAX_PLATFORMS=cpu python -m tools.interleave --no-save \
+            --output interleave.sarif
+        # Seeded random walk over the good scenarios ((seed, index)
+        # reproducer contract).
+        JAX_PLATFORMS=cpu python -m tools.interleave --seed 0 \
+            --iters 100 --no-save
+        d1=$(JAX_PLATFORMS=cpu python -m tools.interleave --digest \
+            --no-save | tail -1)
+        d2=$(JAX_PLATFORMS=cpu python -m tools.interleave --digest \
+            --no-save | tail -1)
+        [ -n "$d1" ] && [ "$d1" = "$d2" ] || {
+            echo "interleave: digest UNSTABLE ($d1 vs $d2)"; exit 1; }
+        echo "interleave: digest stable ($d1)"
+    ) || fail=1
+fi
+
 if [ "$FAST" != 1 ]; then
-    step "SARIF merge (graftlint + native_tidy + planverify -> check.sarif)"
+    step "SARIF merge (graftlint + native_tidy + planverify + interleave -> check.sarif)"
     # One artifact for CI, one run object per tool (SARIF's own
     # composition model); availability-gated inputs may be absent.
     python -m tools.sarif_merge --output check.sarif \
-        graftlint.sarif native_tidy.sarif planverify.sarif || fail=1
+        graftlint.sarif native_tidy.sarif planverify.sarif \
+        interleave.sarif || fail=1
 fi
 
 step "profiler smoke (one profiled query, JAX_PLATFORMS=cpu)"

@@ -117,6 +117,16 @@ class Config:
     opcode_table_paths: Tuple[str, ...] = (
         "pilosa_tpu/ops/megakernel.py",)
     mutation_table_paths: Tuple[str, ...] = ("tools/planverify.py",)
+    # GL015: packages where a guard read under one lock acquisition
+    # must not control a dependent mutation under a LATER acquisition
+    # of the same lock (directly or through a call that re-acquires) —
+    # the resize-routing check-then-act shape.
+    atomicity_paths: Tuple[str, ...] = ("pilosa_tpu/", "tools/")
+    # GL016: packages where an attribute read under a class's lock
+    # must be assigned under it too (outside __init__) — an
+    # unsynchronized publication lets critical sections observe torn
+    # state.
+    publication_paths: Tuple[str, ...] = ("pilosa_tpu/", "tools/")
     select: Optional[Set[str]] = None
     ignore: Set[str] = field(default_factory=set)
 

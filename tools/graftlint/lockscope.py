@@ -1,0 +1,111 @@
+"""Shared lock-scope resolution for the concurrency rules.
+
+GL009 (blocking under a lock), GL015 (check-then-act) and GL016
+(unsynchronized publication) all need the same two questions answered:
+
+- *which lock does this ``with`` statement acquire?* — model resolution
+  first (exact: ``self._lock`` inside a class whose ``__init__``
+  constructs it through the ``make_*`` factories resolves to the lock
+  NODE ``Class._lock``), lock-shaped terminal names second (GL001's
+  heuristic — ``with open(path):`` never counts);
+- *which locks may this function acquire, transitively?* — the direct
+  ``with``-acquisitions per function closed over the shared call graph
+  (the same conservative resolution GL002 uses: unresolvable calls
+  contribute nothing, so the answer under-approximates).
+
+Lock identity is compared at two strengths: exact node name
+(``Cluster._lock``) when both sides resolve, and (base, attr) shape —
+``with c._lock:`` followed by ``c.method(...)`` where ``method``
+acquires a ``*._lock`` node is the SAME object's lock for any
+single-lock-attr class, which is how the rules see receivers the model
+cannot type.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import dotted_name, walk_shallow
+from tools.graftlint.model import FuncInfo, Model
+
+LOCKISH = re.compile(r"lock|mutex|cond|sem|guard", re.IGNORECASE)
+
+
+def with_lock_name(with_node: ast.With, fi: FuncInfo,
+                   model: Model) -> Optional[Tuple[str, str]]:
+    """``(lock_id, raw)`` when this with-statement acquires a lock:
+    ``lock_id`` is the resolved model node name when available, else
+    the raw dotted expression; ``raw`` is always the dotted source
+    text (``self._lock`` / ``c._lock`` / ``_REGISTRY_LOCK``)."""
+    for item in with_node.items:
+        expr = item.context_expr
+        name = dotted_name(expr)
+        if name is None:
+            continue
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            if expr.value.id == "self" and fi.cls is not None:
+                hit = model.class_lock_attrs.get((fi.cls, expr.attr))
+                if hit:
+                    return hit, name
+            hits = model.lock_attr_names.get(expr.attr, set())
+            if len(hits) == 1:
+                return next(iter(hits)), name
+        if isinstance(expr, ast.Name):
+            mod_locks = model.module_locks.get(fi.module, {})
+            if expr.id in mod_locks:
+                return mod_locks[expr.id], name
+        if LOCKISH.search(name.rsplit(".", 1)[-1]):
+            return name, name
+    return None
+
+
+def lock_withs(fi: FuncInfo, model: Model
+               ) -> List[Tuple[ast.With, str, str]]:
+    """Every lock-acquiring with-statement in one function scope, as
+    ``(node, lock_id, raw)``."""
+    out: List[Tuple[ast.With, str, str]] = []
+    for node in walk_shallow(fi.node):
+        if isinstance(node, ast.With):
+            hit = with_lock_name(node, fi, model)
+            if hit is not None:
+                out.append((node, hit[0], hit[1]))
+    return out
+
+
+def lock_attr(lock_id: str) -> str:
+    """The attribute/terminal component of a lock id — the piece two
+    differently-resolved references to the same lock share
+    (``Cluster._lock`` / ``c._lock`` -> ``_lock``)."""
+    return lock_id.rsplit(".", 1)[-1]
+
+
+def transitive_acquires(cg, model: Model) -> Dict[str, Set[str]]:
+    """qualname -> lock ids the function may acquire, directly or via
+    any resolvable callee. Memoized on the shared call graph (one
+    computation per lint run)."""
+    def build() -> Dict[str, Set[str]]:
+        direct = {
+            fi.qualname: {lid for _, lid, _ in lock_withs(fi, model)}
+            for fi in cg.funcs}
+        return cg.transitive_closure(direct)
+    return cg.memo("lockscope.acquires", build)
+
+
+def acquires_matching(acquired: Set[str], lock_id: str, raw: str,
+                      receiver: Optional[str]) -> bool:
+    """Does a callee that may acquire ``acquired`` re-acquire the lock
+    a caller identified as ``(lock_id, raw)``? Exact node match, or —
+    when the caller's reference did not resolve — same receiver base
+    and same lock attribute (``with c._lock:`` then ``c.m()`` where
+    ``m`` takes a ``*._lock``)."""
+    if lock_id in acquired:
+        return True
+    if receiver is None or "." not in raw:
+        return False
+    base, attr = raw.rsplit(".", 1)
+    if receiver != base:
+        return False
+    return any(lock_attr(a) == attr for a in acquired)

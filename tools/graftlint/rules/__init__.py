@@ -14,6 +14,8 @@ from tools.graftlint.rules.gl011_ctypes import GL011CtypesBoundary
 from tools.graftlint.rules.gl012_planlaunch import GL012UnverifiedPlanLaunch
 from tools.graftlint.rules.gl013_failpoints import GL013FailpointRegistry
 from tools.graftlint.rules.gl014_opcodecoverage import GL014OpcodeCoverage
+from tools.graftlint.rules.gl015_checkthenact import GL015CheckThenAct
+from tools.graftlint.rules.gl016_publication import GL016UnsyncPublication
 
 ALL_RULES = (
     GL001LockDiscipline(),
@@ -30,4 +32,6 @@ ALL_RULES = (
     GL012UnverifiedPlanLaunch(),
     GL013FailpointRegistry(),
     GL014OpcodeCoverage(),
+    GL015CheckThenAct(),
+    GL016UnsyncPublication(),
 )

@@ -36,7 +36,6 @@ finding names the chain (``f calls g which calls time.sleep``).
 from __future__ import annotations
 
 import ast
-import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from tools.graftlint.dataflow import (
@@ -45,9 +44,8 @@ from tools.graftlint.dataflow import (
 from tools.graftlint.engine import (
     Finding, Project, Rule, SourceFile, dotted_name, walk_shallow,
 )
+from tools.graftlint.lockscope import with_lock_name
 from tools.graftlint.model import FuncInfo
-
-_LOCKISH = re.compile(r"lock|mutex|cond|sem|guard", re.IGNORECASE)
 
 _SUBPROCESS_FNS = {"subprocess.run", "subprocess.call",
                    "subprocess.check_call", "subprocess.check_output"}
@@ -232,28 +230,8 @@ class GL009BlockingUnderLock(Rule):
 
     def _lock_name(self, with_node: ast.With, fi: FuncInfo,
                    model) -> Optional[str]:
-        """The held lock's name when this with-statement acquires one:
-        a resolvable model lock, or a lock-shaped terminal name
-        (GL001's heuristic — `with open(path):` never counts)."""
-        for item in with_node.items:
-            expr = item.context_expr
-            name = dotted_name(expr)
-            if name is None:
-                continue
-            # Model resolution first (exact), name shape second.
-            if isinstance(expr, ast.Attribute) \
-                    and isinstance(expr.value, ast.Name):
-                if expr.value.id == "self" and fi.cls is not None:
-                    hit = model.class_lock_attrs.get((fi.cls, expr.attr))
-                    if hit:
-                        return hit
-                hits = model.lock_attr_names.get(expr.attr, set())
-                if len(hits) == 1:
-                    return next(iter(hits))
-            if isinstance(expr, ast.Name):
-                mod_locks = model.module_locks.get(fi.module, {})
-                if expr.id in mod_locks:
-                    return mod_locks[expr.id]
-            if _LOCKISH.search(name.rsplit(".", 1)[-1]):
-                return name
-        return None
+        """The held lock's name when this with-statement acquires one
+        (tools.graftlint.lockscope — the resolution shared with
+        GL015/GL016)."""
+        hit = with_lock_name(with_node, fi, model)
+        return hit[0] if hit is not None else None

@@ -1,0 +1,230 @@
+"""GL015 — check-then-act across lock scopes.
+
+The PR 14 resize-routing race is this rule's motivating incident: the
+RESIZING flag was read under ``Cluster._lock`` in one acquisition and
+the placement computed under a SECOND acquisition — a topology change
+landing between them routed shards to a just-joined member that had
+not pulled yet, and the merge silently undercounted (a TopN missing
+exactly one shard, found live by tools/chaos.py). The fix
+(``route_shards``) made check and act one critical section; this rule
+flags the shape statically so the next one never ships.
+
+What the rule sees (per function, over the shared call graph):
+
+1. a **guard** — a local assigned inside a ``with <lock>:`` body from
+   an expression that reads state (any attribute read) — captures a
+   fact that is only true while the lock is held;
+2. after that critical section ends, the guard
+   - is read inside a LATER acquisition of the same lock (a stale
+     index/flag used under re-acquire),
+   - is passed as an argument to a call that may re-acquire the lock
+     (transitively, via the call graph — the resize-routing shape), or
+   - controls an ``if``/``while`` test ahead of a call that re-acquires
+     the lock — the early-return-guard shape (``if not resizing:
+     return`` then placement math that takes the lock again).
+
+A later critical section that **re-validates** — its body tests an
+attribute it re-reads, a local it assigns itself, or the guard's own
+value compared against captured state (``if q[0] == (deadline, msg)``)
+before acting — is the double-checked locking idiom and is NOT
+flagged: the second read under the lock is fresh, the stale guard only
+gated the attempt. Tests that sit INSIDE a later critical section are
+likewise left to the with-level check — under the lock again, the
+re-read governs, not the lexical position of the ``if``.
+Lock identity follows tools.graftlint.lockscope: exact model node when
+resolvable, same-receiver + same lock-attribute shape otherwise.
+
+A true positive that is safe for a deeper reason (the callee
+re-validates internally, the guard is monotone) carries a line-level
+``# graftlint: disable=GL015`` with the argument.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding, Project, Rule, dotted_name, walk_shallow,
+)
+from tools.graftlint.lockscope import (
+    acquires_matching, lock_withs, transitive_acquires,
+)
+from tools.graftlint.model import FuncInfo
+
+
+def _name_targets(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for elt in target.elts:
+            out.extend(_name_targets(elt))
+        return out
+    return []
+
+
+def _guards_in(with_node: ast.With) -> Dict[str, Tuple[int, Set[str]]]:
+    """Locals assigned under the lock from a state read:
+    name -> (line, attribute names the guard expression read)."""
+    out: Dict[str, Tuple[int, Set[str]]] = {}
+    for n in walk_shallow(with_node):
+        if not isinstance(n, ast.Assign):
+            continue
+        attrs = {a.attr for a in ast.walk(n.value)
+                 if isinstance(a, ast.Attribute)
+                 and isinstance(a.ctx, ast.Load)}
+        if not attrs:
+            continue
+        for t in n.targets:
+            for name in _name_targets(t):
+                out[name] = (n.lineno, attrs)
+    return out
+
+
+def _revalidates(with_node: ast.With, guards: Set[str]) -> bool:
+    """True when the critical section tests state it checks itself —
+    an ``if``/``while`` over an attribute read, a local assigned in
+    this body (the double-checked re-check), or a comparison involving
+    the guard's own value (``if q[0] == (deadline, msg): q.popleft()``
+    re-checks before acting even though the re-read is by value)."""
+    local: Set[str] = set()
+    for n in walk_shallow(with_node):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                local.update(_name_targets(t))
+    for n in walk_shallow(with_node):
+        if isinstance(n, (ast.If, ast.While)):
+            for t in ast.walk(n.test):
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.ctx, ast.Load):
+                    return True
+                if isinstance(t, ast.Name) and t.id in local:
+                    return True
+            for cmp_ in ast.walk(n.test):
+                if isinstance(cmp_, ast.Compare) and any(
+                        isinstance(t, ast.Name) and t.id in guards
+                        for t in ast.walk(cmp_)):
+                    return True
+    return False
+
+
+def _call_receiver(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    if name is None or "." not in name:
+        return None
+    return name.rsplit(".", 1)[0]
+
+
+class GL015CheckThenAct(Rule):
+    code = "GL015"
+    name = "check-then-act"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        cfg = project.config
+        cg = project.callgraph
+        model = project.model
+        acquires = transitive_acquires(cg, model)
+        out: List[Finding] = []
+        for fi in cg.funcs:
+            if not fi.sf.in_path(cfg.atomicity_paths):
+                continue
+            self._check_func(fi, cg, model, acquires, out)
+        return out
+
+    def _check_func(self, fi: FuncInfo, cg, model,
+                    acquires: Dict[str, Set[str]],
+                    out: List[Finding]) -> None:
+        withs = lock_withs(fi, model)
+        if not withs:
+            return
+        sites = cg.call_sites.get(fi.qualname, [])
+        seen: Set[Tuple[int, str]] = set()
+
+        def emit(line: int, col: int, var: str, msg: str) -> None:
+            if (line, var) in seen:
+                return
+            seen.add((line, var))
+            out.append(Finding(fi.sf.path, line, col, self.code, msg))
+
+        for w1, lid, raw in withs:
+            end = w1.end_lineno or w1.lineno
+            guards = _guards_in(w1)
+            if not guards:
+                continue
+            guard_names = set(guards)
+            # Later re-acquisitions of the same lock in this function.
+            later_withs = [
+                (w2, _revalidates(w2, guard_names))
+                for w2, lid2, raw2 in withs
+                if w2 is not w1 and w2.lineno > end
+                and (lid2 == lid or raw2 == raw)]
+            # Later calls that may re-acquire it (call graph).
+            later_calls = [
+                (call, callee) for call, callee in sites
+                if call.lineno > end and acquires_matching(
+                    acquires.get(callee.qualname, set()), lid, raw,
+                    _call_receiver(call))]
+
+            # (a) guard read inside a later same-lock section that does
+            # not re-validate.
+            for w2, revalidates in later_withs:
+                if revalidates:
+                    continue
+                for n in walk_shallow(w2):
+                    if isinstance(n, ast.Name) \
+                            and isinstance(n.ctx, ast.Load) \
+                            and n.id in guards:
+                        gline, _ = guards[n.id]
+                        emit(n.lineno, n.col_offset, n.id,
+                             f"`{n.id}` was computed under `{raw}` at "
+                             f"line {gline} but is used under a "
+                             f"SEPARATE acquisition — the lock was "
+                             f"dropped in between, so the guard can be "
+                             f"stale; re-read it in this critical "
+                             f"section or make check and act one "
+                             f"acquisition")
+
+            # (b) guard passed to a call that re-acquires the lock.
+            for call, callee in later_calls:
+                args = list(call.args) + [kw.value for kw in call.keywords]
+                for a in args:
+                    for n in ast.walk(a):
+                        if isinstance(n, ast.Name) and n.id in guards:
+                            gline, _ = guards[n.id]
+                            emit(call.lineno, call.col_offset, n.id,
+                                 f"`{n.id}` (read under `{raw}` at "
+                                 f"line {gline}) is passed to "
+                                 f"`{callee.qualname}`, which "
+                                 f"re-acquires the lock — check and "
+                                 f"act happen under different "
+                                 f"acquisitions (the resize-routing "
+                                 f"race shape); compute both under one "
+                                 f"acquisition or justify with a "
+                                 f"disable")
+
+            # (c) guard controls a test ahead of a call that
+            # re-acquires. Tests INSIDE a later critical section are
+            # the with-level check's business (case a + revalidation),
+            # not this leg's — being under the lock again with a fresh
+            # read present IS the double-check.
+            if not later_calls:
+                continue
+            in_later_with = {id(n) for w2, _ in later_withs
+                             for n in walk_shallow(w2)}
+            for n in walk_shallow(fi.node):
+                if not isinstance(n, (ast.If, ast.While)) \
+                        or n.lineno <= end or id(n) in in_later_with:
+                    continue
+                for t in ast.walk(n.test):
+                    if isinstance(t, ast.Name) and t.id in guards:
+                        gline, _ = guards[t.id]
+                        emit(n.lineno, n.col_offset, t.id,
+                             f"`{t.id}` (read under `{raw}` at line "
+                             f"{gline}) guards code that re-acquires "
+                             f"the lock in a separate critical "
+                             f"section — a writer can interleave "
+                             f"between the check and the act; make "
+                             f"them one acquisition or justify with a "
+                             f"disable")
+                        break

@@ -1,0 +1,205 @@
+"""GL016 — unsynchronized publication of lock-guarded attributes.
+
+When readers take ``Class._lock`` to see ``self.attr``, a writer that
+assigns ``self.attr`` WITHOUT the lock publishes past them: the read
+under the lock can observe a half-updated pair (a value without its
+version bump — the PR 10 stamp hazard shape), and nothing orders the
+store against the critical sections that consume it. The discipline is
+one-sided locking is no locking: an attribute read under a class's
+lock is written under it too.
+
+Per class that owns a model lock (``self._lock = make_*`` in
+``__init__``):
+
+1. collect the attributes read under each of the class's locks
+   (attribute loads inside ``with self._lock:`` bodies across all
+   methods — method calls and the lock attributes themselves are not
+   state reads);
+2. flag every ``self.attr = ...`` / ``+=`` / annotated assign to such
+   an attribute that is NOT inside an acquisition of ANY of the
+   class's locks — except in ``__init__`` (construction precedes
+   publication: no other thread can hold a reference yet). A store
+   under a *different* class lock is serialized, not bare — whether it
+   is the RIGHT lock is a design question (GL002 territory), not an
+   unsynchronized publication.
+
+A method whose every resolvable call site sits inside the lock's
+critical section (or in the class's own ``__init__``, or in another
+method that itself qualifies — the closure is a fixpoint, so
+``set_bit -> _maybe_snapshot -> _snapshot`` chains resolve) is a
+**lock-held helper** — its stores are synchronized by its callers and
+are not flagged (``Cluster._update_state`` is the canonical case:
+"lock held by callers"). This is the call-graph leg: the suppression
+is proven, not annotated. A store that is safe for a reason the rule
+cannot see (single-threaded phase, monotone flag, thread-bootstrap
+happens-before) carries a line-level ``# graftlint: disable=GL016``
+with the argument.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from tools.graftlint.engine import (
+    Finding, Project, Rule, walk_shallow,
+)
+from tools.graftlint.lockscope import lock_withs
+from tools.graftlint.model import FuncInfo
+
+
+def _self_attr_stores(fn: ast.AST) -> List[Tuple[ast.stmt, str]]:
+    """(statement, attr) for every ``self.attr`` assignment in one
+    function scope (plain, augmented, annotated)."""
+    out: List[Tuple[ast.stmt, str]] = []
+    for n in walk_shallow(fn):
+        targets: List[ast.AST] = []
+        if isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        for t in targets:
+            if isinstance(t, ast.Attribute) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id == "self":
+                out.append((n, t.attr))
+    return out
+
+
+class GL016UnsyncPublication(Rule):
+    code = "GL016"
+    name = "unsynchronized-publication"
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        cfg = project.config
+        cg = project.callgraph
+        model = project.model
+        by_cls: Dict[str, List[FuncInfo]] = {}
+        for fi in cg.funcs:
+            if fi.cls is not None:
+                by_cls.setdefault(fi.cls, []).append(fi)
+        # Lock node ids owned by each class.
+        cls_locks: Dict[str, Set[str]] = {}
+        for (cls, _attr), node in model.class_lock_attrs.items():
+            cls_locks.setdefault(cls, set()).add(node)
+        # Per-function: lock id -> AST node ids inside its with-bodies.
+        under: Dict[str, Dict[str, Set[int]]] = {}
+        for fi in cg.funcs:
+            regions: Dict[str, Set[int]] = {}
+            for w, lid, _raw in lock_withs(fi, model):
+                ids = regions.setdefault(lid, set())
+                for n in walk_shallow(w):
+                    ids.add(id(n))
+            under[fi.qualname] = regions
+
+        out: List[Finding] = []
+        for cls, locks in cls_locks.items():
+            methods = by_cls.get(cls, [])
+            if not methods:
+                continue
+            method_names = {m.name for m in methods}
+            reads = self._reads_under(methods, locks, under,
+                                      method_names)
+            if not any(reads.values()):
+                continue
+            held_helpers = self._lock_held_helpers(
+                cls, methods, locks, under, cg, cfg)
+            for m in methods:
+                if m.name == "__init__" \
+                        or not m.sf.in_path(cfg.publication_paths):
+                    continue
+                regions = under[m.qualname]
+                for stmt, attr in _self_attr_stores(m.node):
+                    # Serialized under ANY class lock => not bare.
+                    if any(id(stmt) in regions.get(l, set())
+                           for l in locks):
+                        continue
+                    for lid, attr_reads in reads.items():
+                        witness = attr_reads.get(attr)
+                        if witness is None:
+                            continue
+                        if (m.qualname, lid) in held_helpers:
+                            continue
+                        out.append(Finding(
+                            m.sf.path, stmt.lineno, stmt.col_offset,
+                            self.code,
+                            f"`self.{attr}` is assigned without "
+                            f"`{lid}`, but readers take that lock to "
+                            f"see it ({witness}) — an unsynchronized "
+                            f"publication lets a critical section "
+                            f"observe a torn or stale value; move the "
+                            f"store under the lock or justify with a "
+                            f"disable"))
+        return out
+
+    def _reads_under(self, methods: List[FuncInfo], locks: Set[str],
+                     under: Dict[str, Dict[str, Set[int]]],
+                     method_names: Set[str],
+                     ) -> Dict[str, Dict[str, str]]:
+        """lock id -> {attr read under it -> witness site}."""
+        lock_attrs = {lid.rsplit(".", 1)[-1] for lid in locks}
+        reads: Dict[str, Dict[str, str]] = {lid: {} for lid in locks}
+        for m in methods:
+            regions = under[m.qualname]
+            call_funcs = {id(n.func) for n in ast.walk(m.node)
+                          if isinstance(n, ast.Call)}
+            for n in walk_shallow(m.node):
+                if not (isinstance(n, ast.Attribute)
+                        and isinstance(n.ctx, ast.Load)
+                        and isinstance(n.value, ast.Name)
+                        and n.value.id == "self"):
+                    continue
+                if n.attr in lock_attrs or n.attr in method_names \
+                        or id(n) in call_funcs:
+                    continue
+                for lid in locks:
+                    if id(n) in regions.get(lid, set()):
+                        reads[lid].setdefault(
+                            n.attr, f"{m.name}():{n.lineno}")
+        return reads
+
+    def _lock_held_helpers(self, cls: str, methods: List[FuncInfo],
+                           locks: Set[str],
+                           under: Dict[str, Dict[str, Set[int]]],
+                           cg, cfg) -> Set[Tuple[str, str]]:
+        """(method qualname, lock id) pairs where every resolvable
+        call site of the method is inside that lock's critical section,
+        in the class's own __init__, or in another held helper — a
+        fixpoint, so chains like ``set_bit -> _maybe_snapshot ->
+        _snapshot`` (the outermost frame holds the lock the whole way
+        down) qualify the innermost store. Only call sites inside the
+        rule's own paths count as evidence: a test or bench driving a
+        private helper single-threaded is not a concurrent caller and
+        must not break the proof for the production paths."""
+        targets = {m.qualname: m for m in methods}
+        # callee qualname -> [(caller FuncInfo, call node)]
+        callers: Dict[str, List[Tuple[FuncInfo, ast.Call]]] = {}
+        for fi in cg.funcs:
+            if not fi.sf.in_path(cfg.publication_paths):
+                continue
+            for call, callee in cg.call_sites.get(fi.qualname, []):
+                if callee.qualname in targets:
+                    callers.setdefault(callee.qualname, []).append(
+                        (fi, call))
+        held: Set[Tuple[str, str]] = set()
+        init_qual = f"{next(iter(targets.values())).module}.{cls}.__init__"
+        changed = True
+        while changed:
+            changed = False
+            for q in targets:
+                sites = callers.get(q, [])
+                if not sites:
+                    continue
+                for lid in locks:
+                    if (q, lid) in held:
+                        continue
+                    ok = all(
+                        caller.qualname == init_qual
+                        or (caller.qualname, lid) in held
+                        or id(call) in under[caller.qualname].get(
+                            lid, set())
+                        for caller, call in sites)
+                    if ok:
+                        held.add((q, lid))
+                        changed = True
+        return held

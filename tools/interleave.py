@@ -1,0 +1,872 @@
+#!/usr/bin/env python3
+"""interleave — deterministic interleaving explorer over the host-side
+concurrency planes (the loom-style model checker of ROADMAP's
+concurrency verification plane; ``pilosa_tpu/utils/sched.py`` is the
+scheduler it drives).
+
+Each scenario builds a small multi-thread situation over REAL
+pilosa_tpu modules (ResultCache, LayoutManager, Cluster) or a faithful
+model of one (the coalescer's pipelined double buffer, the executor's
+``_bank_cache`` miss path), then the explorer enumerates thread
+interleavings — systematic DFS over schedule choices, or a seeded
+random walk — and checks every run against three invariants:
+
+1. **no exception** in any worker,
+2. **no deadlock** (the scheduler's wait-for graph),
+3. **sequential equivalence**: the observed final state must match
+   some serial order of the scenario's threads (the oracle runs every
+   thread-priority permutation and collects the allowed outcomes).
+
+Reproducers follow the ``roaring_fuzz``/``plan_fuzz`` contract:
+
+- a DFS failure is pinned by its explicit *schedule* (the choice list
+  printed with the failure and saved to ``tests/interleave_corpus/``),
+- a random-walk failure is pinned by ``(seed, index)`` —
+  ``default_rng([seed, index])`` regenerates the exact schedule.
+
+The corpus also carries **known-bad fixtures**: seeded
+re-introductions of the three historical races (the PR 14 two-step
+resize routing race, the PR 8 unlocked bank-cache evict, the PR 10
+stamp-then-read cache hazard). The default sweep REQUIRES the explorer
+to find each of them within the schedule budget — the plane's own
+regression test — while every good scenario must sweep clean.
+
+Usage:
+  python -m tools.interleave                  # gate: DFS sweep, all scenarios
+  python -m tools.interleave --list
+  python -m tools.interleave --scenario NAME [--budget N]
+  python -m tools.interleave --seed 0 --iters 200   # seeded random walk
+  python -m tools.interleave --replay [FILE...]     # corpus replay
+  python -m tools.interleave --digest               # determinism pin
+  python -m tools.interleave --output interleave.sarif
+
+Exit codes: 0 green, 1 unexpected failure (repro saved unless
+--no-save), 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import itertools
+import json
+import os
+import sys
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from pilosa_tpu.utils import sched
+from pilosa_tpu.utils.locks import make_condition, make_lock
+
+CORPUS_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "interleave_corpus")
+DEFAULT_BUDGET = 400
+
+
+class _NS:
+    """Plain attribute bag for scenario state."""
+
+
+class Scenario:
+    """One model-checking scenario: build state (its ``make_*`` locks
+    become scheduler-instrumented), define workers, observe the final
+    state, assert extra invariants. ``known_bad=True`` marks a seeded
+    re-introduction of a historical race: the sweep REQUIRES a failure
+    to be found for it."""
+
+    name = ""
+    known_bad = False
+    budget = DEFAULT_BUDGET  # per-scenario DFS budget override
+
+    def build(self) -> Any:
+        raise NotImplementedError
+
+    def workers(self, state: Any) -> List[Tuple[str, Callable[[], None]]]:
+        raise NotImplementedError
+
+    def observe(self, state: Any) -> Any:
+        """Final observed state (JSON-able) — compared against the
+        sequential oracle's allowed set."""
+        return None
+
+    def check(self, state: Any) -> None:
+        """Extra invariant over the final state; raise AssertionError
+        to fail the run."""
+
+
+# ----------------------------------------------------------- running
+
+
+class RunResult:
+    def __init__(self, kind: str, detail: str, schedule: List[int],
+                 obs: Any) -> None:
+        self.kind = kind          # ok|exception|deadlock|invariant|divergence
+        self.detail = detail
+        self.schedule = schedule
+        self.obs = obs
+
+    @property
+    def failed(self) -> bool:
+        return self.kind != "ok"
+
+    def __repr__(self) -> str:
+        return f"<{self.kind} schedule={self.schedule} {self.detail}>"
+
+
+def run_once(scn: Scenario, decide: sched.Decider) -> RunResult:
+    """One scheduled run of a scenario; divergence vs the oracle is
+    judged by the caller (the oracle itself uses run_once)."""
+    with sched.Scheduler(decide) as s:
+        state = scn.build()
+        for name, fn in scn.workers(state):
+            s.spawn(name, fn)
+        out = s.run()
+        if out.deadlock is not None:
+            return RunResult("deadlock", out.deadlock, out.schedule, None)
+        if out.errors:
+            return RunResult("exception", "; ".join(out.errors),
+                             out.schedule, None)
+        obs = scn.observe(state)
+        try:
+            scn.check(state)
+        except AssertionError as e:
+            return RunResult("invariant", str(e), out.schedule, obs)
+    return RunResult("ok", "", out.schedule, obs)
+
+
+def _obs_key(obs: Any) -> str:
+    return json.dumps(obs, sort_keys=True, default=repr)
+
+
+_ORACLE_CACHE: Dict[str, List[str]] = {}
+
+
+def sequential_outcomes(scn: Scenario) -> List[str]:
+    """Allowed final states: run the scenario once per thread-priority
+    permutation (each run executes the highest-priority runnable
+    thread until it blocks or finishes — serial execution when threads
+    never block on each other). A permutation run that itself fails is
+    excluded; at least one must survive."""
+    cached = _ORACLE_CACHE.get(scn.name)
+    if cached is not None:
+        return cached
+    # Count workers: build needs an active scheduler (the make_* locks
+    # check for one at construction).
+    with sched.Scheduler(sched.schedule_decider([])):
+        n = len(scn.workers(scn.build()))
+    allowed: List[str] = []
+    for perm in itertools.permutations(range(n)):
+        rank = {t: i for i, t in enumerate(perm)}
+
+        def decide(step: int, ids: Any,
+                   _rank: Dict[int, int] = rank) -> int:
+            return min(range(len(ids)), key=lambda j: _rank[ids[j]])
+
+        r = run_once(scn, decide)
+        if not r.failed:
+            k = _obs_key(r.obs)
+            if k not in allowed:
+                allowed.append(k)
+    if not allowed:
+        raise RuntimeError(
+            f"scenario {scn.name}: every sequential-priority run "
+            f"failed — the scenario itself is broken")
+    _ORACLE_CACHE[scn.name] = allowed
+    return allowed
+
+
+def judge(scn: Scenario, r: RunResult) -> RunResult:
+    """Apply the sequential-equivalence invariant to an ok run."""
+    if r.failed:
+        return r
+    if _obs_key(r.obs) not in sequential_outcomes(scn):
+        return RunResult(
+            "divergence",
+            f"final state {_obs_key(r.obs)} matches no sequential "
+            f"order (allowed: {sequential_outcomes(scn)})",
+            r.schedule, r.obs)
+    return r
+
+
+def sweep(scn: Scenario, budget: int) -> Tuple[int, List[RunResult]]:
+    """Systematic DFS sweep returning (runs, failures). Runs the
+    scenario inline (not via run_once) so explore_dfs backtracks over
+    the true (choice, n_runnable) traces."""
+    failures: List[RunResult] = []
+
+    def run_keep(decide: sched.Decider) -> sched.Outcome:
+        with sched.Scheduler(decide) as s:
+            state = scn.build()
+            for name, fn in scn.workers(state):
+                s.spawn(name, fn)
+            out = s.run()
+            r: RunResult
+            if out.deadlock is not None:
+                r = RunResult("deadlock", out.deadlock, out.schedule, None)
+            elif out.errors:
+                r = RunResult("exception", "; ".join(out.errors),
+                              out.schedule, None)
+            else:
+                obs = scn.observe(state)
+                try:
+                    scn.check(state)
+                    r = RunResult("ok", "", out.schedule, obs)
+                except AssertionError as e:
+                    r = RunResult("invariant", str(e), out.schedule, obs)
+        jr = judge(scn, r)
+        if jr.failed:
+            failures.append(jr)
+        return out
+
+    results = sched.explore_dfs(run_keep, budget)
+    return len(results), failures
+
+
+# --------------------------------------------------------- scenarios
+
+
+class CoalescerDoubleBuffer(Scenario):
+    """The coalescer's depth-1 pipelined hand-off (``_pl_pending`` +
+    ``_pl_cond`` in server/coalescer.py): two producers contend for the
+    single pending slot, the finalizer drains it. Invariant: both items
+    processed exactly once, slot empty at the end."""
+
+    name = "coalescer_double_buffer"
+
+    def build(self) -> Any:
+        st = _NS()
+        st.cond = make_condition("QueryCoalescer._pl_cond")
+        st.pending: Optional[int] = None
+        st.processed: List[int] = []
+        return st
+
+    def workers(self, st: Any) -> List[Tuple[str, Callable[[], None]]]:
+        def producer(item: int) -> Callable[[], None]:
+            def fn() -> None:
+                with st.cond:
+                    while st.pending is not None:
+                        st.cond.wait(timeout=0.1)
+                    st.pending = item
+                    st.cond.notify_all()
+            return fn
+
+        def finalizer() -> None:
+            for _ in range(2):
+                while True:
+                    with st.cond:
+                        if st.pending is not None:
+                            item = st.pending
+                            break
+                        st.cond.wait(timeout=0.1)
+                st.processed.append(item)  # drain outside the lock
+                with st.cond:
+                    st.pending = None
+                    st.cond.notify_all()
+
+        return [("producer0", producer(0)), ("producer1", producer(1)),
+                ("finalizer", finalizer)]
+
+    def observe(self, st: Any) -> Any:
+        return {"processed": sorted(st.processed), "pending": st.pending}
+
+    def check(self, st: Any) -> None:
+        assert sorted(st.processed) == [0, 1], st.processed
+        assert st.pending is None
+
+
+class ResultCacheStamp(Scenario):
+    """Real ResultCache vs a writer bumping a fragment-style version
+    stamp. The GOOD discipline: readers snapshot (stamp, value) under
+    the fragment lock, fill/lookup against the cache with that stamp —
+    a racing write can at worst make the entry stale, never produce a
+    stale hit. Invariant: every hit returned a value consistent with
+    the stamp it was validated against."""
+
+    name = "result_cache_stamp"
+
+    def build(self) -> Any:
+        from pilosa_tpu.executor.result_cache import ResultCache
+        st = _NS()
+        st.cache = ResultCache(max_bytes=1 << 16, enabled=True)
+        st.frag_lock = make_lock("Fragment._lock")
+        st.version = 0
+        st.value = "v0"
+        st.history = {0: "v0", 1: "v1"}
+        st.hits: List[Tuple[int, str]] = []
+        return st
+
+    def workers(self, st: Any) -> List[Tuple[str, Callable[[], None]]]:
+        def reader() -> None:
+            with st.frag_lock:
+                gen, val = st.version, st.value  # consistent snapshot
+            st.cache.fill("k", gen, val, 8)
+            with st.frag_lock:
+                cur = st.version
+            hit = st.cache.lookup("k", cur)
+            if hit is not None:
+                st.hits.append((cur, hit))
+
+        def writer() -> None:
+            with st.frag_lock:
+                st.version = 1
+                st.value = "v1"
+
+        return [("reader0", reader), ("reader1", reader),
+                ("writer", writer)]
+
+    def observe(self, st: Any) -> Any:
+        # Hit contents are judged by check(); WHICH lookups hit is
+        # timing-dependent in every serial order too.
+        return {"version": st.version, "value": st.value}
+
+    def check(self, st: Any) -> None:
+        for gen, val in st.hits:
+            assert st.history[gen] == val, (
+                f"stale hit: stamp {gen} served {val!r}, "
+                f"stamp-consistent value is {st.history[gen]!r}")
+
+
+class LayoutDemotePromote(Scenario):
+    """Real LayoutManager demote vs promote racing a query-staging
+    read. Representations may flip either way; DATA never changes —
+    the staged bank must always carry the view's data, and the
+    manager's counters must reconcile."""
+
+    name = "layout_demote_promote"
+    DATA = "rows:7"
+
+    def build(self) -> Any:
+        from pilosa_tpu.core.layout import LayoutManager
+        data = self.DATA
+
+        class _Frag:
+            def optimize_storage(self) -> None:
+                pass
+
+        class _View:
+            index, field, name = "i", "f", "standard"
+
+            def __init__(self) -> None:
+                self.layout_mode = "dense"
+                self.fragments = {0: _Frag()}
+
+            def trimmed_words(self) -> int:
+                return 1
+
+            def available_shards(self) -> Tuple[int, ...]:
+                return (0,)
+
+            def set_layout(self, mode: str) -> bool:
+                changed = self.layout_mode != mode
+                sched.checkpoint()  # publication point
+                self.layout_mode = mode
+                return changed
+
+            def sparse_bank(self, shards: Tuple[int, ...]) -> Any:
+                sched.checkpoint()
+                if self.layout_mode != "sparse":
+                    return None  # demoted-then-promoted: build refuses
+                return _NS()
+
+        class _Holder:
+            indexes: Dict[str, Any] = {}
+
+            def index(self, name: str) -> None:
+                return None
+
+        st = _NS()
+        st.view = _View()
+        st.mgr = LayoutManager(_Holder(), interval_s=0)
+        st.staged: List[Tuple[str, str]] = []
+        st.data = data
+        return st
+
+    def workers(self, st: Any) -> List[Tuple[str, Callable[[], None]]]:
+        def demoter() -> None:
+            st.mgr.demote(st.view)
+
+        def promoter() -> None:
+            st.mgr.promote(st.view)
+
+        def stager() -> None:
+            mode = st.view.layout_mode
+            sched.checkpoint()
+            st.staged.append((mode, st.data))  # bank carries the data
+
+        return [("demote", demoter), ("promote", promoter),
+                ("stage", stager)]
+
+    def observe(self, st: Any) -> Any:
+        return {"mode": st.view.layout_mode}
+
+    def check(self, st: Any) -> None:
+        for _mode, data in st.staged:
+            assert data == self.DATA
+        m = st.mgr
+        assert m.demotions + m.demote_failures <= 1
+        assert m.promotions <= 1
+        assert st.view.layout_mode in ("dense", "sparse")
+
+
+class BankCacheMissRace(Scenario):
+    """The executor ``_empty_bank`` miss path as shipped TODAY: probe
+    under the lock, build OUTSIDE it, re-check-and-insert with
+    first-insert-wins + LRU evict + ledger register under the lock.
+    Invariant: both racing misses return the same bank object and the
+    ledger exactly mirrors the cache."""
+
+    name = "bank_cache_miss_race"
+
+    def build(self) -> Any:
+        st = _NS()
+        st.lock = make_lock("Executor._bank_cache_lock")
+        st.cache: Dict[str, Any] = {"old": _NS()}
+        st.ledger = {"old"}
+        st.max = 2
+        st.results: Dict[str, Any] = {}
+        return st
+
+    def workers(self, st: Any) -> List[Tuple[str, Callable[[], None]]]:
+        def get(who: str, key: str) -> Callable[[], None]:
+            def fn() -> None:
+                with st.lock:
+                    b = st.cache.get(key)
+                if b is not None:
+                    st.results[who] = b
+                    return
+                sched.checkpoint()
+                built = _NS()  # device build happens outside the lock
+                with st.lock:
+                    cur = st.cache.get(key)
+                    if cur is not None:
+                        st.results[who] = cur  # first insert wins
+                        return
+                    while len(st.cache) >= st.max:
+                        victim = next(iter(st.cache))
+                        st.cache.pop(victim)
+                        st.ledger.discard(victim)
+                    st.cache[key] = built
+                    st.ledger.add(key)
+                st.results[who] = built
+            return fn
+
+        return [("miss0", get("miss0", "a")), ("miss1", get("miss1", "a"))]
+
+    def observe(self, st: Any) -> Any:
+        return {"same": st.results["miss0"] is st.results["miss1"],
+                "ledger_matches": st.ledger == set(st.cache)}
+
+    def check(self, st: Any) -> None:
+        assert st.results["miss0"] is st.results["miss1"]
+        assert st.ledger == set(st.cache), (st.ledger, set(st.cache))
+
+
+class ClusterRouteAdopt(Scenario):
+    """Real Cluster: ``route_shards`` (the PR 14 fix — RESIZING check
+    atomic with placement) racing a node join that pins the pre-change
+    placement before adding the member. Data lives on n1 until the
+    resize completes, so every routed shard must land on n1."""
+
+    name = "cluster_route_adopt"
+
+    def build(self) -> Any:
+        from pilosa_tpu.parallel.cluster import Cluster, Node
+        st = _NS()
+        st.c = Cluster(Node("n1", "http://a", True), replica_n=1)
+        st.c.set_state("NORMAL")
+        st.n2 = Node("n2", "http://b", False)
+        st.routed: List[str] = []
+        return st
+
+    def workers(self, st: Any) -> List[Tuple[str, Callable[[], None]]]:
+        def router() -> None:
+            by_node, _prev = st.c.route_shards("i", list(range(8)))
+            st.routed.extend(sorted(by_node))
+
+        def joiner() -> None:
+            st.c.begin_resize()   # pin placement FIRST
+            st.c.add_node(st.n2)
+
+        return [("router", router), ("joiner", joiner)]
+
+    def observe(self, st: Any) -> Any:
+        return {"routed_to": sorted(set(st.routed))}
+
+    def check(self, st: Any) -> None:
+        assert set(st.routed) <= {"n1"}, (
+            f"shard routed to a joiner that has not pulled: "
+            f"{sorted(set(st.routed))}")
+
+
+# ------------------------------------------------ known-bad fixtures
+
+
+class BadResizeTwoStepRoute(Scenario):
+    """PR 14's race, re-introduced: the RESIZING check and the
+    placement computation as two separate lock acquisitions. A join
+    landing between them routes shards to the new member before it has
+    pulled — the silent-undercount TopN bug chaos found live."""
+
+    name = "bad_resize_two_step_route"
+    known_bad = True
+
+    def build(self) -> Any:
+        from pilosa_tpu.parallel.cluster import (Cluster, Node,
+                                                 STATE_RESIZING)
+        st = _NS()
+        st.STATE_RESIZING = STATE_RESIZING
+        st.c = Cluster(Node("n1", "http://a", True), replica_n=1)
+        st.c.set_state("NORMAL")
+        st.n2 = Node("n2", "http://b", False)
+        st.routed: List[str] = []
+        return st
+
+    def workers(self, st: Any) -> List[Tuple[str, Callable[[], None]]]:
+        def router() -> None:
+            c = st.c
+            # The pre-PR-14 shape: state read and placement math in
+            # two acquisitions.
+            # graftlint: disable=GL015 — deliberate re-introduction of
+            # the historical race; this fixture exists so the explorer
+            # proves it can find it.
+            with c._lock:
+                previous = c.state == st.STATE_RESIZING
+            sched.checkpoint()
+            by_node = c.shards_by_node("i", list(range(8)),
+                                       previous=previous)
+            st.routed.extend(sorted(by_node))
+
+        def joiner() -> None:
+            st.c.begin_resize()
+            st.c.add_node(st.n2)
+
+        return [("router", router), ("joiner", joiner)]
+
+    def observe(self, st: Any) -> Any:
+        return {"routed_to": sorted(set(st.routed))}
+
+    def check(self, st: Any) -> None:
+        assert set(st.routed) <= {"n1"}, (
+            f"shard routed to a joiner that has not pulled: "
+            f"{sorted(set(st.routed))}")
+
+
+class BadBankCacheUnlockedEvict(Scenario):
+    """PR 8's race, re-introduced: the bank-cache LRU evict performed
+    OUTSIDE the cache lock as check-then-act — two racing misses pick
+    the same victim and the second ``pop`` raises KeyError."""
+
+    name = "bad_bank_cache_unlocked_evict"
+    known_bad = True
+
+    def build(self) -> Any:
+        st = _NS()
+        st.lock = make_lock("Executor._bank_cache_lock")
+        st.cache: Dict[str, Any] = {"old": _NS()}
+        st.max = 1
+        return st
+
+    def workers(self, st: Any) -> List[Tuple[str, Callable[[], None]]]:
+        def get(key: str) -> Callable[[], None]:
+            def fn() -> None:
+                with st.lock:
+                    st.cache[key] = _NS()
+                # graftlint: disable=GL015 — deliberate
+                # re-introduction of the historical unlocked-evict
+                # race (known-bad explorer fixture).
+                if len(st.cache) > st.max:
+                    victim = next(k for k in st.cache if k != key)
+                    sched.checkpoint()
+                    st.cache.pop(victim)  # unlocked: double-pop raises
+            return fn
+
+        return [("miss0", get("a")), ("miss1", get("b"))]
+
+    def observe(self, st: Any) -> Any:
+        return {"keys": sorted(st.cache)}
+
+
+class BadCacheStampThenRead(Scenario):
+    """PR 10's hazard, re-introduced: a reader snapshots the value and
+    the version stamp WITHOUT the fragment lock (value first, stamp
+    second) and fills the real ResultCache with the torn pair — a
+    second reader then takes a stale hit at the new stamp."""
+
+    name = "bad_cache_stamp_then_read"
+    known_bad = True
+
+    def build(self) -> Any:
+        from pilosa_tpu.executor.result_cache import ResultCache
+        st = _NS()
+        st.cache = ResultCache(max_bytes=1 << 16, enabled=True)
+        st.frag_lock = make_lock("Fragment._lock")
+        st.version = 0
+        st.value = "v0"
+        st.history = {0: "v0", 1: "v1"}
+        return st
+
+    def workers(self, st: Any) -> List[Tuple[str, Callable[[], None]]]:
+        def torn_reader() -> None:
+            # graftlint: disable=GL015 — deliberate re-introduction of
+            # the stamp-then-read hazard (known-bad explorer fixture).
+            val = st.value          # read value ...
+            sched.checkpoint()
+            gen = st.version        # ... THEN the stamp: torn pair
+            st.cache.fill("k", gen, val, 8)
+
+        def verifier() -> None:
+            with st.frag_lock:
+                gen, val = st.version, st.value
+            hit = st.cache.lookup("k", gen)
+            assert hit is None or hit == val, (
+                f"stale hit: stamp {gen} served {hit!r}, current "
+                f"value is {val!r}")
+
+        def writer() -> None:
+            with st.frag_lock:
+                st.version = 1
+                st.value = "v1"
+
+        return [("torn_reader", torn_reader), ("verifier", verifier),
+                ("writer", writer)]
+
+    def observe(self, st: Any) -> Any:
+        return {"version": st.version}
+
+
+class BadLockOrderABBA(Scenario):
+    """Minimal AB/BA ordering deadlock — the wait-for-graph detection
+    fixture (the dynamic twin of graftlint GL002)."""
+
+    name = "bad_lock_order_abba"
+    known_bad = True
+
+    def build(self) -> Any:
+        st = _NS()
+        st.a = make_lock("A")
+        st.b = make_lock("B")
+        return st
+
+    def workers(self, st: Any) -> List[Tuple[str, Callable[[], None]]]:
+        def t1() -> None:
+            with st.a:
+                with st.b:
+                    pass
+
+        def t2() -> None:
+            with st.b:
+                with st.a:
+                    pass
+
+        return [("t1", t1), ("t2", t2)]
+
+
+SCENARIOS: List[Scenario] = [
+    CoalescerDoubleBuffer(),
+    ResultCacheStamp(),
+    LayoutDemotePromote(),
+    BankCacheMissRace(),
+    ClusterRouteAdopt(),
+    BadResizeTwoStepRoute(),
+    BadBankCacheUnlockedEvict(),
+    BadCacheStampThenRead(),
+    BadLockOrderABBA(),
+]
+
+
+def scenario_by_name(name: str) -> Scenario:
+    for s in SCENARIOS:
+        if s.name == name:
+            return s
+    raise SystemExit(f"unknown scenario {name!r} (see --list)")
+
+
+# --------------------------------------------------------- sweeps/CLI
+
+
+def gate_scenario(scn: Scenario, budget: int,
+                  record: Optional[Callable[[str], None]] = None
+                  ) -> Tuple[bool, str, Optional[RunResult]]:
+    """The sweep verdict for one scenario: good must be clean,
+    known-bad must be caught. Returns (ok, message, first_failure)."""
+    runs, failures = sweep(scn, budget)
+    if record is not None:
+        for f in failures[:5]:
+            record(f"{scn.name}|{f.kind}|{f.schedule}")
+        record(f"{scn.name}|runs={runs}|failures={len(failures)}")
+    if scn.known_bad:
+        if failures:
+            f = failures[0]
+            return True, (f"found expected race in {runs} schedules: "
+                          f"{f.kind} at schedule {f.schedule}"), f
+        return False, (f"known-bad scenario NOT caught within "
+                       f"{budget}-schedule budget"), None
+    if failures:
+        f = failures[0]
+        return False, (f"{f.kind} at schedule {f.schedule}: "
+                       f"{f.detail}"), f
+    return True, f"clean over {runs} schedules", None
+
+
+def save_repro(scn: Scenario, r: RunResult, out_dir: str) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    digest = hashlib.sha256(
+        f"{scn.name}|{r.schedule}".encode()).hexdigest()[:12]
+    path = os.path.join(out_dir, f"found_{scn.name}_{digest}.json")
+    with open(path, "w") as fh:
+        json.dump({"scenario": scn.name, "schedule": r.schedule,
+                   "expect": "fail", "kind": r.kind,
+                   "note": r.detail[:500]}, fh, indent=2)
+        fh.write("\n")
+    return path
+
+
+def replay_corpus(paths: List[str]) -> int:
+    """Replay pinned schedules; each entry's verdict must match its
+    ``expect``. Returns the number of mismatches."""
+    bad = 0
+    for path in paths:
+        with open(path) as fh:
+            entry = json.load(fh)
+        scn = scenario_by_name(entry["scenario"])
+        r = judge(scn, run_once(
+            scn, sched.schedule_decider(entry["schedule"])))
+        want_fail = entry.get("expect", "fail") == "fail"
+        if r.failed != want_fail:
+            bad += 1
+            print(f"REPLAY MISMATCH {path}: expected "
+                  f"{'failure' if want_fail else 'pass'}, got "
+                  f"{r.kind} ({r.detail})")
+        else:
+            print(f"replay ok: {os.path.basename(path)} -> {r.kind}")
+    return bad
+
+
+def write_sarif(path: str,
+                problems: List[Tuple[str, str]]) -> None:
+    """Minimal SARIF 2.1.0 run for the merge into check.sarif: one
+    result per unexpected sweep/replay problem (normally none)."""
+    results = [{
+        "ruleId": "IL001",
+        "level": "error",
+        "message": {"text": f"{name}: {msg}"},
+        "locations": [{"physicalLocation": {"artifactLocation": {
+            "uri": "tools/interleave.py"}}}],
+    } for name, msg in problems]
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "interleave",
+                "informationUri": "tools/interleave.py",
+                "rules": [{
+                    "id": "IL001",
+                    "shortDescription": {"text":
+                        "interleaving invariant violation"},
+                }],
+            }},
+            "results": results,
+        }],
+    }
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="interleave", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--scenario", help="restrict to one scenario")
+    ap.add_argument("--budget", type=int, default=DEFAULT_BUDGET,
+                    help="DFS schedule budget per scenario")
+    ap.add_argument("--seed", type=int, help="random-walk seed")
+    ap.add_argument("--iters", type=int, default=100,
+                    help="random-walk iterations per scenario")
+    ap.add_argument("--replay", nargs="*", metavar="FILE",
+                    help="replay corpus entries (default: the whole "
+                         "tests/interleave_corpus/)")
+    ap.add_argument("--digest", action="store_true",
+                    help="print the deterministic sweep digest and exit")
+    ap.add_argument("--output", help="write a SARIF report here")
+    ap.add_argument("--no-save", action="store_true",
+                    help="do not save repros for unexpected failures")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for s in SCENARIOS:
+            tag = " [known-bad]" if s.known_bad else ""
+            print(f"{s.name}{tag}")
+        return 0
+
+    selected = ([scenario_by_name(args.scenario)] if args.scenario
+                else list(SCENARIOS))
+    problems: List[Tuple[str, str]] = []
+
+    if args.replay is not None:
+        paths = args.replay or sorted(
+            os.path.join(CORPUS_DIR, f)
+            for f in os.listdir(CORPUS_DIR) if f.endswith(".json"))
+        bad = replay_corpus(paths)
+        if bad:
+            problems.append(("corpus", f"{bad} replay mismatches"))
+    elif args.seed is not None:
+        # Seeded random walk over the GOOD scenarios ((seed, index) is
+        # the complete reproducer); known-bad fixtures are the DFS
+        # gate's job — a random walk is not guaranteed to hit them.
+        for scn in selected:
+            if scn.known_bad:
+                continue
+            fails = 0
+            for i in range(args.iters):
+                rng = np.random.default_rng([args.seed, i])
+                r = judge(scn, run_once(scn, sched.rng_decider(rng)))
+                if r.failed:
+                    fails += 1
+                    msg = (f"seed={args.seed} index={i}: {r.kind} "
+                           f"({r.detail})")
+                    print(f"FAIL {scn.name}: {msg}")
+                    problems.append((scn.name, msg))
+                    if not args.no_save:
+                        print("  repro saved:",
+                              save_repro(scn, r, CORPUS_DIR))
+                    break
+            if not fails:
+                print(f"ok {scn.name}: {args.iters} random schedules "
+                      f"clean (seed {args.seed})")
+    else:
+        hasher = hashlib.sha256() if args.digest else None
+
+        def record(line: str) -> None:
+            if hasher is not None:
+                hasher.update(line.encode())
+                hasher.update(b"\n")
+
+        for scn in selected:
+            budget = min(args.budget, scn.budget) if args.digest \
+                else args.budget
+            ok, msg, first = gate_scenario(scn, budget, record)
+            if not args.digest:
+                print(f"{'ok' if ok else 'FAIL'} {scn.name}: {msg}")
+            if not ok:
+                problems.append((scn.name, msg))
+                if first is not None and not args.no_save:
+                    print("  repro saved:",
+                          save_repro(scn, first, CORPUS_DIR))
+        if hasher is not None:
+            print(hasher.hexdigest())
+
+    if args.output:
+        write_sarif(args.output, problems)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
