@@ -243,6 +243,7 @@ def bench_tpu(holder, partial):
             break
     stage_timeline_breakdown(ex, q, partial)
     cache_stats_stanza(ex, partial)
+    roofline_stanza(ex, partial)
     return float(np.median(times)), want.pairs
 
 
@@ -271,6 +272,44 @@ def cache_stats_stanza(ex, partial):
             f"rank={partial['rank_cache']}")
     except Exception as e:
         log(f"bench: cache stats failed: {e!r}")
+
+
+def roofline_stanza(ex, partial):
+    """Roofline attribution during the bench run (ISSUE 18): the
+    recorder's live achieved-GB/s / roofline-fraction EWMAs and the
+    executor's cumulative plan_cost byte splits, so the record shows
+    how close the measured workload ran to the memory-bandwidth
+    ceiling — the live counterpart of docs/perf.md's hand-run roofline
+    micro legs. A TopN-only bench takes the fused (non-megakernel)
+    path, so zero launches is a legitimate stanza; presence is the
+    contract, not a launch count. Best-effort: a failure costs the
+    stanza, never the headline number."""
+    try:
+        from pilosa_tpu.utils.roofline import ROOFLINE
+        snap = ROOFLINE.snapshot()
+        partial["roofline"] = {
+            "enabled": snap["enabled"],
+            "rooflineGbps": snap["rooflineGbps"],
+            "rooflineSource": snap["rooflineSource"],
+            "estimateOnly": snap["estimateOnly"],
+            "launches": snap["launches"],
+            "fencedLaunches": snap["fencedLaunches"],
+            "achievedGbps": snap["achievedGbps"],
+            "rooflineFraction": snap["rooflineFraction"],
+            "bytesByKind": snap["bytesByKind"],
+            "opcodeTotals": snap["opcodeTotals"],
+            "driftFlags": snap["driftFlags"],
+            "launchBytes": (ex.launch_bytes_gather
+                            + ex.launch_bytes_compute
+                            + ex.launch_bytes_expand
+                            + ex.launch_bytes_pad),
+        }
+        log(f"bench: roofline launches={snap['launches']} "
+            f"achieved={snap['achievedGbps']:.1f} GB/s "
+            f"of {snap['rooflineGbps']:.0f} "
+            f"({snap['rooflineSource']})")
+    except Exception as e:
+        log(f"bench: roofline stanza failed: {e!r}")
 
 
 def stage_timeline_breakdown(ex, q, partial, iters: int = 3):

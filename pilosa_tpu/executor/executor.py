@@ -559,6 +559,16 @@ class Executor:
         self.mega_queries = 0
         self.mega_plan_entries = 0
         self.mega_plan_bytes = 0
+        # Launch cost attribution (ops/megakernel.plan_cost, the
+        # roofline plane): HBM bytes each launch moved split by kind,
+        # plus per-opcode instruction totals. /metrics exports
+        # pilosa_executor_launch_bytes_total{kind=gather|compute|
+        # expand|pad} and pilosa_executor_opcode_total{op=...}.
+        self.launch_bytes_gather = 0
+        self.launch_bytes_compute = 0
+        self.launch_bytes_expand = 0
+        self.launch_bytes_pad = 0
+        self.opcode_counts: Dict[str, int] = {}
         # Plan-IR verification gate (ops/megakernel.verify_plan,
         # PILOSA_TPU_PLAN_VERIFY): plans checked before dispatch and
         # plans rejected (a reject means a lowering bug — the launch
@@ -750,6 +760,32 @@ class Executor:
             self.stats.count("executor.mega_plan_entries", plan_entries)
             self.stats.count("executor.mega_plan_bytes", plan_bytes)
             self.stats.histogram("executor.mega_batch_size", queries)
+
+    def _note_launch_cost(self, cost: Dict[str, Any]) -> None:
+        """Account one launch's HBM traffic attribution (ops/
+        megakernel.plan_cost — the roofline plane's byte splits and
+        per-opcode histogram). '+=' is not atomic and batches can run
+        from several threads."""
+        with self._jit_stats_lock:
+            self.launch_bytes_gather += cost["gatherBytes"]
+            self.launch_bytes_compute += cost["computeBytes"]
+            self.launch_bytes_expand += cost["expandBytes"]
+            self.launch_bytes_pad += cost["padBytes"]
+            for name, n in cost["opcodeHist"].items():
+                # graftlint: disable=GL008 — keyed by opcode name:
+                # bounded by the (8-entry) plan-IR opcode table.
+                self.opcode_counts[name] = \
+                    self.opcode_counts.get(name, 0) + n
+        if self.stats is not None:
+            for kind, key in (("gather", "gatherBytes"),
+                              ("compute", "computeBytes"),
+                              ("expand", "expandBytes"),
+                              ("pad", "padBytes")):
+                self.stats.with_tags(f"kind:{kind}").count(
+                    "executor.launch_bytes", cost[key])
+            for name, n in cost["opcodeHist"].items():
+                self.stats.with_tags(f"op:{name}").count(
+                    "executor.opcode", n)
 
     def _note_plan_verify(self, ok: bool) -> None:
         """Account one pre-launch plan verification (ops/megakernel.

@@ -41,6 +41,7 @@ import numpy as np
 
 from pilosa_tpu.utils.hotspots import WORKLOAD
 from pilosa_tpu.utils.memledger import LEDGER
+from pilosa_tpu.utils.roofline import ROOFLINE
 from pilosa_tpu.utils.timeline import (
     LANE_DEVICE, LANE_DISPATCH, LANE_PLAN, TIMELINE,
 )
@@ -280,6 +281,10 @@ class _FuseGroup:
                     TIMELINE.event(prof.timeline, "device", LANE_DEVICE,
                                    t_dev, device_s,
                                    **({"fusedBatch": B} if fused else {}))
+            # No plan IR on this path, so no byte attribution: count
+            # the fenced time as unattributed so /debug/roofline
+            # states how much sampled device time its bytes explain.
+            ROOFLINE.note_unattributed_fence(device_s)
         # Cache-opportunity attribution AFTER the (sampled) fence so
         # fused evals report the same dispatch + device cost basis as
         # the unfused path (_run_staged) — one fused dispatch covered

@@ -30,6 +30,7 @@ import numpy as np
 from pilosa_tpu.ops import megakernel as mk
 from pilosa_tpu.utils.hotspots import WORKLOAD
 from pilosa_tpu.utils.memledger import LEDGER
+from pilosa_tpu.utils.roofline import ROOFLINE
 from pilosa_tpu.utils.timeline import (
     LANE_DEVICE, LANE_DISPATCH, TIMELINE,
 )
@@ -302,6 +303,35 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
             g.entries, g.profs, g.nodes = [], [], []
         return
     launch = _MegaLaunch(out)
+    # Launch cost attribution (the roofline plane): price the verified
+    # IR's HBM traffic in host numpy — microseconds, no fences, and
+    # best-effort by contract: a surprised cost model must never fail
+    # a request that already has its results in flight.
+    try:
+        cost = mk.plan_cost(plan, n_shards, w_mega)
+    except Exception:
+        cost = None
+    # Cohort signature for the per-cohort bandwidth EWMAs: the capacity
+    # buckets (not bank identity), so steady-state traffic of one shape
+    # aggregates instead of fragmenting.
+    ckey = (f"S{n_shards}|W{w_mega}|T{plan.n_regs}"
+            f"|P{plan.instrs.shape[0]}")
+    if cost is not None and ROOFLINE.enabled:
+        if ROOFLINE.needs_resolve():
+            try:
+                from pilosa_tpu.utils.benchenv import resolve_roofline
+                dev = jax.devices()[0]
+                gbps, kind = resolve_roofline(dev)
+                # A non-TPU backend has no TPU HBM roofline: label the
+                # default clearly as an estimate, never a measurement.
+                ROOFLINE.set_resolved(gbps, kind,
+                                      dev.platform != "tpu")
+            except Exception:
+                pass
+        opt = plan.opt_stats
+        ROOFLINE.note_launch(
+            ckey, cost,
+            opt.predicted_bytes if opt is not None else None)
     try:
         for g, g_lanes in zip(cohort, lanes):
             rep = g.entries[0]
@@ -323,10 +353,12 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
                      batch=n_entries, groups=len(cohort),
                      planEntries=plan.n_instrs)
         ex._note_mega(n_entries, plan.n_instrs, plan_bytes)
+        if cost is not None:
+            ex._note_launch_cost(cost)
         if plan.opt_stats is not None:
             ex._note_opt(plan.opt_stats)
         _attribute(ex, cohort, launch, jit_hit, t0, dispatch_s, plan,
-                   plan_bytes, n_entries)
+                   plan_bytes, n_entries, cost, ckey)
     except Exception as e:
         # Per-member error isolation, the _FuseGroup.run contract: an
         # async device failure surfacing here (e.g. the sampled
@@ -343,11 +375,16 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
 
 def _attribute(ex: Any, cohort: List[Any], launch: _MegaLaunch,
                jit_hit: bool, t_disp: float, dispatch_s: float,
-               plan: mk.Plan, plan_bytes: int, n_entries: int) -> None:
+               plan: mk.Plan, plan_bytes: int, n_entries: int,
+               cost: Optional[Dict[str, Any]] = None,
+               ckey: str = "") -> None:
     """Profiler/timeline attribution, the _FuseGroup._attribute
     convention: the program ran once for the whole launch, so every
     member sees the shared dispatch (and sampled device) time labeled
-    with its launch coordinates."""
+    with its launch coordinates. When a sampled fence fires, the cost
+    vector joins the measured device time into the roofline plane —
+    achieved GB/s rides EXISTING fences only; the unsampled path adds
+    none (pinned by tests/test_roofline.py)."""
     fence_profs: List[Tuple[Any, Any]] = []
     opt = plan.opt_stats
     mega_index = 0
@@ -364,6 +401,12 @@ def _attribute(ex: Any, cohort: List[Any], launch: _MegaLaunch,
             node.attrs["megaIndex"] = b
             node.attrs["planEntries"] = plan.n_instrs
             node.attrs["planBytes"] = plan_bytes
+            if cost is not None:
+                # The cost vector rides the slow-query ring: a
+                # post-mortem profile shows what the launch MOVED, not
+                # just how long it took.
+                node.attrs["launchBytes"] = cost["totalBytes"]
+                node.attrs["opcodeHist"] = dict(cost["opcodeHist"])
             if opt is not None:
                 # The optimizer's before/after so a profile reader can
                 # attribute the reduction without the /metrics deltas.
@@ -391,6 +434,14 @@ def _attribute(ex: Any, cohort: List[Any], launch: _MegaLaunch,
             if prof.timeline is not None:
                 TIMELINE.event(prof.timeline, "device", LANE_DEVICE,
                                t_dev, device_s, megaBatch=n_entries)
+        if cost is not None:
+            # Bytes ÷ the fence we already paid = achieved bandwidth:
+            # per-cohort EWMA + drift detection in the recorder, and a
+            # ph:"C" counter sample for the timeline export.
+            bw = ROOFLINE.note_device(ckey, cost["totalBytes"],
+                                      device_s)
+            if bw is not None:
+                TIMELINE.note_bandwidth(bw["bytesPerS"], bw["frac"])
     # Cache-opportunity attribution AFTER the (sampled) fence — the
     # per-entry share of one launch, same cost basis as the fused and
     # unfused paths.

@@ -879,6 +879,139 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
                 f"result")
 
 
+# ------------------------------------------------------ cost attribution
+#
+# plan_cost() is the measured half of the calibration loop: the same
+# [P, 4] IR the verifier types is also a complete statement of the
+# launch's HBM traffic, so the executor can attribute bytes to every
+# launch for free (host numpy, microseconds) and the profiler's sampled
+# device fences turn them into achieved GB/s. Like verify_plan it is
+# pure host code — no jax import, no fences, GL003 clean by
+# construction.
+
+
+def _buf_nbytes(a: Any) -> int:
+    """Byte size of a (possibly device-resident) buffer WITHOUT
+    materializing it: `.nbytes`/`.shape` are host metadata on both
+    numpy and jax arrays; opaque stubs fall back to 0."""
+    n = getattr(a, "nbytes", None)
+    if n is not None:
+        return int(n)
+    shape = getattr(a, "shape", None)
+    if isinstance(shape, tuple) and shape:
+        item = getattr(getattr(a, "dtype", None), "itemsize", 4) or 4
+        return int(np.prod(shape)) * int(item)
+    return 0
+
+
+def plan_cost(plan: Plan, n_shards: int, w_mega: int) -> Dict[str, Any]:
+    """Per-launch HBM traffic model over one finished plan, split by
+    kind, plus the per-opcode instruction histogram.
+
+    The model (``row`` = one padded ``[S, W]`` register row =
+    ``n_shards * w_mega * 4`` bytes; ``live(r)`` = the masked words =
+    ``n_shards * widths[r] * 4``):
+
+    * ``gatherBytes`` — per dense slot: ``live(r)`` read from the bank
+      plus one ``row`` written into the slab.
+    * ``expandBytes`` — per expand register: its sparse bank's full
+      ``(pos, starts)`` buffers read (the interpreter's pre-loop
+      scatter sweeps the whole pos table per slot) plus one ``row``
+      scatter-written; per ``OP_EXPAND`` instruction: one ``row`` read
+      + one ``row`` written.
+    * ``computeBytes`` — per real non-EXPAND instruction: one ``row``
+      per register read (exactly the verifier's read sets — _READS_A /
+      _READS_B, THRESH's dst read via _READS_DST; ZERO reads nothing)
+      plus one ``row`` written; plus the output stage: each real count
+      lane popcount-reads one ``row`` and writes ``S * 4`` bytes, each
+      real row lane moves ``2 * row``.
+    * ``padBytes`` — the pow2 capacity waste as a first-class split,
+      mirroring the memledger live-vs-padded convention: unreferenced
+      slab registers above the high-water mark (incl. the spare), pad
+      OP_ZERO instruction writes, and pad output lanes.
+
+    ``totalBytes`` is the sum of the four splits. ``slabBytes`` /
+    ``liveSlabBytes`` / ``planBytes`` restate the ledger's numbers so
+    a reader can assert ``padded_bytes == (slabBytes - liveSlabBytes)
+    + planBytes`` against the ``fusion_pad`` entry of the same launch.
+    ``opcodeHist`` counts REAL instructions only, keyed by OP_NAMES,
+    zero-count opcodes omitted.
+    """
+    S, W = int(n_shards), int(w_mega)
+    row = S * W * 4
+    n_slots = int(plan.n_slots)
+    n_xslots = int(getattr(plan, "n_xslots", 0))
+    n_gathered = n_slots + n_xslots
+    n_instrs = int(plan.n_instrs)
+    P = int(plan.instrs.shape[0])
+    # Plan buffers are host numpy by construction (Lowering.finish);
+    # .tolist() is a host copy, never a device sync.
+    # graftlint: disable=GL003 — host-numpy plan buffer read.
+    widths = [int(w) for w in plan.widths.tolist()]
+
+    gather = sum(S * widths[r] * 4 + row for r in range(n_slots))
+
+    hist: Dict[str, int] = {}
+    compute = 0
+    n_expand_instrs = 0
+    used_high = n_gathered  # slab high-water mark (exclusive)
+    rows_list = plan.instrs[:n_instrs].tolist()
+    for op, dst, a, b in rows_list:
+        op, dst, a, b = int(op), int(dst), int(a), int(b)
+        name = OP_NAMES[op] if 0 <= op < len(OP_NAMES) else str(op)
+        hist[name] = hist.get(name, 0) + 1
+        used_high = max(used_high, dst + 1)
+        if op == OP_EXPAND:
+            n_expand_instrs += 1
+            used_high = max(used_high, a + 1)
+            continue
+        reads = 0
+        if op in _READS_A:
+            reads += 1
+            used_high = max(used_high, a + 1)
+        if op in _READS_B:
+            reads += 1
+            used_high = max(used_high, b + 1)
+        if op in _READS_DST:
+            reads += 1
+        compute += (reads + 1) * row
+
+    expand = n_expand_instrs * 2 * row
+    for pair, slots in zip(plan.xbanks, plan.xslots):
+        pair_bytes = 0
+        if isinstance(pair, (tuple, list)) and len(pair) == 2:
+            pair_bytes = _buf_nbytes(pair[0]) + _buf_nbytes(pair[1])
+        expand += len(slots) * (pair_bytes + row)
+
+    nc = len(plan.lane_count_widths)
+    nr = len(plan.lane_row_widths)
+    for j in range(nc):
+        used_high = max(used_high, int(plan.out_count[j]) + 1)
+    for j in range(nr):
+        used_high = max(used_high, int(plan.out_row[j]) + 1)
+    compute += nc * (row + S * 4) + nr * 2 * row
+
+    n_regs = int(plan.n_regs)
+    pad = ((n_regs - used_high) * row
+           + (P - n_instrs) * row
+           + (len(plan.out_count) - nc) * (row + S * 4)
+           + (len(plan.out_row) - nr) * 2 * row)
+
+    total = gather + compute + expand + pad
+    return {
+        "gatherBytes": int(gather),
+        "computeBytes": int(compute),
+        "expandBytes": int(expand),
+        "padBytes": int(pad),
+        "totalBytes": int(total),
+        "slabBytes": slab_nbytes(n_regs, S, W),
+        "liveSlabBytes": slab_nbytes(n_gathered, S, W),
+        "planBytes": int(plan.plan_nbytes),
+        "opcodeHist": hist,
+        "nInstrs": n_instrs,
+    }
+
+
 def build_program(n_shards: int, w_mega: int, t_pad: int,
                   use_pallas: bool = False) -> Callable[..., Any]:
     """The traceable interpreter body for one capacity bucket. The

@@ -825,16 +825,21 @@ class API:
         request. Pure host-side dict reads — no device interaction."""
         from pilosa_tpu.utils.hotspots import WORKLOAD
         from pilosa_tpu.utils.memledger import LEDGER
+        from pilosa_tpu.utils.roofline import ROOFLINE
         from pilosa_tpu.utils.timeline import TIMELINE
         # Telemetry rings register their own bytes (category
         # "telemetry") before the ledger publishes, so /debug/memory
         # totals cover the observability plane itself.
         TIMELINE.register_memory(LEDGER)
+        ROOFLINE.register_memory(LEDGER)
         if hasattr(self.tracer, "register_memory"):
             self.tracer.register_memory(LEDGER)
         LEDGER.publish(self.stats)
         WORKLOAD.publish(self.stats)
         TIMELINE.publish(self.stats)
+        # Roofline gauges (pilosa_roofline_*): resolved/achieved GB/s,
+        # the fraction EWMA, cohort count, and the drift counter.
+        ROOFLINE.publish(self.stats)
         # Result-cache live gauges (hit/miss/eviction counters
         # increment at event time); the rank-cache store publishes its
         # entry/byte gauges the same way.
@@ -915,6 +920,34 @@ class API:
         self.refresh_memory_gauges()
         return TIMELINE.snapshot(last=last, trace_id=trace,
                                  node_id=node_id)
+
+    def debug_roofline(self) -> Dict[str, Any]:
+        """The GET /debug/roofline document (utils/roofline.py): the
+        per-opcode instruction table and per-kind byte splits priced
+        by ops/megakernel.plan_cost, per-cohort achieved bandwidth
+        EWMAs from the profiler's sampled fences, and the
+        predicted-vs-measured cost-model residuals ranked by drift —
+        the live replacement for docs/perf.md's hand-run roofline
+        micro legs."""
+        from pilosa_tpu.utils.roofline import ROOFLINE
+        node_id, _ = self._node_ident()
+        self.refresh_memory_gauges()
+        doc = ROOFLINE.snapshot()
+        doc["node"] = node_id
+        # The executor's cumulative splits beside the recorder's: the
+        # two count the same launches (the recorder LRU-bounds only
+        # its per-cohort state, never the totals), so a reader can
+        # cross-check the plane against /debug/queries.
+        ex = self.executor
+        doc["executor"] = {
+            "launchBytesGather": ex.launch_bytes_gather,
+            "launchBytesCompute": ex.launch_bytes_compute,
+            "launchBytesExpand": ex.launch_bytes_expand,
+            "launchBytesPad": ex.launch_bytes_pad,
+            "opcodeTotals": dict(ex.opcode_counts),
+            "megaLaunches": ex.mega_launches,
+        }
+        return doc
 
     @staticmethod
     def _merge_timeline_events(pid: int, node_id: str,
@@ -1072,6 +1105,13 @@ class API:
                     "foldsReordered": self.executor.opt_folds_reordered,
                     "bytesSaved": self.executor.opt_bytes_saved,
                 },
+                # Roofline attribution plane (utils/roofline.py): what
+                # the launched plans moved, and how fast. launchBytes
+                # are cumulative plan_cost splits; achievedGbps /
+                # fraction are fence-sampled EWMAs; driftFlags > 0
+                # means the optimizer's cost model currently mis-ranks
+                # cohorts on this node (see GET /debug/roofline).
+                "roofline": self._roofline_health(),
             },
             # Cross-request cache tier (executor/result_cache.py +
             # core/cache.RANK_CACHE): hit ratios and live bytes in the
@@ -1122,12 +1162,34 @@ class API:
                              if self.cluster is not None else 0),
         }
 
+    def _roofline_health(self) -> Dict[str, Any]:
+        """The compact roofline stanza embedded in node_health() — the
+        paging-relevant subset of GET /debug/roofline."""
+        from pilosa_tpu.utils.roofline import ROOFLINE
+        snap = ROOFLINE.snapshot()
+        ex = self.executor
+        return {
+            "enabled": snap["enabled"],
+            "launches": snap["launches"],
+            "fencedLaunches": snap["fencedLaunches"],
+            "launchBytes": (ex.launch_bytes_gather
+                            + ex.launch_bytes_compute
+                            + ex.launch_bytes_expand
+                            + ex.launch_bytes_pad),
+            "rooflineGbps": snap["rooflineGbps"],
+            "achievedGbps": snap["achievedGbps"],
+            "fraction": snap["rooflineFraction"],
+            "estimateOnly": snap["estimateOnly"],
+            "driftFlags": snap["driftFlags"],
+        }
+
     @staticmethod
     def _merge_health_totals(nodes: List[Dict[str, Any]]
                              ) -> Dict[str, Any]:
         tot = {"memoryBytes": 0, "paddingBytes": 0, "queueDepth": 0,
                "jitCacheSize": 0, "retraces": 0, "slowQueries": 0,
-               "fragmentReads": 0, "fragmentWrites": 0}
+               "fragmentReads": 0, "fragmentWrites": 0,
+               "launchBytes": 0, "rooflineDriftFlags": 0}
         for d in nodes:
             mem = d.get("memory") or {}
             tot["memoryBytes"] += int(mem.get("totalBytes", 0))
@@ -1141,6 +1203,12 @@ class API:
             wl = d.get("workload") or {}
             tot["fragmentReads"] += int(wl.get("fragmentReads", 0))
             tot["fragmentWrites"] += int(wl.get("fragmentWrites", 0))
+            # Fleet-wide roofline rollup: total bytes attributed to
+            # megakernel launches and how many nodes currently flag
+            # cost-model drift (any nonzero is worth a look).
+            rf = ex.get("roofline") or {}
+            tot["launchBytes"] += int(rf.get("launchBytes", 0))
+            tot["rooflineDriftFlags"] += int(rf.get("driftFlags", 0))
         return tot
 
     def cluster_health(self) -> Dict[str, Any]:

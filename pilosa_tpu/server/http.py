@@ -16,7 +16,7 @@ Routes implemented (public):
   DEL  /index/{i}
   POST /index/{i}/field/{f}   {"options": {...}}
   DEL  /index/{i}/field/{f}
-  POST /index/{i}/field/{f}/import            {"rows": [...], ...}
+  POST /index/{i}/field/{f}/import            {"rowIDs": [...], ...}
   POST /index/{i}/field/{f}/import-roaring/{s} raw roaring bytes
   GET  /export?index&field&shard
   POST /recalculate-caches
@@ -69,7 +69,8 @@ _EP_STATIC = frozenset({
     "/", "/schema", "/status", "/info", "/version", "/index",
     "/metrics", "/batch/query", "/export", "/recalculate-caches",
     "/debug/vars", "/debug/queries", "/debug/memory", "/debug/hotspots",
-    "/debug/timeline", "/cluster/health", "/cluster/hotspots",
+    "/debug/timeline", "/debug/roofline", "/cluster/health",
+    "/cluster/hotspots",
     # Internal/cluster routes are fixed strings: an explicit whitelist,
     # NOT a prefix match — unknown paths under these prefixes must fold
     # into "other" like everything else or a scanner mints series.
@@ -374,6 +375,20 @@ class Handler(BaseHTTPRequestHandler):
                                 api.executor.opt_folds_reordered,
                             "optBytesSaved":
                                 api.executor.opt_bytes_saved,
+                            # Roofline plane rollup (plan_cost splits
+                            # + per-opcode instruction totals over
+                            # every megakernel launch) — the full
+                            # bandwidth view lives at /debug/roofline.
+                            "launchBytesGather":
+                                api.executor.launch_bytes_gather,
+                            "launchBytesCompute":
+                                api.executor.launch_bytes_compute,
+                            "launchBytesExpand":
+                                api.executor.launch_bytes_expand,
+                            "launchBytesPad":
+                                api.executor.launch_bytes_pad,
+                            "opcodeTotals":
+                                dict(api.executor.opcode_counts),
                             "jitCacheSize":
                                 api.executor.jit_cache_size()})
             elif path == "/debug/memory":
@@ -402,6 +417,13 @@ class Handler(BaseHTTPRequestHandler):
                 self._json(api.debug_timeline(
                     last=int(q["last"]) if q.get("last") else None,
                     trace=q.get("trace")))
+            elif path == "/debug/roofline":
+                # Kernel cost & roofline attribution plane
+                # (utils/roofline.py): per-opcode byte/instruction
+                # totals, per-cohort achieved bandwidth vs the device
+                # roofline, and predicted-vs-measured cost-model
+                # residuals ranked by drift.
+                self._json(api.debug_roofline())
             elif path == "/cluster/timeline":
                 # Cluster lifecycle timeline (no trace id): merged
                 # membership/failure/resize events from every member —

@@ -183,6 +183,21 @@ class Config:
     timeline_ring: int = 256        # request timelines kept
     timeline_sample_every: int = 1  # record 1 in N requests (1 = all)
     timeline_gap_window_s: float = 60.0  # idle-ratio rolling window
+    # Roofline attribution plane (utils/roofline.py): per-launch HBM
+    # bytes from ops/megakernel.plan_cost joined with the profiler's
+    # SAMPLED device fences into achieved-GB/s / roofline-fraction
+    # estimators (served at GET /debug/roofline, gauges on /metrics).
+    # `gbps = 0` auto-resolves the roofline from the attached device
+    # kind (utils/benchenv table); a non-TPU backend is labeled
+    # estimate-only. No fences of its own: with profile_sample_every =
+    # 0 and no ?profile=true traffic the plane only accumulates byte
+    # counters. TOML accepts a [roofline] table (enabled / gbps /
+    # ewma_alpha / max_cohorts) or the flat roofline_* spelling; env
+    # uses PILOSA_TPU_ROOFLINE_*.
+    roofline_enabled: bool = True
+    roofline_gbps: float = 0.0       # 0 = auto-resolve by device kind
+    roofline_ewma_alpha: float = 0.25  # per-cohort bandwidth EWMA
+    roofline_max_cohorts: int = 256  # LRU bound on per-cohort state
     # Metrics (reference server/config.go Metric.Service/Host: expvar |
     # statsd | none — "mem" is the expvar equivalent)
     metric_service: str = "mem"   # mem | statsd | none
@@ -320,6 +335,12 @@ class Config:
                 "timeline ring/sample_every must be >= 1")
         if self.timeline_gap_window_s <= 0:
             raise ValueError("timeline gap_window_s must be > 0")
+        if self.roofline_gbps < 0:
+            raise ValueError("roofline gbps must be >= 0 (0 = auto)")
+        if not 0 < self.roofline_ewma_alpha <= 1:
+            raise ValueError("roofline ewma_alpha must be in (0, 1]")
+        if self.roofline_max_cohorts < 1:
+            raise ValueError("roofline max_cohorts must be >= 1")
         if not 0 <= self.telemetry_hbm_watermark <= 1:
             raise ValueError(
                 "telemetry hbm_watermark must be in [0, 1]")

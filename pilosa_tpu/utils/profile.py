@@ -348,6 +348,11 @@ class Profiler:
             # critical section that bumps _seq.
             with self._lock:
                 self.sample_every = max(0, int(sample_every))
+            # The roofline plane scales its total-device-time estimate
+            # by the fence rate (the sampled="true" bias warning made
+            # quantitative).
+            from pilosa_tpu.utils.roofline import ROOFLINE
+            ROOFLINE.note_sample_every(self.sample_every)
         if ring_size is not None:
             with self._lock:
                 self._ring = deque(self._ring, maxlen=max(1, int(ring_size)))
@@ -385,7 +390,15 @@ class Profiler:
             st.timing("executor.dispatch", p.totals["dispatch"])
             st.timing("executor.materialize", p.totals["materialize"])
             if p.sample_device:
-                st.timing("executor.device", p.totals["device"])
+                # Fed ONLY by sampled fences (1-in-N + forced), never
+                # total device time: the label says so, and the gauge
+                # beside it carries the rate a reader must scale by
+                # (0 = only ?profile=true fences; see the roofline
+                # plane's deviceSecondsEstimate for the scaled view).
+                st.with_tags("sampled:true").timing(
+                    "executor.device", p.totals["device"])
+                st.gauge("executor.device_sample_every",
+                         self.sample_every)
             if p.jit_hits:
                 st.count("executor.jit_hit", p.jit_hits)
             if p.jit_misses:

@@ -43,7 +43,7 @@ import threading
 import time
 import uuid
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from pilosa_tpu.utils.locks import make_lock
 
@@ -123,6 +123,11 @@ class TimelineRecorder:
     MAX_EVENTS_PER_REQUEST = 192
     # Rough per-event ledger cost (tuple + strings + args dict).
     EVENT_NBYTES = 120
+    # Roofline counter-track samples kept (ph:"C" lanes in the export);
+    # fed only by sampled device fences, so the ring turns over slowly.
+    MAX_COUNTER_SAMPLES = 512
+    # Rough per-sample ledger cost (tuple of three floats).
+    COUNTER_NBYTES = 48
 
     def __init__(self, ring: int = 256, sample_every: int = 1,
                  gap_window_s: float = 60.0,
@@ -143,6 +148,13 @@ class TimelineRecorder:
         self._gap_lock = make_lock("TimelineRecorder._gap_lock")
         self._dispatches: deque = deque(maxlen=max(16, int(max_dispatches)))
         self.dispatches_total = 0
+        # Roofline counter track: (wall_s, bytes_per_s, fraction)
+        # samples from the megakernel's sampled device fences
+        # (executor/megakernel._attribute via roofline.note_device) —
+        # exported as ph:"C" Perfetto counter lanes. Guarded by the
+        # gap lock: both are leaf locks fed from the dispatch path.
+        self._counters: deque = deque(maxlen=self.MAX_COUNTER_SAMPLES)
+        self.counters_total = 0
 
     # ------------------------------------------------------------ configure
 
@@ -170,6 +182,8 @@ class TimelineRecorder:
         with self._gap_lock:
             self._dispatches.clear()
             self.dispatches_total = 0
+            self._counters.clear()
+            self.counters_total = 0
 
     # ------------------------------------------------------------ recording
 
@@ -251,6 +265,42 @@ class TimelineRecorder:
         with self._gap_lock:
             self._dispatches.append((start_pc, start_pc + max(0.0, dur_s)))
             self.dispatches_total += 1
+
+    def note_bandwidth(self, bytes_per_s: float,
+                       roofline_frac: float) -> None:
+        """One achieved-bandwidth sample (a megakernel launch that hit
+        a sampled device fence): feeds the ph:"C" counter lanes in the
+        export. Independent of request sampling, like note_dispatch —
+        the fence already happened, recording it costs one append."""
+        if not self.enabled:
+            return
+        with self._gap_lock:
+            self._counters.append((time.time(), float(bytes_per_s),
+                                   float(roofline_frac)))
+            self.counters_total += 1
+
+    def counter_samples(self) -> List[Tuple[float, float, float]]:
+        with self._gap_lock:
+            return list(self._counters)
+
+    def _export_counters(self, pid: int) -> List[Dict[str, Any]]:
+        """Chrome ``ph:"C"`` counter events — one bytes/s lane and one
+        roofline-fraction lane per sample. ``dur``/``tid`` ride along
+        as 0 so every event in the document carries the full
+        ph/ts/dur/pid/tid shape (the CI smoke validates exactly
+        that)."""
+        events: List[Dict[str, Any]] = []
+        for wall_s, bps, frac in self.counter_samples():
+            ts = wall_s * 1e6
+            events.append({"name": "launch_bytes_per_s", "ph": "C",
+                           "cat": "pilosa", "ts": ts, "dur": 0,
+                           "pid": pid, "tid": 0,
+                           "args": {"bytes_per_s": bps}})
+            events.append({"name": "roofline_fraction", "ph": "C",
+                           "cat": "pilosa", "ts": ts, "dur": 0,
+                           "pid": pid, "tid": 0,
+                           "args": {"fraction": frac}})
+        return events
 
     def gap_summary(self, now_pc: Optional[float] = None
                     ) -> Dict[str, Any]:
@@ -361,8 +411,9 @@ class TimelineRecorder:
         directly in Perfetto/chrome://tracing) plus a summary with the
         dispatch-gap analysis and per-stage duration medians."""
         reqs = self.requests(last=last, trace_id=trace_id)
+        counters = self._export_counters(pid)
         events = self.metadata_events(pid, node_id) \
-            + self._export_events(reqs, pid)
+            + counters + self._export_events(reqs, pid)
         gap = self.gap_summary()
         return {
             "traceEvents": events,
@@ -374,6 +425,7 @@ class TimelineRecorder:
                 "requestsSkipped": self.requests_skipped,
                 "ringCapacity": self._ring.maxlen,
                 "sampleEvery": self.sample_every,
+                "counterSamples": len(counters) // 2,
                 "deviceIdleRatio": gap["idleRatio"],
                 "dispatchGap": gap,
                 "stageMedianS": self._stage_medians(reqs),
@@ -390,7 +442,10 @@ class TimelineRecorder:
         with self._lock:
             n_events = sum(len(r.events) for r in self._ring)
             n_reqs = len(self._ring)
-        return n_events * self.EVENT_NBYTES + n_reqs * 160
+        with self._gap_lock:
+            n_counters = len(self._counters)
+        return (n_events * self.EVENT_NBYTES + n_reqs * 160
+                + n_counters * self.COUNTER_NBYTES)
 
     def register_memory(self, ledger: Optional[Any] = None) -> None:
         """Register the ring's bytes with the memory ledger (category

@@ -99,6 +99,29 @@ def test_sample_every_fences_one_in_n(tmp_holder, monkeypatch):
     assert len(fences) == 2  # queries 3 and 6
 
 
+def test_device_seconds_carries_sampled_label(tmp_holder):
+    """Satellite (ISSUE 18): pilosa_executor_device_seconds is fed
+    ONLY by 1-in-N sampled fences, so the series carries an explicit
+    sampled="true" label and the live fence rate exports beside it —
+    a dashboard scaling device time must multiply by the rate."""
+    _seed_two_shards(tmp_holder)
+    api = API(tmp_holder, stats=MemStatsClient())
+    api.executor.result_cache.enabled = False
+    api.profiler.configure(sample_every=2)
+    for _ in range(4):
+        api.query("p", "Count(Row(f=1))")
+    prom = prometheus_text(api.stats)
+    line = next(l for l in prom.splitlines()
+                if l.startswith("pilosa_executor_device_seconds{"))
+    assert 'sampled="true"' in line, line
+    # No unlabeled twin series: one family, one label shape.
+    assert "pilosa_executor_device_seconds{quantile" not in prom
+    assert "pilosa_executor_device_sample_every 2" in prom
+    # The recorder learned the rate through Profiler.configure.
+    from pilosa_tpu.utils.roofline import ROOFLINE
+    assert ROOFLINE.sample_every == 2
+
+
 def test_retrace_counter_and_metrics(tmp_holder):
     _seed_two_shards(tmp_holder)
     api = API(tmp_holder, stats=MemStatsClient())

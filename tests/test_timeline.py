@@ -168,6 +168,37 @@ def test_snapshot_chrome_trace_event_shape():
     assert 0.0 <= summary["deviceIdleRatio"] <= 1.0
 
 
+def test_bandwidth_counter_track_shape():
+    """Roofline plane counter tracks: note_bandwidth exports two
+    Perfetto ph:"C" samples (launch_bytes_per_s + roofline_fraction)
+    with the full event shape, bounded by MAX_COUNTER_SAMPLES."""
+    rec = TimelineRecorder()
+    rec.note_bandwidth(2.5e9, 0.8)
+    rec.note_bandwidth(1.0e9, 0.3)
+    doc = rec.snapshot()
+    cs = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert len(cs) == 4                      # 2 samples x 2 tracks
+    for ev in cs:
+        for k in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert k in ev, ev
+    by_name = {}
+    for ev in cs:
+        by_name.setdefault(ev["name"], []).append(ev)
+    assert set(by_name) == {"launch_bytes_per_s", "roofline_fraction"}
+    assert [e["args"]["bytes_per_s"]
+            for e in by_name["launch_bytes_per_s"]] == [2.5e9, 1.0e9]
+    assert [e["args"]["fraction"]
+            for e in by_name["roofline_fraction"]] == [0.8, 0.3]
+    assert doc["summary"]["counterSamples"] == 2
+    # Bounded ring: the counter deque never outgrows the cap.
+    for _ in range(rec.MAX_COUNTER_SAMPLES + 50):
+        rec.note_bandwidth(1.0, 0.5)
+    assert len(rec.counter_samples()) == rec.MAX_COUNTER_SAMPLES
+    assert rec.counters_total == 2 + rec.MAX_COUNTER_SAMPLES + 50
+    rec.reset()
+    assert len(rec.counter_samples()) == 0
+
+
 def test_snapshot_filters_last_and_trace():
     rec = TimelineRecorder()
     for i in range(6):

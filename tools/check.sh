@@ -373,6 +373,101 @@ with tempfile.TemporaryDirectory() as d:
 print("plan-optimizer smoke OK")
 EOF
 
+step "roofline smoke (mixed burst -> /debug/roofline populated, ledger-consistent bytes, counter tracks)"
+# The ISSUE 18 cost & roofline attribution plane: a 32-query mixed
+# burst with sampled device fences must populate /debug/roofline
+# (per-opcode totals, per-cohort bandwidth), the plan_cost pad split
+# must agree EXACTLY with the ledger's fusion_pad registration
+# (slabBytes - liveSlabBytes + planBytes == padded_bytes), and the
+# timeline export must carry the ph:"C" bandwidth counter tracks.
+PILOSA_TPU_RESULT_CACHE=0 PILOSA_TPU_MEGAKERNEL=1 \
+    PILOSA_TPU_PLAN_VERIFY=on JAX_PLATFORMS=cpu \
+    python - <<'EOF' || fail=1
+import tempfile
+import numpy as np
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.ops import megakernel as mk
+from pilosa_tpu.utils.memledger import LEDGER
+from pilosa_tpu.utils.profile import QueryProfile
+from pilosa_tpu.utils.roofline import ROOFLINE
+from pilosa_tpu.utils.timeline import TIMELINE
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+
+ROOFLINE.reset(); ROOFLINE.configure(enabled=True)
+TIMELINE.configure(enabled=True)
+costs = []
+orig_cost = mk.plan_cost
+def spy(plan, n_shards, w_mega):
+    c = orig_cost(plan, n_shards, w_mega)
+    costs.append(c)
+    return c
+mk.plan_cost = spy
+# The fusion_pad entry dies with the launch object (ledger tracks by
+# liveness), so capture what _launch REGISTERS rather than racing the
+# finalizer.
+tracked = []
+orig_track = LEDGER.track
+def track_spy(obj, category, nbytes, padded_bytes=0, **meta):
+    if category == "fusion_pad":
+        tracked.append((int(nbytes), int(padded_bytes)))
+    return orig_track(obj, category, nbytes, padded_bytes, **meta)
+LEDGER.track = track_spy
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("roof")
+    f = idx.create_field("f"); g = idx.create_field("g")
+    rng = np.random.default_rng(11)
+    rows = rng.integers(0, 8, 4000).astype(np.uint64)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, 4000).astype(np.uint64)
+    f.import_bits(rows, cols); g.import_bits(rows[::2], cols[::2])
+    idx.add_existence(cols)
+    ex = Executor(h)
+    reqs = []
+    for k in range(32):
+        r = k % 8
+        reqs.append(("roof", [f"Count(Row(f={r}))", f"Row(g={r})",
+                              f"Count(Intersect(Row(f={r}), Row(g={r})))",
+                              f"Count(Union(Row(f={r}), Row(g={r})))"
+                              ][(k // 8) % 4], None))
+    profs = [QueryProfile(i, q, sample_device=True) for i, q, s in reqs]
+    out = ex.execute_batch_shaped(reqs, profiles=profs)
+    assert ex.mega_launches == 1 and len(costs) == 1, \
+        (ex.mega_launches, len(costs))
+    cost = costs[0]
+    # Byte split sanity: every split priced, totals add up.
+    assert cost["totalBytes"] == (cost["gatherBytes"] + cost["computeBytes"]
+                                  + cost["expandBytes"] + cost["padBytes"])
+    assert cost["gatherBytes"] > 0 and cost["computeBytes"] > 0
+    # Ledger consistency: what plan_cost calls pad waste is EXACTLY
+    # what _launch registered as fusion_pad padding.
+    assert len(tracked) == 1 and tracked[0][1] == \
+        (cost["slabBytes"] - cost["liveSlabBytes"] + cost["planBytes"]), \
+        (tracked, cost["slabBytes"], cost["liveSlabBytes"],
+         cost["planBytes"])
+    # /debug/roofline document: per-opcode + per-cohort populated,
+    # fenced bandwidth measured.
+    snap = ROOFLINE.snapshot()
+    assert snap["launches"] == 1 and snap["fencedLaunches"] == 1, snap
+    assert snap["opcodeTotals"] and snap["cohorts"], snap
+    assert snap["bytesByKind"]["gather"] == cost["gatherBytes"]
+    assert snap["achievedGbps"] > 0, snap["achievedGbps"]
+    assert snap["estimateOnly"], "CPU gate must be labeled estimate-only"
+    # Executor counters mirror the same split.
+    assert ex.launch_bytes_gather == cost["gatherBytes"]
+    assert ex.opcode_counts == dict(cost["opcodeHist"])
+    # Timeline export carries the bandwidth counter tracks.
+    tl = TIMELINE.snapshot()
+    names = {e["name"] for e in tl["traceEvents"] if e.get("ph") == "C"}
+    assert {"launch_bytes_per_s", "roofline_fraction"} <= names, names
+    assert tl["summary"]["counterSamples"] >= 1
+    del out
+    h.close()
+mk.plan_cost = orig_cost
+LEDGER.track = orig_track
+print("roofline smoke OK")
+EOF
+
 step "plan-fuzz gate (corpus replay + deterministic sweep + digest stability)"
 # The plan-space differential oracle (tools/plan_fuzz): committed
 # corpus replays clean, then a seeded sweep — every batch bit-exact
