@@ -35,10 +35,22 @@ artifact (progress chatter on stderr):
   folds reordered) that /metrics exports as
   ``pilosa_executor_opt_*_total``.
 
+* ``multichip``: the serving-path lane over an N-device mesh (the
+  MULTICHIP dryrun promoted to a first-class record). A fresh BOUNDED
+  child — the PR 11 probe_device_once reaper shape: subprocess +
+  timeout + stderr tail, because the forced device count latches at
+  first jax init — runs the mixed burst against a mesh-sharded
+  executor: one SPMD cohort launch per flush, Count lanes psum'd
+  in-kernel, rows all-gathered. The record carries mesh q/s, the
+  collective-reduce bytes and the profiler-asserted d2h accounting
+  (4 bytes per Count — the final answer, ZERO host bytes of per-shard
+  partials), with responses byte-identical to PILOSA_TPU_MESH=0.
+
 Env knobs: MEGA_BENCH_THREADS (64), MEGA_BENCH_QUERIES (256 total),
 MEGA_BENCH_ROWS (16), MEGA_BENCH_BITS (400000), MEGA_BENCH_REPEATS
 (5), MEGA_BENCH_BATCH (16), MEGA_BENCH_MOLECULES (20000),
-MEGA_BENCH_CANDIDATES (192), MEGA_BENCH_TOPK (50).
+MEGA_BENCH_CANDIDATES (192), MEGA_BENCH_TOPK (50),
+MEGA_BENCH_MESH_DEVICES (8), MEGA_BENCH_MESH_TIMEOUT_S (900).
 """
 
 import json
@@ -65,6 +77,8 @@ MAX_BATCH = int(os.environ.get("MEGA_BENCH_BATCH", 16))
 N_MOLECULES = int(os.environ.get("MEGA_BENCH_MOLECULES", 20_000))
 N_CANDIDATES = int(os.environ.get("MEGA_BENCH_CANDIDATES", 192))
 TOPK = int(os.environ.get("MEGA_BENCH_TOPK", 50))
+MESH_DEVICES = int(os.environ.get("MEGA_BENCH_MESH_DEVICES", 8))
+MESH_TIMEOUT_S = float(os.environ.get("MEGA_BENCH_MESH_TIMEOUT_S", 900))
 FP_BITS = 4096
 BITS_PER_MOL = 48
 ARTIFACT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -441,8 +455,163 @@ def lane_opt():
         h.close()
 
 
+def _multichip_child():
+    """In-child body of the multichip lane (the parent spawned us with
+    the device-count XLA flag — it latches at first jax init, so the
+    mesh size can never be set from an already-warm bench process).
+    Prints ONE JSON record on stdout."""
+    import jax
+
+    from pilosa_tpu.core.holder import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.executor import megakernel as megamod
+    from pilosa_tpu.ops.bitset import SHARD_WIDTH
+    from pilosa_tpu.parallel import MeshContext
+    from pilosa_tpu.server.coalescer import QueryCoalescer
+    from pilosa_tpu.utils.profile import QueryProfile
+    from pilosa_tpu.utils.stats import MemStatsClient
+
+    devs = jax.devices()
+    n_mesh = min(MESH_DEVICES, len(devs))
+    with tempfile.TemporaryDirectory() as tmp:
+        h = Holder(tmp)
+        h.open()
+        idx = h.create_index("bench")
+        f = idx.create_field("f")
+        g = idx.create_field("g")
+        rng = np.random.default_rng(42)
+        rows = rng.integers(0, N_ROWS, N_BITS).astype(np.uint64)
+        cols = rng.integers(0, 2 * SHARD_WIDTH, N_BITS).astype(np.uint64)
+        f.import_bits(rows, cols)
+        g.import_bits(rows[::2], cols[::2])
+        idx.add_existence(cols)
+
+        queries = []
+        for k in range(N_QUERIES):
+            r = k % N_ROWS
+            queries.append([
+                f"Count(Row(f={r}))",
+                f"Row(g={r})",
+                f"Count(Intersect(Row(f={r}), Row(g={r})))",
+                f"Count(Union(Row(f={r}), Row(g={r})))"][
+                    (k // N_ROWS) % 4])
+        perm = np.random.default_rng(3).permutation(len(queries))
+        queries = [queries[int(p)] for p in perm]
+
+        megamod.MEGAKERNEL_ENABLED = True
+
+        def serving_qps(executor):
+            executor.result_cache.enabled = False
+            for q in queries[:8]:  # warm the cohort programs
+                executor.execute_full("bench", q)
+            walls, results = [], None
+            for _ in range(REPEATS):
+                co = QueryCoalescer(executor, window_s=0.002,
+                                    max_batch=MAX_BATCH,
+                                    max_queue=4 * len(queries),
+                                    stats=MemStatsClient(),
+                                    pipeline=True)
+                co.start()
+                try:
+                    results, wall = burst(co, queries)
+                finally:
+                    co.stop()
+                walls.append(wall)
+            return len(queries) / statistics.median(walls), results
+
+        mesh_ex = Executor(h, mesh=MeshContext(devs[:n_mesh]))
+        mesh_qps, mesh_res = serving_qps(mesh_ex)
+        collective = mesh_ex.mesh_collective_bytes
+        launches = mesh_ex.mesh_launches
+        assert launches > 0, "burst never took the mesh cohort path"
+
+        # Kill-switch twin on the same sharded banks: PILOSA_TPU_MESH=0
+        # semantics, byte-identical responses required.
+        megamod.MESH_ENABLED = False
+        off_qps, off_res = serving_qps(Executor(h, mesh=MeshContext(
+            devs[:n_mesh])))
+        megamod.MESH_ENABLED = True
+        assert mesh_res == off_res, \
+            "mesh responses differ from PILOSA_TPU_MESH=0 path"
+
+        # The zero-host-bytes claim on the Count/Sum reduce path: the
+        # profiler's d2h accounting must see ONE uint32 (the psum'd
+        # final answer) per count lane, never the [S] partial vector.
+        count_qs = [("bench", q, None) for q in queries
+                    if q.startswith("Count")][:16]
+        profs = [QueryProfile("bench", q) for _, q, _ in count_qs]
+        out = mesh_ex.execute_batch(count_qs, profiles=profs)
+        assert not any(isinstance(r, Exception) for r in out), out[:3]
+        d2h = [p.d2h_bytes for p in profs]
+        assert all(b == 4 for b in d2h), f"host partials on reduce: {d2h}"
+
+        print(json.dumps({
+            "bench": "mega_burst_multichip",
+            "mesh_devices": n_mesh,
+            "threads": min(N_THREADS, N_QUERIES),
+            "queries": len(queries),
+            "repeats": REPEATS,
+            "mesh_qps": mesh_qps,
+            "qps_mesh_off": off_qps,
+            "mesh_launches": launches,
+            "collective_bytes": collective,
+            "d2h_bytes_per_count": 4,
+            "bit_identical_mesh_on_off": True,
+            "backend": jax.devices()[0].platform,
+            "note": ("on forced-host CPU the N 'devices' share one "
+                     "socket, so the collective epilogue only adds "
+                     "emulation overhead; the lane's subject is the "
+                     "record shape + the zero-host-bytes reduce "
+                     "assertion, the speedup is the ICI fabric's on "
+                     "real chips"),
+        }, sort_keys=True), flush=True)
+        h.close()
+
+
+def lane_multichip():
+    """Serving-path lane over an N-device mesh: one SPMD cohort launch
+    per flush, Count/Sum reduced in-kernel (psum), rows all-gathered.
+    Runs in a BOUNDED fresh child — the probe_device_once reaper shape
+    (subprocess + timeout + stderr tail) — because the forced device
+    count latches at first jax init and a dead backend stalls rather
+    than errors."""
+    import subprocess
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if "--xla_force_host_platform_device_count" not in env.get(
+            "XLA_FLAGS", "") and env["JAX_PLATFORMS"] == "cpu":
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            f" --xla_force_host_platform_device_count="
+                            f"{MESH_DEVICES}").strip()
+    log(f"mega-bench: multichip lane in bounded child "
+        f"({MESH_DEVICES} devices, timeout {MESH_TIMEOUT_S:.0f}s)")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--multichip-child"],
+            timeout=MESH_TIMEOUT_S, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    except subprocess.TimeoutExpired:
+        emit({"bench": "mega_burst_multichip", "partial": True,
+              "error": f"child timed out after {MESH_TIMEOUT_S:.0f}s"})
+        return
+    if r.returncode != 0:
+        tail = (r.stderr or b"").decode("utf-8", "replace")[-500:]
+        emit({"bench": "mega_burst_multichip", "partial": True,
+              "error": f"child rc={r.returncode}: {tail}"})
+        return
+    for line in r.stdout.decode().splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            emit(json.loads(line))
+
+
 def main():
-    lanes = sys.argv[1:] or ["mixed", "tanimoto", "opt"]
+    if "--multichip-child" in sys.argv[1:]:
+        _multichip_child()
+        return
+    lanes = sys.argv[1:] or ["mixed", "tanimoto", "opt", "multichip"]
     # A full run regenerates the artifact; a single-lane rerun appends
     # to the committed record set instead of destroying it.
     if not sys.argv[1:] and os.path.exists(ARTIFACT):
@@ -453,6 +622,8 @@ def main():
         lane_tanimoto()
     if "opt" in lanes:
         lane_opt()
+    if "multichip" in lanes:
+        lane_multichip()
 
 
 if __name__ == "__main__":

@@ -3,10 +3,9 @@
 # hold (benchenv.probe_device_once — the same probe, called here) can
 # never drift in what "tunnel is up" means.
 #
-# The r04 scripts (run_tpu_suite_r04b.sh, run_tpu_followup_r04.sh,
-# run_quiet_capture_r04.sh) carry inline copies because they were
+# run_tpu_suite_r04b.sh carries an inline copy because it was
 # mid-execution when this file was extracted (bash reads scripts
-# incrementally — editing a running script corrupts it); round-5
+# incrementally — editing a running script corrupts it); new suite
 # scripts should `source benches/probe.sh` instead.
 probe() {
   timeout 100 python -c "

@@ -244,6 +244,12 @@ def cmd_server(args) -> int:
     from pilosa_tpu.executor import megakernel as _megamod
     if not cfg.optimizer_enabled:
         _megamod.PLAN_OPT_ENABLED = False
+    # Mesh collective path ([mesh] collectives): same one-way rule —
+    # config can disable the mesh cohort launches (per-group fusion
+    # under the mesh, the pre-mesh behavior), never re-enable past
+    # the PILOSA_TPU_MESH=0 kill switch.
+    if not cfg.mesh_collectives:
+        _megamod.MESH_ENABLED = False
     coalescer = None
     if cfg.coalescer_enabled:
         # Cross-request query coalescer: concurrent single-query POSTs

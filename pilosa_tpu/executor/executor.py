@@ -559,6 +559,15 @@ class Executor:
         self.mega_queries = 0
         self.mega_plan_entries = 0
         self.mega_plan_bytes = 0
+        # Mesh cohort launches (executor/megakernel.py under a
+        # MeshContext, PILOSA_TPU_MESH): one plan buffer dispatched
+        # SPMD over the mesh shard axis, reductions finished in-kernel
+        # by the collective epilogue. collective_bytes is the modeled
+        # ICI wire traffic (psum + all_gather, ops/megakernel.
+        # plan_cost). /metrics exports pilosa_executor_mesh_
+        # {launches,collective_bytes}_total.
+        self.mesh_launches = 0
+        self.mesh_collective_bytes = 0
         # Launch cost attribution (ops/megakernel.plan_cost, the
         # roofline plane): HBM bytes each launch moved split by kind,
         # plus per-opcode instruction totals. /metrics exports
@@ -760,6 +769,20 @@ class Executor:
             self.stats.count("executor.mega_plan_entries", plan_entries)
             self.stats.count("executor.mega_plan_bytes", plan_bytes)
             self.stats.histogram("executor.mega_batch_size", queries)
+
+    def _note_mesh(self, n_devices: int, collective_bytes: int) -> None:
+        """Account one mesh cohort launch: the plan buffer ran SPMD
+        over `n_devices` device slices and the epilogue's collectives
+        moved `collective_bytes` over ICI ('+=' is not atomic and
+        batches can run from several threads)."""
+        with self._jit_stats_lock:
+            self.mesh_launches += 1
+            self.mesh_collective_bytes += collective_bytes
+        if self.stats is not None:
+            self.stats.count("executor.mesh_launches", 1)
+            self.stats.count("executor.mesh_collective_bytes",
+                             collective_bytes)
+            self.stats.histogram("executor.mesh_devices", n_devices)
 
     def _note_launch_cost(self, cost: Dict[str, Any]) -> None:
         """Account one launch's HBM traffic attribution (ops/
@@ -1605,7 +1628,8 @@ class Executor:
                         TIMELINE.event(tl, "cache", LANE_CACHE,
                                        t_plan0, plan_s, hit=True)
                 return hit
-        if fusible and FUSION_ENABLED and self.mesh is None:
+        if fusible and FUSION_ENABLED and (
+                self.mesh is None or self._mesh_fusion_enabled()):
             fuser = getattr(self._tls, "fuser", None)
             if fuser is not None:
                 out = fuser.add(staged, prof, t_plan0)
@@ -1614,6 +1638,18 @@ class Executor:
         out = self._run_staged(staged, prof, t_plan0)
         return _CacheFillEval(out, rc, ckey, staged.gen) \
             if ckey is not None else out
+
+    def _mesh_fusion_enabled(self) -> bool:
+        """Mesh requests enter the fusion collector exactly when the
+        mesh megakernel path can take the staged evals (executor/
+        megakernel.py's MESH_ENABLED + MEGAKERNEL_ENABLED switches):
+        the collector is the gateway to the mesh cohort launch, and
+        groups the launch doesn't take run per-group — the solo path
+        is byte-identical to the unfused mesh path. With
+        PILOSA_TPU_MESH=0 (or the megakernel off) mesh requests skip
+        the collector entirely, the pre-mesh behavior."""
+        from pilosa_tpu.executor import megakernel as megamod
+        return megamod.MEGAKERNEL_ENABLED and megamod.MESH_ENABLED
 
     def _stage_tree(self, idx: Index, call: Call, shards: List[int],
                     mode: str) -> "_StagedEval":

@@ -66,6 +66,15 @@ class FusedEval:
 
     @property
     def nbytes(self) -> int:
+        # A megakernel _MegaView knows the REAL per-lane host bytes —
+        # under a mesh epilogue a count lane is one reduced uint32,
+        # not the [S] partial vector the stage-time shape assumed.
+        # Asking the resolved output keeps the profiler's d2h
+        # accounting honest without this handle knowing launch kinds.
+        out = self.group.out
+        fn = getattr(out, "lane_nbytes", None)
+        if fn is not None:
+            return int(fn(self.b))
         return self.slice_nbytes
 
     def _out(self) -> Any:

@@ -14,9 +14,23 @@ already returned to result code resolves unchanged — one host fetch
 per launch output, per-entry slices bit-identical to the unfused path
 (tests/test_megakernel.py pins this op-by-op).
 
+Mesh cohorts: when the executor carries a MeshContext the SAME plan
+buffer dispatches once and runs SPMD over the mesh shard axis — banks
+are already mesh-sharded (put_bank), plan buffers replicate, and the
+collective epilogue (ops/megakernel.mesh_epilogue) finishes the
+reduction in-kernel: count lanes psum to final ``[Nc]`` answers, row
+lanes all-gather via replicated out_shardings. The jit-cache key gains
+the mesh cache_key (device set + axis split change the partitioned
+program), verify_plan runs with the MeshSpec (shard-axis agreement,
+replica-axis no-op proof, collective lane typing), and d2h accounting
+shrinks to the final answers — zero per-shard partials on the
+Count/Sum reduce path.
+
 Kill switch: PILOSA_TPU_MEGAKERNEL=0 restores per-group fusion
-exactly. PILOSA_TPU_MEGA_BYTES caps the launch's register-slab HBM
-footprint; an over-budget cohort falls back rather than OOM.
+exactly. PILOSA_TPU_MESH=0 kills the mesh cohort path (per-group
+fusion under the mesh, exactly the pre-mesh behavior).
+PILOSA_TPU_MEGA_BYTES caps the launch's register-slab HBM footprint;
+an over-budget cohort falls back rather than OOM.
 """
 
 from __future__ import annotations
@@ -65,6 +79,21 @@ MEGAKERNEL_ENABLED = _default_enabled()
 # [T_pad, S, W] uint32 registers (gathered operand rows + scratch); a
 # cohort whose slab would exceed this runs per-group instead.
 MEGA_MAX_BYTES = int(os.environ.get("PILOSA_TPU_MEGA_BYTES", 1 << 30))
+
+
+def _default_mesh_enabled() -> bool:
+    """PILOSA_TPU_MESH: the mesh cohort path runs by default whenever
+    the executor carries a MeshContext; 0 is the blunt kill switch
+    that restores the pre-mesh behavior (per-group fusion under the
+    mesh) — the bit-exactness lever the check.sh mesh smoke and the
+    64-thread burst test flip."""
+    flag = os.environ.get("PILOSA_TPU_MESH", "on").strip().lower()
+    return flag not in ("0", "false", "no", "off")
+
+
+# Module attribute like MEGAKERNEL_ENABLED: tests/benches toggle it
+# directly; the env var sets the process default.
+MESH_ENABLED = _default_mesh_enabled()
 
 
 def _default_verify_mode() -> str:
@@ -128,6 +157,18 @@ class _MegaView:
             return self._dev()[lane]
         return self._dev()[lane, :, :self.width]
 
+    def lane_nbytes(self, b: int) -> int:
+        """Host bytes ONE member's finalize moves — the d2h accounting
+        seam FusedEval.nbytes delegates to. Shape metadata only, never
+        a device sync. Under a mesh epilogue a count lane is a single
+        reduced uint32 (counts are [Nc], not [Nc, S]) — the
+        zero-host-bytes-on-the-reduce-path number the profiler's d2h
+        assertion reads."""
+        arr = self._dev()
+        if self.mode == "count":
+            return int(arr.nbytes) // max(1, int(arr.shape[0]))
+        return int(arr.shape[-2]) * int(self.width) * 4
+
     # graftlint: materialize — the FusedEval.host convention: the
     # launch output fetches ONCE (cached on the launch) and every
     # group view slices the shared host copy.
@@ -179,7 +220,8 @@ def run_megakernel(executor: Any, groups: Dict[tuple, Any]
     failures fall back silently (results must never depend on the
     megakernel); failures after dispatch surface per member exactly
     like _FuseGroup errors."""
-    if not MEGAKERNEL_ENABLED or executor.mesh is not None:
+    if not MEGAKERNEL_ENABLED or (executor.mesh is not None
+                                  and not MESH_ENABLED):
         return groups
     cohorts: Dict[int, List[Any]] = {}
     remaining: Dict[tuple, Any] = {}
@@ -249,8 +291,22 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
 
     ex = executor
     n_entries = sum(len(g.entries) for g in cohort)
+    mesh = getattr(ex, "mesh", None)
+    epi = spec = None
     try:
         key = plan.sig(n_shards, w_mega)
+        if mesh is not None:
+            # Mesh cohort: one plan buffer, every device slice. The
+            # epilogue types one collective per real output lane and
+            # the jit-cache key gains the mesh identity (device set /
+            # axis split change the partitioned program) plus an
+            # epilogue marker (the mesh program returns [Nc] counts,
+            # not [Nc, S]).
+            epi = mk.mesh_epilogue(plan, mesh.SHARD_AXIS)
+            spec = mk.MeshSpec(mesh.SHARD_AXIS, mesh.REPLICA_AXIS,
+                               mesh.n_shard_devices, mesh.replicas,
+                               epi)
+            key = f"{key}|{mesh.cache_key()}|epi"
         fn = ex._jit_get(key)
         jit_hit = fn is not None
         # Plan-IR verification gate: the checked-IR contract
@@ -264,33 +320,55 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
         if PLAN_VERIFY_MODE == "on" or (PLAN_VERIFY_MODE == "auto"
                                         and not jit_hit):
             try:
-                mk.verify_plan(plan, n_shards, w_mega)
+                mk.verify_plan(plan, n_shards, w_mega, mesh=spec)
             except mk.PlanVerifyError:
                 ex._note_plan_verify(False)
                 raise
             ex._note_plan_verify(True)
         if fn is None:
             ex._note_jit_compile()
-            from pilosa_tpu.ops import pallas_kernels
-            # The Pallas instruction loop predates OP_EXPAND; a plan
-            # with sparse operands takes the jnp interpreter (the
-            # expansion itself is a pre-loop scatter either way).
-            fn = jax.jit(mk.build_program(
-                n_shards, w_mega, plan.n_regs,
-                use_pallas=pallas_kernels.enabled()
-                and not plan.xslots))
+            if mesh is not None:
+                # GSPMD partitions the interpreter over the mesh-
+                # sharded banks; the epilogue's count-lane sum over
+                # the shard axis lowers to the psum, and replicated
+                # out_shardings inserts the row lanes' all_gather.
+                # The Pallas loop is single-device — the mesh path
+                # always takes the jnp interpreter.
+                fn = jax.jit(
+                    mk.build_program(n_shards, w_mega, plan.n_regs,
+                                     epilogue=epi),
+                    out_shardings=(mesh.replicated(),
+                                   mesh.replicated()))
+            else:
+                from pilosa_tpu.ops import pallas_kernels
+                # The Pallas instruction loop predates OP_EXPAND; a
+                # plan with sparse operands takes the jnp interpreter
+                # (the expansion itself is a pre-loop scatter either
+                # way).
+                fn = jax.jit(mk.build_program(
+                    n_shards, w_mega, plan.n_regs,
+                    use_pallas=pallas_kernels.enabled()
+                    and not plan.xslots))
             ex._jit_put(key, fn)
         # Plan buffers are per-launch data (the whole point: new mixed
         # composition, same compiled program) — upload them now and
         # charge the bytes as this launch's plan-buffer H2D. Sparse
         # banks (plan.xbanks) are already device-resident pairs; only
-        # their slot lists upload.
-        slots_dev = tuple(jnp.asarray(s) for s in plan.slots)
-        widths_dev = jnp.asarray(plan.widths)
-        instrs_dev = jnp.asarray(plan.instrs)
-        out_count_dev = jnp.asarray(plan.out_count)
-        out_row_dev = jnp.asarray(plan.out_row)
-        xslots_dev = tuple(jnp.asarray(s) for s in plan.xslots)
+        # their slot lists upload. Under a mesh they land REPLICATED
+        # (every device reads the same instruction stream) — a bare
+        # asarray would commit them to one device and fight the
+        # sharded banks inside the partitioned program.
+        if mesh is None:
+            _put = jnp.asarray
+        else:
+            def _put(a: Any) -> Any:
+                return jax.device_put(np.asarray(a), mesh.replicated())
+        slots_dev = tuple(_put(s) for s in plan.slots)
+        widths_dev = _put(plan.widths)
+        instrs_dev = _put(plan.instrs)
+        out_count_dev = _put(plan.out_count)
+        out_row_dev = _put(plan.out_row)
+        xslots_dev = tuple(_put(s) for s in plan.xslots)
         plan_bytes = plan.plan_nbytes
         t0 = time.perf_counter()
         out = ex._call_program(fn, plan.banks, slots_dev, widths_dev,
@@ -308,7 +386,7 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
     # best-effort by contract: a surprised cost model must never fail
     # a request that already has its results in flight.
     try:
-        cost = mk.plan_cost(plan, n_shards, w_mega)
+        cost = mk.plan_cost(plan, n_shards, w_mega, mesh=spec)
     except Exception:
         cost = None
     # Cohort signature for the per-cohort bandwidth EWMAs: the capacity
@@ -341,9 +419,13 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
         # lanes' outputs; padding is the pow2 capacity slack in the slab,
         # instruction buffer and output lanes. Keyed on the launch object,
         # unregistered when the last member's response drops it.
+        # Under a mesh epilogue a count lane's output is ONE reduced
+        # uint32, not an [S] partial vector — the ledger's live bytes
+        # track what the launch actually keeps resident.
         lane_bytes = sum(
-            int(np.prod((e.n_shards,) if e.mode == "count"
-                        else (e.n_shards, e.width))) * 4
+            int(np.prod((1,) if mesh is not None
+                        else (e.n_shards,)) if e.mode == "count"
+                else np.prod((e.n_shards, e.width))) * 4
             for g in cohort for e in g.entries)
         slab = mk.slab_nbytes(plan.n_regs, n_shards, w_mega)
         live_slab = mk.slab_nbytes(plan.n_slots + plan.n_xslots,
@@ -353,6 +435,10 @@ def _launch(executor: Any, cohort: List[Any], plan: mk.Plan,
                      batch=n_entries, groups=len(cohort),
                      planEntries=plan.n_instrs)
         ex._note_mega(n_entries, plan.n_instrs, plan_bytes)
+        if spec is not None:
+            ex._note_mesh(spec.n_devices,
+                          cost.get("collectiveBytes", 0)
+                          if cost is not None else 0)
         if cost is not None:
             ex._note_launch_cost(cost)
         if plan.opt_stats is not None:
@@ -407,6 +493,13 @@ def _attribute(ex: Any, cohort: List[Any], launch: _MegaLaunch,
                 # just how long it took.
                 node.attrs["launchBytes"] = cost["totalBytes"]
                 node.attrs["opcodeHist"] = dict(cost["opcodeHist"])
+                if "collectiveBytes" in cost:
+                    # Mesh launch: which mesh carried it and what the
+                    # collectives moved over ICI — the per-chip HBM
+                    # share is deviceBytes in the same vector.
+                    node.attrs["meshDevices"] = cost["meshDevices"]
+                    node.attrs["collectiveBytes"] = \
+                        cost["collectiveBytes"]
             if opt is not None:
                 # The optimizer's before/after so a profile reader can
                 # attribute the reduction without the /metrics deltas.

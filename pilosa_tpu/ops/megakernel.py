@@ -545,6 +545,88 @@ def slab_nbytes(n_regs: int, n_shards: int, w_mega: int) -> int:
     return int(n_regs) * int(n_shards) * int(w_mega) * 4
 
 
+# ------------------------------------------------------- mesh epilogue
+#
+# A mesh launch runs the SAME [P, 4] plan buffer on every device slice
+# of the shard axis (banks land sharded via MeshContext.put_bank, the
+# plan buffers replicated), so the instruction loop needs no changes —
+# registers are [S, W] slabs whose S axis is simply split across chips.
+# What changes is the OUTPUT stage: the single-device program returns
+# per-shard count vectors for the host to sum, which on a mesh would
+# ship S partials per lane over PCIe. The epilogue finishes the
+# reduction in-kernel instead: count lanes collapse the shard axis on
+# device (under GSPMD the sum over the mesh-sharded axis lowers to an
+# XLA all-reduce — a psum over the shard axis), and row lanes are
+# all-gathered to every device by the launch's replicated out_shardings
+# so the coordinator reads whole rows, not per-device slices. Like the
+# instruction stream, the epilogue is typed DATA: one collective opcode
+# per real output lane, verified pre-launch (verify_plan's mesh rules)
+# so a mis-built mesh plan fails loudly instead of double-counting.
+
+EPI_NONE = 0
+# Count lane: collapse the shard axis in-kernel. Over mesh-sharded
+# banks this is the cross-chip all-reduce; uint32 is safe because one
+# reduced lane covers at most the full shard stack's set bits
+# (popcount's 2^30 < 2^32 bound, ops/bitset.py).
+EPI_PSUM = 1
+# Row lane: replicate the [S, W] result words to every device (the
+# launch's replicated out_shardings inserts the all-gather); device
+# top-k over row lanes reads the gathered words without a host hop.
+EPI_ALL_GATHER = 2
+
+EPI_NAMES = ("none", "psum", "all_gather")
+
+
+class Epilogue:
+    """Typed collective plan for one mesh launch: which named mesh axes
+    the epilogue reduces over, and one collective opcode per REAL
+    output lane (count lanes and row lanes separately — pad lanes never
+    reach a collective, the masking invariant keeps them zero). Pure
+    host data, same contract as the instruction buffer: verified before
+    launch, hashed into the jit-cache key."""
+
+    __slots__ = ("axes", "count_ops", "row_ops")
+
+    def __init__(self, axes: Sequence[str], count_ops: Sequence[int],
+                 row_ops: Sequence[int]):
+        self.axes = tuple(str(a) for a in axes)
+        self.count_ops = np.asarray(list(count_ops), dtype=np.int32)
+        self.row_ops = np.asarray(list(row_ops), dtype=np.int32)
+
+
+class MeshSpec:
+    """Host-side description of the device mesh a plan is verified
+    against — axis names, device counts and the collective epilogue.
+    Deliberately NOT parallel.mesh.MeshContext: verify_plan/plan_cost
+    stay pure host numpy (no jax import, no device handles), so the
+    planverify/plan_fuzz sweeps can type-check mesh plans on a machine
+    with zero accelerators."""
+
+    __slots__ = ("shard_axis", "replica_axis", "n_devices", "replicas",
+                 "epilogue")
+
+    def __init__(self, shard_axis: str, replica_axis: str,
+                 n_devices: int, replicas: int = 1,
+                 epilogue: Optional[Epilogue] = None):
+        self.shard_axis = str(shard_axis)
+        self.replica_axis = str(replica_axis)
+        self.n_devices = int(n_devices)
+        self.replicas = int(replicas)
+        self.epilogue = epilogue
+
+
+def mesh_epilogue(plan: Plan, shard_axis: str = "shards") -> Epilogue:
+    """The canonical epilogue for a finished plan: every real count
+    lane reduces with a shard-axis psum, every real row lane
+    all-gathers. Built from the plan's REAL lane counts (pad lanes are
+    excluded by construction — exactly the lanes the masking invariant
+    proves are result-invisible)."""
+    nc = len(plan.lane_count_widths)
+    nr = len(plan.lane_row_widths)
+    return Epilogue((shard_axis,), [EPI_PSUM] * nc,
+                    [EPI_ALL_GATHER] * nr)
+
+
 # --------------------------------------------------------- verification
 #
 # The plan buffer is DATA handed to one compiled interpreter, so a
@@ -576,7 +658,8 @@ def _is_pow2(n: int) -> bool:
     return n >= 1 and (n & (n - 1)) == 0
 
 
-def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
+def verify_plan(plan: Plan, n_shards: int, w_mega: int,
+                mesh: Optional[MeshSpec] = None) -> None:
     """Validate one launch's plan buffers against the interpreter's
     execution model; raise :class:`PlanVerifyError` on the first
     violation, return ``None`` when every invariant holds.
@@ -633,6 +716,16 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
       (``dst = dst | (a & b)``) additionally READS its dst: the
       accumulator must be defined (a missed thermometer init would
       silently under-count) and its span joins ``min(za, zb)``.
+    * **Mesh collectives (``mesh`` is not None)** — the launch's shard
+      axis must split evenly across the mesh's shard devices
+      (shard-axis agreement: a ragged split would give devices
+      different local S and the shared plan buffer different register
+      shapes per chip); the epilogue must reduce over EXACTLY the
+      shard axis — never the replica axis (a psum over a replicated
+      axis multiplies every count by R: the replica-axis no-op proof);
+      and every REAL output lane carries a typed collective — count
+      lanes ``psum``, row lanes ``all_gather`` — so no lane can leak
+      per-device partials to the host merge path.
     """
     instrs = plan.instrs
     if instrs.ndim != 2 or instrs.shape[1] != 4:
@@ -878,6 +971,65 @@ def verify_plan(plan: Plan, n_shards: int, w_mega: int) -> None:
                 f"output lane reads — the pad tail would corrupt a "
                 f"result")
 
+    if mesh is not None:
+        _verify_mesh(mesh, n_shards, nc, nr)
+
+
+def _verify_mesh(mesh: MeshSpec, n_shards: int, nc: int,
+                 nr: int) -> None:
+    """The mesh rules of verify_plan: shard-axis agreement, the
+    replica-axis no-op proof, and per-lane collective typing."""
+    D = int(mesh.n_devices)
+    if D < 1:
+        raise PlanVerifyError(f"mesh: n_devices={D} must be >= 1")
+    if int(n_shards) % D != 0:
+        raise PlanVerifyError(
+            f"mesh: n_shards={int(n_shards)} does not split evenly "
+            f"over {D} shard devices — shard-axis agreement requires "
+            f"identical local register shapes on every chip")
+    if not mesh.shard_axis or mesh.shard_axis == mesh.replica_axis:
+        raise PlanVerifyError(
+            f"mesh: shard axis {mesh.shard_axis!r} must be a named "
+            f"axis distinct from replica axis {mesh.replica_axis!r}")
+    epi = mesh.epilogue
+    if epi is None:
+        raise PlanVerifyError(
+            "mesh: launch has no collective epilogue — a mesh plan "
+            "without typed collectives would return per-device "
+            "partials")
+    if epi.axes != (mesh.shard_axis,):
+        raise PlanVerifyError(
+            f"mesh: epilogue reduces over axes {epi.axes}, expected "
+            f"exactly ({mesh.shard_axis!r},)")
+    if mesh.replica_axis in epi.axes:
+        raise PlanVerifyError(
+            f"mesh: epilogue reduces over the replica axis "
+            f"{mesh.replica_axis!r} — replicated operands would be "
+            f"counted {int(mesh.replicas)}x (the replica-axis no-op "
+            f"proof fails)")
+    if len(epi.count_ops) != nc or len(epi.row_ops) != nr:
+        raise PlanVerifyError(
+            f"mesh: epilogue types {len(epi.count_ops)} count / "
+            f"{len(epi.row_ops)} row lanes, plan has {nc} / {nr} real "
+            f"lanes")
+    # graftlint: disable=GL003 — epilogue ops are host numpy by
+    # construction (Epilogue.__init__), never device buffers.
+    for j, op in enumerate(epi.count_ops.tolist()):
+        if op != EPI_PSUM:
+            name = EPI_NAMES[op] if 0 <= op < len(EPI_NAMES) else op
+            raise PlanVerifyError(
+                f"mesh: count lane {j} typed {name!r}, must be "
+                f"'psum' — anything else ships per-shard partials "
+                f"to the host")
+    # graftlint: disable=GL003 — host-numpy epilogue ops, as above.
+    for j, op in enumerate(epi.row_ops.tolist()):
+        if op != EPI_ALL_GATHER:
+            name = EPI_NAMES[op] if 0 <= op < len(EPI_NAMES) else op
+            raise PlanVerifyError(
+                f"mesh: row lane {j} typed {name!r}, must be "
+                f"'all_gather' — the coordinator reads whole rows, "
+                f"not per-device slices")
+
 
 # ------------------------------------------------------ cost attribution
 #
@@ -904,7 +1056,8 @@ def _buf_nbytes(a: Any) -> int:
     return 0
 
 
-def plan_cost(plan: Plan, n_shards: int, w_mega: int) -> Dict[str, Any]:
+def plan_cost(plan: Plan, n_shards: int, w_mega: int,
+              mesh: Optional[MeshSpec] = None) -> Dict[str, Any]:
     """Per-launch HBM traffic model over one finished plan, split by
     kind, plus the per-opcode instruction histogram.
 
@@ -936,6 +1089,16 @@ def plan_cost(plan: Plan, n_shards: int, w_mega: int) -> Dict[str, Any]:
     + planBytes`` against the ``fusion_pad`` entry of the same launch.
     ``opcodeHist`` counts REAL instructions only, keyed by OP_NAMES,
     zero-count opcodes omitted.
+
+    With ``mesh`` set, three more keys attribute the multi-chip
+    launch: ``meshDevices``, ``deviceBytes`` (every split above scales
+    with the shard axis, so one chip's HBM share is the ceiling of
+    ``totalBytes / D``), and ``collectiveBytes`` = ``psumBytes`` (ring
+    all-reduce of the real count lanes' uint32 partial vector:
+    ``2 * (D-1) * nc * 4``) + ``allGatherBytes`` (each real row lane's
+    ``[S, W]`` words replicated to the other ``D-1`` devices:
+    ``(D-1) * nr * row``) — ICI wire bytes, disjoint from the HBM
+    splits.
     """
     S, W = int(n_shards), int(w_mega)
     row = S * W * 4
@@ -998,7 +1161,7 @@ def plan_cost(plan: Plan, n_shards: int, w_mega: int) -> Dict[str, Any]:
            + (len(plan.out_row) - nr) * 2 * row)
 
     total = gather + compute + expand + pad
-    return {
+    out = {
         "gatherBytes": int(gather),
         "computeBytes": int(compute),
         "expandBytes": int(expand),
@@ -1010,13 +1173,35 @@ def plan_cost(plan: Plan, n_shards: int, w_mega: int) -> Dict[str, Any]:
         "opcodeHist": hist,
         "nInstrs": n_instrs,
     }
+    if mesh is not None:
+        D = max(1, int(mesh.n_devices))
+        psum = 2 * (D - 1) * nc * 4
+        ag = (D - 1) * nr * row
+        out["meshDevices"] = D
+        out["deviceBytes"] = int(-(-total // D))
+        out["psumBytes"] = int(psum)
+        out["allGatherBytes"] = int(ag)
+        out["collectiveBytes"] = int(psum + ag)
+    return out
 
 
 def build_program(n_shards: int, w_mega: int, t_pad: int,
-                  use_pallas: bool = False) -> Callable[..., Any]:
+                  use_pallas: bool = False,
+                  epilogue: Optional[Epilogue] = None
+                  ) -> Callable[..., Any]:
     """The traceable interpreter body for one capacity bucket. The
     caller jits it (through the executor's LRU compile cache, so the
-    retrace counter sees every real signature miss)."""
+    retrace counter sees every real signature miss).
+
+    With ``epilogue`` set (mesh launch) the count output stage
+    collapses the shard axis in-kernel: under GSPMD the sum over the
+    mesh-sharded axis lowers to an XLA all-reduce (the psum the
+    epilogue's count lanes are typed with), so the launch returns
+    final ``[Nc]`` answers instead of ``[Nc, S]`` partials. Row lanes
+    keep their ``[Nr, S, W]`` shape — the caller's replicated
+    out_shardings inserts the all_gather the row lanes are typed
+    with. uint32 stays safe: one reduced lane covers at most the full
+    shard stack (popcount's 2^30 < 2^32 bound)."""
     import jax
     import jax.numpy as jnp
 
@@ -1099,6 +1284,11 @@ def build_program(n_shards: int, w_mega: int, t_pad: int,
             slab = jax.lax.fori_loop(0, instrs.shape[0], body, slab)
         counts = popcount(slab[out_count], axis=-1)   # [Nc, S] uint32
         rows = slab[out_row]                          # [Nr, S, W]
+        if epilogue is not None:
+            # EPI_PSUM over every count lane: the shard axis is the
+            # mesh-sharded one, so this sum IS the cross-chip
+            # all-reduce — [Nc] final answers, zero host partials.
+            counts = jnp.sum(counts, axis=-1, dtype=jnp.uint32)
         return counts, rows
 
     return run

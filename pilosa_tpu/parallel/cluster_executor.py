@@ -13,7 +13,15 @@ merge rule per call type — the associative reduceFn table
 This HTTP path distributes across *hosts*; within a host the local
 executor still batches its shard subset on the TPU mesh. The two layers
 compose: DCN-style distribution over HTTP, ICI-style reduction inside the
-chip mesh.
+chip mesh. One process group IS one mesh leg of the fan-out: when the
+local executor carries a MeshContext, its leg's shard subset runs the
+mesh megakernel cohort path (executor/megakernel.py) — one verified
+plan buffer SPMD over the process's devices, count/row lanes reduced
+in-kernel by the collective epilogue — and only the already-final
+per-leg answers meet the HTTP merge table below. HTTP is kept for the
+cross-PROCESS failure domain on purpose (failover, hedged reads,
+deadline budgets all operate per leg); device collectives own the
+intra-process reduce domain where none of those can happen.
 """
 
 from __future__ import annotations
@@ -551,6 +559,12 @@ class ClusterExecutor:
             if local_shards is not None:
                 # The coordinator's own leg records into the root
                 # profile directly — its ops ARE the tree's trunk.
+                # Under a MeshContext this leg IS a mesh leg: the
+                # shard subset reduces with device collectives inside
+                # the process and only the final answer joins the
+                # HTTP merge.
+                if getattr(self.local, "mesh", None) is not None:
+                    self.stats.count("cluster.mesh_legs", 1)
                 local = self.local.execute(index, call.to_pql(),
                                            shards=local_shards,
                                            profile=profile)

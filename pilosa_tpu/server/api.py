@@ -946,6 +946,8 @@ class API:
             "launchBytesPad": ex.launch_bytes_pad,
             "opcodeTotals": dict(ex.opcode_counts),
             "megaLaunches": ex.mega_launches,
+            "meshLaunches": ex.mesh_launches,
+            "meshCollectiveBytes": ex.mesh_collective_bytes,
         }
         return doc
 
@@ -1089,6 +1091,12 @@ class API:
                 "megaQueries": self.executor.mega_queries,
                 "megaPlanEntries": self.executor.mega_plan_entries,
                 "megaPlanBytes": self.executor.mega_plan_bytes,
+                # Mesh cohort path (executor/megakernel.py under a
+                # MeshContext, PILOSA_TPU_MESH): one plan buffer SPMD
+                # over the shard axis, in-kernel collective reduce.
+                "meshLaunches": self.executor.mesh_launches,
+                "meshCollectiveBytes":
+                    self.executor.mesh_collective_bytes,
                 # Plan-IR verification gate (PILOSA_TPU_PLAN_VERIFY):
                 # a nonzero reject count means a lowering bug raised
                 # instead of executing — page-worthy.

@@ -363,6 +363,16 @@ class Handler(BaseHTTPRequestHandler):
                                 api.executor.mega_plan_entries,
                             "megaPlanBytes":
                                 api.executor.mega_plan_bytes,
+                            # Mesh cohort launches (PILOSA_TPU_MESH):
+                            # plan buffers run SPMD over the mesh
+                            # shard axis, reductions finished by the
+                            # collective epilogue (psum/all_gather) —
+                            # collectiveBytes is the modeled ICI wire
+                            # traffic.
+                            "meshLaunches":
+                                api.executor.mesh_launches,
+                            "meshCollectiveBytes":
+                                api.executor.mesh_collective_bytes,
                             "planVerifyPasses":
                                 api.executor.plan_verify_passes,
                             "planVerifyRejects":

@@ -58,6 +58,14 @@ class Config:
     # TPU
     mesh_devices: int = 0         # 0 = all visible devices
     mesh_replicas: int = 1
+    # Mesh cohort path (executor/megakernel.py): megakernel plan
+    # buffers run SPMD over the mesh shard axis with in-kernel
+    # collective reductions (psum count lanes, all-gather row lanes).
+    # TOML accepts a [mesh] table (devices/replicas/collectives) or
+    # the flat mesh_* spelling; the env kill switch PILOSA_TPU_MESH=0
+    # always wins — config can disable the collective path, never
+    # re-enable it past the blunt switch.
+    mesh_collectives: bool = True
     # JAX platform override ("" = default). "cpu" keeps the server
     # serving host-path queries when the accelerator transport is down —
     # without it, the first jax.devices() blocks on a hung backend.

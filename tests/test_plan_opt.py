@@ -200,6 +200,11 @@ def test_gather_only_row_plan_survives():
 
 
 def test_density_feed_roundtrip_and_cap():
+    # The registry keys on id(); arrays freed by earlier tests can leave
+    # stale entries whose id a fresh bank() may reuse. Harmless in prod
+    # (ordering-only), but this test asserts exact defaults — isolate it.
+    with plan_opt._density_lock:
+        plan_opt._density.clear()
     a, b = bank(4), bank(4)
     plan_opt.note_bank_density(a, 0.25)
     assert plan_opt.bank_density(a) == 0.25

@@ -316,6 +316,62 @@ with tempfile.TemporaryDirectory() as d:
 print("megakernel smoke OK")
 EOF
 
+step "mesh smoke (4-device SPMD burst -> 1 mesh launch, collective reduce, kill-switch bit-identity)"
+# The mesh cohort path on 4 forced host devices: one SPMD megakernel
+# launch over mesh-sharded banks, the collective epilogue psums count
+# lanes in-kernel (verify_plan's mesh rules gate the plan), and
+# PILOSA_TPU_MESH=0 must restore the exact single-device path
+# byte-for-byte.
+XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+    PILOSA_TPU_RESULT_CACHE=0 PILOSA_TPU_MEGAKERNEL=1 \
+    PILOSA_TPU_PLAN_VERIFY=on JAX_PLATFORMS=cpu \
+    python - <<'EOF' || fail=1
+import tempfile
+import numpy as np
+import jax
+from pilosa_tpu.core.holder import Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor import megakernel as megamod
+from pilosa_tpu.ops.bitset import SHARD_WIDTH
+from pilosa_tpu.parallel import MeshContext
+
+assert len(jax.devices()) == 4, jax.devices()
+with tempfile.TemporaryDirectory() as d:
+    h = Holder(d); h.open()
+    idx = h.create_index("mesh")
+    f = idx.create_field("f"); g = idx.create_field("g")
+    rng = np.random.default_rng(7)
+    rows = rng.integers(0, 8, 4000).astype(np.uint64)
+    cols = rng.integers(0, 2 * SHARD_WIDTH, 4000).astype(np.uint64)
+    f.import_bits(rows, cols); g.import_bits(rows[::2], cols[::2])
+    idx.add_existence(cols)
+    reqs = []
+    for k in range(32):
+        r = k % 8
+        reqs.append(("mesh", [f"Count(Row(f={r}))", f"Row(g={r})",
+                              f"Count(Intersect(Row(f={r}), Row(g={r})))",
+                              f"Count(Union(Row(f={r}), Row(g={r})))"
+                              ][(k // 8) % 4], None))
+    mex = Executor(h, mesh=MeshContext(jax.devices()))
+    on = mex.execute_batch_shaped(reqs)
+    assert mex.mesh_launches == 1 and mex.mega_launches == 1, \
+        (mex.mesh_launches, mex.mega_launches)
+    # The mesh plan passed the verifier's mesh rules pre-launch.
+    assert mex.plan_verify_passes == 1 and mex.plan_verify_rejects == 0, \
+        (mex.plan_verify_passes, mex.plan_verify_rejects)
+    assert mex.mesh_collective_bytes > 0
+    # PILOSA_TPU_MESH=0 regime on the same sharded banks.
+    megamod.MESH_ENABLED = False
+    off = Executor(h, mesh=MeshContext(jax.devices())).execute_batch_shaped(reqs)
+    megamod.MESH_ENABLED = True
+    assert on == off, "mesh responses differ from kill-switch path"
+    # No mesh at all (single-device megakernel) is also bit-identical.
+    plain = Executor(h).execute_batch_shaped(reqs)
+    assert on == plain, "mesh responses differ from single-device path"
+    h.close()
+print("mesh smoke OK")
+EOF
+
 step "plan-optimizer smoke (64 shared-subtree queries -> CSE hits, kill-switch bit-identity)"
 # The PR 16 cost-based optimizer (ops/plan_opt.py): a shared-subtree
 # burst must produce cross-request CSE hits with the optimized launch
@@ -398,8 +454,8 @@ ROOFLINE.reset(); ROOFLINE.configure(enabled=True)
 TIMELINE.configure(enabled=True)
 costs = []
 orig_cost = mk.plan_cost
-def spy(plan, n_shards, w_mega):
-    c = orig_cost(plan, n_shards, w_mega)
+def spy(plan, n_shards, w_mega, mesh=None):
+    c = orig_cost(plan, n_shards, w_mega, mesh=mesh)
     costs.append(c)
     return c
 mk.plan_cost = spy
@@ -473,8 +529,11 @@ step "plan-fuzz gate (corpus replay + deterministic sweep + digest stability)"
 # corpus replays clean, then a seeded sweep — every batch bit-exact
 # across megakernel / vmap fusion / packed numpy, every captured plan
 # verified, every mutation rejected. Fast mode replays the corpus
-# only; the default path adds the 300-case sweep and pins generator
-# determinism (two --digest runs must agree).
+# only; the default path adds the 300-case sweep, a four-way sweep
+# with the mesh collective leg (--mesh 4: every case also runs the
+# SPMD cohort path over 4 forced host devices, bit-exact against the
+# single-device interpreter) and pins generator determinism (two
+# --digest runs must agree).
 if [ "$FAST" = 1 ]; then
     JAX_PLATFORMS=cpu python -m tools.plan_fuzz --replay || fail=1
 else
@@ -483,6 +542,9 @@ else
         JAX_PLATFORMS=cpu python -m tools.plan_fuzz --replay
         JAX_PLATFORMS=cpu python -m tools.plan_fuzz --seed 0 \
             --iters 300 --no-save
+        XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+            JAX_PLATFORMS=cpu python -m tools.plan_fuzz --seed 1 \
+            --iters 40 --mesh 4 --no-save
         d1=$(python -m tools.plan_fuzz --seed 0 --iters 300 --digest)
         d2=$(python -m tools.plan_fuzz --seed 0 --iters 300 --digest)
         [ -n "$d1" ] && [ "$d1" = "$d2" ] || {

@@ -48,6 +48,12 @@ CLI::
     python -m tools.plan_fuzz --seed 7 --iters 300
     python -m tools.plan_fuzz --replay tests/plan_corpus
     python -m tools.plan_fuzz --seed 7 --iters 100 --digest
+    python -m tools.plan_fuzz --seed 7 --iters 50 --mesh 4
+
+``--mesh N`` adds differential leg (d): the same forests through an
+executor whose banks are mesh-sharded over N devices — ONE SPMD
+cohort launch whose count lanes psum and row lanes all-gather
+in-kernel — and the shaped responses must match leg (a) bit-exact.
 
 Exit status: 0 clean, 1 divergence found (reproducer written unless
 --no-save), 2 usage error.
@@ -351,7 +357,7 @@ class Harness:
     every case of a run (the jit cache warms across cases exactly like
     production traffic)."""
 
-    def __init__(self, data_seed: int = 0) -> None:
+    def __init__(self, data_seed: int = 0, mesh_devices: int = 0) -> None:
         from pilosa_tpu.core.field import FieldOptions
         from pilosa_tpu.core.holder import Holder
         from pilosa_tpu.executor import Executor
@@ -399,6 +405,24 @@ class Harness:
         # Exact-path differential: the result cache would serve leg
         # (b) from leg (a)'s fills and mask a divergence.
         self.executor.result_cache.enabled = False
+        # Optional leg (d): the same forests through a mesh-sharded
+        # executor (one SPMD cohort launch, in-kernel collective
+        # reduce) — banks live sharded over N devices, so every case
+        # differentials the psum/all-gather epilogue against the
+        # single-device interpreter and the numpy oracle.
+        self.mesh_executor = None
+        if mesh_devices:
+            import jax
+            from pilosa_tpu.parallel import MeshContext
+            devs = jax.devices()
+            if len(devs) < mesh_devices:
+                raise SystemExit(
+                    f"plan_fuzz: --mesh {mesh_devices} but only "
+                    f"{len(devs)} devices visible (set XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count)")
+            self.mesh_executor = Executor(
+                self.holder, mesh=MeshContext(devs[:mesh_devices]))
+            self.mesh_executor.result_cache.enabled = False
 
     def close(self) -> None:
         self.holder.close()
@@ -440,16 +464,39 @@ class Harness:
             megamod._build = orig_build
             megamod.MEGAKERNEL_ENABLED = prev_enabled
 
+        mesh = None
+        if self.mesh_executor is not None:
+            launches0 = self.mesh_executor.mesh_launches
+            megamod.MEGAKERNEL_ENABLED = True
+            try:
+                mesh = self.mesh_executor.execute_batch_shaped(reqs)
+            finally:
+                megamod.MEGAKERNEL_ENABLED = prev_enabled
+            if captured and (self.mesh_executor.mesh_launches
+                             == launches0):
+                problems.append(
+                    "mesh leg never took a mesh cohort launch — the "
+                    "collective path was silently skipped")
+
         for i, (resp_m, resp_v, exp) in enumerate(zip(mega, vmap,
                                                       expected)):
             q = reqs[i][1]
-            for name, resp in (("megakernel", resp_m), ("vmap", resp_v)):
+            legs = [("megakernel", resp_m), ("vmap", resp_v)]
+            if mesh is not None:
+                legs.append(("mesh", mesh[i]))
+            for name, resp in legs:
                 if isinstance(resp, Exception):
                     problems.append(f"[{i}] {q}: {name} raised {resp!r}")
-            if any(isinstance(r, Exception) for r in (resp_m, resp_v)):
+            if any(isinstance(r, Exception) for _, r in legs):
                 continue
             got_m = resp_m["results"][0]
             got_v = resp_v["results"][0]
+            if mesh is not None:
+                got_d = mesh[i]["results"][0]
+                if got_d != got_m:
+                    problems.append(
+                        f"[{i}] {q}: mesh collective {_brief(got_d)} "
+                        f"!= megakernel {_brief(got_m)}")
             if got_m != got_v:
                 problems.append(
                     f"[{i}] {q}: megakernel {_brief(got_m)} != vmap "
@@ -506,10 +553,10 @@ def save_case(case: List[List[Any]], data_seed: int, corpus_dir: str,
 
 
 def run_fuzz(seed: int, iters: int, corpus_dir: Optional[str],
-             verbose: bool = False) -> int:
+             verbose: bool = False, mesh: int = 0) -> int:
     digest = hashlib.sha256()
     failures = 0
-    h = Harness(data_seed=seed)
+    h = Harness(data_seed=seed, mesh_devices=mesh)
     try:
         for i in range(iters):
             case = gen_case(seed, i)
@@ -535,7 +582,7 @@ def run_fuzz(seed: int, iters: int, corpus_dir: Optional[str],
     return 1 if failures else 0
 
 
-def run_replay(corpus_dir: str) -> int:
+def run_replay(corpus_dir: str, mesh: int = 0) -> int:
     if not os.path.isdir(corpus_dir):
         print(f"plan_fuzz: no corpus at {corpus_dir} — nothing to "
               "replay")
@@ -551,7 +598,8 @@ def run_replay(corpus_dir: str) -> int:
             ds = int(doc.get("dataSeed", 0))
             h = harnesses.get(ds)
             if h is None:
-                h = harnesses[ds] = Harness(data_seed=ds)
+                h = harnesses[ds] = Harness(data_seed=ds,
+                                            mesh_devices=mesh)
             problems = h.check_case(doc["queries"], mutate_seed=ds)
             if problems:
                 failures += 1
@@ -585,11 +633,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument("--digest", action="store_true",
                     help="only print the generated-stream digest "
                          "(determinism check; no execution)")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="add differential leg (d): every case also "
+                         "runs through an executor mesh-sharded over "
+                         "N devices (one SPMD cohort launch, psum/"
+                         "all-gather epilogue) and must match leg (a) "
+                         "bit-exact")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
 
     if args.replay is not None:
-        return run_replay(args.replay)
+        return run_replay(args.replay, mesh=args.mesh)
     if args.digest:
         digest = hashlib.sha256()
         for i in range(args.iters):
@@ -597,7 +651,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(digest.hexdigest())
         return 0
     corpus = None if args.no_save else args.corpus_dir
-    return run_fuzz(args.seed, args.iters, corpus, verbose=args.verbose)
+    return run_fuzz(args.seed, args.iters, corpus,
+                    verbose=args.verbose, mesh=args.mesh)
 
 
 if __name__ == "__main__":
