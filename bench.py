@@ -244,6 +244,7 @@ def bench_tpu(holder, partial):
     stage_timeline_breakdown(ex, q, partial)
     cache_stats_stanza(ex, partial)
     roofline_stanza(ex, partial)
+    slo_stanza(partial, times)
     return float(np.median(times)), want.pairs
 
 
@@ -310,6 +311,66 @@ def roofline_stanza(ex, partial):
             f"({snap['rooflineSource']})")
     except Exception as e:
         log(f"bench: roofline stanza failed: {e!r}")
+
+
+def slo_stanza(partial, times):
+    """Would the measured latency distribution hold a serving SLO
+    (ISSUE 20)?  Replays the timed loop's per-call latencies through a
+    private SentinelRecorder (utils/sentinel.py) against the objective
+    in PILOSA_BENCH_SLO (default "99% < 25ms") on a synthetic clock —
+    the record then carries budget consumed, windowed p95/p99 and any
+    burn-rate alerts the run would have fired, so a bench regression
+    reads directly in SLO terms. Best-effort: a failure costs the
+    stanza, never the headline number."""
+    try:
+        from pilosa_tpu.server.http import SLO_BUCKETS
+        from pilosa_tpu.utils.sentinel import SentinelRecorder
+        from pilosa_tpu.utils.stats import MemStatsClient
+
+        spec = os.environ.get("PILOSA_BENCH_SLO", "99% < 25ms")
+        sent = SentinelRecorder()
+        sent.configure(enabled=True, ring=64, decimate=10,
+                       alert_ring=32, objectives={"query": spec})
+        stats = MemStatsClient()
+        red = stats.with_tags("endpoint:/index/{index}/query",
+                              "status:200")
+        # Replay in ~8 sentinel ticks; the synthetic clock advances by
+        # the real wall time each chunk of calls took, so q/s and the
+        # burn windows see the measured rate, not an arbitrary one.
+        clock = 0.0
+        sent.sample({}, stats.snapshot()["histograms"], now=clock)
+        chunk = max(1, len(times) // 8)
+        for i, s in enumerate(times):
+            red.histogram("http_request_seconds", s,
+                          buckets=SLO_BUCKETS)
+            clock += max(s, 1e-9)
+            if (i + 1) % chunk == 0 or i == len(times) - 1:
+                sent.sample({}, stats.snapshot()["histograms"],
+                            now=clock)
+        snap = sent.slo_snapshot()
+        ep = next((e for e in snap["endpoints"]
+                   if "target" in e), None)
+        if ep is None:
+            log("bench: slo stanza: no tracked endpoint")
+            return
+        partial["slo"] = {
+            "objective": spec,
+            "target": ep["target"],
+            "thresholdS": ep["thresholdS"],
+            "thresholdBucket": ep["thresholdBucket"],
+            "budgetConsumed": round(ep["budgetConsumed"], 6),
+            "budgetRemaining": round(ep["budgetRemaining"], 6),
+            "rates": {k: round(v, 6) if v == v else v
+                      for k, v in ep["rates"].items()},
+            "alertsFired": snap["alerts"]["fired"],
+            "alerts": [e["key"] for e in snap["alerts"]["ring"]
+                       if e["event"] == "fire"],
+        }
+        log(f"bench: slo {spec!r} budget consumed "
+            f"{partial['slo']['budgetConsumed']:.2%}, "
+            f"{snap['alerts']['fired']} alert(s) fired")
+    except Exception as e:
+        log(f"bench: slo stanza failed: {e!r}")
 
 
 def stage_timeline_breakdown(ex, q, partial, iters: int = 3):

@@ -26,6 +26,12 @@ def drain_telemetry(api, watchdog=None, logger=None) -> None:
     shutdown never discards buffered telemetry. Factored out of
     cmd_server's finally block so tests can drive it directly with a
     simulated drain."""
+    # Re-entrancy guard: the drain runs once per API lifetime. A signal
+    # racing the finally block (or a test calling twice) must not dump
+    # every ring a second time into the post-mortem log.
+    if getattr(api, "_telemetry_drained", False):
+        return
+    api._telemetry_drained = True
     if watchdog is not None:
         watchdog.stop()
         watchdog.dump(logger)
@@ -49,6 +55,12 @@ def drain_telemetry(api, watchdog=None, logger=None) -> None:
     from pilosa_tpu.utils.roofline import ROOFLINE
     if ROOFLINE.enabled:
         ROOFLINE.dump(logger)
+    # SLO sentinel (utils/sentinel.py): the budget verdict per
+    # objective + the last alert fire/clear events — whether the
+    # process died inside or outside its objectives.
+    from pilosa_tpu.utils.sentinel import SENTINEL
+    if SENTINEL.enabled:
+        SENTINEL.dump(logger)
     tracer = getattr(api, "tracer", None)
     if tracer is not None:
         # The finished-span ring leaves evidence even when no exporter
@@ -227,6 +239,24 @@ def cmd_server(args) -> int:
                        gbps=cfg.roofline_gbps,
                        ewma_alpha=cfg.roofline_ewma_alpha,
                        max_cohorts=cfg.roofline_max_cohorts)
+    # SLO & regression sentinel ([sentinel]/[slo] sections,
+    # utils/sentinel.py): bounded metrics history + burn-rate alerts,
+    # sampled from the watchdog's extra-gauges hook below. The HBM
+    # pressure condition shares the watchdog's watermark.
+    from pilosa_tpu.core.view import BANK_BUDGET as _SENT_BUDGET
+    from pilosa_tpu.utils.sentinel import SENTINEL
+    SENTINEL.configure(enabled=cfg.sentinel_enabled,
+                       ring=cfg.sentinel_ring,
+                       decimate=cfg.sentinel_decimate,
+                       alert_ring=cfg.sentinel_alert_ring,
+                       objectives=cfg.slo,
+                       watermark_bytes=int(
+                           _SENT_BUDGET.budget
+                           * cfg.telemetry_hbm_watermark))
+    if cfg.slo:
+        logger.printf("slo objectives: %s",
+                      ", ".join(f"{k}: {v}"
+                                for k, v in sorted(cfg.slo.items())))
     # Cross-request cache tier ([cache] section): the generation-keyed
     # result cache lives on the executor, the device rank-cache store
     # is process-wide. The PILOSA_TPU_RESULT_CACHE=0 /
@@ -277,6 +307,14 @@ def cmd_server(args) -> int:
         from pilosa_tpu.utils.memledger import LEDGER, MemoryWatchdog
 
         def _telemetry_gauges():
+            # The sentinel samples its history rings at the watchdog
+            # cadence (gauges must never kill the watchdog — the
+            # sample_once wrapper already swallows, but the queue
+            # gauges below must survive a sentinel bug too).
+            try:
+                api.sample_sentinel()
+            except Exception:
+                pass
             coal = api.coalescer
             return {
                 "queueDepth": (coal.queue_depth()

@@ -120,6 +120,14 @@ class API:
         # Always-on memory watchdog (utils/memledger.MemoryWatchdog),
         # attached by cli/main.py; the health plane reports its state.
         self.watchdog = None
+        # Sentinel node-down edge tracking (sample_sentinel): which
+        # members were down at the previous sample, so the alert ring
+        # sees fire/clear transitions instead of steady-state spam.
+        self._sentinel_down_prev: set = set()
+        # Cached backend label for pilosa_build_info: resolved from an
+        # already-imported jax only (never forces backend init from a
+        # metrics scrape).
+        self._build_backend: Optional[str] = None
         # Adaptive hybrid bank layout (core/layout.py): the background
         # re-layout pass. Constructed unconditionally (its counters
         # and the layout stanza must exist even when the thread is
@@ -823,15 +831,18 @@ class API:
         into the stats client. Called by the watchdog every sample and
         by the /metrics handler so a scrape is never staler than one
         request. Pure host-side dict reads — no device interaction."""
+        import sys as _sys
         from pilosa_tpu.utils.hotspots import WORKLOAD
         from pilosa_tpu.utils.memledger import LEDGER
         from pilosa_tpu.utils.roofline import ROOFLINE
+        from pilosa_tpu.utils.sentinel import SENTINEL
         from pilosa_tpu.utils.timeline import TIMELINE
         # Telemetry rings register their own bytes (category
         # "telemetry") before the ledger publishes, so /debug/memory
         # totals cover the observability plane itself.
         TIMELINE.register_memory(LEDGER)
         ROOFLINE.register_memory(LEDGER)
+        SENTINEL.register_memory(LEDGER)
         if hasattr(self.tracer, "register_memory"):
             self.tracer.register_memory(LEDGER)
         LEDGER.publish(self.stats)
@@ -853,6 +864,26 @@ class API:
         self.layout.publish(self.stats)
         self.stats.gauge("executor.jit_cache_size",
                          self.executor.jit_cache_size())
+        # Sentinel burn/budget/alert gauges (pilosa_slo_*,
+        # pilosa_sentinel_*) ride the same scrape-time refresh.
+        SENTINEL.publish(self.stats)
+        # Process identity on /metrics: uptime (previously only in the
+        # node_health JSON) and the build-info constant gauge every
+        # Prometheus setup joins version rollouts against.
+        self.stats.gauge("process_uptime_seconds",
+                         _time.time() - self._started_at)
+        if self._build_backend in (None, "none"):
+            backend = "none"
+            jaxmod = _sys.modules.get("jax")
+            if jaxmod is not None:
+                try:
+                    backend = str(jaxmod.default_backend())
+                except Exception:
+                    backend = "error"
+            self._build_backend = backend
+        self.stats.with_tags(f"version:{__version__}",
+                             f"backend:{self._build_backend}").gauge(
+            "build_info", 1)
 
     def debug_memory(self, top_k: int = 10) -> Dict[str, Any]:
         """The GET /debug/memory document: per-category live/padded
@@ -949,6 +980,96 @@ class API:
             "meshLaunches": ex.mesh_launches,
             "meshCollectiveBytes": ex.mesh_collective_bytes,
         }
+        return doc
+
+    def sample_sentinel(self) -> None:
+        """One sentinel history tick: gather the key gauges from every
+        plane (host-side dict reads only — no device touch), hand them
+        plus the cumulative RED histograms to the sentinel, and report
+        the edge-triggered alert conditions (roofline drift, HBM
+        watermark pressure, cluster node-down). Called from the memory
+        watchdog's extra-gauges hook at its cadence, and by tests
+        directly with an injected clock."""
+        from pilosa_tpu.utils.memledger import HOST_CATEGORIES, LEDGER
+        from pilosa_tpu.utils.roofline import ROOFLINE
+        from pilosa_tpu.utils.sentinel import SENTINEL
+        from pilosa_tpu.utils.timeline import TIMELINE
+        if not SENTINEL.enabled:
+            return
+        rsnap = ROOFLINE.snapshot()
+        rc = self.executor.result_cache.snapshot()
+        live = padded = 0
+        for cat, t in LEDGER.totals().items():
+            if cat not in HOST_CATEGORIES:
+                live += t["bytes"]
+                padded += t["paddedBytes"]
+        hits = self.executor.rank_cache_hits
+        rebuilds = self.executor.rank_cache_rebuilds
+        coal = self.coalescer
+        gauges = {
+            "device_idle_ratio": TIMELINE.idle_ratio(),
+            "roofline_achieved_gbps": rsnap["achievedGbps"],
+            "roofline_fraction": rsnap["rooflineFraction"],
+            "result_cache_hit_ratio": rc["hitRatio"],
+            "rank_cache_hit_ratio": (hits / (hits + rebuilds)
+                                     if hits + rebuilds else 0.0),
+            "hbm_live_bytes": live,
+            "hbm_padded_bytes": padded,
+            "mesh_collective_bytes":
+                self.executor.mesh_collective_bytes,
+            "coalescer_queue_depth": (coal.queue_depth()
+                                      if coal is not None else 0),
+        }
+        snap_fn = getattr(self.stats, "snapshot", None)
+        histos = (snap_fn() or {}).get("histograms") \
+            if snap_fn is not None else None
+        SENTINEL.sample(gauges=gauges, histograms=histos)
+        flagged = sum(1 for c in rsnap["cohorts"] if c["drift"])
+        SENTINEL.note_condition(
+            "roofline.drift", flagged > 0,
+            f"{flagged} cohort(s) invert the optimizer's predicted "
+            f"cost ordering (see /debug/roofline)", kind="roofline")
+        if SENTINEL.watermark_bytes > 0:
+            SENTINEL.note_condition(
+                "hbm.pressure", live >= SENTINEL.watermark_bytes,
+                f"{live} device bytes ledgered (watermark "
+                f"{SENTINEL.watermark_bytes})", kind="memory")
+        if self.cluster is not None:
+            down = set(getattr(self.cluster, "down_ids", set()))
+            for nid in down:
+                SENTINEL.note_condition(
+                    f"cluster.node_down:{nid}", True,
+                    f"node {nid} marked down by the failure detector",
+                    kind="cluster")
+            for nid in self._sentinel_down_prev - down:
+                SENTINEL.note_condition(
+                    f"cluster.node_down:{nid}", False,
+                    f"node {nid} recovered", kind="cluster")
+            self._sentinel_down_prev = down
+
+    def debug_history(self, series: Optional[List[str]] = None,
+                      last: Optional[int] = None) -> Dict[str, Any]:
+        """The GET /debug/history document (utils/sentinel.py): the
+        bounded per-series history rings (raw + decimated tiers) plus
+        a Perfetto counter-track export (`ph:"C"`) that loads beside
+        the /debug/timeline slices."""
+        from pilosa_tpu.utils.sentinel import SENTINEL
+        node_id, _ = self._node_ident()
+        self.refresh_memory_gauges()
+        doc = SENTINEL.history(series=series, last=last)
+        doc["node"] = node_id
+        return doc
+
+    def debug_slo(self) -> Dict[str, Any]:
+        """The GET /debug/slo document (utils/sentinel.py): declared
+        objectives, per-endpoint error budgets + multi-window burn
+        rates, derived q/s + windowed latency quantiles, and the
+        bounded alert ring."""
+        from pilosa_tpu.utils.sentinel import SENTINEL
+        node_id, _ = self._node_ident()
+        self.refresh_memory_gauges()
+        doc = SENTINEL.slo_snapshot()
+        doc["node"] = node_id
         return doc
 
     @staticmethod
@@ -1048,6 +1169,7 @@ class API:
         cluster_health() merges one of these per node."""
         from pilosa_tpu.utils.hotspots import WORKLOAD
         from pilosa_tpu.utils.memledger import LEDGER
+        from pilosa_tpu.utils.sentinel import SENTINEL as _SENTINEL
         from pilosa_tpu.utils.timeline import TIMELINE as _TIMELINE
         now = _time.time()
         if self.cluster is not None:
@@ -1151,6 +1273,10 @@ class API:
                 "lastSampleAt": (wd.last_sample_at if wd is not None
                                  else None),
             },
+            # SLO sentinel (utils/sentinel.py): objective count, active
+            # burn-rate/condition alerts, worst current burn — the
+            # paging-relevant subset of GET /debug/slo.
+            "slo": _SENTINEL.health_stanza(),
             # Adaptive hybrid layout (core/layout.py): how many views
             # serve sparse, what re-layout reclaimed, when it last ran
             # — the capacity axis in the same health document.
@@ -1197,7 +1323,8 @@ class API:
         tot = {"memoryBytes": 0, "paddingBytes": 0, "queueDepth": 0,
                "jitCacheSize": 0, "retraces": 0, "slowQueries": 0,
                "fragmentReads": 0, "fragmentWrites": 0,
-               "launchBytes": 0, "rooflineDriftFlags": 0}
+               "launchBytes": 0, "rooflineDriftFlags": 0,
+               "sloAlertsActive": 0, "sloAlertsFired": 0}
         for d in nodes:
             mem = d.get("memory") or {}
             tot["memoryBytes"] += int(mem.get("totalBytes", 0))
@@ -1217,6 +1344,11 @@ class API:
             rf = ex.get("roofline") or {}
             tot["launchBytes"] += int(rf.get("launchBytes", 0))
             tot["rooflineDriftFlags"] += int(rf.get("driftFlags", 0))
+            # Fleet-wide alert pressure: any nonzero active count is
+            # the first number an operator reads off /cluster/health.
+            slo = d.get("slo") or {}
+            tot["sloAlertsActive"] += int(slo.get("alertsActive", 0))
+            tot["sloAlertsFired"] += int(slo.get("alertsFired", 0))
         return tot
 
     def cluster_health(self) -> Dict[str, Any]:
@@ -1445,6 +1577,94 @@ class API:
         tot["queryRepeatRatio"] = (
             tot["windowRepeats"] / tot["windowSeen"]
             if tot["windowSeen"] else 0.0)
+        return tot
+
+    def cluster_slo(self) -> Dict[str, Any]:
+        """The GET /cluster/slo document: one debug_slo() snapshot per
+        member — local inline, remote fanned out in parallel over the
+        internal client (the cluster_hotspots pattern) — with a fleet
+        error-budget roll-up per objective. An unreachable node is
+        REPORTED with its error, never dropped: a node whose SLO
+        surface cannot be read is itself an availability fact."""
+        import threading as _threading
+        local = self.debug_slo()
+        if self.cluster is None:
+            nodes = [{"id": self.holder.node_id, "uri": "",
+                      "healthy": True, "slo": local}]
+            return {"totalNodes": 1, "respondedNodes": 1,
+                    "nodes": nodes,
+                    "totals": self._merge_slo_totals(nodes)}
+        docs: Dict[str, Dict[str, Any]] = {}
+        down = set(getattr(self.cluster, "down_ids", set()))
+
+        def fetch(node):
+            if node.id == self.cluster.local.id:
+                docs[node.id] = {"id": node.id, "uri": node.uri,
+                                 "healthy": True, "slo": local}
+                return
+            try:
+                doc = self._client.node_slo(node.uri)
+                if not isinstance(doc, dict):
+                    raise ValueError(f"bad slo body: {doc!r}")
+                docs[node.id] = {"id": node.id, "uri": node.uri,
+                                 "healthy": True, "slo": doc}
+            except Exception as e:
+                docs[node.id] = {"id": node.id, "uri": node.uri,
+                                 "healthy": False,
+                                 "error": f"{type(e).__name__}: {e}"}
+
+        members = list(self.cluster.nodes())
+        threads = [_threading.Thread(target=fetch, args=(n,))
+                   for n in members]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        nodes = []
+        for node in members:
+            doc = docs.get(node.id,
+                           {"id": node.id, "uri": node.uri,
+                            "healthy": False, "error": "no response"})
+            doc["down"] = node.id in down
+            if doc["down"]:
+                doc["healthy"] = False
+            nodes.append(doc)
+        return {
+            "totalNodes": len(nodes),
+            "respondedNodes": sum(1 for d in nodes if "slo" in d),
+            "nodes": nodes,
+            "totals": self._merge_slo_totals(nodes),
+        }
+
+    @staticmethod
+    def _merge_slo_totals(nodes: List[Dict[str, Any]]
+                          ) -> Dict[str, Any]:
+        """Fleet error-budget roll-up over every node that RESPONDED:
+        per-objective bad/total sums re-derive one fleet-wide budget —
+        a node burning alone can hide inside a per-node average, never
+        inside a summed ratio."""
+        tot: Dict[str, Any] = {"alertsActive": 0, "alertsFired": 0,
+                               "endpoints": {}}
+        for d in nodes:
+            doc = d.get("slo") or {}
+            alerts = doc.get("alerts") or {}
+            tot["alertsActive"] += len(alerts.get("active") or [])
+            tot["alertsFired"] += int(alerts.get("fired", 0))
+            for ep in doc.get("endpoints") or []:
+                if "target" not in ep:
+                    continue
+                label = ep.get("alias") or ep["endpoint"]
+                agg = tot["endpoints"].setdefault(
+                    label, {"target": ep["target"], "total": 0,
+                            "bad": 0})
+                agg["total"] += int(ep.get("total", 0))
+                agg["bad"] += int(ep.get("bad", 0))
+        for agg in tot["endpoints"].values():
+            budget = 1.0 - agg["target"]
+            consumed = ((agg["bad"] / agg["total"]) / budget
+                        if agg["total"] and budget > 0 else 0.0)
+            agg["budgetConsumed"] = consumed
+            agg["budgetRemaining"] = max(0.0, 1.0 - consumed)
         return tot
 
     # ---------------------------------------------------------------- status

@@ -69,8 +69,9 @@ _EP_STATIC = frozenset({
     "/", "/schema", "/status", "/info", "/version", "/index",
     "/metrics", "/batch/query", "/export", "/recalculate-caches",
     "/debug/vars", "/debug/queries", "/debug/memory", "/debug/hotspots",
-    "/debug/timeline", "/debug/roofline", "/cluster/health",
-    "/cluster/hotspots",
+    "/debug/timeline", "/debug/roofline", "/debug/history",
+    "/debug/slo", "/cluster/health", "/cluster/hotspots",
+    "/cluster/slo",
     # Internal/cluster routes are fixed strings: an explicit whitelist,
     # NOT a prefix match — unknown paths under these prefixes must fold
     # into "other" like everything else or a scanner mints series.
@@ -434,6 +435,26 @@ class Handler(BaseHTTPRequestHandler):
                 # roofline, and predicted-vs-measured cost-model
                 # residuals ranked by drift.
                 self._json(api.debug_roofline())
+            elif path == "/debug/history":
+                # Metrics history plane (utils/sentinel.py): bounded
+                # per-series rings (raw + decimated) with a Perfetto
+                # counter-track export. ?series=a,b filters, ?last=N
+                # bounds the raw points per series.
+                self._check_args(q, "series", "last")
+                series = [s for s in
+                          (q.get("series") or "").split(",") if s]
+                self._json(api.debug_history(
+                    series=series or None,
+                    last=int(q["last"]) if q.get("last") else None))
+            elif path == "/debug/slo":
+                # SLO engine surface (utils/sentinel.py): objectives,
+                # error budgets, multi-window burn rates, alert ring.
+                self._json(api.debug_slo())
+            elif path == "/cluster/slo":
+                # Coordinator-merged fleet SLO view: one slo snapshot
+                # per node + the fleet error-budget roll-up,
+                # unreachable nodes reported not dropped.
+                self._json(api.cluster_slo())
             elif path == "/cluster/timeline":
                 # Cluster lifecycle timeline (no trace id): merged
                 # membership/failure/resize events from every member —

@@ -268,6 +268,25 @@ class Config:
     # PILOSA_TPU_FAILPOINTS="site=spec;site=spec". Any entry enables
     # the test-only POST /internal/failpoints surface.
     failpoints: dict = field(default_factory=dict)
+    # SLO objectives (utils/sentinel.py): endpoint -> objective spec,
+    # e.g. [slo] query = "99.9% < 25ms". Keys are endpoint labels
+    # ("/index/{index}/query", quoted in TOML) or their last path
+    # segment as a short alias ("query"). Also settable via
+    # PILOSA_TPU_SLO="query=99.9% < 25ms;metrics=99% < 100ms".
+    # Declaring any objective makes the sentinel judge that endpoint's
+    # RED histogram with multi-window burn-rate alerts.
+    slo: dict = field(default_factory=dict)
+    # SLO & regression sentinel (utils/sentinel.py): bounded metrics
+    # history rings sampled at the watchdog cadence + the burn-rate
+    # alert engine. Host-side dict arithmetic only — never fences the
+    # device. `enabled = false` is the kill switch (no sampling, no
+    # alerts; the surfaces serve empty documents). TOML accepts a
+    # [sentinel] table (enabled / ring / decimate / alert_ring) or the
+    # flat sentinel_* spelling; env uses PILOSA_TPU_SENTINEL_*.
+    sentinel_enabled: bool = True
+    sentinel_ring: int = 720       # raw points kept per series
+    sentinel_decimate: int = 10    # raw:decimated tier ratio
+    sentinel_alert_ring: int = 256  # fire/clear events kept
     advertise: str = ""  # URI peers reach us at; default <scheme>://<bind>
     # TLS (reference server/config.go:120-166: TLS.CertificatePath,
     # TLS.CertificateKeyPath, TLS.SkipCertificateVerification; listener
@@ -373,6 +392,19 @@ class Config:
                     raise ValueError(
                         f"failpoint site names must be strings: "
                         f"{site!r}")
+        if self.slo:
+            from pilosa_tpu.utils.sentinel import parse_objective
+            for ep, spec in self.slo.items():
+                if not isinstance(ep, str) or not ep:
+                    raise ValueError(
+                        f"slo endpoint keys must be strings: {ep!r}")
+                parse_objective(str(spec))  # ValueError on bad spec
+        if self.sentinel_ring < 2:
+            raise ValueError("sentinel ring must be >= 2")
+        if self.sentinel_decimate < 1:
+            raise ValueError("sentinel decimate must be >= 1")
+        if self.sentinel_alert_ring < 8:
+            raise ValueError("sentinel alert_ring must be >= 8")
 
     def server_ssl_context(self):
         """ssl.SSLContext for the listener, or None when TLS is off
@@ -442,14 +474,16 @@ def load_config(path: Optional[str] = None,
         settable = {f.name for f in fields(cfg)}
         for k, v in data.items():
             k = k.replace("-", "_")
-            if k == "failpoints":
-                # Site names carry dots ("client.connect") — the table
-                # stays a dict instead of flattening to field names.
+            if k in ("failpoints", "slo"):
+                # Keys carry dots/slashes ("client.connect",
+                # "/index/{index}/query") — these tables stay dicts
+                # instead of flattening to field names.
                 if not isinstance(v, dict):
-                    raise ValueError("[failpoints] must be a table of "
-                                     "site = \"spec\" entries")
-                cfg.failpoints = {str(sk): str(sv)
-                                  for sk, sv in v.items()}
+                    raise ValueError(
+                        f"[{k}] must be a table of "
+                        f"key = \"value\" entries")
+                setattr(cfg, k, {str(sk): str(sv)
+                                 for sk, sv in v.items()})
                 continue
             if isinstance(v, dict):
                 # TOML table, e.g. [coalescer] window_ms = 2.0 -> the

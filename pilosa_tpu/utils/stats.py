@@ -346,6 +346,75 @@ class StatsdStatsClient(StatsClient):
         self._emit(name, f"{self._num(value * 1000.0)}|ms", rate)
 
 
+# Central metric-description registry: exported family name ->
+# # HELP text (one line, plain ASCII). prometheus_text emits exactly
+# one HELP + one TYPE line per family (pinned by test); families not
+# listed here get a generic fallback so every family still carries a
+# HELP line. Keep entries alphabetical within their plane.
+METRIC_HELP: Dict[str, str] = {
+    "pilosa_build_info":
+        "Constant 1 labeled with the server version and jax backend.",
+    "pilosa_coalescer_batch_size":
+        "Queries per coalesced executor batch.",
+    "pilosa_device_idle_ratio":
+        "Fraction of the rolling window the device spent idle between "
+        "dispatches (utils/timeline.py gap analyzer).",
+    "pilosa_executor_fusion_group_size":
+        "Queries fused per executor dispatch group.",
+    "pilosa_executor_jit_cache_size":
+        "Entries in the executor's LRU jit trace cache.",
+    "pilosa_fragment_reads_total":
+        "Fragment read accesses recorded by the workload plane.",
+    "pilosa_fragment_writes_total":
+        "Fragment write accesses recorded by the workload plane.",
+    "pilosa_http_request_seconds":
+        "Per-endpoint RED request latency histogram (pow2 buckets), "
+        "labeled by endpoint and status.",
+    "pilosa_memory_bytes":
+        "Live bytes registered with the memory ledger, per category.",
+    "pilosa_memory_objects":
+        "Live allocations registered with the memory ledger, per "
+        "category.",
+    "pilosa_memory_padding_bytes":
+        "Pow2-padding waste bytes in the memory ledger, per category.",
+    "pilosa_process_uptime_seconds":
+        "Seconds since this server process constructed its API.",
+    "pilosa_query_repeat_ratio":
+        "Fraction of queries in the rolling window that repeat an "
+        "already-seen query identity.",
+    "pilosa_rank_cache_bytes":
+        "Device bytes held by the TopN rank cache.",
+    "pilosa_rank_cache_entries":
+        "Live entries in the TopN rank cache.",
+    "pilosa_roofline_achieved_gbps":
+        "Fence-sampled achieved HBM bandwidth, GB/s.",
+    "pilosa_roofline_cohorts":
+        "Cohort-signature entries tracked by the roofline recorder.",
+    "pilosa_roofline_drift_flagged":
+        "Cohorts currently inverting the optimizer's predicted cost "
+        "ordering.",
+    "pilosa_roofline_drift_total":
+        "Cumulative cost-model drift flags raised.",
+    "pilosa_roofline_fraction":
+        "EWMA of achieved bandwidth over the device roofline.",
+    "pilosa_roofline_gbps":
+        "Configured or auto-resolved device roofline, GB/s.",
+    "pilosa_sentinel_alerts_active":
+        "Alerts currently active in the sentinel (burn-rate + "
+        "conditions).",
+    "pilosa_sentinel_alerts_fired":
+        "Cumulative alerts fired since process start.",
+    "pilosa_sentinel_series":
+        "History series tracked by the sentinel ring store.",
+    "pilosa_slo_burn_rate":
+        "Error-budget burn rate over the trailing window (1.0 = "
+        "burning exactly at budget), labeled by endpoint and window.",
+    "pilosa_slo_error_budget_remaining":
+        "Fraction of the error budget left over the retained history "
+        "span, per endpoint objective.",
+}
+
+
 def prometheus_text(stats: object) -> str:
     """Prometheus text exposition (v0.0.4) of a snapshot()-capable stats
     client — the modern pull-based complement to /debug/vars and the
@@ -394,7 +463,13 @@ def prometheus_text(stats: object) -> str:
     def emit(name: str, typ: str, sample_lines: List[str]) -> None:
         group = families.get(name)
         if group is None:
-            group = families[name] = [f"# TYPE {name} {typ}"]
+            # HELP directly above the family's single TYPE line (the
+            # exposition convention); samples still directly follow
+            # TYPE, so the contiguity pins hold unchanged.
+            help_text = METRIC_HELP.get(
+                name, f"pilosa-tpu metric {name}.")
+            group = families[name] = [f"# HELP {name} {help_text}",
+                                      f"# TYPE {name} {typ}"]
             order.append(name)
         group.extend(sample_lines)
 

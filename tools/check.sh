@@ -809,6 +809,126 @@ with tempfile.TemporaryDirectory() as d:
 print("timeline smoke OK")
 EOF
 
+step "sentinel smoke (burn-rate fire/clear on client.5xx + /debug/history + doctor self-diff)"
+# The SLO plane end to end on a 2-node in-process cluster with an
+# injected sentinel clock (no wall-clock sleeps): history ring fills
+# monotonically, a client.5xx failpoint burst fires the burn-rate
+# alert pair and recovery past the slow window clears it, and a
+# doctor bundle diffed against itself is empty (volatile keys
+# normalized).
+JAX_PLATFORMS=cpu python - <<'EOF' || fail=1
+import json
+import pathlib
+import tempfile
+import time
+import urllib.error
+
+from pilosa_tpu.utils.failpoints import FAILPOINTS
+from pilosa_tpu.utils.sentinel import SENTINEL
+from tests.test_cluster import _seed_bits, req, run_cluster
+from tools.doctor import main as doctor_main, snapshot_bundle
+
+clock = [1000.0]
+SENTINEL.reset()
+# 100 s threshold sits past every finite pow-2 latency bucket, so the
+# objective degrades to availability-only: CI latency noise cannot
+# burn budget here — only the injected 5xx burst can.
+SENTINEL.configure(enabled=True, objectives={"query": "99.9% < 100s"},
+                   clock=lambda: clock[0])
+with tempfile.TemporaryDirectory() as d:
+    nodes = run_cluster(pathlib.Path(d), 2, replica_n=1)
+    try:
+        base = nodes[0].uri
+        _seed_bits(base)
+        api = nodes[0].api
+        sent = [0]
+
+        def settle():
+            # The SLO observation lands AFTER the response bytes hit
+            # the socket; wait for every sent query to be recorded so
+            # a straggler 5xx cannot leak past a sample into the
+            # recovery window.
+            def landed():
+                return sum(
+                    h["count"] for k, h in
+                    api.stats.snapshot()["histograms"].items()
+                    if k.startswith("http_request_seconds")
+                    and "/index/{index}/query" in k)
+            deadline = time.time() + 10.0
+            while landed() < sent[0] and time.time() < deadline:
+                time.sleep(0.005)
+            assert landed() >= sent[0], (landed(), sent[0])
+
+        for _ in range(8):      # warm jit/caches before the baseline
+            sent[0] += 1
+            req(base, "POST", "/index/ci/query", b"Count(Row(f=1))")
+
+        def burst(n=32, expect_5xx=False):
+            bad = 0
+            for _ in range(n):
+                sent[0] += 1
+                try:
+                    req(base, "POST", "/index/ci/query",
+                        b"Count(Row(f=1))")
+                except urllib.error.HTTPError as e:
+                    assert e.code >= 500, e.code
+                    bad += 1
+            assert (bad > 0) == expect_5xx, bad
+            settle()
+            clock[0] += 30.0
+            api.sample_sentinel()
+
+        settle()
+        api.sample_sentinel()   # baseline sample
+        clock[0] += 30.0
+        burst(); burst()        # healthy traffic, >=3 samples total
+        hist = req(base, "GET", "/debug/history")
+        assert hist["samples"] >= 3, hist["samples"]
+        assert len(hist["series"]) >= 3, sorted(hist["series"])
+        for s in hist["series"].values():
+            ts = [p[0] for p in s["points"]]
+            assert ts == sorted(ts), "non-monotone history timestamps"
+        doc = req(base, "GET", "/debug/slo")
+        assert doc["alerts"]["active"] == []
+
+        # Fail the partner's client leg: fan-out queries now 500.
+        port1 = nodes[1].uri.rsplit(":", 1)[1]
+        FAILPOINTS.arm("client.5xx", f"partition(:{port1})")
+        burst(expect_5xx=True)
+        FAILPOINTS.disarm_all()
+        doc = req(base, "GET", "/debug/slo")
+        active = {a["key"] for a in doc["alerts"]["active"]}
+        assert active == {"slo-burn:query:300s",
+                          "slo-burn:query:1800s"}, active
+        met = req(base, "GET", "/metrics", raw=True).decode()
+        assert "pilosa_sentinel_alerts_active 2" in met
+
+        # Recovery: jump past the 6 h slow window; hysteresis clears.
+        clock[0] += 22000.0
+        burst()
+        doc = req(base, "GET", "/debug/slo")
+        assert doc["alerts"]["active"] == [], doc["alerts"]
+        assert doc["alerts"]["cleared"] == 2, doc["alerts"]
+        # The burst stays visible in the consumed budget after clear.
+        ep = next(e for e in doc["endpoints"] if "target" in e)
+        assert ep["budgetConsumed"] > 0, ep
+
+        # Doctor bundle: all surfaces captured, self-diff empty.
+        bundle = snapshot_bundle(base)
+        errs = [k for k, s in bundle["surfaces"].items()
+                if "error" in s]
+        assert not errs, errs
+        p = pathlib.Path(d) / "bundle.json"
+        p.write_text(json.dumps(bundle, default=str))
+        assert doctor_main(["diff", str(p), str(p)]) == 0
+    finally:
+        FAILPOINTS.disarm_all()
+        SENTINEL.reset()
+        for nd in nodes:
+            nd.stop()
+print("sentinel smoke OK")
+EOF
+
 step "hybrid-layout smoke (skewed corpus -> re-layout -> ledger delta + kill-switch identity)"
 # Cache off inside the tool (exact-path differential); plan
 # verification pinned ON so every sparse-expand launch also passes
